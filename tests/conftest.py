@@ -1,0 +1,18 @@
+"""Test harness config.
+
+JAX-facing tests (workloads, __graft_entry__) run on a virtual 8-device CPU
+mesh so multi-chip sharding is exercised hermetically, per the driver's
+dry-run contract. The env vars must be set before the first jax import.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# repo root on sys.path so `import tpushare` works without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,71 @@
+"""Native C++ engine parity: placement.cpp must match select_chips_py.
+
+Randomized differential test over fleets of node states. Skipped when the
+shared object cannot be built (no g++).
+"""
+
+import random
+
+import pytest
+
+from tpushare.core.chips import ChipView
+from tpushare.core.native import engine as native_engine
+from tpushare.core.placement import PlacementRequest, select_chips_py
+from tpushare.core.topology import MeshTopology
+
+pytestmark = pytest.mark.skipif(
+    not native_engine.available(), reason="native engine unavailable")
+
+
+def random_case(rng):
+    n = rng.choice([1, 2, 4, 8, 16])
+    shape = MeshTopology.for_chip_count(n).shape
+    topo = MeshTopology(shape)
+    total = rng.choice([8192, 16276])
+    chips = [
+        ChipView(i, topo.coords(i), total, rng.randrange(0, total + 1),
+                 healthy=rng.random() > 0.15)
+        for i in range(n)
+    ]
+    count = rng.choice([1, 1, 2, 4])
+    topology = None
+    if count > 1 and rng.random() < 0.4:
+        choices = list(topo.box_shapes(count))
+        # rank-mismatched pin exercises the drop-to-scatter path
+        if len(topo.shape) > 1:
+            choices.append((count,))
+        if choices:
+            topology = rng.choice(choices)
+    req = PlacementRequest(
+        hbm_mib=rng.choice([0, 512, 2048, 8138]),
+        chip_count=count,
+        topology=topology,
+        allow_scatter=rng.random() < 0.5,
+    )
+    # input order must not affect the decision in either engine
+    rng.shuffle(chips)
+    return chips, topo, req
+
+
+def test_differential_vs_python():
+    rng = random.Random(7)
+    for trial in range(500):
+        chips, topo, req = random_case(rng)
+        py = select_chips_py(chips, topo, req)
+        nat = native_engine.select_chips(chips, topo, req)
+        if py is None:
+            assert nat is None, (trial, req, chips)
+        else:
+            assert nat is not None, (trial, req, chips)
+            assert nat.chip_ids == py.chip_ids, (trial, req, chips)
+            assert nat.box == py.box, (trial, req)
+            assert nat.score == py.score, (trial, req)
+
+
+def test_topology_pin_parity():
+    topo = MeshTopology((4, 4))
+    chips = [ChipView(i, topo.coords(i), 16000, 0) for i in range(16)]
+    req = PlacementRequest(hbm_mib=1000, chip_count=4, topology=(2, 2))
+    py = select_chips_py(chips, topo, req)
+    nat = native_engine.select_chips(chips, topo, req)
+    assert py.chip_ids == nat.chip_ids and py.box == nat.box

@@ -1,0 +1,185 @@
+"""Placement engine unit + property tests (tpushare/core/placement.py).
+
+Includes the reference design-doc scenarios as golden cases:
+- binpack example (designs.md §2.2): free {12207, 8138, 4069, 16276},
+  request 8138 -> the 8138 device ("min free that fits").
+- node-level vs device-level fit (designs.md §2.1 / README demo 2): 8138
+  spread across two chips must NOT satisfy a single-chip 8138 request.
+"""
+
+import random
+
+import pytest
+
+from tpushare.core.chips import ChipView, node_chips
+from tpushare.core.placement import (
+    PlacementRequest, fits, select_chips_py, utilization_pct, fragmentation)
+from tpushare.core.topology import MeshTopology
+
+
+def mk(frees, total=16276, shape=None):
+    topo = MeshTopology(shape) if shape else MeshTopology.for_chip_count(len(frees))
+    chips = [ChipView(i, topo.coords(i), total, total - f)
+             for i, f in enumerate(frees)]
+    return chips, topo
+
+
+def test_binpack_min_free_that_fits():
+    chips, topo = mk([12207, 8138, 4069, 16276])
+    p = select_chips_py(chips, topo, PlacementRequest(hbm_mib=8138))
+    assert p is not None and p.chip_ids == (1,)
+
+
+def test_device_level_fit_rejects_spread_memory():
+    # 8138 free in total, but 4069 + 4069 on two chips: no single chip fits.
+    chips, topo = mk([4069, 4069])
+    req = PlacementRequest(hbm_mib=8138)
+    assert not fits(chips, topo, req)
+    assert select_chips_py(chips, topo, req) is None
+
+
+def test_single_chip_fit_accepts():
+    chips, topo = mk([4069, 8138])
+    req = PlacementRequest(hbm_mib=8138)
+    assert fits(chips, topo, req)
+    assert select_chips_py(chips, topo, req).chip_ids == (1,)
+
+
+def test_zero_count_normalizes_to_one():
+    req = PlacementRequest(hbm_mib=1024, chip_count=0)
+    assert req.chip_count == 1
+
+
+def test_empty_request_rejected():
+    with pytest.raises(ValueError):
+        PlacementRequest(hbm_mib=0, chip_count=0)
+    with pytest.raises(ValueError):
+        PlacementRequest(hbm_mib=-1)
+
+
+def test_unhealthy_chip_skipped():
+    chips, topo = mk([16276, 16276])
+    chips[0] = ChipView(0, chips[0].coords, 16276, 0, healthy=False)
+    p = select_chips_py(chips, topo, PlacementRequest(hbm_mib=1024))
+    assert p.chip_ids == (1,)
+    chips[1] = ChipView(1, chips[1].coords, 16276, 0, healthy=False)
+    assert select_chips_py(chips, topo, PlacementRequest(hbm_mib=1024)) is None
+
+
+def test_exclusive_chips_require_empty():
+    chips, topo = mk([16000, 16276])  # chip 0 has 276 MiB used
+    req = PlacementRequest(hbm_mib=0, chip_count=1)
+    p = select_chips_py(chips, topo, req)
+    assert p.chip_ids == (1,)
+
+
+def test_multichip_contiguous_2x2_on_v5e16():
+    chips = node_chips(16, 16000, mesh_shape=(4, 4))
+    topo = MeshTopology((4, 4))
+    p = select_chips_py(chips, topo, PlacementRequest(hbm_mib=8000, chip_count=4))
+    assert p is not None and p.contiguous and p.box == (2, 2)
+    coords = [topo.coords(i) for i in p.chip_ids]
+    xs = {c[0] for c in coords}
+    ys = {c[1] for c in coords}
+    assert len(xs) == 2 and len(ys) == 2  # a real 2x2 block
+
+
+def test_multichip_prefers_tighter_pack_within_shape():
+    # two candidate 1x2 boxes on a 1x4 mesh; (2,3) have less free -> chosen
+    chips, topo = mk([16000, 16000, 9000, 9000], shape=(1, 4))
+    p = select_chips_py(chips, topo, PlacementRequest(hbm_mib=8000, chip_count=2))
+    assert p is not None and set(p.chip_ids) == {2, 3}
+
+
+def test_multichip_contiguity_beats_scatter():
+    # Free chips at mesh corners + one free 2-chip strip; contiguous wins.
+    topo = MeshTopology((2, 2))
+    chips = [
+        ChipView(0, (0, 0), 16000, 0),
+        ChipView(1, (0, 1), 16000, 12000),
+        ChipView(2, (1, 0), 16000, 0),
+        ChipView(3, (1, 1), 16000, 12000),
+    ]
+    p = select_chips_py(chips, topo, PlacementRequest(hbm_mib=8000, chip_count=2))
+    assert p.contiguous
+    assert set(p.chip_ids) == {0, 2}  # the (0,0)-(1,0) column
+
+
+def test_multichip_no_contiguous_no_scatter_fails():
+    # diagonal free chips only; contiguity required -> no placement
+    topo = MeshTopology((2, 2))
+    chips = [
+        ChipView(0, (0, 0), 16000, 0),
+        ChipView(1, (0, 1), 16000, 12000),
+        ChipView(2, (1, 0), 16000, 12000),
+        ChipView(3, (1, 1), 16000, 0),
+    ]
+    req = PlacementRequest(hbm_mib=8000, chip_count=2)
+    assert select_chips_py(chips, topo, req) is None
+    assert not fits(chips, topo, req)
+    # ...but scatter opt-in reproduces the reference fork's behavior
+    req2 = PlacementRequest(hbm_mib=8000, chip_count=2, allow_scatter=True)
+    p = select_chips_py(chips, topo, req2)
+    assert p is not None and not p.contiguous and set(p.chip_ids) == {0, 3}
+    assert fits(chips, topo, req2)
+
+
+def test_topology_pin():
+    chips = node_chips(16, 16000, mesh_shape=(4, 4))
+    topo = MeshTopology((4, 4))
+    req = PlacementRequest(hbm_mib=1000, chip_count=4, topology=(1, 4))
+    p = select_chips_py(chips, topo, req)
+    assert p.box == (1, 4)
+    with pytest.raises(ValueError):
+        PlacementRequest(hbm_mib=1, chip_count=4, topology=(2, 3))
+
+
+def test_mesh_mismatch_falls_back_to_1d():
+    # node reports 3 chips but claims a 2x2 mesh: placement still works
+    topo = MeshTopology((2, 2))
+    chips = [ChipView(i, (i,), 16000, 0) for i in range(3)]
+    p = select_chips_py(chips, topo, PlacementRequest(hbm_mib=1000, chip_count=2))
+    assert p is not None and len(p.chip_ids) == 2
+
+
+def test_metrics():
+    chips, _ = mk([8138, 16276], total=16276)
+    assert utilization_pct(chips) == pytest.approx(25.0)
+    assert fragmentation(chips) == pytest.approx(1 - 16276 / (8138 + 16276))
+    full, _ = mk([0, 0])
+    assert fragmentation(full) == 0.0
+    assert utilization_pct([]) == 0.0
+
+
+def test_property_never_oversubscribe_and_fit_select_agree():
+    rng = random.Random(42)
+    for trial in range(300):
+        n = rng.choice([1, 2, 4, 8, 16])
+        total = rng.choice([8192, 16276, 32768])
+        shape = MeshTopology.for_chip_count(n).shape
+        topo = MeshTopology(shape)
+        chips = [
+            ChipView(i, topo.coords(i), total,
+                     rng.randrange(0, total + 1),
+                     healthy=rng.random() > 0.1)
+            for i in range(n)
+        ]
+        req = PlacementRequest(
+            hbm_mib=rng.choice([0, 512, 2048, 8138, total]),
+            chip_count=rng.choice([1, 1, 1, 2, 4]),
+            allow_scatter=rng.random() < 0.5,
+        )
+        if req.hbm_mib == 0 and req.chip_count == 0:
+            continue
+        p = select_chips_py(chips, topo, req)
+        assert fits(chips, topo, req) == (p is not None)
+        if p is None:
+            continue
+        assert len(p.chip_ids) == req.chip_count
+        assert len(set(p.chip_ids)) == req.chip_count
+        for cid in p.chip_ids:
+            c = chips[cid]
+            assert c.healthy
+            demand = req.chip_demand_mib(c.total_hbm_mib)
+            # the invariant: selection never oversubscribes a chip
+            assert c.used_hbm_mib + demand <= c.total_hbm_mib

@@ -1,0 +1,83 @@
+"""Mesh topology unit tests (tpushare/core/topology.py)."""
+
+import pytest
+
+from tpushare.core.topology import MeshTopology
+
+
+def test_coords_index_roundtrip_2d():
+    t = MeshTopology((4, 4))
+    assert t.num_chips == 16
+    for i in range(16):
+        assert t.index(t.coords(i)) == i
+    assert t.coords(0) == (0, 0)
+    assert t.coords(1) == (0, 1)  # last axis fastest (row-major)
+    assert t.coords(4) == (1, 0)
+
+
+def test_coords_index_roundtrip_3d():
+    t = MeshTopology((2, 2, 2))
+    for i in range(8):
+        assert t.index(t.coords(i)) == i
+
+
+def test_invalid_shapes():
+    with pytest.raises(ValueError):
+        MeshTopology(())
+    with pytest.raises(ValueError):
+        MeshTopology((4, 0))
+    with pytest.raises(IndexError):
+        MeshTopology((2, 2)).coords(4)
+    with pytest.raises(IndexError):
+        MeshTopology((2, 2)).index((2, 0))
+
+
+def test_box_shapes_compact_first():
+    t = MeshTopology((4, 4))
+    shapes = t.box_shapes(4)
+    assert shapes[0] == (2, 2)  # square beats 1x4/4x1
+    assert set(shapes) == {(2, 2), (1, 4), (4, 1)}
+    assert t.box_shapes(16) == [(4, 4)]
+    # count that doesn't fit any box
+    assert t.box_shapes(32) == []
+
+
+def test_box_shapes_3d():
+    t = MeshTopology((2, 2, 4))
+    shapes = t.box_shapes(8)
+    assert shapes[0] == (2, 2, 2)
+    assert (1, 2, 4) in shapes
+
+
+def test_box_positions_and_chips():
+    t = MeshTopology((4, 4))
+    pos = t.box_positions((2, 2))
+    assert len(pos) == 9  # 3x3 origins
+    chips = t.box_chips((1, 1), (2, 2))
+    assert chips == [t.index((1, 1)), t.index((1, 2)),
+                     t.index((2, 1)), t.index((2, 2))]
+
+
+def test_neighbors_mesh_edges():
+    t = MeshTopology((4, 4))
+    corner = t.index((0, 0))
+    assert sorted(t.neighbors(corner)) == sorted(
+        [t.index((0, 1)), t.index((1, 0))])
+    middle = t.index((1, 1))
+    assert len(t.neighbors(middle)) == 4
+
+
+def test_from_label_and_back():
+    assert MeshTopology.from_label("4x4").shape == (4, 4)
+    assert MeshTopology.from_label("2x2x4").shape == (2, 2, 4)
+    assert MeshTopology((2, 4)).label() == "2x4"
+    with pytest.raises(ValueError):
+        MeshTopology.from_label("fourbyfour")
+
+
+def test_for_chip_count_default_shapes():
+    assert MeshTopology.for_chip_count(16).shape == (4, 4)
+    assert MeshTopology.for_chip_count(8).shape == (2, 4)
+    assert MeshTopology.for_chip_count(4).shape == (2, 2)
+    assert MeshTopology.for_chip_count(1).shape == (1,)
+    assert MeshTopology.for_chip_count(7).shape == (7,)  # prime -> 1-D

@@ -1,0 +1,42 @@
+"""tpushare — TPU-native fine-grained accelerator-sharing scheduler.
+
+A from-scratch Kubernetes scheduler-extender framework with the capabilities of
+the GPU Share Scheduler Extender (mengwanguc/gpushare-scheduler-extender),
+re-designed for TPU hosts:
+
+- Pods request HBM megabytes (``aliyun.com/tpu-hbm``) and/or chip counts
+  (``aliyun.com/tpu-count``) instead of whole devices.
+- The extender performs per-chip fit checking and binpack placement
+  (reference: pkg/cache/nodeinfo.go), with ICI-mesh-topology awareness so
+  multi-chip requests land on *contiguous* sub-slices — the TPU-native
+  generalization of the reference fork's multi-GPU allocator
+  (nodeinfo.go:312-363).
+- A device plugin enumerates chips (libtpu / /dev/accel scan; reference uses
+  NVML, designs.md:59) and injects ``TPU_VISIBLE_CHIPS`` + HBM-limit env vars
+  at container start (reference injects NVIDIA_VISIBLE_DEVICES,
+  designs.md:95-101).
+- Pod annotations carry the placement decision between extender and device
+  plugin; all state is crash-rebuildable from the apiserver
+  (reference: pkg/cache/cache.go:49-74).
+
+Layer map (mirrors SURVEY.md §1):
+
+====================  =========================================================
+``tpushare.extender`` HTTP wire protocol + routes (reference pkg/routes,
+                      pkg/scheduler)
+``tpushare.cache``    SchedulerCache / NodeInfo / ChipInfo state layer
+                      (reference pkg/cache)
+``tpushare.controller`` informer-style sync loop (reference pkg/gpushare)
+``tpushare.core``     pure placement domain: mesh topology, fit, binpack,
+                      contiguous sub-slice selection (+ native C++ engine)
+``tpushare.contract`` extended-resource names + annotation codec
+                      (reference pkg/utils)
+``tpushare.k8s``      minimal cluster client (fake + in-cluster stdlib HTTP)
+``tpushare.deviceplugin`` node agent: chip enumeration, kubelet Allocate
+                      rendezvous (reference sibling repo, designs.md:53-101)
+``tpushare.workloads`` JAX serving workloads that run under the HBM limits the
+                      plugin injects (samples/ analogue)
+====================  =========================================================
+"""
+
+__version__ = "0.1.0"

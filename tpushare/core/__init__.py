@@ -1,0 +1,30 @@
+"""Pure placement domain: chips, mesh topology, fit/binpack/sub-slice selection.
+
+No Kubernetes types anywhere in this package — it is the hermetically testable
+core that SURVEY.md §7 stage 1 calls for. The extender's Filter path reduces to
+:func:`tpushare.core.placement.fits` and the Bind path to
+:func:`tpushare.core.placement.select_chips`.
+"""
+
+from tpushare.core.chips import ChipView, node_chips
+from tpushare.core.topology import MeshTopology
+from tpushare.core.placement import (
+    PlacementRequest,
+    Placement,
+    fits,
+    select_chips,
+    utilization_pct,
+    fragmentation,
+)
+
+__all__ = [
+    "ChipView",
+    "node_chips",
+    "MeshTopology",
+    "PlacementRequest",
+    "Placement",
+    "fits",
+    "select_chips",
+    "utilization_pct",
+    "fragmentation",
+]

@@ -1,0 +1,71 @@
+"""Chip-level state snapshots used by the placement engine.
+
+The reference models one GPU as ``DeviceInfo{idx, totalGPUMem, podMap}``
+(/root/reference/pkg/cache/deviceinfo.go:12-22) and computes used memory as the
+sum of the pod annotations on that device (deviceinfo.go:41-54). Here the
+mutable pod-tracking lives in :mod:`tpushare.cache`; the placement engine only
+ever sees immutable :class:`ChipView` snapshots, so the hot fit/select path is
+a pure function — trivially testable and portable to the native C++ engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+
+@dataclass(frozen=True, slots=True)
+class ChipView:
+    """Immutable snapshot of one TPU chip at placement time.
+
+    ``coords`` are the chip's ICI-mesh coordinates within its host's topology
+    (e.g. ``(1, 2)`` on a v5e 4x4 host) — the TPU-native datum the reference
+    has no analogue for; its devices are an unordered 1-D array
+    (nodeinfo.go:38-40).
+    """
+
+    idx: int
+    coords: tuple[int, ...]
+    total_hbm_mib: int
+    used_hbm_mib: int = 0
+    healthy: bool = True
+
+    @property
+    def free_hbm_mib(self) -> int:
+        return self.total_hbm_mib - self.used_hbm_mib
+
+    def with_used(self, used_hbm_mib: int) -> "ChipView":
+        return ChipView(self.idx, self.coords, self.total_hbm_mib,
+                        used_hbm_mib, self.healthy)
+
+
+def node_chips(
+    count: int,
+    total_hbm_mib_per_chip: int,
+    mesh_shape: tuple[int, ...] | None = None,
+    used: Sequence[int] | None = None,
+    unhealthy: Sequence[int] = (),
+) -> list[ChipView]:
+    """Build a chip array for one node.
+
+    The reference derives per-device memory as ``node total / device count``
+    (nodeinfo.go:38-40) because the device plugin only reports the aggregate;
+    our device plugin reports per-chip HBM and topology explicitly, but this
+    constructor keeps the same uniform-chip convenience for tests and for
+    nodes whose plugin predates topology labels.
+    """
+    from tpushare.core.topology import MeshTopology
+
+    topo = MeshTopology.for_chip_count(count) if mesh_shape is None \
+        else MeshTopology(mesh_shape)
+    bad = set(unhealthy)
+    return [
+        ChipView(
+            idx=i,
+            coords=topo.coords(i),
+            total_hbm_mib=total_hbm_mib_per_chip,
+            used_hbm_mib=0 if used is None else used[i],
+            healthy=i not in bad,
+        )
+        for i in range(count)
+    ]

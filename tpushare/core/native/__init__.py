@@ -1,0 +1,11 @@
+"""Optional C++ placement engine (ctypes-loaded).
+
+Built from placement.cpp by ``make -C tpushare/core/native`` or lazily on
+first import via g++. Falls back to the pure-Python implementation in
+:mod:`tpushare.core.placement` when the shared object is unavailable — both
+are behaviorally identical (tests/test_native_parity.py).
+"""
+
+from tpushare.core.native.engine import available, select_chips
+
+__all__ = ["available", "select_chips"]

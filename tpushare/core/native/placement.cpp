@@ -1,0 +1,193 @@
+// Native placement engine for tpushare.
+//
+// Behavioral twin of tpushare/core/placement.py::select_chips_py — the Python
+// file is the specification, this file is the speed. Parity is enforced by
+// tests/test_native_parity.py over randomized fleets. Keep the two in
+// lockstep: iteration order, tie-breaking, and score arithmetic all matter.
+//
+// Exposed C ABI (ctypes, see engine.py):
+//   tpushare_select_chips(...) -> 1 placed / 0 no-fit / -1 engine error
+//
+// Design notes: a single TPU host has <= 16 chips and rank <= 3, so all
+// loops are tiny; the win over Python is constant-factor (no allocation, no
+// interpreter) which matters because the extender's Filter fans out over
+// every candidate node in the cluster per pending pod (SURVEY §3.2).
+
+#include <cstdint>
+#include <algorithm>
+#include <vector>
+
+namespace {
+
+struct Shape {
+  std::vector<int64_t> d;
+  int64_t mx() const { return *std::max_element(d.begin(), d.end()); }
+  int64_t mn() const { return *std::min_element(d.begin(), d.end()); }
+};
+
+// Order: (max edge, max-min spread, lexicographic) — most ICI-compact first.
+bool shape_less(const Shape& a, const Shape& b) {
+  if (a.mx() != b.mx()) return a.mx() < b.mx();
+  int64_t sa = a.mx() - a.mn(), sb = b.mx() - b.mn();
+  if (sa != sb) return sa < sb;
+  return a.d < b.d;
+}
+
+void enum_shapes(const int64_t* mesh, int rank, int axis, int64_t remaining,
+                 std::vector<int64_t>& prefix, std::vector<Shape>& out) {
+  if (axis == rank - 1) {
+    if (remaining <= mesh[axis]) {
+      Shape s; s.d = prefix; s.d.push_back(remaining);
+      out.push_back(std::move(s));
+    }
+    return;
+  }
+  for (int64_t d = 1; d <= remaining; ++d) {
+    if (remaining % d == 0 && d <= mesh[axis]) {
+      prefix.push_back(d);
+      enum_shapes(mesh, rank, axis + 1, remaining / d, prefix, out);
+      prefix.pop_back();
+    }
+  }
+}
+
+int64_t chip_index(const int64_t* mesh, int rank, const int64_t* coords) {
+  int64_t idx = 0;
+  for (int i = 0; i < rank; ++i) idx = idx * mesh[i] + coords[i];
+  return idx;
+}
+
+void chip_coords(const int64_t* mesh, int rank, int64_t idx, int64_t* out) {
+  for (int i = rank - 1; i >= 0; --i) { out[i] = idx % mesh[i]; idx /= mesh[i]; }
+}
+
+}  // namespace
+
+extern "C" int tpushare_select_chips(
+    int n_chips,
+    const int64_t* free_hbm,   // -1 => ineligible (unhealthy / exclusive-busy)
+    const int64_t* total_hbm,
+    int rank,
+    const int64_t* mesh,
+    int64_t req_hbm,           // 0 => exclusive (demand = chip total)
+    int req_count,
+    int topo_rank,             // 0 => any shape
+    const int64_t* topo_dims,
+    int allow_scatter,
+    int64_t* out_ids,
+    int64_t* out_box,          // out_box[0] == -1 => scattered
+    int64_t* out_origin,
+    int64_t* out_score) {
+  if (n_chips <= 0 || rank <= 0 || req_count <= 0 || req_count > n_chips)
+    return req_count > n_chips ? 0 : -1;
+  int64_t mesh_n = 1;
+  for (int i = 0; i < rank; ++i) mesh_n *= mesh[i];
+  if (mesh_n != n_chips) return -1;  // caller falls back to Python topo repair
+
+  auto demand = [&](int i) -> int64_t {
+    return req_hbm == 0 ? total_hbm[i] : req_hbm;
+  };
+  auto eligible = [&](int i) -> bool {
+    return free_hbm[i] >= 0 && free_hbm[i] >= demand(i);
+  };
+
+  // --- single chip: min-free-that-fits (nodeinfo.go:283-286 semantics) ---
+  if (req_count == 1) {
+    int best = -1;
+    for (int i = 0; i < n_chips; ++i)
+      if (eligible(i) && (best < 0 || free_hbm[i] < free_hbm[best])) best = i;
+    if (best < 0) return 0;
+    out_ids[0] = best;
+    for (int i = 0; i < rank; ++i) out_box[i] = 1;
+    chip_coords(mesh, rank, best, out_origin);
+    *out_score = free_hbm[best] - demand(best);
+    return 1;
+  }
+
+  // --- multi chip: tightest contiguous sub-box, most-compact shape first ---
+  std::vector<Shape> shapes;
+  if (topo_rank > 0) {
+    if (topo_rank != rank) goto scatter;  // rank-mismatched pin can't match
+    Shape s; s.d.assign(topo_dims, topo_dims + topo_rank);
+    int64_t prod = 1;
+    for (auto d : s.d) prod *= d;
+    if (prod == req_count) shapes.push_back(std::move(s));
+  } else {
+    std::vector<int64_t> prefix;
+    enum_shapes(mesh, rank, 0, req_count, prefix, shapes);
+    std::sort(shapes.begin(), shapes.end(), shape_less);
+  }
+
+  {
+    std::vector<int64_t> origin(rank), best_origin(rank), best_box(rank);
+    std::vector<int64_t> ids, best_ids;
+    for (const auto& shape : shapes) {
+      bool fits_mesh = true;
+      for (int i = 0; i < rank; ++i)
+        if (shape.d[i] > mesh[i]) { fits_mesh = false; break; }
+      if (!fits_mesh) continue;
+
+      bool found = false;
+      int64_t best_score = 0;
+      // iterate origins row-major, last axis fastest (itertools.product order)
+      std::fill(origin.begin(), origin.end(), 0);
+      while (true) {
+        // evaluate box at `origin`
+        ids.clear();
+        int64_t score = 0;
+        bool ok = true;
+        std::vector<int64_t> c(rank);
+        std::fill(c.begin(), c.end(), 0);
+        while (true) {
+          std::vector<int64_t> abs(rank);
+          for (int i = 0; i < rank; ++i) abs[i] = origin[i] + c[i];
+          int64_t idx = chip_index(mesh, rank, abs.data());
+          if (!eligible((int)idx)) { ok = false; break; }
+          ids.push_back(idx);
+          score += free_hbm[idx] - demand((int)idx);
+          int ax = rank - 1;
+          while (ax >= 0 && ++c[ax] == shape.d[ax]) c[ax--] = 0;
+          if (ax < 0) break;
+        }
+        if (ok && (!found || score < best_score)) {
+          found = true;
+          best_score = score;
+          best_ids = ids;
+          best_origin = origin;
+          best_box = shape.d;
+        }
+        int ax = rank - 1;
+        while (ax >= 0 && ++origin[ax] > mesh[ax] - shape.d[ax]) origin[ax--] = 0;
+        if (ax < 0) break;
+      }
+      if (found) {
+        for (size_t i = 0; i < best_ids.size(); ++i) out_ids[i] = best_ids[i];
+        for (int i = 0; i < rank; ++i) {
+          out_box[i] = best_box[i];
+          out_origin[i] = best_origin[i];
+        }
+        *out_score = best_score;
+        return 1;
+      }
+    }
+  }
+
+scatter:
+  if (!allow_scatter) return 0;
+  {
+    std::vector<int> elig;
+    for (int i = 0; i < n_chips; ++i)
+      if (eligible(i)) elig.push_back(i);
+    if ((int)elig.size() < req_count) return 0;
+    std::stable_sort(elig.begin(), elig.end(),
+                     [&](int a, int b) { return free_hbm[a] < free_hbm[b]; });
+    int64_t score = 0;
+    for (int k = 0; k < req_count; ++k) {
+      out_ids[k] = elig[k];
+      score += free_hbm[elig[k]] - demand(elig[k]);
+    }
+    out_box[0] = -1;
+    *out_score = score;
+    return 1;
+  }
+}

@@ -1,0 +1,220 @@
+"""Fit checking and chip selection — the scheduler's decision kernel.
+
+Reference behavior being matched (and then extended):
+
+- Fit check (``Assume``, /root/reference/pkg/cache/nodeinfo.go:147-181):
+  a pod requesting ``mem`` on ``count`` devices fits a node iff there exist
+  ``count`` devices each with ``free >= mem``; ``mem>0 && count==0`` implies
+  ``count=1`` (nodeinfo.go:157-159).
+- Single-device binpack (``allocateGPUID``, nodeinfo.go:265-308): among
+  devices with ``free >= mem`` pick the one with the *least* free memory
+  ("min free that fits") so big holes survive for big pods.
+- Multi-device allocation (fork's ``allocateGPUIDs``, nodeinfo.go:312-363):
+  first-fit N devices each with ``free >= mem``.
+
+TPU-native extension: multi-chip requests are placed on a *contiguous
+axis-aligned sub-box* of the host's ICI mesh (2x2 on v5e for count=4) chosen
+by a binpack score, rather than any N chips. Scatter placement is kept as an
+explicit opt-in fallback (`allow_scatter`) for workloads that do no
+inter-chip communication — that mode reproduces the reference fork's
+semantics exactly.
+
+The same algorithms exist in C++ (tpushare/core/native/placement.cpp) for
+large fleets; `select_chips` transparently uses the native engine when its
+shared object is available. Both implementations are covered by the parity
+tests in tests/test_native_parity.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from tpushare.core.chips import ChipView
+from tpushare.core.topology import MeshTopology
+
+
+@dataclass(frozen=True)
+class PlacementRequest:
+    """What one pod asks of one node.
+
+    ``hbm_mib`` is the per-chip HBM request (the reference's per-device
+    semantics: each of the N devices must offer the full amount,
+    nodeinfo.go:345-350). ``chip_count == 0`` with ``hbm_mib > 0`` is
+    normalized to 1 chip. ``hbm_mib == 0`` with ``chip_count > 0`` means
+    *exclusive* chips (the whole-device case: only completely-free chips
+    qualify). ``topology`` optionally pins the sub-slice shape (e.g. (2, 2));
+    ``allow_scatter`` permits non-contiguous fallback.
+    """
+
+    hbm_mib: int
+    chip_count: int = 1
+    topology: tuple[int, ...] | None = None
+    allow_scatter: bool = False
+
+    def __post_init__(self) -> None:
+        if self.hbm_mib < 0 or self.chip_count < 0:
+            raise ValueError("negative request")
+        if self.hbm_mib == 0 and self.chip_count == 0:
+            raise ValueError("empty request")
+        if self.chip_count == 0:
+            object.__setattr__(self, "chip_count", 1)
+        if self.topology is not None:
+            n = 1
+            for d in self.topology:
+                n *= d
+            if n != self.chip_count:
+                raise ValueError(
+                    f"topology {self.topology} has {n} chips, "
+                    f"request asks for {self.chip_count}")
+
+    @property
+    def exclusive(self) -> bool:
+        return self.hbm_mib == 0
+
+    def chip_demand_mib(self, chip_total: int) -> int:
+        """HBM this request consumes on each selected chip."""
+        return chip_total if self.exclusive else self.hbm_mib
+
+
+@dataclass(frozen=True)
+class Placement:
+    """A concrete device decision: which chips, what shape, how tight."""
+
+    chip_ids: tuple[int, ...]
+    box: tuple[int, ...] | None  # None => scattered (non-contiguous)
+    origin: tuple[int, ...] | None = None
+    score: int = 0  # lower is better (leftover free HBM on chosen chips)
+
+    @property
+    def contiguous(self) -> bool:
+        return self.box is not None
+
+
+def _eligible(chip: ChipView, req: PlacementRequest) -> bool:
+    if not chip.healthy:
+        return False
+    if req.exclusive:
+        return chip.used_hbm_mib == 0
+    return chip.free_hbm_mib >= req.hbm_mib
+
+
+def fits(chips: Sequence[ChipView], topo: MeshTopology,
+         req: PlacementRequest) -> bool:
+    """Filter-path predicate: can this node host the request at all?
+
+    Mirrors ``Assume`` (nodeinfo.go:147-181): count chips with enough free
+    HBM. For contiguity-required multi-chip requests the existence check must
+    consult the mesh, so it delegates to :func:`select_chips` — still O(mesh)
+    small on a single host (<=16 chips on v5e, 8 on v5p hosts).
+    """
+    if req.chip_count == 1 or req.allow_scatter:
+        n = sum(1 for c in chips if _eligible(c, req))
+        return n >= req.chip_count
+    return select_chips(chips, topo, req) is not None
+
+
+def select_chips(chips: Sequence[ChipView], topo: MeshTopology,
+                 req: PlacementRequest) -> Placement | None:
+    """Bind-path selector. Returns the chosen placement or None.
+
+    Single chip: min-free-that-fits binpack (nodeinfo.go:283-286).
+    Multi chip: tightest contiguous sub-box; optional scatter fallback
+    reproducing the fork's first-fit (nodeinfo.go:312-363) — except ordered
+    by the same binpack score instead of device index, which is what drives
+    the anti-fragmentation numbers in bench.py.
+    """
+    from tpushare.core import native  # late import: optional C++ engine
+    if native.available():
+        return native.select_chips(chips, topo, req)
+    return select_chips_py(chips, topo, req)
+
+
+def select_chips_py(chips: Sequence[ChipView], topo: MeshTopology,
+                    req: PlacementRequest) -> Placement | None:
+    """Pure-Python selection (the behavioral specification)."""
+    if len(chips) != topo.num_chips:
+        # Node reported fewer chips than its mesh label claims (partial
+        # breakage): fall back to a 1-D mesh over what exists.
+        topo = MeshTopology((len(chips),))
+
+    if req.chip_count == 1:
+        # tie-break on idx so the decision is identical regardless of input
+        # order and of which engine (Python/C++) evaluates it
+        best: ChipView | None = None
+        for c in chips:
+            if _eligible(c, req) and (
+                    best is None
+                    or (c.free_hbm_mib, c.idx) < (best.free_hbm_mib, best.idx)):
+                best = c
+        if best is None:
+            return None
+        return Placement((best.idx,), box=(1,) * len(topo.shape),
+                         origin=best.coords,
+                         score=best.free_hbm_mib - req.chip_demand_mib(best.total_hbm_mib))
+
+    by_idx = {c.idx: c for c in chips}
+    shapes = [req.topology] if req.topology is not None \
+        else topo.box_shapes(req.chip_count)
+
+    best_p: Placement | None = None
+    for box in shapes:
+        if len(box) != len(topo.shape):
+            continue
+        for origin in topo.box_positions(box):
+            ids = topo.box_chips(origin, box)
+            members = [by_idx[i] for i in ids if i in by_idx]
+            if len(members) != len(ids):
+                continue
+            if not all(_eligible(c, req) for c in members):
+                continue
+            score = sum(
+                c.free_hbm_mib - req.chip_demand_mib(c.total_hbm_mib)
+                for c in members)
+            if best_p is None or score < best_p.score:
+                best_p = Placement(tuple(ids), box=box, origin=origin,
+                                   score=score)
+        if best_p is not None:
+            # shapes are ordered most-ICI-compact first; once any position
+            # works for the best shape class, don't degrade to stringier
+            # boxes just to chase a tighter HBM pack.
+            break
+
+    if best_p is not None:
+        return best_p
+
+    if req.allow_scatter:
+        elig = sorted((c for c in chips if _eligible(c, req)),
+                      key=lambda c: (c.free_hbm_mib, c.idx))
+        if len(elig) >= req.chip_count:
+            chosen = elig[:req.chip_count]
+            return Placement(tuple(c.idx for c in chosen), box=None,
+                             score=sum(
+                                 c.free_hbm_mib - req.chip_demand_mib(c.total_hbm_mib)
+                                 for c in chosen))
+    return None
+
+
+# -- fleet metrics (inspect API + bench) ------------------------------------
+
+def utilization_pct(chips: Sequence[ChipView]) -> float:
+    """Aggregate allocated-HBM / total-HBM, the BASELINE headline metric."""
+    total = sum(c.total_hbm_mib for c in chips)
+    if total == 0:
+        return 0.0
+    return 100.0 * sum(c.used_hbm_mib for c in chips) / total
+
+
+def fragmentation(chips: Sequence[ChipView]) -> float:
+    """1 - (largest single-chip free block / total free HBM).
+
+    0.0 = all free HBM is on one chip (a whole-chip pod could still land);
+    approaching 1.0 = free HBM is dust spread across chips that no large
+    request can use. This is the quantity the min-free-that-fits binpack
+    minimizes, reported via /metrics (SURVEY §6 "chip fragmentation").
+    """
+    free = [c.free_hbm_mib for c in chips if c.healthy]
+    total_free = sum(free)
+    if total_free == 0:
+        return 0.0
+    return 1.0 - max(free) / total_free
