@@ -1,0 +1,254 @@
+"""State-layer tests: NodeInfo assume/allocate, SchedulerCache replay.
+
+Covers the reference's critical paths (SURVEY §3.2 filter, §3.3 bind,
+§3.5 sync) against the FakeCluster, including the failure/rollback and
+optimistic-conflict behaviors, and a concurrency stress proving the
+assume/confirm redesign never oversubscribes.
+"""
+
+import threading
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import AllocationError, SchedulerCache
+from tpushare.cache.nodeinfo import request_from_pod
+from tpushare.k8s import ApiError, FakeCluster
+
+
+def cluster_with_node(chips=4, hbm=16000, mesh=None, name="n1"):
+    fc = FakeCluster()
+    fc.add_tpu_node(name, chips=chips, hbm_per_chip_mib=hbm, mesh=mesh)
+    return fc
+
+
+def test_request_from_pod_normalization():
+    assert request_from_pod(make_pod()) is None
+    r = request_from_pod(make_pod(hbm=2048))
+    assert r.chip_count == 1 and r.hbm_mib == 2048
+    r = request_from_pod(make_pod(count=2))
+    assert r.exclusive and r.chip_count == 2
+    r = request_from_pod(make_pod(hbm=1024, count=4,
+                                  ann={contract.ANN_TOPOLOGY: "2x2"}))
+    assert r.topology == (2, 2)
+    # inconsistent topology pin is dropped, not fatal
+    r = request_from_pod(make_pod(hbm=1024, count=4,
+                                  ann={contract.ANN_TOPOLOGY: "3x1"}))
+    assert r.topology is None
+
+
+def test_allocate_writes_annotations_and_binds():
+    fc = cluster_with_node()
+    cache = SchedulerCache(fc)
+    pod = fc.create_pod(make_pod(hbm=2048, name="p1"))
+    info = cache.get_node_info("n1")
+    ok, _ = info.assume(pod)
+    assert ok
+    placement = info.allocate(pod, fc, now_ns=lambda: 42)
+    assert len(placement.chip_ids) == 1
+    bound = fc.get_pod("default", "p1")
+    assert bound["spec"]["nodeName"] == "n1"
+    ann = bound["metadata"]["annotations"]
+    assert ann[contract.ANN_HBM_POD] == "2048"
+    assert ann[contract.ANN_ASSIGNED] == "false"
+    assert ann[contract.ANN_ASSUME_TIME] == "42"
+    assert contract.chip_ids_from_annotations(bound) == placement.chip_ids
+    # cache reflects the usage
+    d = info.describe()
+    assert d["used_hbm_mib"] == 2048
+
+
+def test_allocate_binpacks_onto_least_free_chip():
+    fc = cluster_with_node(chips=2, hbm=16000)
+    cache = SchedulerCache(fc)
+    info = cache.get_node_info("n1")
+    p1 = fc.create_pod(make_pod(hbm=10000, name="big"))
+    info.allocate(p1, fc)
+    p2 = fc.create_pod(make_pod(hbm=4000, name="small"))
+    placement = info.allocate(p2, fc)
+    # 6000 free on chip0 vs 16000 on chip1: small pod joins chip0
+    big_ids = contract.chip_ids_from_annotations(fc.get_pod("default", "big"))
+    assert placement.chip_ids == big_ids
+
+
+def test_allocate_no_fit_raises():
+    fc = cluster_with_node(chips=1, hbm=4000)
+    info = SchedulerCache(fc).get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=5000, name="p"))
+    ok, reason = info.assume(pod)
+    assert not ok and "no fit" in reason
+    with pytest.raises(AllocationError):
+        info.allocate(pod, fc)
+    assert info.describe()["used_hbm_mib"] == 0
+
+
+def test_allocate_rollback_on_bind_failure():
+    fc = cluster_with_node()
+    info = SchedulerCache(fc).get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2048, name="p"))
+    # someone else binds it AFTER our (stale) copy was fetched
+    fc.bind_pod("default", "p", "n1")
+    with pytest.raises(AllocationError):
+        info.allocate(pod, fc)
+    # reservation fully rolled back
+    assert info.describe()["used_hbm_mib"] == 0
+    # and the losing attempt's annotation patch was reverted, so the pod
+    # doesn't advertise a placement the cache never confirmed
+    after = fc.get_pod("default", "p")
+    assert contract.chip_ids_from_annotations(after) is None
+
+
+def test_allocate_refuses_already_bound_pod():
+    fc = cluster_with_node()
+    info = SchedulerCache(fc).get_node_info("n1")
+    fc.create_pod(make_pod(hbm=2048, name="p"))
+    fc.bind_pod("default", "p", "n1")
+    bound = fc.get_pod("default", "p")  # fresh copy shows the binding
+    rv_before = bound["metadata"]["resourceVersion"]
+    with pytest.raises(AllocationError, match="already bound"):
+        info.allocate(bound, fc)
+    # fail-fast: no write at all reached the apiserver
+    assert fc.get_pod("default", "p")["metadata"]["resourceVersion"] == rv_before
+
+
+def test_allocate_retries_patch_conflict_once():
+    fc = cluster_with_node()
+
+    class FlakyOnce:
+        def __init__(self, inner):
+            self._inner = inner
+            self.failed = False
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def patch_pod(self, ns, name, patch):
+            if not self.failed:
+                self.failed = True
+                raise ApiError(409, "simulated optimistic-lock conflict")
+            return self._inner.patch_pod(ns, name, patch)
+
+    flaky = FlakyOnce(fc)
+    info = SchedulerCache(fc).get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=1024, name="p"))
+    placement = info.allocate(pod, flaky)
+    assert placement is not None
+    assert fc.get_pod("default", "p")["spec"]["nodeName"] == "n1"
+
+
+def test_exclusive_chip_request_via_count_only():
+    fc = cluster_with_node(chips=2, hbm=16000)
+    info = SchedulerCache(fc).get_node_info("n1")
+    shared = fc.create_pod(make_pod(hbm=100, name="shared"))
+    info.allocate(shared, fc)
+    excl = fc.create_pod(make_pod(count=1, name="excl"))
+    placement = info.allocate(excl, fc)
+    # must land on the untouched chip and consume it fully
+    shared_ids = contract.chip_ids_from_annotations(
+        fc.get_pod("default", "shared"))
+    assert placement.chip_ids != shared_ids
+    assert info.describe()["used_hbm_mib"] == 100 + 16000
+    # a second exclusive pod no longer fits
+    excl2 = fc.create_pod(make_pod(count=1, name="excl2"))
+    ok, _ = info.assume(excl2)
+    assert not ok
+
+
+def test_unhealthy_chips_excluded():
+    fc = cluster_with_node(chips=2, hbm=16000)
+    info = SchedulerCache(fc).get_node_info("n1")
+    info.set_unhealthy({0})
+    pod = fc.create_pod(make_pod(hbm=1000, name="p"))
+    placement = info.allocate(pod, fc)
+    assert placement.chip_ids == (1,)
+    info.set_unhealthy({0, 1})
+    ok, _ = info.assume(fc.create_pod(make_pod(hbm=1000, name="q")))
+    assert not ok
+
+
+def test_multichip_allocation_contiguous():
+    fc = cluster_with_node(chips=16, hbm=16000, mesh="4x4")
+    info = SchedulerCache(fc).get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=8000, count=4, name="p"))
+    placement = info.allocate(pod, fc)
+    assert placement.box == (2, 2)
+    ann = fc.get_pod("default", "p")["metadata"]["annotations"]
+    assert ann[contract.ANN_TOPOLOGY] == "2x2"
+    assert info.describe()["used_hbm_mib"] == 4 * 8000
+
+
+def test_build_cache_replays_annotations():
+    fc = cluster_with_node(chips=4, hbm=16000)
+    # pre-existing bound pod with placement annotations (extender restarted)
+    ann = contract.placement_annotations([1, 2], 3000, 16000, now_ns=1)
+    fc.create_pod(make_pod(hbm=3000, count=2, name="old", ann=ann,
+                           phase="Running", node="n1"))
+    # a completed pod must NOT hold chips
+    fc.create_pod(make_pod(hbm=9999, name="done",
+                           ann=contract.placement_annotations([0], 9999, 16000),
+                           phase="Succeeded", node="n1"))
+    cache = SchedulerCache(fc)
+    assert cache.build_cache() == 1
+    d = cache.describe()
+    assert d["used_hbm_mib"] == 2 * 3000
+    node = d["nodes"][0]
+    assert node["chips"][1]["used_hbm_mib"] == 3000
+    assert node["chips"][2]["used_hbm_mib"] == 3000
+
+
+def test_remove_pod_frees_chips():
+    fc = cluster_with_node()
+    cache = SchedulerCache(fc)
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2048, name="p"))
+    info.allocate(pod, fc)
+    bound = fc.get_pod("default", "p")
+    cache.add_or_update_pod(bound)
+    assert cache.known_pod(bound["metadata"]["uid"])
+    cache.remove_pod(bound)
+    assert info.describe()["used_hbm_mib"] == 0
+    assert not cache.known_pod(bound["metadata"]["uid"])
+
+
+def test_update_node_rebuild_preserves_assignments():
+    fc = cluster_with_node(chips=2, hbm=16000)
+    cache = SchedulerCache(fc)
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2048, name="p"))
+    info.allocate(pod, fc)
+    cache.add_or_update_pod(fc.get_pod("default", "p"))
+    # device plugin now reports 4 chips (e.g. after maintenance)
+    grown = fc.add_tpu_node("n1-new", chips=4, hbm_per_chip_mib=16000)
+    grown["metadata"]["name"] = "n1"
+    cache.update_node(grown)
+    assert info.chip_count == 4
+    assert info.describe()["used_hbm_mib"] == 2048
+
+
+def test_concurrent_allocations_never_oversubscribe():
+    fc = cluster_with_node(chips=4, hbm=16000)
+    info = SchedulerCache(fc).get_node_info("n1")
+    pods = [fc.create_pod(make_pod(hbm=5000, name=f"p{i}"))
+            for i in range(16)]
+    results: list = [None] * len(pods)
+
+    def run(i):
+        try:
+            results[i] = info.allocate(pods[i], fc)
+        except AllocationError:
+            results[i] = "denied"
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(pods))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    granted = [r for r in results if r != "denied" and r is not None]
+    # 4 chips x floor(16000/5000)=3 pods -> at most 12 grants
+    assert len(granted) == 12
+    d = info.describe()
+    for chip in d["nodes"][0]["chips"] if "nodes" in d else d["chips"]:
+        assert chip["used_hbm_mib"] <= chip["total_hbm_mib"]
+    assert d["used_hbm_mib"] == 12 * 5000

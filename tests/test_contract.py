@@ -1,0 +1,187 @@
+"""Golden tests for the resource/annotation contract (tpushare/contract)."""
+
+import json
+import uuid
+
+import pytest
+
+from tpushare import contract as c
+from tpushare.contract import pod as podlib
+from tpushare.contract import node as nodelib
+
+
+def make_pod(hbm=0, count=0, ann=None, phase="Pending", node="",
+             name="p1", namespace="default", uid=None, containers=1,
+             deletion=False):
+    if uid is None:
+        uid = f"uid-{uuid.uuid4()}"  # k8s UIDs are always unique
+    limits = {}
+    if hbm:
+        limits[c.RESOURCE_HBM] = str(hbm)
+    if count:
+        limits[c.RESOURCE_COUNT] = str(count)
+    pod = {
+        "metadata": {
+            "name": name, "namespace": namespace, "uid": uid,
+            "annotations": dict(ann or {}),
+        },
+        "spec": {
+            "nodeName": node,
+            "containers": [
+                {"name": f"c{i}", "resources": {"limits": dict(limits)}}
+                for i in range(containers)
+            ],
+        },
+        "status": {"phase": phase},
+    }
+    if deletion:
+        pod["metadata"]["deletionTimestamp"] = "2026-07-29T00:00:00Z"
+    return pod
+
+
+def make_node(name="n1", hbm=0, count=0, mesh=None):
+    node = {
+        "metadata": {"name": name, "labels": {}},
+        "status": {"allocatable": {}},
+    }
+    if hbm:
+        node["status"]["allocatable"][c.RESOURCE_HBM] = str(hbm)
+    if count:
+        node["status"]["allocatable"][c.RESOURCE_COUNT] = str(count)
+    if mesh:
+        node["metadata"]["labels"][c.LABEL_MESH] = mesh
+    return node
+
+
+# -- resource requests -------------------------------------------------------
+
+def test_hbm_request_sums_containers():
+    # reference sums gpu-mem limits across containers (pod.go:154-163)
+    pod = make_pod(hbm=2048, containers=2)
+    assert c.pod_hbm_request(pod) == 4096
+
+
+def test_chip_count_takes_max():
+    # reference takes the max gpu-count across containers (pod.go:167-176)
+    pod = make_pod(hbm=1024, count=4, containers=3)
+    assert c.pod_chip_count_request(pod) == 4
+
+
+def test_requests_absent_are_zero():
+    pod = make_pod()
+    assert c.pod_hbm_request(pod) == 0
+    assert c.pod_chip_count_request(pod) == 0
+    assert not c.is_tpushare_pod(pod)
+
+
+def test_garbage_limit_values_read_as_zero():
+    pod = make_pod(hbm=1024)
+    pod["spec"]["containers"][0]["resources"]["limits"][c.RESOURCE_HBM] = "2Gi"
+    assert c.pod_hbm_request(pod) == 0  # MiB integers only, by contract
+
+
+def test_is_tpushare_pod():
+    assert c.is_tpushare_pod(make_pod(hbm=512))
+    assert c.is_tpushare_pod(make_pod(count=2))
+
+
+# -- lifecycle ----------------------------------------------------------------
+
+def test_complete_pod_phases():
+    assert c.is_complete_pod(make_pod(phase="Succeeded"))
+    assert c.is_complete_pod(make_pod(phase="Failed"))
+    assert not c.is_complete_pod(make_pod(phase="Running"))
+    assert c.is_complete_pod(make_pod(phase="Running", deletion=True))
+
+
+def test_assigned_non_terminated():
+    assert c.is_assigned_non_terminated(make_pod(phase="Running", node="n1"))
+    assert not c.is_assigned_non_terminated(make_pod(phase="Running"))
+    assert not c.is_assigned_non_terminated(
+        make_pod(phase="Succeeded", node="n1"))
+
+
+# -- annotation codec ---------------------------------------------------------
+
+def test_placement_annotations_golden():
+    ann = c.placement_annotations(
+        chip_ids=[5, 0], hbm_mib=2048, chip_total_mib=16276,
+        box=(2, 1), now_ns=123456789)
+    assert ann == {
+        "tpushare.aliyun.com/chip-ids": "[0, 5]",
+        "tpushare.aliyun.com/hbm-pod": "2048",
+        "tpushare.aliyun.com/hbm-chip": "16276",
+        "tpushare.aliyun.com/assigned": "false",
+        "tpushare.aliyun.com/assume-time": "123456789",
+        "tpushare.aliyun.com/topology": "2x1",
+    }
+    patch = c.placement_patch(ann)
+    assert patch == {"metadata": {"annotations": ann}}
+    # round-trip through a pod
+    pod = make_pod(hbm=2048, ann=ann)
+    assert c.chip_ids_from_annotations(pod) == (0, 5)
+    assert c.hbm_from_annotations(pod) == 2048
+    assert c.assume_time_from_annotations(pod) == 123456789
+    assert not c.is_assigned(pod)
+
+
+def test_assigned_patch():
+    assert c.assigned_patch() == {
+        "metadata": {"annotations": {"tpushare.aliyun.com/assigned": "true"}}}
+
+
+@pytest.mark.parametrize("raw", [
+    "not json", "{}", "[1, -2]", '["a"]', "[true]", "[]", "3",
+])
+def test_malformed_chip_ids_decode_to_none(raw):
+    pod = make_pod(ann={c.ANN_CHIP_IDS: raw})
+    assert c.chip_ids_from_annotations(pod) is None
+
+
+def test_malformed_numeric_annotations():
+    pod = make_pod(ann={c.ANN_HBM_POD: "lots", c.ANN_ASSUME_TIME: "noon"})
+    assert c.hbm_from_annotations(pod) == 0
+    assert c.assume_time_from_annotations(pod) == 0
+
+
+def test_topology_request_annotation():
+    assert c.pod_topology_request(make_pod(ann={c.ANN_TOPOLOGY: "2x2"})) == (2, 2)
+    assert c.pod_topology_request(make_pod(ann={c.ANN_TOPOLOGY: "junk"})) is None
+    assert c.pod_topology_request(make_pod(ann={c.ANN_TOPOLOGY: "0x2"})) is None
+    assert c.pod_topology_request(make_pod()) is None
+
+
+def test_pod_key_and_identity():
+    pod = make_pod(name="svc-1", namespace="prod", uid="u-9")
+    assert podlib.pod_key(pod) == "prod/svc-1"
+    assert podlib.pod_uid(pod) == "u-9"
+
+
+# -- node accessors -----------------------------------------------------------
+
+def test_node_capacity_and_sharing():
+    node = make_node(hbm=65104, count=4)
+    assert c.node_hbm_capacity(node) == 65104
+    assert c.node_chip_count(node) == 4
+    assert c.is_tpushare_node(node)
+    assert not c.is_tpushare_node(make_node())
+
+
+def test_node_mesh_label():
+    node = make_node(hbm=65104, count=4, mesh="2x2")
+    topo = c.node_mesh_topology(node)
+    assert topo is not None and topo.shape == (2, 2)
+    # stale label (claims 16 chips, node has 4) is ignored
+    stale = make_node(hbm=65104, count=4, mesh="4x4")
+    assert c.node_mesh_topology(stale) is None
+    # malformed label behaves like no label
+    bad = make_node(hbm=65104, count=4, mesh="2by2")
+    assert c.node_mesh_topology(bad) is None
+    assert c.node_mesh_topology(make_node(hbm=1)) is None
+
+
+def test_node_capacity_fallback_when_no_allocatable():
+    node = {"metadata": {"name": "n"},
+            "status": {"capacity": {c.RESOURCE_HBM: "100"}}}
+    assert c.node_hbm_capacity(node) == 100
+    assert nodelib.node_name(node) == "n"

@@ -1,0 +1,181 @@
+"""Sync-controller integration tests against the FakeCluster watch streams.
+
+These mirror the reference's informer-driven lifecycle (SURVEY §3.5):
+bind-time cache updates become durable, completed pods free chips without an
+explicit deallocate, deletions clean up via the stashed copy, and the
+unhealthy-chip configmap flows into the fit check.
+"""
+
+import time
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller
+from tpushare.controller.controller import parse_unhealthy
+from tpushare.k8s import FakeCluster
+
+
+@pytest.fixture
+def rig():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    ctl.start()
+    yield fc, cache, ctl
+    ctl.stop()
+
+
+def wait_until(fn, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_parse_unhealthy():
+    assert parse_unhealthy({"chips": "0, 2,junk,5"}) == {0, 2, 5}
+    assert parse_unhealthy({"chips": ""}) == set()
+    assert parse_unhealthy(None) == set()
+    assert parse_unhealthy({}) == set()
+
+
+def test_bound_annotated_pod_enters_cache(rig):
+    fc, cache, ctl = rig
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2000, name="p"))
+    info.allocate(pod, fc)  # extender bind path writes annotations + binding
+    assert wait_until(
+        lambda: cache.known_pod(pod["metadata"]["uid"]))
+    assert info.describe()["used_hbm_mib"] == 2000
+
+
+def test_completed_pod_frees_chips(rig):
+    fc, cache, ctl = rig
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2000, name="p"))
+    info.allocate(pod, fc)
+    assert wait_until(lambda: cache.known_pod(pod["metadata"]["uid"]))
+    fc.set_pod_phase("default", "p", "Succeeded")
+    assert wait_until(lambda: info.describe()["used_hbm_mib"] == 0)
+    assert not cache.known_pod(pod["metadata"]["uid"])
+
+
+def test_deleted_pod_cleans_cache_via_stash(rig):
+    fc, cache, ctl = rig
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2000, name="p"))
+    info.allocate(pod, fc)
+    assert wait_until(lambda: cache.known_pod(pod["metadata"]["uid"]))
+    fc.delete_pod("default", "p")
+    assert wait_until(lambda: info.describe()["used_hbm_mib"] == 0)
+
+
+def test_externally_annotated_pod_discovered(rig):
+    # a pod bound+annotated by ANOTHER extender replica must enter the cache
+    fc, cache, ctl = rig
+    ann = contract.placement_annotations([3], 4000, 16000, now_ns=1)
+    fc.create_pod(make_pod(hbm=4000, name="ext", phase="Running",
+                           node="n1", ann=ann))
+    info = cache.get_node_info("n1")
+    assert wait_until(lambda: info.describe()["used_hbm_mib"] == 4000)
+    assert info.describe()["chips"][3]["used_hbm_mib"] == 4000
+
+
+def test_unhealthy_configmap_flows_to_fit_check(rig):
+    fc, cache, ctl = rig
+    info = cache.get_node_info("n1")
+    fc.set_configmap("kube-system", "unhealthy-tpu-n1", {"chips": "0,1,2,3"})
+    assert wait_until(
+        lambda: info.describe()["unhealthy_chips"] == [0, 1, 2, 3])
+    ok, _ = info.assume(make_pod(hbm=100, name="q"))
+    assert not ok
+    # recovery: configmap cleared
+    fc.set_configmap("kube-system", "unhealthy-tpu-n1", {"chips": ""})
+    assert wait_until(lambda: info.describe()["unhealthy_chips"] == [])
+    ok, _ = info.assume(make_pod(hbm=100, name="q"))
+    assert ok
+
+
+def test_unhealthy_configmap_loaded_at_startup():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=16000)
+    fc.set_configmap("kube-system", "unhealthy-tpu-n1", {"chips": "1"})
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    assert cache.get_node_info("n1").describe()["unhealthy_chips"] == [1]
+
+
+def test_irrelevant_update_not_processed(rig):
+    fc, cache, ctl = rig
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2000, name="p"))
+    info.allocate(pod, fc)
+    assert wait_until(lambda: cache.known_pod(pod["metadata"]["uid"]))
+    before = info.describe()["used_hbm_mib"]
+    # label-only change: relevance filter must skip it (no phase change,
+    # pod already known)
+    fc.patch_pod("default", "p", {"metadata": {"labels": {"x": "y"}}})
+    time.sleep(0.2)
+    assert info.describe()["used_hbm_mib"] == before
+
+
+def test_delete_then_recreate_same_name_frees_old_chips(rig):
+    # StatefulSet pattern: web-0 deleted and instantly recreated (new UID).
+    # The OLD pod's chips must be freed even though get_pod would find the
+    # new pod under the same key.
+    fc, cache, ctl = rig
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2000, name="web-0"))
+    info.allocate(pod, fc)
+    assert wait_until(lambda: info.describe()["used_hbm_mib"] == 2000)
+    fc.delete_pod("default", "web-0")
+    fc.create_pod(make_pod(hbm=2000, name="web-0"))  # new UID, Pending
+    assert wait_until(lambda: info.describe()["used_hbm_mib"] == 0)
+
+
+def test_resync_reconciles_missed_delete(rig):
+    fc, cache, ctl = rig
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2000, name="p"))
+    info.allocate(pod, fc)
+    # wait for the watch-driven sync to fully land (pod known), so no
+    # in-flight event can double as the reconciler below
+    assert wait_until(lambda: cache.known_pod(pod["metadata"]["uid"]))
+    assert ctl.drain()
+    # simulate a DELETED event lost during a watch gap: remove from the
+    # store WITHOUT notifying watchers
+    with fc._lock:
+        fc._pods.pop("default/p")
+    time.sleep(0.1)
+    assert info.describe()["used_hbm_mib"] == 2000  # still leaked
+    ctl.resync_once()
+    assert wait_until(lambda: info.describe()["used_hbm_mib"] == 0)
+
+
+def test_resync_clears_unhealthy_after_configmap_deletion(rig):
+    fc, cache, ctl = rig
+    info = cache.get_node_info("n1")
+    fc.set_configmap("kube-system", "unhealthy-tpu-n1", {"chips": "0"})
+    assert wait_until(lambda: info.describe()["unhealthy_chips"] == [0])
+    # configmap deletion missed by the watch: resync reconciles
+    with fc._lock:
+        fc._configmaps.pop("kube-system/unhealthy-tpu-n1")
+    ctl.resync_once()
+    assert info.describe()["unhealthy_chips"] == []
+
+
+def test_node_deletion_removes_nodeinfo(rig):
+    fc, cache, ctl = rig
+    cache.get_node_info("n1")
+    with fc._lock:
+        node = fc._nodes.pop("n1")
+    fc._notify("nodes", "DELETED", node)
+    assert wait_until(lambda: "n1" not in cache.node_names())

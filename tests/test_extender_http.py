@@ -1,0 +1,178 @@
+"""Protocol-level tests: golden extender-API JSON over real HTTP.
+
+SURVEY §4: "POST golden ExtenderArgs/ExtenderBindingArgs JSON at the HTTP
+layer and assert on ExtenderFilterResult/ExtenderBindingResult". The server
+runs on an ephemeral port against a FakeCluster; requests go through
+urllib — the same path an unmodified kube-scheduler would take.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller
+from tpushare.extender.handlers import register_cache_gauges
+from tpushare.extender.metrics import Registry
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+
+
+@pytest.fixture
+def rig():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    fc.add_tpu_node("n2", chips=2, hbm_per_chip_mib=8000)
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    ctl.start()
+    registry = Registry()
+    server = ExtenderServer(cache, fc, registry, host="127.0.0.1", port=0)
+    register_cache_gauges(registry, cache)
+    port = server.start()
+    yield fc, cache, f"http://127.0.0.1:{port}"
+    server.stop()
+    ctl.stop()
+
+
+def post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, json.loads(r.read())
+
+
+def get(url, as_json=True):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        raw = r.read()
+        return r.status, (json.loads(raw) if as_json else raw.decode())
+
+
+def test_filter_golden(rig):
+    fc, cache, base = rig
+    pod = make_pod(hbm=10000, name="p")
+    status, result = post(f"{base}/tpushare-scheduler/filter", {
+        "Pod": pod, "NodeNames": ["n1", "n2", "ghost"]})
+    assert status == 200
+    assert result["NodeNames"] == ["n1"]  # n2 chips are 8000 MiB < 10000
+    assert "n2" in result["FailedNodes"]
+    assert "no fit" in result["FailedNodes"]["n2"]
+    assert "ghost" in result["FailedNodes"]
+    assert result["Error"] == ""
+
+
+def test_filter_non_tpu_pod_passes_everything(rig):
+    fc, cache, base = rig
+    status, result = post(f"{base}/tpushare-scheduler/filter", {
+        "Pod": make_pod(), "NodeNames": ["n1", "n2"]})
+    assert status == 200
+    assert result["NodeNames"] == ["n1", "n2"]
+
+
+def test_filter_nodes_fallback_for_non_cache_capable(rig):
+    fc, cache, base = rig
+    status, result = post(f"{base}/tpushare-scheduler/filter", {
+        "Pod": make_pod(hbm=100),
+        "Nodes": {"items": [fc.get_node("n1")]}})
+    assert status == 200 and result["NodeNames"] == ["n1"]
+
+
+def test_bind_golden_writes_annotations(rig):
+    fc, cache, base = rig
+    created = fc.create_pod(make_pod(hbm=2000, name="p"))
+    status, result = post(f"{base}/tpushare-scheduler/bind", {
+        "PodName": "p", "PodNamespace": "default",
+        "PodUID": created["metadata"]["uid"], "Node": "n1"})
+    assert status == 200 and result["Error"] == ""
+    bound = fc.get_pod("default", "p")
+    assert bound["spec"]["nodeName"] == "n1"
+    assert contract.chip_ids_from_annotations(bound) is not None
+    assert contract.hbm_from_annotations(bound) == 2000
+
+
+def test_bind_failure_returns_500(rig):
+    fc, cache, base = rig
+    created = fc.create_pod(make_pod(hbm=99999, name="toobig"))
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(f"{base}/tpushare-scheduler/bind", {
+            "PodName": "toobig", "PodNamespace": "default",
+            "PodUID": created["metadata"]["uid"], "Node": "n1"})
+    assert e.value.code == 500
+    body = json.loads(e.value.read())
+    assert "no placement" in body["Error"]
+
+
+def test_bind_uid_mismatch_rejected(rig):
+    fc, cache, base = rig
+    fc.create_pod(make_pod(hbm=100, name="p"))
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(f"{base}/tpushare-scheduler/bind", {
+            "PodName": "p", "PodNamespace": "default",
+            "PodUID": "stale-uid", "Node": "n1"})
+    assert e.value.code == 500
+    assert "UID changed" in json.loads(e.value.read())["Error"]
+
+
+def test_inspect_tree_and_node(rig):
+    fc, cache, base = rig
+    created = fc.create_pod(make_pod(hbm=2000, name="p"))
+    post(f"{base}/tpushare-scheduler/bind", {
+        "PodName": "p", "PodNamespace": "default",
+        "PodUID": created["metadata"]["uid"], "Node": "n1"})
+    status, tree = get(f"{base}/tpushare-scheduler/inspect")
+    assert status == 200
+    assert tree["used_hbm_mib"] == 2000
+    assert {n["name"] for n in tree["nodes"]} == {"n1", "n2"}
+    status, node = get(f"{base}/tpushare-scheduler/inspect/n1")
+    assert status == 200 and node["mesh"] == "2x2"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(f"{base}/tpushare-scheduler/inspect/ghost")
+    assert e.value.code == 404
+
+
+def test_version_healthz_metrics(rig):
+    fc, cache, base = rig
+    status, v = get(f"{base}/version")
+    assert status == 200 and "version" in v
+    status, h = get(f"{base}/healthz", as_json=False)
+    assert status == 200 and h == "ok"
+    # generate one bind so latency histogram is non-empty
+    created = fc.create_pod(make_pod(hbm=500, name="m"))
+    post(f"{base}/tpushare-scheduler/bind", {
+        "PodName": "m", "PodNamespace": "default",
+        "PodUID": created["metadata"]["uid"], "Node": "n1"})
+    status, text = get(f"{base}/metrics", as_json=False)
+    assert status == 200
+    assert "tpushare_bind_requests_total 1.0" in text
+    assert "tpushare_bind_seconds_bucket" in text
+    assert 'tpushare_node_hbm{node="n1",metric="utilization_pct"}' in text
+
+
+def test_malformed_json_is_400(rig):
+    fc, cache, base = rig
+    req = urllib.request.Request(
+        f"{base}/tpushare-scheduler/filter", data=b"{not json",
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 400
+
+
+def test_unknown_routes_404(rig):
+    fc, cache, base = rig
+    for path in ["/nope", "/tpushare-scheduler/nope"]:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(f"{base}{path}")
+        assert e.value.code == 404
+
+
+def test_debug_threads(rig):
+    fc, cache, base = rig
+    status, text = get(f"{base}/debug/threads", as_json=False)
+    assert status == 200 and "tpushare-http" in text

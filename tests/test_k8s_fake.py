@@ -1,0 +1,112 @@
+"""FakeCluster semantics tests: the apiserver behaviors the scheduler relies on."""
+
+import threading
+
+import pytest
+
+from tpushare.k8s import ApiError, FakeCluster
+from tpushare.k8s.client import strategic_merge
+from tests.test_contract import make_pod
+
+
+def test_node_seeding_reports_aggregate_resources():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    node = fc.get_node("n1")
+    assert node["status"]["allocatable"]["aliyun.com/tpu-hbm"] == "64000"
+    assert node["status"]["allocatable"]["aliyun.com/tpu-count"] == "4"
+    assert node["metadata"]["labels"]["tpushare.aliyun.com/mesh"] == "2x2"
+
+
+def test_pod_crud_and_conflict():
+    fc = FakeCluster()
+    fc.create_pod(make_pod(hbm=1000, name="a"))
+    with pytest.raises(ApiError) as e:
+        fc.create_pod(make_pod(hbm=1000, name="a"))
+    assert e.value.is_conflict
+    with pytest.raises(ApiError) as e:
+        fc.get_pod("default", "missing")
+    assert e.value.is_not_found
+
+
+def test_patch_merges_annotations_without_clobbering():
+    fc = FakeCluster()
+    fc.create_pod(make_pod(name="a", ann={"keep": "me"}))
+    out = fc.patch_pod("default", "a",
+                       {"metadata": {"annotations": {"new": "val"}}})
+    assert out["metadata"]["annotations"] == {"keep": "me", "new": "val"}
+    # None deletes (strategic merge semantics)
+    out = fc.patch_pod("default", "a",
+                       {"metadata": {"annotations": {"keep": None}}})
+    assert out["metadata"]["annotations"] == {"new": "val"}
+
+
+def test_bind_semantics():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", 1, 16000)
+    created = fc.create_pod(make_pod(name="a"))
+    with pytest.raises(ApiError):  # unknown node
+        fc.bind_pod("default", "a", "ghost")
+    with pytest.raises(ApiError) as e:  # uid precondition
+        fc.bind_pod("default", "a", "n1", uid="wrong")
+    assert e.value.is_conflict
+    fc.bind_pod("default", "a", "n1", uid=created["metadata"]["uid"])
+    assert fc.get_pod("default", "a")["spec"]["nodeName"] == "n1"
+    with pytest.raises(ApiError) as e:  # double bind
+        fc.bind_pod("default", "a", "n1")
+    assert e.value.is_conflict
+
+
+def test_resource_version_bumps():
+    fc = FakeCluster()
+    p1 = fc.create_pod(make_pod(name="a"))
+    p2 = fc.patch_pod("default", "a", {"metadata": {"annotations": {"x": "1"}}})
+    assert int(p2["metadata"]["resourceVersion"]) > \
+        int(p1["metadata"]["resourceVersion"])
+
+
+def test_watch_stream_delivers_lifecycle():
+    fc = FakeCluster()
+    stop = threading.Event()
+    got = []
+
+    def consume():
+        for ev in fc.watch_pods(stop):
+            got.append((ev.type, ev.object["metadata"]["name"]))
+            if len(got) == 3:
+                stop.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    fc.create_pod(make_pod(name="a"))
+    fc.set_pod_phase("default", "a", "Succeeded")
+    fc.delete_pod("default", "a")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got == [("ADDED", "a"), ("MODIFIED", "a"), ("DELETED", "a")]
+
+
+def test_watch_snapshot_isolated_from_store():
+    fc = FakeCluster()
+    stop = threading.Event()
+    events = []
+    t = threading.Thread(target=lambda: [
+        (events.append(e), stop.set()) for e in fc.watch_pods(stop)])
+    t.start()
+    fc.create_pod(make_pod(name="a"))
+    t.join(timeout=5)
+    # mutating the delivered object must not corrupt the store
+    events[0].object["metadata"]["name"] = "hacked"
+    assert fc.get_pod("default", "a")["metadata"]["name"] == "a"
+
+
+def test_strategic_merge_lists_replace():
+    base = {"a": [1, 2], "b": {"c": 1}}
+    assert strategic_merge(base, {"a": [3]}) == {"a": [3], "b": {"c": 1}}
+
+
+def test_configmap_roundtrip():
+    fc = FakeCluster()
+    fc.set_configmap("kube-system", "unhealthy-tpu-n1", {"chips": "0,2"})
+    cm = fc.get_configmap("kube-system", "unhealthy-tpu-n1")
+    assert cm["data"]["chips"] == "0,2"

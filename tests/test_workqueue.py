@@ -1,0 +1,56 @@
+"""WorkQueue contract tests (dedup, dirty-reprocess, retry backoff)."""
+
+import threading
+import time
+
+from tpushare.controller import WorkQueue
+
+
+def test_dedup_while_queued():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert q.get(0.1) == "a"
+    assert q.get(0.1) == "b"
+    assert q.get(0.05) is None
+
+
+def test_dirty_reprocess_after_done():
+    q = WorkQueue()
+    q.add("a")
+    key = q.get(0.1)
+    q.add("a")  # re-added while processing -> must run again after done
+    assert q.get(0.05) is None
+    q.done(key)
+    assert q.get(0.1) == "a"
+
+
+def test_retry_backoff_and_cap():
+    q = WorkQueue(base_delay=0.01, max_delay=0.05, max_retries=2)
+    assert q.retry("k") is True
+    t0 = time.monotonic()
+    assert q.get(1.0) == "k"
+    assert time.monotonic() - t0 >= 0.005
+    q.done("k")
+    assert q.retry("k") is True
+    assert q.get(1.0) == "k"
+    q.done("k")
+    assert q.retry("k") is False  # cap reached -> dropped
+
+
+def test_shutdown_unblocks_getters():
+    q = WorkQueue()
+    out = []
+    t = threading.Thread(target=lambda: out.append(q.get()))
+    t.start()
+    q.shut_down()
+    t.join(timeout=2)
+    assert not t.is_alive() and out == [None]
+
+
+def test_forget_resets_retry_count():
+    q = WorkQueue(max_retries=1)
+    assert q.retry("k") is True
+    q.forget("k")
+    assert q.retry("k") is True  # counter was reset

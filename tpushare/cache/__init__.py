@@ -1,0 +1,17 @@
+"""Scheduler state layer: per-chip allocation tracking, fit check, allocation.
+
+The tpushare analogue of the reference's pkg/cache (SURVEY §2.7): a
+SchedulerCache of NodeInfo objects, each tracking per-chip pod assignments,
+rebuilt from pod annotations at startup and kept consistent by the
+controller. Key departure from the reference: the bind path uses
+assume/confirm reservations instead of holding the node write-lock across
+apiserver round-trips (nodeinfo.go:185 holds it through Patch+Bind), which
+is what keeps schedule-to-bind p50 under the 50 ms target while staying
+oversubscription-safe under concurrent binds.
+"""
+
+from tpushare.cache.chipusage import ChipUsage
+from tpushare.cache.nodeinfo import NodeInfo, AllocationError
+from tpushare.cache.cache import SchedulerCache
+
+__all__ = ["ChipUsage", "NodeInfo", "AllocationError", "SchedulerCache"]

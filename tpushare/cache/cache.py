@@ -1,0 +1,163 @@
+"""SchedulerCache: the cluster-wide allocation state.
+
+Reference: /root/reference/pkg/cache/cache.go. Node-name -> NodeInfo map plus
+a known-pods UID set, lock-guarded; `build_cache` replays assigned tpushare
+pods from their annotations at startup so a crashed/restarted extender
+reconstructs exact chip assignments from the apiserver (cache.go:49-74 — the
+annotations are the durable write-ahead state, SURVEY §5.3b/§5.4).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any
+
+from tpushare import contract
+from tpushare.cache.nodeinfo import NodeInfo
+from tpushare.contract import node as nodelib
+from tpushare.contract import pod as podlib
+from tpushare.k8s.client import ApiError
+
+log = logging.getLogger("tpushare.cache")
+
+
+class SchedulerCache:
+    def __init__(self, cluster) -> None:
+        self._cluster = cluster
+        self._lock = threading.RLock()
+        self._nodes: dict[str, NodeInfo] = {}
+        self._known_pods: dict[str, dict[str, Any]] = {}  # uid -> pod object
+
+    # -- node access ----------------------------------------------------------
+
+    def get_node_info(self, node_name: str) -> NodeInfo:
+        """Fetch-or-create the NodeInfo (reference GetNodeInfo,
+        cache.go:130-165, including lazy creation on first touch)."""
+        with self._lock:
+            info = self._nodes.get(node_name)
+        if info is not None:
+            return info
+        node = self._cluster.get_node(node_name)  # may raise ApiError(404)
+        with self._lock:
+            # double-checked: another thread may have built it meanwhile
+            info = self._nodes.get(node_name)
+            if info is None:
+                info = NodeInfo(node)
+                self._nodes[node_name] = info
+                log.debug("cache: created NodeInfo %s (%d chips x %d MiB)",
+                          node_name, info.chip_count, info.hbm_per_chip)
+        return info
+
+    def update_node(self, node: dict[str, Any]) -> None:
+        name = nodelib.node_name(node)
+        if not contract.is_tpushare_node(node):
+            return
+        with self._lock:
+            info = self._nodes.get(name)
+        if info is None:
+            return  # will be built lazily with fresh data when needed
+        if info.update_node(node):
+            log.info("cache: rebuilt NodeInfo %s after capacity change", name)
+            self._replay_node_pods(info)
+
+    def remove_node(self, node_name: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_name, None)
+
+    def node_names(self) -> list[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    # -- pod lifecycle --------------------------------------------------------
+
+    def known_pod(self, uid: str) -> bool:
+        with self._lock:
+            return uid in self._known_pods
+
+    def add_or_update_pod(self, pod: dict[str, Any]) -> None:
+        """Reference AddOrUpdatePod (cache.go:89-113): place the pod into its
+        node's chip map from annotations and remember it."""
+        node_name = podlib.pod_node_name(pod)
+        if not node_name:
+            return
+        try:
+            info = self.get_node_info(node_name)
+        except ApiError as e:
+            log.warning("cache: node %s for pod %s unavailable: %s",
+                        node_name, podlib.pod_key(pod), e)
+            return
+        # update = remove + re-add (annotations may have changed)
+        info.remove_pod(pod)
+        if info.add_or_update_pod(pod):
+            with self._lock:
+                self._known_pods[podlib.pod_uid(pod)] = pod
+
+    def remove_pod(self, pod: dict[str, Any]) -> None:
+        """Reference RemovePod (cache.go:116-127): completed/deleted pods
+        release their chips."""
+        node_name = podlib.pod_node_name(pod)
+        if node_name:
+            with self._lock:
+                info = self._nodes.get(node_name)
+            if info is not None:
+                info.remove_pod(pod)
+        with self._lock:
+            self._known_pods.pop(podlib.pod_uid(pod), None)
+
+    # -- startup replay -------------------------------------------------------
+
+    def build_cache(self, pods: list[dict[str, Any]] | None = None) -> int:
+        """Replay all assigned, non-terminated tpushare pods with a chip-ids
+        annotation (reference BuildCache, cache.go:49-74). Also primes
+        NodeInfos for every TPU node so Filter doesn't pay lazy-creation
+        latency on first touch. Returns the number of pods replayed.
+
+        ``pods`` lets the caller share one cluster-wide LIST (the controller
+        passes its own)."""
+        for node in self._cluster.list_nodes():
+            if contract.is_tpushare_node(node):
+                name = nodelib.node_name(node)
+                with self._lock:
+                    if name not in self._nodes:
+                        self._nodes[name] = NodeInfo(node)
+        replayed = 0
+        for pod in (self._cluster.list_pods() if pods is None else pods):
+            if not contract.is_tpushare_pod(pod):
+                continue
+            if contract.is_complete_pod(pod):
+                continue
+            if not podlib.pod_node_name(pod):
+                continue
+            if contract.chip_ids_from_annotations(pod) is None:
+                continue
+            self.add_or_update_pod(pod)
+            replayed += 1
+        log.info("cache: replayed %d assigned pods onto %d nodes",
+                 replayed, len(self._nodes))
+        return replayed
+
+    def _replay_node_pods(self, info: NodeInfo) -> None:
+        with self._lock:
+            pods = [p for p in self._known_pods.values()
+                    if podlib.pod_node_name(p) == info.name]
+        for p in pods:
+            info.add_or_update_pod(p)
+
+    # -- inspect --------------------------------------------------------------
+
+    def describe(self) -> dict[str, Any]:
+        """Full cluster allocation tree for the inspect API
+        (reference Inspect.Handler, inspect.go:8-69)."""
+        with self._lock:
+            infos = list(self._nodes.values())
+            pod_index = {uid: p for uid, p in self._known_pods.items()}
+        nodes = [info.describe(pod_index) for info in infos]
+        total = sum(n["total_hbm_mib"] for n in nodes)
+        used = sum(n["used_hbm_mib"] for n in nodes)
+        return {
+            "nodes": nodes,
+            "total_hbm_mib": total,
+            "used_hbm_mib": used,
+            "utilization_pct": round(100.0 * used / total, 2) if total else 0.0,
+        }

@@ -1,0 +1,72 @@
+"""Per-chip pod accounting (the reference's DeviceInfo, deviceinfo.go:12-54).
+
+Differences from the reference:
+- Used HBM is maintained incrementally instead of recomputed by iterating
+  the pod map on every fit check (deviceinfo.go:41-54 sums annotations under
+  a lock in the Filter hot loop).
+- Reservations: a pod being bound occupies HBM *before* its annotation patch
+  lands, so concurrent binds on the same node can't double-book a chip even
+  though no lock is held during the apiserver round-trip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpushare.core.chips import ChipView
+
+
+@dataclass
+class _Entry:
+    hbm_mib: int
+    reserved: bool  # True while the bind-path patch/bind is in flight
+
+
+class ChipUsage:
+    """Mutable allocation state of one chip. Not thread-safe by itself —
+    NodeInfo's lock guards all access (as the reference's per-NodeInfo
+    RWMutex guards its DeviceInfo array)."""
+
+    def __init__(self, idx: int, coords: tuple[int, ...],
+                 total_hbm_mib: int) -> None:
+        self.idx = idx
+        self.coords = coords
+        self.total_hbm_mib = total_hbm_mib
+        self._pods: dict[str, _Entry] = {}  # pod UID -> entry
+
+    @property
+    def used_hbm_mib(self) -> int:
+        return sum(e.hbm_mib for e in self._pods.values())
+
+    @property
+    def pod_uids(self) -> list[str]:
+        return list(self._pods)
+
+    def pod_hbm(self, uid: str) -> int:
+        e = self._pods.get(uid)
+        return e.hbm_mib if e else 0
+
+    def view(self, healthy: bool = True) -> ChipView:
+        return ChipView(self.idx, self.coords, self.total_hbm_mib,
+                        self.used_hbm_mib, healthy)
+
+    # -- mutations (NodeInfo-lock held) --------------------------------------
+
+    def reserve(self, uid: str, hbm_mib: int) -> None:
+        self._pods[uid] = _Entry(hbm_mib, reserved=True)
+
+    def confirm(self, uid: str) -> None:
+        e = self._pods.get(uid)
+        if e:
+            e.reserved = False
+
+    def add_pod(self, uid: str, hbm_mib: int) -> None:
+        """Record a pod known from its annotations (sync/replay path,
+        reference deviceinfo.go addPod)."""
+        self._pods[uid] = _Entry(hbm_mib, reserved=False)
+
+    def remove_pod(self, uid: str) -> bool:
+        return self._pods.pop(uid, None) is not None
+
+    def has_pod(self, uid: str) -> bool:
+        return uid in self._pods

@@ -1,0 +1,300 @@
+"""Per-node allocation state + the assume/allocate scheduling operations.
+
+Reference: NodeInfo (/root/reference/pkg/cache/nodeinfo.go). Same
+responsibilities — fit check (`Assume`, :meth:`NodeInfo.assume`), device
+selection + pod patch + bind (`Allocate`, :meth:`NodeInfo.allocate`),
+annotation-driven bookkeeping (`addOrUpdatePod`, `removePod`) — with three
+deliberate redesigns:
+
+1. **Lock scope.** The reference holds the node write-lock across both
+   apiserver round-trips inside Allocate (nodeinfo.go:185-262), serializing
+   all scheduling on a node behind network latency. Here `allocate` holds
+   the lock only to compute the placement and reserve the chips, releases it
+   for patch+bind, then re-takes it to confirm or roll back. Reservations
+   make the window oversubscription-safe.
+
+2. **Topology.** Device selection goes through
+   :func:`tpushare.core.placement.select_chips` — multi-chip requests land
+   on contiguous ICI sub-slices instead of the reference fork's first-fit N
+   devices (nodeinfo.go:312-363). Scatter remains available per-pod via
+   `allow_scatter`.
+
+3. **Health.** Unhealthy chips are pushed into the cache by the controller's
+   configmap watch instead of re-read from the apiserver on every fit check
+   (nodeinfo.go:406-431 lists configmaps inside Assume).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from tpushare import contract
+from tpushare.cache.chipusage import ChipUsage
+from tpushare.contract import node as nodelib
+from tpushare.contract import pod as podlib
+from tpushare.core.chips import ChipView
+from tpushare.core.placement import Placement, PlacementRequest, fits, select_chips
+from tpushare.core.topology import MeshTopology
+from tpushare.k8s.client import ApiError
+
+
+class AllocationError(Exception):
+    """Bind-path failure; the default scheduler will retry the pod after its
+    timeout (reference designs.md:82)."""
+
+
+def request_from_pod(pod: dict[str, Any]) -> PlacementRequest | None:
+    """Translate a pod's resource limits + annotations into a placement
+    request. Returns None for non-tpushare pods.
+
+    Reference semantics: mem>0 && count==0 -> count=1 (nodeinfo.go:157-159);
+    count>0 means N devices each offering the full per-device amount."""
+    hbm = contract.pod_hbm_request(pod)
+    count = contract.pod_chip_count_request(pod)
+    if hbm <= 0 and count <= 0:
+        return None
+    topology = contract.pod_topology_request(pod)
+    if topology is not None and count > 0:
+        n = 1
+        for d in topology:
+            n *= d
+        if n != count:
+            topology = None  # inconsistent pin; ignore rather than reject
+    return PlacementRequest(
+        hbm_mib=hbm,
+        chip_count=count if count > 0 else 1,
+        topology=topology if count > 1 else None,
+        allow_scatter=(pod.get("metadata", {}).get("annotations") or {})
+        .get("tpushare.aliyun.com/allow-scatter") == "true",
+    )
+
+
+class NodeInfo:
+    def __init__(self, node: dict[str, Any]) -> None:
+        self._lock = threading.RLock()
+        self.name = nodelib.node_name(node)
+        self._unhealthy: set[int] = set()
+        self._init_chips(node)
+
+    def _init_chips(self, node: dict[str, Any]) -> None:
+        count = contract.node_chip_count(node)
+        total_hbm = contract.node_hbm_capacity(node)
+        if count <= 0 and total_hbm > 0:
+            count = 1  # hbm-only node report: treat as one big chip
+        self.chip_count = max(count, 0)
+        # per-chip capacity = node total / count (reference nodeinfo.go:38-40)
+        self.hbm_per_chip = total_hbm // count if count > 0 else 0
+        topo = contract.node_mesh_topology(node)
+        self.topology = topo if topo is not None else (
+            MeshTopology.for_chip_count(count) if count > 0 else MeshTopology((1,)))
+        self.chips: list[ChipUsage] = [
+            ChipUsage(i, self.topology.coords(i), self.hbm_per_chip)
+            for i in range(self.chip_count)
+        ]
+
+    # -- snapshots -----------------------------------------------------------
+
+    def set_unhealthy(self, chip_ids: set[int]) -> None:
+        with self._lock:
+            self._unhealthy = set(chip_ids)
+
+    def snapshot(self) -> list[ChipView]:
+        with self._lock:
+            return [c.view(healthy=c.idx not in self._unhealthy)
+                    for c in self.chips]
+
+    # -- scheduling operations ------------------------------------------------
+
+    def assume(self, pod: dict[str, Any]) -> tuple[bool, str]:
+        """Filter-path fit check (reference Assume, nodeinfo.go:147-181).
+        Returns (fits, reason-if-not)."""
+        req = request_from_pod(pod)
+        if req is None:
+            return True, ""  # not a tpushare pod; nothing to check
+        if self.chip_count == 0:
+            return False, "node has no TPU chips"
+        if fits(self.snapshot(), self.topology, req):
+            return True, ""
+        return False, (
+            f"no fit: need {req.chip_count} chip(s) x {req.hbm_mib} MiB"
+            f"{' contiguous' if req.chip_count > 1 and not req.allow_scatter else ''}"
+            f" on {self.name}")
+
+    def allocate(
+        self,
+        pod: dict[str, Any],
+        cluster,
+        now_ns: Callable[[], int] = time.time_ns,
+    ) -> Placement:
+        """Bind-path: select chips, reserve, patch annotations, bind, confirm.
+
+        Raises AllocationError when no placement exists or the apiserver
+        writes fail (after rolling back the reservation).
+        """
+        req = request_from_pod(pod)
+        if req is None:
+            raise AllocationError(f"pod {podlib.pod_key(pod)} requests no TPU")
+        if podlib.pod_node_name(pod):
+            # already bound (double-delivered bind, or another extender
+            # replica won): refuse BEFORE any write, or we'd overwrite the
+            # live placement annotations with a new decision
+            raise AllocationError(
+                f"pod {podlib.pod_key(pod)} already bound to "
+                f"{podlib.pod_node_name(pod)}")
+        uid = podlib.pod_uid(pod)
+        ns, name = podlib.pod_namespace(pod), podlib.pod_name(pod)
+
+        # phase 1: place + reserve (lock held; pure compute, no I/O)
+        with self._lock:
+            views = [c.view(healthy=c.idx not in self._unhealthy)
+                     for c in self.chips]
+            placement = select_chips(views, self.topology, req)
+            if placement is None:
+                raise AllocationError(
+                    f"no placement for {podlib.pod_key(pod)} on {self.name}")
+            demand = req.chip_demand_mib(self.hbm_per_chip)
+            for cid in placement.chip_ids:
+                self.chips[cid].reserve(uid, demand)
+
+        # phase 2: apiserver writes (no lock held)
+        ann = contract.placement_annotations(
+            chip_ids=placement.chip_ids,
+            hbm_mib=demand,
+            chip_total_mib=self.hbm_per_chip,
+            box=placement.box,
+            now_ns=now_ns(),
+        )
+        # remember prior values so a failed bind can revert the patch
+        # (None = key absent -> delete on revert)
+        old_ann = podlib.annotations(pod)
+        revert = {k: old_ann.get(k) for k in ann}
+        patched = False
+        try:
+            try:
+                cluster.patch_pod(ns, name, contract.placement_patch(ann))
+                patched = True
+            except ApiError as e:
+                if not e.is_conflict:
+                    raise
+                # optimistic-lock loser: refetch and retry once
+                # (reference nodeinfo.go:202-218)
+                fresh = cluster.get_pod(ns, name)
+                if podlib.pod_uid(fresh) != uid:
+                    raise ApiError(409, "pod replaced during bind")
+                if podlib.pod_node_name(fresh):
+                    raise ApiError(409, "pod bound concurrently")
+                cluster.patch_pod(ns, name, contract.placement_patch(ann))
+                patched = True
+            cluster.bind_pod(ns, name, self.name, uid=uid)
+        except ApiError as e:
+            with self._lock:
+                for cid in placement.chip_ids:
+                    self.chips[cid].remove_pod(uid)
+            if patched:
+                # best-effort: restore the previous annotation state — but
+                # only if our values are still the live ones. A concurrent
+                # extender replica may have overwritten them and bound the
+                # pod; reverting then would erase the winner's placement.
+                try:
+                    fresh = cluster.get_pod(ns, name)
+                    # assume-time is a per-attempt ns timestamp: if it still
+                    # matches, the last annotation write was ours
+                    if (podlib.annotations(fresh).get(contract.ANN_ASSUME_TIME)
+                            == ann[contract.ANN_ASSUME_TIME]):
+                        cluster.patch_pod(
+                            ns, name, contract.placement_patch(revert))
+                except ApiError:
+                    pass
+            raise AllocationError(
+                f"bind {podlib.pod_key(pod)} -> {self.name} failed: {e}") from e
+
+        # phase 3: confirm (lock re-taken)
+        with self._lock:
+            for cid in placement.chip_ids:
+                self.chips[cid].confirm(uid)
+        return placement
+
+    # -- sync-path bookkeeping (controller / replay) --------------------------
+
+    def add_or_update_pod(self, pod: dict[str, Any]) -> bool:
+        """Record a pod from its annotations (reference addOrUpdatePod,
+        nodeinfo.go:123-144). Returns True if the pod occupies chips here."""
+        ids = contract.chip_ids_from_annotations(pod)
+        hbm = contract.hbm_from_annotations(pod)
+        if ids is None:
+            return False
+        uid = podlib.pod_uid(pod)
+        with self._lock:
+            for cid in ids:
+                if 0 <= cid < len(self.chips):
+                    self.chips[cid].add_pod(uid, hbm)
+        return True
+
+    def remove_pod(self, pod: dict[str, Any]) -> None:
+        uid = podlib.pod_uid(pod)
+        with self._lock:
+            for c in self.chips:
+                c.remove_pod(uid)
+
+    def update_node(self, node: dict[str, Any]) -> bool:
+        """Node capacity/topology changed (device plugin restarted with
+        different chips): rebuild the chip array, preserving assignments
+        where chip ids still exist. Reference analogue: the Reset repair in
+        GetNodeInfo (cache.go:150-163). Returns True if a rebuild happened."""
+        count = contract.node_chip_count(node)
+        total = contract.node_hbm_capacity(node)
+        per_chip = total // count if count > 0 else 0
+        topo = contract.node_mesh_topology(node)
+        with self._lock:
+            if (count == self.chip_count and per_chip == self.hbm_per_chip
+                    and (topo is None or topo.shape == self.topology.shape)):
+                return False
+            old = self.chips
+            self._init_chips(node)
+            for oc in old:
+                if oc.idx < len(self.chips):
+                    nc = self.chips[oc.idx]
+                    for uid in oc.pod_uids:
+                        nc.add_pod(uid, oc.pod_hbm(uid))
+            return True
+
+    # -- metrics / inspect -----------------------------------------------------
+
+    def describe(self, pod_index: dict[str, dict[str, Any]] | None = None
+                 ) -> dict[str, Any]:
+        """Inspect-API tree for this node (reference buildNode,
+        gpushare-inspect.go:14-37)."""
+        with self._lock:
+            chips = []
+            used_total = 0
+            for c in self.chips:
+                pods = []
+                for uid in c.pod_uids:
+                    entry: dict[str, Any] = {"uid": uid,
+                                             "hbm_mib": c.pod_hbm(uid)}
+                    if pod_index and uid in pod_index:
+                        p = pod_index[uid]
+                        entry["name"] = podlib.pod_name(p)
+                        entry["namespace"] = podlib.pod_namespace(p)
+                    pods.append(entry)
+                used_total += c.used_hbm_mib
+                chips.append({
+                    "idx": c.idx,
+                    "coords": list(c.coords),
+                    "total_hbm_mib": c.total_hbm_mib,
+                    "used_hbm_mib": c.used_hbm_mib,
+                    "healthy": c.idx not in self._unhealthy,
+                    "pods": pods,
+                })
+            return {
+                "name": self.name,
+                "mesh": self.topology.label(),
+                "chip_count": self.chip_count,
+                "hbm_per_chip_mib": self.hbm_per_chip,
+                "total_hbm_mib": self.hbm_per_chip * self.chip_count,
+                "used_hbm_mib": used_total,
+                "unhealthy_chips": sorted(self._unhealthy),
+                "chips": chips,
+            }

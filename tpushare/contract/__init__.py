@@ -1,0 +1,64 @@
+"""The resource/annotation contract between extender, device plugin, and pods.
+
+This is the tpushare analogue of the reference's pkg/utils constants and
+pod/node accessors (/root/reference/pkg/utils/const.go:3-13, pod.go, node.go):
+the *only* shared vocabulary between the scheduler extender (which decides
+chip placement) and the device plugin (which realizes it at container start).
+Everything in here operates on plain dict-shaped Kubernetes objects (the JSON
+the apiserver speaks), so it has no client dependencies and is fully covered
+by golden tests.
+"""
+
+from tpushare.contract.constants import (
+    RESOURCE_HBM,
+    RESOURCE_COUNT,
+    ANN_CHIP_IDS,
+    ANN_HBM_POD,
+    ANN_HBM_CHIP,
+    ANN_ASSIGNED,
+    ANN_ASSUME_TIME,
+    ANN_TOPOLOGY,
+    LABEL_MESH,
+    LABEL_TPUSHARE_NODE,
+    ENV_VISIBLE_CHIPS,
+    ENV_HBM_LIMIT,
+    ENV_HBM_CHIP_TOTAL,
+    ENV_MEM_FRACTION,
+)
+from tpushare.contract.pod import (
+    pod_hbm_request,
+    pod_chip_count_request,
+    pod_topology_request,
+    chip_ids_from_annotations,
+    hbm_from_annotations,
+    assume_time_from_annotations,
+    is_assigned,
+    is_tpushare_pod,
+    is_complete_pod,
+    is_assigned_non_terminated,
+    placement_annotations,
+    placement_patch,
+    assigned_patch,
+)
+from tpushare.contract.node import (
+    node_hbm_capacity,
+    node_chip_count,
+    node_mesh_topology,
+    is_tpushare_node,
+)
+
+__all__ = [
+    "RESOURCE_HBM", "RESOURCE_COUNT",
+    "ANN_CHIP_IDS", "ANN_HBM_POD", "ANN_HBM_CHIP", "ANN_ASSIGNED",
+    "ANN_ASSUME_TIME", "ANN_TOPOLOGY",
+    "LABEL_MESH", "LABEL_TPUSHARE_NODE",
+    "ENV_VISIBLE_CHIPS", "ENV_HBM_LIMIT", "ENV_HBM_CHIP_TOTAL",
+    "ENV_MEM_FRACTION",
+    "pod_hbm_request", "pod_chip_count_request", "pod_topology_request",
+    "chip_ids_from_annotations", "hbm_from_annotations",
+    "assume_time_from_annotations", "is_assigned",
+    "is_tpushare_pod", "is_complete_pod", "is_assigned_non_terminated",
+    "placement_annotations", "placement_patch", "assigned_patch",
+    "node_hbm_capacity", "node_chip_count", "node_mesh_topology",
+    "is_tpushare_node",
+]

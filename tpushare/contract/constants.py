@@ -1,0 +1,62 @@
+"""Shared names: extended resources, annotations, labels, env vars.
+
+Reference contract being paralleled (/root/reference/pkg/utils/const.go:3-13):
+
+=========================  ================================================
+reference (GPU)            tpushare (TPU)
+=========================  ================================================
+aliyun.com/gpu-mem         aliyun.com/tpu-hbm          (MiB, extended res)
+aliyun.com/gpu-count       aliyun.com/tpu-count        (chips, extended res)
+ALIYUN_COM_GPU_MEM_IDX     tpushare.aliyun.com/chip-ids  (JSON int list)
+ALIYUN_COM_GPU_MEM_POD     tpushare.aliyun.com/hbm-pod   (per-chip MiB ask)
+ALIYUN_COM_GPU_MEM_DEV     tpushare.aliyun.com/hbm-chip  (per-chip MiB total)
+..._MEM_ASSIGNED           tpushare.aliyun.com/assigned  ("false" at bind,
+                                                         "true" at runtime)
+..._MEM_ASSUME_TIME        tpushare.aliyun.com/assume-time (ns timestamp)
+(none)                     tpushare.aliyun.com/topology  (requested box, "2x2")
+NVIDIA_VISIBLE_DEVICES     TPU_VISIBLE_CHIPS (env, container)
+=========================  ================================================
+
+Two deliberate departures from the reference:
+
+- Annotations are namespaced under ``tpushare.aliyun.com/`` instead of the
+  reference's bare upper-case env-style keys (const.go:8-12) — annotation
+  keys with a DNS-subdomain prefix are the k8s API convention and avoid
+  collisions.
+- The chip-id list is JSON (``"[0, 5]"``) rather than Go's ``fmt.Sprintf
+  map`` dump (pod.go:234), so the device plugin parses it without
+  stringly-typed heuristics.
+"""
+
+# -- extended resources (node capacity / pod requests) -----------------------
+RESOURCE_HBM = "aliyun.com/tpu-hbm"      # schedulable unit: MiB of chip HBM
+RESOURCE_COUNT = "aliyun.com/tpu-count"  # number of distinct chips
+
+# -- pod annotations (the extender -> device-plugin channel) -----------------
+_PREFIX = "tpushare.aliyun.com/"
+ANN_CHIP_IDS = _PREFIX + "chip-ids"         # JSON list of chip indices
+ANN_HBM_POD = _PREFIX + "hbm-pod"           # per-chip HBM granted, MiB
+ANN_HBM_CHIP = _PREFIX + "hbm-chip"         # per-chip HBM total, MiB
+ANN_ASSIGNED = _PREFIX + "assigned"         # "false" at bind; "true" at runtime
+ANN_ASSUME_TIME = _PREFIX + "assume-time"   # bind timestamp, ns since epoch
+ANN_TOPOLOGY = _PREFIX + "topology"         # granted sub-slice shape, "2x2"
+
+# -- node labels (published by the device plugin) ----------------------------
+LABEL_TPUSHARE_NODE = "tpushare"            # "true" enables the DaemonSet
+LABEL_MESH = _PREFIX + "mesh"               # host ICI mesh shape, e.g. "4x4"
+
+# -- container env (injected by the device plugin at Allocate) ---------------
+ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"         # e.g. "0,1,4,5"
+ENV_HBM_LIMIT = "TPUSHARE_HBM_LIMIT_MIB"        # per-chip grant, MiB
+ENV_HBM_CHIP_TOTAL = "TPUSHARE_HBM_CHIP_TOTAL_MIB"
+# The XLA knob that makes the grant effective inside JAX workloads — the
+# analogue of the TF per_process_gpu_memory_fraction guidance in the
+# reference's userguide.md:67-77:
+ENV_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+
+# -- unhealthy-chip configmap (operator-maintained, kube-system) -------------
+# reference: configmap "unhealthy-gpu-<node>" key "gpus" = CSV device ids
+# (/root/reference/pkg/cache/nodeinfo.go:406-431, configmap.go:20-34)
+UNHEALTHY_CM_NAMESPACE = "kube-system"
+UNHEALTHY_CM_PREFIX = "unhealthy-tpu-"      # configmap name: prefix + node
+UNHEALTHY_CM_KEY = "chips"                  # CSV chip indices

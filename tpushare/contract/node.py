@@ -1,0 +1,70 @@
+"""Node accessors: capacity, chip count, mesh topology.
+
+Reference equivalents: GetTotalGPUMemory / GetGPUCountInNode read
+``node.Status.Capacity`` (/root/reference/pkg/utils/node.go:11-30);
+IsGPUSharingNode is "capacity > 0" (node.go:6-8). The mesh label is new —
+the reference's device array is geometry-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from tpushare.contract.constants import (
+    LABEL_MESH,
+    RESOURCE_COUNT,
+    RESOURCE_HBM,
+)
+from tpushare.core.topology import MeshTopology
+
+Node = Mapping[str, Any]
+
+
+def node_name(node: Node) -> str:
+    return (node.get("metadata") or {}).get("name", "")
+
+
+def _capacity(node: Node) -> Mapping[str, Any]:
+    status = node.get("status") or {}
+    # allocatable preferred; capacity as fallback (kubelet reports both)
+    return status.get("allocatable") or status.get("capacity") or {}
+
+
+def node_hbm_capacity(node: Node) -> int:
+    """Total schedulable HBM MiB on the node (all chips)."""
+    try:
+        return int(_capacity(node).get(RESOURCE_HBM, 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def node_chip_count(node: Node) -> int:
+    try:
+        return int(_capacity(node).get(RESOURCE_COUNT, 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def is_tpushare_node(node: Node) -> bool:
+    return node_hbm_capacity(node) > 0
+
+
+def node_mesh_topology(node: Node) -> MeshTopology | None:
+    """Host ICI mesh from the device plugin's label, if published.
+
+    Returns None for unlabeled nodes; callers fall back to
+    MeshTopology.for_chip_count (and a malformed label behaves like no
+    label rather than poisoning the scheduler).
+    """
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    raw = labels.get(LABEL_MESH)
+    if not raw:
+        return None
+    try:
+        topo = MeshTopology.from_label(raw)
+    except ValueError:
+        return None
+    count = node_chip_count(node)
+    if count and topo.num_chips != count:
+        return None  # stale label; geometry no longer trustworthy
+    return topo

@@ -1,0 +1,201 @@
+"""Pod accessors and annotation codec over dict-shaped k8s objects.
+
+Mirrors the behavior of /root/reference/pkg/utils/pod.go:
+
+- HBM request = sum of container *limits* (pod.go:154-163 sums gpu-mem).
+- Chip count = max of container limits (pod.go:167-176 takes the max).
+- Lifecycle predicates match IsCompletePod / AssignedNonTerminatedPod /
+  IsGPUsharingPod (pod.go:21-50).
+- The placement writer emits a strategic-merge patch fragment the same way
+  PatchPodAnnotationSpec does (pod.go:230-241), but with JSON-typed values.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Mapping
+
+from tpushare.contract.constants import (
+    ANN_ASSIGNED,
+    ANN_ASSUME_TIME,
+    ANN_CHIP_IDS,
+    ANN_HBM_CHIP,
+    ANN_HBM_POD,
+    ANN_TOPOLOGY,
+    RESOURCE_COUNT,
+    RESOURCE_HBM,
+)
+
+Pod = Mapping[str, Any]
+
+
+def _meta(pod: Pod) -> Mapping[str, Any]:
+    return pod.get("metadata") or {}
+
+
+def pod_name(pod: Pod) -> str:
+    return _meta(pod).get("name", "")
+
+
+def pod_namespace(pod: Pod) -> str:
+    return _meta(pod).get("namespace", "default")
+
+
+def pod_uid(pod: Pod) -> str:
+    return _meta(pod).get("uid", "")
+
+
+def pod_key(pod: Pod) -> str:
+    """``namespace/name`` — the workqueue/cache key format."""
+    return f"{pod_namespace(pod)}/{pod_name(pod)}"
+
+
+def pod_node_name(pod: Pod) -> str:
+    return (pod.get("spec") or {}).get("nodeName", "")
+
+
+def annotations(pod: Pod) -> Mapping[str, str]:
+    return _meta(pod).get("annotations") or {}
+
+
+def _containers(pod: Pod) -> list[Mapping[str, Any]]:
+    return (pod.get("spec") or {}).get("containers") or []
+
+
+def _limit(container: Mapping[str, Any], resource: str) -> int:
+    limits = ((container.get("resources") or {}).get("limits") or {})
+    v = limits.get(resource, 0)
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return 0
+
+
+# -- resource requests -------------------------------------------------------
+
+def pod_hbm_request(pod: Pod) -> int:
+    """Per-chip HBM the pod asks for: sum of container limits (MiB)."""
+    return sum(_limit(c, RESOURCE_HBM) for c in _containers(pod))
+
+
+def pod_chip_count_request(pod: Pod) -> int:
+    """Chips the pod asks for: max across containers (reference semantics)."""
+    counts = [_limit(c, RESOURCE_COUNT) for c in _containers(pod)]
+    return max(counts, default=0)
+
+
+def pod_topology_request(pod: Pod) -> tuple[int, ...] | None:
+    """Optional pinned sub-slice shape from the pod's own annotation."""
+    raw = annotations(pod).get(ANN_TOPOLOGY)
+    if not raw:
+        return None
+    from tpushare.core.topology import MeshTopology  # single "NxM" parser
+    try:
+        return MeshTopology.from_label(raw).shape
+    except ValueError:
+        return None
+
+
+# -- lifecycle predicates ----------------------------------------------------
+
+def is_tpushare_pod(pod: Pod) -> bool:
+    """Does this pod participate in HBM-shared scheduling?
+
+    True when it requests tpu-hbm (or tpu-count) — the filter the reference
+    applies via IsGPUsharingPod (pod.go:46-50) and as the informer filter
+    (controller.go:78-94).
+    """
+    return pod_hbm_request(pod) > 0 or pod_chip_count_request(pod) > 0
+
+
+def is_complete_pod(pod: Pod) -> bool:
+    """Terminal pods release their chips (pod.go:21-32 semantics)."""
+    status = pod.get("status") or {}
+    if _meta(pod).get("deletionTimestamp"):
+        return True
+    return status.get("phase") in ("Succeeded", "Failed")
+
+
+def is_assigned_non_terminated(pod: Pod) -> bool:
+    """Scheduled to a node and not yet terminal (pod.go:35-43 semantics)."""
+    return bool(pod_node_name(pod)) and not is_complete_pod(pod)
+
+
+# -- annotation codec --------------------------------------------------------
+
+def chip_ids_from_annotations(pod: Pod) -> tuple[int, ...] | None:
+    """Decode the granted chip ids, or None if the pod has no placement.
+
+    Accepts the canonical JSON list; a malformed value decodes to None (the
+    sync layer treats such pods as unplaced rather than crashing the
+    scheduler, unlike a panic path).
+    """
+    raw = annotations(pod).get(ANN_CHIP_IDS)
+    if raw is None:
+        return None
+    try:
+        ids = json.loads(raw)
+        if isinstance(ids, list) and all(
+                isinstance(i, int) and not isinstance(i, bool) and i >= 0
+                for i in ids) and ids:
+            return tuple(ids)
+    except (json.JSONDecodeError, TypeError):
+        pass
+    return None
+
+
+def hbm_from_annotations(pod: Pod) -> int:
+    """Granted per-chip HBM MiB recorded at bind time (0 if absent)."""
+    raw = annotations(pod).get(ANN_HBM_POD)
+    try:
+        return max(int(raw), 0) if raw is not None else 0
+    except (TypeError, ValueError):
+        return 0
+
+
+def assume_time_from_annotations(pod: Pod) -> int:
+    raw = annotations(pod).get(ANN_ASSUME_TIME)
+    try:
+        return int(raw) if raw is not None else 0
+    except (TypeError, ValueError):
+        return 0
+
+
+def is_assigned(pod: Pod) -> bool:
+    return annotations(pod).get(ANN_ASSIGNED) == "true"
+
+
+def placement_annotations(
+    chip_ids: tuple[int, ...] | list[int],
+    hbm_mib: int,
+    chip_total_mib: int,
+    box: tuple[int, ...] | None = None,
+    now_ns: int | None = None,
+) -> dict[str, str]:
+    """The annotation set the extender writes at bind time.
+
+    Reference equivalent: PatchPodAnnotationSpec writes _IDX/_POD/_DEV/
+    _ASSIGNED=false/_ASSUME_TIME (pod.go:230-241, designs.md:82-91).
+    """
+    ann = {
+        ANN_CHIP_IDS: json.dumps(sorted(int(i) for i in chip_ids)),
+        ANN_HBM_POD: str(int(hbm_mib)),
+        ANN_HBM_CHIP: str(int(chip_total_mib)),
+        ANN_ASSIGNED: "false",
+        ANN_ASSUME_TIME: str(time.time_ns() if now_ns is None else now_ns),
+    }
+    if box is not None:
+        ann[ANN_TOPOLOGY] = "x".join(str(d) for d in box)
+    return ann
+
+
+def placement_patch(ann: Mapping[str, str]) -> dict[str, Any]:
+    """Strategic-merge-patch body updating only the annotations."""
+    return {"metadata": {"annotations": dict(ann)}}
+
+
+def assigned_patch() -> dict[str, Any]:
+    """Patch the device plugin applies when the grant becomes real
+    (designs.md:101: mark ASSIGNED true)."""
+    return {"metadata": {"annotations": {ANN_ASSIGNED: "true"}}}

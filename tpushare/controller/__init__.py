@@ -1,0 +1,6 @@
+"""Sync layer: keeps the SchedulerCache consistent with the apiserver."""
+
+from tpushare.controller.controller import Controller
+from tpushare.controller.workqueue import WorkQueue
+
+__all__ = ["Controller", "WorkQueue"]

@@ -1,0 +1,275 @@
+"""The sync controller: watch streams -> workqueue -> cache reconciliation.
+
+Reference: /root/reference/pkg/gpushare/controller.go. Same structure —
+a pod watch filtered to tpushare pods feeding a rate-limited workqueue
+(controller.go:77-100), worker loops running syncPod (controller.go:185-216),
+plus node and configmap watches (controller.go:106-113) — without client-go:
+watches come from the ClusterClient protocol and run on daemon threads.
+
+The reconciliation rules match the reference exactly:
+- deleted pod        -> remove from cache via the stashed last-seen copy
+                        (controller.go:194-200, removePodCache:342)
+- completed pod      -> remove (frees chips; controller.go:204-206)
+- assigned+annotated -> add_or_update (controller.go:208-215)
+- update events only enqueue when the pod became complete or an unknown pod
+  gained a chip-ids annotation (controller.go:283-290)
+- configmap ``unhealthy-tpu-<node>`` key ``chips`` (CSV ids) marks chips
+  unschedulable (nodeinfo.go:406-431)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any
+
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.contract.constants import (
+    UNHEALTHY_CM_KEY,
+    UNHEALTHY_CM_NAMESPACE,
+    UNHEALTHY_CM_PREFIX,
+)
+from tpushare.contract import node as nodelib
+from tpushare.contract import pod as podlib
+from tpushare.controller.workqueue import WorkQueue
+from tpushare.k8s.client import ApiError
+
+log = logging.getLogger("tpushare.controller")
+
+
+def parse_unhealthy(data: dict[str, str] | None) -> set[int]:
+    """CSV chip ids -> set (reference getUnhealthyGPUs parses the same
+    format from the configmap, nodeinfo.go:414-429)."""
+    if not data:
+        return set()
+    raw = data.get(UNHEALTHY_CM_KEY, "")
+    out: set[int] = set()
+    for part in raw.split(","):
+        part = part.strip()
+        if part.isdigit():
+            out.add(int(part))
+    return out
+
+
+class Controller:
+    def __init__(self, cluster, cache: SchedulerCache,
+                 workers: int = 1, resync_seconds: float = 30.0) -> None:
+        self._cluster = cluster
+        self.cache = cache
+        self._queue = WorkQueue()
+        self._workers = workers
+        self._resync_seconds = resync_seconds
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # last-seen copy of every queued pod so deletes can clean the cache
+        # after the object is gone from the apiserver (controller.go:342)
+        self._seen_lock = threading.Lock()
+        self._seen: dict[str, dict[str, Any]] = {}  # ns/name -> pod
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def build_cache(self) -> int:
+        """Initial state: replay pods, then load unhealthy-chip configmaps
+        for every known node (reference BuildCache + configmap lister warm).
+        A single pod LIST serves both the cache replay and the stash."""
+        pods = self._cluster.list_pods()
+        replayed = self.cache.build_cache(pods=pods)
+        for pod in pods:
+            if contract.is_tpushare_pod(pod):
+                with self._seen_lock:
+                    self._seen[podlib.pod_key(pod)] = pod
+        for name in self.cache.node_names():
+            self._load_unhealthy(name)
+        return replayed
+
+    def start(self) -> None:
+        self._spawn(self._pod_watch_loop, "pod-watch")
+        self._spawn(self._node_watch_loop, "node-watch")
+        self._spawn(self._cm_watch_loop, "cm-watch")
+        self._spawn(self._resync_loop, "resync")
+        for i in range(self._workers):
+            self._spawn(self._worker_loop, f"worker-{i}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue.shut_down()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=f"tpushare-{name}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- watch loops ----------------------------------------------------------
+
+    def _pod_watch_loop(self) -> None:
+        for ev in self._cluster.watch_pods(self._stop):
+            pod = ev.object
+            if not contract.is_tpushare_pod(pod):
+                continue
+            key = podlib.pod_key(pod)
+            if ev.type == "ADDED":
+                with self._seen_lock:
+                    self._seen[key] = pod
+                self._queue.add(key)
+            elif ev.type == "MODIFIED":
+                relevant = self._update_relevant(pod)
+                with self._seen_lock:
+                    self._seen[key] = pod
+                if relevant:
+                    self._queue.add(key)
+            elif ev.type == "DELETED":
+                # remove synchronously with the event's own object: going
+                # through get_pod would race a same-name recreate (e.g. a
+                # StatefulSet replacing web-0 with a new UID) and leak the
+                # old UID's chip reservations forever
+                self.cache.remove_pod(pod)
+                with self._seen_lock:
+                    stashed = self._seen.get(key)
+                    if stashed is not None and \
+                            podlib.pod_uid(stashed) == podlib.pod_uid(pod):
+                        self._seen.pop(key, None)
+
+    def _update_relevant(self, pod: dict[str, Any]) -> bool:
+        """controller.go:283-290: process updates only when the pod became
+        complete, or when a pod we don't track gained a placement."""
+        if contract.is_complete_pod(pod):
+            return True
+        uid = podlib.pod_uid(pod)
+        if not self.cache.known_pod(uid) and \
+                contract.chip_ids_from_annotations(pod) is not None:
+            return True
+        return False
+
+    def _node_watch_loop(self) -> None:
+        for ev in self._cluster.watch_nodes(self._stop):
+            node = ev.object
+            name = nodelib.node_name(node)
+            if ev.type == "DELETED":
+                self.cache.remove_node(name)
+            elif contract.is_tpushare_node(node):
+                self.cache.update_node(node)
+
+    def _cm_watch_loop(self) -> None:
+        for ev in self._cluster.watch_configmaps(self._stop):
+            cm = ev.object
+            meta = cm.get("metadata") or {}
+            name = meta.get("name", "")
+            if meta.get("namespace") != UNHEALTHY_CM_NAMESPACE:
+                continue
+            if not name.startswith(UNHEALTHY_CM_PREFIX):
+                continue
+            node_name = name[len(UNHEALTHY_CM_PREFIX):]
+            chips = set() if ev.type == "DELETED" \
+                else parse_unhealthy(cm.get("data"))
+            try:
+                self.cache.get_node_info(node_name).set_unhealthy(chips)
+                log.info("controller: node %s unhealthy chips = %s",
+                         node_name, sorted(chips))
+            except ApiError:
+                pass  # node gone; nothing to mark
+
+    def _resync_loop(self) -> None:
+        """Periodic anti-entropy (reference: 30 s informer resync,
+        cmd/main.go:28; SURVEY §5.4). Watch streams can drop events during
+        reconnects — the k8s watch API does not replay a gap — so every
+        resync re-lists pods, enqueues all live tpushare pods, and removes
+        stashed pods that no longer exist (their DELETED event was missed)."""
+        while not self._stop.wait(self._resync_seconds):
+            try:
+                self.resync_once()
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                log.warning("controller: resync failed: %s", e)
+
+    def resync_once(self) -> None:
+        pods = self._cluster.list_pods()
+        live: dict[str, str] = {}
+        for pod in pods:
+            if not contract.is_tpushare_pod(pod):
+                continue
+            key = podlib.pod_key(pod)
+            live[key] = podlib.pod_uid(pod)
+            with self._seen_lock:
+                self._seen[key] = pod
+            self._queue.add(key)
+        with self._seen_lock:
+            stale = [(k, p) for k, p in self._seen.items()
+                     if live.get(k) != podlib.pod_uid(p)]
+            for k, _ in stale:
+                if k not in live:
+                    self._seen.pop(k, None)
+        for _, pod in stale:
+            self.cache.remove_pod(pod)  # missed DELETED / replaced UID
+        for name in self.cache.node_names():
+            self._load_unhealthy(name)
+
+    def _load_unhealthy(self, node_name: str) -> None:
+        try:
+            cm = self._cluster.get_configmap(
+                UNHEALTHY_CM_NAMESPACE, UNHEALTHY_CM_PREFIX + node_name)
+            chips = parse_unhealthy(cm.get("data"))
+        except ApiError as e:
+            if not e.is_not_found:
+                return  # transient failure: keep the current set
+            chips = set()  # configmap gone = all chips healthy again
+        try:
+            self.cache.get_node_info(node_name).set_unhealthy(chips)
+        except ApiError:
+            pass  # node disappeared meanwhile
+
+    # -- workers --------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            key = self._queue.get()
+            if key is None:
+                return
+            try:
+                self._sync_pod(key)
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                if self._queue.retry(key):
+                    log.warning("controller: sync %s failed, will retry: %s",
+                                key, e)
+                else:
+                    log.error("controller: dropping %s after max retries: %s",
+                              key, e)
+            else:
+                self._queue.forget(key)
+            finally:
+                self._queue.done(key)
+
+    def _sync_pod(self, key: str) -> None:
+        """Reference syncPod (controller.go:185-216)."""
+        ns, _, name = key.partition("/")
+        try:
+            pod = self._cluster.get_pod(ns, name)
+        except ApiError as e:
+            if not e.is_not_found:
+                raise
+            with self._seen_lock:
+                stashed = self._seen.pop(key, None)
+            if stashed is not None:
+                self.cache.remove_pod(stashed)
+            return
+        if contract.is_complete_pod(pod):
+            self.cache.remove_pod(pod)
+        elif podlib.pod_node_name(pod) and \
+                contract.chip_ids_from_annotations(pod) is not None:
+            self.cache.add_or_update_pod(pod)
+
+    # -- test hooks -----------------------------------------------------------
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Wait until the queue is empty and no key is processing (tests)."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._queue._lock:
+                idle = (not self._queue._queue and not self._queue._delayed
+                        and not self._queue._processing)
+            if idle:
+                return True
+            time.sleep(0.01)
+        return False

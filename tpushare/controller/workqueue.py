@@ -1,0 +1,113 @@
+"""Deduplicating retry workqueue.
+
+The reference uses client-go's rate-limited workqueue
+(/root/reference/pkg/gpushare/controller.go:95-99): keys are deduplicated
+while queued, failed items are re-added with backoff, and a max-retry cap
+drops poison keys. This is a dependency-free equivalent with the same
+contract (add / get / done / forget / retry accounting).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+
+
+class WorkQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1.0,
+                 max_retries: int = 15) -> None:
+        self._lock = threading.Condition()
+        self._queue: list[str] = []
+        self._queued: set[str] = set()
+        self._processing: set[str] = set()
+        self._dirty: set[str] = set()       # re-added while processing
+        self._retries: dict[str, int] = {}
+        self._delayed: list[tuple[float, str]] = []  # heap of (when, key)
+        self._shutdown = False
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.max_retries = max_retries
+
+    def add(self, key: str) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            if key in self._processing:
+                self._dirty.add(key)  # reprocess after current run finishes
+                return
+            if key not in self._queued:
+                self._queued.add(key)
+                self._queue.append(key)
+                self._lock.notify()
+
+    def add_after(self, key: str, delay: float) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            heapq.heappush(self._delayed, (time.monotonic() + delay, key))
+            self._lock.notify()
+
+    def get(self, timeout: float | None = None) -> str | None:
+        """Blocking pop; returns None on shutdown or timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                now = time.monotonic()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, key = heapq.heappop(self._delayed)
+                    if key not in self._queued and key not in self._processing:
+                        self._queued.add(key)
+                        self._queue.append(key)
+                if self._queue:
+                    key = self._queue.pop(0)
+                    self._queued.discard(key)
+                    self._processing.add(key)
+                    return key
+                if self._shutdown:
+                    return None
+                wait = None
+                if self._delayed:
+                    wait = max(self._delayed[0][0] - now, 0.001)
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._lock.wait(wait)
+
+    def done(self, key: str) -> None:
+        with self._lock:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                if key not in self._queued:
+                    self._queued.add(key)
+                    self._queue.append(key)
+                    self._lock.notify()
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._retries.pop(key, None)
+
+    def retry(self, key: str) -> bool:
+        """Schedule a failed key for retry with exponential backoff.
+        Returns False (and forgets the key) once max_retries is exhausted."""
+        with self._lock:
+            n = self._retries.get(key, 0) + 1
+            if n > self.max_retries:
+                self._retries.pop(key, None)
+                return False
+            self._retries[key] = n
+        self.add_after(key, min(self.base_delay * (2 ** (n - 1)),
+                                self.max_delay))
+        return True
+
+    def shut_down(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._delayed)

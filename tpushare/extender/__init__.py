@@ -1,0 +1,25 @@
+"""Scheduler-extender HTTP service.
+
+The webhook the unmodified kube-scheduler calls during Filter and Bind
+(reference: pkg/routes + pkg/scheduler, wire types vendored at
+vendor/k8s.io/kubernetes/pkg/scheduler/api/types.go:258-302). URL scheme:
+
+    POST /tpushare-scheduler/filter     ExtenderArgs -> ExtenderFilterResult
+    POST /tpushare-scheduler/bind       ExtenderBindingArgs -> ExtenderBindingResult
+    GET  /tpushare-scheduler/inspect[/<node>]   allocation tree JSON
+    GET  /version
+    GET  /healthz
+    GET  /metrics                       Prometheus text format
+    GET  /debug/threads | /debug/profile?seconds=N   (pprof analogue)
+
+Registered via config/scheduler-policy-config.json (legacy Policy API) or
+config/kube-scheduler-config.yaml (KubeSchedulerConfiguration extenders
+stanza) with nodeCacheCapable:true and managedResources [aliyun.com/tpu-hbm,
+aliyun.com/tpu-count], so the scheduler sends node *names* and delegates the
+bind verb (reference scheduler-policy-config.json:5-18).
+"""
+
+from tpushare.extender.handlers import BindHandler, FilterHandler, InspectHandler
+from tpushare.extender.server import ExtenderServer
+
+__all__ = ["BindHandler", "FilterHandler", "InspectHandler", "ExtenderServer"]

@@ -1,0 +1,108 @@
+"""Extender entry point (reference cmd/main.go:53-106).
+
+In-cluster production mode:
+
+    python -m tpushare.extender --port 39999
+
+Development mode against an in-memory cluster (no kubeconfig needed):
+
+    python -m tpushare.extender --fake-nodes "n1:4x16000:2x2" --port 0
+
+Env config mirrors the reference: LOG_LEVEL (main.go:57-66), PORT
+(main.go:70-73), THREADNESS worker count (main.go:128-132 — stubbed to 1
+there, real here).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller
+from tpushare.core.native import engine as native_engine
+from tpushare.extender.handlers import register_cache_gauges
+from tpushare.extender.metrics import Registry
+from tpushare.extender.server import ExtenderServer
+
+
+def parse_fake_nodes(spec: str):
+    """``name:CHIPSxHBM[:MESH]`` comma-separated, e.g. ``n1:4x16000:2x2``."""
+    from tpushare.k8s import FakeCluster
+    fc = FakeCluster()
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        parts = item.split(":")
+        if len(parts) < 2:
+            raise ValueError(f"bad node spec {item!r}")
+        name = parts[0]
+        chips_s, _, hbm_s = parts[1].partition("x")
+        mesh = parts[2] if len(parts) > 2 else None
+        fc.add_tpu_node(name, chips=int(chips_s),
+                        hbm_per_chip_mib=int(hbm_s), mesh=mesh)
+    return fc
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpushare-extender")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("PORT", "39999")))
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--fake-nodes", default=None,
+                    help="run against an in-memory cluster: 'n1:4x16000:2x2,...'")
+    ap.add_argument("--apiserver", default=None,
+                    help="explicit apiserver base URL (e.g. kubectl proxy)")
+    ap.add_argument("--workers", type=int,
+                    default=int(os.environ.get("THREADNESS", "1")))
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=getattr(logging,
+                      os.environ.get("LOG_LEVEL", "info").upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    log = logging.getLogger("tpushare.main")
+
+    if args.fake_nodes:
+        cluster = parse_fake_nodes(args.fake_nodes)
+        log.info("running with FakeCluster: %s", args.fake_nodes)
+    else:
+        from tpushare.k8s.incluster import InClusterClient
+        cluster = InClusterClient(base_url=args.apiserver)
+
+    native_engine.warmup()  # compile/load the C++ engine off the hot path
+    cache = SchedulerCache(cluster)
+    controller = Controller(cluster, cache, workers=args.workers)
+    replayed = controller.build_cache()
+    log.info("cache built: %d pods replayed", replayed)
+    controller.start()
+
+    registry = Registry()
+    server = ExtenderServer(cache, cluster, registry,
+                            host=args.host, port=args.port,
+                            allow_debug_seed=bool(args.fake_nodes))
+    register_cache_gauges(registry, cache)
+
+    stop = threading.Event()
+
+    def on_signal(signum, _frame):
+        # second signal forces exit (reference signals/signal.go:16-30)
+        if stop.is_set():
+            sys.exit(1)
+        stop.set()
+        server.stop()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+
+    port = server.start()
+    print(f"tpushare extender ready on {args.host}:{port}", flush=True)
+    stop.wait()
+    controller.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Protocol handlers: Filter, Bind, Inspect.
+
+Reference: pkg/scheduler/{predicate,bind,inspect}.go +
+gpushare-{predicate,bind,inspect}.go. The wire structs follow the k8s
+scheduler extender v1 API (vendored reference types.go:258-302):
+
+- ExtenderArgs{Pod, Nodes?, NodeNames?} -> ExtenderFilterResult{NodeNames,
+  FailedNodes, Error} — with nodeCacheCapable:true the scheduler sends only
+  NodeNames (types.go:258-267), but the Nodes fallback is handled for
+  non-cache-capable deployments.
+- ExtenderBindingArgs{PodName, PodNamespace, PodUID, Node} ->
+  ExtenderBindingResult{Error}.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from tpushare import contract
+from tpushare.cache import AllocationError, SchedulerCache
+from tpushare.cache.nodeinfo import request_from_pod
+from tpushare.contract import pod as podlib
+from tpushare.core.placement import fragmentation, utilization_pct
+from tpushare.extender.metrics import LATENCY_BUCKETS, Registry
+from tpushare.k8s.client import ApiError
+
+log = logging.getLogger("tpushare.extender")
+
+
+class FilterHandler:
+    """Per-scheduling-attempt fit check over candidate nodes
+    (reference Predicate.Handler, predicate.go:15-39)."""
+
+    def __init__(self, cache: SchedulerCache, registry: Registry) -> None:
+        self._cache = cache
+        self._filter_total = registry.counter(
+            "tpushare_filter_requests_total", "Filter webhook calls")
+        self._filter_latency = registry.histogram(
+            "tpushare_filter_seconds", "Filter latency", LATENCY_BUCKETS)
+
+    def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+        t0 = time.perf_counter()
+        self._filter_total.inc()
+        pod = args.get("Pod") or {}
+        node_names = args.get("NodeNames")
+        if node_names is None:
+            items = (args.get("Nodes") or {}).get("items") or []
+            node_names = [n.get("metadata", {}).get("name", "")
+                          for n in items]
+        ok_nodes: list[str] = []
+        failed: dict[str, str] = {}
+        for name in node_names:
+            if not name:
+                continue
+            try:
+                info = self._cache.get_node_info(name)
+            except ApiError as e:
+                failed[name] = f"node unavailable: {e}"
+                continue
+            if info.chip_count <= 0:
+                failed[name] = "not a TPU-share node"
+                continue
+            fits, reason = info.assume(pod)
+            if fits:
+                ok_nodes.append(name)
+            else:
+                failed[name] = reason
+        self._filter_latency.observe(time.perf_counter() - t0)
+        log.debug("filter %s: %d ok / %d failed",
+                  podlib.pod_key(pod), len(ok_nodes), len(failed))
+        return {"NodeNames": ok_nodes, "FailedNodes": failed, "Error": ""}
+
+
+class BindHandler:
+    """The delegated bind verb: choose chips, annotate, bind
+    (reference Bind.Handler -> gpusharingbinding, gpushare-bind.go:22-43)."""
+
+    def __init__(self, cache: SchedulerCache, cluster,
+                 registry: Registry) -> None:
+        self._cache = cache
+        self._cluster = cluster
+        self.bind_total = registry.counter(
+            "tpushare_bind_requests_total", "Bind webhook calls")
+        self.bind_failures = registry.counter(
+            "tpushare_bind_failures_total", "Failed binds")
+        self.bind_latency = registry.histogram(
+            "tpushare_bind_seconds",
+            "Schedule-to-bind latency (the BASELINE p50<50ms metric)",
+            LATENCY_BUCKETS)
+
+    def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+        t0 = time.perf_counter()
+        self.bind_total.inc()
+        ns = args.get("PodNamespace", "default")
+        name = args.get("PodName", "")
+        uid = args.get("PodUID", "")
+        node = args.get("Node", "")
+        try:
+            pod = self._get_pod(ns, name, uid)
+            info = self._cache.get_node_info(node)
+            info.allocate(pod, self._cluster)
+        except (AllocationError, ApiError) as e:
+            self.bind_failures.inc()
+            log.warning("bind %s/%s -> %s failed: %s", ns, name, node, e)
+            return {"Error": str(e)}
+        finally:
+            self.bind_latency.observe(time.perf_counter() - t0)
+        log.info("bind %s/%s -> %s ok", ns, name, node)
+        return {"Error": ""}
+
+    def _get_pod(self, ns: str, name: str, uid: str) -> dict[str, Any]:
+        """Fetch with UID recheck (reference getPod, gpushare-bind.go:45-70:
+        lister first, apiserver fallback, UID-mismatch refetch — here the
+        apiserver read doubles as both)."""
+        pod = self._cluster.get_pod(ns, name)
+        if uid and podlib.pod_uid(pod) != uid:
+            raise AllocationError(
+                f"pod {ns}/{name} UID changed (got {podlib.pod_uid(pod)}, "
+                f"scheduler sent {uid})")
+        return pod
+
+
+class InspectHandler:
+    """Read-only allocation report (reference Inspect.Handler,
+    inspect.go:8-69), consumed by the tpushare-inspect CLI."""
+
+    def __init__(self, cache: SchedulerCache) -> None:
+        self._cache = cache
+
+    def handle(self, node_name: str | None = None) -> dict[str, Any]:
+        tree = self._cache.describe()
+        if node_name:
+            nodes = [n for n in tree["nodes"] if n["name"] == node_name]
+            if not nodes:
+                return {"error": f"node {node_name} not found in cache"}
+            return nodes[0]
+        return tree
+
+
+def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
+    """Scrape-time gauges over the allocation cache: per-node utilization and
+    fragmentation — the BASELINE headline metrics."""
+
+    def per_node() -> list[tuple[str, float]]:
+        out = []
+        for name in cache.node_names():
+            info = cache.get_node_info(name)
+            views = info.snapshot()
+            out.append((f'{{node="{name}",metric="utilization_pct"}}',
+                        round(utilization_pct(views), 4)))
+            out.append((f'{{node="{name}",metric="fragmentation"}}',
+                        round(fragmentation(views), 4)))
+        return out
+
+    registry.gauge_func(
+        "tpushare_node_hbm", "Per-node HBM utilization %% and fragmentation",
+        per_node)

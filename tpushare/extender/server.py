@@ -1,0 +1,178 @@
+"""HTTP server wiring for the extender (reference pkg/routes/routes.go).
+
+stdlib ThreadingHTTPServer: every scheduler webhook call is handled on its
+own thread over the lock-scoped cache, replacing the reference's
+httprouter + net/http stack. Bind failures return HTTP 500 with the
+ExtenderBindingResult body (routes.go:139-143 does the same), which makes
+the default scheduler retry after its timeout.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import json
+import logging
+import pstats
+import sys
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import tpushare
+from tpushare.extender.handlers import BindHandler, FilterHandler, InspectHandler
+from tpushare.extender.metrics import Registry
+
+log = logging.getLogger("tpushare.extender.http")
+
+PREFIX = "/tpushare-scheduler"
+
+
+class ExtenderServer:
+    def __init__(self, cache, cluster, registry: Registry | None = None,
+                 host: str = "0.0.0.0", port: int = 39999,
+                 allow_debug_seed: bool = False) -> None:
+        self.registry = registry or Registry()
+        self.filter_handler = FilterHandler(cache, self.registry)
+        self.bind_handler = BindHandler(cache, cluster, self.registry)
+        self.inspect_handler = InspectHandler(cache)
+        self.host, self.port = host, port
+        self._httpd: ThreadingHTTPServer | None = None
+        # development-mode only (--fake-nodes): lets an operator seed pods
+        # into the in-memory cluster so the full filter->bind cycle can be
+        # driven with curl; never enabled against a real apiserver
+        self._seed_cluster = cluster if allow_debug_seed else None
+
+    # -- request routing ------------------------------------------------------
+
+    def _make_handler(server_self):  # noqa: N805 — closure over the server
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route into logging, not stderr
+                log.debug("%s %s", self.address_string(), fmt % args)
+
+            def _reply(self, code: int, body: Any,
+                       content_type: str = "application/json") -> None:
+                data = (json.dumps(body).encode()
+                        if content_type == "application/json"
+                        else body.encode())
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _read_json(self) -> Any:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                return json.loads(raw) if raw else {}
+
+            def do_POST(self):
+                try:
+                    if self.path == f"{PREFIX}/filter":
+                        args = self._read_json()
+                        self._reply(200, server_self.filter_handler.handle(args))
+                    elif self.path == f"{PREFIX}/bind":
+                        args = self._read_json()
+                        result = server_self.bind_handler.handle(args)
+                        # reference returns 500 on bind failure (routes.go:139)
+                        self._reply(500 if result.get("Error") else 200, result)
+                    elif self.path == "/debug/pods" and server_self._seed_cluster:
+                        pod = server_self._seed_cluster.create_pod(
+                            self._read_json())
+                        self._reply(201, pod)
+                    else:
+                        self._reply(404, {"error": f"no route {self.path}"})
+                except json.JSONDecodeError as e:
+                    self._reply(400, {"error": f"bad JSON: {e}"})
+                except Exception as e:  # noqa: BLE001 — webhook must answer
+                    log.error("POST %s crashed: %s\n%s", self.path, e,
+                              traceback.format_exc())
+                    self._reply(500, {"Error": f"internal error: {e}"})
+
+            def do_GET(self):
+                try:
+                    if self.path == "/version":
+                        self._reply(200, {"version": tpushare.__version__})
+                    elif self.path == "/healthz":
+                        self._reply(200, "ok", content_type="text/plain")
+                    elif self.path == "/metrics":
+                        self._reply(200, server_self.registry.expose(),
+                                    content_type="text/plain; version=0.0.4")
+                    elif self.path == f"{PREFIX}/inspect" or \
+                            self.path == f"{PREFIX}/inspect/":
+                        self._reply(200, server_self.inspect_handler.handle())
+                    elif self.path.startswith(f"{PREFIX}/inspect/"):
+                        node = self.path[len(f"{PREFIX}/inspect/"):]
+                        out = server_self.inspect_handler.handle(node)
+                        self._reply(404 if "error" in out else 200, out)
+                    elif self.path == "/debug/threads":
+                        self._reply(200, _thread_dump(),
+                                    content_type="text/plain")
+                    elif self.path.startswith("/debug/profile"):
+                        seconds = 1.0
+                        if "seconds=" in self.path:
+                            try:
+                                seconds = min(float(
+                                    self.path.split("seconds=")[1]), 30.0)
+                            except ValueError:
+                                pass
+                        self._reply(200, _profile(seconds),
+                                    content_type="text/plain")
+                    else:
+                        self._reply(404, {"error": f"no route {self.path}"})
+                except Exception as e:  # noqa: BLE001
+                    log.error("GET %s crashed: %s", self.path, e)
+                    self._reply(500, {"error": str(e)})
+
+        return Handler
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind and serve on a background thread; returns the bound port."""
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), self._make_handler())
+        self.port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="tpushare-http", daemon=True)
+        t.start()
+        log.info("extender listening on %s:%d", self.host, self.port)
+        return self.port
+
+    def serve_forever(self) -> None:
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), self._make_handler())
+        log.info("extender listening on %s:%d", self.host, self.port)
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def _thread_dump() -> str:
+    """Goroutine-dump analogue of the reference's pprof mount
+    (pkg/routes/pprof.go:10-22)."""
+    lines = []
+    for tid, frame in sys._current_frames().items():
+        name = next((t.name for t in threading.enumerate()
+                     if t.ident == tid), str(tid))
+        lines.append(f"--- thread {name} ({tid}) ---")
+        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    return "\n".join(lines) + "\n"
+
+
+def _profile(seconds: float) -> str:
+    """CPU profile of the serving process for N seconds (pprof /profile)."""
+    prof = cProfile.Profile()
+    prof.enable()
+    time.sleep(seconds)
+    prof.disable()
+    buf = io.StringIO()
+    pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(40)
+    return buf.getvalue()

@@ -1,0 +1,21 @@
+"""Minimal Kubernetes client layer.
+
+The reference leans on client-go (informers, listers, patch/bind calls —
+SURVEY §2.7/§2.8). This environment has no kubernetes Python client, so
+tpushare ships its own thin layer with exactly the surface the framework
+needs, in two implementations:
+
+- :class:`tpushare.k8s.fake.FakeCluster` — in-memory apiserver with watch
+  streams and optimistic concurrency, the hermetic-test backend (the
+  reference *could* have used client-go's fake clientset; SURVEY §4 calls
+  this out as the seam to build on from day one).
+- :class:`tpushare.k8s.incluster.InClusterClient` — stdlib http.client
+  against the real apiserver using the pod's service-account credentials.
+
+Everything speaks dict-shaped JSON objects; no typed model classes.
+"""
+
+from tpushare.k8s.client import ApiError, ClusterClient, WatchEvent
+from tpushare.k8s.fake import FakeCluster
+
+__all__ = ["ApiError", "ClusterClient", "WatchEvent", "FakeCluster"]

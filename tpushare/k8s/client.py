@@ -1,0 +1,75 @@
+"""Cluster client protocol + shared error/merge machinery."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterator, Protocol
+
+
+class ApiError(Exception):
+    """Kubernetes API failure with its HTTP status code."""
+
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"{status}: {message}" if message else str(status))
+        self.status = status
+        self.message = message
+
+    @property
+    def is_conflict(self) -> bool:  # optimistic-lock loser (409)
+        return self.status == 409
+
+    @property
+    def is_not_found(self) -> bool:
+        return self.status == 404
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    """One apiserver watch event: type ADDED|MODIFIED|DELETED."""
+
+    type: str
+    object: dict[str, Any]
+
+
+class ClusterClient(Protocol):
+    """The exact cluster surface tpushare uses.
+
+    Mirrors the reference's dependency set (SURVEY §4: "client-go listers +
+    three write calls — Patch, Bind, ListAndWatch"), plus configmap reads
+    for unhealthy chips and event creation for observability.
+    """
+
+    # reads
+    def list_pods(self) -> list[dict[str, Any]]: ...
+    def get_pod(self, namespace: str, name: str) -> dict[str, Any]: ...
+    def list_nodes(self) -> list[dict[str, Any]]: ...
+    def get_node(self, name: str) -> dict[str, Any]: ...
+    def get_configmap(self, namespace: str, name: str) -> dict[str, Any]: ...
+
+    # writes
+    def patch_pod(self, namespace: str, name: str,
+                  patch: dict[str, Any]) -> dict[str, Any]: ...
+    def bind_pod(self, namespace: str, name: str, node: str,
+                 uid: str | None = None) -> None: ...
+    def create_event(self, namespace: str, event: dict[str, Any]) -> None: ...
+
+    # watches (blocking iterators; controller runs them on threads)
+    def watch_pods(self, stop) -> Iterator[WatchEvent]: ...
+    def watch_nodes(self, stop) -> Iterator[WatchEvent]: ...
+    def watch_configmaps(self, stop) -> Iterator[WatchEvent]: ...
+
+
+def strategic_merge(base: dict[str, Any], patch: dict[str, Any]) -> dict[str, Any]:
+    """Strategic-merge-patch subset: recursive dict merge, None deletes,
+    scalars/lists replace. Sufficient for the metadata.annotations patches
+    this framework writes (reference uses types.StrategicMergePatchType,
+    nodeinfo.go:198)."""
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = strategic_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,235 @@
+"""In-memory apiserver for hermetic tests and the bench suite.
+
+Implements the :class:`tpushare.k8s.client.ClusterClient` protocol with real
+apiserver semantics where they matter to the scheduler:
+
+- resourceVersion bumps on every mutation; patch/bind take an optional UID
+  precondition and fail 409 on mismatch — exercising the extender's
+  optimistic-lock retry (reference: nodeinfo.go:202-218 retries once on
+  conflict).
+- bind on an already-bound pod fails 409 (kubelet/apiserver behavior); bind
+  on a missing pod 404.
+- every mutation fans out WatchEvents to open watch streams, so the
+  controller's informer loop is tested against the same event flow a real
+  cluster produces.
+
+Also provides seeding helpers (`add_tpu_node`, `create_pod`) used by tests,
+bench.py, and the extender's `--fake` development mode.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import queue
+import threading
+import uuid
+from typing import Any, Iterator
+
+from tpushare.contract.constants import (
+    LABEL_MESH,
+    RESOURCE_COUNT,
+    RESOURCE_HBM,
+)
+from tpushare.k8s.client import ApiError, WatchEvent, strategic_merge
+
+
+class FakeCluster:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._pods: dict[str, dict[str, Any]] = {}      # ns/name -> pod
+        self._nodes: dict[str, dict[str, Any]] = {}
+        self._configmaps: dict[str, dict[str, Any]] = {}  # ns/name -> cm
+        self._events: list[dict[str, Any]] = []
+        self._watchers: dict[str, list[queue.Queue]] = {
+            "pods": [], "nodes": [], "configmaps": []}
+
+    # -- internal ------------------------------------------------------------
+
+    def _bump(self, obj: dict[str, Any]) -> None:
+        obj.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+
+    def _notify(self, kind: str, etype: str, obj: dict[str, Any]) -> None:
+        ev = WatchEvent(etype, copy.deepcopy(obj))
+        for q in list(self._watchers[kind]):
+            q.put(ev)
+
+    @staticmethod
+    def _key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}"
+
+    # -- seeding helpers -----------------------------------------------------
+
+    def add_tpu_node(self, name: str, chips: int, hbm_per_chip_mib: int,
+                     mesh: str | None = None) -> dict[str, Any]:
+        """Register a TPU host the way the device plugin would: aggregate
+        tpu-hbm, tpu-count, and the mesh topology label (designs.md:57-63
+        reports count x mem through ListAndWatch)."""
+        node = {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {
+                "name": name,
+                "labels": ({LABEL_MESH: mesh} if mesh else {}) | {"tpushare": "true"},
+            },
+            "status": {
+                "allocatable": {
+                    RESOURCE_HBM: str(chips * hbm_per_chip_mib),
+                    RESOURCE_COUNT: str(chips),
+                },
+                "capacity": {
+                    RESOURCE_HBM: str(chips * hbm_per_chip_mib),
+                    RESOURCE_COUNT: str(chips),
+                },
+            },
+        }
+        with self._lock:
+            self._bump(node)
+            self._nodes[name] = node
+            self._notify("nodes", "ADDED", node)
+        return copy.deepcopy(node)
+
+    def create_pod(self, pod: dict[str, Any]) -> dict[str, Any]:
+        with self._lock:
+            meta = pod.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            meta.setdefault("uid", str(uuid.uuid4()))
+            pod.setdefault("status", {}).setdefault("phase", "Pending")
+            key = self._key(meta["namespace"], meta["name"])
+            if key in self._pods:
+                raise ApiError(409, f"pod {key} already exists")
+            self._bump(pod)
+            self._pods[key] = pod
+            self._notify("pods", "ADDED", pod)
+            return copy.deepcopy(pod)
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str) -> None:
+        with self._lock:
+            pod = self._pods.get(self._key(namespace, name))
+            if pod is None:
+                raise ApiError(404, f"pod {namespace}/{name}")
+            pod["status"]["phase"] = phase
+            self._bump(pod)
+            self._notify("pods", "MODIFIED", pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop(self._key(namespace, name), None)
+            if pod is None:
+                raise ApiError(404, f"pod {namespace}/{name}")
+            self._notify("pods", "DELETED", pod)
+
+    def delete_configmap(self, namespace: str, name: str) -> None:
+        with self._lock:
+            cm = self._configmaps.pop(self._key(namespace, name), None)
+            if cm is not None:
+                self._notify("configmaps", "DELETED", cm)
+
+    def set_configmap(self, namespace: str, name: str,
+                      data: dict[str, str]) -> None:
+        with self._lock:
+            cm = {
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": namespace},
+                "data": dict(data),
+            }
+            self._bump(cm)
+            self._configmaps[self._key(namespace, name)] = cm
+            self._notify("configmaps", "MODIFIED", cm)
+
+    # -- ClusterClient reads -------------------------------------------------
+
+    def list_pods(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return copy.deepcopy(list(self._pods.values()))
+
+    def get_pod(self, namespace: str, name: str) -> dict[str, Any]:
+        with self._lock:
+            pod = self._pods.get(self._key(namespace, name))
+            if pod is None:
+                raise ApiError(404, f"pod {namespace}/{name}")
+            return copy.deepcopy(pod)
+
+    def list_nodes(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return copy.deepcopy(list(self._nodes.values()))
+
+    def get_node(self, name: str) -> dict[str, Any]:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise ApiError(404, f"node {name}")
+            return copy.deepcopy(node)
+
+    def get_configmap(self, namespace: str, name: str) -> dict[str, Any]:
+        with self._lock:
+            cm = self._configmaps.get(self._key(namespace, name))
+            if cm is None:
+                raise ApiError(404, f"configmap {namespace}/{name}")
+            return copy.deepcopy(cm)
+
+    # -- ClusterClient writes ------------------------------------------------
+
+    def patch_pod(self, namespace: str, name: str,
+                  patch: dict[str, Any]) -> dict[str, Any]:
+        with self._lock:
+            key = self._key(namespace, name)
+            pod = self._pods.get(key)
+            if pod is None:
+                raise ApiError(404, f"pod {namespace}/{name}")
+            merged = strategic_merge(pod, json.loads(json.dumps(patch)))
+            self._bump(merged)
+            self._pods[key] = merged
+            self._notify("pods", "MODIFIED", merged)
+            return copy.deepcopy(merged)
+
+    def bind_pod(self, namespace: str, name: str, node: str,
+                 uid: str | None = None) -> None:
+        with self._lock:
+            pod = self._pods.get(self._key(namespace, name))
+            if pod is None:
+                raise ApiError(404, f"pod {namespace}/{name}")
+            if uid is not None and pod["metadata"].get("uid") != uid:
+                raise ApiError(409, "uid precondition failed")
+            if node not in self._nodes:
+                raise ApiError(404, f"node {node}")
+            if pod.get("spec", {}).get("nodeName"):
+                raise ApiError(409, f"pod {namespace}/{name} already bound")
+            pod.setdefault("spec", {})["nodeName"] = node
+            self._bump(pod)
+            self._notify("pods", "MODIFIED", pod)
+
+    def create_event(self, namespace: str, event: dict[str, Any]) -> None:
+        with self._lock:
+            self._events.append({"namespace": namespace, **event})
+
+    @property
+    def events(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return copy.deepcopy(self._events)
+
+    # -- watches -------------------------------------------------------------
+
+    def _watch(self, kind: str, stop: threading.Event) -> Iterator[WatchEvent]:
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._watchers[kind].append(q)
+        try:
+            while not stop.is_set():
+                try:
+                    yield q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+        finally:
+            with self._lock:
+                self._watchers[kind].remove(q)
+
+    def watch_pods(self, stop) -> Iterator[WatchEvent]:
+        return self._watch("pods", stop)
+
+    def watch_nodes(self, stop) -> Iterator[WatchEvent]:
+        return self._watch("nodes", stop)
+
+    def watch_configmaps(self, stop) -> Iterator[WatchEvent]:
+        return self._watch("configmaps", stop)

@@ -1,0 +1,189 @@
+"""Real apiserver client over stdlib http.client (no external deps).
+
+Replaces the reference's client-go usage (cmd/main.go:32-50 builds a
+clientset from kubeconfig or in-cluster config). Only the in-cluster path is
+implemented — the extender and device plugin both run as cluster workloads
+(config/tpushare-schd-extender.yaml) — plus an explicit base-URL/token mode
+for development against `kubectl proxy`.
+
+Watches use the apiserver's streaming JSON-lines protocol
+(`?watch=true&resourceVersion=...`) and reconnect from the server's current
+state after a gap. Events dropped during the gap are NOT replayed by the
+watch API — the Controller's periodic resync (controller.py::_resync_loop)
+is the anti-entropy mechanism that reconciles them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import ssl
+import threading
+import urllib.parse
+import urllib.request
+from typing import Any, Iterator
+
+from tpushare.k8s.client import ApiError, WatchEvent
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class InClusterClient:
+    def __init__(self, base_url: str | None = None, token: str | None = None,
+                 ca_file: str | None = None, timeout: float = 10.0) -> None:
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not running in-cluster (KUBERNETES_SERVICE_HOST unset); "
+                    "pass base_url explicitly")
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        self._token_file = os.path.join(SA_DIR, "token")
+        self._token = token
+        self.timeout = timeout
+        ca = ca_file or os.path.join(SA_DIR, "ca.crt")
+        if self.base_url.startswith("https") and os.path.exists(ca):
+            self._ctx: ssl.SSLContext | None = ssl.create_default_context(cafile=ca)
+        elif self.base_url.startswith("https"):
+            self._ctx = ssl.create_default_context()
+        else:
+            self._ctx = None
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _auth_header(self) -> dict[str, str]:
+        token = self._token
+        if token is None and os.path.exists(self._token_file):
+            # re-read every request: kubelet rotates projected SA tokens
+            with open(self._token_file) as f:
+                token = f.read().strip()
+        return {"Authorization": f"Bearer {token}"} if token else {}
+
+    def _request(self, method: str, path: str, body: Any = None,
+                 content_type: str = "application/json",
+                 timeout: float | None = None):
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        for k, v in self._auth_header().items():
+            req.add_header(k, v)
+        try:
+            return urllib.request.urlopen(
+                req, timeout=self.timeout if timeout is None else timeout,
+                context=self._ctx)
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode(errors="replace")[:512]
+            except Exception:
+                pass
+            raise ApiError(e.code, detail) from None
+        except (urllib.error.URLError, socket.timeout, OSError) as e:
+            raise ApiError(0, str(e)) from None
+
+    def _json(self, method: str, path: str, body: Any = None,
+              content_type: str = "application/json") -> dict[str, Any]:
+        with self._request(method, path, body, content_type) as resp:
+            return json.loads(resp.read().decode())
+
+    # -- reads ---------------------------------------------------------------
+
+    def list_pods(self) -> list[dict[str, Any]]:
+        return self._json("GET", "/api/v1/pods").get("items", [])
+
+    def get_pod(self, namespace: str, name: str) -> dict[str, Any]:
+        return self._json("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def list_nodes(self) -> list[dict[str, Any]]:
+        return self._json("GET", "/api/v1/nodes").get("items", [])
+
+    def get_node(self, name: str) -> dict[str, Any]:
+        return self._json("GET", f"/api/v1/nodes/{name}")
+
+    def get_configmap(self, namespace: str, name: str) -> dict[str, Any]:
+        return self._json(
+            "GET", f"/api/v1/namespaces/{namespace}/configmaps/{name}")
+
+    # -- writes --------------------------------------------------------------
+
+    def patch_pod(self, namespace: str, name: str,
+                  patch: dict[str, Any]) -> dict[str, Any]:
+        # strategic merge patch, like the reference (nodeinfo.go:198)
+        return self._json(
+            "PATCH", f"/api/v1/namespaces/{namespace}/pods/{name}", patch,
+            content_type="application/strategic-merge-patch+json")
+
+    def bind_pod(self, namespace: str, name: str, node: str,
+                 uid: str | None = None) -> None:
+        # pods/binding subresource — the write the extender is delegated
+        # via the policy's bindVerb (reference nodeinfo.go:226-239)
+        binding: dict[str, Any] = {
+            "apiVersion": "v1", "kind": "Binding",
+            "metadata": {"name": name, "namespace": namespace},
+            "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+        }
+        if uid:
+            binding["metadata"]["uid"] = uid
+        self._json(
+            "POST", f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            binding)
+
+    def create_event(self, namespace: str, event: dict[str, Any]) -> None:
+        body = {"apiVersion": "v1", "kind": "Event", **event}
+        try:
+            self._json("POST", f"/api/v1/namespaces/{namespace}/events", body)
+        except ApiError:
+            pass  # events are best-effort (reference: record.EventBroadcaster)
+
+    # -- watches -------------------------------------------------------------
+
+    def _watch(self, path: str, stop: threading.Event) -> Iterator[WatchEvent]:
+        rv = ""
+        while not stop.is_set():
+            q = {"watch": "true", "allowWatchBookmarks": "true"}
+            if rv:
+                q["resourceVersion"] = rv
+            url = f"{path}?{urllib.parse.urlencode(q)}"
+            try:
+                resp = self._request("GET", url, timeout=300)
+            except ApiError:
+                if stop.wait(2.0):
+                    return
+                rv = ""  # re-list from now
+                continue
+            try:
+                for line in resp:
+                    if stop.is_set():
+                        return
+                    if not line.strip():
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # truncated stream; reconnect
+                    etype = ev.get("type", "")
+                    obj = ev.get("object", {})
+                    rv = (obj.get("metadata") or {}).get("resourceVersion", rv)
+                    if etype == "BOOKMARK":
+                        continue
+                    if etype == "ERROR":
+                        rv = ""  # 410 Gone et al: restart from fresh list
+                        break
+                    yield WatchEvent(etype, obj)
+            finally:
+                resp.close()
+
+    def watch_pods(self, stop) -> Iterator[WatchEvent]:
+        return self._watch("/api/v1/pods", stop)
+
+    def watch_nodes(self, stop) -> Iterator[WatchEvent]:
+        return self._watch("/api/v1/nodes", stop)
+
+    def watch_configmaps(self, stop) -> Iterator[WatchEvent]:
+        return self._watch("/api/v1/configmaps", stop)
