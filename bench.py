@@ -1,0 +1,203 @@
+"""tpushare benchmark: the BASELINE.json suite, end to end.
+
+Drives a live extender HTTP service the way kube-scheduler would
+(POST /filter across candidate nodes, then POST /bind on the chosen one)
+over the five BASELINE configs:
+
+  1. single-pod smoke test (1 GiB),
+  2. 8 x 2 GiB JAX inference pods binpacked onto ONE v5e chip,
+  3. mixed 1/2/4/8 GiB anti-fragmentation suite on a 4-chip host,
+  4. 4-contiguous-chip (2x2) ICI-topology placement,
+  5. two co-located llama-int8 2x2 serving replicas on a v5e-16 slice,
+
+then saturates the fleet with a deterministic mixed workload until nothing
+>= 512 MiB fits anywhere, and reports:
+
+  - aggregate HBM binpack utilization % (target >= 90, BASELINE north star)
+  - p50/p99 schedule-to-bind latency in ms (target p50 < 50)
+
+Prints ONE JSON line; vs_baseline is utilization / 90 (the target), so
+>= 1.0 means the north-star bar is met.
+
+Hermetic by design: scheduling is control-plane work (SURVEY §6 — the
+reference publishes no perf numbers; targets come from BASELINE.json), so
+the suite runs identically on a laptop and on the TPU host the driver uses.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+import urllib.request
+
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller
+from tpushare.extender.handlers import register_cache_gauges
+from tpushare.extender.metrics import Registry
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+
+GIB = 1024  # MiB
+V5E_HBM = 16 * GIB
+
+_pod_seq = [0]
+
+
+def make_pod(hbm: int, count: int = 0, topology: str | None = None) -> dict:
+    _pod_seq[0] += 1
+    name = f"bench-{_pod_seq[0]}"
+    limits: dict = {}
+    if hbm:
+        limits["aliyun.com/tpu-hbm"] = str(hbm)
+    if count:
+        limits["aliyun.com/tpu-count"] = str(count)
+    ann = {"tpushare.aliyun.com/topology": topology} if topology else {}
+    return {
+        "metadata": {"name": name, "namespace": "bench",
+                     "annotations": ann},
+        "spec": {"containers": [{"name": "c",
+                                 "resources": {"limits": limits}}]},
+    }
+
+
+class Driver:
+    """Plays the kube-scheduler's role against the extender webhook."""
+
+    def __init__(self, base_url: str, cluster: FakeCluster,
+                 node_names: list[str]) -> None:
+        self.base = base_url
+        self.cluster = cluster
+        self.nodes = node_names
+        self.latencies_ms: list[float] = []
+
+    def _post(self, path: str, body: dict) -> tuple[int, dict]:
+        req = urllib.request.Request(
+            f"{self.base}{path}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def schedule(self, pod_spec: dict) -> str | None:
+        """filter -> bind; returns the node name or None. Timed end-to-end
+        (the BASELINE schedule-to-bind metric)."""
+        created = self.cluster.create_pod(pod_spec)
+        t0 = time.perf_counter()
+        _, result = self._post("/tpushare-scheduler/filter",
+                               {"Pod": created, "NodeNames": self.nodes})
+        ok = result.get("NodeNames") or []
+        if not ok:
+            self.cluster.delete_pod(created["metadata"]["namespace"],
+                                    created["metadata"]["name"])
+            return None
+        node = ok[0]
+        status, bind = self._post("/tpushare-scheduler/bind", {
+            "PodName": created["metadata"]["name"],
+            "PodNamespace": created["metadata"]["namespace"],
+            "PodUID": created["metadata"]["uid"],
+            "Node": node,
+        })
+        self.latencies_ms.append((time.perf_counter() - t0) * 1e3)
+        if status != 200 or bind.get("Error"):
+            return None
+        return node
+
+    def inspect(self) -> dict:
+        with urllib.request.urlopen(
+                f"{self.base}/tpushare-scheduler/inspect", timeout=10) as r:
+            return json.loads(r.read())
+
+
+def main() -> int:
+    fc = FakeCluster()
+    # the BASELINE fleet: one v5e-16 slice host + one 4-chip v5e host
+    fc.add_tpu_node("v5e-16", chips=16, hbm_per_chip_mib=V5E_HBM, mesh="4x4")
+    fc.add_tpu_node("v5e-4", chips=4, hbm_per_chip_mib=V5E_HBM, mesh="2x2")
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    ctl.start()
+    registry = Registry()
+    server = ExtenderServer(cache, fc, registry, host="127.0.0.1", port=0)
+    register_cache_gauges(registry, cache)
+    port = server.start()
+    d = Driver(f"http://127.0.0.1:{port}", fc, ["v5e-16", "v5e-4"])
+
+    checks: list[str] = []
+
+    def expect(cond: bool, what: str) -> None:
+        checks.append(("PASS " if cond else "FAIL ") + what)
+
+    # 2. 8 x 2 GiB -> one chip exactly (8*2048 == 16384); runs first so the
+    #    fleet is pristine and a full chip is available
+    chips_used = set()
+    for _ in range(8):
+        node = d.schedule(make_pod(2 * GIB))
+        expect(node is not None, "config2 2GiB pod scheduled")
+    tree = d.inspect()
+    for n in tree["nodes"]:
+        for cdesc in n["chips"]:
+            pods_2g = [p for p in cdesc["pods"] if p["hbm_mib"] == 2 * GIB]
+            if pods_2g:
+                chips_used.add((n["name"], cdesc["idx"]))
+    expect(len(chips_used) == 1, f"config2 binpacked onto one chip "
+                                 f"(got {len(chips_used)})")
+
+    # 1. smoke: single 1 GiB pod
+    expect(d.schedule(make_pod(1 * GIB)) is not None, "config1 smoke 1GiB")
+
+    # 3. mixed anti-fragmentation on the 4-chip host (the 16er is also
+    #    open, but binpack keeps the mix tight wherever it lands)
+    for hbm in [1, 2, 4, 8, 8, 4, 2, 1, 1, 2]:
+        d.schedule(make_pod(hbm * GIB))
+
+    # 4. contiguous 2x2 sub-slice
+    node = d.schedule(make_pod(4 * GIB, count=4, topology="2x2"))
+    expect(node is not None, "config4 2x2 sub-slice placed")
+
+    # 5. two llama-int8 serving replicas (2x2 @ 8 GiB/chip) co-located
+    for i in range(2):
+        node = d.schedule(make_pod(8 * GIB, count=4, topology="2x2"))
+        expect(node == "v5e-16",
+               f"config5 llama replica {i} on the v5e-16 slice")
+
+    # saturate: deterministic mixed fill until nothing >= 512 MiB fits
+    sizes = [8 * GIB, 4 * GIB, 2 * GIB, 1 * GIB, GIB // 2]
+    for size in sizes:
+        while d.schedule(make_pod(size)) is not None:
+            pass
+
+    tree = d.inspect()
+    util = tree["used_hbm_mib"] / tree["total_hbm_mib"] * 100.0
+    lat = sorted(d.latencies_ms)
+    p50 = statistics.median(lat)
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+    for line in checks:
+        print(f"# {line}", file=sys.stderr)
+    print(f"# pods scheduled: {len(lat)}; p50 {p50:.2f} ms, "
+          f"p99 {p99:.2f} ms; utilization {util:.2f}%", file=sys.stderr)
+
+    server.stop()
+    ctl.stop()
+
+    failed = [c for c in checks if c.startswith("FAIL")]
+    print(json.dumps({
+        "metric": "hbm_binpack_utilization_v5e",
+        "value": round(util, 2),
+        "unit": "%",
+        "vs_baseline": round(util / 90.0, 4),
+        "p50_bind_ms": round(p50, 3),
+        "p99_bind_ms": round(p99, 3),
+        "pods": len(lat),
+        "suite_failures": len(failed),
+    }))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

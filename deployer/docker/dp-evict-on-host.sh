@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Move any stock whole-TPU device-plugin static manifest out of the way so
+# it stops advertising exclusive google.com/tpu devices that would fight
+# the fractional tpushare resources. (Reference analogue: dp-evict-on-host.sh
+# moves nvidia-device-plugin.yml out of the manifests dir.)
+set -euo pipefail
+
+MANIFESTS="${HOST_K8S_DIR:-/etc/kubernetes}/manifests"
+PARKED="${HOST_K8S_DIR:-/etc/kubernetes}/tpushare-parked"
+mkdir -p "$PARKED"
+
+moved=0
+for f in "$MANIFESTS"/*tpu-device-plugin*.y*ml; do
+  [[ -e "$f" ]] || continue
+  mv "$f" "$PARKED/"
+  echo "parked $f -> $PARKED/"
+  moved=1
+done
+[[ "$moved" == 1 ]] || echo "no stock TPU device-plugin manifest found"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Restore a stock TPU device plugin parked by dp-evict-on-host.sh.
+set -euo pipefail
+
+MANIFESTS="${HOST_K8S_DIR:-/etc/kubernetes}/manifests"
+PARKED="${HOST_K8S_DIR:-/etc/kubernetes}/tpushare-parked"
+
+restored=0
+for f in "$PARKED"/*tpu-device-plugin*.y*ml; do
+  [[ -e "$f" ]] || continue
+  mv "$f" "$MANIFESTS/"
+  echo "restored $f -> $MANIFESTS/"
+  restored=1
+done
+[[ "$restored" == 1 ]] || echo "nothing parked in $PARKED"

@@ -1,0 +1,95 @@
+#!/usr/bin/env bash
+# Host-mutation installer: registers the tpushare extender with the
+# control-plane kube-scheduler static pod. Idempotent; backs up first.
+# (Role analogue of the reference's
+# deployer/docker/.../install-sched-extender-on-host.sh which sed-inserts
+# the --policy-config-file flag; this writes the modern --config variant.)
+#
+# Run inside a privileged pod with the host's /etc/kubernetes mounted at
+# $HOST_K8S_DIR (default /etc/kubernetes), as the installer chart does.
+set -euo pipefail
+
+HOST_K8S_DIR="${HOST_K8S_DIR:-/etc/kubernetes}"
+MANIFEST="$HOST_K8S_DIR/manifests/kube-scheduler.yaml"
+CONF_DIR="$HOST_K8S_DIR/tpushare"
+EXTENDER_URL="${EXTENDER_URL:-http://127.0.0.1:32766/tpushare-scheduler}"
+STAMP="$(date +%Y%m%d-%H%M%S)"
+
+if [[ ! -f "$MANIFEST" ]]; then
+  echo "error: $MANIFEST not found (is this a control-plane host?)" >&2
+  exit 1
+fi
+
+mkdir -p "$CONF_DIR"
+cat > "$CONF_DIR/kube-scheduler-config.yaml" <<EOF
+apiVersion: kubescheduler.config.k8s.io/v1
+kind: KubeSchedulerConfiguration
+clientConnection:
+  kubeconfig: /etc/kubernetes/scheduler.conf
+extenders:
+  - urlPrefix: "$EXTENDER_URL"
+    filterVerb: filter
+    bindVerb: bind
+    enableHTTPS: false
+    nodeCacheCapable: true
+    managedResources:
+      - name: aliyun.com/tpu-hbm
+        ignoredByScheduler: false
+      - name: aliyun.com/tpu-count
+        ignoredByScheduler: false
+    ignorable: false
+EOF
+
+if grep -q "tpushare/kube-scheduler-config.yaml" "$MANIFEST"; then
+  echo "tpushare extender already registered in $MANIFEST"
+  exit 0
+fi
+
+cp "$MANIFEST" "$MANIFEST.tpushare-backup-$STAMP"
+echo "backed up scheduler manifest to $MANIFEST.tpushare-backup-$STAMP"
+
+python3 - "$MANIFEST" <<'EOF'
+import sys
+
+path = sys.argv[1]
+with open(path) as f:
+    lines = f.readlines()
+
+out = []
+in_command = False
+for line in lines:
+    stripped = line.strip()
+    if stripped.startswith("- kube-scheduler"):
+        in_command = True
+        out.append(line)
+        indent = line[:len(line) - len(line.lstrip())]
+        out.append(f"{indent}- --config=/etc/kubernetes/tpushare/kube-scheduler-config.yaml\n")
+        continue
+    if in_command and stripped.startswith("- --config="):
+        continue  # drop any pre-existing --config flag
+    if in_command and not stripped.startswith("- --"):
+        in_command = False
+    out.append(line)
+
+# ensure the tpushare config dir is mounted
+text = "".join(out)
+if "tpushare-config" not in text:
+    text = text.replace(
+        "  volumes:\n",
+        "  volumes:\n"
+        "  - hostPath:\n"
+        "      path: /etc/kubernetes/tpushare\n"
+        "      type: DirectoryOrCreate\n"
+        "    name: tpushare-config\n", 1)
+    text = text.replace(
+        "    volumeMounts:\n",
+        "    volumeMounts:\n"
+        "    - mountPath: /etc/kubernetes/tpushare\n"
+        "      name: tpushare-config\n"
+        "      readOnly: true\n", 1)
+
+with open(path, "w") as f:
+    f.write(text)
+EOF
+
+echo "registered tpushare extender; kubelet will restart kube-scheduler"

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Restore the newest pre-tpushare kube-scheduler manifest backup.
+set -euo pipefail
+
+HOST_K8S_DIR="${HOST_K8S_DIR:-/etc/kubernetes}"
+MANIFEST="$HOST_K8S_DIR/manifests/kube-scheduler.yaml"
+
+backup="$(ls -1t "$MANIFEST".tpushare-backup-* 2>/dev/null | head -1 || true)"
+if [[ -z "$backup" ]]; then
+  echo "no tpushare backup found next to $MANIFEST" >&2
+  exit 1
+fi
+cp "$backup" "$MANIFEST"
+echo "restored $MANIFEST from $backup"

@@ -8,11 +8,21 @@ dry-run contract. The env vars must be set before the first jax import.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session environment points JAX at real TPU
+# hardware (JAX_PLATFORMS=axon, registered by a sitecustomize hook that
+# imports jax BEFORE this file runs — env vars alone are therefore too
+# late). Backends initialize lazily, so flipping the config here still
+# works. The test suite must be hermetic and fast; only bench.py runs on
+# the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (after env setup, before any backend init)
+
+jax.config.update("jax_platforms", "cpu")
 
 # repo root on sys.path so `import tpushare` works without installation
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -172,6 +172,42 @@ def test_resync_clears_unhealthy_after_configmap_deletion(rig):
     assert info.describe()["unhealthy_chips"] == []
 
 
+def test_watch_loop_survives_stream_crash():
+    # a watch stream that dies mid-flight must be restarted, not abandoned
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+
+    class CrashyOnce:
+        def __init__(self, inner):
+            self._inner = inner
+            self.crashed = False
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def watch_pods(self, stop):
+            if not self.crashed:
+                self.crashed = True
+                raise ConnectionResetError("stream reset")
+            return self._inner.watch_pods(stop)
+
+    crashy = CrashyOnce(fc)
+    cache = SchedulerCache(crashy)
+    ctl = Controller(crashy, cache)
+    ctl.build_cache()
+    ctl.start()
+    try:
+        # wait for the crashed loop to reconnect (a live subscriber appears)
+        assert wait_until(lambda: crashy.crashed and fc._watchers["pods"])
+        info = cache.get_node_info("n1")
+        pod = fc.create_pod(make_pod(hbm=2000, name="p"))
+        info.allocate(pod, fc)
+        # the restarted watch (second attempt) must deliver the sync
+        assert wait_until(lambda: cache.known_pod(pod["metadata"]["uid"]))
+    finally:
+        ctl.stop()
+
+
 def test_node_deletion_removes_nodeinfo(rig):
     fc, cache, ctl = rig
     cache.get_node_info("n1")

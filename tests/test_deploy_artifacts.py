@@ -1,0 +1,124 @@
+"""Deployment artifact tests: manifests parse, contracts line up, and the
+host-mutation installer script actually rewrites a scheduler manifest."""
+
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+import yaml
+
+from tpushare import contract
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_all(relpath):
+    with open(os.path.join(REPO, relpath)) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def test_all_config_manifests_parse():
+    for name in os.listdir(os.path.join(REPO, "config")):
+        if name.endswith((".yaml", ".yml")):
+            assert load_all(f"config/{name}"), name
+        elif name.endswith(".json"):
+            with open(os.path.join(REPO, "config", name)) as f:
+                assert json.load(f), name
+
+
+def test_all_samples_parse_and_request_tpu():
+    for name in sorted(os.listdir(os.path.join(REPO, "samples"))):
+        if not name.endswith(".yaml"):
+            continue
+        docs = load_all(f"samples/{name}")
+        for doc in docs:
+            tmpl = doc["spec"]["template"]["spec"]
+            limits = tmpl["containers"][0]["resources"]["limits"]
+            assert contract.RESOURCE_HBM in limits, name
+
+
+def test_policy_config_matches_contract():
+    with open(os.path.join(REPO, "config/scheduler-policy-config.json")) as f:
+        policy = json.load(f)
+    ext = policy["extenders"][0]
+    managed = {m["name"] for m in ext["managedResources"]}
+    assert managed == {contract.RESOURCE_HBM, contract.RESOURCE_COUNT}
+    assert ext["nodeCacheCapable"] is True
+    assert ext["bindVerb"] == "bind" and ext["filterVerb"] == "filter"
+    # modern config must manage the same resources
+    (cfg,) = load_all("config/kube-scheduler-config.yaml")
+    modern = {m["name"] for m in cfg["extenders"][0]["managedResources"]}
+    assert modern == managed
+
+
+def test_serving_sample_topology_annotation_is_consistent():
+    (doc,) = load_all("samples/5-serving.yaml")
+    meta = doc["spec"]["template"]["metadata"]
+    ann = meta["annotations"][contract.ANN_TOPOLOGY]
+    limits = doc["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    dims = [int(x) for x in ann.split("x")]
+    count = limits["aliyun.com/tpu-count"]
+    assert dims[0] * dims[1] == count
+
+
+@pytest.fixture
+def fake_host(tmp_path):
+    """A pretend control-plane host's /etc/kubernetes."""
+    k8s = tmp_path / "etc-kubernetes"
+    (k8s / "manifests").mkdir(parents=True)
+    shutil.copy(os.path.join(REPO, "config/kube-scheduler.yaml"),
+                k8s / "manifests" / "kube-scheduler.yaml")
+    # strip the pre-registered tpushare config to simulate a stock host
+    manifest = k8s / "manifests" / "kube-scheduler.yaml"
+    text = manifest.read_text().replace(
+        "        - --config=/etc/kubernetes/tpushare/kube-scheduler-config.yaml\n",
+        "")
+    manifest.write_text(text)
+    return k8s
+
+
+def run_script(name, env):
+    return subprocess.run(
+        ["bash", os.path.join(REPO, "deployer/docker", name)],
+        env={**os.environ, **env}, capture_output=True, text=True)
+
+
+def test_install_script_registers_extender_idempotently(fake_host):
+    env = {"HOST_K8S_DIR": str(fake_host)}
+    r = run_script("install-sched-extender-on-host.sh", env)
+    assert r.returncode == 0, r.stderr
+    manifest = (fake_host / "manifests" / "kube-scheduler.yaml").read_text()
+    assert "--config=/etc/kubernetes/tpushare/kube-scheduler-config.yaml" in manifest
+    (doc,) = yaml.safe_load_all(manifest)  # still valid YAML
+    cfg = yaml.safe_load(
+        (fake_host / "tpushare" / "kube-scheduler-config.yaml").read_text())
+    assert cfg["extenders"][0]["nodeCacheCapable"] is True
+    backups = list((fake_host / "manifests").glob("*.tpushare-backup-*"))
+    assert len(backups) == 1
+    # second run is a no-op (no duplicate flag, no second backup)
+    r2 = run_script("install-sched-extender-on-host.sh", env)
+    assert r2.returncode == 0 and "already registered" in r2.stdout
+    assert manifest == (fake_host / "manifests" / "kube-scheduler.yaml").read_text()
+
+
+def test_uninstall_script_restores_backup(fake_host):
+    env = {"HOST_K8S_DIR": str(fake_host)}
+    original = (fake_host / "manifests" / "kube-scheduler.yaml").read_text()
+    run_script("install-sched-extender-on-host.sh", env)
+    r = run_script("uninstall-sched-extender-on-host.sh", env)
+    assert r.returncode == 0, r.stderr
+    assert (fake_host / "manifests" / "kube-scheduler.yaml").read_text() == original
+
+
+def test_evict_and_recover_scripts(fake_host):
+    env = {"HOST_K8S_DIR": str(fake_host)}
+    stock = fake_host / "manifests" / "stock-tpu-device-plugin.yaml"
+    stock.write_text("kind: DaemonSet\n")
+    r = run_script("dp-evict-on-host.sh", env)
+    assert r.returncode == 0 and not stock.exists()
+    assert (fake_host / "tpushare-parked" /
+            "stock-tpu-device-plugin.yaml").exists()
+    r = run_script("dp-recover-on-host.sh", env)
+    assert r.returncode == 0 and stock.exists()

@@ -1,0 +1,299 @@
+"""Device plugin tests: enumeration, registration, Allocate rendezvous,
+health reporting, socket transport, and the full extender->plugin handoff.
+"""
+
+import os
+import threading
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.deviceplugin import DevicePlugin, FakeEnumerator
+from tpushare.deviceplugin.enumerator import NativeEnumerator, _hbm_from_env
+from tpushare.deviceplugin.plugin import AllocateError
+from tpushare.deviceplugin.transport import SocketServer, call
+from tpushare.k8s import FakeCluster
+
+
+def rig(chips=4, hbm=16000, mesh="2x2", node="n1"):
+    fc = FakeCluster()
+    fc.add_tpu_node(node, chips=chips, hbm_per_chip_mib=hbm, mesh=mesh)
+    enum = FakeEnumerator(chips, hbm, mesh)
+    plugin = DevicePlugin(fc, node, enum)
+    return fc, plugin
+
+
+def place(fc, name, hbm, count=1, node="n1", now_ns=None):
+    """Run the extender's bind path to produce a placed pod."""
+    cache = SchedulerCache(fc)
+    info = cache.get_node_info(node)
+    pod = fc.create_pod(make_pod(hbm=hbm, count=count if count > 1 else 0,
+                                 name=name))
+    kwargs = {} if now_ns is None else {"now_ns": lambda: now_ns}
+    info.allocate(pod, fc, **kwargs)
+    return fc.get_pod("default", name)
+
+
+# -- enumeration --------------------------------------------------------------
+
+def test_fake_enumerator_shapes():
+    e = FakeEnumerator(4, 16000, "2x2")
+    chips = e.enumerate()
+    assert [c.idx for c in chips] == [0, 1, 2, 3]
+    assert chips[3].coords == (1, 1)
+    with pytest.raises(ValueError):
+        FakeEnumerator(4, 16000, "4x4")
+
+
+def test_native_enumerator_fake_env(monkeypatch):
+    monkeypatch.setenv("TPUSHARE_FAKE_CHIPS", "4")
+    monkeypatch.setenv("TPUSHARE_HBM_MIB", "12345")
+    native = NativeEnumerator()
+    if not native.available():
+        pytest.skip("native enumerator unavailable")
+    chips = native.enumerate()
+    assert len(chips) == 4
+    assert all(c.hbm_mib == 12345 for c in chips)
+    assert chips[0].device_path == "/dev/accel0"
+    # chips can disappear between scans (health loop relies on this)
+    monkeypatch.setenv("TPUSHARE_FAKE_CHIPS", "2")
+    assert len(native.enumerate()) == 2
+
+
+def test_hbm_generation_table(monkeypatch):
+    monkeypatch.delenv("TPUSHARE_HBM_MIB", raising=False)
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-8")
+    assert _hbm_from_env() == 95 * 1024
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e")
+    assert _hbm_from_env() == 16 * 1024
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE")
+    assert _hbm_from_env() == 16 * 1024
+
+
+# -- registration -------------------------------------------------------------
+
+def test_register_node_patches_resources_and_labels():
+    fc = FakeCluster()
+    # node exists but reports nothing yet (fresh kubelet)
+    fc.add_tpu_node("n1", chips=1, hbm_per_chip_mib=1)
+    plugin = DevicePlugin(fc, "n1", FakeEnumerator(4, 16000, "2x2"))
+    plugin.register_node()
+    node = fc.get_node("n1")
+    assert node["status"]["allocatable"][contract.RESOURCE_HBM] == "64000"
+    assert node["status"]["allocatable"][contract.RESOURCE_COUNT] == "4"
+    assert node["metadata"]["labels"][contract.LABEL_MESH] == "2x2"
+
+
+# -- allocate rendezvous ------------------------------------------------------
+
+def test_allocate_matches_amount_and_injects_env():
+    fc, plugin = rig()
+    place(fc, "w1", hbm=2048)
+    resp = plugin.allocate(hbm_mib=2048)
+    assert resp["pod"]["name"] == "w1"
+    env = resp["env"]
+    assert env[contract.ENV_VISIBLE_CHIPS] == str(resp["chip_ids"][0])
+    assert env[contract.ENV_HBM_LIMIT] == "2048"
+    assert env[contract.ENV_HBM_CHIP_TOTAL] == "16000"
+    assert env[contract.ENV_MEM_FRACTION] == f"{2048/16000:.4f}"
+    assert resp["devices"] == [f"/dev/accel{resp['chip_ids'][0]}"]
+    # assigned flipped to true (designs.md:101)
+    assert contract.is_assigned(fc.get_pod("default", "w1"))
+    # second allocate finds nothing pending
+    with pytest.raises(AllocateError):
+        plugin.allocate(hbm_mib=2048)
+
+
+def test_allocate_tie_broken_by_assume_time_then_uid():
+    fc, plugin = rig()
+    place(fc, "late", hbm=2048, now_ns=2000)
+    place(fc, "early", hbm=2048, now_ns=1000)
+    resp = plugin.allocate(hbm_mib=2048)
+    assert resp["pod"]["name"] == "early"  # earliest assume-time wins
+    resp2 = plugin.allocate(hbm_mib=2048)
+    assert resp2["pod"]["name"] == "late"
+
+
+def test_allocate_by_pod_uid():
+    fc, plugin = rig()
+    p1 = place(fc, "a", hbm=2048, now_ns=1)
+    place(fc, "b", hbm=2048, now_ns=2)
+    resp = plugin.allocate(pod_uid=p1["metadata"]["uid"])
+    assert resp["pod"]["name"] == "a"
+
+
+def test_allocate_multichip_env():
+    fc, plugin = rig(chips=16, hbm=16000, mesh="4x4")
+    place(fc, "mc", hbm=8000, count=4)
+    resp = plugin.allocate(hbm_mib=8000)
+    assert len(resp["chip_ids"]) == 4
+    assert resp["env"][contract.ENV_VISIBLE_CHIPS] == \
+        ",".join(str(i) for i in resp["chip_ids"])
+    assert len(resp["devices"]) == 4
+
+
+def test_allocate_exclusive_has_no_fraction_cap():
+    fc, plugin = rig(chips=2, hbm=16000, mesh=None)
+    cache = SchedulerCache(fc)
+    pod = fc.create_pod(make_pod(count=1, name="excl"))
+    cache.get_node_info("n1").allocate(pod, fc)
+    resp = plugin.allocate(hbm_mib=None, pod_uid=pod["metadata"]["uid"])
+    assert contract.ENV_MEM_FRACTION not in resp["env"]
+    assert resp["env"][contract.ENV_HBM_LIMIT] == "16000"
+
+
+def test_allocate_matches_per_container_amount():
+    # kubelet allocates per CONTAINER: a two-container pod (1024 each) gets
+    # Allocate(1024) calls while the annotation carries the pod sum 2048
+    fc, plugin = rig()
+    cache = SchedulerCache(fc)
+    pod = make_pod(hbm=1024, name="mc2", containers=2)  # pod-level ask 2048
+    pod = fc.create_pod(pod)
+    cache.get_node_info("n1").allocate(pod, fc)
+    resp = plugin.allocate(hbm_mib=1024)  # container-level amount
+    assert resp["pod"]["name"] == "mc2"
+    assert resp["env"][contract.ENV_HBM_LIMIT] == "2048"
+
+
+def test_allocate_exclusive_matches_zero_amount():
+    # count-only pods have no tpu-hbm limit: kubelet's tpu-count Allocate
+    # carries no hbm amount (0)
+    fc, plugin = rig(chips=2, hbm=16000, mesh=None)
+    cache = SchedulerCache(fc)
+    pod = fc.create_pod(make_pod(count=1, name="excl0"))
+    cache.get_node_info("n1").allocate(pod, fc)
+    resp = plugin.allocate(hbm_mib=0)
+    assert resp["pod"]["name"] == "excl0"
+
+
+def test_native_enumerator_keeps_device_numbers(monkeypatch):
+    # ids must come from the device-node number so a vanished middle chip
+    # doesn't shift the survivors' identities
+    from tpushare.deviceplugin.enumerator import _idx_from_path
+    assert _idx_from_path("/dev/accel3", default=9) == 3
+    assert _idx_from_path("/dev/vfio/7", default=9) == 7
+    assert _idx_from_path("/dev/weird", default=9) == 9
+
+
+def test_health_writes_only_on_change():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    enum = ShrinkingEnumerator()
+    plugin = DevicePlugin(fc, "n1", enum)
+    plugin.check_health()
+    rv1 = fc.get_configmap("kube-system", "unhealthy-tpu-n1")[
+        "metadata"]["resourceVersion"]
+    plugin.check_health()  # unchanged -> no write
+    rv2 = fc.get_configmap("kube-system", "unhealthy-tpu-n1")[
+        "metadata"]["resourceVersion"]
+    assert rv1 == rv2
+    enum.lost = {2}
+    plugin.check_health()  # changed -> write
+    cm = fc.get_configmap("kube-system", "unhealthy-tpu-n1")
+    assert cm["data"]["chips"] == "2"
+    assert cm["metadata"]["resourceVersion"] != rv1
+
+
+def test_allocate_no_match_errors():
+    fc, plugin = rig()
+    place(fc, "w1", hbm=2048)
+    with pytest.raises(AllocateError, match="no pending pod"):
+        plugin.allocate(hbm_mib=4096)  # wrong amount
+
+
+# -- health -------------------------------------------------------------------
+
+class ShrinkingEnumerator(FakeEnumerator):
+    def __init__(self):
+        super().__init__(4, 16000, "2x2")
+        self.lost: set = set()
+
+    def enumerate(self):
+        return [c for c in super().enumerate() if c.idx not in self.lost]
+
+
+def test_health_writes_unhealthy_configmap():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    enum = ShrinkingEnumerator()
+    plugin = DevicePlugin(fc, "n1", enum)
+    assert plugin.check_health() == set()
+    enum.lost = {1, 3}
+    assert plugin.check_health() == {1, 3}
+    cm = fc.get_configmap("kube-system", "unhealthy-tpu-n1")
+    assert cm["data"]["chips"] == "1,3"
+    # recovery clears the configmap
+    enum.lost = set()
+    plugin.check_health()
+    assert fc.get_configmap(
+        "kube-system", "unhealthy-tpu-n1")["data"]["chips"] == ""
+
+
+def test_gc_counts_stale_pending():
+    fc, plugin = rig()
+    place(fc, "stuck", hbm=2048, now_ns=1)  # placed at epoch -> ancient
+    assert plugin.gc_stale_assignments(max_pending_seconds=1) == 1
+    plugin.allocate(hbm_mib=2048)
+    assert plugin.gc_stale_assignments(max_pending_seconds=1) == 0
+
+
+# -- socket transport ---------------------------------------------------------
+
+def test_socket_transport_roundtrip(tmp_path):
+    fc, plugin = rig()
+    place(fc, "w1", hbm=2048)
+    sock = str(tmp_path / "dp.sock")
+    server = SocketServer(plugin, sock)
+    server.start()
+    try:
+        resp = call(sock, {"method": "list"})
+        assert len(resp["chips"]) == 4
+        resp = call(sock, {"method": "report"})
+        assert resp["status"]["allocatable"][contract.RESOURCE_HBM] == "64000"
+        resp = call(sock, {"method": "allocate", "hbm_mib": 2048})
+        assert resp["pod"]["name"] == "w1"
+        resp = call(sock, {"method": "allocate", "hbm_mib": 2048})
+        assert "no pending pod" in resp["error"]
+        resp = call(sock, {"method": "health"})
+        assert resp["unhealthy"] == []
+        resp = call(sock, {"method": "bogus"})
+        assert "unknown method" in resp["error"]
+    finally:
+        server.stop()
+
+
+# -- full extender -> device-plugin handoff -----------------------------------
+
+def test_full_scheduling_to_runtime_cycle():
+    """The complete designs.md lifecycle: filter-time fit, bind-time
+    placement annotations, runtime Allocate matching, assigned flip."""
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    cache = SchedulerCache(fc)
+    info = cache.get_node_info("n1")
+    plugin = DevicePlugin(fc, "n1", FakeEnumerator(4, 16000, "2x2"))
+
+    for i, hbm in enumerate([2000, 2000, 12000]):
+        pod = fc.create_pod(make_pod(hbm=hbm, name=f"w{i}"))
+        ok, _ = info.assume(pod)
+        assert ok
+        info.allocate(pod, fc, now_ns=lambda i=i: i)
+
+    # kubelet starts containers in arbitrary order; amounts disambiguate,
+    # ties resolve by assume time
+    r3 = plugin.allocate(hbm_mib=12000)
+    assert r3["pod"]["name"] == "w2"
+    r1 = plugin.allocate(hbm_mib=2000)
+    assert r1["pod"]["name"] == "w0"  # earlier assume-time
+    r2 = plugin.allocate(hbm_mib=2000)
+    assert r2["pod"]["name"] == "w1"
+    # min-free-that-fits packs ALL three onto chip 0: the two 2000s share
+    # it, then its remaining 12000 is the tightest fit for the big pod —
+    # one chip fully utilized, three left pristine for future large pods
+    assert r1["chip_ids"] == r2["chip_ids"] == r3["chip_ids"]
+    node_desc = cache.get_node_info("n1").describe()
+    packed = node_desc["chips"][r1["chip_ids"][0]]
+    assert packed["used_hbm_mib"] == packed["total_hbm_mib"] == 16000
+    assert cache.describe()["used_hbm_mib"] == 16000

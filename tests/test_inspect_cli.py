@@ -1,0 +1,58 @@
+"""Inspect CLI tests: render golden tables from live extender output."""
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare.cache import SchedulerCache
+from tpushare.inspect.cli import fetch, main, render_table
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+
+
+@pytest.fixture
+def live(capsys):
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=15000)
+    fc.add_tpu_node("n2", chips=1, hbm_per_chip_mib=15000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=9000, name="worker"))
+    info.allocate(pod, fc)
+    # register in the pod index as the controller's sync loop would
+    cache.add_or_update_pod(fc.get_pod("default", "worker"))
+    server = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    port = server.start()
+    yield f"http://127.0.0.1:{port}"
+    server.stop()
+
+
+def test_cli_summary_table(live, capsys):
+    assert main(["--endpoint", live]) == 0
+    out = capsys.readouterr().out
+    assert "NAME" in out and "n1" in out and "n2" in out
+    # userguide.md:17-style cluster footer: 9000/45000 = 20%
+    assert "Allocated/Total TPU HBM in Cluster: 9000/45000 MiB (20%)" in out
+
+
+def test_cli_details_shows_pods(live, capsys):
+    assert main(["--endpoint", live, "-d"]) == 0
+    out = capsys.readouterr().out
+    assert "default/worker=9000" in out
+    assert "COORDS" in out
+
+
+def test_cli_single_node(live, capsys):
+    assert main(["--endpoint", live, "n1"]) == 0
+    out = capsys.readouterr().out
+    assert "n1" in out and "9000/30000" in out
+
+
+def test_cli_unreachable_endpoint(capsys):
+    assert main(["--endpoint", "http://127.0.0.1:1"]) == 1
+    assert "cannot reach extender" in capsys.readouterr().err
+
+
+def test_render_empty_cluster():
+    out = render_table({"nodes": [], "used_hbm_mib": 0, "total_hbm_mib": 0})
+    assert "Allocated/Total TPU HBM in Cluster: 0/0 MiB (-)" in out

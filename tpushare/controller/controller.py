@@ -104,7 +104,24 @@ class Controller:
 
     # -- watch loops ----------------------------------------------------------
 
+    def _watch_forever(self, fn, name: str) -> None:
+        """Run a watch-consuming loop, restarting it on any unexpected
+        exception: a dead watch thread would silently freeze the cache
+        (only the 30 s resync would remain, and nothing at all for node
+        or configmap changes)."""
+        while not self._stop.is_set():
+            try:
+                fn()
+                return  # clean exit (stop set)
+            except Exception as e:  # noqa: BLE001 — watch must survive
+                log.warning("controller: %s watch crashed, restarting: %s",
+                            name, e)
+                self._stop.wait(1.0)
+
     def _pod_watch_loop(self) -> None:
+        self._watch_forever(self._consume_pod_events, "pod")
+
+    def _consume_pod_events(self) -> None:
         for ev in self._cluster.watch_pods(self._stop):
             pod = ev.object
             if not contract.is_tpushare_pod(pod):
@@ -144,6 +161,9 @@ class Controller:
         return False
 
     def _node_watch_loop(self) -> None:
+        self._watch_forever(self._consume_node_events, "node")
+
+    def _consume_node_events(self) -> None:
         for ev in self._cluster.watch_nodes(self._stop):
             node = ev.object
             name = nodelib.node_name(node)
@@ -153,6 +173,9 @@ class Controller:
                 self.cache.update_node(node)
 
     def _cm_watch_loop(self) -> None:
+        self._watch_forever(self._consume_cm_events, "configmap")
+
+    def _consume_cm_events(self) -> None:
         for ev in self._cluster.watch_configmaps(self._stop):
             cm = ev.object
             meta = cm.get("metadata") or {}

@@ -1,0 +1,36 @@
+"""TPU-share device plugin: the node agent.
+
+The tpushare analogue of the sibling-repo gpushare-device-plugin (behavioral
+spec: /root/reference/docs/designs/designs.md:53-101, SURVEY §2.9):
+
+1. **Enumerate** the host's TPU chips (reference uses NVML, designs.md:59;
+   here a C++ enumerator probes /dev/accel* + libtpu topology env, with a
+   fake backend for hermetic tests).
+2. **Report** ``aliyun.com/tpu-hbm = chips x hbm`` and ``tpu-count`` as node
+   extended resources, plus the ``tpushare.aliyun.com/mesh`` topology label
+   (designs.md:57-63 reports through kubelet ListAndWatch; standalone mode
+   patches node status directly — the reference's device-plugin RBAC already
+   includes nodes/status patch, config/device-plugin-rbac.yaml:34-39).
+3. **Allocate**: when kubelet creates a container, match the request to the
+   pod the extender placed and return the container env
+   (``TPU_VISIBLE_CHIPS``, HBM limit vars; reference injects
+   NVIDIA_VISIBLE_DEVICES, designs.md:95-101).
+
+The rendezvous improves on the reference's amount-only matching
+(designs.md:97-99, ambiguous when two pending pods request the same
+amount): candidates are ordered by (assume-time, pod UID) so ties are
+deterministic, and the chosen pod's UID travels in the response for
+auditability.
+
+Transport: the core logic (:class:`DevicePlugin`) is transport-agnostic.
+A JSON-over-unix-socket server drives it in tests and standalone
+deployments; the kubelet device-plugin gRPC definitions are shipped under
+``protos/`` for the production shim (grpc is not in this image).
+"""
+
+from tpushare.deviceplugin.enumerator import (
+    ChipRecord, FakeEnumerator, NativeEnumerator, detect_enumerator)
+from tpushare.deviceplugin.plugin import DevicePlugin
+
+__all__ = ["ChipRecord", "FakeEnumerator", "NativeEnumerator",
+           "detect_enumerator", "DevicePlugin"]

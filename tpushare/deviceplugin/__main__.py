@@ -1,0 +1,96 @@
+"""Device plugin entry point.
+
+Production (on a TPU node, in-cluster):
+
+    python -m tpushare.deviceplugin --node-name "$NODE_NAME"
+
+Development / hermetic:
+
+    python -m tpushare.deviceplugin --node-name n1 \
+        --fake-chips 4 --hbm 16384 --mesh 2x2 \
+        --fake-cluster --socket /tmp/tpushare-dp.sock
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from tpushare.deviceplugin.enumerator import FakeEnumerator, detect_enumerator
+from tpushare.deviceplugin.plugin import DevicePlugin
+from tpushare.deviceplugin.transport import SocketServer
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpushare-device-plugin")
+    ap.add_argument("--node-name",
+                    default=os.environ.get("NODE_NAME", ""))
+    ap.add_argument("--socket",
+                    default="/var/lib/tpushare/device-plugin.sock")
+    ap.add_argument("--fake-chips", type=int, default=0)
+    ap.add_argument("--hbm", type=int, default=16 * 1024,
+                    help="per-chip HBM MiB for --fake-chips")
+    ap.add_argument("--mesh", default=None)
+    ap.add_argument("--fake-cluster", action="store_true",
+                    help="run against an in-memory cluster (dev only)")
+    ap.add_argument("--apiserver", default=None)
+    ap.add_argument("--health-interval", type=float, default=30.0)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=getattr(logging,
+                      os.environ.get("LOG_LEVEL", "info").upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    log = logging.getLogger("tpushare.dp.main")
+
+    if not args.node_name:
+        ap.error("--node-name (or NODE_NAME env) is required")
+
+    if args.fake_chips > 0:
+        enumerator = FakeEnumerator(args.fake_chips, args.hbm, args.mesh)
+    else:
+        enumerator = detect_enumerator()
+        if enumerator is None:
+            log.error("no TPU chips detected (and no --fake-chips given)")
+            return 1
+
+    if args.fake_cluster:
+        from tpushare.k8s import FakeCluster
+        cluster = FakeCluster()
+        cluster.add_tpu_node(args.node_name,
+                             chips=max(args.fake_chips, 1),
+                             hbm_per_chip_mib=args.hbm, mesh=args.mesh)
+    else:
+        from tpushare.k8s.incluster import InClusterClient
+        cluster = InClusterClient(base_url=args.apiserver)
+
+    plugin = DevicePlugin(cluster, args.node_name, enumerator)
+    plugin.register_node()
+
+    server = SocketServer(plugin, args.socket)
+    server.start()
+
+    stop = threading.Event()
+    threading.Thread(target=plugin.health_loop,
+                     args=(stop, args.health_interval),
+                     name="tpushare-dp-health", daemon=True).start()
+
+    def on_signal(signum, _frame):
+        if stop.is_set():
+            sys.exit(1)
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    print(f"tpushare device plugin ready on {args.socket}", flush=True)
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

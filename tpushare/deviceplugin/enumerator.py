@@ -1,0 +1,190 @@
+"""Chip enumeration backends.
+
+The reference device plugin asks NVML for device count + memory
+(designs.md:59). TPU hosts have no NVML; the native backend (tpuinfo.cpp,
+ctypes-loaded like the placement engine) probes, in order:
+
+1. ``TPUSHARE_FAKE_CHIPS`` / ``TPUSHARE_FAKE_HBM_MIB`` env override
+   (hermetic CI on chip-less machines),
+2. ``/dev/accel*`` device nodes created by the Google TPU driver,
+3. ``/dev/vfio`` group count as a fallback for VFIO-passthrough VMs,
+
+and derives per-chip HBM from ``TPUSHARE_HBM_MIB`` env or a generation
+table keyed by ``TPU_ACCELERATOR_TYPE`` (v5e 16 GiB, v5p 95 GiB, v4 32 GiB,
+v6e 32 GiB). Host mesh shape comes from libtpu's
+``TPU_CHIPS_PER_HOST_BOUNDS`` (e.g. ``2,2,1``) when set, else the default
+near-square factorization.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+
+from tpushare.core.topology import MeshTopology
+
+# per-chip HBM MiB by accelerator generation (public specs)
+GENERATION_HBM_MIB = {
+    "v2": 8 * 1024,
+    "v3": 16 * 1024,
+    "v4": 32 * 1024,
+    "v5e": 16 * 1024,
+    "v5litepod": 16 * 1024,
+    "v5p": 95 * 1024,
+    "v6e": 32 * 1024,
+}
+DEFAULT_HBM_MIB = 16 * 1024
+
+
+@dataclass(frozen=True)
+class ChipRecord:
+    idx: int
+    coords: tuple[int, ...]
+    hbm_mib: int
+    device_path: str  # what the container needs mounted (informational)
+
+
+class FakeEnumerator:
+    """Hermetic backend: a synthetic host (tests, --fake-chips mode)."""
+
+    def __init__(self, chips: int, hbm_mib: int = 16 * 1024,
+                 mesh: str | None = None) -> None:
+        self._topo = (MeshTopology.from_label(mesh) if mesh
+                      else MeshTopology.for_chip_count(chips))
+        if self._topo.num_chips != chips:
+            raise ValueError(f"mesh {mesh} != {chips} chips")
+        self._chips = chips
+        self._hbm = hbm_mib
+
+    def enumerate(self) -> list[ChipRecord]:
+        return [ChipRecord(i, self._topo.coords(i), self._hbm,
+                           f"/dev/accel{i}")
+                for i in range(self._chips)]
+
+    @property
+    def mesh(self) -> MeshTopology:
+        return self._topo
+
+
+def _mesh_from_env(count: int) -> MeshTopology:
+    bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+    if bounds:
+        try:
+            dims = tuple(int(x) for x in bounds.split(",") if int(x) > 0)
+            dims = tuple(d for d in dims if d > 1) or (1,)
+            topo = MeshTopology(dims)
+            if topo.num_chips == count:
+                return topo
+        except ValueError:
+            pass
+    return MeshTopology.for_chip_count(count)
+
+
+def _hbm_from_env() -> int:
+    raw = os.environ.get("TPUSHARE_HBM_MIB")
+    if raw and raw.isdigit():
+        return int(raw)
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE", "").lower()
+    for gen, hbm in GENERATION_HBM_MIB.items():
+        if acc.startswith(gen):
+            return hbm
+    return DEFAULT_HBM_MIB
+
+
+class NativeEnumerator:
+    """C++ probe of the host (tpuinfo.cpp), ctypes-bridged.
+
+    The native layer answers only "how many chips, where are the device
+    nodes"; HBM sizing and mesh shape policy stay in Python where the env
+    conventions live.
+    """
+
+    _lock = threading.Lock()
+    _lib: ctypes.CDLL | None = None
+    _tried = False
+
+    def __init__(self) -> None:
+        self._load()
+
+    @classmethod
+    def _load(cls) -> ctypes.CDLL | None:
+        with cls._lock:
+            if cls._tried:
+                return cls._lib
+            cls._tried = True
+            here = os.path.dirname(os.path.abspath(__file__))
+            src = os.path.join(here, "native", "tpuinfo.cpp")
+            so = os.path.join(here, "native", "libtpushare_tpuinfo.so")
+            if not os.path.exists(so) or (
+                    os.path.exists(src)
+                    and os.path.getmtime(src) > os.path.getmtime(so)):
+                try:
+                    subprocess.run(
+                        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                         src, "-o", so, "-ldl"],
+                        check=True, capture_output=True, timeout=120)
+                except Exception:
+                    return None
+            try:
+                lib = ctypes.CDLL(so)
+                lib.tpushare_chip_count.restype = ctypes.c_int
+                lib.tpushare_chip_count.argtypes = []
+                lib.tpushare_device_path.restype = ctypes.c_int
+                lib.tpushare_device_path.argtypes = [
+                    ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+                lib.tpushare_probe_reset.restype = None
+                lib.tpushare_probe_reset.argtypes = []
+                cls._lib = lib
+            except OSError:
+                cls._lib = None
+            return cls._lib
+
+    def available(self) -> bool:
+        return self._lib is not None
+
+    def enumerate(self) -> list[ChipRecord]:
+        lib = self._load()
+        if lib is None:
+            return []
+        lib.tpushare_probe_reset()  # fresh scan: health checks need truth
+        count = lib.tpushare_chip_count()
+        if count <= 0:
+            return []
+        hbm = _hbm_from_env()
+        topo = _mesh_from_env(count)
+        out = []
+        buf = ctypes.create_string_buffer(256)
+        for i in range(count):
+            rc = lib.tpushare_device_path(i, buf, len(buf))
+            path = buf.value.decode() if rc == 0 else f"/dev/accel{i}"
+            # chip id comes from the device-node NUMBER, not the scan
+            # position: when /dev/accel1 vanishes the survivors must keep
+            # ids {0,2,3} so health reporting marks the right chip
+            idx = _idx_from_path(path, default=i)
+            coords = topo.coords(idx) if idx < topo.num_chips else (idx,)
+            out.append(ChipRecord(idx, coords, hbm, path))
+        return out
+
+    @property
+    def mesh(self) -> MeshTopology:
+        lib = self._load()
+        count = lib.tpushare_chip_count() if lib else 0
+        return _mesh_from_env(max(count, 1))
+
+
+def _idx_from_path(path: str, default: int) -> int:
+    tail = path.rstrip("/").rsplit("/", 1)[-1]
+    digits = "".join(ch for ch in tail if ch.isdigit())
+    return int(digits) if digits else default
+
+
+def detect_enumerator():
+    """NativeEnumerator when it finds chips (or a fake-env override is set),
+    else None — callers fall back to explicit --fake-chips configuration."""
+    native = NativeEnumerator()
+    if native.available() and native.enumerate():
+        return native
+    return None

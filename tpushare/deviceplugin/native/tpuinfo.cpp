@@ -1,0 +1,99 @@
+// Host TPU chip probe for the tpushare device plugin.
+//
+// Role analogue: the reference device plugin's NVML usage
+// (/root/reference/docs/designs/designs.md:59 — "uses the nvml library to
+// query the number of GPU devices and the GPU memory"). TPU hosts expose
+// chips as /dev/accel* nodes (Google TPU kernel driver) or as VFIO groups;
+// libtpu itself has no stable public C enumeration ABI, so this probes the
+// device filesystem the way libtpu's own platform layer does.
+//
+// Probe order:
+//   1. TPUSHARE_FAKE_CHIPS env (hermetic tests / chip-less CI)
+//   2. /dev/accel[0-9]+
+//   3. /dev/vfio/<group> entries (VFIO passthrough VMs)
+//
+// Exposed C ABI (ctypes): tpushare_chip_count(), tpushare_device_path().
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Probe {
+  std::vector<std::string> paths;
+  bool done = false;
+};
+
+Probe g_probe;
+
+void run_probe() {
+  if (g_probe.done) return;
+  g_probe.done = true;
+
+  const char* fake = std::getenv("TPUSHARE_FAKE_CHIPS");
+  if (fake != nullptr) {
+    int n = std::atoi(fake);
+    for (int i = 0; i < n; ++i)
+      g_probe.paths.push_back("/dev/accel" + std::to_string(i));
+    return;
+  }
+
+  // /dev/accel* — Google TPU driver device nodes
+  if (DIR* dev = opendir("/dev")) {
+    std::vector<int> ids;
+    while (dirent* e = readdir(dev)) {
+      if (std::strncmp(e->d_name, "accel", 5) == 0) {
+        const char* suffix = e->d_name + 5;
+        if (*suffix && std::strspn(suffix, "0123456789") == std::strlen(suffix))
+          ids.push_back(std::atoi(suffix));
+      }
+    }
+    closedir(dev);
+    if (!ids.empty()) {
+      std::sort(ids.begin(), ids.end());
+      for (int id : ids)
+        g_probe.paths.push_back("/dev/accel" + std::to_string(id));
+      return;
+    }
+  }
+
+  // /dev/vfio/<N> groups (TPU VMs with VFIO passthrough)
+  if (DIR* vfio = opendir("/dev/vfio")) {
+    std::vector<int> ids;
+    while (dirent* e = readdir(vfio)) {
+      if (std::strspn(e->d_name, "0123456789") == std::strlen(e->d_name) &&
+          e->d_name[0] != '\0')
+        ids.push_back(std::atoi(e->d_name));
+    }
+    closedir(vfio);
+    std::sort(ids.begin(), ids.end());
+    for (int id : ids)
+      g_probe.paths.push_back("/dev/vfio/" + std::to_string(id));
+  }
+}
+
+}  // namespace
+
+extern "C" void tpushare_probe_reset() {
+  // re-probe on next call — the health loop must see chips disappear
+  g_probe.paths.clear();
+  g_probe.done = false;
+}
+
+extern "C" int tpushare_chip_count() {
+  run_probe();
+  return static_cast<int>(g_probe.paths.size());
+}
+
+extern "C" int tpushare_device_path(int idx, char* out, int cap) {
+  run_probe();
+  if (idx < 0 || idx >= static_cast<int>(g_probe.paths.size()) || cap <= 0)
+    return -1;
+  std::snprintf(out, cap, "%s", g_probe.paths[idx].c_str());
+  return 0;
+}

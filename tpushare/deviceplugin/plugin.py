@@ -1,0 +1,237 @@
+"""Device plugin core logic (transport-agnostic).
+
+Implements the runtime-allocation side of the annotation contract
+(reference designs.md §3 "Run the deployment on the node", SURVEY §3.4):
+
+    kubelet Allocate(request)                        [per container start]
+      -> list this node's pending tpushare pods with a placement annotation
+         and assigned=false, sorted by (assume-time, pod UID)
+      -> pick the one whose granted HBM matches the requested amount
+      -> patch assigned=true
+      -> return container env: TPU_VISIBLE_CHIPS, HBM limit vars, and the
+         XLA mem fraction that makes the limit effective inside JAX
+
+plus the reporting side: node extended resources + mesh label, and a health
+loop that records vanished chips in the unhealthy-chip configmap (an
+*automated* version of the reference's operator-maintained configmap,
+nodeinfo.go:406-431).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from tpushare import contract
+from tpushare.contract import pod as podlib
+from tpushare.contract.constants import (
+    ENV_HBM_CHIP_TOTAL,
+    ENV_HBM_LIMIT,
+    ENV_MEM_FRACTION,
+    ENV_VISIBLE_CHIPS,
+    LABEL_MESH,
+    LABEL_TPUSHARE_NODE,
+    RESOURCE_COUNT,
+    RESOURCE_HBM,
+    UNHEALTHY_CM_KEY,
+    UNHEALTHY_CM_NAMESPACE,
+    UNHEALTHY_CM_PREFIX,
+)
+from tpushare.k8s.client import ApiError
+
+log = logging.getLogger("tpushare.deviceplugin")
+
+
+class AllocateError(Exception):
+    pass
+
+
+def _match_amounts(pod) -> set[int]:
+    """Amounts a kubelet Allocate call for this pod may carry.
+
+    Kubelet allocates per *container*, so a multi-container pod produces one
+    call per container with that container's tpu-hbm limit — while the
+    hbm-pod annotation holds the pod-level sum. Exclusive (count-only) pods
+    produce tpu-count allocations with no tpu-hbm amount at all (0). All of
+    these must rendezvous with the same placed pod.
+    """
+    amounts = {contract.hbm_from_annotations(pod),
+               contract.pod_hbm_request(pod)}
+    for c in (pod.get("spec") or {}).get("containers") or []:
+        limits = ((c.get("resources") or {}).get("limits") or {})
+        raw = limits.get(contract.RESOURCE_HBM)
+        try:
+            if raw is not None:
+                amounts.add(int(raw))
+        except (TypeError, ValueError):
+            pass
+    if contract.pod_hbm_request(pod) == 0:  # exclusive count-only pod
+        amounts.add(0)
+    amounts.discard(None)
+    return amounts
+
+
+class DevicePlugin:
+    def __init__(self, cluster, node_name: str, enumerator) -> None:
+        self._cluster = cluster
+        self.node_name = node_name
+        self._enumerator = enumerator
+        self._chips = enumerator.enumerate()
+        if not self._chips:
+            raise RuntimeError("no TPU chips found on this host")
+        self._registered_ids = {c.idx for c in self._chips}
+        self._last_reported_unhealthy: set[int] | None = None
+
+    # -- reporting ------------------------------------------------------------
+
+    @property
+    def chips(self):
+        return list(self._chips)
+
+    def resource_report(self) -> dict[str, Any]:
+        """Node patch advertising the shareable resources + topology label
+        (reference reports count x mem via ListAndWatch, designs.md:61-63)."""
+        total_hbm = sum(c.hbm_mib for c in self._chips)
+        resources = {
+            RESOURCE_HBM: str(total_hbm),
+            RESOURCE_COUNT: str(len(self._chips)),
+        }
+        return {
+            "metadata": {"labels": {
+                LABEL_TPUSHARE_NODE: "true",
+                LABEL_MESH: self._enumerator.mesh.label(),
+            }},
+            "status": {"capacity": resources, "allocatable": resources},
+        }
+
+    def register_node(self) -> None:
+        report = self.resource_report()
+        self._cluster.patch_node(self.node_name,
+                                 {"metadata": report["metadata"]})
+        self._cluster.patch_node(self.node_name,
+                                 {"status": report["status"]}, status=True)
+        log.info("device plugin: registered %s (%d chips, mesh %s)",
+                 self.node_name, len(self._chips),
+                 self._enumerator.mesh.label())
+
+    # -- allocation rendezvous ------------------------------------------------
+
+    def pending_pods(self) -> list[dict[str, Any]]:
+        """This node's placed-but-unassigned tpushare pods, deterministic
+        order (assume-time, then UID — fixes the reference's tie ambiguity,
+        designs.md:97-99)."""
+        out = []
+        for pod in self._cluster.list_pods():
+            if podlib.pod_node_name(pod) != self.node_name:
+                continue
+            if not contract.is_tpushare_pod(pod) or contract.is_complete_pod(pod):
+                continue
+            if contract.chip_ids_from_annotations(pod) is None:
+                continue
+            if contract.is_assigned(pod):
+                continue
+            out.append(pod)
+        out.sort(key=lambda p: (contract.assume_time_from_annotations(p),
+                                podlib.pod_uid(p)))
+        return out
+
+    def allocate(self, hbm_mib: int | None = None,
+                 pod_uid: str | None = None) -> dict[str, Any]:
+        """Match a container-start request to a placed pod and produce its
+        device environment. ``hbm_mib`` is what kubelet's Allocate carries
+        (the container's tpu-hbm limit); ``pod_uid`` short-circuits the
+        amount matching when the caller knows the pod (checkpoint/restart
+        paths and tests)."""
+        candidates = self.pending_pods()
+        chosen = None
+        for pod in candidates:
+            if pod_uid is not None:
+                if podlib.pod_uid(pod) == pod_uid:
+                    chosen = pod
+                    break
+            elif hbm_mib is None or hbm_mib in _match_amounts(pod):
+                chosen = pod
+                break
+        if chosen is None:
+            raise AllocateError(
+                f"no pending pod on {self.node_name} matches "
+                f"hbm={hbm_mib} uid={pod_uid} "
+                f"({len(candidates)} candidates)")
+
+        ns, name = podlib.pod_namespace(chosen), podlib.pod_name(chosen)
+        self._cluster.patch_pod(ns, name, contract.assigned_patch())
+
+        ids = contract.chip_ids_from_annotations(chosen) or ()
+        grant = contract.hbm_from_annotations(chosen)
+        chip_total = self._chips[0].hbm_mib if self._chips else 0
+        by_idx = {c.idx: c for c in self._chips}
+        env = {
+            ENV_VISIBLE_CHIPS: ",".join(str(i) for i in ids),
+            ENV_HBM_LIMIT: str(grant),
+            ENV_HBM_CHIP_TOTAL: str(chip_total),
+        }
+        if 0 < grant < chip_total:
+            # bound XLA's preallocation to the grant (the analogue of the
+            # reference's TF gpu-memory-fraction guidance, userguide.md:67-77)
+            env[ENV_MEM_FRACTION] = f"{grant / chip_total:.4f}"
+        devices = [by_idx[i].device_path for i in ids if i in by_idx]
+        log.info("allocate: pod %s/%s -> chips %s (%s MiB/chip)",
+                 ns, name, list(ids), grant)
+        return {
+            "pod": {"namespace": ns, "name": name,
+                    "uid": podlib.pod_uid(chosen)},
+            "chip_ids": list(ids),
+            "devices": devices,
+            "env": env,
+        }
+
+    # -- health ---------------------------------------------------------------
+
+    def check_health(self) -> set[int]:
+        """Re-enumerate; chips that disappeared are written to the
+        unhealthy-chip configmap so the extender stops placing onto them.
+        Returns the unhealthy set."""
+        present = {c.idx for c in self._enumerator.enumerate()}
+        missing = self._registered_ids - present
+        # write only on change: an unconditional PUT every tick would fan
+        # MODIFIED watch events to every extender replica for nothing
+        if missing != self._last_reported_unhealthy:
+            try:
+                self._cluster.put_configmap(
+                    UNHEALTHY_CM_NAMESPACE,
+                    UNHEALTHY_CM_PREFIX + self.node_name,
+                    {UNHEALTHY_CM_KEY: ",".join(
+                        str(i) for i in sorted(missing))})
+                self._last_reported_unhealthy = set(missing)
+            except ApiError as e:
+                log.warning("health: configmap update failed: %s", e)
+        if missing:
+            log.warning("health: chips %s missing on %s",
+                        sorted(missing), self.node_name)
+        return missing
+
+    def health_loop(self, stop, interval: float = 30.0) -> None:
+        while not stop.wait(interval):
+            try:
+                self.check_health()
+            except Exception as e:  # noqa: BLE001
+                log.warning("health loop error: %s", e)
+
+    # -- garbage collection ---------------------------------------------------
+
+    def gc_stale_assignments(self, max_pending_seconds: float = 300.0) -> int:
+        """Pods that were placed (assigned=false) but never started within
+        the window are counted and logged — kubelet never called Allocate
+        (image pull failure, pod deleted mid-flight). The extender's resync
+        frees their chips when they terminate; this is observability, not
+        correctness. Returns the stale count."""
+        now_ns = time.time_ns()
+        stale = 0
+        for pod in self.pending_pods():
+            t = contract.assume_time_from_annotations(pod)
+            if t and (now_ns - t) / 1e9 > max_pending_seconds:
+                stale += 1
+                log.warning("gc: pod %s placed %.0fs ago but never assigned",
+                            podlib.pod_key(pod), (now_ns - t) / 1e9)
+        return stale

@@ -1,0 +1,102 @@
+"""JSON-over-unix-socket transport for the device plugin.
+
+Stands in for the kubelet device-plugin gRPC endpoint (grpcio is not in
+this image; the wire definitions for the production shim are under
+``protos/``). Protocol: one JSON object per line, one response per request:
+
+    {"method": "allocate", "hbm_mib": 2048}         -> allocate response
+    {"method": "allocate", "pod_uid": "..."}        -> allocate response
+    {"method": "list"}                              -> chip inventory
+    {"method": "report"}                            -> node resource report
+    {"method": "health"}                            -> unhealthy chip ids
+
+Errors come back as {"error": "..."}.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import socketserver
+import threading
+from typing import Any
+
+from tpushare.deviceplugin.plugin import AllocateError, DevicePlugin
+
+log = logging.getLogger("tpushare.deviceplugin.transport")
+
+
+class SocketServer:
+    def __init__(self, plugin: DevicePlugin, path: str) -> None:
+        self.plugin = plugin
+        self.path = path
+        self._server: socketserver.ThreadingUnixStreamServer | None = None
+
+    def _dispatch(self, req: dict[str, Any]) -> dict[str, Any]:
+        method = req.get("method", "")
+        if method == "allocate":
+            return self.plugin.allocate(
+                hbm_mib=req.get("hbm_mib"), pod_uid=req.get("pod_uid"))
+        if method == "list":
+            return {"chips": [
+                {"idx": c.idx, "coords": list(c.coords),
+                 "hbm_mib": c.hbm_mib, "device_path": c.device_path}
+                for c in self.plugin.chips]}
+        if method == "report":
+            return self.plugin.resource_report()
+        if method == "health":
+            return {"unhealthy": sorted(self.plugin.check_health())}
+        raise AllocateError(f"unknown method {method!r}")
+
+    def start(self) -> None:
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        dispatch = self._dispatch
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        resp = dispatch(json.loads(line))
+                    except (AllocateError, json.JSONDecodeError) as e:
+                        resp = {"error": str(e)}
+                    except Exception as e:  # noqa: BLE001 — keep serving
+                        log.error("dispatch crashed: %s", e)
+                        resp = {"error": f"internal: {e}"}
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                    self.wfile.flush()
+
+        self._server = socketserver.ThreadingUnixStreamServer(
+            self.path, Handler)
+        t = threading.Thread(target=self._server.serve_forever,
+                             name="tpushare-dp-socket", daemon=True)
+        t.start()
+        log.info("device plugin listening on %s", self.path)
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+
+def call(path: str, request: dict[str, Any],
+         timeout: float = 10.0) -> dict[str, Any]:
+    """One-shot client (used by tests and the tpushare-inspect tooling)."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(timeout)
+        s.connect(path)
+        s.sendall(json.dumps(request).encode() + b"\n")
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        return json.loads(buf)

@@ -55,21 +55,6 @@ class Histogram:
             else:
                 self._counts[-1] += 1
 
-    def percentile(self, q: float) -> float:
-        """Approximate quantile from buckets (upper bound of the bucket the
-        q-th observation falls in). Used by bench.py for p50 latency."""
-        with self._lock:
-            total = sum(self._counts)
-            if total == 0:
-                return 0.0
-            target = q * total
-            run = 0
-            for i, b in enumerate(self.buckets):
-                run += self._counts[i]
-                if run >= target:
-                    return b
-            return float("inf")
-
     def expose(self) -> str:
         with self._lock:
             counts = list(self._counts)

@@ -9,11 +9,8 @@ the default scheduler retry after its timeout.
 
 from __future__ import annotations
 
-import cProfile
-import io
 import json
 import logging
-import pstats
 import sys
 import threading
 import time
@@ -167,12 +164,29 @@ def _thread_dump() -> str:
     return "\n".join(lines) + "\n"
 
 
-def _profile(seconds: float) -> str:
-    """CPU profile of the serving process for N seconds (pprof /profile)."""
-    prof = cProfile.Profile()
-    prof.enable()
-    time.sleep(seconds)
-    prof.disable()
-    buf = io.StringIO()
-    pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(40)
-    return buf.getvalue()
+def _profile(seconds: float, interval: float = 0.005) -> str:
+    """Sampling profile across ALL threads for N seconds (pprof /profile).
+
+    cProfile only instruments the calling thread (which would just be this
+    handler sleeping); instead we sample sys._current_frames() and
+    aggregate stack suffixes — a flat statistical view of where the
+    scheduler actually spends time under load.
+    """
+    counts: dict[str, int] = {}
+    samples = 0
+    deadline = time.monotonic() + seconds
+    me = threading.get_ident()
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = traceback.extract_stack(frame, limit=6)
+            key = " <- ".join(f"{f.name}:{f.lineno} ({f.filename.rsplit('/', 1)[-1]})"
+                              for f in reversed(stack))
+            counts[key] = counts.get(key, 0) + 1
+            samples += 1
+        time.sleep(interval)
+    lines = [f"# {samples} samples over {seconds}s at {interval * 1e3:.0f}ms"]
+    for key, n in sorted(counts.items(), key=lambda kv: -kv[1])[:40]:
+        lines.append(f"{n:6d}  {key}")
+    return "\n".join(lines) + "\n"

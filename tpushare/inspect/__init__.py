@@ -1,0 +1,13 @@
+"""tpushare-inspect: cluster allocation CLI.
+
+The analogue of ``kubectl inspect gpushare`` (reference sibling-repo
+cmd/inspect, SURVEY §2.10; output format modeled on
+/root/reference/docs/userguide.md:10-17). Reads the extender's /inspect
+endpoint and renders the per-node / per-chip / per-pod allocation table.
+Deployable as a kubectl plugin by dropping ``kubectl-inspect_tpushare``
+(deployer/bin/) on PATH.
+"""
+
+from tpushare.inspect.cli import main, render_table
+
+__all__ = ["main", "render_table"]

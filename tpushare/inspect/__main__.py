@@ -1,0 +1,5 @@
+import sys
+
+from tpushare.inspect.cli import main
+
+sys.exit(main())

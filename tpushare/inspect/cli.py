@@ -1,0 +1,99 @@
+"""Render the cluster TPU allocation tree as a terminal table."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any
+
+
+def fetch(endpoint: str, node: str | None = None) -> dict[str, Any]:
+    url = endpoint.rstrip("/") + "/tpushare-scheduler/inspect"
+    if node:
+        url += f"/{node}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _fmt_row(cols: list[str], widths: list[int]) -> str:
+    return "  ".join(c.ljust(w) for c, w in zip(cols, widths)).rstrip()
+
+
+def render_table(tree: dict[str, Any], details: bool = False) -> str:
+    """Cluster summary table (modeled on userguide.md:10-17's
+    NAME/IPADDRESS/GPU-Memory table, extended with mesh/chip columns)."""
+    lines: list[str] = []
+    rows = [["NAME", "MESH", "CHIPS", "HEALTHY", "HBM USED/TOTAL (MiB)",
+             "UTIL"]]
+    for node in tree.get("nodes", []):
+        healthy = node["chip_count"] - len(node.get("unhealthy_chips", []))
+        total = node["total_hbm_mib"]
+        used = node["used_hbm_mib"]
+        util = f"{100.0 * used / total:.0f}%" if total else "-"
+        rows.append([node["name"], node.get("mesh", "-"),
+                     str(node["chip_count"]),
+                     str(healthy), f"{used}/{total}", util])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines.extend(_fmt_row(r, widths) for r in rows)
+
+    if details:
+        for node in tree.get("nodes", []):
+            lines.append("")
+            lines.append(f"node {node['name']} (mesh {node.get('mesh', '-')}):")
+            crows = [["  CHIP", "COORDS", "USED/TOTAL", "HEALTHY", "PODS"]]
+            for chip in node.get("chips", []):
+                pods = ", ".join(
+                    f"{p.get('namespace', '?')}/{p.get('name', p['uid'][:8])}"
+                    f"={p['hbm_mib']}"
+                    for p in chip.get("pods", [])) or "-"
+                crows.append([
+                    f"  {chip['idx']}",
+                    "x".join(str(c) for c in chip.get("coords", [])),
+                    f"{chip['used_hbm_mib']}/{chip['total_hbm_mib']}",
+                    "yes" if chip.get("healthy", True) else "NO",
+                    pods,
+                ])
+            cw = [max(len(r[i]) for r in crows) for i in range(len(crows[0]))]
+            lines.extend(_fmt_row(r, cw) for r in crows)
+
+    used, total = tree.get("used_hbm_mib", 0), tree.get("total_hbm_mib", 0)
+    pct = f"{100.0 * used / total:.0f}%" if total else "-"
+    lines.append("")
+    # closing summary line matches the reference CLI's
+    # "Allocated/Total GPU Memory In Cluster" footer (userguide.md:17)
+    lines.append(
+        f"Allocated/Total TPU HBM in Cluster: {used}/{total} MiB ({pct})")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpushare-inspect",
+        description="Show per-node/per-chip TPU HBM allocation")
+    ap.add_argument("-d", "--details", action="store_true",
+                    help="per-chip and per-pod breakdown")
+    ap.add_argument("--endpoint", default="http://127.0.0.1:39999",
+                    help="extender base URL")
+    ap.add_argument("node", nargs="?", default=None,
+                    help="restrict to one node")
+    args = ap.parse_args(argv)
+    try:
+        if args.node:
+            tree = {"nodes": [fetch(args.endpoint, args.node)]}
+            node = tree["nodes"][0]
+            tree["used_hbm_mib"] = node.get("used_hbm_mib", 0)
+            tree["total_hbm_mib"] = node.get("total_hbm_mib", 0)
+        else:
+            tree = fetch(args.endpoint)
+    except Exception as e:  # noqa: BLE001 — CLI surface
+        print(f"error: cannot reach extender at {args.endpoint}: {e}",
+              file=sys.stderr)
+        return 1
+    print(render_table(tree, details=args.details or bool(args.node)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,12 @@ class ClusterClient(Protocol):
     def bind_pod(self, namespace: str, name: str, node: str,
                  uid: str | None = None) -> None: ...
     def create_event(self, namespace: str, event: dict[str, Any]) -> None: ...
+    # device-plugin writes (reference device-plugin RBAC includes
+    # nodes/status patch and configmap writes, device-plugin-rbac.yaml)
+    def patch_node(self, name: str, patch: dict[str, Any],
+                   status: bool = False) -> dict[str, Any]: ...
+    def put_configmap(self, namespace: str, name: str,
+                      data: dict[str, str]) -> None: ...
 
     # watches (blocking iterators; controller runs them on threads)
     def watch_pods(self, stop) -> Iterator[WatchEvent]: ...

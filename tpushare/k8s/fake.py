@@ -200,6 +200,22 @@ class FakeCluster:
             self._bump(pod)
             self._notify("pods", "MODIFIED", pod)
 
+    def patch_node(self, name: str, patch: dict[str, Any],
+                   status: bool = False) -> dict[str, Any]:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise ApiError(404, f"node {name}")
+            merged = strategic_merge(node, json.loads(json.dumps(patch)))
+            self._bump(merged)
+            self._nodes[name] = merged
+            self._notify("nodes", "MODIFIED", merged)
+            return copy.deepcopy(merged)
+
+    def put_configmap(self, namespace: str, name: str,
+                      data: dict[str, str]) -> None:
+        self.set_configmap(namespace, name, data)
+
     def create_event(self, namespace: str, event: dict[str, Any]) -> None:
         with self._lock:
             self._events.append({"namespace": namespace, **event})

@@ -134,6 +134,28 @@ class InClusterClient:
             "POST", f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
             binding)
 
+    def patch_node(self, name: str, patch: dict[str, Any],
+                   status: bool = False) -> dict[str, Any]:
+        path = f"/api/v1/nodes/{name}" + ("/status" if status else "")
+        return self._json(
+            "PATCH", path, patch,
+            content_type="application/strategic-merge-patch+json")
+
+    def put_configmap(self, namespace: str, name: str,
+                      data: dict[str, str]) -> None:
+        body = {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": namespace},
+                "data": dict(data)}
+        try:
+            self._json(
+                "PUT", f"/api/v1/namespaces/{namespace}/configmaps/{name}",
+                body)
+        except ApiError as e:
+            if not e.is_not_found:
+                raise
+            self._json("POST", f"/api/v1/namespaces/{namespace}/configmaps",
+                       body)
+
     def create_event(self, namespace: str, event: dict[str, Any]) -> None:
         body = {"apiVersion": "v1", "kind": "Event", **event}
         try:
@@ -176,6 +198,12 @@ class InClusterClient:
                         rv = ""  # 410 Gone et al: restart from fresh list
                         break
                     yield WatchEvent(etype, obj)
+            except OSError:
+                # mid-stream timeout/reset (incl. the 300 s idle timeout on
+                # quiet clusters): reconnect from the last seen rv; the
+                # controller resync reconciles anything missed in the gap
+                if stop.wait(1.0):
+                    return
             finally:
                 resp.close()
 

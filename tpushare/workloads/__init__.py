@@ -1,0 +1,16 @@
+"""JAX workloads that run under tpushare HBM grants.
+
+The reference ships sample "gpu-player" workloads that echo their injected
+device env (samples/docker/run.sh) and TF fraction guidance for respecting
+the memory grant (userguide.md:67-77). The tpushare equivalents are real
+JAX programs:
+
+- :mod:`tpushare.workloads.hbm` — turns the device plugin's injected env
+  (``TPU_VISIBLE_CHIPS``, ``TPUSHARE_HBM_LIMIT_MIB``) into effective XLA
+  settings. Import and call ``apply_hbm_gating()`` BEFORE importing jax.
+- :mod:`tpushare.workloads.model` — a llama-style decoder (bf16 + optional
+  int8 weight quantization) with dp/tp mesh shardings, sized by presets.
+- :mod:`tpushare.workloads.player` — binpack-demo tenant (samples/1-4).
+- :mod:`tpushare.workloads.serve` — the BASELINE config #5 co-located
+  int8 serving replica.
+"""

@@ -1,0 +1,73 @@
+"""Make the device plugin's HBM grant effective inside a JAX process.
+
+The scheduler guarantees non-oversubscription at placement time; runtime
+enforcement is delegated to XLA's allocator (the same division of labor as
+the reference: scheduling-level guarantee, runtime isolation out of scope —
+designs.md "Non Goals", with the TF fraction knob as the practical fence,
+userguide.md:67-77).
+
+Call :func:`apply_hbm_gating` BEFORE the first ``import jax``:
+
+    from tpushare.workloads.hbm import apply_hbm_gating
+    apply_hbm_gating()
+    import jax
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from tpushare.contract.constants import (
+    ENV_HBM_CHIP_TOTAL,
+    ENV_HBM_LIMIT,
+    ENV_MEM_FRACTION,
+    ENV_VISIBLE_CHIPS,
+)
+
+log = logging.getLogger("tpushare.workloads.hbm")
+
+
+def apply_hbm_gating(environ: dict[str, str] | None = None) -> dict[str, str]:
+    """Derive XLA memory settings from the tpushare grant env.
+
+    - ``XLA_PYTHON_CLIENT_MEM_FRACTION`` <- grant/chip-total (if the device
+      plugin didn't already inject it),
+    - disables preallocation for fractional grants so co-tenants don't race
+      to grab the whole fraction at import time,
+    - maps ``TPU_VISIBLE_CHIPS`` to libtpu's visible-devices setting.
+
+    Returns the settings applied (for logging/tests). Mutates os.environ
+    (or the supplied dict) only where the operator hasn't set values.
+    """
+    env = os.environ if environ is None else environ
+    applied: dict[str, str] = {}
+
+    limit = _to_int(env.get(ENV_HBM_LIMIT))
+    total = _to_int(env.get(ENV_HBM_CHIP_TOTAL))
+    if limit and total and 0 < limit < total:
+        if ENV_MEM_FRACTION not in env:
+            applied[ENV_MEM_FRACTION] = f"{limit / total:.4f}"
+        # fractional tenants must not preallocate the whole fraction up
+        # front: leave headroom allocation to demand
+        applied.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+
+    chips = env.get(ENV_VISIBLE_CHIPS)
+    if chips and "TPU_PROCESS_BOUNDS" not in env:
+        # libtpu honors TPU_VISIBLE_CHIPS directly (the device plugin
+        # injects it); a fractional tenant is a single-process job, so pin
+        # the process bounds accordingly unless the operator set their own
+        applied["TPU_PROCESS_BOUNDS"] = "1,1,1"
+
+    for k, v in applied.items():
+        env.setdefault(k, v)
+    if applied:
+        log.info("hbm gating applied: %s", applied)
+    return applied
+
+
+def _to_int(raw: str | None) -> int:
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        return 0

@@ -1,0 +1,59 @@
+"""Binpack-demo tenant (samples/1-4): the reference's "gpu-player" analogue.
+
+The reference's player just echoes its injected env vars
+(samples/docker/run.sh:3-6). This one also *runs*: it applies the HBM
+gating, brings up JAX on its granted chips, and loops a small llama-mini
+forward pass so co-tenants demonstrably share a chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpushare-player")
+    ap.add_argument("--preset", default="llama-tiny")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="forward passes to run (0 = run forever)")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=128)
+    args = ap.parse_args(argv)
+
+    from tpushare.contract import constants as c
+    from tpushare.workloads.hbm import apply_hbm_gating
+    applied = apply_hbm_gating()
+
+    # echo the contract env like the reference player (run.sh:3-6)
+    for var in (c.ENV_VISIBLE_CHIPS, c.ENV_HBM_LIMIT, c.ENV_HBM_CHIP_TOTAL,
+                c.ENV_MEM_FRACTION):
+        print(f"{var}={os.environ.get(var, '<unset>')}", flush=True)
+    if applied:
+        print(f"gating applied: {applied}", flush=True)
+
+    import jax
+    import jax.numpy as jnp
+    from tpushare.workloads.model import PRESETS, forward, init_params
+
+    cfg = PRESETS[args.preset]
+    params = init_params(cfg, jax.random.key(0))
+    step = jax.jit(lambda p, t: forward(p, t, cfg))
+    tokens = jnp.zeros((args.batch, args.seq), jnp.int32)
+
+    n = 0
+    t0 = time.perf_counter()
+    while args.steps == 0 or n < args.steps:
+        step(params, tokens).block_until_ready()
+        n += 1
+        if n % 50 == 0 or n == args.steps:
+            dt = time.perf_counter() - t0
+            print(f"step {n}: {n / dt:.1f} fwd/s on "
+                  f"{jax.devices()[0].platform}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

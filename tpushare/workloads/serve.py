@@ -1,0 +1,105 @@
+"""Co-located int8 serving replica (samples/5-serving.yaml, BASELINE #5).
+
+Runs a llama-style model (int8 weights by default) over the granted chips
+with a dp x tp mesh, serving greedy completions over a tiny stdlib HTTP
+endpoint (POST /generate {"tokens": [[...]], "steps": N}).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpushare-serve")
+    ap.add_argument("--preset", default="llama-tiny")
+    ap.add_argument("--quant", choices=["none", "int8"], default="int8")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel size (0 = all local devices)")
+    args = ap.parse_args(argv)
+
+    from tpushare.workloads.hbm import apply_hbm_gating
+    apply_hbm_gating()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+    from tpushare.workloads.model import (
+        PRESETS, forward, greedy_decode, init_params, param_specs,
+        quant_specs, quantize_int8)
+
+    cfg = PRESETS[args.preset]
+    devices = jax.devices()
+    tp = args.tp or len(devices)
+    mesh = Mesh(
+        __import__("numpy").array(devices[:tp]).reshape(1, tp), ("dp", "tp"))
+
+    params = init_params(cfg, jax.random.key(0))
+    specs = param_specs(cfg)
+    if args.quant == "int8":
+        params = quantize_int8(params)
+        specs = quant_specs(specs)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    params = jax.device_put(params, shardings)
+
+    decode = jax.jit(
+        lambda p, t, n: greedy_decode(p, t, n, cfg),
+        static_argnums=2)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self.send_error(404)
+                return
+            try:
+                body = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))))
+                tokens = jnp.asarray(body["tokens"], jnp.int32)
+                steps = int(body.get("steps", 8))
+                out = decode(params, tokens, steps)
+                resp = json.dumps({"tokens": out.tolist()}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(resp)))
+                self.end_headers()
+                self.wfile.write(resp)
+            except Exception as e:  # noqa: BLE001 — serving surface
+                msg = json.dumps({"error": str(e)}).encode()
+                self.send_response(400)
+                self.send_header("Content-Length", str(len(msg)))
+                self.end_headers()
+                self.wfile.write(msg)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+            else:
+                self.send_error(404)
+
+    httpd = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
+    print(f"tpushare-serve ready on :{httpd.server_address[1]} "
+          f"(preset={args.preset}, quant={args.quant}, mesh dp=1 tp={tp})",
+          flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
