@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Echo the injected tpushare grant env (the reference player echoes
+# ALIYUN_COM_GPU_MEM_* the same way, samples/docker/run.sh:3-6), then run
+# the JAX player loop under it.
+echo "TPU_VISIBLE_CHIPS=${TPU_VISIBLE_CHIPS:-<unset>}"
+echo "TPUSHARE_HBM_LIMIT_MIB=${TPUSHARE_HBM_LIMIT_MIB:-<unset>}"
+echo "TPUSHARE_HBM_CHIP_TOTAL_MIB=${TPUSHARE_HBM_CHIP_TOTAL_MIB:-<unset>}"
+echo "XLA_PYTHON_CLIENT_MEM_FRACTION=${XLA_PYTHON_CLIENT_MEM_FRACTION:-<unset>}"
+exec python -m tpushare.workloads.player "$@"

@@ -1,0 +1,246 @@
+"""Leader-election tests: single winner, failover, renewal, bind gating.
+
+Short lease durations keep these fast; all timing waits are generous
+upper bounds, not exact schedules.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare.cache import SchedulerCache
+from tpushare.extender.server import ExtenderServer
+from tpushare.ha import LeaderElector
+from tpushare.k8s import ApiError, FakeCluster
+
+
+def elector(fc, ident, **kw):
+    kw.setdefault("lease_duration", 0.6)
+    kw.setdefault("renew_period", 0.1)
+    kw.setdefault("retry_period", 0.05)
+    return LeaderElector(fc, ident, **kw)
+
+
+def wait_until(fn, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_single_candidate_acquires():
+    fc = FakeCluster()
+    a = elector(fc, "a")
+    a.start()
+    try:
+        assert wait_until(a.is_leader)
+        lease = fc.get_lease("kube-system", "tpushare-schd-extender")
+        assert lease["spec"]["holderIdentity"] == "a"
+    finally:
+        a.stop()
+
+
+def test_exactly_one_of_two_leads():
+    fc = FakeCluster()
+    a, b = elector(fc, "a"), elector(fc, "b")
+    a.start()
+    b.start()
+    try:
+        assert wait_until(lambda: a.is_leader() or b.is_leader())
+        time.sleep(0.3)  # several renew cycles
+        assert a.is_leader() != b.is_leader()  # never both
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_failover_on_leader_stop():
+    fc = FakeCluster()
+    a, b = elector(fc, "a"), elector(fc, "b")
+    a.start()
+    assert wait_until(a.is_leader)
+    b.start()
+    try:
+        time.sleep(0.2)
+        assert not b.is_leader()
+        a.stop()  # abdicates (clears holder)
+        assert wait_until(b.is_leader, timeout=3.0)
+        lease = fc.get_lease("kube-system", "tpushare-schd-extender")
+        assert lease["spec"]["holderIdentity"] == "b"
+    finally:
+        b.stop()
+
+
+def test_takeover_after_expiry_without_abdication():
+    fc = FakeCluster()
+    a = elector(fc, "a")
+    a.start()
+    assert wait_until(a.is_leader)
+    # simulate a crash: thread killed without releasing the lease
+    a._stop.set()
+    a._thread.join(timeout=2)
+    b = elector(fc, "b")
+    b.start()
+    try:
+        # b must wait out the lease duration, then win
+        assert wait_until(b.is_leader, timeout=3.0)
+    finally:
+        b.stop()
+
+
+def test_renewal_keeps_leadership():
+    fc = FakeCluster()
+    a = elector(fc, "a")
+    a.start()
+    try:
+        assert wait_until(a.is_leader)
+        time.sleep(1.0)  # > lease_duration: only renewal explains survival
+        assert a.is_leader()
+    finally:
+        a.stop()
+
+
+def test_update_lease_conflict_semantics():
+    fc = FakeCluster()
+    fc.create_lease("kube-system", "l", {"holderIdentity": "x"})
+    lease = fc.get_lease("kube-system", "l")
+    rv = lease["metadata"]["resourceVersion"]
+    fc.update_lease("kube-system", "l", {"holderIdentity": "y"},
+                    resource_version=rv)
+    with pytest.raises(ApiError) as e:  # stale rv loses
+        fc.update_lease("kube-system", "l", {"holderIdentity": "z"},
+                        resource_version=rv)
+    assert e.value.is_conflict
+
+
+def test_partitioned_leader_steps_down_after_renew_deadline():
+    fc = FakeCluster()
+
+    class Flaky:
+        def __init__(self, inner):
+            self._inner = inner
+            self.partitioned = False
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def get_lease(self, ns, name):
+            if self.partitioned:
+                raise ApiError(0, "apiserver unreachable")
+            return self._inner.get_lease(ns, name)
+
+    flaky = Flaky(fc)
+    a = elector(flaky, "a")
+    a.start()
+    try:
+        assert wait_until(a.is_leader)
+        flaky.partitioned = True  # this replica alone loses the apiserver
+        # must step down once it can't renew within lease_duration —
+        # otherwise it would serve Bind alongside the next elected leader
+        assert wait_until(lambda: not a.is_leader(), timeout=5.0)
+    finally:
+        a.stop()
+
+
+def test_callback_exception_does_not_kill_election():
+    fc = FakeCluster()
+    boom = {"n": 0}
+
+    def exploding_callback():
+        boom["n"] += 1
+        raise RuntimeError("callback boom")
+
+    a = elector(fc, "a", on_started_leading=exploding_callback)
+    a.start()
+    try:
+        assert wait_until(a.is_leader)
+        time.sleep(0.5)  # several renew cycles after the exploding callback
+        assert a.is_leader()  # election loop survived
+        assert boom["n"] == 1
+    finally:
+        a.stop()
+
+
+def test_non_leader_503_keeps_keepalive_connection_clean():
+    # the 503 must drain the request body: on a reused HTTP/1.1 connection
+    # leftover bytes would parse as the next request line
+    import http.client
+
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=16000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+
+    class NeverLeader:
+        identity = "r2"
+
+        def is_leader(self):
+            return False
+
+    server = ExtenderServer(cache, fc, host="127.0.0.1", port=0,
+                            elector=NeverLeader())
+    port = server.start()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        body = json.dumps({"PodName": "p", "PodNamespace": "default",
+                           "PodUID": "u", "Node": "n1"})
+        for _ in range(3):  # same connection, repeatedly
+            conn.request("POST", "/tpushare-scheduler/bind", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 503
+            assert "not the leader" in json.loads(resp.read())["Error"]
+    finally:
+        conn.close()
+        server.stop()
+
+
+def test_non_leader_replica_rejects_bind_serves_filter():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=16000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+
+    class NeverLeader:
+        identity = "replica-2"
+
+        def is_leader(self):
+            return False
+
+    server = ExtenderServer(cache, fc, host="127.0.0.1", port=0,
+                            elector=NeverLeader())
+    port = server.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        # filter still served from the local cache
+        req = urllib.request.Request(
+            f"{base}/tpushare-scheduler/filter",
+            data=json.dumps({"Pod": make_pod(hbm=100),
+                             "NodeNames": ["n1"]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["NodeNames"] == ["n1"]
+        # bind rejected with a retryable 503
+        created = fc.create_pod(make_pod(hbm=100, name="p"))
+        req = urllib.request.Request(
+            f"{base}/tpushare-scheduler/bind",
+            data=json.dumps({"PodName": "p", "PodNamespace": "default",
+                             "PodUID": created["metadata"]["uid"],
+                             "Node": "n1"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 503
+        assert "not the leader" in json.loads(e.value.read())["Error"]
+        # /version reports the HA state
+        with urllib.request.urlopen(f"{base}/version", timeout=5) as r:
+            v = json.loads(r.read())
+        assert v["leader"] is False and v["identity"] == "replica-2"
+    finally:
+        server.stop()

@@ -57,6 +57,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="explicit apiserver base URL (e.g. kubectl proxy)")
     ap.add_argument("--workers", type=int,
                     default=int(os.environ.get("THREADNESS", "1")))
+    ap.add_argument("--ha", action="store_true",
+                    default=os.environ.get("ENABLE_HA", "") == "true",
+                    help="run Lease-based leader election; only the leader "
+                         "serves Bind (multi-replica deployments)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
@@ -79,10 +83,24 @@ def main(argv: list[str] | None = None) -> int:
     log.info("cache built: %d pods replayed", replayed)
     controller.start()
 
+    elector = None
+    if args.ha:
+        import socket as socketlib
+
+        from tpushare.ha import LeaderElector
+        identity = f"{socketlib.gethostname()}-{os.getpid()}"
+        # on takeover, resync so the new leader binds against fresh state
+        elector = LeaderElector(
+            cluster, identity,
+            on_started_leading=controller.resync_once)
+        elector.start()
+        log.info("ha: leader election enabled (identity %s)", identity)
+
     registry = Registry()
     server = ExtenderServer(cache, cluster, registry,
                             host=args.host, port=args.port,
-                            allow_debug_seed=bool(args.fake_nodes))
+                            allow_debug_seed=bool(args.fake_nodes),
+                            elector=elector)
     register_cache_gauges(registry, cache)
 
     stop = threading.Event()
@@ -100,6 +118,8 @@ def main(argv: list[str] | None = None) -> int:
     port = server.start()
     print(f"tpushare extender ready on {args.host}:{port}", flush=True)
     stop.wait()
+    if elector is not None:
+        elector.stop()
     controller.stop()
     return 0
 

@@ -30,7 +30,8 @@ PREFIX = "/tpushare-scheduler"
 class ExtenderServer:
     def __init__(self, cache, cluster, registry: Registry | None = None,
                  host: str = "0.0.0.0", port: int = 39999,
-                 allow_debug_seed: bool = False) -> None:
+                 allow_debug_seed: bool = False,
+                 elector=None) -> None:
         self.registry = registry or Registry()
         self.filter_handler = FilterHandler(cache, self.registry)
         self.bind_handler = BindHandler(cache, cluster, self.registry)
@@ -41,6 +42,10 @@ class ExtenderServer:
         # into the in-memory cluster so the full filter->bind cycle can be
         # driven with curl; never enabled against a real apiserver
         self._seed_cluster = cluster if allow_debug_seed else None
+        # HA: when an elector is wired, only the leader replica may Bind
+        # (Filter/Inspect stay readable on every replica — their caches are
+        # watch-warmed). None = single-replica mode, always leader.
+        self._elector = elector
 
     # -- request routing ------------------------------------------------------
 
@@ -69,17 +74,26 @@ class ExtenderServer:
 
             def do_POST(self):
                 try:
+                    # ALWAYS drain the body first: these are HTTP/1.1
+                    # keep-alive connections, and replying with unread
+                    # Content-Length bytes in the socket would make the
+                    # leftover body parse as the next request line
+                    args = self._read_json()
                     if self.path == f"{PREFIX}/filter":
-                        args = self._read_json()
                         self._reply(200, server_self.filter_handler.handle(args))
                     elif self.path == f"{PREFIX}/bind":
-                        args = self._read_json()
+                        if server_self._elector is not None and \
+                                not server_self._elector.is_leader():
+                            # retryable: the default scheduler re-binds
+                            # after its timeout and reaches the leader
+                            self._reply(503, {
+                                "Error": "not the leader; retry"})
+                            return
                         result = server_self.bind_handler.handle(args)
                         # reference returns 500 on bind failure (routes.go:139)
                         self._reply(500 if result.get("Error") else 200, result)
                     elif self.path == "/debug/pods" and server_self._seed_cluster:
-                        pod = server_self._seed_cluster.create_pod(
-                            self._read_json())
+                        pod = server_self._seed_cluster.create_pod(args)
                         self._reply(201, pod)
                     else:
                         self._reply(404, {"error": f"no route {self.path}"})
@@ -93,7 +107,11 @@ class ExtenderServer:
             def do_GET(self):
                 try:
                     if self.path == "/version":
-                        self._reply(200, {"version": tpushare.__version__})
+                        info = {"version": tpushare.__version__}
+                        if server_self._elector is not None:
+                            info["leader"] = server_self._elector.is_leader()
+                            info["identity"] = server_self._elector.identity
+                        self._reply(200, info)
                     elif self.path == "/healthz":
                         self._reply(200, "ok", content_type="text/plain")
                     elif self.path == "/metrics":

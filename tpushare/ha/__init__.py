@@ -1,0 +1,17 @@
+"""High availability: Lease-based leader election for the extender.
+
+The reference lists scheduler-extender HA as an unimplemented roadmap item
+(/root/reference/README.md:80) and deploys a single replica with
+``ignorable: false`` — extender downtime blocks all gpu-mem scheduling
+(SURVEY §5.3d). tpushare closes that gap: multiple extender replicas run
+behind the Service; all of them serve Filter/Inspect from their own
+watch-warmed caches, while the Bind verb — the only writer — is gated on
+holding a ``coordination.k8s.io/v1`` Lease, the same mechanism
+kube-scheduler itself uses for leader election. A non-leader replica
+answers binds with a retryable error; the default scheduler retries and
+the Service (or the scheduler's own retry) reaches the leader.
+"""
+
+from tpushare.ha.leaderelection import LeaderElector
+
+__all__ = ["LeaderElector"]

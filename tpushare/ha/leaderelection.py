@@ -1,0 +1,223 @@
+"""Lease-based leader election (client-go leaderelection semantics).
+
+Acquire/renew loop over a single ``coordination.k8s.io/v1`` Lease object
+with optimistic concurrency: every transition is an update preconditioned
+on the lease's resourceVersion, so two candidates can't both win a term.
+
+A candidate acquires when the lease is absent, expired (renewTime +
+duration < now), or already its own; it renews every ``renew_period``
+while leading and abdicates (best-effort holder clear) on stop. Followers
+poll at ``retry_period``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+from typing import Callable
+
+from tpushare.k8s.client import ApiError
+
+log = logging.getLogger("tpushare.ha")
+
+LEASE_NAMESPACE = "kube-system"
+LEASE_NAME = "tpushare-schd-extender"
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _fmt(t: datetime.datetime) -> str:
+    return t.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+
+def _parse(raw: str | None) -> datetime.datetime | None:
+    if not raw:
+        return None
+    try:
+        return datetime.datetime.strptime(
+            raw.rstrip("Z"), "%Y-%m-%dT%H:%M:%S.%f").replace(
+            tzinfo=datetime.timezone.utc)
+    except ValueError:
+        return None
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        cluster,
+        identity: str,
+        lease_name: str = LEASE_NAME,
+        namespace: str = LEASE_NAMESPACE,
+        lease_duration: float = 15.0,
+        renew_period: float = 5.0,
+        retry_period: float = 2.0,
+        on_started_leading: Callable[[], None] | None = None,
+        on_stopped_leading: Callable[[], None] | None = None,
+    ) -> None:
+        self._cluster = cluster
+        self.identity = identity
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self._on_start = on_started_leading
+        self._on_stop = on_stopped_leading
+        self._leader = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_renew = 0.0  # monotonic time of last successful write
+
+    # -- public ---------------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        return self._leader.is_set()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"tpushare-ha-{self.identity}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # loop is stuck in a slow apiserver call; its in-flight
+                # write is suppressed by the _stop checks, but skip the
+                # abdication rather than race it
+                log.warning("ha: %s election loop did not stop in time",
+                            self.identity)
+                self._set_leader(False)
+                return
+        if self._leader.is_set():
+            self._set_leader(False)
+            self._release()
+
+    # -- loop -----------------------------------------------------------------
+
+    # outcomes of one acquire/renew attempt
+    _RENEWED, _LOST, _ERROR = "renewed", "lost", "error"
+
+    def _run(self) -> None:
+        import time as _time
+        while not self._stop.is_set():
+            outcome = self._try_acquire_or_renew()
+            if outcome == self._RENEWED:
+                self._last_renew = _time.monotonic()
+                self._set_leader(True)
+                wait = self.renew_period
+            elif outcome == self._LOST:
+                # someone else holds a live lease: demote immediately
+                self._set_leader(False)
+                wait = self.retry_period
+            else:  # transient apiserver error
+                # renew-deadline rule (client-go semantics): a leader that
+                # cannot renew within lease_duration MUST step down — a
+                # partitioned replica that kept is_leader() true would
+                # serve Bind alongside the newly elected leader
+                if self.is_leader() and (
+                        _time.monotonic() - self._last_renew
+                        > self.lease_duration):
+                    log.warning("ha: %s renew deadline exceeded; stepping "
+                                "down", self.identity)
+                    self._set_leader(False)
+                wait = self.retry_period
+            if self._stop.wait(wait):
+                break
+
+    def _set_leader(self, leading: bool) -> None:
+        was = self._leader.is_set()
+        if leading and not was:
+            self._leader.set()
+            log.info("ha: %s became leader", self.identity)
+            self._fire(self._on_start, "on_started_leading")
+        elif not leading and was:
+            self._leader.clear()
+            log.warning("ha: %s lost leadership", self.identity)
+            self._fire(self._on_stop, "on_stopped_leading")
+
+    def _fire(self, cb: Callable[[], None] | None, what: str) -> None:
+        """Run a transition callback on its own thread, exception-guarded:
+        a slow or failing callback must neither stall lease renewal nor
+        kill the election loop (client-go runs OnStartedLeading in its own
+        goroutine for the same reason)."""
+        if cb is None:
+            return
+
+        def safe() -> None:
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001
+                log.error("ha: %s callback failed: %s", what, e)
+
+        threading.Thread(target=safe, name=f"tpushare-ha-cb-{what}",
+                         daemon=True).start()
+
+    # -- lease mechanics -------------------------------------------------------
+
+    def _spec(self, acquire_time: str | None = None) -> dict:
+        now = _fmt(_now())
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration) or 1,
+            "acquireTime": acquire_time or now,
+            "renewTime": now,
+        }
+
+    def _try_acquire_or_renew(self) -> str:
+        try:
+            lease = self._cluster.get_lease(self.namespace, self.lease_name)
+        except ApiError as e:
+            if not e.is_not_found:
+                return self._ERROR  # transient; _run applies renew deadline
+            if self._stop.is_set():
+                return self._LOST
+            try:
+                self._cluster.create_lease(
+                    self.namespace, self.lease_name, self._spec())
+                return self._RENEWED
+            except ApiError:
+                return self._LOST  # lost the creation race
+
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        renew = _parse(spec.get("renewTime"))
+        duration = float(spec.get("leaseDurationSeconds")
+                         or self.lease_duration)
+        expired = renew is None or \
+            (_now() - renew).total_seconds() > duration
+        if holder not in (None, "", self.identity) and not expired:
+            return self._LOST  # someone else holds a live lease
+
+        acquire = spec.get("acquireTime") if holder == self.identity else None
+        new_spec = self._spec(acquire_time=acquire)
+        if self._stop.is_set():
+            # stopping: don't renew — a write here could overwrite the
+            # abdication stop() is about to perform
+            return self._LOST
+        try:
+            self._cluster.update_lease(
+                self.namespace, self.lease_name, new_spec,
+                resource_version=(lease.get("metadata") or {})
+                .get("resourceVersion"))
+            return self._RENEWED
+        except ApiError:
+            return self._LOST  # optimistic-lock loser
+
+    def _release(self) -> None:
+        """Best-effort abdication so the next candidate wins immediately."""
+        try:
+            lease = self._cluster.get_lease(self.namespace, self.lease_name)
+            if (lease.get("spec") or {}).get("holderIdentity") != self.identity:
+                return
+            spec = dict(lease["spec"])
+            spec["holderIdentity"] = ""
+            self._cluster.update_lease(
+                self.namespace, self.lease_name, spec,
+                resource_version=lease["metadata"].get("resourceVersion"))
+        except ApiError:
+            pass

@@ -59,6 +59,13 @@ class ClusterClient(Protocol):
     def put_configmap(self, namespace: str, name: str,
                       data: dict[str, str]) -> None: ...
 
+    # leases (coordination.k8s.io/v1; HA leader election)
+    def get_lease(self, namespace: str, name: str) -> dict[str, Any]: ...
+    def create_lease(self, namespace: str, name: str,
+                     spec: dict[str, Any]) -> dict[str, Any]: ...
+    def update_lease(self, namespace: str, name: str, spec: dict[str, Any],
+                     resource_version: str | None = None) -> dict[str, Any]: ...
+
     # watches (blocking iterators; controller runs them on threads)
     def watch_pods(self, stop) -> Iterator[WatchEvent]: ...
     def watch_nodes(self, stop) -> Iterator[WatchEvent]: ...

@@ -42,6 +42,7 @@ class FakeCluster:
         self._pods: dict[str, dict[str, Any]] = {}      # ns/name -> pod
         self._nodes: dict[str, dict[str, Any]] = {}
         self._configmaps: dict[str, dict[str, Any]] = {}  # ns/name -> cm
+        self._leases: dict[str, dict[str, Any]] = {}
         self._events: list[dict[str, Any]] = []
         self._watchers: dict[str, list[queue.Queue]] = {
             "pods": [], "nodes": [], "configmaps": []}
@@ -199,6 +200,43 @@ class FakeCluster:
             pod.setdefault("spec", {})["nodeName"] = node
             self._bump(pod)
             self._notify("pods", "MODIFIED", pod)
+
+    # -- leases (coordination.k8s.io/v1) --------------------------------------
+
+    def get_lease(self, namespace: str, name: str) -> dict[str, Any]:
+        with self._lock:
+            lease = self._leases.get(self._key(namespace, name))
+            if lease is None:
+                raise ApiError(404, f"lease {namespace}/{name}")
+            return copy.deepcopy(lease)
+
+    def create_lease(self, namespace: str, name: str,
+                     spec: dict[str, Any]) -> dict[str, Any]:
+        with self._lock:
+            key = self._key(namespace, name)
+            if key in self._leases:
+                raise ApiError(409, f"lease {key} exists")
+            lease = {
+                "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": dict(spec),
+            }
+            self._bump(lease)
+            self._leases[key] = lease
+            return copy.deepcopy(lease)
+
+    def update_lease(self, namespace: str, name: str, spec: dict[str, Any],
+                     resource_version: str | None = None) -> dict[str, Any]:
+        with self._lock:
+            lease = self._leases.get(self._key(namespace, name))
+            if lease is None:
+                raise ApiError(404, f"lease {namespace}/{name}")
+            if resource_version is not None and \
+                    lease["metadata"].get("resourceVersion") != resource_version:
+                raise ApiError(409, "lease resourceVersion conflict")
+            lease["spec"] = dict(spec)
+            self._bump(lease)
+            return copy.deepcopy(lease)
 
     def patch_node(self, name: str, patch: dict[str, Any],
                    status: bool = False) -> dict[str, Any]:

@@ -163,6 +163,32 @@ class InClusterClient:
         except ApiError:
             pass  # events are best-effort (reference: record.EventBroadcaster)
 
+    # -- leases (coordination.k8s.io/v1) --------------------------------------
+
+    def _lease_path(self, namespace: str, name: str = "") -> str:
+        base = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        return f"{base}/{name}" if name else base
+
+    def get_lease(self, namespace: str, name: str) -> dict[str, Any]:
+        return self._json("GET", self._lease_path(namespace, name))
+
+    def create_lease(self, namespace: str, name: str,
+                     spec: dict[str, Any]) -> dict[str, Any]:
+        body = {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": spec}
+        return self._json("POST", self._lease_path(namespace), body)
+
+    def update_lease(self, namespace: str, name: str, spec: dict[str, Any],
+                     resource_version: str | None = None) -> dict[str, Any]:
+        body = {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": spec}
+        if resource_version is not None:
+            body["metadata"]["resourceVersion"] = resource_version
+        # PUT with resourceVersion = optimistic concurrency on the apiserver
+        return self._json("PUT", self._lease_path(namespace, name), body)
+
     # -- watches -------------------------------------------------------------
 
     def _watch(self, path: str, stop: threading.Event) -> Iterator[WatchEvent]:
