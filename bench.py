@@ -171,6 +171,32 @@ def main() -> int:
         while d.schedule(make_pod(size)) is not None:
             pass
 
+    # fleet-scale Filter: one webhook call fanning over 1000 candidate
+    # nodes (the reference's O(nodes) hot loop, SURVEY §3.2) — measures the
+    # fused native fleet scan
+    fleet = FakeCluster()
+    fleet_names = [f"f{i}" for i in range(1000)]
+    for fn in fleet_names:
+        fleet.add_tpu_node(fn, chips=4, hbm_per_chip_mib=V5E_HBM, mesh="2x2")
+    fleet_cache = SchedulerCache(fleet)
+    fleet_cache.build_cache()
+    fleet_server = ExtenderServer(fleet_cache, fleet, host="127.0.0.1", port=0)
+    fleet_port = fleet_server.start()
+    fleet_pod = make_pod(8 * GIB, count=4, topology="2x2")
+    fleet_body = {"Pod": fleet_pod, "NodeNames": fleet_names}
+    fleet_ms = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fleet_port}/tpushare-scheduler/filter",
+            data=json.dumps(fleet_body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            ok_count = len(json.loads(r.read())["NodeNames"])
+        fleet_ms.append((time.perf_counter() - t0) * 1e3)
+    fleet_server.stop()
+    expect(ok_count == 1000, f"fleet filter saw all nodes ({ok_count})")
+
     tree = d.inspect()
     util = tree["used_hbm_mib"] / tree["total_hbm_mib"] * 100.0
     lat = sorted(d.latencies_ms)
@@ -193,6 +219,7 @@ def main() -> int:
         "vs_baseline": round(util / 90.0, 4),
         "p50_bind_ms": round(p50, 3),
         "p99_bind_ms": round(p99, 3),
+        "filter_1k_nodes_ms": round(min(fleet_ms), 2),
         "pods": len(lat),
         "suite_failures": len(failed),
     }))

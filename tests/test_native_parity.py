@@ -62,6 +62,35 @@ def test_differential_vs_python():
             assert nat.score == py.score, (trial, req)
 
 
+def test_fits_fleet_parity():
+    """The one-call fleet Filter must agree with per-node fits()."""
+    from tpushare.core.placement import fits as fits_py
+
+    rng = random.Random(99)
+    for trial in range(60):
+        nodes = []
+        for _ in range(rng.randrange(1, 12)):
+            chips, topo, _ = random_case(rng)
+            nodes.append((chips, topo))
+        _, _, req = random_case(rng)
+        fleet = native_engine.fits_fleet(nodes, req)
+        per_node = [fits_py(chips, topo, req) for chips, topo in nodes]
+        assert fleet == per_node, (trial, req)
+
+
+def test_fits_fleet_handles_gappy_ids():
+    # a node with non-dense chip ids must fall back to the Python path
+    from tpushare.core.placement import fits as fits_py
+
+    topo = MeshTopology((2, 2))
+    gappy = [ChipView(i, topo.coords(min(i, 3)), 16000, 0)
+             for i in (0, 1, 2, 4)]
+    dense = [ChipView(i, topo.coords(i), 16000, 0) for i in range(4)]
+    req = PlacementRequest(hbm_mib=1000, chip_count=4)
+    fleet = native_engine.fits_fleet([(gappy, topo), (dense, topo)], req)
+    assert fleet == [fits_py(gappy, topo, req), True]
+
+
 def test_topology_pin_parity():
     topo = MeshTopology((4, 4))
     chips = [ChipView(i, topo.coords(i), 16000, 0) for i in range(16)]

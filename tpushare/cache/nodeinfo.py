@@ -71,6 +71,13 @@ def request_from_pod(pod: dict[str, Any]) -> PlacementRequest | None:
     )
 
 
+def no_fit_reason(req: PlacementRequest, node_name: str) -> str:
+    return (
+        f"no fit: need {req.chip_count} chip(s) x {req.hbm_mib} MiB"
+        f"{' contiguous' if req.chip_count > 1 and not req.allow_scatter else ''}"
+        f" on {node_name}")
+
+
 class NodeInfo:
     def __init__(self, node: dict[str, Any]) -> None:
         self._lock = threading.RLock()
@@ -117,10 +124,7 @@ class NodeInfo:
             return False, "node has no TPU chips"
         if fits(self.snapshot(), self.topology, req):
             return True, ""
-        return False, (
-            f"no fit: need {req.chip_count} chip(s) x {req.hbm_mib} MiB"
-            f"{' contiguous' if req.chip_count > 1 and not req.allow_scatter else ''}"
-            f" on {self.name}")
+        return False, no_fit_reason(req, self.name)
 
     def allocate(
         self,

@@ -62,6 +62,21 @@ def _load() -> ctypes.CDLL | None:
         try:
             lib = ctypes.CDLL(_SO)
             lib.tpushare_select_chips.restype = ctypes.c_int
+            lib.tpushare_fits_fleet.restype = ctypes.c_int
+            lib.tpushare_fits_fleet.argtypes = [
+                ctypes.c_int,                    # n_nodes
+                ctypes.POINTER(ctypes.c_int64),  # node chip offsets (n+1)
+                ctypes.POINTER(ctypes.c_int64),  # free per chip (concat)
+                ctypes.POINTER(ctypes.c_int64),  # total per chip (concat)
+                ctypes.POINTER(ctypes.c_int64),  # mesh rank offsets (n+1)
+                ctypes.POINTER(ctypes.c_int64),  # mesh dims (concat)
+                ctypes.c_int64,                  # req hbm
+                ctypes.c_int,                    # req count
+                ctypes.c_int,                    # topo rank
+                ctypes.POINTER(ctypes.c_int64),  # topo dims
+                ctypes.c_int,                    # allow_scatter
+                ctypes.POINTER(ctypes.c_uint8),  # out fits (n)
+            ]
             lib.tpushare_select_chips.argtypes = [
                 ctypes.c_int,                    # n_chips
                 ctypes.POINTER(ctypes.c_int64),  # free_hbm per chip (-1 = unhealthy)
@@ -79,7 +94,9 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_int64),  # out score
             ]
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so missing newer symbols —
+            # degrade to the Python path instead of crashing startup
             _lib = None
         return _lib
 
@@ -95,6 +112,69 @@ def warmup() -> bool:
     the first Filter never pays the g++ compile. Returns availability.
     """
     return available()
+
+
+def fits_fleet(nodes, req: "PlacementRequest") -> "list[bool]":
+    """Fleet-wide Filter in ONE native call.
+
+    ``nodes`` is a list of (chips, topo) snapshots. Nodes the native ABI
+    can't express (gappy chip ids, mesh/chip-count mismatch) fall back to
+    the Python ``fits`` individually; everything else is evaluated in a
+    single C scan — this is what keeps Filter flat as fleets grow
+    (per-node ctypes marshalling dominated the old loop).
+    """
+    from tpushare.core.placement import fits as fits_py
+
+    lib = _load()
+    results: list[bool | None] = [None] * len(nodes)
+    dense: list[tuple[int, list]] = []  # (node index, idx-sorted chips)
+    if lib is not None:
+        for i, (chips, topo) in enumerate(nodes):
+            by_idx = sorted(chips, key=lambda c: c.idx)
+            if len(chips) == topo.num_chips and all(
+                    c.idx == j for j, c in enumerate(by_idx)):
+                dense.append((i, by_idx))
+    if lib is None or not dense:
+        return [fits_py(chips, topo, req) for chips, topo in nodes]
+
+    chip_offsets = [0]
+    mesh_offsets = [0]
+    free: list[int] = []
+    total: list[int] = []
+    dims: list[int] = []
+    for i, by_idx in dense:
+        topo = nodes[i][1]
+        for c in by_idx:
+            ineligible = (not c.healthy
+                          or (req.hbm_mib == 0 and c.used_hbm_mib > 0))
+            free.append(-1 if ineligible else c.free_hbm_mib)
+            total.append(c.total_hbm_mib)
+        dims.extend(topo.shape)
+        chip_offsets.append(len(free))
+        mesh_offsets.append(len(dims))
+
+    n = len(dense)
+    t_rank = len(req.topology) if req.topology else 0
+    t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
+    out = (ctypes.c_uint8 * n)()
+    rc = lib.tpushare_fits_fleet(
+        n,
+        (ctypes.c_int64 * len(chip_offsets))(*chip_offsets),
+        (ctypes.c_int64 * max(len(free), 1))(*free),
+        (ctypes.c_int64 * max(len(total), 1))(*total),
+        (ctypes.c_int64 * len(mesh_offsets))(*mesh_offsets),
+        (ctypes.c_int64 * max(len(dims), 1))(*dims),
+        req.hbm_mib, req.chip_count, t_rank, t_dims,
+        1 if req.allow_scatter else 0, out)
+    if rc != 0:
+        return [fits_py(chips, topo, req) for chips, topo in nodes]
+    for pos, (i, _) in enumerate(dense):
+        results[i] = bool(out[pos])
+    for i, r in enumerate(results):
+        if r is None:
+            chips, topo = nodes[i]
+            results[i] = fits_py(chips, topo, req)
+    return results  # type: ignore[return-value]
 
 
 def select_chips(chips: "Sequence[ChipView]", topo: "MeshTopology",

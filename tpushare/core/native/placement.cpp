@@ -63,6 +63,104 @@ void chip_coords(const int64_t* mesh, int rank, int64_t idx, int64_t* out) {
 
 }  // namespace
 
+namespace {
+
+// Existence-only fit check for one node (early exit; no scoring).
+// Mirrors tpushare.core.placement.fits semantics.
+bool fits_one(int n_chips, const int64_t* free_hbm, const int64_t* total_hbm,
+              int rank, const int64_t* mesh,
+              int64_t req_hbm, int req_count,
+              int topo_rank, const int64_t* topo_dims, int allow_scatter) {
+  auto demand = [&](int i) -> int64_t {
+    return req_hbm == 0 ? total_hbm[i] : req_hbm;
+  };
+  auto eligible = [&](int i) -> bool {
+    return free_hbm[i] >= 0 && free_hbm[i] >= demand(i);
+  };
+  if (req_count > n_chips) return false;
+
+  if (req_count == 1 || allow_scatter) {
+    int n = 0;
+    for (int i = 0; i < n_chips; ++i)
+      if (eligible(i) && ++n >= req_count) return true;
+    return false;
+  }
+
+  int64_t mesh_n = 1;
+  for (int i = 0; i < rank; ++i) mesh_n *= mesh[i];
+  if (mesh_n != n_chips) return false;  // caller uses Python repair path
+
+  std::vector<Shape> shapes;
+  if (topo_rank > 0) {
+    if (topo_rank != rank) return false;  // rank-mismatched pin, no scatter
+    Shape s; s.d.assign(topo_dims, topo_dims + topo_rank);
+    int64_t prod = 1;
+    for (auto d : s.d) prod *= d;
+    if (prod != req_count) return false;
+    shapes.push_back(std::move(s));
+  } else {
+    std::vector<int64_t> prefix;
+    enum_shapes(mesh, rank, 0, req_count, prefix, shapes);
+  }
+
+  std::vector<int64_t> origin(rank), c(rank);
+  for (const auto& shape : shapes) {
+    bool fits_mesh = true;
+    for (int i = 0; i < rank; ++i)
+      if (shape.d[i] > mesh[i]) { fits_mesh = false; break; }
+    if (!fits_mesh) continue;
+    std::fill(origin.begin(), origin.end(), 0);
+    while (true) {
+      bool ok = true;
+      std::fill(c.begin(), c.end(), 0);
+      while (true) {
+        int64_t idx = 0;
+        for (int i = 0; i < rank; ++i) idx = idx * mesh[i] + origin[i] + c[i];
+        if (!eligible((int)idx)) { ok = false; break; }
+        int ax = rank - 1;
+        while (ax >= 0 && ++c[ax] == shape.d[ax]) c[ax--] = 0;
+        if (ax < 0) break;
+      }
+      if (ok) return true;  // existence is enough for Filter
+      int ax = rank - 1;
+      while (ax >= 0 && ++origin[ax] > mesh[ax] - shape.d[ax]) origin[ax--] = 0;
+      if (ax < 0) break;
+    }
+  }
+  return false;
+}
+
+}  // namespace
+
+// Fleet-wide Filter: one call evaluates every candidate node, avoiding
+// per-node FFI marshalling (the reference's hot loop #1 x #2,
+// SURVEY §3.2, fused into native code). Chip arrays are concatenated;
+// node_chip_offsets/mesh_rank_offsets are prefix offsets (n_nodes+1).
+extern "C" int tpushare_fits_fleet(
+    int n_nodes,
+    const int64_t* node_chip_offsets,
+    const int64_t* free_hbm,
+    const int64_t* total_hbm,
+    const int64_t* mesh_rank_offsets,
+    const int64_t* mesh_dims,
+    int64_t req_hbm,
+    int req_count,
+    int topo_rank,
+    const int64_t* topo_dims,
+    int allow_scatter,
+    uint8_t* out_fits) {
+  if (n_nodes < 0) return -1;
+  for (int n = 0; n < n_nodes; ++n) {
+    int64_t c0 = node_chip_offsets[n], c1 = node_chip_offsets[n + 1];
+    int64_t m0 = mesh_rank_offsets[n], m1 = mesh_rank_offsets[n + 1];
+    out_fits[n] = fits_one(
+        (int)(c1 - c0), free_hbm + c0, total_hbm + c0,
+        (int)(m1 - m0), mesh_dims + m0,
+        req_hbm, req_count, topo_rank, topo_dims, allow_scatter) ? 1 : 0;
+  }
+  return 0;
+}
+
 extern "C" int tpushare_select_chips(
     int n_chips,
     const int64_t* free_hbm,   // -1 => ineligible (unhealthy / exclusive-busy)

@@ -138,8 +138,8 @@ class NativeEnumerator:
                 lib.tpushare_probe_reset.restype = None
                 lib.tpushare_probe_reset.argtypes = []
                 cls._lib = lib
-            except OSError:
-                cls._lib = None
+            except (OSError, AttributeError):
+                cls._lib = None  # stale .so without newer symbols
             return cls._lib
 
     def available(self) -> bool:

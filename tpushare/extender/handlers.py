@@ -20,7 +20,8 @@ from typing import Any
 
 from tpushare import contract
 from tpushare.cache import AllocationError, SchedulerCache
-from tpushare.cache.nodeinfo import request_from_pod
+from tpushare.cache.nodeinfo import no_fit_reason, request_from_pod
+from tpushare.core.native import engine as native_engine
 from tpushare.contract import pod as podlib
 from tpushare.core.placement import fragmentation, utilization_pct
 from tpushare.extender.metrics import LATENCY_BUCKETS, Registry
@@ -51,6 +52,8 @@ class FilterHandler:
                           for n in items]
         ok_nodes: list[str] = []
         failed: dict[str, str] = {}
+        req = request_from_pod(pod)
+        candidates: list[tuple[str, Any]] = []  # (name, NodeInfo)
         for name in node_names:
             if not name:
                 continue
@@ -59,14 +62,25 @@ class FilterHandler:
             except ApiError as e:
                 failed[name] = f"node unavailable: {e}"
                 continue
-            if info.chip_count <= 0:
+            if req is not None and info.chip_count <= 0:
                 failed[name] = "not a TPU-share node"
                 continue
-            fits, reason = info.assume(pod)
-            if fits:
-                ok_nodes.append(name)
-            else:
-                failed[name] = reason
+            candidates.append((name, info))
+        if req is None:
+            # not a tpushare pod: nothing to check (handler shouldn't even
+            # be consulted thanks to managedResources, but be permissive)
+            ok_nodes.extend(name for name, _ in candidates)
+        else:
+            # one native call evaluates the whole fleet (hot loops #1+#2
+            # of SURVEY §3.2 fused; flat wrt node count)
+            snapshots = [(info.snapshot(), info.topology)
+                         for _, info in candidates]
+            mask = native_engine.fits_fleet(snapshots, req)
+            for (name, _), ok in zip(candidates, mask):
+                if ok:
+                    ok_nodes.append(name)
+                else:
+                    failed[name] = no_fit_reason(req, name)
         self._filter_latency.observe(time.perf_counter() - t0)
         log.debug("filter %s: %d ok / %d failed",
                   podlib.pod_key(pod), len(ok_nodes), len(failed))

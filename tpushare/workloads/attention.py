@@ -1,0 +1,167 @@
+"""Fused causal attention: a Pallas TPU kernel with online softmax.
+
+The serving workload's attention is the HBM-bandwidth hot spot: the naive
+einsum path materializes a [B, H, S, S] score tensor in fp32 through HBM.
+This kernel streams ONE K/V block at a time through VMEM with the
+flash-attention recurrence (running max + rescaled accumulator held in VMEM
+scratch across grid steps), so residency is O(BLOCK x D) regardless of
+sequence length — nothing quadratic ever exists, on chip or off. MXU does
+the block matmuls, VPU the rescaling (see
+/opt/skills/guides/pallas_guide.md).
+
+Grid: (B, H, q_blocks, kv_blocks); TPU grids execute sequentially with the
+last axis fastest, so the (m, l, acc) scratch carries across the kv axis of
+one (b, h, i) triple and is re-initialized at kv step 0. Causal q-blocks
+skip kv blocks beyond their diagonal entirely (no compute, no DMA use) —
+the standard ~2x causal FLOP saving.
+
+Forward-only by design: training uses the einsum path (XLA's fused
+attention + autodiff), serving/decoding uses this kernel; make_train_step
+rejects flash configs explicitly. A custom VJP is the natural next step.
+
+Layout contract: q, k, v are [B, H, S, D] (heads already GQA-expanded),
+D <= 128. Sequences are padded to the 128-block internally; padded KEY
+positions are masked, padded QUERY rows are sliced off on return.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+BLOCK = 128  # q/k block edge (MXU-aligned; bf16 min tile is (16, 128))
+
+
+def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True) -> jax.Array:
+    """Plain einsum attention (the behavioral spec the kernel must match)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (d ** -0.5)
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale: float, seq: int, n_kv: int, causal: bool):
+    """One (b, h, q-block i, kv-block j) grid step.
+
+    q_ref: [1, 1, BLOCK, D]; k_ref/v_ref: [1, 1, BLOCK, D] (current kv
+    block only); o_ref: [1, 1, BLOCK, D]; m/l/acc: VMEM scratch carrying
+    the online-softmax state across the kv axis.
+    """
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: kv blocks past the diagonal contribute nothing
+    visible = (j <= i) if causal else (j >= 0)
+
+    @pl.when(visible)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [BQ, D]
+        bq = q.shape[0]
+        kb = k_ref[0, 0].astype(jnp.float32)             # [BK, D]
+        vb = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BQ, BK]
+        row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, BLOCK), 0)
+        col = j * BLOCK + jax.lax.broadcasted_iota(jnp.int32, (bq, BLOCK), 1)
+        mask = col < seq                                  # padded keys out
+        if causal:
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, -jnp.inf)
+
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # rows with no visible key yet keep m=-inf; exp(-inf - -inf) would
+        # be NaN, so clamp the shift for those rows
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # final kv step for this q block: normalize and emit
+    last = i if causal else (n_kv - 1)
+
+    @pl.when(j == last)
+    def _emit():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    interpret: bool | None = None) -> jax.Array:
+    """Fused attention over [B, H, S, D] tensors (kv heads pre-expanded).
+
+    Runs the Pallas TPU kernel natively on TPU backends and in interpret
+    mode elsewhere (tests/CPU meshes) — same code path, same numerics.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    if k.shape != (B, H, k.shape[2], D) or v.shape != k.shape:
+        raise ValueError(
+            f"q {q.shape} / k {k.shape} / v {v.shape} must share batch, "
+            "heads and head_dim")
+    if D > BLOCK:
+        raise ValueError(f"head_dim {D} > {BLOCK} unsupported")
+    if causal and k.shape[2] != S:
+        raise ValueError("causal attention requires matching q/k lengths")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    pad_q = (-S) % BLOCK
+    kv = k.shape[2]
+    pad_k = (-kv) % BLOCK
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    Sp, KVp = S + pad_q, kv + pad_k
+    n_kv = KVp // BLOCK
+
+    grid = (B, H, Sp // BLOCK, n_kv)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=D ** -0.5, seq=kv,
+                          n_kv=n_kv, causal=causal),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, BLOCK, D),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, BLOCK, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, BLOCK, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, BLOCK, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK, 1), jnp.float32),   # running max m
+            pltpu.VMEM((BLOCK, 1), jnp.float32),   # running denom l
+            pltpu.VMEM((BLOCK, D), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :, :S, :]

@@ -40,6 +40,10 @@ class ModelConfig:
     d_ff: int = 14336
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
+    # attention implementation: "einsum" (XLA-fused, differentiable — the
+    # training path) or "flash" (Pallas online-softmax kernel, forward-only
+    # — the serving path; see tpushare/workloads/attention.py)
+    attn: str = "einsum"
 
     @property
     def head_dim(self) -> int:
@@ -218,11 +222,19 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
         reps = nh // nkv
         k = jnp.repeat(k, reps, axis=2)
         v = jnp.repeat(v, reps, axis=2)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        scores = scores * (hd ** -0.5)
-        scores = jnp.where(causal[None, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, nh * hd)
+        if cfg.attn == "flash":
+            from tpushare.workloads.attention import flash_attention
+            attn = flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True,
+            ).transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            scores = scores * (hd ** -0.5)
+            scores = jnp.where(causal[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(
+                B, S, nh * hd)
         x = x + _matmul(attn, lp["wo"])
         h = _rmsnorm(x, lp["ffn_norm"])
         gated = jax.nn.silu(_matmul(h, lp["w1"])) * _matmul(h, lp["w3"])
@@ -247,6 +259,11 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
 def make_train_step(cfg: ModelConfig, learning_rate: float = 3e-4):
     """(params, opt_state, tokens) -> (params, opt_state, loss), pure."""
     import optax
+
+    if cfg.attn == "flash":
+        raise ValueError(
+            "flash attention is forward-only (no custom VJP yet); use "
+            'attn="einsum" for training configs')
 
     tx = optax.adamw(learning_rate)
 

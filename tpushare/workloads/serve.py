@@ -18,6 +18,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="tpushare-serve")
     ap.add_argument("--preset", default="llama-tiny")
     ap.add_argument("--quant", choices=["none", "int8"], default="int8")
+    ap.add_argument("--attn", choices=["einsum", "flash"], default="einsum",
+                    help="flash = Pallas fused-attention kernel (TPU)")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel size (0 = all local devices)")
@@ -34,7 +36,9 @@ def main(argv: list[str] | None = None) -> int:
         PRESETS, forward, greedy_decode, init_params, param_specs,
         quant_specs, quantize_int8)
 
-    cfg = PRESETS[args.preset]
+    import dataclasses
+
+    cfg = dataclasses.replace(PRESETS[args.preset], attn=args.attn)
     devices = jax.devices()
     tp = args.tp or len(devices)
     mesh = Mesh(
