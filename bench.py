@@ -199,6 +199,12 @@ def main() -> int:
 
     tree = d.inspect()
     util = tree["used_hbm_mib"] / tree["total_hbm_mib"] * 100.0
+    # fleet fragmentation: 1 - largest single-chip free block / total free
+    # (0 when saturated or when all free HBM is usable by a whole-chip pod)
+    free_blocks = [c["total_hbm_mib"] - c["used_hbm_mib"]
+                   for n in tree["nodes"] for c in n["chips"]]
+    total_free = sum(free_blocks)
+    frag = 0.0 if total_free == 0 else 1.0 - max(free_blocks) / total_free
     lat = sorted(d.latencies_ms)
     p50 = statistics.median(lat)
     p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
@@ -220,6 +226,7 @@ def main() -> int:
         "p50_bind_ms": round(p50, 3),
         "p99_bind_ms": round(p99, 3),
         "filter_1k_nodes_ms": round(min(fleet_ms), 2),
+        "fragmentation": round(frag, 4),
         "pods": len(lat),
         "suite_failures": len(failed),
     }))

@@ -1,0 +1,63 @@
+"""KV-cache decode path: equivalence with the cache-free reference decode."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tpushare.workloads.model import (
+    PRESETS, forward, forward_cached, greedy_decode, greedy_decode_kv,
+    init_kv_cache, init_params, quantize_int8)
+
+CFG = PRESETS["llama-tiny"]
+
+
+def test_prefill_logits_match_full_forward():
+    params = init_params(CFG, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 11), 0, CFG.vocab)
+    cache = init_kv_cache(CFG, 2, 11)
+    logits_c, cache = forward_cached(params, tokens, cache, 0, CFG)
+    logits = forward(params, tokens, CFG)
+    np.testing.assert_allclose(np.asarray(logits_c), np.asarray(logits),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_incremental_matches_full_forward():
+    # prefill 5 tokens, then feed 3 more one at a time; the last-token
+    # logits must match a full forward over the whole 8-token sequence
+    params = init_params(CFG, jax.random.key(2))
+    tokens = jax.random.randint(jax.random.key(3), (2, 8), 0, CFG.vocab)
+    cache = init_kv_cache(CFG, 2, 8)
+    _, cache = forward_cached(params, tokens[:, :5], cache, 0, CFG)
+    for i in range(5, 8):
+        step_logits, cache = forward_cached(
+            params, tokens[:, i:i + 1], cache, i, CFG)
+    full = forward(params, tokens, CFG)
+    np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                               np.asarray(full[:, -1]),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_greedy_decode_kv_matches_reference_decode():
+    params = init_params(CFG, jax.random.key(4))
+    prompt = jax.random.randint(jax.random.key(5), (2, 7), 0, CFG.vocab)
+    ref = greedy_decode(params, prompt, 9, CFG)
+    out = greedy_decode_kv(params, prompt, 9, CFG)
+    assert (np.asarray(ref) == np.asarray(out)).all()
+
+
+def test_greedy_decode_kv_int8():
+    params = quantize_int8(init_params(CFG, jax.random.key(6)))
+    prompt = jax.random.randint(jax.random.key(7), (1, 4), 0, CFG.vocab)
+    ref = greedy_decode(params, prompt, 6, CFG)
+    out = greedy_decode_kv(params, prompt, 6, CFG)
+    assert (np.asarray(ref) == np.asarray(out)).all()
+
+
+def test_greedy_decode_kv_jits():
+    params = init_params(CFG, jax.random.key(8))
+    prompt = jax.random.randint(jax.random.key(9), (1, 4), 0, CFG.vocab)
+    fn = jax.jit(lambda p, t: greedy_decode_kv(p, t, 5, CFG))
+    out = fn(params, prompt)
+    assert out.shape == (1, 9)
+    assert (np.asarray(out)[:, :4] == np.asarray(prompt)).all()

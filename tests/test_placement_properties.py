@@ -1,0 +1,151 @@
+"""Randomized property tests for the placement engine (SURVEY §7 stage 1:
+"Property tests: never oversubscribe, fragmentation metrics").
+
+A seeded multi-step simulation drives select_chips through thousands of
+allocate/release cycles over random mesh shapes and asserts the invariants
+the whole scheduler rests on. Runs through the public select_chips entry,
+so whichever engine is live (C++ when buildable, else Python) is the one
+being property-checked.
+"""
+
+import random
+
+import pytest
+
+from tpushare.core.chips import ChipView
+from tpushare.core.placement import (
+    PlacementRequest, fits, fragmentation, select_chips, utilization_pct)
+from tpushare.core.topology import MeshTopology
+
+MESHES = [(1,), (2,), (4,), (2, 2), (4, 2), (4, 4), (2, 2, 2)]
+
+
+def fresh_chips(topo: MeshTopology, total: int) -> list[ChipView]:
+    return [ChipView(i, topo.coords(i), total)
+            for i in range(topo.num_chips)]
+
+
+def random_request(rng: random.Random, total: int) -> PlacementRequest:
+    if rng.random() < 0.15:
+        return PlacementRequest(hbm_mib=0,
+                                chip_count=rng.choice([1, 2, 4]))  # exclusive
+    return PlacementRequest(
+        hbm_mib=rng.choice([256, 1024, 2048, total // 2, total]),
+        chip_count=rng.choice([1, 1, 1, 2, 4]),
+        allow_scatter=rng.random() < 0.3,
+    )
+
+
+def is_axis_aligned_box(topo, ids, box, origin):
+    return sorted(ids) == sorted(topo.box_chips(origin, box))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_allocation_invariants_under_churn(seed):
+    rng = random.Random(seed)
+    total = 16000
+    topo = MeshTopology(rng.choice(MESHES))
+    chips = fresh_chips(topo, total)
+    live: list[tuple[tuple[int, ...], int]] = []  # (chip_ids, per-chip demand)
+
+    for step in range(400):
+        if live and rng.random() < 0.4:
+            ids, demand = live.pop(rng.randrange(len(live)))
+            chips = [c.with_used(c.used_hbm_mib - demand)
+                     if c.idx in ids else c for c in chips]
+            continue
+
+        req = random_request(rng, total)
+        placement = select_chips(chips, topo, req)
+        claims_fit = fits(chips, topo, req)
+        if placement is None:
+            # fits() may only be MORE permissive for scatter-able requests
+            # (it counts eligible chips without contiguity); for contiguous
+            # multi-chip it must agree exactly with the selector
+            if req.chip_count > 1 and not req.allow_scatter:
+                assert not claims_fit
+            continue
+        assert claims_fit, f"selector placed but fits()==False: {req}"
+
+        # distinct chips, as many as requested
+        assert len(set(placement.chip_ids)) == req.chip_count
+        demand = req.chip_demand_mib(total)
+        for cid in placement.chip_ids:
+            c = chips[cid]
+            assert c.healthy
+            if req.exclusive:
+                assert c.used_hbm_mib == 0
+            # the load-bearing invariant: NEVER oversubscribe a chip
+            assert c.used_hbm_mib + demand <= total
+        # contiguity: a non-scatter multi-chip result is an axis-aligned box
+        if placement.contiguous and req.chip_count > 1:
+            assert is_axis_aligned_box(topo, placement.chip_ids,
+                                       placement.box, placement.origin)
+        elif req.chip_count > 1:
+            assert req.allow_scatter  # scatter only when the pod opted in
+
+        chips = [c.with_used(c.used_hbm_mib + demand)
+                 if c.idx in placement.chip_ids else c for c in chips]
+        live.append((placement.chip_ids, demand))
+
+    # metrics stay in range whatever state churn produced
+    assert 0.0 <= utilization_pct(chips) <= 100.0
+    assert 0.0 <= fragmentation(chips) <= 1.0
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_unhealthy_chips_never_selected(seed):
+    rng = random.Random(1000 + seed)
+    topo = MeshTopology(rng.choice(MESHES))
+    total = 8192
+    bad = {i for i in range(topo.num_chips) if rng.random() < 0.4}
+    chips = [ChipView(i, topo.coords(i), total, healthy=i not in bad)
+             for i in range(topo.num_chips)]
+    for _ in range(100):
+        req = random_request(rng, total)
+        p = select_chips(chips, topo, req)
+        if p is not None:
+            assert not (set(p.chip_ids) & bad)
+
+
+def test_binpack_preserves_large_holes():
+    # min-free-that-fits: small pods stack on the fullest chip that still
+    # fits, keeping whole chips free for whole-chip pods (reference
+    # allocateGPUID semantics, nodeinfo.go:283-286)
+    topo = MeshTopology((4,))
+    total = 16000
+    chips = fresh_chips(topo, total)
+    for _ in range(8):
+        p = select_chips(chips, topo, PlacementRequest(hbm_mib=1000))
+        chips = [c.with_used(c.used_hbm_mib + 1000)
+                 if c.idx in p.chip_ids else c for c in chips]
+    used = sorted(c.used_hbm_mib for c in chips)
+    # all 8 small pods should have stacked onto one chip, not spread 2-each
+    assert used == [0, 0, 0, 8000]
+    # so a whole-chip pod still fits
+    assert select_chips(chips, topo,
+                        PlacementRequest(hbm_mib=0, chip_count=1)) is not None
+
+
+def test_saturation_reaches_full_utilization():
+    # deterministic greedy fill must reach 100% (no stranded capacity from
+    # the selector's own decisions)
+    topo = MeshTopology((4, 4))
+    total = 16000
+    chips = fresh_chips(topo, total)
+    sizes = [8000, 4000, 2000, 1000, 500, 250, 125]
+    progress = True
+    while progress:
+        progress = False
+        for s in sizes:
+            while True:
+                p = select_chips(chips, topo, PlacementRequest(hbm_mib=s))
+                if p is None:
+                    break
+                chips = [c.with_used(c.used_hbm_mib + s)
+                         if c.idx in p.chip_ids else c for c in chips]
+                progress = True
+    free = sum(c.free_hbm_mib for c in chips)
+    # only the sub-125-MiB remainder per chip may be left
+    assert free <= 124 * len(chips)
+    assert utilization_pct(chips) > 99.0

@@ -1,0 +1,95 @@
+"""Ring-attention (sequence-parallel) parity tests on the 8-device CPU mesh.
+
+The reference has no sequence parallelism (SURVEY.md §5.7 ABSENT); this
+covers the TPU build's long-context workload path: K/V chunks rotating
+around the sp ring via ppermute, online-softmax combine per hop.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tpushare.workloads.attention import attention_reference
+from tpushare.workloads.ringattention import ring_attention
+
+
+def sp_mesh(n=8):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), ("sp",))
+
+
+def rand_qkv(key, B=2, H=4, S=256, D=64, dtype=jnp.bfloat16):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (B, H, S, D), dtype),
+            jax.random.normal(kk, (B, H, S, D), dtype),
+            jax.random.normal(kv, (B, H, S, D), dtype))
+
+
+def assert_close(a, b, atol=2e-2):
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32),
+                               atol=atol, rtol=2e-2)
+
+
+def test_ring_matches_reference_causal():
+    mesh = sp_mesh()
+    q, k, v = rand_qkv(jax.random.key(0))
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+    assert out.shape == q.shape and out.dtype == q.dtype
+    assert_close(out, attention_reference(q, k, v, causal=True))
+
+
+def test_ring_matches_reference_non_causal():
+    mesh = sp_mesh()
+    q, k, v = rand_qkv(jax.random.key(1), S=128)
+    out = ring_attention(q, k, v, mesh, causal=False)
+    assert_close(out, attention_reference(q, k, v, causal=False))
+
+
+def test_ring_fp32_tight_tolerance():
+    mesh = sp_mesh()
+    q, k, v = rand_qkv(jax.random.key(2), S=64, dtype=jnp.float32)
+    out = ring_attention(q, k, v, mesh)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(attention_reference(q, k, v)),
+        atol=1e-5, rtol=1e-5)
+
+
+def test_ring_output_stays_sequence_sharded():
+    # the result must come back sharded over sp — no hidden all-gather
+    mesh = sp_mesh()
+    q, k, v = rand_qkv(jax.random.key(3), S=128)
+    spec = NamedSharding(mesh, P(None, None, "sp", None))
+    q, k, v = (jax.device_put(x, spec) for x in (q, k, v))
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+    assert out.sharding.is_equivalent_to(spec, out.ndim)
+
+
+def test_ring_smaller_ring_sizes():
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.array(devs[:4]), ("sp",))
+    q, k, v = rand_qkv(jax.random.key(4), S=96)  # 24 per shard
+    out = ring_attention(q, k, v, mesh)
+    assert_close(out, attention_reference(q, k, v, causal=True))
+
+
+def test_ring_rejects_indivisible_seq():
+    mesh = sp_mesh()
+    q, k, v = rand_qkv(jax.random.key(5), S=100)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k, v, mesh)
+
+
+def test_ring_rejects_mismatched_kv():
+    mesh = sp_mesh()
+    q, k, v = rand_qkv(jax.random.key(6), S=128)
+    with pytest.raises(ValueError, match="must match"):
+        ring_attention(q, k[:, :, :64], v[:, :, :64], mesh)

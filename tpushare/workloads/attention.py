@@ -15,9 +15,10 @@ one (b, h, i) triple and is re-initialized at kv step 0. Causal q-blocks
 skip kv blocks beyond their diagonal entirely (no compute, no DMA use) —
 the standard ~2x causal FLOP saving.
 
-Forward-only by design: training uses the einsum path (XLA's fused
-attention + autodiff), serving/decoding uses this kernel; make_train_step
-rejects flash configs explicitly. A custom VJP is the natural next step.
+Differentiable: :func:`flash_attention` carries a custom VJP whose backward
+pass regenerates each probability block from the kernel's log-sum-exp
+residual and scans over K/V blocks — training configs may therefore use
+``attn="flash"`` and keep O(S x BLOCK) attention residency in both passes.
 
 Layout contract: q, k, v are [B, H, S, D] (heads already GQA-expanded),
 D <= 128. Sequences are padded to the 128-block internally; padded KEY
@@ -47,8 +48,9 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale: float, seq: int, n_kv: int, causal: bool):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *, scale: float, seq: int, n_kv: int,
+                  causal: bool):
     """One (b, h, q-block i, kv-block j) grid step.
 
     q_ref: [1, 1, BLOCK, D]; k_ref/v_ref: [1, 1, BLOCK, D] (current kv
@@ -104,11 +106,138 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(j == last)
     def _emit():
-        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        l = l_ref[...]
+        out = acc_ref[...] / jnp.maximum(l, 1e-30)
         o_ref[0, 0] = out.astype(o_ref.dtype)
+        # log-sum-exp of the scaled scores per query row (the residual the
+        # backward pass needs to regenerate p without storing it);
+        # rows with no visible key (query padding) emit -inf
+        lse = jnp.where(l > 0, m_ref[...] + jnp.log(jnp.maximum(l, 1e-30)),
+                        -jnp.inf)
+        # lse block is [1, 1, 8, BLOCK]: the sublane dim is padding that
+        # exists purely to satisfy Mosaic's (8, 128) min-tile rule for
+        # fp32 outputs — broadcast the row vector across it
+        lse_ref[0, 0] = jnp.broadcast_to(lse[:, 0], lse_ref.shape[2:])
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
+def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
+                causal: bool, interpret: bool):
+    """Run the kernel; returns (out [B,H,S,D], lse [B,H,S] fp32)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    pad_q = (-S) % BLOCK
+    kv = k.shape[2]
+    pad_k = (-kv) % BLOCK
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    Sp, KVp = S + pad_q, kv + pad_k
+    n_kv = KVp // BLOCK
+
+    grid = (B, H, Sp // BLOCK, n_kv)
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=D ** -0.5, seq=kv,
+                          n_kv=n_kv, causal=causal),
+        out_shape=(jax.ShapeDtypeStruct(qp.shape, q.dtype),
+                   jax.ShapeDtypeStruct((B, H, 8, Sp), jnp.float32)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, BLOCK, D),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, BLOCK, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, BLOCK, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, 1, BLOCK, D),
+                                lambda b, h, i, j: (b, h, i, 0)),
+                   pl.BlockSpec((1, 1, 8, BLOCK),
+                                lambda b, h, i, j: (b, h, 0, i))),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK, 1), jnp.float32),   # running max m
+            pltpu.VMEM((BLOCK, 1), jnp.float32),   # running denom l
+            pltpu.VMEM((BLOCK, D), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :, :S, :], lse[:, :, 0, :S]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, interpret):
+    out, _ = _flash_call(q, k, v, causal, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    out, lse = _flash_call(q, k, v, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, interpret, res, do):
+    """Blockwise flash backward: scan over K/V blocks, regenerating each
+    probability block from the saved LSE — residency stays O(S x BLOCK),
+    nothing [S, S] is ever materialized (the point of training with the
+    fused kernel). Runs as plain XLA ops: einsums land on the MXU and the
+    scan body fuses. Known slack vs a hand-written Pallas backward: the
+    causal case still multiplies the fully-masked rows above each block's
+    diagonal (~2x the minimal backward matmul FLOPs), because skipping
+    them would need a second blocking level over the query axis.
+    """
+    q, k, v, out, lse = res
+    B, H, S, D = q.shape
+    kv = k.shape[2]
+    scale = D ** -0.5
+
+    pad_q = (-S) % BLOCK
+    pad_k = (-kv) % BLOCK
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))).astype(jnp.float32)
+    dop = jnp.pad(do, ((0, 0), (0, 0), (0, pad_q), (0, 0))).astype(jnp.float32)
+    op = jnp.pad(out, ((0, 0), (0, 0), (0, pad_q), (0, 0))).astype(jnp.float32)
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))).astype(jnp.float32)
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))).astype(jnp.float32)
+    # padded / no-visible-key rows carry lse = -inf; exp(s - -inf) would be
+    # inf, so clamp — their do is zero, which zeroes every contribution
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q)), constant_values=0.0)
+    lsep = jnp.where(jnp.isfinite(lsep), lsep, 0.0)[..., None]   # [B,H,Sp,1]
+    n_kv = (kv + pad_k) // BLOCK
+
+    qs = qp * scale
+    delta = jnp.sum(dop * op, axis=-1, keepdims=True)            # [B,H,Sp,1]
+    row = jnp.arange(S + pad_q)
+
+    # [n_kv, B, H, BLOCK, D] so lax.scan walks kv blocks
+    kb_all = jnp.moveaxis(kp.reshape(B, H, n_kv, BLOCK, D), 2, 0)
+    vb_all = jnp.moveaxis(vp.reshape(B, H, n_kv, BLOCK, D), 2, 0)
+
+    def block(dq, xs):
+        j, kb, vb = xs
+        col = j * BLOCK + jnp.arange(BLOCK)
+        mask = (col < kv)[None, :]
+        if causal:
+            mask = jnp.logical_and(mask, col[None, :] <= row[:, None])
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, kb)
+        p = jnp.where(mask[None, None], jnp.exp(s - lsep), 0.0)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dop)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dop, vb)
+        ds = p * (dp - delta)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kb) * scale
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qp) * scale
+        return dq, (dk_j, dv_j)
+
+    dq, (dk_b, dv_b) = jax.lax.scan(
+        block, jnp.zeros_like(qp), (jnp.arange(n_kv), kb_all, vb_all))
+    dk = jnp.moveaxis(dk_b, 0, 2).reshape(B, H, kv + pad_k, D)
+    dv = jnp.moveaxis(dv_b, 0, 2).reshape(B, H, kv + pad_k, D)
+    return (dq[:, :, :S].astype(q.dtype), dk[:, :, :kv].astype(k.dtype),
+            dv[:, :, :kv].astype(v.dtype))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     interpret: bool | None = None) -> jax.Array:
@@ -116,10 +245,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     Runs the Pallas TPU kernel natively on TPU backends and in interpret
     mode elsewhere (tests/CPU meshes) — same code path, same numerics.
+    Differentiable: a custom VJP regenerates probabilities blockwise from
+    the kernel's log-sum-exp residual, so training never materializes the
+    [S, S] score matrix either.
     """
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
     B, H, S, D = q.shape
     if k.shape != (B, H, k.shape[2], D) or v.shape != k.shape:
         raise ValueError(
@@ -131,37 +260,4 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError("causal attention requires matching q/k lengths")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-
-    pad_q = (-S) % BLOCK
-    kv = k.shape[2]
-    pad_k = (-kv) % BLOCK
-    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
-    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-    Sp, KVp = S + pad_q, kv + pad_k
-    n_kv = KVp // BLOCK
-
-    grid = (B, H, Sp // BLOCK, n_kv)
-    out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=D ** -0.5, seq=kv,
-                          n_kv=n_kv, causal=causal),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, BLOCK, D),
-                         lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, BLOCK, D),
-                         lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, BLOCK, D),
-                         lambda b, h, i, j: (b, h, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, BLOCK, D),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((BLOCK, 1), jnp.float32),   # running max m
-            pltpu.VMEM((BLOCK, 1), jnp.float32),   # running denom l
-            pltpu.VMEM((BLOCK, D), jnp.float32),   # output accumulator
-        ],
-        interpret=interpret,
-    )(qp, kp, vp)
-    return out[:, :, :S, :]
+    return _flash(q, k, v, bool(causal), bool(interpret))

@@ -40,9 +40,11 @@ class ModelConfig:
     d_ff: int = 14336
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
-    # attention implementation: "einsum" (XLA-fused, differentiable — the
-    # training path) or "flash" (Pallas online-softmax kernel, forward-only
-    # — the serving path; see tpushare/workloads/attention.py)
+    # attention implementation: "einsum" (XLA-fused) or "flash" (Pallas
+    # online-softmax kernel, differentiable via its blockwise custom VJP;
+    # see tpushare/workloads/attention.py). Both train and serve; the
+    # KV-cached decode path always uses the einsum core (its single-token
+    # queries don't amortize a fused kernel).
     attn: str = "einsum"
 
     @property
@@ -213,11 +215,7 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
 
     def layer(x, lp):
         h = _rmsnorm(x, lp["attn_norm"])
-        q = _matmul(h, lp["wq"]).reshape(B, S, nh, hd)
-        k = _matmul(h, lp["wk"]).reshape(B, S, nkv, hd)
-        v = _matmul(h, lp["wv"]).reshape(B, S, nkv, hd)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q, k, v = _qkv(h, lp, positions, cfg)
         # GQA: repeat kv heads up to query heads
         reps = nh // nkv
         k = jnp.repeat(k, reps, axis=2)
@@ -236,9 +234,7 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
             attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(
                 B, S, nh * hd)
         x = x + _matmul(attn, lp["wo"])
-        h = _rmsnorm(x, lp["ffn_norm"])
-        gated = jax.nn.silu(_matmul(h, lp["w1"])) * _matmul(h, lp["w3"])
-        return x + _matmul(gated, lp["w2"]), None
+        return _ffn_block(x, lp), None
 
     x, _ = lax.scan(layer, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"])
@@ -260,11 +256,6 @@ def make_train_step(cfg: ModelConfig, learning_rate: float = 3e-4):
     """(params, opt_state, tokens) -> (params, opt_state, loss), pure."""
     import optax
 
-    if cfg.attn == "flash":
-        raise ValueError(
-            "flash attention is forward-only (no custom VJP yet); use "
-            'attn="einsum" for training configs')
-
     tx = optax.adamw(learning_rate)
 
     def train_step(params, opt_state, tokens):
@@ -277,14 +268,119 @@ def make_train_step(cfg: ModelConfig, learning_rate: float = 3e-4):
     return tx, train_step
 
 
-# -- greedy decode (serving path) --------------------------------------------
+# -- KV-cache forward (serving path) ------------------------------------------
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict:
+    """Zeroed per-layer K/V buffers: [L, B, max_len, n_kv, head_dim]."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _qkv(h: jax.Array, lp: dict, positions: jax.Array, cfg: ModelConfig):
+    """Projections + RoPE shared by the cached and uncached layer bodies."""
+    B, T = h.shape[:2]
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    q = _matmul(h, lp["wq"]).reshape(B, T, nh, hd)
+    k = _matmul(h, lp["wk"]).reshape(B, T, nkv, hd)
+    v = _matmul(h, lp["wv"]).reshape(B, T, nkv, hd)
+    return (_rope(q, positions, cfg.rope_theta),
+            _rope(k, positions, cfg.rope_theta), v)
+
+
+def _ffn_block(x: jax.Array, lp: dict) -> jax.Array:
+    """Post-attention half of a layer: residual + RMSNorm + SwiGLU."""
+    h = _rmsnorm(x, lp["ffn_norm"])
+    gated = jax.nn.silu(_matmul(h, lp["w1"])) * _matmul(h, lp["w3"])
+    return x + _matmul(gated, lp["w2"])
+
+
+def forward_cached(params: dict, tokens: jax.Array, cache: dict,
+                   pos_offset: jax.Array, cfg: ModelConfig):
+    """Incremental forward: attend the T new tokens against the KV cache.
+
+    tokens [B, T] occupy global positions pos_offset..pos_offset+T-1; their
+    K/V are written into the cache in place (functionally), and attention
+    runs over the full fixed-size buffer with a causal position mask — so
+    one compiled program serves both prefill (T = prompt len) and decode
+    (T = 1). Returns (logits [B, T, vocab], updated cache). Cost per decode
+    step is O(max_len) instead of greedy_decode's O(max_len^2) recompute.
+    """
+    B, T = tokens.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    reps = nh // nkv
+    M = cache["k"].shape[2]
+    x = jnp.take(params["embed"], tokens, axis=0)
+    q_pos = pos_offset + jnp.arange(T)                       # [T] global
+    positions = jnp.broadcast_to(q_pos, (B, T))
+    key_pos = jnp.arange(M)
+    mask = key_pos[None, :] <= q_pos[:, None]                # [T, M]
+
+    def layer(x, xs):
+        lp, ck, cv = xs
+        h = _rmsnorm(x, lp["attn_norm"])
+        q, k, v = _qkv(h, lp, positions, cfg)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, pos_offset, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, pos_offset, 0, 0))
+        # grouped-query attention against the buffer without expanding the
+        # cache to n_heads: group axis g = kv head, r = queries per group
+        qg = q.reshape(B, T, nkv, reps, hd)
+        scores = jnp.einsum("btgrd,bmgd->bgrtm", qg, ck).astype(jnp.float32)
+        scores = scores * (hd ** -0.5)
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bgrtm,bmgd->btgrd", probs, cv)
+        x = x + _matmul(attn.reshape(B, T, nh * hd), lp["wo"])
+        return _ffn_block(x, lp), (ck, cv)
+
+    x, (ck, cv) = lax.scan(layer, x, (params["layers"],
+                                      cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["final_norm"])
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}
+
+
+def greedy_decode_kv(params: dict, prompt: jax.Array, steps: int,
+                     cfg: ModelConfig) -> jax.Array:
+    """KV-cached greedy decoding: one prefill over the prompt, then one
+    single-token forward_cached per generated token. Token-for-token
+    equivalent to :func:`greedy_decode` at ~S x lower decode-step FLOPs.
+    """
+    B, S = prompt.shape
+    total = S + steps
+    buf = jnp.zeros((B, total), jnp.int32).at[:, :S].set(prompt)
+    if steps <= 0:
+        return buf
+    cache = init_kv_cache(cfg, B, total)
+    logits, cache = forward_cached(params, prompt, cache, 0, cfg)
+    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)   # [B]
+    buf = buf.at[:, S].set(tok)
+
+    # steps-1 single-token forwards: iteration i consumes the token at
+    # position S+i-1 and emits the one at S+i (no trailing wasted step)
+    def body(i, carry):
+        buf, cache, tok = carry
+        logits, cache = forward_cached(params, tok[:, None], cache,
+                                       S + i - 1, cfg)
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        buf = lax.dynamic_update_slice(buf, tok[:, None], (0, S + i))
+        return buf, cache, tok
+
+    buf, _, _ = lax.fori_loop(jnp.int32(1), jnp.int32(steps), body,
+                              (buf, cache, tok))
+    return buf
+
+
+# -- greedy decode (cache-free reference) -------------------------------------
 
 def greedy_decode(params: dict, prompt: jax.Array, steps: int,
                   cfg: ModelConfig) -> jax.Array:
-    """Fixed-shape greedy decoding: the prompt buffer is extended by
-    ``steps`` positions and filled one token per iteration via
-    ``lax.fori_loop`` (static shapes; recomputes the prefix each step —
-    fine for the demo scale; a KV cache is the obvious next optimization).
+    """Fixed-shape greedy decoding WITHOUT a KV cache: the prompt buffer is
+    extended by ``steps`` positions and filled one token per iteration via
+    ``lax.fori_loop``, recomputing the prefix each step. Kept as the
+    behavioral spec for :func:`greedy_decode_kv` (and for tiny smoke runs
+    where the cache isn't worth its HBM).
     """
     B, S = prompt.shape
     total = S + steps

@@ -1,0 +1,110 @@
+"""Ring attention: sequence-parallel exact attention over an ICI ring.
+
+Long-context serving on a shared slice needs attention over sequences whose
+K/V don't fit one chip's HBM grant. Ring attention shards the sequence over
+an ``sp`` mesh axis — each chip holds a contiguous [B, H, S/n, D] chunk of
+q, k, v — and rotates the K/V chunks around the ring with
+``lax.ppermute`` while folding each visiting chunk into a flash-style
+online-softmax accumulator. Per-chip residency is O(S/n); the collective
+pattern is n-1 neighbor-to-neighbor hops that XLA maps onto ICI (no
+all-gather of the full sequence ever exists).
+
+The reference framework (mengwanguc/gpushare-scheduler-extender) has no
+model/attention code — SURVEY.md §5.7 marks sequence parallelism ABSENT —
+so this module is part of the TPU build's workload family (the programs the
+scheduler places), exercised by the driver's multi-chip dry run.
+
+Numerics contract: matches :func:`tpushare.workloads.attention.
+attention_reference` on the gathered sequence to bf16 tolerance. The
+online-softmax recurrence is the same one the Pallas kernel uses, so the
+two compose: intra-chip attention could itself run the fused kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _ring_body(carry, step, *, axis_name: str, n: int, my: jax.Array,
+               q32: jax.Array, q_pos: jax.Array, causal: bool):
+    """Fold the currently-held K/V chunk into the online-softmax state,
+    then pass the chunk to the next rank (skip the send on the last step)."""
+    m, l, acc, kb, vb = carry
+    sk = kb.shape[2]
+    src = (my - step) % n                     # rank this chunk started at
+    k_pos = src * sk + jnp.arange(sk)         # global key positions
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", q32, kb.astype(jnp.float32))
+    if causal:
+        mask = k_pos[None, :] <= q_pos[:, None]        # [Sq, Sk]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # rows with no visible key yet carry m = -inf; clamp the shift so
+    # exp(-inf - -inf) never produces NaN
+    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - shift)
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                   vb.astype(jnp.float32))
+
+    def rotate(kv):
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return (lax.ppermute(kv[0], axis_name, perm),
+                lax.ppermute(kv[1], axis_name, perm))
+
+    kb, vb = lax.cond(step < n - 1, rotate, lambda kv: kv, (kb, vb))
+    return (m_new, l, acc, kb, vb), None
+
+
+def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                          axis_name: str, causal: bool) -> jax.Array:
+    """Per-shard body (runs under shard_map): q, k, v are the local
+    [B, H, S/n, D] chunks, contiguous in ring order."""
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, sq, d = q.shape
+    q32 = q.astype(jnp.float32) * (d ** -0.5)
+    q_pos = my * sq + jnp.arange(sq)
+
+    m = jnp.full((B, H, sq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, sq, 1), jnp.float32)
+    acc = jnp.zeros((B, H, sq, d), jnp.float32)
+
+    body = functools.partial(_ring_body, axis_name=axis_name, n=n, my=my,
+                             q32=q32, q_pos=q_pos, causal=causal)
+    (m, l, acc, _, _), _ = lax.scan(body, (m, l, acc, k, v),
+                                    jnp.arange(n))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   mesh: jax.sharding.Mesh, axis: str = "sp",
+                   causal: bool = True) -> jax.Array:
+    """Exact attention over [B, H, S, D] with the sequence sharded on
+    ``axis``. S must divide evenly by the axis size. Jit-compatible; under
+    jit the shard_map composes with outer dp/tp shardings.
+    """
+    B, H, S, D = q.shape
+    n = mesh.shape[axis]
+    if S % n:
+        raise ValueError(f"seq len {S} not divisible by {axis} size {n}")
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"q {q.shape} / k {k.shape} / v {v.shape} must match "
+            "(GQA heads pre-expanded; causal ring needs equal q/kv lengths)")
+    spec = P(None, None, axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

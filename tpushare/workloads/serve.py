@@ -21,6 +21,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--attn", choices=["einsum", "flash"], default="einsum",
                     help="flash = Pallas fused-attention kernel (TPU)")
     ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--no-kv-cache", action="store_true",
+                    help="use the cache-free reference decode path")
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel size (0 = all local devices)")
     args = ap.parse_args(argv)
@@ -33,8 +35,8 @@ def main(argv: list[str] | None = None) -> int:
     from jax.sharding import Mesh, NamedSharding
     from jax.sharding import PartitionSpec as P
     from tpushare.workloads.model import (
-        PRESETS, forward, greedy_decode, init_params, param_specs,
-        quant_specs, quantize_int8)
+        PRESETS, forward, greedy_decode, greedy_decode_kv, init_params,
+        param_specs, quant_specs, quantize_int8)
 
     import dataclasses
 
@@ -54,8 +56,16 @@ def main(argv: list[str] | None = None) -> int:
         is_leaf=lambda x: isinstance(x, P))
     params = jax.device_put(params, shardings)
 
+    if args.attn == "flash" and not args.no_kv_cache:
+        # the KV-cached decode attends single-token queries against the
+        # cache with the einsum core; the fused kernel only applies to the
+        # cache-free path (or training/prefill-style full-sequence runs)
+        print("note: --attn flash has no effect on the KV-cached decode "
+              "path; pass --no-kv-cache to serve with the fused kernel",
+              flush=True)
+    decode_fn = greedy_decode if args.no_kv_cache else greedy_decode_kv
     decode = jax.jit(
-        lambda p, t, n: greedy_decode(p, t, n, cfg),
+        lambda p, t, n: decode_fn(p, t, n, cfg),
         static_argnums=2)
 
     class Handler(BaseHTTPRequestHandler):
