@@ -199,10 +199,12 @@ def main() -> int:
 
     tree = d.inspect()
     util = tree["used_hbm_mib"] / tree["total_hbm_mib"] * 100.0
-    # fleet fragmentation: 1 - largest single-chip free block / total free
-    # (0 when saturated or when all free HBM is usable by a whole-chip pod)
+    # fleet fragmentation over healthy chips, same definition as
+    # tpushare.core.placement.fragmentation (the /metrics export):
+    # 1 - largest single-chip free block / total free
     free_blocks = [c["total_hbm_mib"] - c["used_hbm_mib"]
-                   for n in tree["nodes"] for c in n["chips"]]
+                   for n in tree["nodes"] for c in n["chips"]
+                   if c.get("healthy", True)]
     total_free = sum(free_blocks)
     frag = 0.0 if total_free == 0 else 1.0 - max(free_blocks) / total_free
     lat = sorted(d.latencies_ms)

@@ -238,6 +238,7 @@ def _flash_bwd(causal, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     interpret: bool | None = None) -> jax.Array:
