@@ -46,10 +46,28 @@ class ModelConfig:
     # KV-cached decode path always uses the einsum core (its single-token
     # queries don't amortize a fused kernel).
     attn: str = "einsum"
+    # mixture-of-experts FFN (tpushare/workloads/moe.py): 0 = dense SwiGLU;
+    # >0 replaces every layer's FFN with moe_experts experts of width d_ff,
+    # expert weights sharded over the "ep" mesh axis.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def moe(self) -> "Any":
+        """MoEConfig for the FFN, or None when dense."""
+        if self.moe_experts <= 0:
+            return None
+        from tpushare.workloads.moe import MoEConfig
+        return MoEConfig(d_model=self.d_model, d_ff=self.d_ff,
+                         n_experts=self.moe_experts, top_k=self.moe_top_k,
+                         capacity_factor=self.moe_capacity_factor,
+                         dtype=self.dtype)
 
     def validate(self) -> "ModelConfig":
         assert self.d_model % self.n_heads == 0
@@ -66,6 +84,11 @@ PRESETS = {
     # tiny config for compile checks and CPU-mesh dry runs
     "llama-tiny": ModelConfig(vocab=256, d_model=64, n_layers=2,
                               n_heads=4, n_kv_heads=2, d_ff=128),
+    # tiny mixtral-style MoE variant: 4 experts, top-2 routing, for the
+    # expert-parallel ("ep") sharding dry run and tests
+    "llama-moe-tiny": ModelConfig(vocab=256, d_model=64, n_layers=2,
+                                  n_heads=4, n_kv_heads=2, d_ff=128,
+                                  moe_experts=4),
 }
 
 
@@ -82,19 +105,33 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
         return (jax.random.normal(key, shape, jnp.float32)
                 * (fan_in ** -0.5)).astype(cfg.dtype)
 
-    return {
-        "embed": w(next(k), v, d, fan_in=d),  # scaled like output layers
-        "layers": {
-            "attn_norm": jnp.ones((L, d), cfg.dtype),
-            "wq": w(next(k), L, d, nh * hd, fan_in=d),
-            "wk": w(next(k), L, d, nkv * hd, fan_in=d),
-            "wv": w(next(k), L, d, nkv * hd, fan_in=d),
-            "wo": w(next(k), L, nh * hd, d, fan_in=nh * hd),
-            "ffn_norm": jnp.ones((L, d), cfg.dtype),
+    # key draw order is part of the reproducibility contract: embed, then
+    # attention weights, then FFN weights, then lm_head — identical to the
+    # pre-MoE layout for dense configs (same seed => same dense params)
+    embed = w(next(k), v, d, fan_in=d)  # scaled like output layers
+    layers = {
+        "attn_norm": jnp.ones((L, d), cfg.dtype),
+        "wq": w(next(k), L, d, nh * hd, fan_in=d),
+        "wk": w(next(k), L, d, nkv * hd, fan_in=d),
+        "wv": w(next(k), L, d, nkv * hd, fan_in=d),
+        "wo": w(next(k), L, nh * hd, d, fan_in=nh * hd),
+        "ffn_norm": jnp.ones((L, d), cfg.dtype),
+    }
+    if cfg.moe_experts > 0:
+        # moe.py owns the expert layout; vmap stacks it to [L, ...]
+        from tpushare.workloads.moe import init_moe_params
+        moe_keys = jax.random.split(next(k), L)
+        layers.update(jax.vmap(
+            lambda kk: init_moe_params(cfg.moe, kk))(moe_keys))
+    else:
+        layers.update({
             "w1": w(next(k), L, d, f, fan_in=d),
             "w3": w(next(k), L, d, f, fan_in=d),
             "w2": w(next(k), L, f, d, fan_in=f),
-        },
+        })
+    return {
+        "embed": embed,
+        "layers": layers,
         "final_norm": jnp.ones((d,), cfg.dtype),
         "lm_head": w(next(k), d, v, fan_in=d),
     }
@@ -106,20 +143,31 @@ def param_specs(cfg: ModelConfig) -> dict:
     Heads/hidden shard on the output dim of the in-projections and the
     input dim of the out-projections, so XLA inserts exactly one
     ICI all-reduce per block (after wo, after w2) — the megatron layout.
+    MoE variants shard the expert axis over "ep" instead (the token
+    dispatch/combine einsums then lower to ICI all_to_all).
     """
-    return {
-        "embed": P(None, None),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "ffn_norm": P(None, None),
+    layers = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "ffn_norm": P(None, None),
+    }
+    if cfg.moe_experts > 0:
+        # derive from moe.py's single-layer specs: prepend the layer axis
+        from tpushare.workloads.moe import moe_param_specs
+        layers.update({name: P(None, *spec)
+                       for name, spec in moe_param_specs().items()})
+    else:
+        layers.update({
             "w1": P(None, None, "tp"),
             "w3": P(None, None, "tp"),
             "w2": P(None, "tp", None),
-        },
+        })
+    return {
+        "embed": P(None, None),
+        "layers": layers,
         "final_norm": P(None),
         "lm_head": P(None, "tp"),
     }
@@ -143,7 +191,10 @@ def quantize_int8(params: dict) -> dict:
     out = {"embed": params["embed"], "final_norm": params["final_norm"],
            "lm_head": _q(params["lm_head"]), "layers": {}}
     for name, w in params["layers"].items():
-        out["layers"][name] = _q(w) if name in QUANT_KEYS else w
+        # MoE expert weights ([L, E, d, f]) stay bf16: moe_ffn's batched
+        # expert einsums take plain arrays (router fp32 regardless)
+        quant = name in QUANT_KEYS and w.ndim == 3
+        out["layers"][name] = _q(w) if quant else w
     return out
 
 
@@ -173,7 +224,8 @@ def quant_specs(specs: dict) -> dict:
     out = {"embed": specs["embed"], "final_norm": specs["final_norm"],
            "lm_head": _qspec(specs["lm_head"]), "layers": {}}
     for name, spec in specs["layers"].items():
-        out["layers"][name] = _qspec(spec) if name in QUANT_KEYS else spec
+        quant = name in QUANT_KEYS and len(spec) == 3
+        out["layers"][name] = _qspec(spec) if quant else spec
     return out
 
 
@@ -202,7 +254,15 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab].
+    """tokens [B, S] int32 -> logits [B, S, vocab]."""
+    return forward_with_aux(params, tokens, cfg)[0]
+
+
+def forward_with_aux(params: dict, tokens: jax.Array, cfg: ModelConfig):
+    """tokens [B, S] int32 -> (logits [B, S, vocab], aux loss scalar).
+
+    ``aux`` is the mean per-layer MoE load-balance loss (0 for dense
+    models); training adds it with weight ``cfg.moe_aux_weight``.
 
     Layer stack runs under ``lax.scan``; the whole function is jit/pjit
     compatible (static shapes, no data-dependent Python control flow).
@@ -234,22 +294,23 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
             attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(
                 B, S, nh * hd)
         x = x + _matmul(attn, lp["wo"])
-        return _ffn_block(x, lp), None
+        return _ffn_block(x, lp, cfg)
 
-    x, _ = lax.scan(layer, x, params["layers"])
+    x, auxs = lax.scan(layer, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"])
-    return _matmul(x, params["lm_head"]).astype(jnp.float32)
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, jnp.mean(auxs)
 
 
 # -- loss / train step --------------------------------------------------------
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """Next-token cross-entropy over the shifted sequence."""
-    logits = forward(params, tokens[:, :-1], cfg)
+    """Next-token cross-entropy over the shifted sequence (+ MoE aux)."""
+    logits, aux = forward_with_aux(params, tokens[:, :-1], cfg)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.moe_aux_weight * aux
 
 
 def make_train_step(cfg: ModelConfig, learning_rate: float = 3e-4):
@@ -287,11 +348,19 @@ def _qkv(h: jax.Array, lp: dict, positions: jax.Array, cfg: ModelConfig):
             _rope(k, positions, cfg.rope_theta), v)
 
 
-def _ffn_block(x: jax.Array, lp: dict) -> jax.Array:
-    """Post-attention half of a layer: residual + RMSNorm + SwiGLU."""
+def _ffn_block(x: jax.Array, lp: dict, cfg: ModelConfig):
+    """Post-attention half of a layer: residual + RMSNorm + FFN.
+
+    Returns ``(x, aux)``: aux is the MoE load-balance loss for this layer
+    (0 for the dense SwiGLU path)."""
     h = _rmsnorm(x, lp["ffn_norm"])
+    if cfg.moe_experts > 0:
+        from tpushare.workloads.moe import moe_ffn
+        y, aux = moe_ffn({"wg": lp["wg"], "w1": lp["w1"],
+                          "w3": lp["w3"], "w2": lp["w2"]}, h, cfg.moe)
+        return x + y, aux
     gated = jax.nn.silu(_matmul(h, lp["w1"])) * _matmul(h, lp["w3"])
-    return x + _matmul(gated, lp["w2"])
+    return x + _matmul(gated, lp["w2"]), jnp.zeros((), jnp.float32)
 
 
 def forward_cached(params: dict, tokens: jax.Array, cache: dict,
@@ -332,7 +401,8 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn = jnp.einsum("bgrtm,bmgd->btgrd", probs, cv)
         x = x + _matmul(attn.reshape(B, T, nh * hd), lp["wo"])
-        return _ffn_block(x, lp), (ck, cv)
+        x, _aux = _ffn_block(x, lp, cfg)  # aux only matters in training
+        return x, (ck, cv)
 
     x, (ck, cv) = lax.scan(layer, x, (params["layers"],
                                       cache["k"], cache["v"]))
@@ -346,6 +416,14 @@ def greedy_decode_kv(params: dict, prompt: jax.Array, steps: int,
     """KV-cached greedy decoding: one prefill over the prompt, then one
     single-token forward_cached per generated token. Token-for-token
     equivalent to :func:`greedy_decode` at ~S x lower decode-step FLOPs.
+
+    MoE caveat: capacity routing couples tokens within a forward call (they
+    compete for expert slots), and the cache-free path re-routes the whole
+    zero-padded buffer each step. The two decoders are therefore only
+    guaranteed identical when capacity never binds —
+    ``cfg.moe_capacity_factor >= n_experts / top_k`` makes every expert big
+    enough for all tokens (the shipped MoE presets satisfy this). Tightly
+    capacity-bound serving should use this KV path only.
     """
     B, S = prompt.shape
     total = S + steps

@@ -1,0 +1,191 @@
+"""Mixture-of-experts FFN with expert parallelism — GShard/Switch style.
+
+The reference framework has no model code at all (SURVEY.md §2: parallelism
+components ABSENT — /root/reference contains only the Go scheduler); this
+module is part of tpushare's workload family, the JAX programs that the
+scheduler's samples/ suite places onto shared TPU chips. It exists so the
+framework's "ep" (expert-parallel) sharding axis is a real, exercised code
+path rather than a label.
+
+TPU-first design choices:
+
+- **Static-shape capacity routing** (top-k with per-expert capacity C):
+  every tensor shape is known at trace time, so the whole layer jits into
+  one XLA program — no ragged dispatch, no host round-trips. Tokens over
+  capacity are *dropped* (contribute zero; the transformer's residual path
+  carries them), the standard Switch/GShard behavior.
+- **Dispatch/combine as einsums**: routing becomes two big matmuls
+  ([T,E,C] one-hot against [T,d] activations), which is exactly what the
+  MXU wants, and which XLA turns into an ``all_to_all`` over the "ep" mesh
+  axis when the expert axis is sharded — ICI does the token shuffle.
+- **Per-expert SwiGLU** evaluated as batched einsums over the expert axis
+  ([E,C,d] x [E,d,f]); with ``w1/w3/w2`` sharded ``P("ep", ...)`` each
+  device computes only its local experts.
+- **fp32 router** (softmax + cumsum bookkeeping), bf16 expert compute.
+
+The pure-Python/dense reference (`moe_ffn_reference`) loops over experts and
+is the behavioral spec for the packed implementation; parity is covered by
+tests/test_moe.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int            # per-expert hidden width
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: object = jnp.bfloat16
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert token slots for a batch of ``n_tokens`` (static)."""
+        cap = math.ceil(self.top_k * n_tokens / self.n_experts
+                        * self.capacity_factor)
+        return max(cap, 1)
+
+
+def init_moe_params(cfg: MoEConfig, key: jax.Array) -> dict:
+    """Router + stacked expert weights (leading axis = expert)."""
+    kg, k1, k3, k2 = jax.random.split(key, 4)
+    E, d, f = cfg.n_experts, cfg.d_model, cfg.d_ff
+
+    def w(key, *shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    return {
+        # router stays fp32: tiny, and argmax stability matters more than HBM
+        "wg": jax.random.normal(kg, (d, E), jnp.float32) * (d ** -0.5),
+        "w1": w(k1, E, d, f, fan_in=d),
+        "w3": w(k3, E, d, f, fan_in=d),
+        "w2": w(k2, E, f, d, fan_in=f),
+    }
+
+
+def moe_param_specs() -> dict:
+    """PartitionSpec tree: experts shard over the "ep" mesh axis."""
+    return {
+        "wg": P(None, None),
+        "w1": P("ep", None, None),
+        "w3": P("ep", None, None),
+        "w2": P("ep", None, None),
+    }
+
+
+def _topk_gates(probs: jax.Array, top_k: int):
+    """Shared top-k selection: probs [T, E] -> (masks, gates), each a
+    length-``top_k`` list of [T, E] one-hots / [T] normalized gate values.
+    Single source of truth for the routing contract (tie-break = argmax
+    order, gates renormalized to sum to 1 over the kept experts)."""
+    E = probs.shape[-1]
+    masks, gates = [], []
+    p = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(p, axis=-1)                       # [T]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [T, E]
+        gates.append(jnp.sum(probs * onehot, axis=-1))
+        masks.append(onehot)
+        p = p * (1.0 - onehot)
+    denom = sum(gates)
+    gates = [g / jnp.maximum(denom, 1e-9) for g in gates]
+    return masks, gates
+
+
+def _route(logits: jax.Array, top_k: int, capacity: int):
+    """fp32 top-k capacity routing.
+
+    logits [T, E] -> (dispatch [T, E, C] 0/1, combine [T, E, C] gates,
+    aux load-balance loss). Priority: lower k first, then token order —
+    deterministic and independent of expert sharding.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    masks, gates = _topk_gates(probs, top_k)
+
+    # Switch-style aux loss on the k=0 assignment: E * sum_e f_e * P_e,
+    # minimized (=1) at a uniform expert load.
+    f_e = jnp.mean(masks[0], axis=0)        # fraction routed to e
+    p_e = jnp.mean(probs, axis=0)           # mean router prob for e
+    aux = E * jnp.sum(f_e * p_e)
+
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    prior = jnp.zeros((E,), jnp.float32)    # slots already taken per expert
+    for mask, gate in zip(masks, gates):
+        pos = jnp.cumsum(mask, axis=0) - mask + prior       # [T, E]
+        prior = prior + jnp.sum(mask, axis=0)
+        pos_tok = jnp.sum(pos * mask, axis=-1).astype(jnp.int32)  # [T]
+        keep = (pos_tok < capacity).astype(jnp.float32)
+        slot = jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)  # [T, C]
+        d_k = mask[:, :, None] * slot[:, None, :] * keep[:, None, None]
+        dispatch = dispatch + d_k
+        combine = combine + gate[:, None, None] * d_k
+    return dispatch, combine, aux
+
+
+def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig):
+    """x [..., d_model] -> (y [..., d_model], aux_loss scalar).
+
+    Dropped tokens produce y == 0 for that token (callers add the residual).
+    Under pjit with ``moe_param_specs`` and tokens sharded over "dp"/"ep",
+    the two dispatch einsums lower to ICI all_to_all collectives.
+    """
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)
+    T = xt.shape[0]
+    C = cfg.capacity(T)
+
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32), params["wg"])
+    dispatch, combine, aux = _route(logits, cfg.top_k, C)
+
+    # token shuffle in: [T,E,C] x [T,d] -> [E,C,d]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+    # per-expert SwiGLU, batched over the (sharded) expert axis
+    h = (jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))
+         * jnp.einsum("ecd,edf->ecf", expert_in, params["w3"]))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    # token shuffle out, gate-weighted
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return y.reshape(*lead, d), aux
+
+
+def moe_ffn_reference(params: dict, x: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Dense behavioral spec: every expert computed for every token, output =
+    gate-weighted sum over the token's top-k experts, no capacity drops.
+    Matches :func:`moe_ffn` exactly when ``capacity_factor`` is large enough
+    that nothing drops."""
+    lead = x.shape[:-1]
+    xt = x.reshape(-1, x.shape[-1])
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32), params["wg"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    masks, gates = _topk_gates(probs, cfg.top_k)
+
+    # all experts on all tokens: [E, T, d]
+    h = (jax.nn.silu(jnp.einsum("td,edf->etf", xt, params["w1"]))
+         * jnp.einsum("td,edf->etf", xt, params["w3"]))
+    all_out = jnp.einsum("etf,efd->etd", h, params["w2"])
+
+    y = jnp.zeros_like(xt)
+    for mask, gate in zip(masks, gates):
+        w = (mask * gate[:, None]).astype(x.dtype)          # [T, E]
+        y = y + jnp.einsum("te,etd->td", w, all_out)
+    return y.reshape(*lead, x.shape[-1])
+
+
+def expert_load(params: dict, x: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Tokens routed to each expert at k=0 (observability helper)."""
+    xt = x.reshape(-1, x.shape[-1])
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32), params["wg"])
+    idx = jnp.argmax(logits, axis=-1)
+    return jnp.sum(jax.nn.one_hot(idx, cfg.n_experts, dtype=jnp.int32), axis=0)

@@ -40,11 +40,24 @@ def main(argv: list[str] | None = None) -> int:
 
     import dataclasses
 
+    import numpy as np
+
     cfg = dataclasses.replace(PRESETS[args.preset], attn=args.attn)
     devices = jax.devices()
     tp = args.tp or len(devices)
-    mesh = Mesh(
-        __import__("numpy").array(devices[:tp]).reshape(1, tp), ("dp", "tp"))
+    if cfg.moe_experts > 0:
+        # MoE presets shard experts over "ep": give that axis the devices
+        # (largest divisor of tp that divides n_experts) and the rest to tp.
+        ep = 1
+        for cand in range(min(tp, cfg.moe_experts), 0, -1):
+            if tp % cand == 0 and cfg.moe_experts % cand == 0:
+                ep = cand
+                break
+        tp //= ep
+        mesh = Mesh(np.array(devices[:tp * ep]).reshape(1, tp, ep),
+                    ("dp", "tp", "ep"))
+    else:
+        mesh = Mesh(np.array(devices[:tp]).reshape(1, tp), ("dp", "tp"))
 
     params = init_params(cfg, jax.random.key(0))
     specs = param_specs(cfg)
@@ -106,7 +119,8 @@ def main(argv: list[str] | None = None) -> int:
 
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"tpushare-serve ready on :{httpd.server_address[1]} "
-          f"(preset={args.preset}, quant={args.quant}, mesh dp=1 tp={tp})",
+          f"(preset={args.preset}, quant={args.quant}, "
+          f"mesh {'x'.join(f'{n}={s}' for n, s in zip(mesh.axis_names, mesh.devices.shape))})",
           flush=True)
     try:
         httpd.serve_forever()
