@@ -253,6 +253,48 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
+def decoder_layer(x: jax.Array, lp: dict, positions: jax.Array,
+                  cfg: ModelConfig, mask: jax.Array | None = None):
+    """One transformer block: x [B, S, d] -> (x, aux).
+
+    Shared by :func:`forward`'s layer scan and the pipeline-parallel stage
+    bodies (tpushare/workloads/pipeline.py). ``positions`` [B, S] feeds
+    RoPE; ``mask`` [S, S] overrides the default causal attention mask
+    (einsum backend only — the flash kernel bakes causality in, so a
+    custom mask with ``cfg.attn == "flash"`` raises rather than being
+    silently ignored); ``aux`` is the MoE load-balance term (0 for
+    dense)."""
+    B, S = x.shape[:2]
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    h = _rmsnorm(x, lp["attn_norm"])
+    q, k, v = _qkv(h, lp, positions, cfg)
+    # GQA: repeat kv heads up to query heads
+    reps = nh // nkv
+    k = jnp.repeat(k, reps, axis=2)
+    v = jnp.repeat(v, reps, axis=2)
+    if cfg.attn == "flash":
+        if mask is not None:
+            raise ValueError(
+                "the flash backend supports only the default causal mask; "
+                "use attn='einsum' for custom masks")
+        from tpushare.workloads.attention import flash_attention
+        attn = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=True,
+        ).transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+    else:
+        if mask is None:
+            mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        scores = scores * (hd ** -0.5)
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(
+            B, S, nh * hd)
+    x = x + _matmul(attn, lp["wo"])
+    return _ffn_block(x, lp, cfg)
+
+
 def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, vocab]."""
     return forward_with_aux(params, tokens, cfg)[0]
@@ -268,33 +310,11 @@ def forward_with_aux(params: dict, tokens: jax.Array, cfg: ModelConfig):
     compatible (static shapes, no data-dependent Python control flow).
     """
     B, S = tokens.shape
-    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     x = jnp.take(params["embed"], tokens, axis=0)  # [B,S,d]
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
 
     def layer(x, lp):
-        h = _rmsnorm(x, lp["attn_norm"])
-        q, k, v = _qkv(h, lp, positions, cfg)
-        # GQA: repeat kv heads up to query heads
-        reps = nh // nkv
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
-        if cfg.attn == "flash":
-            from tpushare.workloads.attention import flash_attention
-            attn = flash_attention(
-                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3), causal=True,
-            ).transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
-        else:
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-            scores = scores * (hd ** -0.5)
-            scores = jnp.where(causal[None, None], scores, -jnp.inf)
-            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(
-                B, S, nh * hd)
-        x = x + _matmul(attn, lp["wo"])
-        return _ffn_block(x, lp, cfg)
+        return decoder_layer(x, lp, positions, cfg)
 
     x, auxs = lax.scan(layer, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"])
@@ -304,16 +324,32 @@ def forward_with_aux(params: dict, tokens: jax.Array, cfg: ModelConfig):
 
 # -- loss / train step --------------------------------------------------------
 
-def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """Next-token cross-entropy over the shifted sequence (+ MoE aux)."""
-    logits, aux = forward_with_aux(params, tokens[:, :-1], cfg)
-    targets = tokens[:, 1:]
+def next_token_loss(logits: jax.Array, aux: jax.Array, targets: jax.Array,
+                    cfg: ModelConfig) -> jax.Array:
+    """Cross-entropy of shifted logits against targets + weighted MoE aux.
+
+    The single definition of the training objective, shared by the
+    sequential trainer here and the pipeline-parallel trainer
+    (tpushare/workloads/pipeline.py) so the two cannot drift."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll) + cfg.moe_aux_weight * aux
 
 
-def make_train_step(cfg: ModelConfig, learning_rate: float = 3e-4):
+def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig,
+            forward_fn=None) -> jax.Array:
+    """Next-token cross-entropy over the shifted sequence (+ MoE aux).
+
+    ``forward_fn(params, tokens, cfg) -> (logits, aux)`` defaults to
+    :func:`forward_with_aux`; trainers with a different execution plan for
+    the same model (e.g. the GPipe pipeline) substitute theirs."""
+    logits, aux = (forward_fn or forward_with_aux)(params, tokens[:, :-1],
+                                                   cfg)
+    return next_token_loss(logits, aux, tokens[:, 1:], cfg)
+
+
+def make_train_step(cfg: ModelConfig, learning_rate: float = 3e-4,
+                    forward_fn=None):
     """(params, opt_state, tokens) -> (params, opt_state, loss), pure."""
     import optax
 
@@ -321,7 +357,8 @@ def make_train_step(cfg: ModelConfig, learning_rate: float = 3e-4):
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            functools.partial(loss_fn, cfg=cfg))(params, tokens)
+            functools.partial(loss_fn, cfg=cfg,
+                              forward_fn=forward_fn))(params, tokens)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

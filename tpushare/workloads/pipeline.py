@@ -1,0 +1,158 @@
+"""Pipeline parallelism — GPipe schedule over a "pp" mesh axis.
+
+The reference framework has no model-parallel code (SURVEY.md §2: DP/TP/PP
+ABSENT); this module gives tpushare's workload family a real "pp" sharding
+axis: the llama layer stack is split into ``pp`` contiguous stages (layer
+axis sharded over the mesh), microbatches stream through the stages, and
+stage-to-stage activation handoff is a ``ppermute`` hop between ICI
+neighbors.
+
+TPU-first design:
+
+- **shard_map + lax.scan schedule**: the whole pipeline — M microbatches
+  through P stages in M+P-1 ticks — is one compiled XLA program. Every
+  device runs the identical scan body (SPMD); "which stage am I" is
+  ``lax.axis_index``, and bubble ticks compute on don't-care data that the
+  output masking discards (predication instead of control flow, which is
+  what the compiler wants).
+- **ppermute activation handoff**: stage i sends its activation to stage
+  i+1 along the ring each tick; on a TPU slice the pp axis lays out on ICI
+  neighbors so each hop is one link. ``ppermute`` is differentiable (its
+  transpose is the reversed permutation), so ``jax.grad`` through the
+  pipeline yields the standard GPipe backward schedule for free.
+- **embed/unembed outside the pipelined stack**: token embedding and the
+  lm_head run replicated outside shard_map, keeping the stage body a pure
+  [mb, S, d] -> [mb, S, d] layer stack (and composable with tp sharding of
+  those matmuls).
+
+Scaling note: this implementation keeps microbatch inputs and the output
+buffer replicated across stages — right for validating schedules and
+for the driver's virtual-mesh dry run; a production variant would keep
+activations stage-local. Parity with the sequential model is exact
+(same layer body: model.decoder_layer) and covered by tests/test_pipeline.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpushare.workloads.model import (
+    ModelConfig, _matmul, _rmsnorm, decoder_layer)
+
+
+def stage_layer_specs(params: dict) -> dict:
+    """in_specs pytree for ``params["layers"]``: layer axis over "pp"."""
+    return jax.tree.map(lambda _: P("pp"), params["layers"])
+
+
+def pipelined_forward_with_aux(params: dict, tokens: jax.Array,
+                               cfg: ModelConfig, mesh: jax.sharding.Mesh,
+                               microbatches: int | None = None,
+                               axis: str = "pp"):
+    """tokens [B, S] -> (logits [B, S, vocab], aux) via a GPipe pipeline.
+
+    ``cfg.n_layers`` must divide evenly into ``mesh.shape[axis]`` stages and
+    the batch into ``microbatches`` (default: one per stage). The stages
+    run the same ``decoder_layer`` body in the same order as
+    :func:`tpushare.workloads.model.forward_with_aux`, so dense logits are
+    numerically identical. MoE caveat: routing operates per forward call,
+    so microbatching changes the token population an expert sees — logits
+    match only while routing is dropless (capacity never binds per
+    microbatch; the shipped presets guarantee this), and the aux
+    load-balance term is a mean of per-microbatch values, which is close
+    to but not equal to the full-batch aux.
+    """
+    n_stages = mesh.shape[axis]
+    L = cfg.n_layers
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+    B, S = tokens.shape
+    M = microbatches or n_stages
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+
+    x = jnp.take(params["embed"], tokens, axis=0)        # [B, S, d]
+    xmb = x.reshape(M, mb, S, x.shape[-1])
+    positions = jnp.broadcast_to(jnp.arange(S), (mb, S))
+
+    def stage_apply(local_layers, x):
+        """Run this stage's contiguous slice of the layer stack."""
+        def body(x, lp):
+            return decoder_layer(x, lp, positions, cfg)
+        x, auxs = lax.scan(body, x, local_layers)
+        return x, jnp.mean(auxs)
+
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(stage_layer_specs(params), P()),
+        out_specs=(P(), P()), check_vma=False)
+    def run(local_layers, xmb):
+        stage = lax.axis_index(axis)
+        last = n_stages - 1
+        state = jnp.zeros_like(xmb[0])
+        outbuf = jnp.zeros_like(xmb)
+        ticks = M + n_stages - 1
+
+        def tick(carry, t):
+            state, outbuf = carry
+            # stage i hands last tick's activation to stage i+1
+            recv = lax.ppermute(state, axis, fwd_perm)
+            x0 = lax.dynamic_index_in_dim(xmb, jnp.clip(t, 0, M - 1),
+                                          axis=0, keepdims=False)
+            inp = jnp.where(stage == 0, x0, recv)
+            y, aux = stage_apply(local_layers, inp)
+            # last stage finished microbatch t-(P-1) this tick
+            j = jnp.clip(t - last, 0, M - 1)
+            upd = lax.dynamic_update_index_in_dim(outbuf, y, j, axis=0)
+            outbuf = jnp.where((t >= last) & (stage == last), upd, outbuf)
+            # this stage computed real data only for ticks in [stage, stage+M)
+            aux = jnp.where((t >= stage) & (t < stage + M), aux, 0.0)
+            return (y, outbuf), aux
+
+        (_, outbuf), auxs = lax.scan(tick, (state, outbuf),
+                                     jnp.arange(ticks))
+        # only the last stage holds real outputs; make them uniform so the
+        # out_spec can be replicated
+        out = lax.psum(jnp.where(stage == last, outbuf, 0.0), axis)
+        aux = lax.psum(jnp.sum(auxs), axis) / (n_stages * M)
+        return out, aux
+
+    y, aux = run(params["layers"], xmb)
+    x = y.reshape(B, S, y.shape[-1])
+    x = _rmsnorm(x, params["final_norm"])
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, aux
+
+
+def pipelined_forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
+                      mesh: jax.sharding.Mesh,
+                      microbatches: int | None = None) -> jax.Array:
+    """Logits-only wrapper over :func:`pipelined_forward_with_aux`."""
+    return pipelined_forward_with_aux(params, tokens, cfg, mesh,
+                                      microbatches)[0]
+
+
+def make_pipelined_train_step(cfg: ModelConfig, mesh: jax.sharding.Mesh,
+                              microbatches: int | None = None,
+                              learning_rate: float = 3e-4):
+    """(params, opt_state, tokens) -> (params, opt_state, loss) with the
+    forward (and therefore the GPipe backward) pipelined over "pp".
+
+    The objective is model.make_train_step's, with the pipelined forward
+    substituted (see the MoE-aux caveat on
+    :func:`pipelined_forward_with_aux`)."""
+    from tpushare.workloads.model import make_train_step
+
+    def fwd(params, tokens, cfg):
+        return pipelined_forward_with_aux(params, tokens, cfg, mesh,
+                                          microbatches)
+
+    return make_train_step(cfg, learning_rate, forward_fn=fwd)
