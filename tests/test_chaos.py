@@ -1,0 +1,296 @@
+"""Fault-injection + concurrency storms over the scheduling stack.
+
+The reference's failure paths (bind rollback, optimistic-lock retry,
+watch-loop restart) exist but are never exercised by tests — and it has no
+fault injection at all (SURVEY §5.2/§5.3). These tests drive tpushare's
+equivalents through a ChaosCluster: flaky/slow/conflicting apiserver calls
+and dropped watch streams, under concurrent bind storms, asserting the
+cache invariants that matter:
+
+- chips are never oversubscribed, even transiently;
+- every successful bind is consistent between cache and apiserver;
+- every failed bind leaves no residue (no reservation leak, annotations
+  reverted);
+- the controller converges after watch streams die.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import AllocationError, SchedulerCache
+from tpushare.controller import Controller
+from tpushare.extender.handlers import BindHandler, FilterHandler
+from tpushare.extender.metrics import Registry
+from tpushare.k8s import ApiError, ChaosCluster, FakeCluster
+
+
+def chaos_with_node(chips=4, hbm=16000, mesh=None, name="n1", seed=0):
+    fc = FakeCluster()
+    fc.add_tpu_node(name, chips=chips, hbm_per_chip_mib=hbm, mesh=mesh)
+    return fc, ChaosCluster(fc, seed=seed)
+
+
+# -- the harness itself -------------------------------------------------------
+
+def test_fail_rule_fires_and_expires():
+    fc, chaos = chaos_with_node()
+    chaos.fail("get_pod", status=503, times=2)
+    fc.create_pod(make_pod(hbm=100, name="p"))
+    for _ in range(2):
+        with pytest.raises(ApiError) as ei:
+            chaos.get_pod("default", "p")
+        assert ei.value.status == 503
+    assert chaos.get_pod("default", "p")["metadata"]["name"] == "p"
+    assert chaos.injected["get_pod"] == 2
+
+
+def test_delay_rule_slows_calls():
+    fc, chaos = chaos_with_node()
+    fc.create_pod(make_pod(hbm=100, name="p"))
+    chaos.delay("get_pod", seconds=0.05, times=1)
+    t0 = time.perf_counter()
+    chaos.get_pod("default", "p")
+    assert time.perf_counter() - t0 >= 0.05
+    chaos.get_pod("default", "p")
+    # rule consumed exactly once (no wall-clock upper bound: that flakes
+    # on loaded runners)
+    assert chaos.injected["get_pod"] == 1
+
+
+def test_drop_watch_closes_stream():
+    fc, chaos = chaos_with_node()
+    chaos.drop_watch("pods", after=1)
+    stop = threading.Event()
+    it = chaos.watch_pods(stop)
+
+    def create_later():
+        # the fake's watch subscribes when the generator first runs, so
+        # pods must be created after the consumer starts iterating
+        time.sleep(0.1)
+        fc.create_pod(make_pod(hbm=100, name="p1"))
+        fc.create_pod(make_pod(hbm=100, name="p2"))
+
+    threading.Thread(target=create_later, daemon=True).start()
+    assert next(it).object["metadata"]["name"] == "p1"
+    with pytest.raises(ApiError, match="stream dropped"):
+        next(it)
+    stop.set()
+    assert chaos.injected["watch_pods"] == 1
+
+
+def test_non_callables_and_clean_methods_pass_through():
+    fc, chaos = chaos_with_node()
+    assert chaos.list_nodes() == fc.list_nodes()
+
+
+def test_stacked_fail_rules_take_turns():
+    fc, chaos = chaos_with_node()
+    fc.create_pod(make_pod(hbm=100, name="p"))
+    chaos.fail("get_pod", status=500, times=1)
+    chaos.fail("get_pod", status=409, times=1)
+    statuses = []
+    for _ in range(2):
+        with pytest.raises(ApiError) as ei:
+            chaos.get_pod("default", "p")
+        statuses.append(ei.value.status)
+    assert statuses == [500, 409]  # one fail per call, in order
+    assert chaos.injected["get_pod"] == 2
+    chaos.get_pod("default", "p")  # both spent
+
+
+def test_fail_on_watch_method_rejected_at_declaration():
+    _, chaos = chaos_with_node()
+    with pytest.raises(ValueError, match="drop_watch"):
+        chaos.fail("watch_pods")
+    with pytest.raises(ValueError, match="drop_watch"):
+        chaos.delay("watch_nodes", seconds=0.1)
+
+
+def test_drop_watch_fires_on_quiet_stream():
+    """after=0 must hang up immediately even when no events ever arrive."""
+    _, chaos = chaos_with_node()
+    chaos.drop_watch("pods", after=0)
+    stop = threading.Event()
+    with pytest.raises(ApiError, match="stream dropped"):
+        next(chaos.watch_pods(stop))
+    stop.set()
+
+
+# -- bind-path faults ---------------------------------------------------------
+
+def test_bind_failure_storm_leaves_no_residue():
+    """Persistent bind 500s: every attempt fails, and afterwards the cache
+    and apiserver look exactly as if nothing happened."""
+    fc, chaos = chaos_with_node()
+    cache = SchedulerCache(chaos)
+    info = cache.get_node_info("n1")
+    chaos.fail("bind_pod", status=500, times=None)
+    for i in range(6):
+        pod = fc.create_pod(make_pod(hbm=2048, name=f"p{i}"))
+        with pytest.raises(AllocationError):
+            info.allocate(pod, chaos)
+    assert chaos.injected["bind_pod"] == 6
+    assert info.describe()["used_hbm_mib"] == 0
+    for i in range(6):
+        live = fc.get_pod("default", f"p{i}")
+        assert not live["spec"].get("nodeName")
+        assert contract.chip_ids_from_annotations(live) is None
+
+
+def test_conflict_retry_with_flaky_refetch_rolls_back():
+    """409 on patch, then 500 on the recheck fetch: the allocation must
+    fail cleanly and release its reservation; a later retry succeeds."""
+    fc, chaos = chaos_with_node()
+    info = SchedulerCache(chaos).get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2048, name="p"))
+    chaos.fail("patch_pod", status=409, times=1)
+    chaos.fail("get_pod", status=500, times=1)
+    with pytest.raises(AllocationError):
+        info.allocate(pod, chaos)
+    assert info.describe()["used_hbm_mib"] == 0
+    placement = info.allocate(pod, chaos)  # chaos spent: clean retry wins
+    assert placement is not None
+    assert fc.get_pod("default", "p")["spec"]["nodeName"] == "n1"
+
+
+def test_slow_patch_does_not_serialize_or_double_book():
+    """Two concurrent allocations on one node while patch_pod is slow:
+    reservations (not the node lock) must prevent double-booking, and the
+    binds must overlap rather than serialize behind the apiserver."""
+    fc, chaos = chaos_with_node(chips=2, hbm=16000)
+    info = SchedulerCache(chaos).get_node_info("n1")
+    delay = 0.15
+    chaos.delay("patch_pod", seconds=delay, times=None)
+    # both pods want >half a chip: correctness requires distinct chips
+    pods = [fc.create_pod(make_pod(hbm=9000, name=f"p{i}"))
+            for i in range(2)]
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(2) as ex:
+        placements = list(ex.map(lambda p: info.allocate(p, chaos), pods))
+    elapsed = time.perf_counter() - t0
+    ids0, ids1 = placements[0].chip_ids, placements[1].chip_ids
+    assert set(ids0).isdisjoint(ids1), "double-booked a chip"
+    # overlapping: well under 2x the injected latency (the reference's
+    # whole-Allocate lock would force >= 2*delay)
+    assert elapsed < 2 * delay, f"binds serialized: {elapsed:.3f}s"
+    assert info.describe()["used_hbm_mib"] == 18000
+
+
+def test_concurrent_bind_storm_under_random_faults():
+    """The big one: 24 pods through the real BindHandler from 8 threads
+    against an apiserver that randomly 500s/409s/hangs up, with a sampler
+    thread asserting no transient oversubscription. Everything must
+    eventually bind (capacity suffices) and cache == apiserver."""
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    fc.add_tpu_node("n2", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    chaos = ChaosCluster(fc, seed=1234)
+    chaos.fail("patch_pod", status=500, probability=0.15, times=None)
+    chaos.fail("patch_pod", status=409, probability=0.10, times=None)
+    chaos.fail("bind_pod", status=500, probability=0.15, times=None)
+    cache = SchedulerCache(chaos)
+    registry = Registry()
+    fil = FilterHandler(cache, registry)
+    binder = BindHandler(cache, chaos, registry)
+
+    n_pods, hbm = 24, 4000
+    pods = [fc.create_pod(make_pod(hbm=hbm, name=f"p{i}"))
+            for i in range(n_pods)]
+
+    overcommit = []
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            for node in cache.describe()["nodes"]:
+                for chip in node["chips"]:
+                    if chip["used_hbm_mib"] > chip["total_hbm_mib"]:
+                        overcommit.append(dict(chip))
+            time.sleep(0.002)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+
+    def schedule(pod):
+        """Filter -> bind with retry, as the default scheduler would."""
+        ns = pod["metadata"]["namespace"]
+        name = pod["metadata"]["name"]
+        for attempt in range(80):
+            res = fil.handle({"Pod": pod, "NodeNames": ["n1", "n2"]})
+            nodes = res["NodeNames"]
+            if not nodes:
+                time.sleep(0.005)
+                continue
+            out = binder.handle({
+                "PodNamespace": ns, "PodName": name,
+                "PodUID": pod["metadata"]["uid"],
+                "Node": nodes[attempt % len(nodes)],
+            })
+            if out["Error"] == "":
+                return True
+            time.sleep(0.002)
+        return False
+
+    with ThreadPoolExecutor(8) as ex:
+        results = list(ex.map(schedule, pods))
+    stop.set()
+    sampler_t.join(timeout=2)
+
+    assert all(results), f"{results.count(False)} pods never bound"
+    assert not overcommit, f"transient oversubscription: {overcommit[:3]}"
+    # the storm actually stormed
+    assert chaos.injected["patch_pod"] + chaos.injected["bind_pod"] > 0
+    # apiserver truth == cache accounting
+    per_chip: dict[tuple[str, int], int] = {}
+    for pod in fc.list_pods():
+        node = pod["spec"].get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        assert node and ids, f"bound pod missing placement: {pod['metadata']}"
+        for cid in ids:
+            per_chip[(node, cid)] = per_chip.get((node, cid), 0) + hbm
+    for (node, cid), used in per_chip.items():
+        assert used <= 16000
+    d = cache.describe()
+    assert d["used_hbm_mib"] == n_pods * hbm
+    for node in d["nodes"]:
+        for chip in node["chips"]:
+            assert chip["used_hbm_mib"] == per_chip.get(
+                (node["name"], chip["idx"]), 0)
+
+
+# -- controller resilience ----------------------------------------------------
+
+def test_controller_survives_watch_drops_and_converges():
+    """Pod watch streams keep dying; completion events land anyway (via
+    reconnect or resync) and the cache frees the chips."""
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=16000)
+    chaos = ChaosCluster(fc, seed=7)
+    chaos.drop_watch("pods", after=1, times=5)
+    cache = SchedulerCache(chaos)
+    ctl = Controller(chaos, cache, resync_seconds=0.2)
+    ctl.build_cache()
+    ctl.start()
+    try:
+        info = cache.get_node_info("n1")
+        pods = [fc.create_pod(make_pod(hbm=3000, name=f"p{i}"))
+                for i in range(4)]
+        for p in pods:
+            info.allocate(p, chaos)
+        assert info.describe()["used_hbm_mib"] == 12000
+        for p in pods:
+            fc.set_pod_phase("default", p["metadata"]["name"], "Succeeded")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if cache.describe()["used_hbm_mib"] == 0:
+                break
+            time.sleep(0.05)
+        assert cache.describe()["used_hbm_mib"] == 0
+        assert chaos.injected["watch_pods"] >= 1
+    finally:
+        ctl.stop()

@@ -15,7 +15,9 @@ needs, in two implementations:
 Everything speaks dict-shaped JSON objects; no typed model classes.
 """
 
+from tpushare.k8s.chaos import ChaosCluster
 from tpushare.k8s.client import ApiError, ClusterClient, WatchEvent
 from tpushare.k8s.fake import FakeCluster
 
-__all__ = ["ApiError", "ClusterClient", "WatchEvent", "FakeCluster"]
+__all__ = ["ApiError", "ChaosCluster", "ClusterClient", "WatchEvent",
+           "FakeCluster"]

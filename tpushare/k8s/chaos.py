@@ -1,0 +1,185 @@
+"""Fault injection for the cluster-client surface.
+
+The reference has **no fault injection anywhere** (SURVEY.md §5.3); its
+failure handling — bind rollback, optimistic-lock retry, watch-loop
+restart, annotation replay — is only ever exercised by production
+incidents. tpushare ships this chaos proxy as a first-class test facility
+instead: wrap any cluster client (normally the :class:`FakeCluster`) and
+declare failures per method, then assert the scheduler's invariants hold
+through the storm (tests/test_chaos.py).
+
+Rules are consumed call-by-call and are thread-safe, so a chaos cluster
+can sit under a concurrent bind storm:
+
+    chaos = ChaosCluster(FakeCluster(), seed=7)
+    chaos.fail("patch_pod", status=409, times=2)        # next 2 calls 409
+    chaos.fail("bind_pod", probability=0.3, times=None) # 30% of calls 500
+    chaos.delay("get_pod", seconds=0.05, times=None)    # slow apiserver
+    chaos.drop_watch("pods", after=3)                   # stream dies after 3
+
+Every injected fault is counted in ``chaos.injected`` so tests can assert
+the storm actually happened (a chaos test that injected nothing proves
+nothing).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import Counter
+from typing import Any
+
+from tpushare.k8s.client import ApiError
+
+_WATCH_KINDS = {"pods": "watch_pods", "nodes": "watch_nodes",
+                "configmaps": "watch_configmaps"}
+
+
+class _Rule:
+    __slots__ = ("action", "status", "message", "seconds", "after",
+                 "remaining", "probability")
+
+    def __init__(self, action: str, *, status: int = 500,
+                 message: str | None = None, seconds: float = 0.0,
+                 after: int = 0, times: int | None = 1,
+                 probability: float = 1.0) -> None:
+        self.action = action          # "fail" | "delay" | "drop"
+        self.status = status
+        self.message = message
+        self.seconds = seconds
+        self.after = after
+        self.remaining = float("inf") if times is None else int(times)
+        self.probability = probability
+
+
+class ChaosCluster:
+    """Transparent proxy over a cluster client that injects declared
+    faults. Methods without active rules pass straight through; non-method
+    attributes are proxied untouched."""
+
+    def __init__(self, inner: Any, seed: int = 0) -> None:
+        self._inner = inner
+        self._rng = random.Random(seed)
+        self._rules_lock = threading.Lock()
+        self._rules: dict[str, list[_Rule]] = {}
+        self.injected: Counter = Counter()
+
+    # -- rule declaration -----------------------------------------------------
+
+    def fail(self, method: str, *, status: int = 500,
+             message: str | None = None, times: int | None = 1,
+             probability: float = 1.0) -> None:
+        """Make the next ``times`` calls of ``method`` raise
+        ``ApiError(status)`` (each with ``probability``; times=None =
+        forever). At most one fail rule fires per call, so stacked rules
+        (e.g. a 500 rule and a 409 rule) take turns rather than the later
+        ones being consumed-but-ignored."""
+        self._check_not_watch(method)
+        self._add(method, _Rule("fail", status=status, message=message,
+                                times=times, probability=probability))
+
+    def delay(self, method: str, *, seconds: float,
+              times: int | None = None, probability: float = 1.0) -> None:
+        """Sleep ``seconds`` before the next ``times`` calls of
+        ``method`` (default: every call) — apiserver latency."""
+        self._check_not_watch(method)
+        self._add(method, _Rule("delay", seconds=seconds, times=times,
+                                probability=probability))
+
+    @staticmethod
+    def _check_not_watch(method: str) -> None:
+        if method in _WATCH_KINDS.values():
+            raise ValueError(
+                f"{method} is a watch stream; use drop_watch() — fail/delay "
+                "rules would be counted but never fire there")
+
+    def drop_watch(self, kind: str, *, after: int = 0,
+                   times: int | None = 1) -> None:
+        """Close the next ``times`` ``kind`` watch streams ("pods",
+        "nodes", "configmaps") after yielding ``after`` events — the
+        apiserver hanging up mid-stream."""
+        method = _WATCH_KINDS[kind]
+        self._add(method, _Rule("drop", after=after, times=times))
+
+    def clear(self) -> None:
+        with self._rules_lock:
+            self._rules.clear()
+
+    def _add(self, method: str, rule: _Rule) -> None:
+        with self._rules_lock:
+            self._rules.setdefault(method, []).append(rule)
+
+    def _take(self, method: str) -> list[_Rule]:
+        """Consume (decrement) whichever rules fire for this call.
+
+        Every fired rule takes effect: all delays apply, but at most one
+        fail rule is consumed per call (the caller raises exactly one
+        error, so consuming more would overcount ``injected``)."""
+        with self._rules_lock:
+            fired = []
+            fail_taken = False
+            for rule in self._rules.get(method, []):
+                if rule.remaining <= 0:
+                    continue
+                if rule.action == "fail" and fail_taken:
+                    continue
+                if rule.probability < 1.0 and \
+                        self._rng.random() >= rule.probability:
+                    continue
+                rule.remaining -= 1
+                self.injected[method] += 1
+                fired.append(rule)
+                if rule.action == "fail":
+                    fail_taken = True
+            return fired
+
+    # -- proxy ----------------------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+        if name in _WATCH_KINDS.values():
+            return self._wrap_watch(name, attr)
+        return self._wrap_call(name, attr)
+
+    def _wrap_call(self, name: str, fn: Any) -> Any:
+        def call(*args: Any, **kwargs: Any) -> Any:
+            failure: _Rule | None = None
+            for rule in self._take(name):
+                if rule.action == "delay":
+                    time.sleep(rule.seconds)
+                elif rule.action == "fail":
+                    failure = rule
+            if failure is not None:
+                raise ApiError(
+                    failure.status,
+                    failure.message or f"chaos: injected {failure.status} "
+                                       f"on {name}")
+            return fn(*args, **kwargs)
+        return call
+
+    def _wrap_watch(self, name: str, fn: Any) -> Any:
+        def watch(*args: Any, **kwargs: Any):
+            drop_after: float | None = None
+            for rule in self._take(name):
+                if rule.action == "drop":
+                    drop_after = rule.after if drop_after is None \
+                        else min(drop_after, rule.after)
+            n = 0
+            inner = fn(*args, **kwargs)
+            while True:
+                # check BEFORE pulling: a dropped stream on a quiet
+                # cluster must hang up, not block waiting for an event
+                # that never comes
+                if drop_after is not None and n >= drop_after:
+                    raise ApiError(500, f"chaos: {name} stream dropped "
+                                        f"after {n} events")
+                try:
+                    ev = next(inner)
+                except StopIteration:
+                    return
+                yield ev
+                n += 1
+        return watch
