@@ -164,7 +164,10 @@ def test_slow_patch_does_not_serialize_or_double_book():
     binds must overlap rather than serialize behind the apiserver."""
     fc, chaos = chaos_with_node(chips=2, hbm=16000)
     info = SchedulerCache(chaos).get_node_info("n1")
-    delay = 0.15
+    # delay is deliberately large so the serialized case (>= 2x delay) and
+    # the overlapped case (~1x delay) are separated by far more than
+    # scheduler/GIL noise on a loaded runner
+    delay = 0.5
     chaos.delay("patch_pod", seconds=delay, times=None)
     # both pods want >half a chip: correctness requires distinct chips
     pods = [fc.create_pod(make_pod(hbm=9000, name=f"p{i}"))

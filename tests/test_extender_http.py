@@ -108,6 +108,31 @@ def test_bind_failure_returns_500(rig):
     assert "no placement" in body["Error"]
 
 
+def test_bind_emits_scheduled_and_failure_events(rig):
+    """The extender owns the bind verb, so it emits the Scheduled /
+    FailedScheduling pod events the default scheduler would have (the
+    reference wires an EventRecorder but never emits — SURVEY §5.5)."""
+    fc, cache, base = rig
+    ok = fc.create_pod(make_pod(hbm=2000, name="evt-ok"))
+    post(f"{base}/tpushare-scheduler/bind", {
+        "PodName": "evt-ok", "PodNamespace": "default",
+        "PodUID": ok["metadata"]["uid"], "Node": "n1"})
+    bad = fc.create_pod(make_pod(hbm=99999, name="evt-bad"))
+    with pytest.raises(urllib.error.HTTPError):
+        post(f"{base}/tpushare-scheduler/bind", {
+            "PodName": "evt-bad", "PodNamespace": "default",
+            "PodUID": bad["metadata"]["uid"], "Node": "n1"})
+    events = fc.events
+    sched = [e for e in events if e["reason"] == "Scheduled"]
+    failed = [e for e in events if e["reason"] == "FailedScheduling"]
+    assert len(sched) == 1 and sched[0]["type"] == "Normal"
+    assert sched[0]["involvedObject"]["name"] == "evt-ok"
+    assert "chips" in sched[0]["message"]
+    assert len(failed) == 1 and failed[0]["type"] == "Warning"
+    assert failed[0]["involvedObject"]["name"] == "evt-bad"
+    assert "no placement" in failed[0]["message"]
+
+
 def test_bind_uid_mismatch_rejected(rig):
     fc, cache, base = rig
     fc.create_pod(make_pod(hbm=100, name="p"))

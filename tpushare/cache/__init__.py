@@ -11,7 +11,9 @@ oversubscription-safe under concurrent binds.
 """
 
 from tpushare.cache.chipusage import ChipUsage
-from tpushare.cache.nodeinfo import NodeInfo, AllocationError
+from tpushare.cache.nodeinfo import (
+    AllocationError, AlreadyBoundError, NodeInfo)
 from tpushare.cache.cache import SchedulerCache
 
-__all__ = ["ChipUsage", "NodeInfo", "AllocationError", "SchedulerCache"]
+__all__ = ["ChipUsage", "NodeInfo", "AllocationError", "AlreadyBoundError",
+           "SchedulerCache"]

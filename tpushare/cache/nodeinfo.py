@@ -45,6 +45,13 @@ class AllocationError(Exception):
     timeout (reference designs.md:82)."""
 
 
+class AlreadyBoundError(AllocationError):
+    """The pod is already bound (duplicate-delivered bind, or another
+    extender replica won the race). Not a scheduling failure — the pod IS
+    scheduled — so callers must not surface it as one (e.g. no
+    FailedScheduling event)."""
+
+
 def request_from_pod(pod: dict[str, Any]) -> PlacementRequest | None:
     """Translate a pod's resource limits + annotations into a placement
     request. Returns None for non-tpushare pods.
@@ -144,7 +151,7 @@ class NodeInfo:
             # already bound (double-delivered bind, or another extender
             # replica won): refuse BEFORE any write, or we'd overwrite the
             # live placement annotations with a new decision
-            raise AllocationError(
+            raise AlreadyBoundError(
                 f"pod {podlib.pod_key(pod)} already bound to "
                 f"{podlib.pod_node_name(pod)}")
         uid = podlib.pod_uid(pod)

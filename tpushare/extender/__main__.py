@@ -24,7 +24,6 @@ import threading
 
 from tpushare.cache import SchedulerCache
 from tpushare.controller import Controller
-from tpushare.core.native import engine as native_engine
 from tpushare.extender.handlers import register_cache_gauges
 from tpushare.extender.metrics import Registry
 from tpushare.extender.server import ExtenderServer
@@ -76,7 +75,7 @@ def main(argv: list[str] | None = None) -> int:
         from tpushare.k8s.incluster import InClusterClient
         cluster = InClusterClient(base_url=args.apiserver)
 
-    native_engine.warmup()  # compile/load the C++ engine off the hot path
+    # (native engine warmup happens inside ExtenderServer start/serve)
     cache = SchedulerCache(cluster)
     controller = Controller(cluster, cache, workers=args.workers)
     replayed = controller.build_cache()

@@ -19,7 +19,8 @@ import time
 from typing import Any
 
 from tpushare import contract
-from tpushare.cache import AllocationError, SchedulerCache
+from tpushare.cache import (
+    AllocationError, AlreadyBoundError, SchedulerCache)
 from tpushare.cache.nodeinfo import no_fit_reason, request_from_pod
 from tpushare.core.native import engine as native_engine
 from tpushare.contract import pod as podlib
@@ -111,18 +112,55 @@ class BindHandler:
         name = args.get("PodName", "")
         uid = args.get("PodUID", "")
         node = args.get("Node", "")
+        err: Exception | None = None
+        placement = None
         try:
             pod = self._get_pod(ns, name, uid)
             info = self._cache.get_node_info(node)
-            info.allocate(pod, self._cluster)
+            placement = info.allocate(pod, self._cluster)
         except (AllocationError, ApiError) as e:
             self.bind_failures.inc()
-            log.warning("bind %s/%s -> %s failed: %s", ns, name, node, e)
-            return {"Error": str(e)}
-        finally:
-            self.bind_latency.observe(time.perf_counter() - t0)
+            err = e
+        # latency observed BEFORE event emission: the event POST is its own
+        # apiserver round-trip and must not skew the BASELINE p50/p99
+        self.bind_latency.observe(time.perf_counter() - t0)
+        if err is not None:
+            log.warning("bind %s/%s -> %s failed: %s", ns, name, node, err)
+            # a duplicate-delivered bind is not a scheduling failure (the
+            # pod IS scheduled): no Warning event for a healthy pod
+            if not isinstance(err, AlreadyBoundError):
+                self._emit_event(
+                    ns, name, uid, "Warning", "FailedScheduling",
+                    f"tpushare bind to {node} failed: {err}")
+            return {"Error": str(err)}
         log.info("bind %s/%s -> %s ok", ns, name, node)
+        self._emit_event(
+            ns, name, uid, "Normal", "Scheduled",
+            f"Successfully assigned {ns}/{name} to {node} "
+            f"chips {list(placement.chip_ids)}")
         return {"Error": ""}
+
+    def _emit_event(self, ns: str, name: str, uid: str, etype: str,
+                    reason: str, message: str) -> None:
+        """Best-effort pod Event. The extender owns the bind verb, so it
+        emits the Scheduled / FailedScheduling events the default scheduler
+        would have (the reference wires an EventRecorder but never emits,
+        controller.go:63-67 / SURVEY §5.5 — operators get nothing from
+        `kubectl describe pod` there)."""
+        try:
+            self._cluster.create_event(ns, {
+                "metadata": {"generateName": f"{name}."},
+                "type": etype,
+                "reason": reason,
+                "message": message,
+                "involvedObject": {
+                    "kind": "Pod", "namespace": ns, "name": name,
+                    "uid": uid,
+                },
+                "source": {"component": "tpushare-scheduler-extender"},
+            })
+        except Exception as e:  # noqa: BLE001 — events must never block binds
+            log.debug("event emit failed for %s/%s: %s", ns, name, e)
 
     def _get_pod(self, ns: str, name: str, uid: str) -> dict[str, Any]:
         """Fetch with UID recheck (reference getPod, gpushare-bind.go:45-70:

@@ -149,6 +149,8 @@ class ExtenderServer:
 
     def start(self) -> int:
         """Bind and serve on a background thread; returns the bound port."""
+        from tpushare.core import native as native_engine
+        native_engine.warmup()  # first Filter must not pay engine cold-start
         self._httpd = ThreadingHTTPServer(
             (self.host, self.port), self._make_handler())
         self.port = self._httpd.server_address[1]
@@ -159,6 +161,8 @@ class ExtenderServer:
         return self.port
 
     def serve_forever(self) -> None:
+        from tpushare.core import native as native_engine
+        native_engine.warmup()
         self._httpd = ThreadingHTTPServer(
             (self.host, self.port), self._make_handler())
         log.info("extender listening on %s:%d", self.host, self.port)

@@ -160,26 +160,49 @@ class ChaosCluster:
             return fn(*args, **kwargs)
         return call
 
+    def _take_drop(self, method: str) -> _Rule | None:
+        """Reserve (decrement) the first live drop rule for a new stream.
+        ``injected`` is NOT counted here — only when the drop fires — and
+        a stream that ends before firing refunds its reservation, so the
+        counter reflects actual hangups and stacked times=N budgets don't
+        deplete on streams that were never dropped."""
+        with self._rules_lock:
+            for rule in self._rules.get(method, []):
+                if rule.action != "drop" or rule.remaining <= 0:
+                    continue
+                if rule.probability < 1.0 and \
+                        self._rng.random() >= rule.probability:
+                    continue
+                rule.remaining -= 1
+                return rule
+            return None
+
     def _wrap_watch(self, name: str, fn: Any) -> Any:
         def watch(*args: Any, **kwargs: Any):
-            drop_after: float | None = None
-            for rule in self._take(name):
-                if rule.action == "drop":
-                    drop_after = rule.after if drop_after is None \
-                        else min(drop_after, rule.after)
+            rule = self._take_drop(name)
             n = 0
+            fired = False
             inner = fn(*args, **kwargs)
-            while True:
-                # check BEFORE pulling: a dropped stream on a quiet
-                # cluster must hang up, not block waiting for an event
-                # that never comes
-                if drop_after is not None and n >= drop_after:
-                    raise ApiError(500, f"chaos: {name} stream dropped "
-                                        f"after {n} events")
-                try:
-                    ev = next(inner)
-                except StopIteration:
-                    return
-                yield ev
-                n += 1
+            try:
+                while True:
+                    # check BEFORE pulling: a dropped stream on a quiet
+                    # cluster must hang up, not block waiting for an event
+                    # that never comes
+                    if rule is not None and n >= rule.after:
+                        fired = True
+                        with self._rules_lock:
+                            self.injected[name] += 1
+                        raise ApiError(
+                            500, f"chaos: {name} stream dropped "
+                                 f"after {n} events")
+                    try:
+                        ev = next(inner)
+                    except StopIteration:
+                        return
+                    yield ev
+                    n += 1
+            finally:
+                if rule is not None and not fired:
+                    with self._rules_lock:
+                        rule.remaining += 1
         return watch
