@@ -133,6 +133,33 @@ def test_bind_emits_scheduled_and_failure_events(rig):
     assert "no placement" in failed[0]["message"]
 
 
+def test_duplicate_bind_is_idempotent_success(rig):
+    """A re-delivered bind for a pod already bound to the requested node
+    returns success (the pod IS scheduled as asked); a bind for a pod
+    bound elsewhere fails, but without a FailedScheduling event."""
+    fc, cache, base = rig
+    created = fc.create_pod(make_pod(hbm=1000, name="dup"))
+    body = {"PodName": "dup", "PodNamespace": "default",
+            "PodUID": created["metadata"]["uid"], "Node": "n1"}
+    status, result = post(f"{base}/tpushare-scheduler/bind", body)
+    assert status == 200 and result["Error"] == ""
+    status, result = post(f"{base}/tpushare-scheduler/bind", body)  # again
+    assert status == 200 and result["Error"] == ""
+    # bound to a different node -> refused, but no Warning event
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(f"{base}/tpushare-scheduler/bind", {**body, "Node": "n2"})
+    assert e.value.code == 500
+    assert "already bound" in json.loads(e.value.read())["Error"]
+    warnings = [ev for ev in fc.events
+                if ev["reason"] == "FailedScheduling"
+                and ev["involvedObject"]["name"] == "dup"]
+    assert warnings == []
+    # exactly one Scheduled event despite three bind calls
+    sched = [ev for ev in fc.events if ev["reason"] == "Scheduled"
+             and ev["involvedObject"]["name"] == "dup"]
+    assert len(sched) == 1
+
+
 def test_bind_uid_mismatch_rejected(rig):
     fc, cache, base = rig
     fc.create_pod(make_pod(hbm=100, name="p"))

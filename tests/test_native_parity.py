@@ -91,6 +91,21 @@ def test_fits_fleet_handles_gappy_ids():
     assert fleet == [fits_py(gappy, topo, req), True]
 
 
+def test_fits_fleet_out_of_order_chip_snapshot():
+    # dense but unsorted chip list, delivered as a weakref-able
+    # ChipSnapshot (the caching key type): must pack correctly, not crash
+    from tpushare.core.chips import ChipSnapshot
+    from tpushare.core.placement import fits as fits_py
+
+    topo = MeshTopology((2, 2))
+    shuffled = ChipSnapshot(
+        ChipView(i, topo.coords(i), 16000, 0) for i in (2, 0, 3, 1))
+    req = PlacementRequest(hbm_mib=1000, chip_count=4)
+    for _ in range(2):  # second call exercises the cached-pack path
+        fleet = native_engine.fits_fleet([(shuffled, topo)], req)
+        assert fleet == [fits_py(shuffled, topo, req)] == [True]
+
+
 def test_topology_pin_parity():
     topo = MeshTopology((4, 4))
     chips = [ChipView(i, topo.coords(i), 16000, 0) for i in range(16)]

@@ -33,10 +33,15 @@ class ChipUsage:
         self.coords = coords
         self.total_hbm_mib = total_hbm_mib
         self._pods: dict[str, _Entry] = {}  # pod UID -> entry
+        self._used = 0  # invariant: == sum of entry hbm_mib
 
     @property
     def used_hbm_mib(self) -> int:
-        return sum(e.hbm_mib for e in self._pods.values())
+        # maintained incrementally by the mutations below: this property
+        # sits in the Filter hot loop (every snapshot of every chip), where
+        # re-summing the pod map is what made the reference's fit check
+        # O(pods) per chip (deviceinfo.go:41-54)
+        return self._used
 
     @property
     def pod_uids(self) -> list[str]:
@@ -52,8 +57,15 @@ class ChipUsage:
 
     # -- mutations (NodeInfo-lock held) --------------------------------------
 
+    def _put(self, uid: str, hbm_mib: int, reserved: bool) -> None:
+        old = self._pods.get(uid)
+        if old is not None:
+            self._used -= old.hbm_mib
+        self._pods[uid] = _Entry(hbm_mib, reserved=reserved)
+        self._used += hbm_mib
+
     def reserve(self, uid: str, hbm_mib: int) -> None:
-        self._pods[uid] = _Entry(hbm_mib, reserved=True)
+        self._put(uid, hbm_mib, reserved=True)
 
     def confirm(self, uid: str) -> None:
         e = self._pods.get(uid)
@@ -63,10 +75,14 @@ class ChipUsage:
     def add_pod(self, uid: str, hbm_mib: int) -> None:
         """Record a pod known from its annotations (sync/replay path,
         reference deviceinfo.go addPod)."""
-        self._pods[uid] = _Entry(hbm_mib, reserved=False)
+        self._put(uid, hbm_mib, reserved=False)
 
     def remove_pod(self, uid: str) -> bool:
-        return self._pods.pop(uid, None) is not None
+        e = self._pods.pop(uid, None)
+        if e is not None:
+            self._used -= e.hbm_mib
+            return True
+        return False
 
     def has_pod(self, uid: str) -> bool:
         return uid in self._pods

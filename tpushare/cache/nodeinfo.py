@@ -34,7 +34,7 @@ from tpushare import contract
 from tpushare.cache.chipusage import ChipUsage
 from tpushare.contract import node as nodelib
 from tpushare.contract import pod as podlib
-from tpushare.core.chips import ChipView
+from tpushare.core.chips import ChipSnapshot, ChipView
 from tpushare.core.placement import Placement, PlacementRequest, fits, select_chips
 from tpushare.core.topology import MeshTopology
 from tpushare.k8s.client import ApiError
@@ -90,7 +90,17 @@ class NodeInfo:
         self._lock = threading.RLock()
         self.name = nodelib.node_name(node)
         self._unhealthy: set[int] = set()
+        # snapshot cache: scheduling state changes rarely relative to
+        # Filter calls (every webhook snapshots every node), so views are
+        # rebuilt only when _version moves. Mutators bump _dirty().
+        self._version = 0
+        self._snap_version = -1
+        self._snap: list[ChipView] = []
         self._init_chips(node)
+
+    def _dirty(self) -> None:
+        """Caller holds self._lock."""
+        self._version += 1
 
     def _init_chips(self, node: dict[str, Any]) -> None:
         count = contract.node_chip_count(node)
@@ -113,11 +123,19 @@ class NodeInfo:
     def set_unhealthy(self, chip_ids: set[int]) -> None:
         with self._lock:
             self._unhealthy = set(chip_ids)
+            self._dirty()
 
     def snapshot(self) -> list[ChipView]:
+        """Chip views for placement. The returned list is cached and
+        SHARED between calls until the next mutation — callers iterate it,
+        never mutate it (ChipView itself is frozen)."""
         with self._lock:
-            return [c.view(healthy=c.idx not in self._unhealthy)
-                    for c in self.chips]
+            if self._snap_version != self._version:
+                self._snap = ChipSnapshot(
+                    c.view(healthy=c.idx not in self._unhealthy)
+                    for c in self.chips)
+                self._snap_version = self._version
+            return self._snap
 
     # -- scheduling operations ------------------------------------------------
 
@@ -168,6 +186,7 @@ class NodeInfo:
             demand = req.chip_demand_mib(self.hbm_per_chip)
             for cid in placement.chip_ids:
                 self.chips[cid].reserve(uid, demand)
+            self._dirty()
 
         # phase 2: apiserver writes (no lock held)
         ann = contract.placement_annotations(
@@ -203,6 +222,7 @@ class NodeInfo:
             with self._lock:
                 for cid in placement.chip_ids:
                     self.chips[cid].remove_pod(uid)
+                self._dirty()
             if patched:
                 # best-effort: restore the previous annotation state — but
                 # only if our values are still the live ones. A concurrent
@@ -225,6 +245,7 @@ class NodeInfo:
         with self._lock:
             for cid in placement.chip_ids:
                 self.chips[cid].confirm(uid)
+            self._dirty()
         return placement
 
     # -- sync-path bookkeeping (controller / replay) --------------------------
@@ -241,6 +262,7 @@ class NodeInfo:
             for cid in ids:
                 if 0 <= cid < len(self.chips):
                     self.chips[cid].add_pod(uid, hbm)
+            self._dirty()
         return True
 
     def remove_pod(self, pod: dict[str, Any]) -> None:
@@ -248,6 +270,7 @@ class NodeInfo:
         with self._lock:
             for c in self.chips:
                 c.remove_pod(uid)
+            self._dirty()
 
     def update_node(self, node: dict[str, Any]) -> bool:
         """Node capacity/topology changed (device plugin restarted with
@@ -269,6 +292,7 @@ class NodeInfo:
                     nc = self.chips[oc.idx]
                     for uid in oc.pod_uids:
                         nc.add_pod(uid, oc.pod_hbm(uid))
+            self._dirty()
             return True
 
     # -- metrics / inspect -----------------------------------------------------

@@ -39,6 +39,22 @@ class ChipView:
                         used_hbm_mib, self.healthy)
 
 
+class ChipSnapshot(list):
+    """A list of :class:`ChipView` that supports weak references and
+    identity hashing, so engines can cache marshalled derivatives (e.g.
+    the native engine's packed arrays) keyed by the snapshot object
+    itself. NodeInfo hands the SAME snapshot out until its state changes,
+    making identity a valid cache key. (``list.__hash__`` is None — an
+    unhashable key would silently disable WeakKeyDictionary caching.)"""
+
+    __slots__ = ("__weakref__",)
+
+    # identity hash + inherited elementwise __eq__: a hash-bucket
+    # collision only "hits" on an equal-content snapshot, whose pack is
+    # identical anyway (ChipView coords encode the mesh shape)
+    __hash__ = object.__hash__
+
+
 def node_chips(
     count: int,
     total_hbm_mib_per_chip: int,

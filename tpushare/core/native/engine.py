@@ -128,61 +128,147 @@ def warmup() -> bool:
     return ok
 
 
+class _NodePack:
+    """Request-independent marshalling of one node snapshot: numpy arrays
+    ready to concatenate into the fleet-level native call."""
+
+    __slots__ = ("used", "total", "healthy", "dims")
+
+    def __init__(self, used, total, healthy, dims) -> None:
+        self.used = used
+        self.total = total
+        self.healthy = healthy
+        self.dims = dims
+
+
+# packs cached per snapshot object (NodeInfo hands out the same
+# ChipSnapshot until its state changes, so identity is a valid key);
+# plain lists aren't weakref-able and simply skip the cache
+_pack_cache: "weakref.WeakKeyDictionary" = None  # type: ignore[assignment]
+# one-entry cache of the last fleet's concatenated arrays (benign race:
+# concurrent misses just rebuild)
+_fleet_cache: tuple | None = None
+
+
+def _node_pack(chips, topo) -> "_NodePack | None":
+    """Pack a node for the fleet call, or None if its shape can't be
+    expressed densely (gappy chip ids, mesh/chip-count mismatch)."""
+    global _pack_cache
+    import numpy as np
+
+    if _pack_cache is None:
+        import weakref as _weakref
+        _pack_cache = _weakref.WeakKeyDictionary()
+    key = chips  # cache under the ORIGINAL (stable) snapshot object
+    try:
+        pack = _pack_cache.get(key)
+        cacheable = True
+    except TypeError:
+        pack = None
+        cacheable = False
+    if pack is not None:
+        return pack or None  # False sentinel = known non-dense
+    if len(chips) != topo.num_chips or any(
+            c.idx != j for j, c in enumerate(chips)):
+        by_idx = sorted(chips, key=lambda c: c.idx)
+        if len(chips) != topo.num_chips or any(
+                c.idx != j for j, c in enumerate(by_idx)):
+            if cacheable:
+                _pack_cache[key] = False
+            return None
+        chips = by_idx
+    n = len(chips)
+    pack = _NodePack(
+        used=np.fromiter((c.used_hbm_mib for c in chips), np.int64, n),
+        total=np.fromiter((c.total_hbm_mib for c in chips), np.int64, n),
+        healthy=np.fromiter((c.healthy for c in chips), np.bool_, n),
+        dims=np.asarray(topo.shape, np.int64),
+    )
+    if cacheable:
+        _pack_cache[key] = pack
+    return pack
+
+
+def _i64p(arr) -> "ctypes._Pointer":
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
 def fits_fleet(nodes, req: "PlacementRequest") -> "list[bool]":
     """Fleet-wide Filter in ONE native call.
 
     ``nodes`` is a list of (chips, topo) snapshots. Nodes the native ABI
-    can't express (gappy chip ids, mesh/chip-count mismatch) fall back to
-    the Python ``fits`` individually; everything else is evaluated in a
-    single C scan — this is what keeps Filter flat as fleets grow
-    (per-node ctypes marshalling dominated the old loop).
+    can't express fall back to the Python ``fits`` individually;
+    everything else is evaluated in a single C scan over numpy-packed
+    arrays — per-node packs are cached against the (stable) snapshot
+    objects, so a quiescent fleet re-marshals nothing. This is what keeps
+    Filter flat as fleets grow (per-node Python loops dominated before).
     """
     from tpushare.core.placement import fits as fits_py
 
     lib = _load()
-    results: list[bool | None] = [None] * len(nodes)
-    dense: list[tuple[int, list]] = []  # (node index, idx-sorted chips)
-    if lib is not None:
-        for i, (chips, topo) in enumerate(nodes):
-            by_idx = sorted(chips, key=lambda c: c.idx)
-            if len(chips) == topo.num_chips and all(
-                    c.idx == j for j, c in enumerate(by_idx)):
-                dense.append((i, by_idx))
-    if lib is None or not dense:
+    if lib is None:
+        return [fits_py(chips, topo, req) for chips, topo in nodes]
+    try:
+        import numpy as np
+    except ImportError:
+        # minimal images ship g++ but not numpy: the native single-node
+        # selector still works, only the vectorized fleet scan degrades
         return [fits_py(chips, topo, req) for chips, topo in nodes]
 
-    chip_offsets = [0]
-    mesh_offsets = [0]
-    free: list[int] = []
-    total: list[int] = []
-    dims: list[int] = []
-    for i, by_idx in dense:
-        topo = nodes[i][1]
-        for c in by_idx:
-            ineligible = (not c.healthy
-                          or (req.hbm_mib == 0 and c.used_hbm_mib > 0))
-            free.append(-1 if ineligible else c.free_hbm_mib)
-            total.append(c.total_hbm_mib)
-        dims.extend(topo.shape)
-        chip_offsets.append(len(free))
-        mesh_offsets.append(len(dims))
+    results: list[bool | None] = [None] * len(nodes)
+    dense_idx: list[int] = []
+    packs: list[_NodePack] = []
+    for i, (chips, topo) in enumerate(nodes):
+        p = _node_pack(chips, topo)
+        if p is not None:
+            dense_idx.append(i)
+            packs.append(p)
+    if not dense_idx:
+        return [fits_py(chips, topo, req) for chips, topo in nodes]
 
-    n = len(dense)
+    # fleet-level concatenation cached against the exact tuple of packs:
+    # a quiescent fleet (the common case between scheduling events) reuses
+    # the arrays outright; any node change produces a new pack object and
+    # misses. Tuple equality is elementwise identity (_NodePack defines no
+    # __eq__), and the cache holds the packs alive so identity is stable.
+    global _fleet_cache
+    pack_key = tuple(packs)
+    cached = _fleet_cache
+    if cached is not None and cached[0] == pack_key:
+        _, used, total, healthy, dims, chip_offsets, mesh_offsets = cached
+    else:
+        used = np.concatenate([p.used for p in packs])
+        total = np.concatenate([p.total for p in packs])
+        healthy = np.concatenate([p.healthy for p in packs])
+        dims = np.concatenate([p.dims for p in packs])
+        chip_offsets = np.zeros(len(packs) + 1, np.int64)
+        np.cumsum([p.used.size for p in packs], out=chip_offsets[1:])
+        mesh_offsets = np.zeros(len(packs) + 1, np.int64)
+        np.cumsum([p.dims.size for p in packs], out=mesh_offsets[1:])
+        _fleet_cache = (pack_key, used, total, healthy, dims,
+                        chip_offsets, mesh_offsets)
+
+    # request-dependent eligibility, vectorized (mirrors placement._eligible):
+    # -1 marks a chip that can never host this request
+    ineligible = ~healthy
+    if req.hbm_mib == 0:  # exclusive chips: only completely-free qualify
+        ineligible = ineligible | (used > 0)
+    free = np.where(ineligible, np.int64(-1), total - used)
+    free = np.ascontiguousarray(free, np.int64)
+
+    n = len(packs)
     t_rank = len(req.topology) if req.topology else 0
     t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
-    out = (ctypes.c_uint8 * n)()
+    out = np.zeros(n, np.uint8)
     rc = lib.tpushare_fits_fleet(
-        n,
-        (ctypes.c_int64 * len(chip_offsets))(*chip_offsets),
-        (ctypes.c_int64 * max(len(free), 1))(*free),
-        (ctypes.c_int64 * max(len(total), 1))(*total),
-        (ctypes.c_int64 * len(mesh_offsets))(*mesh_offsets),
-        (ctypes.c_int64 * max(len(dims), 1))(*dims),
+        n, _i64p(chip_offsets), _i64p(free), _i64p(total),
+        _i64p(mesh_offsets), _i64p(dims),
         req.hbm_mib, req.chip_count, t_rank, t_dims,
-        1 if req.allow_scatter else 0, out)
+        1 if req.allow_scatter else 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     if rc != 0:
         return [fits_py(chips, topo, req) for chips, topo in nodes]
-    for pos, (i, _) in enumerate(dense):
+    for pos, i in enumerate(dense_idx):
         results[i] = bool(out[pos])
     for i, r in enumerate(results):
         if r is None:

@@ -114,24 +114,39 @@ class BindHandler:
         node = args.get("Node", "")
         err: Exception | None = None
         placement = None
+        bound_node = ""
         try:
             pod = self._get_pod(ns, name, uid)
             info = self._cache.get_node_info(node)
             placement = info.allocate(pod, self._cluster)
+        except AlreadyBoundError as e:
+            err = e
+            bound_node = podlib.pod_node_name(pod)
         except (AllocationError, ApiError) as e:
             self.bind_failures.inc()
             err = e
         # latency observed BEFORE event emission: the event POST is its own
         # apiserver round-trip and must not skew the BASELINE p50/p99
         self.bind_latency.observe(time.perf_counter() - t0)
+        if isinstance(err, AlreadyBoundError):
+            if bound_node == node:
+                # duplicate delivery (webhook retry / HA replica race lost
+                # to ourselves): the pod is bound exactly as requested —
+                # idempotent success, not a failure
+                log.info("bind %s/%s -> %s: already bound there "
+                         "(duplicate delivery)", ns, name, node)
+                return {"Error": ""}
+            # bound to a DIFFERENT node: real conflict, but the pod IS
+            # scheduled — fail the request without a FailedScheduling event
+            self.bind_failures.inc()
+            log.warning("bind %s/%s -> %s refused: already bound to %s",
+                        ns, name, node, bound_node)
+            return {"Error": str(err)}
         if err is not None:
             log.warning("bind %s/%s -> %s failed: %s", ns, name, node, err)
-            # a duplicate-delivered bind is not a scheduling failure (the
-            # pod IS scheduled): no Warning event for a healthy pod
-            if not isinstance(err, AlreadyBoundError):
-                self._emit_event(
-                    ns, name, uid, "Warning", "FailedScheduling",
-                    f"tpushare bind to {node} failed: {err}")
+            self._emit_event(
+                ns, name, uid, "Warning", "FailedScheduling",
+                f"tpushare bind to {node} failed: {err}")
             return {"Error": str(err)}
         log.info("bind %s/%s -> %s ok", ns, name, node)
         self._emit_event(
