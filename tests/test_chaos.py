@@ -266,6 +266,37 @@ def test_concurrent_bind_storm_under_random_faults():
                 (node["name"], chip["idx"]), 0)
 
 
+def test_concurrent_duplicate_bind_same_pod_single_winner():
+    """Two threads bind the SAME pod concurrently while patch_pod is slow
+    (widening the unlocked apiserver window): exactly one attempt wins,
+    the loser is refused by the in-flight guard, and the loser's rollback
+    must not erase the winner's reservation or annotations."""
+    fc, chaos = chaos_with_node(chips=4, hbm=16000)
+    info = SchedulerCache(chaos).get_node_info("n1")
+    chaos.delay("patch_pod", seconds=0.3, times=None)
+    pod = fc.create_pod(make_pod(hbm=2048, name="dup"))
+
+    outcomes = []
+
+    def attempt():
+        try:
+            outcomes.append(("ok", info.allocate(pod, chaos)))
+        except AllocationError as e:
+            outcomes.append(("err", str(e)))
+
+    with ThreadPoolExecutor(2) as ex:
+        list(ex.map(lambda f: f(), [attempt, attempt]))
+
+    wins = [o for o in outcomes if o[0] == "ok"]
+    errs = [o for o in outcomes if o[0] == "err"]
+    assert len(wins) == 1 and len(errs) == 1, outcomes
+    # winner's state intact: bound, annotated, exactly one pod's HBM used
+    live = fc.get_pod("default", "dup")
+    assert live["spec"]["nodeName"] == "n1"
+    assert contract.chip_ids_from_annotations(live) == wins[0][1].chip_ids
+    assert info.describe()["used_hbm_mib"] == 2048
+
+
 # -- controller resilience ----------------------------------------------------
 
 def test_controller_survives_watch_drops_and_converges():

@@ -1,0 +1,95 @@
+"""Capacity simulator: determinism, invariants, and policy ordering."""
+
+import json
+
+from tpushare.core.topology import MeshTopology
+from tpushare.sim import Fleet, TraceSpec, run_sim, synth_trace
+from tpushare.sim.simulator import _is_contiguous_box
+
+
+def _fleet():
+    return Fleet.homogeneous(2, 16, 16384, (4, 4))
+
+
+def _trace(**kw):
+    return synth_trace(TraceSpec(n_pods=200, arrival_rate=3.0,
+                                 multi_chip_fraction=0.3, seed=42, **kw))
+
+
+def test_trace_is_deterministic():
+    a, b = _trace(), _trace()
+    assert a == b
+    assert len(a) == 200
+    assert all(p.arrival <= q.arrival for p, q in zip(a, a[1:]))
+
+
+def test_run_is_deterministic_and_complete():
+    r1 = run_sim(_fleet(), _trace(), "binpack")
+    r2 = run_sim(_fleet(), _trace(), "binpack")
+    assert r1.to_json() == r2.to_json()
+    assert r1.placed + r1.never_placed == r1.pods
+    assert 0 < r1.util_pct <= 100
+    assert r1.peak_util_pct <= 100
+
+
+def test_fleet_drains_after_run():
+    f = _fleet()
+    run_sim(f, _trace(), "binpack")
+    assert f.used_hbm == 0
+
+
+def test_binpack_never_violates_contiguity_reference_does():
+    rb = run_sim(_fleet(), _trace(), "binpack")
+    rr = run_sim(_fleet(), _trace(), "reference")
+    assert rb.contig_violations == 0
+    assert rr.contig_violations > 0  # scatter breaks topology pins
+
+
+def test_binpack_wins_under_saturation():
+    """Placement policy only moves utilization when the fleet queues; on
+    a saturated single-host trace binpack must beat both alternatives on
+    time-weighted utilization, makespan, and mean wait."""
+    sat = synth_trace(TraceSpec(n_pods=300, arrival_rate=8.0,
+                                mean_duration=60.0,
+                                multi_chip_fraction=0.3, seed=42))
+
+    def saturated(policy):
+        return run_sim(Fleet.homogeneous(1, 16, 16384, (4, 4)), sat, policy)
+
+    rb, rr, rw = (saturated(p) for p in ("binpack", "reference", "worstfit"))
+    assert rb.util_pct > rr.util_pct
+    assert rb.util_pct > rw.util_pct
+    assert rb.makespan < min(rr.makespan, rw.makespan)
+    assert rb.mean_wait < min(rr.mean_wait, rw.mean_wait)
+
+
+def test_underloaded_fleet_utilization_ties_but_frag_differs():
+    """Sanity on the metric itself: with no queueing, util is fixed by
+    the trace (placement can't change when work runs), while
+    fragmentation still reflects placement quality."""
+    rb = run_sim(_fleet(), _trace(), "binpack")
+    rw = run_sim(_fleet(), _trace(), "worstfit")
+    assert abs(rb.util_pct - rw.util_pct) < 1e-6
+    assert rb.frag_time_weighted < rw.frag_time_weighted
+
+
+def test_is_contiguous_box():
+    topo = MeshTopology((4, 4))
+    # chips 0,1,4,5 = rows 0-1 x cols 0-1
+    assert _is_contiguous_box(topo, (0, 1, 4, 5), (2, 2))
+    assert _is_contiguous_box(topo, (5, 4, 1, 0), (2, 2))  # order-free
+    assert not _is_contiguous_box(topo, (0, 1, 4, 8), (2, 2))
+    assert not _is_contiguous_box(topo, (0, 3, 12, 15), (2, 2))  # corners
+    assert _is_contiguous_box(topo, (0, 1, 2, 3), (1, 4))
+    assert not _is_contiguous_box(topo, (0, 1, 2, 3), (4, 1))
+
+
+def test_cli_prints_one_json_per_policy(capsys):
+    from tpushare.sim.__main__ import main
+    assert main(["--nodes", "2", "--chips", "4", "--mesh", "2x2",
+                 "--pods", "50", "--policy", "all"]) == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 3
+    for line in lines:
+        rep = json.loads(line)
+        assert rep["placed"] + rep["never_placed"] == 50

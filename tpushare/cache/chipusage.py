@@ -84,5 +84,16 @@ class ChipUsage:
             return True
         return False
 
+    def remove_reserved(self, uid: str) -> bool:
+        """Remove the entry only while it is still an in-flight
+        reservation — a failed bind's rollback must never evict a
+        CONFIRMED entry for the same UID (written by a concurrent winner)."""
+        e = self._pods.get(uid)
+        if e is not None and e.reserved:
+            del self._pods[uid]
+            self._used -= e.hbm_mib
+            return True
+        return False
+
     def has_pod(self, uid: str) -> bool:
         return uid in self._pods

@@ -90,6 +90,10 @@ class NodeInfo:
         self._lock = threading.RLock()
         self.name = nodelib.node_name(node)
         self._unhealthy: set[int] = set()
+        # pod UIDs with a bind in flight on this node: a concurrent
+        # duplicate bind for the same pod must be refused up front, or the
+        # loser's rollback would erase the winner's live reservation
+        self._inflight: set[str] = set()
         # snapshot cache: scheduling state changes rarely relative to
         # Filter calls (every webhook snapshots every node), so views are
         # rebuilt only when _version moves. Mutators bump _dirty().
@@ -177,6 +181,13 @@ class NodeInfo:
 
         # phase 1: place + reserve (lock held; pure compute, no I/O)
         with self._lock:
+            if uid in self._inflight:
+                # a concurrent duplicate bind for the same pod: letting it
+                # proceed would double-reserve, and its rollback would
+                # erase whatever the first attempt wins
+                raise AllocationError(
+                    f"bind already in flight for {podlib.pod_key(pod)} "
+                    f"on {self.name}")
             views = [c.view(healthy=c.idx not in self._unhealthy)
                      for c in self.chips]
             placement = select_chips(views, self.topology, req)
@@ -186,8 +197,18 @@ class NodeInfo:
             demand = req.chip_demand_mib(self.hbm_per_chip)
             for cid in placement.chip_ids:
                 self.chips[cid].reserve(uid, demand)
+            self._inflight.add(uid)
             self._dirty()
+        try:
+            return self._allocate_io(pod, cluster, now_ns, placement,
+                                     demand, uid, ns, name)
+        finally:
+            with self._lock:
+                self._inflight.discard(uid)
 
+    def _allocate_io(self, pod, cluster, now_ns, placement, demand,
+                     uid, ns, name) -> Placement:
+        """Phases 2-3 of allocate: apiserver writes + confirm/rollback."""
         # phase 2: apiserver writes (no lock held)
         ann = contract.placement_annotations(
             chip_ids=placement.chip_ids,
@@ -221,7 +242,9 @@ class NodeInfo:
         except ApiError as e:
             with self._lock:
                 for cid in placement.chip_ids:
-                    self.chips[cid].remove_pod(uid)
+                    # reserved-only: never evict a confirmed entry for the
+                    # same UID (defense in depth alongside _inflight)
+                    self.chips[cid].remove_reserved(uid)
                 self._dirty()
             if patched:
                 # best-effort: restore the previous annotation state — but

@@ -148,6 +148,7 @@ _pack_cache: "weakref.WeakKeyDictionary" = None  # type: ignore[assignment]
 # one-entry cache of the last fleet's concatenated arrays (benign race:
 # concurrent misses just rebuild)
 _fleet_cache: tuple | None = None
+_warned_no_numpy = False
 
 
 def _node_pack(chips, topo) -> "_NodePack | None":
@@ -213,6 +214,14 @@ def fits_fleet(nodes, req: "PlacementRequest") -> "list[bool]":
     except ImportError:
         # minimal images ship g++ but not numpy: the native single-node
         # selector still works, only the vectorized fleet scan degrades
+        global _warned_no_numpy
+        if not _warned_no_numpy:
+            _warned_no_numpy = True
+            import logging
+            logging.getLogger("tpushare.core.native").warning(
+                "numpy unavailable: fleet Filter runs the per-node Python "
+                "scan (O(nodes) slower at fleet scale); install numpy to "
+                "restore the single-call native path")
         return [fits_py(chips, topo, req) for chips, topo in nodes]
 
     results: list[bool | None] = [None] * len(nodes)

@@ -1,0 +1,18 @@
+"""Capacity-planning simulator for TPU-share fleets.
+
+The reference verifies its binpack behavior with two demo videos
+(README.md:64-70) and nothing else; operators get no way to answer "what
+utilization will MY workload mix reach on N hosts?" before buying them.
+This package answers that offline: a discrete-event simulator drives the
+real placement kernel (:mod:`tpushare.core.placement` — the same code the
+extender serves) over a synthetic or recorded workload trace and reports
+time-weighted utilization, fragmentation, and rejection rates per policy.
+
+CLI: ``python -m tpushare.sim --help``.
+"""
+
+from tpushare.sim.simulator import (
+    POLICIES, Fleet, SimReport, TraceSpec, run_sim, synth_trace)
+
+__all__ = ["POLICIES", "Fleet", "SimReport", "TraceSpec", "run_sim",
+           "synth_trace"]

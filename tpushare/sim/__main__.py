@@ -1,0 +1,60 @@
+"""CLI: compare placement policies on a synthetic workload trace.
+
+    python -m tpushare.sim --nodes 8 --chips 4 --hbm 16384 --mesh 2x2 \
+        --pods 400 --policy all
+
+Prints one JSON object per policy run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpushare.sim.simulator import (
+    POLICIES, Fleet, TraceSpec, run_sim, synth_trace)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpushare-sim")
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--chips", type=int, default=4)
+    ap.add_argument("--hbm", type=int, default=16384,
+                    help="HBM MiB per chip")
+    ap.add_argument("--mesh", default=None,
+                    help='host ICI mesh, e.g. "2x2" (default: 1-D)')
+    ap.add_argument("--pods", type=int, default=400)
+    ap.add_argument("--arrival-rate", type=float, default=2.0)
+    ap.add_argument("--mean-duration", type=float, default=40.0)
+    ap.add_argument("--multi-chip-fraction", type=float, default=0.15)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--policy", default="all",
+                    choices=["all", *POLICIES])
+    args = ap.parse_args(argv)
+
+    mesh = tuple(int(d) for d in args.mesh.split("x")) if args.mesh else None
+    if mesh is not None:
+        n = 1
+        for d in mesh:
+            n *= d
+        if n != args.chips:
+            # a silent mismatch would compare policies on different
+            # geometry (the placement kernel falls back to a 1-D mesh)
+            ap.error(f"--mesh {args.mesh} has {n} chips but --chips is "
+                     f"{args.chips}")
+    spec = TraceSpec(n_pods=args.pods, arrival_rate=args.arrival_rate,
+                     mean_duration=args.mean_duration,
+                     multi_chip_fraction=args.multi_chip_fraction,
+                     seed=args.seed)
+    trace = synth_trace(spec)
+    policies = list(POLICIES) if args.policy == "all" else [args.policy]
+    for policy in policies:
+        fleet = Fleet.homogeneous(args.nodes, args.chips, args.hbm, mesh)
+        report = run_sim(fleet, trace, policy)
+        print(json.dumps(report.to_json()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
