@@ -1,0 +1,85 @@
+"""All-to-all (Ulysses-style) sequence parallelism for attention.
+
+The second of tpushare's two sequence-parallel schemes (the first is
+:mod:`tpushare.workloads.ringattention`). Where ring attention keeps heads
+whole and rotates K/V chunks around the "sp" ring (n-1 ppermute hops,
+O(S/n) residency), the all-to-all scheme re-shards in one collective:
+
+    [B, H, S/n, D]  --all_to_all-->  [B, H/n, S, D]
+
+each device then runs ordinary full-sequence attention over its head
+subset, and a second all_to_all restores the sequence sharding. Two ICI
+collectives total, no per-step pipeline — the better trade when heads are
+plentiful and sequence chunks are small enough that overlapping the ring
+doesn't pay; ring wins when S/n is large or H < n (the scheme requires
+``H % n == 0``).
+
+TPU notes: ``lax.all_to_all(tiled=True)`` lowers to a single ICI
+all-to-all; attention inside runs on the unsharded sequence, so the
+flash/pallas kernel applies unchanged per head subset.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str, causal: bool) -> jax.Array:
+    """Per-shard body under shard_map: q/k/v are local [B, H, S/n, D]."""
+    # heads scatter, sequence gathers: [B, H, S/n, D] -> [B, H/n, S, D]
+    def seq_to_head(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+
+    # ordinary full-sequence attention over the local head subset (fp32
+    # softmax, matching attention_reference numerics)
+    d = qh.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) * (d ** -0.5)
+    if causal:
+        S = qh.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p,
+                   vh.astype(jnp.float32)).astype(q.dtype)
+
+    # restore sequence sharding: [B, H/n, S, D] -> [B, H, S/n, D]
+    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      mesh: jax.sharding.Mesh, axis: str = "sp",
+                      causal: bool = True) -> jax.Array:
+    """Exact attention over [B, H, S, D] with the sequence sharded on
+    ``axis`` via head/sequence all_to_all re-sharding. Requires both
+    ``S`` and ``H`` divisible by the axis size (GQA callers expand K/V
+    heads first, as with ring attention). Jit-compatible; composes with
+    outer dp/tp shardings.
+    """
+    B, H, S, D = q.shape
+    n = mesh.shape[axis]
+    if S % n:
+        raise ValueError(f"seq len {S} not divisible by {axis} size {n}")
+    if H % n:
+        raise ValueError(
+            f"{H} heads not divisible by {axis} size {n}; use ring "
+            "attention when heads are scarcer than shards")
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"q {q.shape} / k {k.shape} / v {v.shape} must match")
+    spec = P(None, None, axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
