@@ -1,0 +1,264 @@
+"""Kubelet gRPC device-plugin tests.
+
+A FakeKubelet (real grpcio server speaking v1beta1.Registration) drives the
+plugin's real gRPC endpoints end to end the way kubelet does on a node:
+Register -> GetDevicePluginOptions -> ListAndWatch stream -> Allocate with
+kubelet-chosen device IDs. This covers the transport the reference's
+sibling plugin serves (/root/reference/docs/designs/designs.md:95-101,
+/root/reference/config/device-plugin-ds.yaml:27-44); the JSON socket in
+transport.py is debug-only.
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from tests.test_deviceplugin import place, rig
+from tpushare import contract
+from tpushare.contract.constants import (
+    ENV_HBM_LIMIT,
+    ENV_MEM_FRACTION,
+    ENV_VISIBLE_CHIPS,
+    RESOURCE_COUNT,
+    RESOURCE_HBM,
+    UNHEALTHY_CM_KEY,
+    UNHEALTHY_CM_NAMESPACE,
+    UNHEALTHY_CM_PREFIX,
+)
+from tpushare.deviceplugin.enumerator import FakeEnumerator
+from tpushare.deviceplugin.grpc_server import (
+    HEALTHY,
+    UNHEALTHY,
+    CountResource,
+    DevicePluginService,
+    FakeKubelet,
+    HBMResource,
+)
+from tpushare.deviceplugin.plugin import DevicePlugin
+
+
+@pytest.fixture
+def plugin_dir(tmp_path):
+    d = tmp_path / "dp"
+    d.mkdir()
+    return str(d)
+
+
+@pytest.fixture
+def stack(plugin_dir):
+    """fake cluster + plugin + fake kubelet + running gRPC service."""
+    fc, plugin = rig(chips=4, hbm=64, mesh="2x2")
+    kubelet = FakeKubelet(plugin_dir)
+    kubelet.start()
+    service = DevicePluginService(plugin, plugin_dir)
+    service.start(kubelet_socket=kubelet.socket_path)
+    yield fc, plugin, kubelet, service
+    service.stop()
+    kubelet.stop()
+
+
+def test_register_and_listandwatch(stack):
+    fc, plugin, kubelet, service = stack
+    assert set(kubelet.registered) == {RESOURCE_HBM, RESOURCE_COUNT}
+    # hbm: one Device per MiB per chip; count: one Device per chip
+    hbm_devs = kubelet.wait_for_devices(RESOURCE_HBM)
+    count_devs = kubelet.wait_for_devices(RESOURCE_COUNT)
+    assert len(hbm_devs) == 4 * 64
+    assert {d.ID for d in count_devs} == {f"chip-{i}" for i in range(4)}
+    assert all(d.health == HEALTHY for d in hbm_devs + count_devs)
+    # both plugins advertise GetPreferredAllocation
+    assert all(o.get_preferred_allocation_available
+               for o in kubelet.options.values())
+
+
+def test_hbm_allocate_end_to_end(stack):
+    fc, plugin, kubelet, service = stack
+    pod = place(fc, "w1", hbm=8)
+    kubelet.wait_for_devices(RESOURCE_HBM)
+
+    resp = kubelet.allocate(RESOURCE_HBM, 8)
+    assert len(resp.container_responses) == 1
+    envs = dict(resp.container_responses[0].envs)
+    assert envs[ENV_HBM_LIMIT] == "8"
+    granted = contract.chip_ids_from_annotations(pod)
+    assert envs[ENV_VISIBLE_CHIPS] == ",".join(str(i) for i in granted)
+    assert float(envs[ENV_MEM_FRACTION]) == pytest.approx(8 / 64, abs=1e-3)
+    # the device passthrough mounts the extender-chosen chip
+    specs = resp.container_responses[0].devices
+    assert [s.host_path for s in specs] == [
+        plugin.chips[i].device_path for i in granted]
+    # runtime handoff completed: assigned flipped to true on the apiserver
+    assert contract.is_assigned(fc.get_pod("default", "w1"))
+
+
+def test_allocate_without_pending_pod_is_not_found(stack):
+    fc, plugin, kubelet, service = stack
+    kubelet.wait_for_devices(RESOURCE_HBM)
+    with pytest.raises(grpc.RpcError) as ei:
+        kubelet.allocate(RESOURCE_HBM, 8)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_count_allocate_exclusive_steered_by_preferred(stack):
+    fc, plugin, kubelet, service = stack
+    pod = place(fc, "excl", hbm=0, count=2)
+    kubelet.wait_for_devices(RESOURCE_COUNT)
+
+    resp = kubelet.allocate(RESOURCE_COUNT, 2)
+    envs = dict(resp.container_responses[0].envs)
+    granted = contract.chip_ids_from_annotations(pod)
+    assert envs[ENV_VISIBLE_CHIPS] == ",".join(str(i) for i in granted)
+    # exclusive pods get the whole chip: no XLA fraction cap
+    assert ENV_MEM_FRACTION not in envs
+    assert contract.is_assigned(fc.get_pod("default", "excl"))
+
+
+def test_count_allocate_noops_for_shared_pod(stack):
+    """A container requesting both tpu-hbm and tpu-count triggers one
+    kubelet Allocate per resource; the count side must not steal or fail
+    the rendezvous owned by the hbm side."""
+    fc, plugin, kubelet, service = stack
+    place(fc, "shared", hbm=8, count=2)
+    kubelet.wait_for_devices(RESOURCE_COUNT)
+
+    resp = kubelet.allocate(RESOURCE_COUNT, 2)  # no-op, not an error
+    assert dict(resp.container_responses[0].envs) == {}
+    assert not contract.is_assigned(fc.get_pod("default", "shared"))
+
+    resp = kubelet.allocate(RESOURCE_HBM, 8)  # the real rendezvous
+    assert dict(resp.container_responses[0].envs)[ENV_HBM_LIMIT] == "8"
+    assert contract.is_assigned(fc.get_pod("default", "shared"))
+
+
+def test_health_change_streams_unhealthy_devices(stack):
+    fc, plugin, kubelet, service = stack
+    kubelet.wait_for_devices(RESOURCE_HBM)
+    # chip 3 vanishes from enumeration
+    plugin._enumerator._chips = 3  # FakeEnumerator: shrink the host
+    missing = service.health_tick()
+    assert missing == {3}
+
+    def chip3_unhealthy(devs):
+        sick = {d.ID for d in devs if d.health == UNHEALTHY}
+        return sick and all(i.startswith("hbm-c3-") for i in sick)
+
+    devs = kubelet.wait_for_devices(RESOURCE_HBM, predicate=chip3_unhealthy)
+    assert sum(d.health == UNHEALTHY for d in devs) == 64
+    count_devs = kubelet.wait_for_devices(
+        RESOURCE_COUNT,
+        predicate=lambda ds: any(d.health == UNHEALTHY for d in ds))
+    assert {d.ID for d in count_devs if d.health == UNHEALTHY} == {"chip-3"}
+    # and the extender-facing configmap was written too
+    cm = fc.get_configmap(UNHEALTHY_CM_NAMESPACE, UNHEALTHY_CM_PREFIX + "n1")
+    assert cm["data"][UNHEALTHY_CM_KEY] == "3"
+
+
+def test_gib_unit_mode(plugin_dir):
+    """unit_mib=1024 is the reference's --memory-unit=GiB deployment mode
+    (device-plugin-ds.yaml:33): the WHOLE stack — node capacity, pod
+    requests, annotations, kubelet device count — is GiB-denominated, and
+    only the container env converts back to real MiB."""
+    from tpushare.k8s import FakeCluster
+
+    fc = FakeCluster()
+    # GiB-denominated cluster: capacity 16 units/chip
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=16, mesh="2x1")
+    enum = FakeEnumerator(2, 16 * 1024, "2x1")  # real chips: 16 GiB HBM
+    plugin = DevicePlugin(fc, "n1", enum, unit_mib=1024)
+    kubelet = FakeKubelet(plugin_dir)
+    kubelet.start()
+    service = DevicePluginService(plugin, plugin_dir)
+    try:
+        service.start(kubelet_socket=kubelet.socket_path)
+        devs = kubelet.wait_for_devices(RESOURCE_HBM)
+        assert len(devs) == 2 * 16
+        # node resource report is unit-denominated too
+        report = plugin.resource_report()
+        assert report["status"]["capacity"][RESOURCE_HBM] == "32"
+        # pod asks for 2 GiB -> kubelet sends 2 device IDs -> env in MiB
+        place(fc, "w1", hbm=2)
+        resp = kubelet.allocate(RESOURCE_HBM, 2)
+        envs = dict(resp.container_responses[0].envs)
+        assert envs[ENV_HBM_LIMIT] == "2048"
+        assert float(envs[ENV_MEM_FRACTION]) == pytest.approx(
+            2048 / 16384, abs=1e-3)
+    finally:
+        service.stop()
+        kubelet.stop()
+
+
+def test_multicontainer_pod_allocates_idempotently(stack):
+    """Kubelet issues one Allocate per container; the second call for the
+    same pod must return the same env, not NOT_FOUND."""
+    fc, plugin, kubelet, service = stack
+    place(fc, "mc", hbm=8)
+    kubelet.wait_for_devices(RESOURCE_HBM)
+    first = kubelet.allocate(RESOURCE_HBM, 8)
+    second = kubelet.allocate(RESOURCE_HBM, 8)  # rematch, no re-patch
+    assert dict(first.container_responses[0].envs) == dict(
+        second.container_responses[0].envs)
+    assert contract.is_assigned(fc.get_pod("default", "mc"))
+
+
+def test_exclusive_allocate_unmatched_count_errors(stack):
+    """A count request no pod explains must fail container start, not
+    silently run without TPUs."""
+    fc, plugin, kubelet, service = stack
+    place(fc, "excl", hbm=0, count=2)
+    kubelet.wait_for_devices(RESOURCE_COUNT)
+    with pytest.raises(grpc.RpcError) as ei:
+        kubelet.allocate(RESOURCE_COUNT, 3)  # pod wants 2, not 3
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_kubelet_restart_reregisters(stack):
+    import os
+
+    fc, plugin, kubelet, service = stack
+    first = dict(kubelet.registered)
+    # kubelet restart wipes the device-plugins dir
+    for s in service.servers:
+        os.unlink(s.socket_path)
+    kubelet.registered.clear()
+
+    stop = threading.Event()
+    t = threading.Thread(
+        target=service.run,
+        kwargs={"stop": stop, "health_interval": 0.05,
+                "kubelet_socket": kubelet.socket_path},
+        daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and set(
+                kubelet.registered) != set(first):
+            time.sleep(0.05)
+        assert set(kubelet.registered) == set(first)
+        # endpoints serve again after the restart
+        pod = place(fc, "after-restart", hbm=4)
+        resp = kubelet.allocate(RESOURCE_HBM, 4)
+        assert dict(resp.container_responses[0].envs)[ENV_HBM_LIMIT] == "4"
+        assert contract.is_assigned(fc.get_pod("default", "after-restart"))
+        del pod
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_hbm_preferred_allocation_fungible():
+    fc, plugin = rig(chips=2, hbm=8, mesh="2x1")
+    res = HBMResource(plugin)
+    got = res.preferred([f"hbm-c0-u{i}" for i in range(8)],
+                        ["hbm-c1-u0"], 3)
+    assert len(got) == 3 and got[0] == "hbm-c1-u0"
+
+
+def test_count_preferred_matches_extender_choice():
+    fc, plugin = rig(chips=4, hbm=64, mesh="2x2")
+    pod = place(fc, "excl", hbm=0, count=2)
+    granted = contract.chip_ids_from_annotations(pod)
+    res = CountResource(plugin)
+    got = res.preferred([f"chip-{i}" for i in range(4)], [], 2)
+    assert got == [f"chip-{i}" for i in granted]

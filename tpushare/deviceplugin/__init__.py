@@ -23,14 +23,17 @@ deterministic, and the chosen pod's UID travels in the response for
 auditability.
 
 Transport: the core logic (:class:`DevicePlugin`) is transport-agnostic.
-A JSON-over-unix-socket server drives it in tests and standalone
-deployments; the kubelet device-plugin gRPC definitions are shipped under
-``protos/`` for the production shim (grpc is not in this image).
+Production serves the kubelet v1beta1 gRPC API (``grpc_server.py`` —
+Registration handshake on kubelet.sock, ListAndWatch device streaming,
+Allocate; wire definitions under ``protos/``); a JSON-over-unix-socket
+server (``transport.py``) remains as a debug surface.
 """
 
 from tpushare.deviceplugin.enumerator import (
     ChipRecord, FakeEnumerator, NativeEnumerator, detect_enumerator)
+from tpushare.deviceplugin.grpc_server import DevicePluginService, FakeKubelet
 from tpushare.deviceplugin.plugin import DevicePlugin
 
 __all__ = ["ChipRecord", "FakeEnumerator", "NativeEnumerator",
-           "detect_enumerator", "DevicePlugin"]
+           "detect_enumerator", "DevicePlugin", "DevicePluginService",
+           "FakeKubelet"]

@@ -1,14 +1,16 @@
 """Device plugin entry point.
 
-Production (on a TPU node, in-cluster):
+Production (on a TPU node, in-cluster — serves the kubelet v1beta1 gRPC
+API and registers on kubelet.sock, like the reference's sibling plugin,
+/root/reference/config/device-plugin-ds.yaml:27-44):
 
     python -m tpushare.deviceplugin --node-name "$NODE_NAME"
 
-Development / hermetic:
+Development / hermetic (no kubelet; JSON debug socket only):
 
     python -m tpushare.deviceplugin --node-name n1 \
         --fake-chips 4 --hbm 16384 --mesh 2x2 \
-        --fake-cluster --socket /tmp/tpushare-dp.sock
+        --fake-cluster --no-kubelet --socket /tmp/tpushare-dp.sock
 """
 
 from __future__ import annotations
@@ -21,6 +23,10 @@ import sys
 import threading
 
 from tpushare.deviceplugin.enumerator import FakeEnumerator, detect_enumerator
+from tpushare.deviceplugin.grpc_server import (
+    DEFAULT_PLUGIN_DIR,
+    DevicePluginService,
+)
 from tpushare.deviceplugin.plugin import DevicePlugin
 from tpushare.deviceplugin.transport import SocketServer
 
@@ -29,8 +35,17 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="tpushare-device-plugin")
     ap.add_argument("--node-name",
                     default=os.environ.get("NODE_NAME", ""))
-    ap.add_argument("--socket",
-                    default="/var/lib/tpushare/device-plugin.sock")
+    ap.add_argument("--plugin-dir", default=DEFAULT_PLUGIN_DIR,
+                    help="kubelet device-plugins dir (kubelet.sock lives "
+                         "here; our endpoints are created in it)")
+    ap.add_argument("--hbm-unit", type=int,
+                    default=int(os.environ.get("TPUSHARE_HBM_UNIT_MIB", "1")),
+                    help="MiB per advertised tpu-hbm device; 1024 = the "
+                         "reference's --memory-unit=GiB mode")
+    ap.add_argument("--no-kubelet", action="store_true",
+                    help="skip the kubelet gRPC endpoints (dev only)")
+    ap.add_argument("--socket", default=None,
+                    help="also serve the JSON debug socket at this path")
     ap.add_argument("--fake-chips", type=int, default=0)
     ap.add_argument("--hbm", type=int, default=16 * 1024,
                     help="per-chip HBM MiB for --fake-chips")
@@ -68,16 +83,16 @@ def main(argv: list[str] | None = None) -> int:
         from tpushare.k8s.incluster import InClusterClient
         cluster = InClusterClient(base_url=args.apiserver)
 
-    plugin = DevicePlugin(cluster, args.node_name, enumerator)
+    plugin = DevicePlugin(cluster, args.node_name, enumerator,
+                          unit_mib=args.hbm_unit)
     plugin.register_node()
 
-    server = SocketServer(plugin, args.socket)
-    server.start()
+    debug_server = None
+    if args.socket:
+        debug_server = SocketServer(plugin, args.socket)
+        debug_server.start()
 
     stop = threading.Event()
-    threading.Thread(target=plugin.health_loop,
-                     args=(stop, args.health_interval),
-                     name="tpushare-dp-health", daemon=True).start()
 
     def on_signal(signum, _frame):
         if stop.is_set():
@@ -86,9 +101,26 @@ def main(argv: list[str] | None = None) -> int:
 
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
-    print(f"tpushare device plugin ready on {args.socket}", flush=True)
-    stop.wait()
-    server.stop()
+
+    service = None
+    if not args.no_kubelet:
+        service = DevicePluginService(plugin, args.plugin_dir)
+        service.start()
+        print(f"tpushare device plugin serving kubelet gRPC in "
+              f"{args.plugin_dir}", flush=True)
+        # blocking loop: health ticks + kubelet-restart re-registration
+        service.run(stop, health_interval=args.health_interval)
+        service.stop()
+    else:
+        threading.Thread(target=plugin.health_loop,
+                         args=(stop, args.health_interval),
+                         name="tpushare-dp-health", daemon=True).start()
+        print("tpushare device plugin ready (no kubelet endpoints)",
+              flush=True)
+        stop.wait()
+
+    if debug_server is not None:
+        debug_server.stop()
     return 0
 
 

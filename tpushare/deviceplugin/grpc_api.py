@@ -1,0 +1,128 @@
+"""Hand-written gRPC service bindings for the kubelet device-plugin API.
+
+grpcio is in the image but grpc_tools (the protoc plugin that would emit
+``*_pb2_grpc.py``) is not, so the service layer is written by hand on top
+of grpcio's generic-handler API. The method paths and message types match
+k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1 exactly (see
+protos/deviceplugin.proto), so these stubs interoperate with a real
+kubelet: the plugin dials kubelet's ``Registration`` service as a client
+and serves ``DevicePlugin`` for kubelet to call back
+(/root/reference/docs/designs/designs.md:95-101).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from tpushare.deviceplugin.protos import deviceplugin_pb2 as pb
+
+REGISTRATION_SERVICE = "v1beta1.Registration"
+DEVICEPLUGIN_SERVICE = "v1beta1.DevicePlugin"
+API_VERSION = "v1beta1"
+
+
+# -- server side --------------------------------------------------------------
+
+def registration_handler(servicer) -> grpc.GenericRpcHandler:
+    """Handler for the Registration service (served by kubelet — in this
+    repo, by the fake kubelet used in tests and by ``k8s/chaos.py``)."""
+    return grpc.method_handlers_generic_handler(
+        REGISTRATION_SERVICE,
+        {
+            "Register": grpc.unary_unary_rpc_method_handler(
+                servicer.Register,
+                request_deserializer=pb.RegisterRequest.FromString,
+                response_serializer=pb.Empty.SerializeToString,
+            ),
+        },
+    )
+
+
+def deviceplugin_handler(servicer) -> grpc.GenericRpcHandler:
+    """Handler for the DevicePlugin service (served by the plugin)."""
+    return grpc.method_handlers_generic_handler(
+        DEVICEPLUGIN_SERVICE,
+        {
+            "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+                servicer.GetDevicePluginOptions,
+                request_deserializer=pb.Empty.FromString,
+                response_serializer=pb.DevicePluginOptions.SerializeToString,
+            ),
+            "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+                servicer.ListAndWatch,
+                request_deserializer=pb.Empty.FromString,
+                response_serializer=pb.ListAndWatchResponse.SerializeToString,
+            ),
+            "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+                servicer.GetPreferredAllocation,
+                request_deserializer=pb.PreferredAllocationRequest.FromString,
+                response_serializer=(
+                    pb.PreferredAllocationResponse.SerializeToString),
+            ),
+            "Allocate": grpc.unary_unary_rpc_method_handler(
+                servicer.Allocate,
+                request_deserializer=pb.AllocateRequest.FromString,
+                response_serializer=pb.AllocateResponse.SerializeToString,
+            ),
+            "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+                servicer.PreStartContainer,
+                request_deserializer=pb.PreStartContainerRequest.FromString,
+                response_serializer=(
+                    pb.PreStartContainerResponse.SerializeToString),
+            ),
+        },
+    )
+
+
+# -- client side --------------------------------------------------------------
+
+class RegistrationStub:
+    """Client the plugin uses to announce itself on kubelet.sock."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        self.Register = channel.unary_unary(
+            f"/{REGISTRATION_SERVICE}/Register",
+            request_serializer=pb.RegisterRequest.SerializeToString,
+            response_deserializer=pb.Empty.FromString,
+        )
+
+
+class DevicePluginStub:
+    """Client kubelet uses against the plugin socket (here: the fake
+    kubelet in tests and the chaos harness)."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        self.GetDevicePluginOptions = channel.unary_unary(
+            f"/{DEVICEPLUGIN_SERVICE}/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString,
+        )
+        self.ListAndWatch = channel.unary_stream(
+            f"/{DEVICEPLUGIN_SERVICE}/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        self.GetPreferredAllocation = channel.unary_unary(
+            f"/{DEVICEPLUGIN_SERVICE}/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            f"/{DEVICEPLUGIN_SERVICE}/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        self.PreStartContainer = channel.unary_unary(
+            f"/{DEVICEPLUGIN_SERVICE}/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString,
+        )
+
+
+def unix_channel(path: str) -> grpc.Channel:
+    # Unlimited receive: MiB-unit ListAndWatch device lists can exceed the
+    # 4 MB default (65k devices on a 4x16GiB host).
+    return grpc.insecure_channel(
+        f"unix://{path}",
+        options=[("grpc.max_send_message_length", -1),
+                 ("grpc.max_receive_message_length", -1)])

@@ -1,0 +1,483 @@
+"""Kubelet-facing gRPC device-plugin endpoints.
+
+This is the transport a real kubelet speaks (the JSON/unix-socket server in
+``transport.py`` remains as a debug surface). It mirrors the reference's
+sibling device plugin (SURVEY §2.9, /root/reference/docs/designs/
+designs.md:57-101, /root/reference/config/device-plugin-ds.yaml:27-44):
+
+- the plugin serves ``v1beta1.DevicePlugin`` on its own socket under the
+  kubelet device-plugins directory and dials kubelet's
+  ``v1beta1.Registration`` on ``kubelet.sock`` to announce itself;
+- ``tpu-hbm`` is advertised as one Device per HBM unit (``hbm-c<chip>-
+  u<n>``), the reference's scalar-to-device-set trick: kubelet derives node
+  capacity from the device count, and an Allocate's ``devicesIDs`` length ×
+  unit is the requested amount, which rendezvouses with the placed pod via
+  the annotation contract (earliest assume-time first, pod.py predicates);
+- ``tpu-count`` is additionally served as one Device per chip
+  (``chip-<idx>``) so whole-chip pods get kubelet-native health and env
+  injection — the reference leaves gpu-count as a bare node patch;
+- kubelet's device choice is advisory: the env the container receives
+  always reflects the chips the *extender* chose at bind time (annotation
+  ``chip-ids``), exactly as the reference ignores kubelet's picks
+  (designs.md:95-101). ``GetPreferredAllocation`` hints kubelet toward the
+  extender's choice so the two views agree when possible.
+
+Unit choice: the default is 1 MiB per device, matching the repo-wide MiB
+contract (constants.py RESOURCE_HBM). Deployments that prefer fewer device
+objects set ``unit_mib=1024`` (the reference's ``--memory-unit=GiB``,
+device-plugin-ds.yaml:33) — pod requests are then denominated in GiB.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent import futures
+from typing import Any
+
+import grpc
+
+from tpushare import contract
+from tpushare.contract.constants import RESOURCE_COUNT, RESOURCE_HBM
+from tpushare.deviceplugin.grpc_api import (
+    API_VERSION,
+    DevicePluginStub,
+    RegistrationStub,
+    deviceplugin_handler,
+    unix_channel,
+)
+from tpushare.deviceplugin.plugin import AllocateError, DevicePlugin
+from tpushare.deviceplugin.protos import deviceplugin_pb2 as pb
+
+log = logging.getLogger("tpushare.deviceplugin.grpc")
+
+KUBELET_SOCKET = "kubelet.sock"
+DEFAULT_PLUGIN_DIR = "/var/lib/kubelet/device-plugins"
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+
+class HBMResource:
+    """tpu-hbm as a device set: one Device per request unit of chip HBM.
+
+    The unit comes from the plugin (``DevicePlugin.unit_mib``) so node
+    capacity, pod requests, annotation amounts, and kubelet's device count
+    all stay in the same denomination: an Allocate's ``devicesIDs`` length
+    IS the requested quantity.
+    """
+
+    def __init__(self, plugin: DevicePlugin) -> None:
+        self.plugin = plugin
+        self.name = RESOURCE_HBM
+
+    def devices(self, unhealthy_chips: set[int]) -> list[pb.Device]:
+        out = []
+        for chip in self.plugin.chips:
+            health = UNHEALTHY if chip.idx in unhealthy_chips else HEALTHY
+            for u in range(chip.hbm_mib // self.plugin.unit_mib):
+                out.append(pb.Device(ID=f"hbm-c{chip.idx}-u{u}",
+                                     health=health))
+        return out
+
+    def allocate(self, devices_ids: list[str]) -> dict[str, Any] | None:
+        return self.plugin.allocate(hbm_mib=len(devices_ids))
+
+    def preferred(self, available: list[str], must_include: list[str],
+                  size: int) -> list[str]:
+        # HBM units are fungible; any subset works. Honor must_include.
+        chosen = list(must_include)
+        for d in available:
+            if len(chosen) >= size:
+                break
+            if d not in chosen:
+                chosen.append(d)
+        return chosen[:size]
+
+
+class CountResource:
+    """tpu-count as a device set: one Device per physical chip."""
+
+    def __init__(self, plugin: DevicePlugin) -> None:
+        self.plugin = plugin
+        self.name = RESOURCE_COUNT
+
+    def devices(self, unhealthy_chips: set[int]) -> list[pb.Device]:
+        return [
+            pb.Device(
+                ID=f"chip-{chip.idx}",
+                health=(UNHEALTHY if chip.idx in unhealthy_chips
+                        else HEALTHY))
+            for chip in self.plugin.chips
+        ]
+
+    def allocate(self, devices_ids: list[str]) -> dict[str, Any] | None:
+        # None (a pending dual-resource pod owns this rendezvous via the
+        # tpu-hbm side) is a deliberate no-op; genuinely unmatched requests
+        # raise (see plugin.allocate_exclusive's resolution order).
+        return self.plugin.allocate_exclusive(count=len(devices_ids))
+
+    def preferred(self, available: list[str], must_include: list[str],
+                  size: int) -> list[str]:
+        # Steer kubelet toward the extender's bind-time chip choice for the
+        # earliest pending exclusive pod of this size.
+        for pod in self.plugin.pending_pods():
+            if contract.pod_hbm_request(pod) != 0:
+                continue
+            ids = contract.chip_ids_from_annotations(pod) or ()
+            if len(ids) == size:
+                want = [f"chip-{i}" for i in ids]
+                if all(w in available or w in must_include for w in want):
+                    return want
+                break
+        chosen = list(must_include)
+        for d in available:
+            if len(chosen) >= size:
+                break
+            if d not in chosen:
+                chosen.append(d)
+        return chosen[:size]
+
+
+class _PluginServicer:
+    """DevicePlugin service implementation for one resource."""
+
+    def __init__(self, resource, stop: threading.Event) -> None:
+        self.resource = resource
+        self._stop = stop
+        self._cond = threading.Condition()
+        self._unhealthy: set[int] = set()
+        self._version = 0
+
+    def set_unhealthy(self, chips: set[int]) -> None:
+        with self._cond:
+            if chips == self._unhealthy:
+                return
+            self._unhealthy = set(chips)
+            self._version += 1
+            self._cond.notify_all()
+
+    # -- rpc methods ----------------------------------------------------------
+
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(
+            pre_start_required=False,
+            get_preferred_allocation_available=True)
+
+    def ListAndWatch(self, request, context):
+        """Initial device list immediately, then a refresh per health change
+        (kubelet keeps this stream open for the plugin's lifetime)."""
+        last_sent: int | None = None
+        while not self._stop.is_set() and context.is_active():
+            with self._cond:
+                if last_sent == self._version:
+                    self._cond.wait(timeout=0.5)
+                    continue
+                version = self._version
+                unhealthy = set(self._unhealthy)
+            yield pb.ListAndWatchResponse(
+                devices=self.resource.devices(unhealthy))
+            last_sent = version
+
+    def GetPreferredAllocation(self, request, context):
+        resp = pb.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            chosen = self.resource.preferred(
+                list(creq.available_deviceIDs),
+                list(creq.must_include_deviceIDs),
+                creq.allocation_size)
+            resp.container_responses.add(deviceIDs=chosen)
+        return resp
+
+    def Allocate(self, request, context):
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            try:
+                result = self.resource.allocate(list(creq.devicesIDs))
+            except AllocateError as e:
+                log.warning("grpc allocate (%s) failed: %s",
+                            self.resource.name, e)
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                return resp  # unreachable; abort raises
+            cresp = resp.container_responses.add()
+            if result is None:
+                continue
+            for k, v in sorted(result["env"].items()):
+                cresp.envs[k] = v
+            for path in result["devices"]:
+                cresp.devices.add(container_path=path, host_path=path,
+                                  permissions="rw")
+        return resp
+
+    def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()
+
+
+class KubeletGRPCServer:
+    """One DevicePlugin endpoint: a gRPC server on a unix socket in the
+    kubelet device-plugins directory, plus the Register call to kubelet."""
+
+    def __init__(self, resource, plugin_dir: str,
+                 endpoint: str | None = None) -> None:
+        self.resource = resource
+        self.plugin_dir = plugin_dir
+        # e.g. "tpushare-tpu-hbm.sock"
+        self.endpoint = endpoint or (
+            "tpushare-" + resource.name.rsplit("/", 1)[-1] + ".sock")
+        self.socket_path = os.path.join(plugin_dir, self.endpoint)
+        self._stop = threading.Event()
+        self.servicer = _PluginServicer(resource, self._stop)
+        self._server: grpc.Server | None = None
+        self.registered = False
+
+    def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix=f"dp-{self.resource.name}"),
+            # MiB-unit device lists are large (65k devices on a 4x16GiB
+            # host); never truncate our own sends. The kubelet side's 4 MB
+            # receive limit is why unit_mib=1024 exists for v5p-class chips.
+            options=[("grpc.max_send_message_length", -1),
+                     ("grpc.max_receive_message_length", -1)])
+        server.add_generic_rpc_handlers((deviceplugin_handler(self.servicer),))
+        server.add_insecure_port(f"unix://{self.socket_path}")
+        server.start()
+        self._server = server
+        log.info("device plugin %s serving on %s",
+                 self.resource.name, self.socket_path)
+
+    def register(self, kubelet_socket: str | None = None) -> None:
+        """Announce this endpoint to kubelet (plugin acts as gRPC client —
+        the handshake in designs.md:95 and device-plugin-ds.yaml:27-44)."""
+        kubelet_socket = kubelet_socket or os.path.join(
+            self.plugin_dir, KUBELET_SOCKET)
+        with unix_channel(kubelet_socket) as channel:
+            RegistrationStub(channel).Register(
+                pb.RegisterRequest(
+                    version=API_VERSION,
+                    endpoint=self.endpoint,
+                    resource_name=self.resource.name,
+                    options=pb.DevicePluginOptions(
+                        get_preferred_allocation_available=True),
+                ),
+                timeout=10.0)
+        self.registered = True
+        log.info("registered %s with kubelet at %s",
+                 self.resource.name, kubelet_socket)
+
+    def set_unhealthy(self, chips: set[int]) -> None:
+        self.servicer.set_unhealthy(chips)
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._stop.set()
+        self.registered = False
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+
+class DevicePluginService:
+    """The full node agent: both resource endpoints + health propagation +
+    kubelet-restart re-registration.
+
+    Health flows two ways, both automated (the reference's configmap is
+    operator-maintained, nodeinfo.go:406-431): ``health_tick`` re-enumerates
+    chips, writes the unhealthy-chip configmap for the extender, and flips
+    the affected Devices to Unhealthy on both ListAndWatch streams so
+    kubelet shrinks node capacity.
+
+    Kubelet restarts are detected the standard way: kubelet wipes its
+    device-plugins dir on restart, so our socket files vanish; ``watch``
+    re-serves and re-registers when that happens.
+    """
+
+    def __init__(self, plugin: DevicePlugin, plugin_dir: str) -> None:
+        self.plugin = plugin
+        self.plugin_dir = plugin_dir
+        self.servers = [
+            KubeletGRPCServer(HBMResource(plugin), plugin_dir),
+            KubeletGRPCServer(CountResource(plugin), plugin_dir),
+        ]
+
+    def start(self, kubelet_socket: str | None = None,
+              register: bool = True) -> None:
+        for s in self.servers:
+            s.start()
+        if register:
+            # Tolerate a kubelet that is still booting: run() retries any
+            # endpoint whose .registered flag is unset, every tick.
+            for s in self.servers:
+                try:
+                    s.register(kubelet_socket)
+                except (grpc.RpcError, OSError) as e:
+                    log.warning("initial register of %s failed (will "
+                                "retry): %s", s.resource.name, e)
+
+    def health_tick(self) -> set[int]:
+        missing = self.plugin.check_health()
+        for s in self.servers:
+            s.set_unhealthy(missing)
+        return missing
+
+    def run(self, stop: threading.Event, health_interval: float = 30.0,
+            kubelet_socket: str | None = None) -> None:
+        """Blocking serve loop: health ticks + kubelet-restart detection."""
+        while not stop.wait(health_interval):
+            try:
+                self.health_tick()
+            except Exception as e:  # noqa: BLE001 — keep the agent alive
+                log.warning("health tick failed: %s", e)
+            for s in self.servers:
+                if not os.path.exists(s.socket_path):
+                    log.warning("socket %s vanished (kubelet restart?); "
+                                "re-serving", s.socket_path)
+                    try:
+                        s.stop(grace=0)
+                        s._stop.clear()
+                        s.start()
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("re-serve failed: %s", e)
+                        continue
+                # Registration retries until it sticks — a restarting
+                # kubelet may not be listening yet when our socket
+                # reappears, and a one-shot attempt would leave the node
+                # without TPU capacity forever.
+                if not s.registered:
+                    try:
+                        s.register(kubelet_socket)
+                    except (grpc.RpcError, OSError) as e:
+                        log.warning("register %s failed (will retry): %s",
+                                    s.resource.name, e)
+
+    def stop(self) -> None:
+        for s in self.servers:
+            s.stop()
+
+
+class FakeKubelet:
+    """A kubelet stand-in for hermetic end-to-end tests: serves Registration
+    on kubelet.sock, then drives each registered plugin the way kubelet does
+    — GetDevicePluginOptions, a background ListAndWatch stream, and
+    Allocate(devicesIDs) picked from the advertised healthy devices."""
+
+    def __init__(self, plugin_dir: str) -> None:
+        self.plugin_dir = plugin_dir
+        self.socket_path = os.path.join(plugin_dir, KUBELET_SOCKET)
+        self.registered: dict[str, str] = {}  # resource -> endpoint
+        self.devices: dict[str, list[pb.Device]] = {}  # resource -> last list
+        self.options: dict[str, pb.DevicePluginOptions] = {}
+        self._server: grpc.Server | None = None
+        self._channels: dict[str, grpc.Channel] = {}
+        self._stubs: dict[str, DevicePluginStub] = {}
+        self._watch_threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._seen = threading.Condition(self._lock)
+
+    # -- Registration service (kubelet side) ----------------------------------
+
+    def Register(self, request, context):
+        if request.version != API_VERSION:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"unsupported version {request.version}")
+        with self._lock:
+            self.registered[request.resource_name] = request.endpoint
+        self._connect(request.resource_name, request.endpoint)
+        return pb.Empty()
+
+    def _connect(self, resource: str, endpoint: str) -> None:
+        channel = unix_channel(os.path.join(self.plugin_dir, endpoint))
+        stub = DevicePluginStub(channel)
+        with self._lock:
+            self._channels[resource] = channel
+            self._stubs[resource] = stub
+        self.options[resource] = stub.GetDevicePluginOptions(
+            pb.Empty(), timeout=5.0)
+        t = threading.Thread(target=self._watch, args=(resource, stub),
+                             name=f"fake-kubelet-watch-{resource}",
+                             daemon=True)
+        t.start()
+        self._watch_threads.append(t)
+
+    def _watch(self, resource: str, stub: DevicePluginStub) -> None:
+        try:
+            for resp in stub.ListAndWatch(pb.Empty()):
+                with self._seen:
+                    self.devices[resource] = list(resp.devices)
+                    self._seen.notify_all()
+                if self._stop.is_set():
+                    return
+        except grpc.RpcError:
+            pass  # plugin went away; kubelet would just drop the resource
+
+    # -- test-driver helpers ---------------------------------------------------
+
+    def wait_for_devices(self, resource: str, timeout: float = 10.0,
+                         predicate=None) -> list[pb.Device]:
+        deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+        with self._seen:
+            ok = self._seen.wait_for(
+                lambda: resource in self.devices and (
+                    predicate is None or predicate(self.devices[resource])),
+                timeout=deadline)
+            if not ok:
+                raise TimeoutError(f"no device list for {resource}")
+            return list(self.devices[resource])
+
+    def healthy_ids(self, resource: str) -> list[str]:
+        with self._lock:
+            return [d.ID for d in self.devices.get(resource, [])
+                    if d.health == HEALTHY]
+
+    def allocate(self, resource: str, n: int,
+                 use_preferred: bool = True) -> pb.AllocateResponse:
+        """Issue an Allocate the way kubelet would for a container
+        requesting ``n`` units of ``resource``."""
+        stub = self._stubs[resource]
+        available = self.healthy_ids(resource)
+        if len(available) < n:
+            raise AllocateError(
+                f"kubelet: {len(available)} healthy {resource} < {n}")
+        chosen = available[:n]
+        if use_preferred and self.options[
+                resource].get_preferred_allocation_available:
+            pref = stub.GetPreferredAllocation(
+                pb.PreferredAllocationRequest(container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=available,
+                        allocation_size=n)]),
+                timeout=5.0)
+            got = list(pref.container_responses[0].deviceIDs)
+            if len(got) == n:
+                chosen = got
+        return stub.Allocate(
+            pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devicesIDs=chosen)]),
+            timeout=5.0)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        from tpushare.deviceplugin.grpc_api import registration_handler
+        server = grpc.server(futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="fake-kubelet"))
+        server.add_generic_rpc_handlers((registration_handler(self),))
+        server.add_insecure_port(f"unix://{self.socket_path}")
+        server.start()
+        self._server = server
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(0.5).wait()
+            self._server = None
+        for ch in self._channels.values():
+            ch.close()
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)

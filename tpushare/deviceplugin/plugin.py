@@ -73,10 +73,24 @@ def _match_amounts(pod) -> set[int]:
 
 
 class DevicePlugin:
-    def __init__(self, cluster, node_name: str, enumerator) -> None:
+    """Transport-agnostic node-agent core.
+
+    ``unit_mib`` denominates the ``tpu-hbm`` resource: pod requests, node
+    capacity, and annotation amounts are all counts of this unit (the
+    reference's ``--memory-unit`` flag, device-plugin-ds.yaml:33). Default 1
+    = MiB, the repo-wide convention; 1024 = GiB, recommended for chips
+    whose per-MiB device list would exceed kubelet's 4 MB gRPC message
+    limit (v5p: 95 GiB/chip). Container env always reports real MiB.
+    """
+
+    def __init__(self, cluster, node_name: str, enumerator,
+                 unit_mib: int = 1) -> None:
+        if unit_mib <= 0:
+            raise ValueError("unit_mib must be positive")
         self._cluster = cluster
         self.node_name = node_name
         self._enumerator = enumerator
+        self.unit_mib = unit_mib
         self._chips = enumerator.enumerate()
         if not self._chips:
             raise RuntimeError("no TPU chips found on this host")
@@ -92,9 +106,9 @@ class DevicePlugin:
     def resource_report(self) -> dict[str, Any]:
         """Node patch advertising the shareable resources + topology label
         (reference reports count x mem via ListAndWatch, designs.md:61-63)."""
-        total_hbm = sum(c.hbm_mib for c in self._chips)
+        total_units = sum(c.hbm_mib // self.unit_mib for c in self._chips)
         resources = {
-            RESOURCE_HBM: str(total_hbm),
+            RESOURCE_HBM: str(total_units),
             RESOURCE_COUNT: str(len(self._chips)),
         }
         return {
@@ -117,10 +131,7 @@ class DevicePlugin:
 
     # -- allocation rendezvous ------------------------------------------------
 
-    def pending_pods(self) -> list[dict[str, Any]]:
-        """This node's placed-but-unassigned tpushare pods, deterministic
-        order (assume-time, then UID — fixes the reference's tie ambiguity,
-        designs.md:97-99)."""
+    def _placed_pods(self, assigned: bool) -> list[dict[str, Any]]:
         out = []
         for pod in self._cluster.list_pods():
             if podlib.pod_node_name(pod) != self.node_name:
@@ -129,55 +140,124 @@ class DevicePlugin:
                 continue
             if contract.chip_ids_from_annotations(pod) is None:
                 continue
-            if contract.is_assigned(pod):
+            if contract.is_assigned(pod) != assigned:
                 continue
             out.append(pod)
         out.sort(key=lambda p: (contract.assume_time_from_annotations(p),
                                 podlib.pod_uid(p)))
         return out
 
+    def pending_pods(self) -> list[dict[str, Any]]:
+        """This node's placed-but-unassigned tpushare pods, deterministic
+        order (assume-time, then UID — fixes the reference's tie ambiguity,
+        designs.md:97-99)."""
+        return self._placed_pods(assigned=False)
+
+    def assigned_pods(self) -> list[dict[str, Any]]:
+        """Placed pods already marked assigned but not yet terminated —
+        the idempotent-rematch pool for multi-container pods and kubelet
+        Allocate retries (see :meth:`allocate`)."""
+        return self._placed_pods(assigned=True)
+
     def allocate(self, hbm_mib: int | None = None,
                  pod_uid: str | None = None) -> dict[str, Any]:
         """Match a container-start request to a placed pod and produce its
         device environment. ``hbm_mib`` is what kubelet's Allocate carries
-        (the container's tpu-hbm limit); ``pod_uid`` short-circuits the
-        amount matching when the caller knows the pod (checkpoint/restart
-        paths and tests)."""
-        candidates = self.pending_pods()
-        chosen = None
-        for pod in candidates:
-            if pod_uid is not None:
-                if podlib.pod_uid(pod) == pod_uid:
-                    chosen = pod
-                    break
-            elif hbm_mib is None or hbm_mib in _match_amounts(pod):
-                chosen = pod
-                break
-        if chosen is None:
-            raise AllocateError(
-                f"no pending pod on {self.node_name} matches "
-                f"hbm={hbm_mib} uid={pod_uid} "
-                f"({len(candidates)} candidates)")
+        (the container's tpu-hbm limit, in request units); ``pod_uid``
+        short-circuits the amount matching when the caller knows the pod
+        (checkpoint/restart paths and tests).
 
+        Matching is two-pass: pending pods first, then already-assigned
+        pods *without* re-patching. The second pass makes Allocate
+        idempotent — kubelet calls once per container, so a multi-container
+        pod's second call must return the same environment rather than
+        NOT_FOUND, and a kubelet retry after a dropped response must
+        succeed.
+        """
+
+        def pick(pods):
+            for pod in pods:
+                if pod_uid is not None:
+                    if podlib.pod_uid(pod) == pod_uid:
+                        return pod
+                elif hbm_mib is None or hbm_mib in _match_amounts(pod):
+                    return pod
+            return None
+
+        candidates = self.pending_pods()
+        chosen = pick(candidates)
+        if chosen is not None:
+            return self._finalize(chosen)
+        rematch = pick(self.assigned_pods())
+        if rematch is not None:
+            return self._finalize(rematch, patch=False)
+        raise AllocateError(
+            f"no pending pod on {self.node_name} matches "
+            f"hbm={hbm_mib} uid={pod_uid} "
+            f"({len(candidates)} candidates)")
+
+    def allocate_exclusive(self, count: int) -> dict[str, Any] | None:
+        """Match a tpu-count (whole-chip, no HBM request) allocation.
+
+        Used by the gRPC tpu-count endpoint: kubelet's devicesIDs length is
+        the requested chip count. Resolution order:
+
+        1. a pending hbm-less (exclusive) pod with ``count`` granted chips
+           — assign it;
+        2. a pending *dual-resource* pod (tpu-hbm + tpu-count) with
+           ``count`` granted chips — return None (no-op): that pod's
+           rendezvous is owned by the tpu-hbm Allocate, and the count call
+           for the same container must not steal or fail it;
+        3. an already-assigned exclusive pod with ``count`` chips — return
+           its environment idempotently (multi-container / kubelet retry);
+        4. otherwise raise, so a genuinely unmatched exclusive container
+           fails container start instead of silently running without TPUs.
+        """
+        for pod in self.pending_pods():
+            if contract.pod_hbm_request(pod) != 0:
+                continue
+            ids = contract.chip_ids_from_annotations(pod) or ()
+            if len(ids) == count:
+                return self._finalize(pod)
+        for pod in self.pending_pods():
+            ids = contract.chip_ids_from_annotations(pod) or ()
+            if contract.pod_hbm_request(pod) != 0 and len(ids) == count:
+                return None
+        for pod in self.assigned_pods():
+            if contract.pod_hbm_request(pod) != 0:
+                continue
+            ids = contract.chip_ids_from_annotations(pod) or ()
+            if len(ids) == count:
+                return self._finalize(pod, patch=False)
+        raise AllocateError(
+            f"no pending exclusive pod on {self.node_name} wants "
+            f"{count} chips")
+
+    def _finalize(self, chosen, patch: bool = True) -> dict[str, Any]:
+        """Build the matched pod's device environment; when ``patch``,
+        also flip it to assigned on the apiserver (skipped for idempotent
+        re-matches of already-assigned pods)."""
         ns, name = podlib.pod_namespace(chosen), podlib.pod_name(chosen)
-        self._cluster.patch_pod(ns, name, contract.assigned_patch())
+        if patch:
+            self._cluster.patch_pod(ns, name, contract.assigned_patch())
 
         ids = contract.chip_ids_from_annotations(chosen) or ()
-        grant = contract.hbm_from_annotations(chosen)
+        grant_units = contract.hbm_from_annotations(chosen)
+        grant_mib = grant_units * self.unit_mib
         chip_total = self._chips[0].hbm_mib if self._chips else 0
         by_idx = {c.idx: c for c in self._chips}
         env = {
             ENV_VISIBLE_CHIPS: ",".join(str(i) for i in ids),
-            ENV_HBM_LIMIT: str(grant),
+            ENV_HBM_LIMIT: str(grant_mib),
             ENV_HBM_CHIP_TOTAL: str(chip_total),
         }
-        if 0 < grant < chip_total:
+        if 0 < grant_mib < chip_total:
             # bound XLA's preallocation to the grant (the analogue of the
             # reference's TF gpu-memory-fraction guidance, userguide.md:67-77)
-            env[ENV_MEM_FRACTION] = f"{grant / chip_total:.4f}"
+            env[ENV_MEM_FRACTION] = f"{grant_mib / chip_total:.4f}"
         devices = [by_idx[i].device_path for i in ids if i in by_idx]
         log.info("allocate: pod %s/%s -> chips %s (%s MiB/chip)",
-                 ns, name, list(ids), grant)
+                 ns, name, list(ids), grant_mib)
         return {
             "pod": {"namespace": ns, "name": name,
                     "uid": podlib.pod_uid(chosen)},

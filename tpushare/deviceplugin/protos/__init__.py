@@ -1,0 +1,10 @@
+"""Kubelet device-plugin v1beta1 wire messages.
+
+``deviceplugin_pb2`` is generated from ``deviceplugin.proto`` by protoc and
+committed (the image has protoc + protobuf runtime but not grpc_tools).
+Regenerate with:
+
+    cd tpushare/deviceplugin/protos && protoc --python_out=. deviceplugin.proto
+"""
+
+from tpushare.deviceplugin.protos import deviceplugin_pb2 as pb  # noqa: F401

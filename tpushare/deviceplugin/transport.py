@@ -1,8 +1,10 @@
-"""JSON-over-unix-socket transport for the device plugin.
+"""JSON-over-unix-socket DEBUG transport for the device plugin.
 
-Stands in for the kubelet device-plugin gRPC endpoint (grpcio is not in
-this image; the wire definitions for the production shim are under
-``protos/``). Protocol: one JSON object per line, one response per request:
+The production transport is the kubelet v1beta1 gRPC endpoint in
+``grpc_server.py``; this line-oriented JSON socket remains for the
+tpushare-inspect tooling and interactive debugging (enable with
+``--socket``). Protocol: one JSON object per line, one response per
+request:
 
     {"method": "allocate", "hbm_mib": 2048}         -> allocate response
     {"method": "allocate", "pod_uid": "..."}        -> allocate response
