@@ -1,0 +1,169 @@
+"""Kubeconfig parsing tests (reference initKubeClient honors KUBECONFIG,
+/root/reference/cmd/main.go:24-38)."""
+
+import base64
+import json
+import os
+import stat
+
+import pytest
+import yaml
+
+from tests.test_contract import make_node
+from tpushare.k8s.incluster import InClusterClient
+from tpushare.k8s.kubeconfig import (
+    KubeconfigError,
+    load_kubeconfig,
+)
+from tpushare.k8s.stubapi import StubApiServer
+
+
+def write_cfg(tmp_path, users, clusters=None, contexts=None, current="c1",
+              name="config"):
+    cfg = {
+        "apiVersion": "v1", "kind": "Config",
+        "current-context": current,
+        "clusters": clusters or [
+            {"name": "cl1", "cluster": {"server": "https://10.0.0.1:6443"}}],
+        "contexts": contexts or [
+            {"name": "c1", "context": {"cluster": "cl1", "user": "u1"}}],
+        "users": users,
+    }
+    p = tmp_path / name
+    p.write_text(yaml.safe_dump(cfg))
+    return str(p)
+
+
+def test_token_user(tmp_path):
+    p = write_cfg(tmp_path, [{"name": "u1", "user": {"token": "tok-abc"}}])
+    auth = load_kubeconfig(p)
+    assert auth.server == "https://10.0.0.1:6443"
+    assert auth.token == "tok-abc"
+    assert auth.headers() == {"Authorization": "Bearer tok-abc"}
+    assert auth.ssl_context is not None  # https => TLS configured
+
+
+def test_token_file_relative_to_kubeconfig_dir(tmp_path):
+    (tmp_path / "tok").write_text("from-file\n")
+    p = write_cfg(tmp_path, [{"name": "u1", "user": {"tokenFile": "tok"}}])
+    assert load_kubeconfig(p).token == "from-file"
+
+
+def test_context_selection_and_missing_context(tmp_path):
+    p = write_cfg(
+        tmp_path,
+        users=[{"name": "u1", "user": {"token": "t1"}},
+               {"name": "u2", "user": {"token": "t2"}}],
+        clusters=[
+            {"name": "cl1", "cluster": {"server": "https://a:6443"}},
+            {"name": "cl2", "cluster": {"server": "https://b:6443"}}],
+        contexts=[
+            {"name": "c1", "context": {"cluster": "cl1", "user": "u1"}},
+            {"name": "c2", "context": {"cluster": "cl2", "user": "u2"}}])
+    auth = load_kubeconfig(p, context="c2")
+    assert auth.server == "https://b:6443" and auth.token == "t2"
+    with pytest.raises(KubeconfigError):
+        load_kubeconfig(p, context="ghost")
+
+
+def test_inline_ca_and_client_cert_data(tmp_path):
+    # self-signed cert+key so load_cert_chain has something real to parse
+    pem_cert, pem_key = _selfsigned()
+    users = [{"name": "u1", "user": {
+        "client-certificate-data": base64.b64encode(pem_cert).decode(),
+        "client-key-data": base64.b64encode(pem_key).decode()}}]
+    clusters = [{"name": "cl1", "cluster": {
+        "server": "https://10.0.0.1:6443",
+        "certificate-authority-data": base64.b64encode(pem_cert).decode()}}]
+    p = write_cfg(tmp_path, users, clusters=clusters)
+    auth = load_kubeconfig(p)
+    assert auth.token is None
+    assert auth.ssl_context is not None
+    assert auth.headers() == {}
+
+
+def test_insecure_skip_tls_verify(tmp_path):
+    clusters = [{"name": "cl1", "cluster": {
+        "server": "https://10.0.0.1:6443",
+        "insecure-skip-tls-verify": True}}]
+    p = write_cfg(tmp_path, [{"name": "u1", "user": {"token": "t"}}],
+                  clusters=clusters)
+    ctx = load_kubeconfig(p).ssl_context
+    import ssl
+    assert ctx.verify_mode == ssl.CERT_NONE and not ctx.check_hostname
+
+
+def test_exec_credential_plugin(tmp_path):
+    helper = tmp_path / "helper.sh"
+    helper.write_text(
+        "#!/bin/sh\n"
+        'echo \'{"apiVersion":"client.authentication.k8s.io/v1",'
+        '"kind":"ExecCredential","status":{"token":"exec-tok"}}\'\n')
+    helper.chmod(helper.stat().st_mode | stat.S_IEXEC)
+    users = [{"name": "u1", "user": {"exec": {
+        "apiVersion": "client.authentication.k8s.io/v1",
+        "command": str(helper), "args": [], "env": []}}}]
+    p = write_cfg(tmp_path, users)
+    assert load_kubeconfig(p).token == "exec-tok"
+
+
+def test_exec_plugin_failure_raises(tmp_path):
+    users = [{"name": "u1", "user": {"exec": {
+        "command": "/nonexistent-helper-xyz"}}}]
+    p = write_cfg(tmp_path, users)
+    with pytest.raises(KubeconfigError):
+        load_kubeconfig(p)
+
+
+def test_basic_auth_user(tmp_path):
+    p = write_cfg(tmp_path, [{"name": "u1", "user": {
+        "username": "admin", "password": "pw"}}])
+    auth = load_kubeconfig(p)
+    expected = base64.b64encode(b"admin:pw").decode()
+    assert auth.headers() == {"Authorization": f"Basic {expected}"}
+
+
+def test_kubeconfig_env_fallback(tmp_path, monkeypatch):
+    p = write_cfg(tmp_path, [{"name": "u1", "user": {"token": "env-tok"}}])
+    monkeypatch.setenv("KUBECONFIG", p)
+    assert load_kubeconfig().token == "env-tok"
+    monkeypatch.setenv("KUBECONFIG", str(tmp_path / "missing"))
+    with pytest.raises(KubeconfigError):
+        load_kubeconfig()
+
+
+def test_client_from_kubeconfig_against_stub(tmp_path, monkeypatch):
+    """End to end: a kubeconfig-built client authenticates to the stub
+    apiserver with its bearer token."""
+    stub = StubApiServer(token="kc-tok").start()
+    try:
+        clusters = [{"name": "cl1", "cluster": {"server": stub.base_url}}]
+        p = write_cfg(tmp_path, [{"name": "u1", "user": {"token": "kc-tok"}}],
+                      clusters=clusters)
+        monkeypatch.setenv("KUBECONFIG", p)
+        client = InClusterClient.autodetect()
+        stub.seed("nodes", make_node("n1"))
+        assert client.get_node("n1")["metadata"]["name"] == "n1"
+    finally:
+        stub.stop()
+
+
+def _selfsigned():
+    """Generate a throwaway self-signed cert+key PEM pair via openssl if
+    available, else skip."""
+    import subprocess
+    import tempfile
+    d = tempfile.mkdtemp()
+    cert, key = os.path.join(d, "c.pem"), os.path.join(d, "k.pem")
+    try:
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "1", "-subj",
+             "/CN=test"], capture_output=True, check=True, timeout=60)
+    except (OSError, subprocess.SubprocessError):
+        pytest.skip("openssl unavailable for self-signed cert generation")
+    with open(cert, "rb") as f:
+        pem_cert = f.read()
+    with open(key, "rb") as f:
+        pem_key = f.read()
+    return pem_cert, pem_key

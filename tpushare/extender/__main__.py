@@ -54,6 +54,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="run against an in-memory cluster: 'n1:4x16000:2x2,...'")
     ap.add_argument("--apiserver", default=None,
                     help="explicit apiserver base URL (e.g. kubectl proxy)")
+    ap.add_argument("--kubeconfig", default=None,
+                    help="out-of-cluster kubeconfig path (default: "
+                         "$KUBECONFIG, else in-cluster SA; reference "
+                         "cmd/main.go:24-38)")
     ap.add_argument("--workers", type=int,
                     default=int(os.environ.get("THREADNESS", "1")))
     ap.add_argument("--ha", action="store_true",
@@ -73,7 +77,10 @@ def main(argv: list[str] | None = None) -> int:
         log.info("running with FakeCluster: %s", args.fake_nodes)
     else:
         from tpushare.k8s.incluster import InClusterClient
-        cluster = InClusterClient(base_url=args.apiserver)
+        if args.apiserver:
+            cluster = InClusterClient(base_url=args.apiserver)
+        else:
+            cluster = InClusterClient.autodetect(kubeconfig=args.kubeconfig)
 
     # (native engine warmup happens inside ExtenderServer start/serve)
     cache = SchedulerCache(cluster)

@@ -1,10 +1,14 @@
 """Real apiserver client over stdlib http.client (no external deps).
 
 Replaces the reference's client-go usage (cmd/main.go:32-50 builds a
-clientset from kubeconfig or in-cluster config). Only the in-cluster path is
-implemented — the extender and device plugin both run as cluster workloads
-(config/tpushare-schd-extender.yaml) — plus an explicit base-URL/token mode
-for development against `kubectl proxy`.
+clientset from kubeconfig or in-cluster config). Construction paths, same
+precedence as the reference's initKubeClient (cmd/main.go:24-38):
+
+- :meth:`InClusterClient.autodetect` — ``--kubeconfig`` flag, else
+  ``$KUBECONFIG``, else the pod's in-cluster service account;
+- :meth:`InClusterClient.from_kubeconfig` — out-of-cluster dev flow
+  (token / client-cert / exec-plugin auth, see k8s/kubeconfig.py);
+- explicit ``base_url``/``token`` for development against `kubectl proxy`.
 
 Watches use the apiserver's streaming JSON-lines protocol
 (`?watch=true&resourceVersion=...`) and reconnect from the server's current
@@ -15,6 +19,7 @@ is the anti-entropy mechanism that reconciles them.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import socket
@@ -31,7 +36,11 @@ SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 class InClusterClient:
     def __init__(self, base_url: str | None = None, token: str | None = None,
-                 ca_file: str | None = None, timeout: float = 10.0) -> None:
+                 ca_file: str | None = None, timeout: float = 10.0,
+                 token_file: str | None = None,
+                 ssl_context: ssl.SSLContext | None = None,
+                 extra_headers: dict[str, str] | None = None) -> None:
+        self._extra_headers = dict(extra_headers or {})
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
@@ -41,16 +50,41 @@ class InClusterClient:
                     "pass base_url explicitly")
             base_url = f"https://{host}:{port}"
         self.base_url = base_url.rstrip("/")
-        self._token_file = os.path.join(SA_DIR, "token")
+        self._token_file = token_file or os.path.join(SA_DIR, "token")
         self._token = token
         self.timeout = timeout
         ca = ca_file or os.path.join(SA_DIR, "ca.crt")
-        if self.base_url.startswith("https") and os.path.exists(ca):
-            self._ctx: ssl.SSLContext | None = ssl.create_default_context(cafile=ca)
+        if ssl_context is not None:
+            self._ctx: ssl.SSLContext | None = ssl_context
+        elif self.base_url.startswith("https") and os.path.exists(ca):
+            self._ctx = ssl.create_default_context(cafile=ca)
         elif self.base_url.startswith("https"):
             self._ctx = ssl.create_default_context()
         else:
             self._ctx = None
+
+    @classmethod
+    def from_kubeconfig(cls, path: str | None = None,
+                        context: str | None = None,
+                        timeout: float = 10.0) -> "InClusterClient":
+        """Out-of-cluster construction from a kubeconfig — the reference's
+        dev flow (initKubeClient honors KUBECONFIG before in-cluster
+        config, /root/reference/cmd/main.go:24-38)."""
+        from tpushare.k8s.kubeconfig import load_kubeconfig
+        auth = load_kubeconfig(path, context)
+        return cls(base_url=auth.server, token=auth.token,
+                   ssl_context=auth.ssl_context, timeout=timeout,
+                   extra_headers=(
+                       {} if auth.token else auth.headers()))
+
+    @classmethod
+    def autodetect(cls, kubeconfig: str | None = None,
+                   timeout: float = 10.0) -> "InClusterClient":
+        """kubeconfig flag > $KUBECONFIG > in-cluster SA, matching the
+        reference's initKubeClient precedence (cmd/main.go:24-38)."""
+        if kubeconfig or os.environ.get("KUBECONFIG"):
+            return cls.from_kubeconfig(kubeconfig, timeout=timeout)
+        return cls(timeout=timeout)
 
     # -- plumbing ------------------------------------------------------------
 
@@ -60,7 +94,10 @@ class InClusterClient:
             # re-read every request: kubelet rotates projected SA tokens
             with open(self._token_file) as f:
                 token = f.read().strip()
-        return {"Authorization": f"Bearer {token}"} if token else {}
+        headers = dict(self._extra_headers)
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        return headers
 
     def _request(self, method: str, path: str, body: Any = None,
                  content_type: str = "application/json",
@@ -118,6 +155,13 @@ class InClusterClient:
         return self._json(
             "PATCH", f"/api/v1/namespaces/{namespace}/pods/{name}", patch,
             content_type="application/strategic-merge-patch+json")
+
+    def replace_pod(self, namespace: str, name: str,
+                    pod: dict[str, Any]) -> dict[str, Any]:
+        """PUT with metadata.resourceVersion = apiserver-side CAS (409 on
+        conflict) — used by the device plugin's stale-placement reclaim."""
+        return self._json(
+            "PUT", f"/api/v1/namespaces/{namespace}/pods/{name}", pod)
 
     def bind_pod(self, namespace: str, name: str, node: str,
                  uid: str | None = None) -> None:
@@ -224,10 +268,12 @@ class InClusterClient:
                         rv = ""  # 410 Gone et al: restart from fresh list
                         break
                     yield WatchEvent(etype, obj)
-            except OSError:
+            except (OSError, http.client.HTTPException):
                 # mid-stream timeout/reset (incl. the 300 s idle timeout on
                 # quiet clusters): reconnect from the last seen rv; the
-                # controller resync reconciles anything missed in the gap
+                # controller resync reconciles anything missed in the gap.
+                # An abrupt close of a chunked stream surfaces as
+                # http.client.IncompleteRead (HTTPException), not OSError.
                 if stop.wait(1.0):
                     return
             finally:

@@ -1,0 +1,157 @@
+"""Kubeconfig parsing: the out-of-cluster auth path.
+
+The reference's ``initKubeClient`` honors ``KUBECONFIG`` before falling
+back to in-cluster config (/root/reference/cmd/main.go:24-38, client-go
+``BuildConfigFromFlags``); this module gives :class:`InClusterClient` the
+same dev flow. Supported: ``current-context`` resolution, cluster
+``server`` / ``certificate-authority[-data]`` /
+``insecure-skip-tls-verify``, user ``token[-file]`` /
+``client-certificate[-data]`` + ``client-key[-data]`` / basic-auth, and
+``exec`` credential plugins (ExecCredential v1/v1beta1, token only).
+Exotic auth providers (gcp/oidc helpers) are out of scope, like most
+non-client-go clients.
+
+Kubeconfig is YAML, but PyYAML is in this image so no hand-rolled parser
+is needed.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import ssl
+import subprocess
+import tempfile
+from typing import Any
+
+import yaml
+
+
+class KubeconfigError(Exception):
+    pass
+
+
+def _by_name(items: list[dict[str, Any]], name: str, what: str,
+             payload: str) -> dict[str, Any]:
+    for item in items or []:
+        if item.get("name") == name:
+            return item.get(payload) or {}
+    raise KubeconfigError(f"{what} {name!r} not found in kubeconfig")
+
+
+def _materialize(data_b64: str | None, path: str | None,
+                 base_dir: str) -> str | None:
+    """Inline ``*-data`` wins over file paths (client-go precedence); data
+    is written to a temp file because ssl wants filenames."""
+    if data_b64:
+        f = tempfile.NamedTemporaryFile(
+            mode="wb", suffix=".pem", delete=False)
+        f.write(base64.b64decode(data_b64))
+        f.close()
+        return f.name
+    if path:
+        return path if os.path.isabs(path) else os.path.join(base_dir, path)
+    return None
+
+
+def _exec_token(spec: dict[str, Any], base_dir: str) -> str:
+    """Run an ExecCredential plugin and extract status.token."""
+    cmd = [spec["command"], *(spec.get("args") or [])]
+    env = dict(os.environ)
+    for e in spec.get("env") or []:
+        env[e["name"]] = e.get("value", "")
+    env["KUBERNETES_EXEC_INFO"] = json.dumps({
+        "apiVersion": spec.get("apiVersion",
+                               "client.authentication.k8s.io/v1"),
+        "kind": "ExecCredential", "spec": {"interactive": False}})
+    try:
+        out = subprocess.run(cmd, env=env, cwd=base_dir, capture_output=True,
+                             text=True, timeout=30, check=True).stdout
+        cred = json.loads(out)
+        return (cred.get("status") or {})["token"]
+    except (OSError, subprocess.SubprocessError, ValueError, KeyError) as e:
+        raise KubeconfigError(f"exec credential plugin failed: {e}") from None
+
+
+class KubeconfigAuth:
+    """Resolved connection parameters for one kubeconfig context."""
+
+    def __init__(self, server: str, token: str | None = None,
+                 ssl_context: ssl.SSLContext | None = None,
+                 basic: tuple[str, str] | None = None) -> None:
+        self.server = server
+        self.token = token
+        self.ssl_context = ssl_context
+        self.basic = basic
+
+    def headers(self) -> dict[str, str]:
+        if self.token:
+            return {"Authorization": f"Bearer {self.token}"}
+        if self.basic:
+            cred = base64.b64encode(
+                f"{self.basic[0]}:{self.basic[1]}".encode()).decode()
+            return {"Authorization": f"Basic {cred}"}
+        return {}
+
+
+def load_kubeconfig(path: str | None = None,
+                    context: str | None = None) -> KubeconfigAuth:
+    """Parse a kubeconfig into connection parameters.
+
+    ``path`` defaults to ``$KUBECONFIG`` (first entry if a list) then
+    ``~/.kube/config``; ``context`` defaults to ``current-context``.
+    """
+    if path is None:
+        env = os.environ.get("KUBECONFIG", "")
+        path = env.split(os.pathsep)[0] if env else \
+            os.path.expanduser("~/.kube/config")
+    if not os.path.exists(path):
+        raise KubeconfigError(f"kubeconfig not found: {path}")
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    base_dir = os.path.dirname(os.path.abspath(path))
+
+    ctx_name = context or cfg.get("current-context")
+    if not ctx_name:
+        raise KubeconfigError("no context selected (current-context unset)")
+    ctx = _by_name(cfg.get("contexts"), ctx_name, "context", "context")
+    cluster = _by_name(cfg.get("clusters"), ctx.get("cluster", ""),
+                       "cluster", "cluster")
+    user = _by_name(cfg.get("users"), ctx.get("user", ""), "user", "user") \
+        if ctx.get("user") else {}
+
+    server = cluster.get("server")
+    if not server:
+        raise KubeconfigError(f"cluster {ctx.get('cluster')!r} has no server")
+
+    ssl_ctx: ssl.SSLContext | None = None
+    if server.startswith("https"):
+        ca = _materialize(cluster.get("certificate-authority-data"),
+                          cluster.get("certificate-authority"), base_dir)
+        ssl_ctx = ssl.create_default_context(cafile=ca)
+        if cluster.get("insecure-skip-tls-verify"):
+            ssl_ctx.check_hostname = False
+            ssl_ctx.verify_mode = ssl.CERT_NONE
+        cert = _materialize(user.get("client-certificate-data"),
+                            user.get("client-certificate"), base_dir)
+        key = _materialize(user.get("client-key-data"),
+                           user.get("client-key"), base_dir)
+        if cert:
+            ssl_ctx.load_cert_chain(cert, key)
+
+    token = user.get("token")
+    if not token and user.get("tokenFile"):
+        tf = user["tokenFile"]
+        tf = tf if os.path.isabs(tf) else os.path.join(base_dir, tf)
+        with open(tf) as f:
+            token = f.read().strip()
+    if not token and user.get("exec"):
+        token = _exec_token(user["exec"], base_dir)
+
+    basic = None
+    if not token and user.get("username"):
+        basic = (user["username"], user.get("password", ""))
+
+    return KubeconfigAuth(server=server, token=token, ssl_context=ssl_ctx,
+                          basic=basic)

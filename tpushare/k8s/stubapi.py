@@ -1,0 +1,452 @@
+"""A stub kube-apiserver speaking the real wire format, for integration
+tests and off-cluster development.
+
+``InClusterClient`` (incluster.py) is the one component that talks to a
+real apiserver — the analogue of the reference's client-go usage
+(/root/reference/cmd/main.go:32-50) — and its failure modes live in the
+wire protocol: chunked watch streams, BOOKMARK events, 410-Gone watch
+restarts, mid-stream disconnects, strategic-merge PATCH semantics, the
+pods/binding subresource, lease optimistic concurrency, and bearer-token
+rotation. This server implements exactly those behaviors over stdlib
+http.server so the client (and the cache/controller/extender stack above
+it) can be driven against them hermetically, with fault-injection knobs:
+
+- ``inject_bookmark()``          — send a BOOKMARK to live pod watches
+- ``gone_on_next_watch()``       — next watch connect gets ERROR 410
+- ``drop_watch_connections()``   — abruptly reset live watch sockets
+- ``close_watch_after(n)``       — end each watch stream after n events
+
+State is apiserver-like: every write bumps a global resourceVersion,
+appends to a bounded event history, and wakes watchers; a watch from an
+rv older than history start gets 410 (compaction), matching apiserver
+semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from tpushare.k8s.client import strategic_merge
+
+HISTORY_LIMIT = 4096
+
+
+def _status(code: int, reason: str, message: str) -> dict[str, Any]:
+    return {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": reason, "message": message, "code": code}
+
+
+class _State:
+    """Object stores + watch event history, RWLock-free (one big lock —
+    this is a test double, not a production server)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Condition()
+        self.rv = 100  # arbitrary non-zero start, like a real cluster
+        # kind -> {key: obj}; keys are "ns/name" or "name" for nodes
+        self.objects: dict[str, dict[str, dict[str, Any]]] = {
+            "pods": {}, "nodes": {}, "configmaps": {}, "leases": {},
+            "events": {},
+        }
+        # (rv, kind, type, obj) in commit order
+        self.history: list[tuple[int, str, str, dict[str, Any]]] = []
+        self.history_start = 101  # rv of the oldest retained event + 1
+
+    def commit(self, kind: str, etype: str, obj: dict[str, Any],
+               key: str) -> dict[str, Any]:
+        """Record a write: bump rv, stamp it on the object, append to the
+        watch history, wake watchers. Caller holds the lock."""
+        self.rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        if etype == "DELETED":
+            self.objects[kind].pop(key, None)
+        else:
+            self.objects[kind][key] = obj
+        self.history.append((self.rv, kind, etype, json.loads(json.dumps(obj))))
+        if len(self.history) > HISTORY_LIMIT:
+            drop = len(self.history) - HISTORY_LIMIT
+            self.history_start = self.history[drop][0]
+            del self.history[:drop]
+        self.lock.notify_all()
+        return obj
+
+
+class StubApiServer:
+    def __init__(self, token: str | None = None) -> None:
+        self.state = _State()
+        self.token = token  # None = no auth required
+        self._fault_lock = threading.Lock()
+        self._gone_next_watch = 0
+        self._close_after_events: int | None = None
+        self._live_watch_sockets: list[socket.socket] = []
+        self._bookmark_seq = 0
+        state = self.state
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            # -- helpers -------------------------------------------------------
+
+            def _send_json(self, code: int, obj: dict[str, Any]) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _fail(self, code: int, reason: str, message: str) -> None:
+                self._send_json(code, _status(code, reason, message))
+
+            def _body(self) -> dict[str, Any]:
+                n = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _authed(self) -> bool:
+                if stub.token is None:
+                    return True
+                if self.headers.get("Authorization") == f"Bearer {stub.token}":
+                    return True
+                self._fail(401, "Unauthorized", "bad or missing bearer token")
+                return False
+
+            def _route(self):
+                """Parse path into (kind, namespace, name, subresource)."""
+                path = self.path.split("?", 1)[0].strip("/")
+                parts = path.split("/")
+                # /api/v1/... or /apis/coordination.k8s.io/v1/...
+                if parts[:2] == ["api", "v1"]:
+                    rest = parts[2:]
+                elif parts[:3] == ["apis", "coordination.k8s.io", "v1"]:
+                    rest = parts[3:]
+                else:
+                    return None
+                if not rest:
+                    return None
+                if rest[0] == "namespaces" and len(rest) >= 3:
+                    ns, kind = rest[1], rest[2]
+                    name = rest[3] if len(rest) > 3 else ""
+                    sub = rest[4] if len(rest) > 4 else ""
+                    return kind, ns, name, sub
+                kind = rest[0]
+                name = rest[1] if len(rest) > 1 else ""
+                sub = rest[2] if len(rest) > 2 else ""
+                return kind, "", name, sub
+
+            def _query(self) -> dict[str, str]:
+                if "?" not in self.path:
+                    return {}
+                out = {}
+                for kv in self.path.split("?", 1)[1].split("&"):
+                    k, _, v = kv.partition("=")
+                    out[k] = v
+                return out
+
+            @staticmethod
+            def _key(kind, ns, name):
+                return f"{ns}/{name}" if ns else name
+
+            # -- verbs ---------------------------------------------------------
+
+            def do_GET(self):
+                if not self._authed():
+                    return
+                route = self._route()
+                if route is None:
+                    return self._fail(404, "NotFound", self.path)
+                kind, ns, name, _sub = route
+                if kind not in state.objects:
+                    return self._fail(404, "NotFound", kind)
+                q = self._query()
+                if q.get("watch") == "true" and not name:
+                    return self._watch(kind, q)
+                with state.lock:
+                    if name:
+                        obj = state.objects[kind].get(self._key(kind, ns, name))
+                        if obj is None:
+                            return self._fail(404, "NotFound",
+                                              f"{kind} {ns}/{name}")
+                        return self._send_json(200, obj)
+                    items = [o for k, o in sorted(state.objects[kind].items())
+                             if not ns or k.startswith(f"{ns}/")]
+                    return self._send_json(200, {
+                        "kind": "List", "items": items,
+                        "metadata": {"resourceVersion": str(state.rv)}})
+
+            def do_PATCH(self):
+                if not self._authed():
+                    return
+                route = self._route()
+                if route is None:
+                    return self._fail(404, "NotFound", self.path)
+                kind, ns, name, sub = route
+                ct = self.headers.get("Content-Type", "")
+                if ct != "application/strategic-merge-patch+json":
+                    return self._fail(415, "UnsupportedMediaType", ct)
+                patch = self._body()
+                key = self._key(kind, ns, name)
+                with state.lock:
+                    obj = state.objects.get(kind, {}).get(key)
+                    if obj is None:
+                        return self._fail(404, "NotFound", f"{kind} {key}")
+                    # /status patches touch only status in real k8s; the
+                    # merge itself is identical
+                    merged = strategic_merge(obj, patch)
+                    merged = state.commit(kind, "MODIFIED", merged, key)
+                    return self._send_json(200, merged)
+
+            def do_POST(self):
+                if not self._authed():
+                    return
+                route = self._route()
+                if route is None:
+                    return self._fail(404, "NotFound", self.path)
+                kind, ns, name, sub = route
+                body = self._body()
+                if kind == "pods" and sub == "binding":
+                    return self._bind(ns, name, body)
+                if kind == "events":
+                    with state.lock:
+                        key = f"{ns}/ev-{state.rv}"
+                        state.commit("events", "ADDED", body, key)
+                    return self._send_json(201, body)
+                # generic create (configmaps, leases, pods in tests)
+                if kind not in state.objects:
+                    return self._fail(404, "NotFound", kind)
+                meta = body.setdefault("metadata", {})
+                meta.setdefault("namespace", ns)
+                key = self._key(kind, ns, meta.get("name", ""))
+                with state.lock:
+                    if key in state.objects[kind]:
+                        return self._fail(409, "AlreadyExists", key)
+                    out = state.commit(kind, "ADDED", body, key)
+                    return self._send_json(201, out)
+
+            def do_PUT(self):
+                if not self._authed():
+                    return
+                route = self._route()
+                if route is None:
+                    return self._fail(404, "NotFound", self.path)
+                kind, ns, name, _sub = route
+                body = self._body()
+                key = self._key(kind, ns, name)
+                with state.lock:
+                    cur = state.objects.get(kind, {}).get(key)
+                    if cur is None:
+                        return self._fail(404, "NotFound", f"{kind} {key}")
+                    want_rv = (body.get("metadata") or {}).get(
+                        "resourceVersion")
+                    have_rv = (cur.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if want_rv is not None and want_rv != have_rv:
+                        # the optimistic-concurrency CAS leases rely on
+                        return self._fail(
+                            409, "Conflict",
+                            f"resourceVersion {want_rv} != {have_rv}")
+                    body.setdefault("metadata", {}).setdefault(
+                        "namespace", ns)
+                    out = state.commit(kind, "MODIFIED", body, key)
+                    return self._send_json(200, out)
+
+            def do_DELETE(self):
+                if not self._authed():
+                    return
+                route = self._route()
+                if route is None:
+                    return self._fail(404, "NotFound", self.path)
+                kind, ns, name, _sub = route
+                key = self._key(kind, ns, name)
+                with state.lock:
+                    obj = state.objects.get(kind, {}).get(key)
+                    if obj is None:
+                        return self._fail(404, "NotFound", key)
+                    state.commit(kind, "DELETED", obj, key)
+                    return self._send_json(200, obj)
+
+            # -- subresources --------------------------------------------------
+
+            def _bind(self, ns, name, body):
+                """pods/binding: the verb the scheduler delegates to the
+                extender (reference nodeinfo.go:226-239)."""
+                key = f"{ns}/{name}"
+                node = ((body.get("target") or {}).get("name")) or ""
+                uid = (body.get("metadata") or {}).get("uid")
+                with state.lock:
+                    pod = state.objects["pods"].get(key)
+                    if pod is None:
+                        return self._fail(404, "NotFound", key)
+                    pod_uid = (pod.get("metadata") or {}).get("uid")
+                    if uid and pod_uid and uid != pod_uid:
+                        return self._fail(409, "Conflict",
+                                          f"uid {uid} != {pod_uid}")
+                    if (pod.get("spec") or {}).get("nodeName"):
+                        return self._fail(409, "Conflict",
+                                          "pod already bound")
+                    pod = json.loads(json.dumps(pod))
+                    pod.setdefault("spec", {})["nodeName"] = node
+                    state.commit("pods", "MODIFIED", pod, key)
+                return self._send_json(201, _status(201, "Created", "bound"))
+
+            # -- watch ---------------------------------------------------------
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            def _watch(self, kind: str, q: dict[str, str]) -> None:
+                with stub._fault_lock:
+                    gone = stub._gone_next_watch > 0
+                    if gone:
+                        stub._gone_next_watch -= 1
+                    close_after = stub._close_after_events
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                if gone:
+                    self._chunk(json.dumps(
+                        {"type": "ERROR",
+                         "object": _status(410, "Expired",
+                                           "too old resource version")}
+                    ).encode() + b"\n")
+                    self._chunk(b"")  # clean end-of-stream
+                    return
+                rv = int(q.get("resourceVersion") or state.rv)
+                with stub._fault_lock:
+                    stub._live_watch_sockets.append(self.connection)
+                sent = 0
+                last_bookmark = stub._bookmark_seq  # only future injections
+                try:
+                    while True:
+                        bookmark = None
+                        with state.lock:
+                            if rv < state.history_start - 1:
+                                # compacted away: real apiservers 410 here
+                                events: list | None = None
+                            else:
+                                events = [(erv, et, obj) for
+                                          (erv, k, et, obj) in state.history
+                                          if k == kind and erv > rv]
+                                bookmark = (stub._bookmark_seq
+                                            if stub._bookmark_seq >
+                                            last_bookmark else None)
+                                if not events and bookmark is None:
+                                    state.lock.wait(timeout=0.25)
+                                    continue
+                        if events is None:
+                            self._chunk(json.dumps(
+                                {"type": "ERROR",
+                                 "object": _status(410, "Expired", "gone")}
+                            ).encode() + b"\n")
+                            break
+                        if not events and bookmark is not None:
+                            last_bookmark = bookmark
+                            self._chunk(json.dumps(
+                                {"type": "BOOKMARK",
+                                 "object": {"kind": kind,
+                                            "metadata": {
+                                                "resourceVersion": str(rv)}}}
+                            ).encode() + b"\n")
+                            continue
+                        for erv, et, obj in events:
+                            self._chunk(json.dumps(
+                                {"type": et, "object": obj}).encode() + b"\n")
+                            rv = erv
+                            sent += 1
+                            if close_after is not None and sent >= close_after:
+                                self._chunk(b"")
+                                return
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    return  # client went away or we were reset
+                finally:
+                    with stub._fault_lock:
+                        try:
+                            stub._live_watch_sockets.remove(self.connection)
+                        except ValueError:
+                            pass
+                self._chunk(b"")
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def base_url(self) -> str:
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "StubApiServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="stub-apiserver", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- seeding (test-side, no HTTP) ------------------------------------------
+
+    def seed(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        meta = obj.setdefault("metadata", {})
+        ns, name = meta.get("namespace", ""), meta.get("name", "")
+        key = f"{ns}/{name}" if kind != "nodes" else name
+        with self.state.lock:
+            return self.state.commit(kind, "ADDED", obj, key)
+
+    def delete(self, kind: str, key: str) -> None:
+        with self.state.lock:
+            obj = self.state.objects[kind].get(key)
+            if obj is not None:
+                self.state.commit(kind, "DELETED", obj, key)
+
+    def get(self, kind: str, key: str) -> dict[str, Any] | None:
+        with self.state.lock:
+            obj = self.state.objects[kind].get(key)
+            return json.loads(json.dumps(obj)) if obj is not None else None
+
+    # -- fault injection -------------------------------------------------------
+
+    def watch_count(self) -> int:
+        """Live watch connections (lets tests wait for attachment before
+        seeding — watches start at the current rv, like a real apiserver)."""
+        with self._fault_lock:
+            return len(self._live_watch_sockets)
+
+    def inject_bookmark(self) -> None:
+        with self._fault_lock:
+            self._bookmark_seq += 1
+        with self.state.lock:
+            self.state.lock.notify_all()
+
+    def gone_on_next_watch(self, n: int = 1) -> None:
+        with self._fault_lock:
+            self._gone_next_watch = n
+
+    def close_watch_after(self, n_events: int | None) -> None:
+        with self._fault_lock:
+            self._close_after_events = n_events
+
+    def drop_watch_connections(self) -> None:
+        """Abruptly reset live watch sockets (mid-stream network failure)."""
+        with self._fault_lock:
+            socks = list(self._live_watch_sockets)
+        for s in socks:
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                s.close()
+            except OSError:
+                pass
