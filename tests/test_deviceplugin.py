@@ -235,12 +235,72 @@ def test_health_writes_unhealthy_configmap():
         "kube-system", "unhealthy-tpu-n1")["data"]["chips"] == ""
 
 
-def test_gc_counts_stale_pending():
+def test_gc_counts_stale_pending_without_reclaim():
     fc, plugin = rig()
     place(fc, "stuck", hbm=2048, now_ns=1)  # placed at epoch -> ancient
-    assert plugin.gc_stale_assignments(max_pending_seconds=1) == 1
+    assert plugin.gc_stale_assignments(max_pending_seconds=1,
+                                       reclaim=False) == 1
     plugin.allocate(hbm_mib=2048)
-    assert plugin.gc_stale_assignments(max_pending_seconds=1) == 0
+    assert plugin.gc_stale_assignments(max_pending_seconds=1,
+                                       reclaim=False) == 0
+
+
+def test_gc_reclaims_stale_placement_and_frees_chips():
+    """VERDICT r1 item 7: a placed-but-never-started pod frees its chips
+    after the window instead of holding them until termination."""
+    from tpushare.cache import SchedulerCache
+    from tpushare.controller import Controller
+
+    fc, plugin = rig()
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    place(fc, "stuck", hbm=2048, now_ns=1)
+    ctl.build_cache()
+    ctl.start()
+    try:
+        assert cache.get_node_info("n1").describe()["used_hbm_mib"] == 2048
+        assert plugin.gc_stale_assignments(max_pending_seconds=1) == 1
+        # annotations cleared on the apiserver...
+        pod = fc.get_pod("default", "stuck")
+        assert contract.chip_ids_from_annotations(pod) is None
+        # ...a late Allocate now fails (chips may be re-granted elsewhere)
+        with pytest.raises(AllocateError):
+            plugin.allocate(hbm_mib=2048)
+        # ...and the controller freed the chips in the extender cache
+        import time as _t
+        deadline = _t.monotonic() + 10
+        while _t.monotonic() < deadline and \
+                cache.get_node_info("n1").describe()["used_hbm_mib"] != 0:
+            _t.sleep(0.02)
+        assert cache.get_node_info("n1").describe()["used_hbm_mib"] == 0
+    finally:
+        ctl.stop()
+
+
+def test_gc_reclaim_loses_cas_race_to_late_allocate():
+    """If Allocate lands between the stale scan and the CAS PUT, the
+    reclaim must lose and the placement must stand."""
+    fc, plugin = rig()
+    place(fc, "racy", hbm=2048, now_ns=1)
+
+    real_get = fc.get_pod
+
+    def get_then_allocate(ns, name):
+        pod = real_get(ns, name)
+        if name == "racy" and not contract.is_assigned(pod):
+            # the kubelet's Allocate sneaks in after gc's freshness read
+            fc.patch_pod(ns, name, contract.assigned_patch())
+        return pod
+
+    fc.get_pod = get_then_allocate
+    try:
+        plugin.gc_stale_assignments(max_pending_seconds=1)
+    finally:
+        fc.get_pod = real_get
+    pod = fc.get_pod("default", "racy")
+    # CAS lost: placement annotations intact, pod assigned
+    assert contract.chip_ids_from_annotations(pod) is not None
+    assert contract.is_assigned(pod)
 
 
 # -- socket transport ---------------------------------------------------------

@@ -132,6 +132,56 @@ def test_count_allocate_noops_for_shared_pod(stack):
     assert contract.is_assigned(fc.get_pod("default", "shared"))
 
 
+def test_count_allocate_noops_after_hbm_side_assigned(stack):
+    """Kubelet's per-resource Allocate order is unspecified: when the
+    tpu-hbm call lands first and assigns the dual-resource pod, the later
+    tpu-count call must still no-op (not NOT_FOUND) or container start
+    wedges permanently."""
+    fc, plugin, kubelet, service = stack
+    place(fc, "dual", hbm=8, count=2)
+    kubelet.wait_for_devices(RESOURCE_HBM)
+
+    resp = kubelet.allocate(RESOURCE_HBM, 8)  # hbm side first
+    assert dict(resp.container_responses[0].envs)[ENV_HBM_LIMIT] == "8"
+    assert contract.is_assigned(fc.get_pod("default", "dual"))
+
+    resp = kubelet.allocate(RESOURCE_COUNT, 2)  # count side after: no-op
+    assert dict(resp.container_responses[0].envs) == {}
+
+
+def test_allocate_loses_to_concurrent_reclaim(stack):
+    """The assigned-marking CAS: if the stale-placement reclaim strips the
+    annotations between Allocate's match and its write, the Allocate must
+    fail — not assign a placement-less pod whose chips were re-granted."""
+    fc, plugin, kubelet, service = stack
+    place(fc, "racy", hbm=8, now_ns=1)
+
+    real_get = fc.get_pod
+    calls = {"n": 0}
+
+    def get_hook(ns, name):
+        """The reclaim lands right after _mark_assigned's freshness read,
+        so its CAS PUT must lose with 409 and re-validation must fail."""
+        pod = real_get(ns, name)
+        if name == "racy":
+            calls["n"] += 1
+            if calls["n"] == 1:
+                fc.replace_pod(ns, name, contract.strip_placement(pod))
+        return pod
+
+    fc.get_pod = get_hook
+    try:
+        from tpushare.deviceplugin.plugin import AllocateError
+        with pytest.raises(AllocateError):
+            plugin.allocate(hbm_mib=8)
+    finally:
+        fc.get_pod = real_get
+    # pod stayed unassigned and placement-free
+    pod = fc.get_pod("default", "racy")
+    assert contract.chip_ids_from_annotations(pod) is None
+    assert not contract.is_assigned(pod)
+
+
 def test_health_change_streams_unhealthy_devices(stack):
     fc, plugin, kubelet, service = stack
     kubelet.wait_for_devices(RESOURCE_HBM)

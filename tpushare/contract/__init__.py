@@ -39,6 +39,7 @@ from tpushare.contract.pod import (
     placement_annotations,
     placement_patch,
     assigned_patch,
+    strip_placement,
 )
 from tpushare.contract.node import (
     node_hbm_capacity,
@@ -59,6 +60,7 @@ __all__ = [
     "assume_time_from_annotations", "is_assigned",
     "is_tpushare_pod", "is_complete_pod", "is_assigned_non_terminated",
     "placement_annotations", "placement_patch", "assigned_patch",
+    "strip_placement",
     "node_hbm_capacity", "node_chip_count", "node_mesh_topology",
     "is_tpushare_node",
 ]

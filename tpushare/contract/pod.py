@@ -199,3 +199,22 @@ def assigned_patch() -> dict[str, Any]:
     """Patch the device plugin applies when the grant becomes real
     (designs.md:101: mark ASSIGNED true)."""
     return {"metadata": {"annotations": {ANN_ASSIGNED: "true"}}}
+
+
+PLACEMENT_ANNOTATION_KEYS = (
+    ANN_CHIP_IDS, ANN_HBM_POD, ANN_HBM_CHIP, ANN_ASSIGNED,
+    ANN_ASSUME_TIME, ANN_TOPOLOGY,
+)
+
+
+def strip_placement(pod: Pod) -> dict[str, Any]:
+    """Deep copy of ``pod`` with the placement annotations removed — the
+    body of the stale-placement reclaim's CAS PUT (the pod keeps its
+    resourceVersion, so a concurrent Allocate that patched assigned=true
+    makes the PUT lose with 409)."""
+    out = json.loads(json.dumps(pod))
+    ann = (out.get("metadata") or {}).get("annotations")
+    if ann:
+        for key in PLACEMENT_ANNOTATION_KEYS:
+            ann.pop(key, None)
+    return out

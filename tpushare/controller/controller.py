@@ -151,12 +151,18 @@ class Controller:
 
     def _update_relevant(self, pod: dict[str, Any]) -> bool:
         """controller.go:283-290: process updates only when the pod became
-        complete, or when a pod we don't track gained a placement."""
+        complete, or when a pod we don't track gained a placement — plus
+        one tpushare extension: a pod we DO track that lost its placement
+        (the device plugin's stale-placement reclaim cleared the
+        annotations; its chips must free now, not at pod termination)."""
         if contract.is_complete_pod(pod):
             return True
         uid = podlib.pod_uid(pod)
-        if not self.cache.known_pod(uid) and \
-                contract.chip_ids_from_annotations(pod) is not None:
+        known = self.cache.known_pod(uid)
+        has_placement = contract.chip_ids_from_annotations(pod) is not None
+        if not known and has_placement:
+            return True
+        if known and not has_placement:
             return True
         return False
 
@@ -281,6 +287,11 @@ class Controller:
         elif podlib.pod_node_name(pod) and \
                 contract.chip_ids_from_annotations(pod) is not None:
             self.cache.add_or_update_pod(pod)
+        elif self.cache.known_pod(podlib.pod_uid(pod)) and \
+                contract.chip_ids_from_annotations(pod) is None:
+            # placement annotations were cleared (stale-placement reclaim):
+            # free the chips without waiting for pod termination
+            self.cache.remove_pod(pod)
 
     # -- test hooks -----------------------------------------------------------
 

@@ -53,6 +53,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--fake-cluster", action="store_true",
                     help="run against an in-memory cluster (dev only)")
     ap.add_argument("--apiserver", default=None)
+    ap.add_argument("--kubeconfig", default=None,
+                    help="out-of-cluster kubeconfig path (default: "
+                         "$KUBECONFIG, else in-cluster SA)")
     ap.add_argument("--health-interval", type=float, default=30.0)
     args = ap.parse_args(argv)
 
@@ -81,7 +84,10 @@ def main(argv: list[str] | None = None) -> int:
                              hbm_per_chip_mib=args.hbm, mesh=args.mesh)
     else:
         from tpushare.k8s.incluster import InClusterClient
-        cluster = InClusterClient(base_url=args.apiserver)
+        if args.apiserver:
+            cluster = InClusterClient(base_url=args.apiserver)
+        else:
+            cluster = InClusterClient.autodetect(kubeconfig=args.kubeconfig)
 
     plugin = DevicePlugin(cluster, args.node_name, enumerator,
                           unit_mib=args.hbm_unit)

@@ -58,6 +58,22 @@ HEALTHY = "Healthy"
 UNHEALTHY = "Unhealthy"
 
 
+def _fill_preferred(available: list[str], must_include: list[str],
+                    size: int) -> list[str]:
+    """must_include first, then available, dedup'd — set-tracked, because
+    MiB-denominated requests make ``size`` tens of thousands and an
+    `x in list` fill would be O(size^2) inside a kubelet RPC."""
+    chosen = list(must_include)
+    seen = set(chosen)
+    for d in available:
+        if len(chosen) >= size:
+            break
+        if d not in seen:
+            seen.add(d)
+            chosen.append(d)
+    return chosen[:size]
+
+
 class HBMResource:
     """tpu-hbm as a device set: one Device per request unit of chip HBM.
 
@@ -86,13 +102,7 @@ class HBMResource:
     def preferred(self, available: list[str], must_include: list[str],
                   size: int) -> list[str]:
         # HBM units are fungible; any subset works. Honor must_include.
-        chosen = list(must_include)
-        for d in available:
-            if len(chosen) >= size:
-                break
-            if d not in chosen:
-                chosen.append(d)
-        return chosen[:size]
+        return _fill_preferred(available, must_include, size)
 
 
 class CountResource:
@@ -130,13 +140,7 @@ class CountResource:
                 if all(w in available or w in must_include for w in want):
                     return want
                 break
-        chosen = list(must_include)
-        for d in available:
-            if len(chosen) >= size:
-                break
-            if d not in chosen:
-                chosen.append(d)
-        return chosen[:size]
+        return _fill_preferred(available, must_include, size)
 
 
 class _PluginServicer:
@@ -331,6 +335,10 @@ class DevicePluginService:
                 self.health_tick()
             except Exception as e:  # noqa: BLE001 — keep the agent alive
                 log.warning("health tick failed: %s", e)
+            try:
+                self.plugin.gc_stale_assignments()
+            except Exception as e:  # noqa: BLE001
+                log.warning("stale-placement gc failed: %s", e)
             for s in self.servers:
                 if not os.path.exists(s.socket_path):
                     log.warning("socket %s vanished (kubelet restart?); "

@@ -19,6 +19,7 @@ nodeinfo.go:406-431).
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 from typing import Any
@@ -131,9 +132,13 @@ class DevicePlugin:
 
     # -- allocation rendezvous ------------------------------------------------
 
-    def _placed_pods(self, assigned: bool) -> list[dict[str, Any]]:
+    def _placed_pods(self, assigned: bool,
+                     pods: list[dict[str, Any]] | None = None
+                     ) -> list[dict[str, Any]]:
+        if pods is None:
+            pods = self._cluster.list_pods()
         out = []
-        for pod in self._cluster.list_pods():
+        for pod in pods:
             if podlib.pod_node_name(pod) != self.node_name:
                 continue
             if not contract.is_tpushare_pod(pod) or contract.is_complete_pod(pod):
@@ -147,17 +152,20 @@ class DevicePlugin:
                                 podlib.pod_uid(p)))
         return out
 
-    def pending_pods(self) -> list[dict[str, Any]]:
+    def pending_pods(self, pods: list[dict[str, Any]] | None = None
+                     ) -> list[dict[str, Any]]:
         """This node's placed-but-unassigned tpushare pods, deterministic
         order (assume-time, then UID — fixes the reference's tie ambiguity,
-        designs.md:97-99)."""
-        return self._placed_pods(assigned=False)
+        designs.md:97-99). ``pods`` lets one apiserver LIST serve several
+        passes within a single Allocate."""
+        return self._placed_pods(assigned=False, pods=pods)
 
-    def assigned_pods(self) -> list[dict[str, Any]]:
+    def assigned_pods(self, pods: list[dict[str, Any]] | None = None
+                      ) -> list[dict[str, Any]]:
         """Placed pods already marked assigned but not yet terminated —
         the idempotent-rematch pool for multi-container pods and kubelet
         Allocate retries (see :meth:`allocate`)."""
-        return self._placed_pods(assigned=True)
+        return self._placed_pods(assigned=True, pods=pods)
 
     def allocate(self, hbm_mib: int | None = None,
                  pod_uid: str | None = None) -> dict[str, Any]:
@@ -184,11 +192,12 @@ class DevicePlugin:
                     return pod
             return None
 
-        candidates = self.pending_pods()
+        snapshot = self._cluster.list_pods()  # one LIST serves both passes
+        candidates = self.pending_pods(snapshot)
         chosen = pick(candidates)
         if chosen is not None:
             return self._finalize(chosen)
-        rematch = pick(self.assigned_pods())
+        rematch = pick(self.assigned_pods(snapshot))
         if rematch is not None:
             return self._finalize(rematch, patch=False)
         raise AllocateError(
@@ -204,34 +213,70 @@ class DevicePlugin:
 
         1. a pending hbm-less (exclusive) pod with ``count`` granted chips
            — assign it;
-        2. a pending *dual-resource* pod (tpu-hbm + tpu-count) with
-           ``count`` granted chips — return None (no-op): that pod's
-           rendezvous is owned by the tpu-hbm Allocate, and the count call
-           for the same container must not steal or fail it;
+        2. a *dual-resource* pod (tpu-hbm + tpu-count) with ``count``
+           granted chips, pending OR already assigned — return None
+           (no-op): that pod's rendezvous is owned by the tpu-hbm
+           Allocate, and kubelet's per-resource call order is unspecified
+           (hbm-first leaves the pod assigned by the time the count call
+           arrives), so the count side must neither steal nor fail it;
         3. an already-assigned exclusive pod with ``count`` chips — return
            its environment idempotently (multi-container / kubelet retry);
         4. otherwise raise, so a genuinely unmatched exclusive container
            fails container start instead of silently running without TPUs.
         """
-        for pod in self.pending_pods():
-            if contract.pod_hbm_request(pod) != 0:
-                continue
-            ids = contract.chip_ids_from_annotations(pod) or ()
-            if len(ids) == count:
+        snapshot = self._cluster.list_pods()  # one LIST serves all passes
+        pending = self.pending_pods(snapshot)
+        assigned = self.assigned_pods(snapshot)
+
+        def chip_count(pod) -> int:
+            return len(contract.chip_ids_from_annotations(pod) or ())
+
+        for pod in pending:
+            if contract.pod_hbm_request(pod) == 0 and \
+                    chip_count(pod) == count:
                 return self._finalize(pod)
-        for pod in self.pending_pods():
-            ids = contract.chip_ids_from_annotations(pod) or ()
-            if contract.pod_hbm_request(pod) != 0 and len(ids) == count:
-                return None
-        for pod in self.assigned_pods():
-            if contract.pod_hbm_request(pod) != 0:
-                continue
-            ids = contract.chip_ids_from_annotations(pod) or ()
-            if len(ids) == count:
+        for pod in pending + assigned:
+            if contract.pod_hbm_request(pod) != 0 and \
+                    chip_count(pod) == count:
+                return None  # dual-resource: hbm side owns the rendezvous
+        for pod in assigned:
+            if contract.pod_hbm_request(pod) == 0 and \
+                    chip_count(pod) == count:
                 return self._finalize(pod, patch=False)
         raise AllocateError(
             f"no pending exclusive pod on {self.node_name} wants "
             f"{count} chips")
+
+    def _mark_assigned(self, ns: str, name: str,
+                       matched: dict[str, Any]) -> dict[str, Any]:
+        """Flip assigned=true with an apiserver CAS.
+
+        A plain merge patch would race the stale-placement reclaim: gc's
+        CAS could strip the placement between our match and our write, and
+        the patch would then assign a placement-less pod whose chips the
+        extender already re-granted. Both writers use resourceVersion'd
+        PUTs, so whichever lands second loses and re-validates. Returns
+        the updated pod (the env must reflect what was actually assigned).
+        """
+        want_t = contract.assume_time_from_annotations(matched)
+        for _ in range(3):
+            fresh = self._cluster.get_pod(ns, name)
+            if contract.chip_ids_from_annotations(fresh) is None or \
+                    contract.assume_time_from_annotations(fresh) != want_t:
+                raise AllocateError(
+                    f"placement of {ns}/{name} was reclaimed or replaced "
+                    "mid-allocate")
+            body = json.loads(json.dumps(fresh))
+            body["metadata"].setdefault("annotations", {})[
+                contract.ANN_ASSIGNED] = "true"
+            try:
+                return self._cluster.replace_pod(ns, name, body)
+            except ApiError as e:
+                if not e.is_conflict:
+                    raise
+                continue  # lost a CAS round: re-read and re-validate
+        raise AllocateError(
+            f"assigning {ns}/{name} kept losing CAS races; giving up")
 
     def _finalize(self, chosen, patch: bool = True) -> dict[str, Any]:
         """Build the matched pod's device environment; when ``patch``,
@@ -239,7 +284,7 @@ class DevicePlugin:
         re-matches of already-assigned pods)."""
         ns, name = podlib.pod_namespace(chosen), podlib.pod_name(chosen)
         if patch:
-            self._cluster.patch_pod(ns, name, contract.assigned_patch())
+            chosen = self._mark_assigned(ns, name, chosen)
 
         ids = contract.chip_ids_from_annotations(chosen) or ()
         grant_units = contract.hbm_from_annotations(chosen)
@@ -297,21 +342,65 @@ class DevicePlugin:
                 self.check_health()
             except Exception as e:  # noqa: BLE001
                 log.warning("health loop error: %s", e)
+            try:
+                self.gc_stale_assignments()
+            except Exception as e:  # noqa: BLE001
+                log.warning("gc error: %s", e)
 
     # -- garbage collection ---------------------------------------------------
 
-    def gc_stale_assignments(self, max_pending_seconds: float = 300.0) -> int:
-        """Pods that were placed (assigned=false) but never started within
-        the window are counted and logged — kubelet never called Allocate
-        (image pull failure, pod deleted mid-flight). The extender's resync
-        frees their chips when they terminate; this is observability, not
-        correctness. Returns the stale count."""
+    def gc_stale_assignments(self, max_pending_seconds: float = 300.0,
+                             reclaim: bool = True) -> int:
+        """Reclaim placements that never started.
+
+        A pod that was placed (assigned=false) but whose container start
+        never reached Allocate within the window (image pull failure, pod
+        stuck mid-flight) holds its chip reservation indefinitely — the
+        extender only frees chips at pod termination. Reclaim clears the
+        placement annotations with an apiserver CAS (PUT keyed on the
+        resourceVersion read here), so:
+
+        - a concurrent late Allocate that wins the race patches
+          assigned=true, bumps the resourceVersion, and our PUT loses with
+          409 — the placement stands;
+        - if the reclaim wins, the pod drops out of ``pending_pods`` and a
+          later Allocate fails NOT_FOUND (container start fails rather
+          than running on chips the extender re-granted elsewhere).
+
+        The controller observes the cleared annotations and frees the
+        chips (controller._update_relevant's lost-placement rule). Returns
+        the number of stale placements found (``reclaim=False`` = count
+        only).
+        """
         now_ns = time.time_ns()
         stale = 0
         for pod in self.pending_pods():
             t = contract.assume_time_from_annotations(pod)
-            if t and (now_ns - t) / 1e9 > max_pending_seconds:
-                stale += 1
-                log.warning("gc: pod %s placed %.0fs ago but never assigned",
-                            podlib.pod_key(pod), (now_ns - t) / 1e9)
+            if not t or (now_ns - t) / 1e9 <= max_pending_seconds:
+                continue
+            stale += 1
+            ns, name = podlib.pod_namespace(pod), podlib.pod_name(pod)
+            log.warning("gc: pod %s placed %.0fs ago but never assigned",
+                        podlib.pod_key(pod), (now_ns - t) / 1e9)
+            if not reclaim:
+                continue
+            try:
+                # re-read so the CAS covers everything since this check
+                fresh = self._cluster.get_pod(ns, name)
+            except ApiError:
+                continue  # pod vanished; termination frees the chips
+            if contract.is_assigned(fresh) or \
+                    contract.assume_time_from_annotations(fresh) != t:
+                continue  # raced a late Allocate or a re-placement
+            try:
+                self._cluster.replace_pod(
+                    ns, name, contract.strip_placement(fresh))
+                log.warning("gc: reclaimed placement of %s/%s", ns, name)
+            except ApiError as e:
+                if e.is_conflict:
+                    log.info("gc: reclaim of %s/%s lost a CAS race "
+                             "(placement stands)", ns, name)
+                else:
+                    log.warning("gc: reclaim of %s/%s failed: %s",
+                                ns, name, e)
         return stale

@@ -49,6 +49,9 @@ class ClusterClient(Protocol):
     # writes
     def patch_pod(self, namespace: str, name: str,
                   patch: dict[str, Any]) -> dict[str, Any]: ...
+    def replace_pod(self, namespace: str, name: str,
+                    pod: dict[str, Any]) -> dict[str, Any]: ...
+
     def bind_pod(self, namespace: str, name: str, node: str,
                  uid: str | None = None) -> None: ...
     def create_event(self, namespace: str, event: dict[str, Any]) -> None: ...

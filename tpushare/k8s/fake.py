@@ -185,6 +185,26 @@ class FakeCluster:
             self._notify("pods", "MODIFIED", merged)
             return copy.deepcopy(merged)
 
+    def replace_pod(self, namespace: str, name: str,
+                    pod: dict[str, Any]) -> dict[str, Any]:
+        """PUT semantics: optimistic concurrency on metadata.resourceVersion
+        (409 on mismatch) — the CAS the stale-placement reclaim relies on."""
+        with self._lock:
+            key = self._key(namespace, name)
+            cur = self._pods.get(key)
+            if cur is None:
+                raise ApiError(404, f"pod {namespace}/{name}")
+            want_rv = (pod.get("metadata") or {}).get("resourceVersion")
+            have_rv = (cur.get("metadata") or {}).get("resourceVersion")
+            if want_rv is not None and want_rv != have_rv:
+                raise ApiError(409,
+                               f"resourceVersion {want_rv} != {have_rv}")
+            new = json.loads(json.dumps(pod))
+            self._bump(new)
+            self._pods[key] = new
+            self._notify("pods", "MODIFIED", new)
+            return copy.deepcopy(new)
+
     def bind_pod(self, namespace: str, name: str, node: str,
                  uid: str | None = None) -> None:
         with self._lock:
