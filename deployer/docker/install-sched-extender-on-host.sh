@@ -29,6 +29,8 @@ clientConnection:
 extenders:
   - urlPrefix: "$EXTENDER_URL"
     filterVerb: filter
+    prioritizeVerb: prioritize
+    weight: 10
     bindVerb: bind
     enableHTTPS: false
     nodeCacheCapable: true

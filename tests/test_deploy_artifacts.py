@@ -112,6 +112,92 @@ def test_uninstall_script_restores_backup(fake_host):
     assert (fake_host / "manifests" / "kube-scheduler.yaml").read_text() == original
 
 
+def _render_helm(template_path: str, values: dict) -> str:
+    """Minimal Helm-template renderer for the subset this chart uses
+    ({{ .Values.x }}, {{- if }}/{{- end }}, toYaml|indent, |default) — the
+    image has no helm binary, and parse-only checks would miss golang
+    template typos inside the YAML."""
+    import re
+
+    def lookup(path):
+        cur = values
+        for part in path.split(".")[2:]:  # drop leading '' and 'Values'
+            cur = cur[part]
+        return cur
+
+    text = open(template_path).read()
+
+    # {{- /* comments */ -}}
+    text = re.sub(r"\{\{-?\s*/\*.*?\*/\s*-?\}\}\n?", "", text, flags=re.S)
+
+    # {{- if .Values.x }} ... {{- end }} (no nesting in this chart)
+    def if_repl(m):
+        return m.group(2) if lookup(m.group(1)) else ""
+
+    text = re.sub(
+        r"\{\{-? if (\.Values[.\w]+) \}\}\n(.*?)\{\{-? end \}\}\n?",
+        if_repl, text, flags=re.S)
+
+    # {{ toYaml .Values.x | indent N }}
+    def toyaml_repl(m):
+        block = yaml.safe_dump(lookup(m.group(1)), default_flow_style=False)
+        pad = " " * int(m.group(2))
+        return "\n".join(pad + line for line in block.strip().split("\n"))
+
+    text = re.sub(r"\{\{ toYaml (\.Values[.\w]+) \| indent (\d+) \}\}",
+                  toyaml_repl, text)
+
+    # {{ .Values.x | default Y }} and {{ .Values.x }}
+    def value_repl(m):
+        try:
+            return str(lookup(m.group(1)))
+        except KeyError:
+            if m.group(2) is not None:
+                return m.group(2)
+            raise
+
+    text = re.sub(r"\{\{ (\.Values[.\w]+)(?: \| default (\S+))? \}\}",
+                  value_repl, text)
+    assert "{{" not in text, f"unrendered template syntax in {template_path}"
+    return text
+
+
+def test_chart_templates_render_to_valid_manifests():
+    """Every chart template renders against values.yaml into parseable,
+    well-formed k8s objects (VERDICT r1 item 6: render-check the chart,
+    including the new evictor/recover DaemonSets)."""
+    chart = os.path.join(REPO, "deployer/chart/tpushare-installer")
+    with open(os.path.join(chart, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    rendered = {}
+    for name in sorted(os.listdir(os.path.join(chart, "templates"))):
+        text = _render_helm(os.path.join(chart, "templates", name), values)
+        docs = [d for d in yaml.safe_load_all(text) if d]
+        assert docs, f"{name} rendered to nothing with default values"
+        for d in docs:
+            assert d.get("kind") and d.get("apiVersion"), name
+        rendered[name] = docs
+
+    evict = rendered["device-plugin-evictor.yaml"][0]
+    assert evict["kind"] == "DaemonSet"
+    spec = evict["spec"]["template"]["spec"]
+    assert spec["nodeSelector"] == {"tpushare": "true"}
+    assert "dp-evict-on-host.sh" in spec["containers"][0]["args"][0]
+
+    recover = rendered["device-plugin-recover.yaml"][0]
+    assert recover["kind"] == "DaemonSet"
+    spec = recover["spec"]["template"]["spec"]
+    assert spec["nodeSelector"] == {"tpushare": "false"}
+    assert "dp-recover-on-host.sh" in spec["containers"][0]["args"][0]
+
+    # value gates actually gate
+    off = dict(values)
+    off["evictStockDevicePlugin"] = False
+    text = _render_helm(os.path.join(
+        chart, "templates/device-plugin-evictor.yaml"), off)
+    assert not [d for d in yaml.safe_load_all(text) if d]
+
+
 def test_evict_and_recover_scripts(fake_host):
     env = {"HOST_K8S_DIR": str(fake_host)}
     stock = fake_host / "manifests" / "stock-tpu-device-plugin.yaml"

@@ -83,6 +83,44 @@ def test_filter_nodes_fallback_for_non_cache_capable(rig):
     assert status == 200 and result["NodeNames"] == ["n1"]
 
 
+def test_prioritize_ranks_tightest_node_first(rig):
+    """VERDICT r1 item 3: the prioritize verb ranks candidates by the
+    tightest-fit binpack policy (leftover HBM on the chosen chips), so the
+    default scheduler packs instead of spreading."""
+    fc, cache, base = rig
+    pod = make_pod(hbm=2000, name="p")
+    status, ranked = post(f"{base}/tpushare-scheduler/prioritize", {
+        "Pod": pod, "NodeNames": ["n1", "n2"]})
+    assert status == 200
+    scores = {h["Host"]: h["Score"] for h in ranked}
+    # empty fleet: n2's 8000-MiB chips leave less leftover than n1's 16000
+    assert scores["n2"] == 10 and scores["n1"] < scores["n2"]
+
+    # fill one n1 chip down to a 2000-MiB hole -> n1 becomes the tightest
+    big = fc.create_pod(make_pod(hbm=14000, name="filler"))
+    post(f"{base}/tpushare-scheduler/bind", {
+        "PodName": "filler", "PodNamespace": "default",
+        "PodUID": big["metadata"]["uid"], "Node": "n1"})
+    status, ranked = post(f"{base}/tpushare-scheduler/prioritize", {
+        "Pod": pod, "NodeNames": ["n1", "n2"]})
+    scores = {h["Host"]: h["Score"] for h in ranked}
+    assert scores["n1"] == 10 and scores["n2"] < scores["n1"]
+
+
+def test_prioritize_non_tpu_pod_and_unknown_node(rig):
+    fc, cache, base = rig
+    status, ranked = post(f"{base}/tpushare-scheduler/prioritize", {
+        "Pod": make_pod(), "NodeNames": ["n1", "ghost"]})
+    assert status == 200
+    assert ranked == [{"Host": "n1", "Score": 0},
+                      {"Host": "ghost", "Score": 0}]
+    # tpushare pod, unknown node scores 0 but stays in the list
+    status, ranked = post(f"{base}/tpushare-scheduler/prioritize", {
+        "Pod": make_pod(hbm=100), "NodeNames": ["ghost", "n1"]})
+    scores = {h["Host"]: h["Score"] for h in ranked}
+    assert scores["ghost"] == 0 and scores["n1"] == 10
+
+
 def test_bind_golden_writes_annotations(rig):
     fc, cache, base = rig
     created = fc.create_pod(make_pod(hbm=2000, name="p"))
@@ -109,8 +147,8 @@ def test_bind_failure_returns_500(rig):
 
 
 def test_bind_emits_scheduled_and_failure_events(rig):
-    """The extender owns the bind verb, so it emits the Scheduled /
-    FailedScheduling pod events the default scheduler would have (the
+    """The extender owns the bind verb, so it emits the TPUShareBound /
+    TPUShareBindFailed pod events (distinct reasons from the default scheduler's own) (the
     reference wires an EventRecorder but never emits — SURVEY §5.5)."""
     fc, cache, base = rig
     ok = fc.create_pod(make_pod(hbm=2000, name="evt-ok"))
@@ -123,8 +161,8 @@ def test_bind_emits_scheduled_and_failure_events(rig):
             "PodName": "evt-bad", "PodNamespace": "default",
             "PodUID": bad["metadata"]["uid"], "Node": "n1"})
     events = fc.events
-    sched = [e for e in events if e["reason"] == "Scheduled"]
-    failed = [e for e in events if e["reason"] == "FailedScheduling"]
+    sched = [e for e in events if e["reason"] == "TPUShareBound"]
+    failed = [e for e in events if e["reason"] == "TPUShareBindFailed"]
     assert len(sched) == 1 and sched[0]["type"] == "Normal"
     assert sched[0]["involvedObject"]["name"] == "evt-ok"
     assert "chips" in sched[0]["message"]
@@ -136,7 +174,7 @@ def test_bind_emits_scheduled_and_failure_events(rig):
 def test_duplicate_bind_is_idempotent_success(rig):
     """A re-delivered bind for a pod already bound to the requested node
     returns success (the pod IS scheduled as asked); a bind for a pod
-    bound elsewhere fails, but without a FailedScheduling event."""
+    bound elsewhere fails, but without a failure event."""
     fc, cache, base = rig
     created = fc.create_pod(make_pod(hbm=1000, name="dup"))
     body = {"PodName": "dup", "PodNamespace": "default",
@@ -151,11 +189,11 @@ def test_duplicate_bind_is_idempotent_success(rig):
     assert e.value.code == 500
     assert "already bound" in json.loads(e.value.read())["Error"]
     warnings = [ev for ev in fc.events
-                if ev["reason"] == "FailedScheduling"
+                if ev["reason"] == "TPUShareBindFailed"
                 and ev["involvedObject"]["name"] == "dup"]
     assert warnings == []
-    # exactly one Scheduled event despite three bind calls
-    sched = [ev for ev in fc.events if ev["reason"] == "Scheduled"
+    # exactly one bound event despite three bind calls
+    sched = [ev for ev in fc.events if ev["reason"] == "TPUShareBound"
              and ev["involvedObject"]["name"] == "dup"]
     assert len(sched) == 1
 
@@ -228,3 +266,18 @@ def test_debug_threads(rig):
     fc, cache, base = rig
     status, text = get(f"{base}/debug/threads", as_json=False)
     assert status == 200 and "tpushare-http" in text
+
+
+def test_debug_heap(rig):
+    """pprof /heap analogue (reference pkg/routes/pprof.go:10-22): first
+    call arms tracemalloc, second returns allocation sites."""
+    import tracemalloc
+
+    fc, cache, base = rig
+    try:
+        status, text = get(f"{base}/debug/heap", as_json=False)
+        assert status == 200
+        status, text = get(f"{base}/debug/heap?top=5", as_json=False)
+        assert status == 200 and "live traced heap" in text and "KiB" in text
+    finally:
+        tracemalloc.stop()  # don't tax the rest of the suite

@@ -12,8 +12,9 @@ oversubscription-safe under concurrent binds.
 
 from tpushare.cache.chipusage import ChipUsage
 from tpushare.cache.nodeinfo import (
-    AllocationError, AlreadyBoundError, NodeInfo)
+    AllocationError, AlreadyBoundError, BindInFlightError, NodeInfo)
 from tpushare.cache.cache import SchedulerCache
 
 __all__ = ["ChipUsage", "NodeInfo", "AllocationError", "AlreadyBoundError",
+           "BindInFlightError",
            "SchedulerCache"]

@@ -52,6 +52,13 @@ class AlreadyBoundError(AllocationError):
     FailedScheduling event)."""
 
 
+class BindInFlightError(AllocationError):
+    """A concurrent bind for the same pod is mid-write on this node. The
+    losing request must fail (the winner's outcome is unknown here) but it
+    is a benign race, not a scheduling failure — callers must not emit a
+    failure event for a pod the winner is about to bind successfully."""
+
+
 def request_from_pod(pod: dict[str, Any]) -> PlacementRequest | None:
     """Translate a pod's resource limits + annotations into a placement
     request. Returns None for non-tpushare pods.
@@ -185,7 +192,7 @@ class NodeInfo:
                 # a concurrent duplicate bind for the same pod: letting it
                 # proceed would double-reserve, and its rollback would
                 # erase whatever the first attempt wins
-                raise AllocationError(
+                raise BindInFlightError(
                     f"bind already in flight for {podlib.pod_key(pod)} "
                     f"on {self.name}")
             views = [c.view(healthy=c.idx not in self._unhealthy)

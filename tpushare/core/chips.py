@@ -54,6 +54,17 @@ class ChipSnapshot(list):
     # identical anyway (ChipView coords encode the mesh shape)
     __hash__ = object.__hash__
 
+    # Snapshots are SHARED between callers and cached by identity —
+    # in-place mutation would corrupt every holder and the engine pack
+    # cache, so the list mutators are disabled.
+    def _immutable(self, *args, **kwargs):
+        raise TypeError("ChipSnapshot is immutable (shared between "
+                        "callers; see NodeInfo.snapshot)")
+
+    append = extend = insert = remove = _immutable
+    pop = clear = sort = reverse = _immutable
+    __setitem__ = __delitem__ = __iadd__ = __imul__ = _immutable
+
 
 def node_chips(
     count: int,

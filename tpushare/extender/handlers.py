@@ -20,7 +20,7 @@ from typing import Any
 
 from tpushare import contract
 from tpushare.cache import (
-    AllocationError, AlreadyBoundError, SchedulerCache)
+    AllocationError, AlreadyBoundError, BindInFlightError, SchedulerCache)
 from tpushare.cache.nodeinfo import no_fit_reason, request_from_pod
 from tpushare.core.native import engine as native_engine
 from tpushare.contract import pod as podlib
@@ -88,6 +88,75 @@ class FilterHandler:
         return {"NodeNames": ok_nodes, "FailedNodes": failed, "Error": ""}
 
 
+class PrioritizeHandler:
+    """The extender ``prioritize`` verb: rank filter-passing nodes so the
+    default scheduler packs tightly instead of spreading.
+
+    The extender API supports a prioritizeVerb next to filter/bind
+    (ExtenderConfig.PrioritizeVerb, /root/reference/vendor/k8s.io/
+    kubernetes/pkg/scheduler/api/types.go:183-188); the reference never
+    registers one, so its cross-node packing quality is whatever the
+    default scheduler's generic spreading produces. tpushare ranks by the
+    same tightest-fit policy its simulator proves out
+    (sim/simulator.py::_policy_binpack): the node whose best placement
+    leaves the least free HBM on the chosen chips scores highest, driving
+    the fleet toward the >=90% utilization north star.
+
+    Returns a HostPriorityList ([{"Host", "Score"}], scores 0..10 =
+    MaxExtenderPriority); the scheduler adds Score x weight to each node.
+    """
+
+    MAX_PRIORITY = 10  # k8s MaxExtenderPriority
+
+    def __init__(self, cache: SchedulerCache, registry: Registry) -> None:
+        self._cache = cache
+        self._prioritize_total = registry.counter(
+            "tpushare_prioritize_requests_total", "Prioritize webhook calls")
+        self._prioritize_latency = registry.histogram(
+            "tpushare_prioritize_seconds", "Prioritize latency",
+            LATENCY_BUCKETS)
+
+    def handle(self, args: dict[str, Any]) -> list[dict[str, Any]]:
+        t0 = time.perf_counter()
+        self._prioritize_total.inc()
+        pod = args.get("Pod") or {}
+        node_names = args.get("NodeNames")
+        if node_names is None:
+            items = (args.get("Nodes") or {}).get("items") or []
+            node_names = [n.get("metadata", {}).get("name", "")
+                          for n in items]
+        node_names = [n for n in node_names if n]
+        req = request_from_pod(pod)
+        raw: dict[str, int | None] = {}  # name -> leftover score (lower=tighter)
+        if req is not None:
+            for name in node_names:
+                try:
+                    info = self._cache.get_node_info(name)
+                except ApiError:
+                    raw[name] = None
+                    continue
+                placement = native_engine.select_chips(
+                    info.snapshot(), info.topology, req)
+                raw[name] = None if placement is None else placement.score
+        fitting = [s for s in raw.values() if s is not None]
+        lo, hi = (min(fitting), max(fitting)) if fitting else (0, 0)
+        out = []
+        for name in node_names:
+            s = raw.get(name)
+            if req is None:
+                score = 0  # nothing to say about non-tpushare pods
+            elif s is None:
+                score = 0  # no placement (filter should have removed it)
+            elif hi == lo:
+                score = self.MAX_PRIORITY
+            else:
+                # tightest (lowest leftover) -> 10, loosest -> 0
+                score = round(self.MAX_PRIORITY * (hi - s) / (hi - lo))
+            out.append({"Host": name, "Score": score})
+        self._prioritize_latency.observe(time.perf_counter() - t0)
+        return out
+
+
 class BindHandler:
     """The delegated bind verb: choose chips, annotate, bind
     (reference Bind.Handler -> gpusharingbinding, gpushare-bind.go:22-43)."""
@@ -122,12 +191,23 @@ class BindHandler:
         except AlreadyBoundError as e:
             err = e
             bound_node = podlib.pod_node_name(pod)
+        except BindInFlightError as e:
+            # benign concurrent-duplicate race: the winner is mid-bind.
+            # Fail this request (outcome unknown here) but emit no failure
+            # event — a FailedScheduling for a pod the winner is about to
+            # bind successfully would mislead operators.
+            self.bind_failures.inc()
+            log.info("bind %s/%s -> %s refused: %s", ns, name, node, e)
+            return {"Error": str(e)}
         except (AllocationError, ApiError) as e:
             self.bind_failures.inc()
             err = e
-        # latency observed BEFORE event emission: the event POST is its own
-        # apiserver round-trip and must not skew the BASELINE p50/p99
-        self.bind_latency.observe(time.perf_counter() - t0)
+        finally:
+            # latency observed on EVERY exit (including unexpected
+            # exceptions and the early returns above) and BEFORE event
+            # emission: the event POST is its own apiserver round-trip and
+            # must not skew the BASELINE p50/p99
+            self.bind_latency.observe(time.perf_counter() - t0)
         if isinstance(err, AlreadyBoundError):
             if bound_node == node:
                 # duplicate delivery (webhook retry / HA replica race lost
@@ -145,23 +225,27 @@ class BindHandler:
         if err is not None:
             log.warning("bind %s/%s -> %s failed: %s", ns, name, node, err)
             self._emit_event(
-                ns, name, uid, "Warning", "FailedScheduling",
+                ns, name, uid, "Warning", "TPUShareBindFailed",
                 f"tpushare bind to {node} failed: {err}")
             return {"Error": str(err)}
         log.info("bind %s/%s -> %s ok", ns, name, node)
         self._emit_event(
-            ns, name, uid, "Normal", "Scheduled",
+            ns, name, uid, "Normal", "TPUShareBound",
             f"Successfully assigned {ns}/{name} to {node} "
             f"chips {list(placement.chip_ids)}")
         return {"Error": ""}
 
     def _emit_event(self, ns: str, name: str, uid: str, etype: str,
                     reason: str, message: str) -> None:
-        """Best-effort pod Event. The extender owns the bind verb, so it
-        emits the Scheduled / FailedScheduling events the default scheduler
-        would have (the reference wires an EventRecorder but never emits,
-        controller.go:63-67 / SURVEY §5.5 — operators get nothing from
-        `kubectl describe pod` there)."""
+        """Best-effort pod Event (the reference wires an EventRecorder but
+        never emits, controller.go:63-67 / SURVEY §5.5 — operators get
+        nothing from `kubectl describe pod` there).
+
+        Reasons are tpushare-specific (TPUShareBound / TPUShareBindFailed)
+        rather than the scheduler's Scheduled / FailedScheduling: in a real
+        cluster the default kube-scheduler records its own events around
+        the extender's bind webhook, and duplicating its reasons would
+        double every line in `kubectl describe`."""
         try:
             self._cluster.create_event(ns, {
                 "metadata": {"generateName": f"{name}."},

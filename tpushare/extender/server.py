@@ -19,7 +19,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 import tpushare
-from tpushare.extender.handlers import BindHandler, FilterHandler, InspectHandler
+from tpushare.extender.handlers import (
+    BindHandler,
+    FilterHandler,
+    InspectHandler,
+    PrioritizeHandler,
+)
 from tpushare.extender.metrics import Registry
 
 log = logging.getLogger("tpushare.extender.http")
@@ -34,6 +39,7 @@ class ExtenderServer:
                  elector=None) -> None:
         self.registry = registry or Registry()
         self.filter_handler = FilterHandler(cache, self.registry)
+        self.prioritize_handler = PrioritizeHandler(cache, self.registry)
         self.bind_handler = BindHandler(cache, cluster, self.registry)
         self.inspect_handler = InspectHandler(cache)
         self.host, self.port = host, port
@@ -81,6 +87,10 @@ class ExtenderServer:
                     args = self._read_json()
                     if self.path == f"{PREFIX}/filter":
                         self._reply(200, server_self.filter_handler.handle(args))
+                    elif self.path == f"{PREFIX}/prioritize":
+                        self._reply(
+                            200,
+                            server_self.prioritize_handler.handle(args))
                     elif self.path == f"{PREFIX}/bind":
                         if server_self._elector is not None and \
                                 not server_self._elector.is_leader():
@@ -137,6 +147,16 @@ class ExtenderServer:
                                 pass
                         self._reply(200, _profile(seconds),
                                     content_type="text/plain")
+                    elif self.path.startswith("/debug/heap"):
+                        top = 25
+                        if "top=" in self.path:
+                            try:
+                                top = min(int(
+                                    self.path.split("top=")[1]), 200)
+                            except ValueError:
+                                pass
+                        self._reply(200, _heap_profile(top),
+                                    content_type="text/plain")
                     else:
                         self._reply(404, {"error": f"no route {self.path}"})
                 except Exception as e:  # noqa: BLE001
@@ -183,6 +203,32 @@ def _thread_dump() -> str:
                      if t.ident == tid), str(tid))
         lines.append(f"--- thread {name} ({tid}) ---")
         lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    return "\n".join(lines) + "\n"
+
+
+def _heap_profile(top: int = 25) -> str:
+    """Heap-profile analogue of pprof's /debug/pprof/heap
+    (/root/reference/pkg/routes/pprof.go:10-22) via tracemalloc.
+
+    First call arms tracing and returns a baseline notice; subsequent
+    calls report the top allocation sites since then. Tracing stays on
+    once armed (a few % overhead) — same operational model as Go's
+    always-on heap profiler.
+    """
+    import tracemalloc
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(25)
+        return ("# tracemalloc armed; heap snapshots available from the "
+                "next request on\n")
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("traceback")
+    total = sum(s.size for s in stats)
+    lines = [f"# live traced heap: {total / 1024:.1f} KiB in "
+             f"{sum(s.count for s in stats)} blocks; top {top} sites"]
+    for s in stats[:top]:
+        lines.append(f"{s.size / 1024:10.1f} KiB  {s.count:6d} blocks")
+        for frame in s.traceback.format(limit=4):
+            lines.append("    " + frame.strip())
     return "\n".join(lines) + "\n"
 
 

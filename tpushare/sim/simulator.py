@@ -229,7 +229,7 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
             policy: str = "binpack") -> SimReport:
     """Run one policy over one trace. Deterministic for a given input."""
     place = POLICIES[policy]
-    # event heap: (time, seq, kind, payload); kind 0=departure, 1=arrival
+    # event heap: (time, kind, seq, payload); kind 0=departure, 1=arrival
     # (departures first at equal times: free capacity before retrying)
     heap: list[tuple] = []
     for seq, pod in enumerate(sorted(trace, key=lambda p: p.arrival)):
