@@ -371,8 +371,20 @@ def main() -> int:
         with urllib.request.urlopen(req, timeout=30) as r:
             ok_count = len(json.loads(r.read())["NodeNames"])
         fleet_ms.append((time.perf_counter() - t0) * 1e3)
+    prio_ms = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fleet_port}/tpushare-scheduler/prioritize",
+            data=json.dumps(fleet_body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            ranked_count = len(json.loads(r.read()))
+        prio_ms.append((time.perf_counter() - t0) * 1e3)
     fleet_server.stop()
     expect(ok_count == 1000, f"fleet filter saw all nodes ({ok_count})")
+    expect(ranked_count == 1000,
+           f"fleet prioritize ranked all nodes ({ranked_count})")
 
     # multi-node packing: prioritize verb vs default-scheduler spreading
     duel = packing_duel()
@@ -421,6 +433,7 @@ def main() -> int:
         "p50_bind_ms": round(p50, 3),
         "p99_bind_ms": round(p99, 3),
         "filter_1k_nodes_ms": round(min(fleet_ms), 2),
+        "prioritize_1k_nodes_ms": round(min(prio_ms), 2),
         "fragmentation": round(frag, 4),
         "pods": len(lat),
         "prioritize_util_pct": round(duel["prioritize"], 2),

@@ -78,6 +78,24 @@ def test_fits_fleet_parity():
         assert fleet == per_node, (trial, req)
 
 
+def test_score_fleet_parity():
+    """The one-call fleet Prioritize must agree with per-node
+    select_chips_py scores (None where no placement exists)."""
+    rng = random.Random(41)
+    for trial in range(60):
+        nodes = []
+        for _ in range(rng.randrange(1, 12)):
+            chips, topo, _ = random_case(rng)
+            nodes.append((chips, topo))
+        _, _, req = random_case(rng)
+        fleet = native_engine.score_fleet(nodes, req)
+        per_node = []
+        for chips, topo in nodes:
+            p = select_chips_py(chips, topo, req)
+            per_node.append(None if p is None else p.score)
+        assert fleet == per_node, (trial, req)
+
+
 def test_fits_fleet_handles_gappy_ids():
     # a node with non-dense chip ids must fall back to the Python path
     from tpushare.core.placement import fits as fits_py

@@ -77,6 +77,21 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_int,                    # allow_scatter
                 ctypes.POINTER(ctypes.c_uint8),  # out fits (n)
             ]
+            lib.tpushare_score_fleet.restype = ctypes.c_int
+            lib.tpushare_score_fleet.argtypes = [
+                ctypes.c_int,                    # n_nodes
+                ctypes.POINTER(ctypes.c_int64),  # node chip offsets (n+1)
+                ctypes.POINTER(ctypes.c_int64),  # free per chip (concat)
+                ctypes.POINTER(ctypes.c_int64),  # total per chip (concat)
+                ctypes.POINTER(ctypes.c_int64),  # mesh rank offsets (n+1)
+                ctypes.POINTER(ctypes.c_int64),  # mesh dims (concat)
+                ctypes.c_int64,                  # req hbm
+                ctypes.c_int,                    # req count
+                ctypes.c_int,                    # topo rank
+                ctypes.POINTER(ctypes.c_int64),  # topo dims
+                ctypes.c_int,                    # allow_scatter
+                ctypes.POINTER(ctypes.c_int64),  # out scores (n)
+            ]
             lib.tpushare_select_chips.argtypes = [
                 ctypes.c_int,                    # n_chips
                 ctypes.POINTER(ctypes.c_int64),  # free_hbm per chip (-1 = unhealthy)
@@ -224,7 +239,39 @@ def fits_fleet(nodes, req: "PlacementRequest") -> "list[bool]":
                 "restore the single-call native path")
         return [fits_py(chips, topo, req) for chips, topo in nodes]
 
+    marshalled = _marshal_fleet(np, nodes, req)
+    if marshalled is None:
+        return [fits_py(chips, topo, req) for chips, topo in nodes]
+    dense_idx, free, total, dims, chip_offsets, mesh_offsets = marshalled
+
     results: list[bool | None] = [None] * len(nodes)
+    n = len(dense_idx)
+    t_rank = len(req.topology) if req.topology else 0
+    t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
+    out = np.zeros(n, np.uint8)
+    rc = lib.tpushare_fits_fleet(
+        n, _i64p(chip_offsets), _i64p(free), _i64p(total),
+        _i64p(mesh_offsets), _i64p(dims),
+        req.hbm_mib, req.chip_count, t_rank, t_dims,
+        1 if req.allow_scatter else 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        return [fits_py(chips, topo, req) for chips, topo in nodes]
+    for pos, i in enumerate(dense_idx):
+        results[i] = bool(out[pos])
+    for i, r in enumerate(results):
+        if r is None:
+            chips, topo = nodes[i]
+            results[i] = fits_py(chips, topo, req)
+    return results  # type: ignore[return-value]
+
+
+def _marshal_fleet(np, nodes, req):
+    """Shared fleet marshalling for fits_fleet/score_fleet: concatenated
+    per-chip arrays + prefix offsets, with request-dependent eligibility
+    folded into ``free`` (-1 = can never host this request). Returns
+    (dense_idx, free, total, dims, chip_offsets, mesh_offsets) or None
+    when no node is ABI-expressible."""
     dense_idx: list[int] = []
     packs: list[_NodePack] = []
     for i, (chips, topo) in enumerate(nodes):
@@ -233,7 +280,7 @@ def fits_fleet(nodes, req: "PlacementRequest") -> "list[bool]":
             dense_idx.append(i)
             packs.append(p)
     if not dense_idx:
-        return [fits_py(chips, topo, req) for chips, topo in nodes]
+        return None
 
     # fleet-level concatenation cached against the exact tuple of packs:
     # a quiescent fleet (the common case between scheduling events) reuses
@@ -264,26 +311,58 @@ def fits_fleet(nodes, req: "PlacementRequest") -> "list[bool]":
         ineligible = ineligible | (used > 0)
     free = np.where(ineligible, np.int64(-1), total - used)
     free = np.ascontiguousarray(free, np.int64)
+    return dense_idx, free, total, dims, chip_offsets, mesh_offsets
 
-    n = len(packs)
+
+def score_fleet(nodes, req: "PlacementRequest") -> "list[int | None]":
+    """Fleet-wide Prioritize in ONE native call: the best binpack score
+    per node (lower = tighter; None = no placement), the ranking analogue
+    of :func:`fits_fleet`. Falls back to the per-node Python selector
+    where the native path is unavailable."""
+    from tpushare.core.placement import select_chips_py
+
+    def py_score(chips, topo):
+        p = select_chips_py(chips, topo, req)
+        return None if p is None else p.score
+
+    lib = _load()
+    if lib is None:
+        return [py_score(chips, topo) for chips, topo in nodes]
+    try:
+        import numpy as np
+    except ImportError:
+        return [py_score(chips, topo) for chips, topo in nodes]
+    marshalled = _marshal_fleet(np, nodes, req)
+    if marshalled is None:
+        return [py_score(chips, topo) for chips, topo in nodes]
+    dense_idx, free, total, dims, chip_offsets, mesh_offsets = marshalled
+
+    results: list[int | None] = [None] * len(nodes)
+    filled = [False] * len(nodes)
+    n = len(dense_idx)
     t_rank = len(req.topology) if req.topology else 0
     t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
-    out = np.zeros(n, np.uint8)
-    rc = lib.tpushare_fits_fleet(
+    out = np.zeros(n, np.int64)
+    rc = lib.tpushare_score_fleet(
         n, _i64p(chip_offsets), _i64p(free), _i64p(total),
         _i64p(mesh_offsets), _i64p(dims),
         req.hbm_mib, req.chip_count, t_rank, t_dims,
-        1 if req.allow_scatter else 0,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        1 if req.allow_scatter else 0, _i64p(out))
     if rc != 0:
-        return [fits_py(chips, topo, req) for chips, topo in nodes]
+        return [py_score(chips, topo) for chips, topo in nodes]
     for pos, i in enumerate(dense_idx):
-        results[i] = bool(out[pos])
-    for i, r in enumerate(results):
-        if r is None:
+        s = int(out[pos])
+        if s >= 0:
+            results[i] = s
+            filled[i] = True
+        elif s == -1:
+            filled[i] = True  # no placement: stays None
+        # -2: not ABI-expressible — Python fallback below
+    for i, done in enumerate(filled):
+        if not done:
             chips, topo = nodes[i]
-            results[i] = fits_py(chips, topo, req)
-    return results  # type: ignore[return-value]
+            results[i] = py_score(chips, topo)
+    return results
 
 
 def select_chips(chips: "Sequence[ChipView]", topo: "MeshTopology",

@@ -162,6 +162,50 @@ extern "C" int tpushare_fits_fleet(
 }
 
 extern "C" int tpushare_select_chips(
+    int n_chips, const int64_t* free_hbm, const int64_t* total_hbm,
+    int rank, const int64_t* mesh, int64_t req_hbm, int req_count,
+    int topo_rank, const int64_t* topo_dims, int allow_scatter,
+    int64_t* out_ids, int64_t* out_box, int64_t* out_origin,
+    int64_t* out_score);
+
+// Fleet-wide Prioritize: best placement score per node in one call (the
+// ranking analogue of tpushare_fits_fleet; same packed-array layout).
+// out_scores[n]: >=0 best binpack score (lower = tighter), -1 = no
+// placement, -2 = node not expressible in this ABI (caller falls back to
+// the Python selector for it).
+extern "C" int tpushare_score_fleet(
+    int n_nodes,
+    const int64_t* node_chip_offsets,
+    const int64_t* free_hbm,
+    const int64_t* total_hbm,
+    const int64_t* mesh_rank_offsets,
+    const int64_t* mesh_dims,
+    int64_t req_hbm,
+    int req_count,
+    int topo_rank,
+    const int64_t* topo_dims,
+    int allow_scatter,
+    int64_t* out_scores) {
+  if (n_nodes < 0) return -1;
+  std::vector<int64_t> ids, box, origin;
+  for (int n = 0; n < n_nodes; ++n) {
+    int64_t c0 = node_chip_offsets[n], c1 = node_chip_offsets[n + 1];
+    int64_t m0 = mesh_rank_offsets[n], m1 = mesh_rank_offsets[n + 1];
+    int n_chips = (int)(c1 - c0), rank = (int)(m1 - m0);
+    ids.resize(n_chips > 0 ? n_chips : 1);
+    box.resize(rank > 0 ? rank : 1);
+    origin.resize(rank > 0 ? rank : 1);
+    int64_t score = 0;
+    int rc = tpushare_select_chips(
+        n_chips, free_hbm + c0, total_hbm + c0, rank, mesh_dims + m0,
+        req_hbm, req_count, topo_rank, topo_dims, allow_scatter,
+        ids.data(), box.data(), origin.data(), &score);
+    out_scores[n] = rc == 1 ? score : (rc == 0 ? -1 : -2);
+  }
+  return 0;
+}
+
+extern "C" int tpushare_select_chips(
     int n_chips,
     const int64_t* free_hbm,   // -1 => ineligible (unhealthy / exclusive-busy)
     const int64_t* total_hbm,

@@ -129,15 +129,21 @@ class PrioritizeHandler:
         req = request_from_pod(pod)
         raw: dict[str, int | None] = {}  # name -> leftover score (lower=tighter)
         if req is not None:
+            known: list[str] = []
+            snapshots = []
             for name in node_names:
                 try:
                     info = self._cache.get_node_info(name)
                 except ApiError:
                     raw[name] = None
                     continue
-                placement = native_engine.select_chips(
-                    info.snapshot(), info.topology, req)
-                raw[name] = None if placement is None else placement.score
+                known.append(name)
+                snapshots.append((info.snapshot(), info.topology))
+            # one native call scores the whole candidate set (the ranking
+            # analogue of Filter's fused fleet scan)
+            for name, score in zip(known,
+                                   native_engine.score_fleet(snapshots, req)):
+                raw[name] = score
         fitting = [s for s in raw.values() if s is not None]
         lo, hi = (min(fitting), max(fitting)) if fitting else (0, 0)
         out = []
