@@ -113,6 +113,80 @@ class Driver:
             return json.loads(r.read())
 
 
+def wire_latency() -> dict:
+    """Schedule-to-bind latency with REAL apiserver round-trips.
+
+    VERDICT r1 flagged the headline p50 as hermetic: FakeCluster binds are
+    in-process, while a real bind pays a strategic-merge PATCH plus a
+    pods/binding POST against the apiserver — exactly what the 3-phase
+    lock design (nodeinfo.py allocate) exists to keep off the lock path.
+    This scenario runs the full stack (SchedulerCache + Controller +
+    ExtenderServer) over InClusterClient against the stub apiserver
+    (tpushare/k8s/stubapi.py, real HTTP wire format + watch streams), so
+    every bind pays both writes on the wire.
+    """
+    from tpushare.k8s.incluster import InClusterClient
+    from tpushare.k8s.stubapi import StubApiServer
+
+    stub = StubApiServer().start()
+    client = InClusterClient(base_url=stub.base_url, timeout=10.0)
+    for i in range(4):
+        stub.seed("nodes", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"w{i}",
+                         "labels": {"tpushare": "true",
+                                    "tpushare.aliyun.com/mesh": "2x2"}},
+            "status": {"capacity": {
+                "aliyun.com/tpu-hbm": str(4 * V5E_HBM),
+                "aliyun.com/tpu-count": "4"}}})
+    cache = SchedulerCache(client)
+    ctl = Controller(client, cache)
+    ctl.build_cache()
+    ctl.start()
+    server = ExtenderServer(cache, client, host="127.0.0.1", port=0)
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/tpushare-scheduler"
+
+    def post(path, body):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    lat_ms = []
+    names = [f"w{i}" for i in range(4)]
+    try:
+        for i in range(60):
+            pod = make_pod(1 * GIB)
+            pod["metadata"]["namespace"] = "bench"
+            created = stub.seed("pods", pod)
+            t0 = time.perf_counter()
+            ok = post("/filter", {"Pod": created,
+                                  "NodeNames": names})["NodeNames"]
+            ranked = post("/prioritize", {"Pod": created, "NodeNames": ok})
+            best = max(h["Score"] for h in ranked)
+            node = next(h["Host"] for h in ranked if h["Score"] == best)
+            result = post("/bind", {
+                "PodName": created["metadata"]["name"],
+                "PodNamespace": "bench",
+                "PodUID": created["metadata"].get("uid", ""),
+                "Node": node})
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+            if result.get("Error"):
+                break
+    finally:
+        server.stop()
+        ctl.stop()
+        stub.stop()
+    lat_ms.sort()
+    return {
+        "p50": statistics.median(lat_ms),
+        "p99": lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))],
+        "pods": len(lat_ms),
+    }
+
+
 def packing_duel() -> dict:
     """Multi-node packing win of the prioritize verb (VERDICT r1 item 3).
 
@@ -386,6 +460,12 @@ def main() -> int:
     expect(ranked_count == 1000,
            f"fleet prioritize ranked all nodes ({ranked_count})")
 
+    # bind latency with real apiserver round-trips (stub apiserver wire)
+    wire = wire_latency()
+    expect(wire["p50"] < 50.0,
+           f"wire bind p50 {wire['p50']:.2f} ms < 50 ms "
+           f"(filter+prioritize+bind incl. PATCH+POST on the wire)")
+
     # multi-node packing: prioritize verb vs default-scheduler spreading
     duel = packing_duel()
     expect(duel["prioritize"] > duel["spread"],
@@ -434,6 +514,8 @@ def main() -> int:
         "p99_bind_ms": round(p99, 3),
         "filter_1k_nodes_ms": round(min(fleet_ms), 2),
         "prioritize_1k_nodes_ms": round(min(prio_ms), 2),
+        "wire_p50_bind_ms": round(wire["p50"], 3),
+        "wire_p99_bind_ms": round(wire["p99"], 3),
         "fragmentation": round(frag, 4),
         "pods": len(lat),
         "prioritize_util_pct": round(duel["prioritize"], 2),
