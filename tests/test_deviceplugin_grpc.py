@@ -297,6 +297,69 @@ def test_kubelet_restart_reregisters(stack):
         t.join(timeout=5)
 
 
+def test_allocate_storm_vs_reclaim_under_chaos():
+    """Concurrent Allocates and the stale-placement reclaim race over the
+    same pods while the apiserver randomly fails writes. Core invariant of
+    the CAS protocol: a pod is never left assigned=true without its
+    placement annotations (that would mean a container got chips the
+    extender no longer accounts)."""
+    import threading
+
+    from tpushare.deviceplugin.plugin import AllocateError, DevicePlugin
+    from tpushare.k8s import ChaosCluster, FakeCluster
+
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=64, mesh="2x2")
+    chaos = ChaosCluster(fc, seed=11)
+    enum = FakeEnumerator(4, 64, "2x2")
+    plugin = DevicePlugin(chaos, "n1", enum)
+    for i in range(8):
+        place(fc, f"racer-{i}", hbm=4, now_ns=1)  # all immediately stale
+
+    chaos.fail("replace_pod", probability=0.25, times=None)
+    chaos.fail("get_pod", probability=0.05, times=None)
+
+    stop = threading.Event()
+    errors: list[Exception] = []
+
+    def storm_allocate():
+        while not stop.is_set():
+            try:
+                plugin.allocate(hbm_mib=4)
+            except (AllocateError, Exception):  # noqa: BLE001 — chaos
+                pass
+
+    def storm_gc():
+        while not stop.is_set():
+            try:
+                plugin.gc_stale_assignments(max_pending_seconds=0.001)
+            except Exception:  # noqa: BLE001 — chaos
+                pass
+
+    threads = [threading.Thread(target=storm_allocate) for _ in range(3)]
+    threads.append(threading.Thread(target=storm_gc))
+    for t in threads:
+        t.start()
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+
+    assert sum(chaos.injected.values()) > 0, "storm injected nothing"
+    assigned_without_placement = []
+    resolved = 0
+    for i in range(8):
+        pod = fc.get_pod("default", f"racer-{i}")
+        has_placement = contract.chip_ids_from_annotations(pod) is not None
+        if contract.is_assigned(pod) and not has_placement:
+            assigned_without_placement.append(pod["metadata"]["name"])
+        if contract.is_assigned(pod) or not has_placement:
+            resolved += 1
+    assert assigned_without_placement == []
+    assert resolved > 0, "storm resolved nothing (allocate and gc both idle)"
+    del errors
+
+
 def test_hbm_preferred_allocation_fungible():
     fc, plugin = rig(chips=2, hbm=8, mesh="2x1")
     res = HBMResource(plugin)

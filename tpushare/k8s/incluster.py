@@ -34,6 +34,77 @@ from tpushare.k8s.client import ApiError, WatchEvent
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
+class _ConnPool:
+    """Keep-alive HTTP(S) connection pool for the request/response calls.
+
+    urllib opens (and for https, TLS-handshakes) a fresh connection per
+    request; on the bind hot path that is two handshakes per pod. The
+    pool checks connections out per request, so concurrent callers never
+    share an http.client connection (they are not thread-safe), and a
+    dead keep-alive connection is detected and retried once with a fresh
+    one. Watches do NOT use the pool — a watch monopolizes its connection
+    for the stream's lifetime (incluster.py _watch).
+    """
+
+    def __init__(self, host: str, port: int, https: bool,
+                 ctx: ssl.SSLContext | None, max_idle: int = 8) -> None:
+        self._host, self._port, self._https, self._ctx = \
+            host, port, https, ctx
+        self._idle: list[http.client.HTTPConnection] = []
+        self._lock = threading.Lock()
+        self._max_idle = max_idle
+
+    def _new_conn(self, timeout: float) -> http.client.HTTPConnection:
+        if self._https:
+            conn: http.client.HTTPConnection = http.client.HTTPSConnection(
+                self._host, self._port, timeout=timeout, context=self._ctx)
+        else:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=timeout)
+        conn.connect()
+        # Nagle + delayed-ACK stalls reused connections ~40ms per request
+        # (headers and body are separate send()s); a scheduler webhook
+        # cannot afford that on its bind path
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+
+    def request(self, method: str, path: str, body: bytes | None,
+                headers: dict[str, str], timeout: float
+                ) -> tuple[int, bytes]:
+        with self._lock:
+            conn = self._idle.pop() if self._idle else None
+        fresh = conn is None
+        if conn is None:
+            conn = self._new_conn(timeout)
+        else:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except (http.client.HTTPException, OSError):
+            conn.close()
+            if fresh:
+                raise
+            # stale keep-alive connection (apiserver idle-closed it):
+            # retry exactly once on a fresh socket
+            conn = self._new_conn(timeout)
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        if resp.will_close:
+            conn.close()
+        else:
+            with self._lock:
+                if len(self._idle) < self._max_idle:
+                    self._idle.append(conn)
+                else:
+                    conn.close()
+        return resp.status, data
+
+
 class InClusterClient:
     def __init__(self, base_url: str | None = None, token: str | None = None,
                  ca_file: str | None = None, timeout: float = 10.0,
@@ -62,6 +133,11 @@ class InClusterClient:
             self._ctx = ssl.create_default_context()
         else:
             self._ctx = None
+        parsed = urllib.parse.urlsplit(self.base_url)
+        self._pool = _ConnPool(
+            parsed.hostname or "localhost",
+            parsed.port or (443 if parsed.scheme == "https" else 80),
+            parsed.scheme == "https", self._ctx)
 
     @classmethod
     def from_kubeconfig(cls, path: str | None = None,
@@ -126,8 +202,22 @@ class InClusterClient:
 
     def _json(self, method: str, path: str, body: Any = None,
               content_type: str = "application/json") -> dict[str, Any]:
-        with self._request(method, path, body, content_type) as resp:
-            return json.loads(resp.read().decode())
+        """Request/response call over the keep-alive pool (watches use
+        :meth:`_request`/urllib instead — they monopolize a connection
+        for the stream's lifetime)."""
+        data = json.dumps(body).encode() if body is not None else None
+        headers = {"Accept": "application/json"}
+        if data is not None:
+            headers["Content-Type"] = content_type
+        headers.update(self._auth_header())
+        try:
+            status, raw = self._pool.request(
+                method, path, data, headers, self.timeout)
+        except (http.client.HTTPException, OSError) as e:
+            raise ApiError(0, str(e)) from None
+        if status >= 400:
+            raise ApiError(status, raw.decode(errors="replace")[:512])
+        return json.loads(raw) if raw else {}
 
     # -- reads ---------------------------------------------------------------
 

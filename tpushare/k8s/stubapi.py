@@ -89,6 +89,10 @@ class StubApiServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # keep-alive + Nagle + delayed-ACK = ~40ms per request (the
+            # headers flush and body are separate segments); real
+            # apiservers run with TCP_NODELAY for the same reason
+            disable_nagle_algorithm = True
 
             def log_message(self, *a):  # quiet
                 pass
