@@ -346,12 +346,23 @@ def _kernel_bench_inline() -> dict | None:
                                 cfg.vocab)
     fwd = jax.jit(lambda p, t: forward(p, t, cfg))
     fwd_ms = best_ms(fwd, params, tokens)
+
+    # serving decode path (BASELINE config #5 is int8 llama serving):
+    # KV-cached greedy decode throughput on int8-quantized weights
+    from tpushare.workloads.model import greedy_decode_kv, quantize_int8
+    qparams = quantize_int8(params)
+    steps = 64
+    prompt = tokens[:, :128]
+    dec = jax.jit(lambda p, t: greedy_decode_kv(p, t, steps, cfg))
+    dec_ms = best_ms(dec, qparams, prompt, reps=5)
     return {
         "flash_ms": round(flash_ms, 3),
         "einsum_ms": round(einsum_ms, 3),
         "flash_speedup": round(einsum_ms / flash_ms, 3),
         "flash_mfu_pct": round(mfu_pct, 2),
         "llama_mini_fwd_tokens_per_s": round(mb * ms / (fwd_ms / 1e3)),
+        "llama_mini_int8_decode_tokens_per_s": round(
+            mb * steps / (dec_ms / 1e3)),
         "attn_shape": f"B{B} H{H} S{S} D{D} bf16 causal",
     }
 
@@ -531,6 +542,8 @@ def main() -> int:
             "flash_mfu_pct": kernel["flash_mfu_pct"],
             "llama_mini_fwd_tokens_per_s":
                 kernel["llama_mini_fwd_tokens_per_s"],
+            "llama_mini_int8_decode_tokens_per_s":
+                kernel["llama_mini_int8_decode_tokens_per_s"],
         })
     print(json.dumps(out))
     return 1 if failed else 0

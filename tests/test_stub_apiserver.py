@@ -196,6 +196,33 @@ def test_watch_resumes_from_rv_after_clean_close(stub, client):
         assert w.names() == ["p0", "p1", "p2"]
 
 
+def test_leader_election_over_the_wire(stub):
+    """Two elector replicas CAS the same Lease through the stub apiserver:
+    exactly one leads, and stopping it fails over to the other — the
+    wire-level version of tests/test_ha.py's fake-cluster coverage."""
+    from tpushare.ha.leaderelection import LeaderElector
+
+    c1 = InClusterClient(base_url=stub.base_url, timeout=5.0)
+    c2 = InClusterClient(base_url=stub.base_url, timeout=5.0)
+    e1 = LeaderElector(c1, identity="r1", lease_duration=1.0,
+                       renew_period=0.2, retry_period=0.05)
+    e2 = LeaderElector(c2, identity="r2", lease_duration=1.0,
+                       renew_period=0.2, retry_period=0.05)
+    e1.start()
+    e2.start()
+    try:
+        assert wait_until(lambda: e1.is_leader() ^ e2.is_leader())
+        leader, follower = (e1, e2) if e1.is_leader() else (e2, e1)
+        leader.stop()  # abdicates; follower must take over via lease CAS
+        assert wait_until(follower.is_leader, timeout=15.0)
+        lease = stub.get("leases", "kube-system/tpushare-schd-extender")
+        assert lease is not None
+        assert lease["spec"]["holderIdentity"] == follower.identity
+    finally:
+        e1.stop()
+        e2.stop()
+
+
 # -- the full stack over the wire ---------------------------------------------
 
 

@@ -454,3 +454,62 @@ class StubApiServer:
                 s.close()
             except OSError:
                 pass
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Standalone stub apiserver for local development:
+
+        python -m tpushare.k8s.stubapi --port 8001 --tpu-nodes n1:4x16384
+        python -m tpushare.extender --apiserver http://127.0.0.1:8001
+
+    gives the full real-wire control plane (watches, PATCH, binding) with
+    no cluster."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="tpushare-stub-apiserver")
+    ap.add_argument("--port", type=int, default=8001)
+    ap.add_argument("--token", default=None,
+                    help="require this bearer token when set")
+    ap.add_argument("--tpu-nodes", default=None,
+                    help="seed TPU nodes: 'n1:4x16384:2x2,n2:2x8192'")
+    args = ap.parse_args(argv)
+
+    stub = StubApiServer(token=args.token)
+    # rebind to the requested port
+    stub._server.server_close()
+    from http.server import ThreadingHTTPServer
+    handler = stub._server.RequestHandlerClass
+    stub._server = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
+    stub._server.daemon_threads = True
+    stub.start()
+    for spec in (args.tpu_nodes or "").split(","):
+        if not spec:
+            continue
+        parts = spec.split(":")
+        name = parts[0]
+        chips_s, _, hbm_s = parts[1].partition("x")
+        mesh = parts[2] if len(parts) > 2 else None
+        labels = {"tpushare": "true"}
+        if mesh:
+            labels["tpushare.aliyun.com/mesh"] = mesh
+        total = int(chips_s) * int(hbm_s)
+        stub.seed("nodes", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels},
+            "status": {"capacity": {
+                "aliyun.com/tpu-hbm": str(total),
+                "aliyun.com/tpu-count": chips_s}}})
+        print(f"seeded node {name}: {chips_s} chips x {hbm_s} MiB")
+    print(f"stub apiserver on {stub.base_url}")
+    try:
+        import threading
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    stub.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
