@@ -77,6 +77,12 @@ def test_crud_and_strategic_merge(stub, client):
         client.get_pod("default", "ghost")
     assert ei.value.is_not_found
 
+    # node-scoped LIST via apiserver fieldSelector (device-plugin hot path)
+    stub.seed("pods", make_pod(name="other", node="n2"))
+    assert {p["metadata"]["name"]
+            for p in client.list_pods(node_name="n1")} == {"p1"}
+    assert len(client.list_pods()) == 2
+
 
 def test_binding_subresource_and_uid_conflict(stub, client):
     created = stub.seed("pods", make_pod(hbm=1, name="p1", uid="uid-a"))

@@ -136,7 +136,7 @@ class DevicePlugin:
                      pods: list[dict[str, Any]] | None = None
                      ) -> list[dict[str, Any]]:
         if pods is None:
-            pods = self._cluster.list_pods()
+            pods = self._list_node_pods()
         out = []
         for pod in pods:
             if podlib.pod_node_name(pod) != self.node_name:
@@ -151,6 +151,14 @@ class DevicePlugin:
         out.sort(key=lambda p: (contract.assume_time_from_annotations(p),
                                 podlib.pod_uid(p)))
         return out
+
+    def _list_node_pods(self) -> list[dict[str, Any]]:
+        """One node-scoped LIST (apiserver fieldSelector where supported):
+        the Allocate hot path must not transfer the whole cluster's pods."""
+        try:
+            return self._cluster.list_pods(node_name=self.node_name)
+        except TypeError:  # older/simpler client without the selector
+            return self._cluster.list_pods()
 
     def pending_pods(self, pods: list[dict[str, Any]] | None = None
                      ) -> list[dict[str, Any]]:
@@ -192,7 +200,7 @@ class DevicePlugin:
                     return pod
             return None
 
-        snapshot = self._cluster.list_pods()  # one LIST serves both passes
+        snapshot = self._list_node_pods()  # one LIST serves both passes
         candidates = self.pending_pods(snapshot)
         chosen = pick(candidates)
         if chosen is not None:
@@ -224,7 +232,7 @@ class DevicePlugin:
         4. otherwise raise, so a genuinely unmatched exclusive container
            fails container start instead of silently running without TPUs.
         """
-        snapshot = self._cluster.list_pods()  # one LIST serves all passes
+        snapshot = self._list_node_pods()  # one LIST serves all passes
         pending = self.pending_pods(snapshot)
         assigned = self.assigned_pods(snapshot)
 

@@ -141,9 +141,13 @@ class FakeCluster:
 
     # -- ClusterClient reads -------------------------------------------------
 
-    def list_pods(self) -> list[dict[str, Any]]:
+    def list_pods(self, node_name: str | None = None) -> list[dict[str, Any]]:
         with self._lock:
-            return copy.deepcopy(list(self._pods.values()))
+            pods = list(self._pods.values())
+        if node_name:
+            pods = [p for p in pods
+                    if (p.get("spec") or {}).get("nodeName") == node_name]
+        return copy.deepcopy(pods)
 
     def get_pod(self, namespace: str, name: str) -> dict[str, Any]:
         with self._lock:

@@ -221,8 +221,15 @@ class InClusterClient:
 
     # -- reads ---------------------------------------------------------------
 
-    def list_pods(self) -> list[dict[str, Any]]:
-        return self._json("GET", "/api/v1/pods").get("items", [])
+    def list_pods(self, node_name: str | None = None) -> list[dict[str, Any]]:
+        """LIST pods cluster-wide, or — the device-plugin hot path — only
+        one node's pods via an apiserver-side fieldSelector (an Allocate
+        on a 5000-pod cluster must not transfer the whole pod list)."""
+        path = "/api/v1/pods"
+        if node_name:
+            path += "?" + urllib.parse.urlencode(
+                {"fieldSelector": f"spec.nodeName={node_name}"})
+        return self._json("GET", path).get("items", [])
 
     def get_pod(self, namespace: str, name: str) -> dict[str, Any]:
         return self._json("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")

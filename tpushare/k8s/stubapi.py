@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
@@ -181,6 +182,12 @@ class StubApiServer:
                         return self._send_json(200, obj)
                     items = [o for k, o in sorted(state.objects[kind].items())
                              if not ns or k.startswith(f"{ns}/")]
+                    fs = urllib.parse.unquote(q.get("fieldSelector", ""))
+                    if fs.startswith("spec.nodeName="):
+                        want = fs.split("=", 1)[1]
+                        items = [o for o in items
+                                 if (o.get("spec") or {}).get(
+                                     "nodeName") == want]
                     return self._send_json(200, {
                         "kind": "List", "items": items,
                         "metadata": {"resourceVersion": str(state.rv)}})
