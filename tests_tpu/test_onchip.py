@@ -1,0 +1,115 @@
+"""Compiled-kernel correctness on real TPU (VERDICT r2 item 3).
+
+The interpret-mode suite (tests/test_attention.py) proves the kernel's
+*algorithm*; these tests prove the *Mosaic compilation* of it — the thing
+the bench times — computes the same values. Forward AND custom-VJP
+backward vs the einsum reference, at the bench shape and at ragged shapes
+(S not a multiple of the 128 tile), plus a regression for the
+unequal-block emit-clamp bug (block_q=768/block_kv=1024 left the last
+padded q block un-emitted before the clamp in attention.py `last`).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpushare.workloads.attention import (
+    attention_reference, flash_attention)
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="requires a real TPU backend (compiled Mosaic path)")
+
+
+def rand_qkv(key, B, H, S, D, dtype):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (B, H, S, D), dtype),
+            jax.random.normal(kk, (B, H, S, D), dtype),
+            jax.random.normal(kv, (B, H, S, D), dtype))
+
+
+def assert_close(a, b, atol, rtol=2e-2):
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32),
+                               atol=atol, rtol=rtol)
+
+
+def test_forward_parity_bench_shape_bf16():
+    # the exact shape bench.py times — parity here is what licenses the
+    # published flash_ms/mfu numbers
+    q, k, v = rand_qkv(jax.random.key(0), 4, 8, 2048, 128, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)   # compiled: interpret=False
+    ref = attention_reference(q, k, v, causal=True)
+    assert_close(out, ref, atol=5e-2)
+
+
+def test_forward_parity_ragged_seq():
+    # S=300: pads to the tile, masks padded keys, slices padded queries
+    q, k, v = rand_qkv(jax.random.key(1), 2, 4, 300, 64, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    assert_close(out, attention_reference(q, k, v, causal=True), atol=5e-2)
+    out_nc = flash_attention(q, k, v, causal=False)
+    assert_close(out_nc, attention_reference(q, k, v, causal=False),
+                 atol=5e-2)
+
+
+def test_forward_parity_unequal_blocks_clamp_regression():
+    # block_q=768 over S=2048 pads Sp to 2304; the last q block's causal
+    # diagonal formula points past the kv grid and must be clamped or its
+    # real rows (1536..2047) are never emitted
+    q, k, v = rand_qkv(jax.random.key(2), 2, 2, 2048, 128, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block_q=768, block_kv=1024)
+    assert_close(out, attention_reference(q, k, v, causal=True), atol=5e-2)
+
+
+def test_forward_parity_fp32():
+    # fp32 inputs: NOT machine-precision on TPU — the MXU decomposes fp32
+    # matmuls into bf16 passes (XLA default precision), and the kernel and
+    # the einsum path decompose differently. Measured max|d| ~7e-3 at
+    # S=512; the tolerance bounds that class of error, not exactness.
+    q, k, v = rand_qkv(jax.random.key(3), 2, 4, 512, 64, jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    assert_close(out, attention_reference(q, k, v, causal=True),
+                 atol=2e-2, rtol=2e-2)
+
+
+def test_backward_parity_fp32():
+    # custom VJP (blockwise backward from the kernel's LSE residual) vs
+    # einsum autodiff, compiled, fp32 so tolerances are meaningful
+    q, k, v = rand_qkv(jax.random.key(4), 2, 4, 384, 64, jnp.float32)
+    w = jax.random.normal(jax.random.key(5), q.shape, jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) * w)
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        # same bf16-pass MXU caveat as the fp32 forward test
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-2, rtol=3e-2,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_backward_parity_ragged_bf16():
+    # ragged S + bf16: the shapes training actually uses
+    q, k, v = rand_qkv(jax.random.key(6), 2, 2, 300, 64, jnp.bfloat16)
+    w = jax.random.normal(jax.random.key(7), q.shape, jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum((flash_attention(q, k, v, causal=True)
+                        * w).astype(jnp.float32))
+
+    def loss_ref(q, k, v):
+        return jnp.sum((attention_reference(q, k, v, causal=True)
+                        * w).astype(jnp.float32))
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        assert_close(a, b, atol=1e-1, rtol=5e-2)
