@@ -270,7 +270,52 @@ def packing_duel() -> dict:
     return {"spread": run(False), "prioritize": run(True)}
 
 
-def tpu_kernel_bench(timeout_s: float = 600.0) -> dict | None:
+def onchip_tests(timeout_s: float = 900.0) -> dict:
+    """Run the compiled-kernel correctness suite (tests_tpu/) in its OWN
+    subprocess, sequenced before the kernel-timing subprocess — two
+    processes cannot hold the TPU at once, so nesting one inside the
+    other hangs the inner backend init.
+
+    Returns {"status": "passed"|"skipped"|"failed"|"error",
+    "summary": <pytest tail line>}. "skipped" = every test skipped =
+    no TPU backend; "passed" licenses the kernel numbers and OBLIGES the
+    kernel bench to produce them (a TPU host that then yields no numbers
+    is a bench failure, not a skip).
+    """
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    suite = os.path.join(here, "tests_tpu")
+    if not os.path.isdir(suite):
+        # a checkout without the correctness suite must not silently
+        # publish on-chip numbers
+        return {"status": "error", "summary": "tests_tpu/ missing"}
+    try:
+        t = subprocess.run(
+            [sys.executable, "-m", "pytest", suite, "-q", "--no-header",
+             "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"status": "error", "summary": "tests_tpu timed out"}
+    except OSError as e:
+        return {"status": "error", "summary": f"tests_tpu: {e}"}
+    tail = ""
+    for line in reversed((t.stdout or "").strip().splitlines()):
+        if "passed" in line or "skipped" in line or "failed" in line \
+                or "error" in line:
+            tail = line.strip().strip("= ")
+            break
+    if t.returncode == 5:  # pytest: no tests collected
+        return {"status": "skipped", "summary": tail or "no tests collected"}
+    if t.returncode != 0:
+        return {"status": "failed",
+                "summary": tail or (t.stderr or "nonzero exit")
+                .strip().splitlines()[-1][:120]}
+    if "passed" in tail:
+        return {"status": "passed", "summary": tail}
+    return {"status": "skipped", "summary": tail or "no tests ran"}
+
+
+def tpu_kernel_bench(timeout_s: float = 1500.0) -> dict | None:
     """Real-chip kernel numbers (VERDICT r1 item 4), run in a SUBPROCESS:
     TPU backend init can hang outright when the chip is held by another
     process or the tunnel is down, and a hung kernel section must not take
@@ -296,75 +341,184 @@ def tpu_kernel_bench(timeout_s: float = 600.0) -> dict | None:
     return None
 
 
+# Per-JAX-device peak dense bf16 TFLOP/s by device_kind (v2/v3 expose each
+# core as a device, so those entries are per-core). An unknown kind yields
+# mfu=None rather than a number computed against the wrong chip — VERDICT
+# r2 weak #4: a hardcoded v5e constant made the metric meaningless
+# anywhere else.
+PEAK_BF16_TFLOPS_BY_KIND = {
+    "TPU v2": 22.5, "TPU v3": 61.5,
+    "TPU v4": 275.0, "TPU v4 lite": 138.0,
+    "TPU v5 lite": 197.0, "TPU v5": 459.0, "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0, "TPU v6e": 918.0,
+}
+
+
 def _kernel_bench_inline() -> dict | None:
-    """The actual on-chip measurement (see tpu_kernel_bench): Pallas flash
-    attention vs the einsum reference at a serving shape
-    (workloads/attention.py's HBM-hot-spot claim), plus llama-mini forward
-    throughput."""
+    """The actual on-chip measurement (see tpu_kernel_bench).
+
+    Timing methodology (VERDICT r2 weak #1 — the per-call wall-clock
+    numbers were physically impossible): on this rig the chip sits behind
+    a network tunnel, so ONE dispatch costs ~67 ms of RTT while the kernel
+    itself runs ~0.5 ms — per-call timing measures the tunnel, and its
+    jitter once produced 741% MFU. Instead each workload is run as an
+    in-jit ``lax.scan`` whose carry feeds iteration i's output into
+    iteration i+1's input (data dependence defeats caching/elision; the
+    final carry is read back to the host so nothing is dead-code), at two
+    scan lengths; (T(n2) - T(n1)) / (n2 - n1) cancels the
+    dispatch/transfer constant and leaves pure per-iteration device time.
+
+    Before anything is timed, the compiled kernel's outputs are asserted
+    against the einsum reference ON CHIP, and the tests_tpu/ suite
+    (compiled forward + backward parity incl. ragged shapes) must pass —
+    a kernel that compiled but computes garbage would otherwise still post
+    a great time.
+    """
     try:
         import jax
         import jax.numpy as jnp
+        import numpy as np
     except Exception:  # noqa: BLE001
         return None
     if jax.default_backend() != "tpu":
         return None
+
     from tpushare.workloads.attention import (
         attention_reference, flash_attention)
-    from tpushare.workloads.model import PRESETS, forward, init_params
+    from tpushare.workloads.model import (
+        PRESETS, forward, greedy_decode_kv, init_params, quantize_int8)
 
-    def best_ms(fn, *args, reps: int = 10) -> float:
-        jax.block_until_ready(fn(*args))  # compile warmup
-        times = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn(*args))
-            times.append((time.perf_counter() - t0) * 1e3)
-        return min(times)
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_BF16_TFLOPS_BY_KIND.get(kind)
+
+    out: dict = {"device_kind": kind,
+                 "peak_bf16_tflops": peak,
+                 "timing_method": "in-jit scan slope (n=5 vs n=205), "
+                                  "chained carry, dispatch cancelled"}
 
     B, H, S, D = 4, 8, 2048, 128
-    key = jax.random.PRNGKey(0)
-    kq, kk, kv = jax.random.split(key, 3)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
     k = jax.random.normal(kk, (B, H, S, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, H, S, D), jnp.bfloat16)
 
-    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    einsum = jax.jit(
-        lambda q, k, v: attention_reference(q, k, v, causal=True))
-    flash_ms = best_ms(flash, q, k, v)
-    einsum_ms = best_ms(einsum, q, k, v)
+    def flash(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    def einsum(q, k, v):
+        return attention_reference(q, k, v, causal=True)
+
+    # gate 2: parity at the exact shape being timed
+    fo = np.asarray(jax.jit(flash)(q, k, v).astype(jnp.float32))
+    eo = np.asarray(jax.jit(einsum)(q, k, v).astype(jnp.float32))
+    parity = float(np.abs(fo - eo).max())
+    out["flash_vs_einsum_max_abs"] = round(parity, 5)
+    out["parity_ok"] = bool(np.isfinite(parity) and parity < 5e-2)
+
+    def scan_loop(attn_fn, n):
+        @jax.jit
+        def loop(q, k, v):
+            def body(qq, _):
+                return attn_fn(qq, k, v).astype(qq.dtype), ()
+            final = jax.lax.scan(body, q, None, length=n)[0]
+            # scalar reduction of the final carry: the host reads back 4
+            # bytes that (transitively) depend on every iteration
+            return jnp.sum(final.astype(jnp.float32))
+        return loop
+
+    def slope_ms(make_loop, args, n1=5, n2=205, reps=3) -> float:
+        l1, l2 = make_loop(n1), make_loop(n2)
+
+        def best(loop):
+            float(np.asarray(jax.tree_util.tree_leaves(
+                loop(*args))[0]).ravel()[0])  # compile warmup
+            t_best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                r = loop(*args)
+                # host readback of a value dependent on real results —
+                # block_until_ready alone is what produced r2's 741% MFU
+                float(np.asarray(jax.tree_util.tree_leaves(r)[0])
+                      .ravel()[0])
+                t_best = min(t_best, (time.perf_counter() - t0) * 1e3)
+            return t_best
+        return (best(l2) - best(l1)) / (n2 - n1)
+
+    flash_ms = slope_ms(lambda n: scan_loop(flash, n), (q, k, v))
+    einsum_ms = slope_ms(lambda n: scan_loop(einsum, n), (q, k, v))
     # causal attention FLOPs: 2 matmuls x 2 MACs x B H S^2 D, halved by
     # the causal triangle
-    flops = 2.0 * B * H * S * S * D
-    V5E_PEAK_BF16 = 197e12  # TPU v5e: 394 TOPS int8 / 197 TFLOP/s bf16
-    mfu_pct = flops / (flash_ms / 1e3) / V5E_PEAK_BF16 * 100.0
+    attn_flops = 2.0 * B * H * S * S * D
 
+    def mfu(ms: float) -> float | None:
+        if peak is None or ms <= 0:
+            return None
+        return round(attn_flops / (ms / 1e3) / (peak * 1e12) * 100.0, 2)
+
+    out.update({
+        "attn_shape": f"B{B} H{H} S{S} D{D} bf16 causal",
+        "flash_ms": round(flash_ms, 4),
+        "einsum_ms": round(einsum_ms, 4),
+        "flash_speedup": round(einsum_ms / flash_ms, 3),
+        "flash_mfu_pct": mfu(flash_ms),
+        "einsum_mfu_pct": mfu(einsum_ms),
+    })
+
+    # llama-mini forward: tokens chained through argmax(logits) so each
+    # scan iteration depends on the previous forward's real output
     cfg = PRESETS["llama-mini"].validate()
     params = init_params(cfg, jax.random.PRNGKey(1))
     mb, ms = 8, 512
     tokens = jax.random.randint(jax.random.PRNGKey(2), (mb, ms), 0,
                                 cfg.vocab)
-    fwd = jax.jit(lambda p, t: forward(p, t, cfg))
-    fwd_ms = best_ms(fwd, params, tokens)
 
-    # serving decode path (BASELINE config #5 is int8 llama serving):
-    # KV-cached greedy decode throughput on int8-quantized weights
-    from tpushare.workloads.model import greedy_decode_kv, quantize_int8
-    qparams = quantize_int8(params)
-    steps = 64
-    prompt = tokens[:, :128]
-    dec = jax.jit(lambda p, t: greedy_decode_kv(p, t, steps, cfg))
-    dec_ms = best_ms(dec, qparams, prompt, reps=5)
-    return {
-        "flash_ms": round(flash_ms, 3),
-        "einsum_ms": round(einsum_ms, 3),
-        "flash_speedup": round(einsum_ms / flash_ms, 3),
-        "flash_mfu_pct": round(mfu_pct, 2),
+    def fwd_loop(n):
+        @jax.jit
+        def loop(p, t):
+            def body(tt, _):
+                logits = forward(p, tt, cfg)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), ()
+            return jnp.sum(jax.lax.scan(body, t, None, length=n)[0])
+        return loop
+
+    fwd_ms = slope_ms(fwd_loop, (params, tokens))
+    fwd_flops = None
+    try:  # XLA's own cost model for the forward step
+        cost = (jax.jit(lambda p, t: forward(p, t, cfg))
+                .lower(params, tokens).compile().cost_analysis())
+        if cost and cost.get("flops"):
+            fwd_flops = float(cost["flops"])
+    except Exception:  # noqa: BLE001
+        pass
+    out.update({
+        "llama_mini_fwd_shape": f"batch {mb} x seq {ms}",
+        "llama_mini_fwd_ms": round(fwd_ms, 3),
         "llama_mini_fwd_tokens_per_s": round(mb * ms / (fwd_ms / 1e3)),
+        "llama_mini_fwd_mfu_pct": (
+            round(fwd_flops / (fwd_ms / 1e3) / (peak * 1e12) * 100.0, 2)
+            if (fwd_flops and peak) else None),
+    })
+
+    # serving decode (BASELINE config #5 is int8 llama serving): KV-cached
+    # greedy decode. steps is static under jit, so the slope runs the SAME
+    # jitted program shape twice (8 vs 72 steps) and the difference is 64
+    # real sequential single-token steps — a per-call number would fold
+    # prefill + dispatch into it.
+    qparams = quantize_int8(params)
+    prompt = tokens[:, :128]
+
+    def dec_loop(steps):
+        return jax.jit(
+            lambda p, t: jnp.sum(greedy_decode_kv(p, t, steps, cfg)))
+
+    d1, d2 = 8, 72
+    dec_ms_step = slope_ms(dec_loop, (qparams, prompt), n1=d1, n2=d2)
+    out.update({
+        "int8_decode_step_ms": round(dec_ms_step, 4),
         "llama_mini_int8_decode_tokens_per_s": round(
-            mb * steps / (dec_ms / 1e3)),
-        "attn_shape": f"B{B} H{H} S{S} D{D} bf16 causal",
-    }
+            mb / (dec_ms_step / 1e3)),
+    })
+    return out
 
 
 def main() -> int:
@@ -483,15 +637,39 @@ def main() -> int:
            f"prioritize packs tighter than spreading "
            f"({duel['prioritize']:.1f}% vs {duel['spread']:.1f}%)")
 
-    # real-chip kernel numbers (skipped cleanly off-TPU)
-    kernel = tpu_kernel_bench()
+    # real-chip section: correctness suite first, then kernel timings —
+    # sequential subprocesses (each must own the chip alone)
+    onchip = onchip_tests()
+    kernel = None
+    if onchip["status"] == "passed":
+        expect(True, f"on-chip compiled-kernel tests ({onchip['summary']})")
+        kernel = tpu_kernel_bench()
+        expect(kernel is not None,
+               "kernel bench produced numbers on a TPU host "
+               "(crash/timeout is a failure, not a skip)")
+    elif onchip["status"] == "skipped":
+        print(f"# kernel bench skipped (no TPU backend: "
+              f"{onchip['summary']})", file=sys.stderr)
+    else:
+        expect(False, f"on-chip test suite {onchip['status']}: "
+                      f"{onchip['summary']}")
     if kernel is not None:
+        expect(kernel.get("parity_ok", False),
+               f"flash==einsum on chip at bench shape "
+               f"(max|d| {kernel.get('flash_vs_einsum_max_abs')})")
+        # the r2 numbers were physically impossible (741% MFU) and were
+        # published anyway; any MFU outside (0, 100] now FAILS the bench
+        for key in ("flash_mfu_pct", "einsum_mfu_pct",
+                    "llama_mini_fwd_mfu_pct"):
+            mfu = kernel.get(key)
+            if mfu is not None:
+                expect(0.0 < mfu <= 100.0,
+                       f"{key} physically plausible ({mfu}% on "
+                       f"{kernel['device_kind']})")
         expect(kernel["flash_speedup"] > 1.0,
                f"flash kernel beats einsum attention "
                f"(x{kernel['flash_speedup']})")
         print(f"# kernel: {kernel}", file=sys.stderr)
-    else:
-        print("# kernel bench skipped (no TPU backend)", file=sys.stderr)
 
     tree = d.inspect()
     util = tree["used_hbm_mib"] / tree["total_hbm_mib"] * 100.0
@@ -516,35 +694,41 @@ def main() -> int:
     ctl.stop()
 
     failed = [c for c in checks if c.startswith("FAIL")]
+    # sections are labeled by what they prove (VERDICT r2 item 7):
+    # hermetic = in-process FakeCluster (no wire), wire = stub apiserver
+    # over real HTTP (no TLS/auth/etcd — a hermetic proxy, not a cluster
+    # number), on_chip = real TPU with the chip model recorded.
     out = {
         "metric": "hbm_binpack_utilization_v5e",
         "value": round(util, 2),
         "unit": "%",
         "vs_baseline": round(util / 90.0, 4),
-        "p50_bind_ms": round(p50, 3),
-        "p99_bind_ms": round(p99, 3),
-        "filter_1k_nodes_ms": round(min(fleet_ms), 2),
-        "prioritize_1k_nodes_ms": round(min(prio_ms), 2),
-        "wire_p50_bind_ms": round(wire["p50"], 3),
-        "wire_p99_bind_ms": round(wire["p99"], 3),
-        "fragmentation": round(frag, 4),
-        "pods": len(lat),
-        "prioritize_util_pct": round(duel["prioritize"], 2),
-        "spread_util_pct": round(duel["spread"], 2),
-        "packing_win_pct": round(duel["prioritize"] - duel["spread"], 2),
-        "suite_failures": len(failed),
+        "hermetic": {
+            "p50_bind_ms": round(p50, 3),
+            "p99_bind_ms": round(p99, 3),
+            "filter_1k_nodes_ms": round(min(fleet_ms), 2),
+            "prioritize_1k_nodes_ms": round(min(prio_ms), 2),
+            "fragmentation": round(frag, 4),
+            "pods": len(lat),
+            "prioritize_util_pct": round(duel["prioritize"], 2),
+            "spread_util_pct": round(duel["spread"], 2),
+            "packing_win_pct": round(duel["prioritize"] - duel["spread"],
+                                     2),
+        },
+        "wire": {
+            "note": "stub apiserver loopback: real HTTP wire format incl. "
+                    "PATCH+binding POST, but no TLS/auth/etcd fsync",
+            "p50_bind_ms": round(wire["p50"], 3),
+            "p99_bind_ms": round(wire["p99"], 3),
+        },
+        "on_chip": dict(
+            {"correctness_suite": onchip["summary"],
+             "correctness_status": onchip["status"]},
+            **(kernel or {})),
+        # bench-internal PASS/FAIL checks, NOT the pytest suite (ADVICE
+        # r2: the old name 'suite_failures' read as pytest state)
+        "bench_check_failures": len(failed),
     }
-    if kernel is not None:
-        out.update({
-            "flash_attn_ms": kernel["flash_ms"],
-            "einsum_attn_ms": kernel["einsum_ms"],
-            "flash_speedup": kernel["flash_speedup"],
-            "flash_mfu_pct": kernel["flash_mfu_pct"],
-            "llama_mini_fwd_tokens_per_s":
-                kernel["llama_mini_fwd_tokens_per_s"],
-            "llama_mini_int8_decode_tokens_per_s":
-                kernel["llama_mini_int8_decode_tokens_per_s"],
-        })
     print(json.dumps(out))
     return 1 if failed else 0
 
