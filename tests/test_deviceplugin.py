@@ -28,6 +28,8 @@ def rig(chips=4, hbm=16000, mesh="2x2", node="n1"):
 def place(fc, name, hbm, count=1, node="n1", now_ns=None):
     """Run the extender's bind path to produce a placed pod."""
     cache = SchedulerCache(fc)
+    cache.build_cache()  # replay prior placements, or successive place()
+    # calls each see an empty node and oversubscribe the first chip
     info = cache.get_node_info(node)
     pod = fc.create_pod(make_pod(hbm=hbm, count=count if count > 1 else 0,
                                  name=name))

@@ -38,9 +38,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--plugin-dir", default=DEFAULT_PLUGIN_DIR,
                     help="kubelet device-plugins dir (kubelet.sock lives "
                          "here; our endpoints are created in it)")
-    ap.add_argument("--hbm-unit", type=int,
-                    default=int(os.environ.get("TPUSHARE_HBM_UNIT_MIB", "1")),
-                    help="MiB per advertised tpu-hbm device; 1024 = the "
+    def hbm_unit(raw: str):
+        if raw == "auto":
+            return raw
+        try:
+            return int(raw)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"{raw!r} is not an integer or 'auto'") from None
+
+    ap.add_argument("--hbm-unit", type=hbm_unit,
+                    default=os.environ.get("TPUSHARE_HBM_UNIT_MIB", "auto"),
+                    help="MiB per advertised tpu-hbm device, or 'auto' "
+                         "(default) to pick the smallest unit whose device "
+                         "list fits kubelet's 4 MB gRPC cap; 1024 = the "
                          "reference's --memory-unit=GiB mode")
     ap.add_argument("--no-kubelet", action="store_true",
                     help="skip the kubelet gRPC endpoints (dev only)")

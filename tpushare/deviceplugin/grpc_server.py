@@ -47,7 +47,8 @@ from tpushare.deviceplugin.grpc_api import (
     deviceplugin_handler,
     unix_channel,
 )
-from tpushare.deviceplugin.plugin import AllocateError, DevicePlugin
+from tpushare.deviceplugin.plugin import (
+    AllocateError, DevicePlugin, hbm_device_id)
 from tpushare.deviceplugin.protos import deviceplugin_pb2 as pb
 
 log = logging.getLogger("tpushare.deviceplugin.grpc")
@@ -92,16 +93,31 @@ class HBMResource:
         for chip in self.plugin.chips:
             health = UNHEALTHY if chip.idx in unhealthy_chips else HEALTHY
             for u in range(chip.hbm_mib // self.plugin.unit_mib):
-                out.append(pb.Device(ID=f"hbm-c{chip.idx}-u{u}",
+                out.append(pb.Device(ID=hbm_device_id(chip.idx, u),
                                      health=health))
         return out
 
     def allocate(self, devices_ids: list[str]) -> dict[str, Any] | None:
-        return self.plugin.allocate(hbm_mib=len(devices_ids))
+        # the granted IDs go along: an exact placement-range match names
+        # the pod directly (same-size rendezvous, VERDICT r2 item 4)
+        return self.plugin.allocate(hbm_mib=len(devices_ids),
+                                    device_ids=devices_ids)
 
     def preferred(self, available: list[str], must_include: list[str],
                   size: int) -> list[str]:
-        # HBM units are fungible; any subset works. Honor must_include.
+        # Steer kubelet to the earliest pending placement's exact unit
+        # range so the granted device set itself identifies the pod.
+        # kubelet excludes devices it already granted, so once a range is
+        # consumed the next container start is steered to the next
+        # placement's range.
+        avail = set(available) | set(must_include)
+        must = set(must_include)
+        for pod, r in self.plugin.placement_unit_ranges():
+            if contract.is_assigned(pod):
+                continue
+            if len(r) == size and r <= avail and must <= r:
+                return sorted(r)
+        # no pending placement of this size: HBM units are fungible
         return _fill_preferred(available, must_include, size)
 
 
@@ -309,6 +325,9 @@ class DevicePluginService:
 
     def start(self, kubelet_socket: str | None = None,
               register: bool = True) -> None:
+        # the kubelet transport has a hard 4MB message cap: refuse to
+        # serve a device list that cannot fit it (--hbm-unit misconfig)
+        self.plugin.validate_kubelet_message_size()
         for s in self.servers:
             s.start()
         if register:
@@ -446,6 +465,12 @@ class FakeKubelet:
         """Issue an Allocate the way kubelet would for a container
         requesting ``n`` units of ``resource``."""
         stub = self._stubs[resource]
+        # kubelet never allocates a resource before its ListAndWatch has
+        # reported a device list; reading healthy_ids() directly raced the
+        # per-resource watch thread (a test that waited for the tpu-hbm
+        # snapshot could allocate tpu-count before ITS snapshot landed —
+        # the r2 cross-test flake)
+        self.wait_for_devices(resource)
         available = self.healthy_ids(resource)
         if len(available) < n:
             raise AllocateError(

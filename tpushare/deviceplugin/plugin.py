@@ -48,6 +48,48 @@ class AllocateError(Exception):
     pass
 
 
+def hbm_device_id(chip_idx: int, unit: int) -> str:
+    """Device-ID scheme for the tpu-hbm device set (one Device per request
+    unit of chip HBM): the chip AND the unit slot are encoded, so a set of
+    granted IDs can name a specific placement (see
+    :meth:`DevicePlugin.placement_unit_ranges`)."""
+    return f"hbm-c{chip_idx}-u{unit}"
+
+
+# kubelet's gRPC receive limit for a ListAndWatch response; a device list
+# that exceeds it wedges plugin registration with an opaque RST. MiB
+# denomination overflows it around ~120k devices (a 95 GiB/chip v5p host is
+# ~390k), which is why the unit must scale with the chip class (reference's
+# --memory-unit=GiB flag, device-plugin-ds.yaml:33).
+KUBELET_GRPC_MSG_CAP = 4 * 1024 * 1024
+_MSG_MARGIN = 0.75  # keep headroom for proto framing drift / extra fields
+_UNIT_LADDER = (1, 1024)  # MiB, then GiB (the reference's two modes)
+
+
+def estimate_listandwatch_bytes(chips, unit_mib: int) -> int:
+    """Upper-bound serialized size of one tpu-hbm ListAndWatchResponse:
+    per Device ~ len(ID) + len("Unhealthy") + 2 field tags + 2 length
+    prefixes + the repeated-field tag. Deliberately pessimistic."""
+    n = sum(c.hbm_mib // unit_mib for c in chips)
+    if n == 0:
+        return 0
+    worst_id = max(len(hbm_device_id(c.idx, c.hbm_mib // unit_mib))
+                   for c in chips)
+    return n * (worst_id + 16)
+
+
+def select_unit_mib(chips) -> int:
+    """Smallest ladder unit whose device list fits kubelet's message cap
+    (the ``--hbm-unit=auto`` mode the manifests ship with)."""
+    for unit in _UNIT_LADDER:
+        if estimate_listandwatch_bytes(chips, unit) <= \
+                KUBELET_GRPC_MSG_CAP * _MSG_MARGIN:
+            return unit
+    raise RuntimeError(
+        f"no tpu-hbm unit in {_UNIT_LADDER} keeps the device list under "
+        f"kubelet's {KUBELET_GRPC_MSG_CAP} B gRPC cap for this host")
+
+
 def _match_amounts(pod) -> set[int]:
     """Amounts a kubelet Allocate call for this pod may carry.
 
@@ -85,18 +127,43 @@ class DevicePlugin:
     """
 
     def __init__(self, cluster, node_name: str, enumerator,
-                 unit_mib: int = 1) -> None:
-        if unit_mib <= 0:
-            raise ValueError("unit_mib must be positive")
+                 unit_mib: int | str = 1) -> None:
         self._cluster = cluster
         self.node_name = node_name
         self._enumerator = enumerator
-        self.unit_mib = unit_mib
         self._chips = enumerator.enumerate()
         if not self._chips:
             raise RuntimeError("no TPU chips found on this host")
+        if unit_mib == "auto":
+            unit_mib = select_unit_mib(self._chips)
+            log.info("hbm-unit auto-selected: %d MiB/device", unit_mib)
+        if not isinstance(unit_mib, int) or unit_mib <= 0:
+            raise ValueError(f"unit_mib must be a positive int or 'auto', "
+                             f"got {unit_mib!r}")
+        self.unit_mib = unit_mib
         self._registered_ids = {c.idx for c in self._chips}
         self._last_reported_unhealthy: set[int] | None = None
+        try:
+            self.validate_kubelet_message_size()
+        except ValueError as e:
+            # the transport-agnostic core only warns (tests and the JSON
+            # debug transport have no 4MB cap); the kubelet gRPC service
+            # re-runs this check and fails startup loudly
+            log.warning("%s", e)
+
+    def validate_kubelet_message_size(self) -> None:
+        """Raise if this host's tpu-hbm device list would exceed kubelet's
+        gRPC message cap — enforced by DevicePluginService.start(), so a
+        misdenominated DaemonSet crash-loops with a clear message instead
+        of wedging registration (v5p-class chips with MiB denomination:
+        ~390k devices ~ 10 MB > the 4 MB cap)."""
+        est = estimate_listandwatch_bytes(self._chips, self.unit_mib)
+        if est > KUBELET_GRPC_MSG_CAP * _MSG_MARGIN:
+            raise ValueError(
+                f"hbm-unit={self.unit_mib} yields a ~{est} B tpu-hbm "
+                f"device list, over kubelet's {KUBELET_GRPC_MSG_CAP} B "
+                f"gRPC cap for this host's chips; use --hbm-unit=auto or "
+                f"a larger unit (e.g. 1024 = GiB)")
 
     # -- reporting ------------------------------------------------------------
 
@@ -175,8 +242,76 @@ class DevicePlugin:
         Allocate retries (see :meth:`allocate`)."""
         return self._placed_pods(assigned=True, pods=pods)
 
+    def placement_unit_ranges(self, pods: list[dict[str, Any]] | None = None
+                              ) -> list[tuple[dict[str, Any], set[str]]]:
+        """Deterministic per-placement HBM-unit device-ID ranges.
+
+        Every placed pod (pending AND assigned) owns a contiguous run of
+        unit slots on each of its granted chips, assigned by walking
+        placements in (assume-time, UID) order with a per-chip cursor.
+        Because the extender never oversubscribes a chip, the runs always
+        fit and never overlap — so a kubelet-granted device set that
+        equals a placement's range identifies THAT placement, which is
+        strictly more information than the amount-only rendezvous the
+        reference uses (designs.md:97-99: same-size pending pods are
+        disambiguated only by assume-time, and a container starting out of
+        order matches the wrong pod — worse, BOTH containers then match
+        the earliest pod, double-occupying its chips while the other
+        placement leaks until gc).
+
+        GetPreferredAllocation steers kubelet to the earliest pending
+        placement's exact range, and kubelet excludes already-granted
+        devices from later calls, so each container start consumes one
+        range. Residual honesty: kubelet's v1beta1 Allocate carries no pod
+        identity, so if kubelet ignores the preference the plugin still
+        cannot know which POD a container belongs to — but range identity
+        keeps every grant internally consistent (env matches granted
+        devices; no double occupancy; amounts exact), leaving at worst a
+        benign same-size attribution swap instead of the reference's
+        double-assignment.
+
+        Range sizing: kubelet's Allocate for a pod carries the container's
+        tpu-hbm limit — the PER-CHIP grant (reference semantics: gpu-mem
+        is per-device, each of N devices reserves the full amount). The
+        identifying range is therefore ``grant`` units on the pod's
+        lowest granted chip, so ``len(range) == allocation_size`` even
+        for multi-chip placements; the cursor still advances on EVERY
+        granted chip, reserving the real per-chip occupancy so later
+        placements' ranges can never collide with it.
+
+        Returns [(pod, device-id set)] in walk order; exclusive
+        (count-only) placements are skipped — they rendezvous on the
+        tpu-count resource whose device IDs are whole chips and already
+        unambiguous.
+        """
+        if pods is None:
+            pods = self._list_node_pods()
+        placed = (self._placed_pods(assigned=False, pods=pods)
+                  + self._placed_pods(assigned=True, pods=pods))
+        placed.sort(key=lambda p: (contract.assume_time_from_annotations(p),
+                                   podlib.pod_uid(p)))
+        cursor = {c.idx: 0 for c in self._chips}
+        cap = {c.idx: c.hbm_mib // self.unit_mib for c in self._chips}
+        out: list[tuple[dict[str, Any], set[str]]] = []
+        for pod in placed:
+            grant = contract.hbm_from_annotations(pod) or 0
+            ids = contract.chip_ids_from_annotations(pod) or ()
+            if grant <= 0 or not ids:
+                continue
+            if any(i not in cursor or cursor[i] + grant > cap[i]
+                   for i in ids):
+                continue  # inconsistent placement; never invent a range
+            anchor = min(ids)
+            r = {hbm_device_id(anchor, u)
+                 for u in range(cursor[anchor], cursor[anchor] + grant)}
+            for i in ids:
+                cursor[i] += grant
+            out.append((pod, r))
+        return out
+
     def allocate(self, hbm_mib: int | None = None,
-                 pod_uid: str | None = None) -> dict[str, Any]:
+                 pod_uid: str | None = None,
+                 device_ids: list[str] | None = None) -> dict[str, Any]:
         """Match a container-start request to a placed pod and produce its
         device environment. ``hbm_mib`` is what kubelet's Allocate carries
         (the container's tpu-hbm limit, in request units); ``pod_uid``
@@ -189,7 +324,25 @@ class DevicePlugin:
         pod's second call must return the same environment rather than
         NOT_FOUND, and a kubelet retry after a dropped response must
         succeed.
+
+        ``device_ids`` is the actual devicesIDs set kubelet granted: when
+        it exactly equals one placement's unit range (see
+        :meth:`placement_unit_ranges`), the devices themselves name the
+        pod and the amount heuristic is skipped entirely — this is what
+        makes same-size rendezvous deterministic at the device level.
         """
+        snapshot = self._list_node_pods()  # one LIST serves all passes
+
+        if pod_uid is None and device_ids:
+            granted = set(device_ids)
+            exact = [pod for pod, r in self.placement_unit_ranges(snapshot)
+                     if r == granted]
+            if len(exact) == 1:
+                if contract.is_assigned(exact[0]):   # kubelet retry
+                    return self._finalize(exact[0], patch=False)
+                return self._finalize(exact[0])
+            # no (or ambiguous) range owner: kubelet ignored the
+            # preferred allocation — fall back to amount matching
 
         def pick(pods):
             for pod in pods:
@@ -200,7 +353,6 @@ class DevicePlugin:
                     return pod
             return None
 
-        snapshot = self._list_node_pods()  # one LIST serves both passes
         candidates = self.pending_pods(snapshot)
         chosen = pick(candidates)
         if chosen is not None:
