@@ -1,0 +1,271 @@
+"""kube-scheduler wire conformance (VERDICT r2 item 5).
+
+Every other HTTP test in this repo drives the extender with requests built
+from the repo's own helpers — they share the repo's assumptions about the
+wire format and can't catch a casing/shape mismatch that would brick a
+real kube-scheduler. The fixtures here are authored FROM THE GO SOURCE of
+the scheduler's extender client instead (the vendored structs in
+/root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/api/types.go:
+258-302, marshaled per encoding/json's rules):
+
+- The extender structs carry NO json tags, so Go emits their exact field
+  names: ``Pod``, ``Nodes``, ``NodeNames``, ``FailedNodes``, ``Error``,
+  ``PodName``, ``PodNamespace``, ``PodUID``, ``Node``, ``Host``,
+  ``Score`` (the later k8s.io/kube-scheduler/extender/v1 package kept the
+  same names for wire compatibility).
+- Nil pointer fields have no ``omitempty``, so a nodeCacheCapable
+  scheduler really POSTs ``"Nodes": null`` alongside ``NodeNames`` — the
+  literal fixtures keep those nulls.
+- The embedded v1.Pod/v1.NodeList marshal with their lowercase v1 tags
+  (``metadata``/``spec``/``status``, ``creationTimestamp: null``), and
+  resource quantities are strings.
+- Go's json.Unmarshal on the response is case-insensitive but the
+  canonical names above are asserted exactly, plus Go-side type rules
+  (Score must decode into an int; HostPriorityList is a bare JSON array).
+
+Also covered: the scheduler's HTTPTimeout firing mid-bind (types.go:199 —
+the client gives up while the extender is still writing) must leave the
+system consistent: the bind completes exactly once and the scheduler's
+retry gets an idempotent success.
+"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller
+from tpushare.extender.metrics import Registry
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+
+
+@pytest.fixture
+def rig():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    fc.add_tpu_node("n2", chips=2, hbm_per_chip_mib=8000)
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    ctl.start()
+    server = ExtenderServer(cache, fc, Registry(), host="127.0.0.1", port=0)
+    port = server.start()
+    yield fc, cache, f"http://127.0.0.1:{port}/tpushare-scheduler"
+    server.stop()
+    ctl.stop()
+
+
+def post_raw(url: str, body: str, timeout: float = 5.0):
+    """POST a LITERAL byte body (no repo-side JSON re-encoding)."""
+    req = urllib.request.Request(
+        url, data=body.encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+# A v1.Pod exactly as client-go marshals one (lowercase tags, null
+# creationTimestamp, quantity strings). Seeded into the FakeCluster AND
+# embedded verbatim in the filter fixture, as the scheduler does.
+GO_POD = """{
+  "metadata": {
+    "name": "wire-pod",
+    "namespace": "default",
+    "uid": "c3a3e1f2-0001-4a5b-9c8d-aabbccddeeff",
+    "creationTimestamp": null,
+    "annotations": {}
+  },
+  "spec": {
+    "containers": [
+      {
+        "name": "main",
+        "image": "example/jax-serve:latest",
+        "resources": {
+          "limits": {
+            "aliyun.com/tpu-hbm": "8000"
+          },
+          "requests": {
+            "aliyun.com/tpu-hbm": "8000"
+          }
+        }
+      }
+    ]
+  },
+  "status": {}
+}"""
+
+# ExtenderArgs from a nodeCacheCapable=true scheduler: Nodes is a nil
+# pointer -> literal null on the wire (no omitempty, types.go:258-267).
+FILTER_ARGS_CACHE_CAPABLE = (
+    '{"Pod":' + GO_POD + ',"Nodes":null,"NodeNames":["n1","n2"]}')
+
+# ExtenderArgs from a nodeCacheCapable=false scheduler: full v1.NodeList,
+# NodeNames null (types.go:262-263).
+FILTER_ARGS_FULL_NODES = ('{"Pod":' + GO_POD + ',"Nodes":{"metadata":{},'
+                          '"items":['
+                          '{"metadata":{"name":"n1","creationTimestamp":null},'
+                          '"spec":{},"status":{}},'
+                          '{"metadata":{"name":"n2","creationTimestamp":null},'
+                          '"spec":{},"status":{}}]},"NodeNames":null}')
+
+BIND_ARGS = ('{"PodName":"wire-pod","PodNamespace":"default",'
+             '"PodUID":"c3a3e1f2-0001-4a5b-9c8d-aabbccddeeff",'
+             '"Node":"n1"}')
+
+
+def seed_wire_pod(fc: FakeCluster) -> None:
+    pod = json.loads(GO_POD)
+    fc.create_pod(pod)
+    # FakeCluster may assign its own uid; force the fixture's
+    stored = fc.get_pod("default", "wire-pod")
+    stored["metadata"]["uid"] = pod["metadata"]["uid"]
+    fc.replace_pod("default", "wire-pod", stored)
+
+
+def test_filter_nodecachecapable_fixture(rig):
+    fc, cache, base = rig
+    seed_wire_pod(fc)
+    status, result = post_raw(f"{base}/filter", FILTER_ARGS_CACHE_CAPABLE)
+    assert status == 200
+    # ExtenderFilterResult decodes field-for-field (types.go:273-285)
+    assert set(result) <= {"Nodes", "NodeNames", "FailedNodes", "Error"}
+    assert result["NodeNames"] == ["n1", "n2"]
+    assert result["FailedNodes"] == {}
+    assert result["Error"] == ""
+
+
+def test_filter_full_nodelist_fixture(rig):
+    fc, cache, base = rig
+    seed_wire_pod(fc)
+    status, result = post_raw(f"{base}/filter", FILTER_ARGS_FULL_NODES)
+    assert status == 200
+    # 8000 MiB fits a 16000-chip on n1 and an 8000-chip on n2
+    assert result["NodeNames"] == ["n1", "n2"]
+
+
+def test_filter_rejection_lands_in_failednodes(rig):
+    fc, cache, base = rig
+    big = GO_POD.replace('"8000"', '"12000"')
+    pod = json.loads(big)
+    pod["metadata"]["name"] = "wire-big"
+    fc.create_pod(pod)
+    args = ('{"Pod":' + big.replace("wire-pod", "wire-big")
+            + ',"Nodes":null,"NodeNames":["n1","n2"]}')
+    status, result = post_raw(f"{base}/filter", args)
+    assert status == 200
+    assert result["NodeNames"] == ["n1"]
+    # FailedNodesMap: node name -> human-readable reason (types.go:270)
+    assert list(result["FailedNodes"]) == ["n2"]
+    assert isinstance(result["FailedNodes"]["n2"], str)
+    assert result["FailedNodes"]["n2"]
+
+
+def test_prioritize_hostprioritylist_shape(rig):
+    fc, cache, base = rig
+    seed_wire_pod(fc)
+    status, result = post_raw(f"{base}/prioritize",
+                              FILTER_ARGS_CACHE_CAPABLE)
+    assert status == 200
+    # HostPriorityList is a BARE array of {Host, Score} (types.go:303-310);
+    # Score must decode into a Go int: JSON integer, no floats
+    assert isinstance(result, list) and len(result) == 2
+    for item in result:
+        assert set(item) == {"Host", "Score"}
+        assert isinstance(item["Score"], int)
+        assert 0 <= item["Score"] <= 10  # MaxExtenderPriority
+    assert {i["Host"] for i in result} == {"n1", "n2"}
+
+
+def test_bind_fixture_roundtrip(rig):
+    fc, cache, base = rig
+    seed_wire_pod(fc)
+    status, result = post_raw(f"{base}/bind", BIND_ARGS)
+    assert status == 200
+    assert set(result) <= {"Error"}
+    assert result["Error"] == ""
+    bound = fc.get_pod("default", "wire-pod")
+    assert bound["spec"].get("nodeName") == "n1" or \
+        bound["metadata"].get("annotations", {})  # bound + annotated
+    anns = bound["metadata"]["annotations"]
+    assert "tpushare.aliyun.com/chip-ids" in anns
+
+
+def test_bind_failure_is_http_500_with_error(rig):
+    fc, cache, base = rig
+    # no such pod: the scheduler expects HTTP 500 + Error (routes.go:139-143
+    # parity; httpExtender also checks result.Error)
+    status, result = post_raw(f"{base}/bind", BIND_ARGS)
+    assert status == 500
+    assert isinstance(result["Error"], str) and result["Error"]
+
+
+def test_bind_uid_mismatch_rejected(rig):
+    fc, cache, base = rig
+    seed_wire_pod(fc)
+    stale = BIND_ARGS.replace("c3a3e1f2-0001", "deadbeef-9999")
+    status, result = post_raw(f"{base}/bind", stale)
+    assert status == 500
+    assert "UID" in result["Error"] or "uid" in result["Error"]
+    # the pod was NOT bound
+    pod = fc.get_pod("default", "wire-pod")
+    assert "tpushare.aliyun.com/chip-ids" not in \
+        pod["metadata"].get("annotations", {})
+
+
+def test_httptimeout_mid_bind_completes_once_and_retry_is_idempotent(rig):
+    """ExtenderConfig.HTTPTimeout (types.go:199): the scheduler's client
+    gives up mid-bind. The extender must finish the in-flight bind exactly
+    once, and the scheduler's retry must get an idempotent success — not a
+    double allocation, not a permanent failure."""
+    fc, cache, base = rig
+    seed_wire_pod(fc)
+
+    real_bind = fc.bind_pod
+
+    def slow_bind(*a, **kw):
+        time.sleep(1.0)  # longer than the client's timeout below
+        return real_bind(*a, **kw)
+
+    fc.bind_pod = slow_bind
+    try:
+        with pytest.raises((TimeoutError, urllib.error.URLError,
+                            socket.timeout)):
+            post_raw(f"{base}/bind", BIND_ARGS, timeout=0.25)
+        # the extender's handler thread is still running; wait for the
+        # BIND (nodeName) — annotations land first in the 3-phase
+        # allocate, so polling them would catch the bind still in flight
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            pod = fc.get_pod("default", "wire-pod")
+            if pod.get("spec", {}).get("nodeName"):
+                break
+            time.sleep(0.05)
+    finally:
+        fc.bind_pod = real_bind
+
+    pod = fc.get_pod("default", "wire-pod")
+    assert pod["spec"].get("nodeName") == "n1", \
+        "in-flight bind must complete despite the client hangup"
+    anns = pod["metadata"]["annotations"]
+    assert "tpushare.aliyun.com/chip-ids" in anns, \
+        "in-flight bind must complete despite the client hangup"
+    first_ids = anns["tpushare.aliyun.com/chip-ids"]
+
+    # the scheduler retries after its timeout: idempotent success
+    status, result = post_raw(f"{base}/bind", BIND_ARGS)
+    assert status == 200 and result["Error"] == ""
+    again = fc.get_pod("default", "wire-pod")
+    assert again["metadata"]["annotations"][
+        "tpushare.aliyun.com/chip-ids"] == first_ids, \
+        "retry must not re-allocate"
+    # exactly one grant accounted in the cache
+    tree = cache.describe()
+    assert tree["used_hbm_mib"] == 8000
