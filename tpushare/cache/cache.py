@@ -71,9 +71,10 @@ class SchedulerCache:
 
     # -- pod lifecycle --------------------------------------------------------
 
-    def known_pod(self, uid: str) -> bool:
+    def known_pod(self, key: str) -> bool:
+        """``key`` is the accounting id (podlib.pod_cache_key)."""
         with self._lock:
-            return uid in self._known_pods
+            return key in self._known_pods
 
     def add_or_update_pod(self, pod: dict[str, Any]) -> None:
         """Reference AddOrUpdatePod (cache.go:89-113): place the pod into its
@@ -91,7 +92,7 @@ class SchedulerCache:
         info.remove_pod(pod)
         if info.add_or_update_pod(pod):
             with self._lock:
-                self._known_pods[podlib.pod_uid(pod)] = pod
+                self._known_pods[podlib.pod_cache_key(pod)] = pod
 
     def remove_pod(self, pod: dict[str, Any]) -> None:
         """Reference RemovePod (cache.go:116-127): completed/deleted pods
@@ -103,7 +104,7 @@ class SchedulerCache:
             if info is not None:
                 info.remove_pod(pod)
         with self._lock:
-            self._known_pods.pop(podlib.pod_uid(pod), None)
+            self._known_pods.pop(podlib.pod_cache_key(pod), None)
 
     # -- startup replay -------------------------------------------------------
 

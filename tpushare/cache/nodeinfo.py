@@ -26,6 +26,7 @@ deliberate redesigns:
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Any, Callable
@@ -167,8 +168,14 @@ class NodeInfo:
         pod: dict[str, Any],
         cluster,
         now_ns: Callable[[], int] = time.time_ns,
+        ha_claims: bool = False,
     ) -> Placement:
         """Bind-path: select chips, reserve, patch annotations, bind, confirm.
+
+        ``ha_claims`` adds the per-node claim CAS (see :meth:`_claim_chips`)
+        that serializes same-node placements across extender REPLICAS; the
+        in-process lock + reservations already make a single replica safe,
+        so single-replica deployments skip its two apiserver round-trips.
 
         Raises AllocationError when no placement exists or the apiserver
         writes fail (after rolling back the reservation).
@@ -184,11 +191,12 @@ class NodeInfo:
                 f"pod {podlib.pod_key(pod)} already bound to "
                 f"{podlib.pod_node_name(pod)}")
         uid = podlib.pod_uid(pod)
+        key = podlib.pod_cache_key(pod)  # accounting id: uid or ns/name
         ns, name = podlib.pod_namespace(pod), podlib.pod_name(pod)
 
         # phase 1: place + reserve (lock held; pure compute, no I/O)
         with self._lock:
-            if uid in self._inflight:
+            if key in self._inflight:
                 # a concurrent duplicate bind for the same pod: letting it
                 # proceed would double-reserve, and its rollback would
                 # erase whatever the first attempt wins
@@ -203,78 +211,250 @@ class NodeInfo:
                     f"no placement for {podlib.pod_key(pod)} on {self.name}")
             demand = req.chip_demand_mib(self.hbm_per_chip)
             for cid in placement.chip_ids:
-                self.chips[cid].reserve(uid, demand)
-            self._inflight.add(uid)
+                self.chips[cid].reserve(key, demand)
+            self._inflight.add(key)
             self._dirty()
         try:
             return self._allocate_io(pod, cluster, now_ns, placement,
-                                     demand, uid, ns, name)
+                                     demand, uid, key, ns, name, ha_claims)
         finally:
             with self._lock:
-                self._inflight.discard(uid)
+                self._inflight.discard(key)
+
+    # claims older than this are abandoned bind attempts (binder crashed
+    # between claim and pod-patch) and stop counting against capacity
+    CLAIM_TTL_NS = 120 * 1_000_000_000
+
+    def _claim_chips(self, cluster, key: str, placement, demand: int,
+                     t_ns: int) -> None:
+        """Durable same-node serialization for HA (split-brain) binds.
+
+        Per-pod CAS alone cannot stop two replicas with stale caches from
+        placing DIFFERENT pods onto the same chip — each bind is
+        internally consistent, and the oversubscription only exists in
+        the union (r3 split-brain storm: six 4 GiB pods on one 16 GiB
+        chip). Every bind therefore CAS-appends an in-flight claim to a
+        NODE annotation (precondition: the node resourceVersion it read),
+        so same-node placements serialize through the apiserver:
+
+        1. GET node -> rv + live claims;
+        2. drop only EXPIRED (CLAIM_TTL_NS) or malformed claims — a claim
+           must outlive the window in which some replica's watch-fed
+           cache may not yet account its placement, so "my cache already
+           sees this pod" is grounds to not COUNT a claim, never to
+           REMOVE it (removing it un-protects every other replica whose
+           cache still lags — the second r3 split-brain finding);
+        3. validate OUR placement against the foreign claims my cache
+           does not already account;
+        4. CAS the set + our claim back; on 409 somebody else claimed
+           concurrently -> re-read and revalidate (bounded).
+
+        Raises AllocationError when a foreign claim makes the placement
+        not fit — the scheduler retries and Filter routes elsewhere.
+        """
+        for _ in range(8):
+            node = cluster.get_node(self.name)
+            rv = (node.get("metadata") or {}).get("resourceVersion")
+            raw = (node.get("metadata") or {}).get(
+                "annotations", {}).get(contract.ANN_NODE_CLAIMS)
+            try:
+                claims = json.loads(raw) if raw else {}
+                if not isinstance(claims, dict):
+                    claims = {}
+            except ValueError:
+                claims = {}
+            with self._lock:
+                # per-CHIP visibility: a pod can be in my cache on chip X
+                # (e.g. my own losing attempt's reservation) while its
+                # winning claim is for chip Y — node-global visibility
+                # would skip the chip-Y claim and leave Y unprotected
+                # (the third r3 split-brain finding)
+                visible = {c.idx: set(c.pod_uids) for c in self.chips}
+                free = {c.idx: c.total_hbm_mib - c.used_hbm_mib
+                        for c in self.chips}
+            mine = claims.get(key)
+            if mine is not None:
+                try:
+                    if int(mine["t"]) == t_ns:
+                        return  # our own write landed (client retry after
+                        # a dropped response); the claim is in place
+                    fresh = (t_ns - int(mine["t"])) < self.CLAIM_TTL_NS
+                except (KeyError, TypeError, ValueError):
+                    fresh = False
+                if fresh:
+                    # a live claim for THIS pod from a concurrent attempt
+                    # (another replica racing the same bind). Replacing it
+                    # and later dropping ours would strip the protection
+                    # off the winner's placement — the bug behind r3's
+                    # residual split-brain oversubscription. Back off; the
+                    # scheduler retries after the dust settles.
+                    raise AllocationError(
+                        f"a concurrent bind attempt holds the claim for "
+                        f"{key} on {self.name}")
+            kept: dict[str, Any] = {}
+            for ckey, entry in claims.items():
+                if ckey == key:
+                    continue  # ours (expired): re-added with a fresh stamp
+                try:
+                    age_ok = (t_ns - int(entry["t"])) < self.CLAIM_TTL_NS
+                    chip_ids = [int(i) for i in entry["c"]]
+                    hbm = int(entry["h"])
+                except (KeyError, TypeError, ValueError):
+                    continue  # malformed: drop
+                if not age_ok:
+                    continue  # expired: binder crashed or placement is
+                    # long since watch-visible everywhere
+                kept[ckey] = entry
+                for cid in chip_ids:
+                    if cid in free and ckey not in visible.get(cid, ()):
+                        # charge only chips where my cache does not
+                        # already account this pod (else double-charge)
+                        free[cid] -= hbm
+            short = [cid for cid in placement.chip_ids
+                     if free.get(cid, 0) < 0]
+            if short:
+                raise AllocationError(
+                    f"chips {short} on {self.name} are claimed by "
+                    f"concurrent binds (HA replica race); not placing "
+                    f"{key} over them")
+            kept[key] = {"c": list(placement.chip_ids), "h": demand,
+                         "t": t_ns}
+            try:
+                cluster.patch_node(self.name, {"metadata": {
+                    "resourceVersion": rv,
+                    "annotations": {
+                        contract.ANN_NODE_CLAIMS: json.dumps(
+                            kept, sort_keys=True)}}})
+                return
+            except ApiError as e:
+                if not e.is_conflict:
+                    raise
+                continue  # another bind claimed concurrently: re-read
+        raise AllocationError(
+            f"claim CAS on node {self.name} kept losing; giving up")
+
+    def _drop_claim(self, cluster, key: str, t_ns: int) -> None:
+        """Best-effort removal of OUR claim instance after a failed bind
+        (an expired claim is dropped by later binds anyway). Stamp-guarded:
+        a claim for the same pod written by a concurrent winner must not
+        be stripped by the loser's rollback."""
+        try:
+            node = cluster.get_node(self.name)
+            rv = (node.get("metadata") or {}).get("resourceVersion")
+            raw = (node.get("metadata") or {}).get(
+                "annotations", {}).get(contract.ANN_NODE_CLAIMS)
+            claims = json.loads(raw) if raw else {}
+            entry = claims.get(key)
+            if entry is None or entry.get("t") != t_ns:
+                return
+            claims.pop(key)
+            cluster.patch_node(self.name, {"metadata": {
+                "resourceVersion": rv,
+                "annotations": {contract.ANN_NODE_CLAIMS: json.dumps(
+                    claims, sort_keys=True)}}})
+        except (ApiError, ValueError):
+            pass
 
     def _allocate_io(self, pod, cluster, now_ns, placement, demand,
-                     uid, ns, name) -> Placement:
+                     uid, key, ns, name, ha_claims=False) -> Placement:
         """Phases 2-3 of allocate: apiserver writes + confirm/rollback."""
         # phase 2: apiserver writes (no lock held)
+        t_ns = now_ns()
         ann = contract.placement_annotations(
             chip_ids=placement.chip_ids,
             hbm_mib=demand,
             chip_total_mib=self.hbm_per_chip,
             box=placement.box,
-            now_ns=now_ns(),
+            now_ns=t_ns,
         )
         # remember prior values so a failed bind can revert the patch
         # (None = key absent -> delete on revert)
         old_ann = podlib.annotations(pod)
         revert = {k: old_ann.get(k) for k in ann}
+        # the placement patch is a CAS keyed on the rv we placed against:
+        # without it two HA replicas blind-overwrite each other's
+        # placement annotations and the loser's rollback can erase the
+        # winner's (a bound pod with no placement = invisible occupancy)
+        rv = (pod.get("metadata") or {}).get("resourceVersion")
         patched = False
+        claimed = False
         try:
+            if ha_claims:
+                # same-node HA serialization: claim the chips on the node
+                # object (CAS) before any pod write; raises if a
+                # concurrent replica's claim makes this placement
+                # overfull. INSIDE the rollback scope: a claim failure
+                # must release the phase-1 reservations or the node leaks
+                # capacity until restart.
+                self._claim_chips(cluster, key, placement, demand, t_ns)
+                claimed = True
             try:
-                cluster.patch_pod(ns, name, contract.placement_patch(ann))
+                cluster.patch_pod(ns, name, contract.placement_patch(
+                    ann, resource_version=rv))
                 patched = True
             except ApiError as e:
                 if not e.is_conflict:
                     raise
-                # optimistic-lock loser: refetch and retry once
-                # (reference nodeinfo.go:202-218)
+                # optimistic-lock loser: refetch and retry ONCE
+                # (reference nodeinfo.go:202-218) — but only when the rv
+                # moved for a benign reason. A live foreign placement
+                # means another replica is mid-bind on this pod: back off
+                # and let the scheduler retry against the survivor.
                 fresh = cluster.get_pod(ns, name)
                 if podlib.pod_uid(fresh) != uid:
                     raise ApiError(409, "pod replaced during bind")
                 if podlib.pod_node_name(fresh):
                     raise ApiError(409, "pod bound concurrently")
-                cluster.patch_pod(ns, name, contract.placement_patch(ann))
+                f_ann = podlib.annotations(fresh)
+                if contract.chip_ids_from_annotations(fresh) is not None \
+                        and f_ann.get(contract.ANN_ASSUME_TIME) != \
+                        ann[contract.ANN_ASSUME_TIME]:
+                    raise ApiError(
+                        409, "another replica holds an in-flight "
+                             "placement for this pod")
+                cluster.patch_pod(ns, name, contract.placement_patch(
+                    ann, resource_version=(fresh.get("metadata") or {})
+                    .get("resourceVersion")))
                 patched = True
-            cluster.bind_pod(ns, name, self.name, uid=uid)
-        except ApiError as e:
+            cluster.bind_pod(ns, name, self.name, uid=uid or None)
+        except (ApiError, AllocationError) as e:
             with self._lock:
                 for cid in placement.chip_ids:
                     # reserved-only: never evict a confirmed entry for the
-                    # same UID (defense in depth alongside _inflight)
-                    self.chips[cid].remove_reserved(uid)
+                    # same pod (defense in depth alongside _inflight)
+                    self.chips[cid].remove_reserved(key)
                 self._dirty()
+            if claimed:
+                self._drop_claim(cluster, key, t_ns)
             if patched:
                 # best-effort: restore the previous annotation state — but
-                # only if our values are still the live ones. A concurrent
-                # extender replica may have overwritten them and bound the
-                # pod; reverting then would erase the winner's placement.
+                # only if our values are still the live ones AND the pod
+                # is still unbound. A concurrent extender replica may have
+                # overwritten them and bound the pod; reverting then would
+                # erase the winner's placement.
                 try:
                     fresh = cluster.get_pod(ns, name)
                     # assume-time is a per-attempt ns timestamp: if it still
                     # matches, the last annotation write was ours
-                    if (podlib.annotations(fresh).get(contract.ANN_ASSUME_TIME)
+                    if (not podlib.pod_node_name(fresh)
+                            and podlib.annotations(fresh)
+                            .get(contract.ANN_ASSUME_TIME)
                             == ann[contract.ANN_ASSUME_TIME]):
-                        cluster.patch_pod(
-                            ns, name, contract.placement_patch(revert))
+                        cluster.patch_pod(ns, name, contract.placement_patch(
+                            revert, resource_version=(
+                                fresh.get("metadata") or {})
+                            .get("resourceVersion")))
                 except ApiError:
                     pass
+            if isinstance(e, AllocationError):
+                raise  # claim-path refusals already carry their reason
             raise AllocationError(
                 f"bind {podlib.pod_key(pod)} -> {self.name} failed: {e}") from e
 
         # phase 3: confirm (lock re-taken)
         with self._lock:
             for cid in placement.chip_ids:
-                self.chips[cid].confirm(uid)
+                self.chips[cid].confirm(key)
             self._dirty()
         return placement
 
@@ -287,19 +467,19 @@ class NodeInfo:
         hbm = contract.hbm_from_annotations(pod)
         if ids is None:
             return False
-        uid = podlib.pod_uid(pod)
+        key = podlib.pod_cache_key(pod)
         with self._lock:
             for cid in ids:
                 if 0 <= cid < len(self.chips):
-                    self.chips[cid].add_pod(uid, hbm)
+                    self.chips[cid].add_pod(key, hbm)
             self._dirty()
         return True
 
     def remove_pod(self, pod: dict[str, Any]) -> None:
-        uid = podlib.pod_uid(pod)
+        key = podlib.pod_cache_key(pod)
         with self._lock:
             for c in self.chips:
-                c.remove_pod(uid)
+                c.remove_pod(key)
             self._dirty()
 
     def update_node(self, node: dict[str, Any]) -> bool:

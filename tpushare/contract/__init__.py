@@ -18,6 +18,7 @@ from tpushare.contract.constants import (
     ANN_ASSIGNED,
     ANN_ASSUME_TIME,
     ANN_TOPOLOGY,
+    ANN_NODE_CLAIMS,
     LABEL_MESH,
     LABEL_TPUSHARE_NODE,
     ENV_VISIBLE_CHIPS,
@@ -51,7 +52,7 @@ from tpushare.contract.node import (
 __all__ = [
     "RESOURCE_HBM", "RESOURCE_COUNT",
     "ANN_CHIP_IDS", "ANN_HBM_POD", "ANN_HBM_CHIP", "ANN_ASSIGNED",
-    "ANN_ASSUME_TIME", "ANN_TOPOLOGY",
+    "ANN_ASSUME_TIME", "ANN_TOPOLOGY", "ANN_NODE_CLAIMS",
     "LABEL_MESH", "LABEL_TPUSHARE_NODE",
     "ENV_VISIBLE_CHIPS", "ENV_HBM_LIMIT", "ENV_HBM_CHIP_TOTAL",
     "ENV_MEM_FRACTION",

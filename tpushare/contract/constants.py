@@ -40,6 +40,11 @@ ANN_HBM_CHIP = _PREFIX + "hbm-chip"         # per-chip HBM total, MiB
 ANN_ASSIGNED = _PREFIX + "assigned"         # "false" at bind; "true" at runtime
 ANN_ASSUME_TIME = _PREFIX + "assume-time"   # bind timestamp, ns since epoch
 ANN_TOPOLOGY = _PREFIX + "topology"         # granted sub-slice shape, "2x2"
+# NODE annotation: JSON map of in-flight bind claims (pod accounting key ->
+# {"c": [chip ids], "h": per-chip MiB, "t": claim ns}). CAS-updated on every
+# bind to serialize same-node placements across HA replicas; see
+# NodeInfo._claim_chips.
+ANN_NODE_CLAIMS = _PREFIX + "claims"
 
 # -- node labels (published by the device plugin) ----------------------------
 LABEL_TPUSHARE_NODE = "tpushare"            # "true" enables the DaemonSet

@@ -51,6 +51,21 @@ def pod_key(pod: Pod) -> str:
     return f"{pod_namespace(pod)}/{pod_name(pod)}"
 
 
+def pod_cache_key(pod: Pod) -> str:
+    """Accounting identity: the UID when present, else ``namespace/name``.
+
+    The allocation cache (chip pod-maps, in-flight bind guard, known-pods
+    registry) must key on THIS, never on the raw UID: a pod object without
+    a uid (hand-seeded test objects, partially-synced caches) would
+    otherwise collapse every such pod onto the one ``""`` key — each new
+    placement silently REPLACING the previous pod's accounting, which let
+    an HA bind storm pile 36 pods onto one chip before r3's storm test
+    caught it. True UID-identity checks (bind UID recheck, StatefulSet
+    same-name-recreate detection) still compare raw UIDs.
+    """
+    return pod_uid(pod) or pod_key(pod)
+
+
 def pod_node_name(pod: Pod) -> str:
     return (pod.get("spec") or {}).get("nodeName", "")
 
@@ -190,9 +205,22 @@ def placement_annotations(
     return ann
 
 
-def placement_patch(ann: Mapping[str, str]) -> dict[str, Any]:
-    """Strategic-merge-patch body updating only the annotations."""
-    return {"metadata": {"annotations": dict(ann)}}
+def placement_patch(ann: Mapping[str, str],
+                    resource_version: str | None = None) -> dict[str, Any]:
+    """Strategic-merge-patch body updating only the annotations.
+
+    ``resource_version`` makes the patch a CAS: Kubernetes honors
+    ``metadata.resourceVersion`` inside a merge-patch body as an
+    optimistic-concurrency precondition (409 on mismatch). The bind path
+    MUST pass the rv it placed against — two HA replicas otherwise
+    blind-overwrite each other's placement annotations, and the loser's
+    rollback can erase the winner's (r3 split-brain storm finding: a
+    bound pod with no placement = invisible chip occupancy).
+    """
+    meta: dict[str, Any] = {"annotations": dict(ann)}
+    if resource_version is not None:
+        meta["resourceVersion"] = resource_version
+    return {"metadata": meta}
 
 
 def assigned_patch() -> dict[str, Any]:

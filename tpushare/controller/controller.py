@@ -157,8 +157,7 @@ class Controller:
         annotations; its chips must free now, not at pod termination)."""
         if contract.is_complete_pod(pod):
             return True
-        uid = podlib.pod_uid(pod)
-        known = self.cache.known_pod(uid)
+        known = self.cache.known_pod(podlib.pod_cache_key(pod))
         has_placement = contract.chip_ids_from_annotations(pod) is not None
         if not known and has_placement:
             return True
@@ -287,7 +286,7 @@ class Controller:
         elif podlib.pod_node_name(pod) and \
                 contract.chip_ids_from_annotations(pod) is not None:
             self.cache.add_or_update_pod(pod)
-        elif self.cache.known_pod(podlib.pod_uid(pod)) and \
+        elif self.cache.known_pod(podlib.pod_cache_key(pod)) and \
                 contract.chip_ids_from_annotations(pod) is None:
             # placement annotations were cleared (stale-placement reclaim):
             # free the chips without waiting for pod termination

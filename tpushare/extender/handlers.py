@@ -168,9 +168,10 @@ class BindHandler:
     (reference Bind.Handler -> gpusharingbinding, gpushare-bind.go:22-43)."""
 
     def __init__(self, cache: SchedulerCache, cluster,
-                 registry: Registry) -> None:
+                 registry: Registry, ha_claims: bool = False) -> None:
         self._cache = cache
         self._cluster = cluster
+        self._ha_claims = ha_claims
         self.bind_total = registry.counter(
             "tpushare_bind_requests_total", "Bind webhook calls")
         self.bind_failures = registry.counter(
@@ -193,7 +194,8 @@ class BindHandler:
         try:
             pod = self._get_pod(ns, name, uid)
             info = self._cache.get_node_info(node)
-            placement = info.allocate(pod, self._cluster)
+            placement = info.allocate(pod, self._cluster,
+                                      ha_claims=self._ha_claims)
         except AlreadyBoundError as e:
             err = e
             bound_node = podlib.pod_node_name(pod)

@@ -40,7 +40,11 @@ class ExtenderServer:
         self.registry = registry or Registry()
         self.filter_handler = FilterHandler(cache, self.registry)
         self.prioritize_handler = PrioritizeHandler(cache, self.registry)
-        self.bind_handler = BindHandler(cache, cluster, self.registry)
+        # HA (an elector is wired): binds also CAS a per-node claim so two
+        # replicas in a stale-leader window cannot co-place onto one chip;
+        # single-replica mode skips the two extra apiserver round-trips
+        self.bind_handler = BindHandler(cache, cluster, self.registry,
+                                        ha_claims=elector is not None)
         self.inspect_handler = InspectHandler(cache)
         self.host, self.port = host, port
         self._httpd: ThreadingHTTPServer | None = None

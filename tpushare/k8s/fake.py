@@ -183,6 +183,13 @@ class FakeCluster:
             pod = self._pods.get(key)
             if pod is None:
                 raise ApiError(404, f"pod {namespace}/{name}")
+            # metadata.resourceVersion in a merge-patch body is an
+            # optimistic-concurrency precondition (real apiserver behavior)
+            want_rv = (patch.get("metadata") or {}).get("resourceVersion")
+            if want_rv is not None and \
+                    want_rv != pod["metadata"].get("resourceVersion"):
+                raise ApiError(409, f"pod {namespace}/{name}: "
+                                    f"resourceVersion conflict")
             merged = strategic_merge(pod, json.loads(json.dumps(patch)))
             self._bump(merged)
             self._pods[key] = merged
@@ -268,6 +275,10 @@ class FakeCluster:
             node = self._nodes.get(name)
             if node is None:
                 raise ApiError(404, f"node {name}")
+            want_rv = (patch.get("metadata") or {}).get("resourceVersion")
+            if want_rv is not None and \
+                    want_rv != node["metadata"].get("resourceVersion"):
+                raise ApiError(409, f"node {name}: resourceVersion conflict")
             merged = strategic_merge(node, json.loads(json.dumps(patch)))
             self._bump(merged)
             self._nodes[name] = merged

@@ -28,6 +28,7 @@ import json
 import socket
 import threading
 import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
@@ -62,7 +63,13 @@ class _State:
         """Record a write: bump rv, stamp it on the object, append to the
         watch history, wake watchers. Caller holds the lock."""
         self.rv += 1
-        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        meta = obj.setdefault("metadata", {})
+        meta["resourceVersion"] = str(self.rv)
+        if etype == "ADDED" and not meta.get("uid"):
+            # a real apiserver stamps a UID on every created object; the
+            # allocation cache keys accounting on it, and uid-less pods
+            # once collapsed onto one cache entry (r3 HA storm finding)
+            meta["uid"] = f"stub-{uuid.uuid4()}"
         if etype == "DELETED":
             self.objects[kind].pop(key, None)
         else:
@@ -208,6 +215,15 @@ class StubApiServer:
                     obj = state.objects.get(kind, {}).get(key)
                     if obj is None:
                         return self._fail(404, "NotFound", f"{kind} {key}")
+                    # metadata.resourceVersion in the body is a CAS
+                    # precondition (real apiserver semantics)
+                    want_rv = (patch.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if want_rv is not None and want_rv != \
+                            obj.get("metadata", {}).get("resourceVersion"):
+                        return self._fail(
+                            409, "Conflict",
+                            f"{key}: resourceVersion {want_rv} is stale")
                     # /status patches touch only status in real k8s; the
                     # merge itself is identical
                     merged = strategic_merge(obj, patch)
