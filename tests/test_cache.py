@@ -252,3 +252,75 @@ def test_concurrent_allocations_never_oversubscribe():
     for chip in d["nodes"][0]["chips"] if "nodes" in d else d["chips"]:
         assert chip["used_hbm_mib"] <= chip["total_hbm_mib"]
     assert d["used_hbm_mib"] == 12 * 5000
+
+
+# -- HA claim lifecycle (per-node claim CAS, nodeinfo._claim_chips) -----------
+
+def test_ha_claim_blocks_capacity_for_unseen_pods():
+    """A claim from a bind this cache has NOT seen must charge capacity
+    (the watch-lag window the claims exist for)."""
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=1, hbm_per_chip_mib=16384)
+    # replica A binds a full-chip pod; replica B's cache never saw it
+    cache_a = SchedulerCache(fc)
+    cache_a.build_cache()
+    pod = fc.create_pod(make_pod(hbm=16384, name="full"))
+    cache_a.get_node_info("n1").allocate(pod, fc, ha_claims=True)
+
+    cache_b = SchedulerCache(fc)  # fresh: no pods replayed, no watches
+    pod2 = fc.create_pod(make_pod(hbm=16384, name="late"))
+    with pytest.raises(AllocationError, match="claimed by concurrent"):
+        cache_b.get_node_info("n1").allocate(pod2, fc, ha_claims=True)
+
+
+def test_ha_claim_tombstone_frees_capacity_after_pod_leaves():
+    """Once THIS cache has seen the pod leave (termination/reclaim), its
+    still-fresh claim must stop charging — or freed chips stay blocked
+    for the rest of the claim TTL (r3 review finding)."""
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=1, hbm_per_chip_mib=16384)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=16384, name="big"))
+    info.allocate(pod, fc, ha_claims=True)  # claim written, chip full
+
+    # the pod terminates; the controller frees its chips in this cache
+    cache.remove_pod(fc.get_pod("default", "big"))
+    fc.delete_pod("default", "big")
+
+    # a new full-chip pod must place IMMEDIATELY despite the live claim
+    pod2 = fc.create_pod(make_pod(hbm=16384, name="next"))
+    placement = info.allocate(pod2, fc, ha_claims=True)
+    assert placement.chip_ids == (0,)
+
+
+def test_ha_claim_failed_bind_releases_reservation_and_claim():
+    """A claim-path refusal must roll back the phase-1 reservation (no
+    capacity leak) and drop the claim so a later retry succeeds."""
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=1, hbm_per_chip_mib=16384)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    info = cache.get_node_info("n1")
+
+    pod = fc.create_pod(make_pod(hbm=8192, name="w"))
+    real_bind = fc.bind_pod
+
+    def failing_bind(*a, **kw):
+        raise ApiError(500, "bind exploded")
+
+    fc.bind_pod = failing_bind
+    try:
+        with pytest.raises(AllocationError):
+            info.allocate(pod, fc, ha_claims=True)
+    finally:
+        fc.bind_pod = real_bind
+
+    # reservation rolled back: the full chip is available again
+    assert info.snapshot()[0].free_hbm_mib == 16384
+    # claim dropped: a fresh cache (worst-case watch lag) can place a
+    # full-chip pod right away
+    fresh = SchedulerCache(fc)
+    pod2 = fc.create_pod(make_pod(hbm=16384, name="w2"))
+    fresh.get_node_info("n1").allocate(pod2, fc, ha_claims=True)

@@ -238,23 +238,27 @@ def test_split_brain_concurrent_binds_exactly_one_wins(cluster):
         b.server._elector = b.elector
         b.elector.start()
 
-    # partition A's ELECTOR from the apiserver (its scheduler-facing
-    # client keeps working — the realistic partial-partition): A keeps
-    # believing it leads until its renew deadline, while B legitimately
-    # acquires the expired lease -> a genuine dual-leader window.
-    real_elector_cluster = a.elector._cluster
+    # Turn A into a ZOMBIE leader — the fencing hazard leases cannot
+    # close: its election loop dies mid-term WITHOUT abdicating (process
+    # pause / GC stall model), but its HTTP server keeps serving binds
+    # on the stale belief. B legitimately acquires the expired lease, so
+    # both replicas now accept binds concurrently. (A partitioned-but-
+    # live elector steps down before the lease expires — tested in
+    # test_ha.py — so a zombie is the only way this window opens, and
+    # the claim CAS is the layer that must hold when it does.)
+    a.elector._stop.set()
+    a.elector._thread.join(timeout=2)
 
-    class Partitioned:
-        def __getattr__(self, item):
-            def boom(*args, **kw):
-                raise OSError("apiserver unreachable (partition)")
-            return boom
+    class Zombie:
+        identity = "ra"
 
-    a.elector._cluster = Partitioned()
+        def is_leader(self):
+            return True
+
+    a.server._elector = Zombie()
     try:
-        assert wait_until(
-            lambda: b.elector.is_leader() and a.elector.is_leader(),
-            timeout=5.0), "need an overlap window (B acquired, A stale)"
+        assert wait_until(b.elector.is_leader, timeout=5.0), \
+            "B must take over the expired lease"
 
         # same pods, bound through BOTH replicas simultaneously
         pods = [seed_pod(stub, f"split-{i}", 4 * GIB) for i in range(8)]
@@ -285,7 +289,7 @@ def test_split_brain_concurrent_binds_exactly_one_wins(cluster):
         for t in threads:
             t.join(timeout=30)
     finally:
-        a.elector._cluster = real_elector_cluster
+        a.server._elector = a.elector  # un-zombie for fixture teardown
 
     # exactly-one-wins comes from the apiserver: every pod is bound to
     # exactly one node with consistent annotations, chips within capacity

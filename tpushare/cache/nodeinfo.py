@@ -102,6 +102,12 @@ class NodeInfo:
         # duplicate bind for the same pod must be refused up front, or the
         # loser's rollback would erase the winner's live reservation
         self._inflight: set[str] = set()
+        # accounting-key -> removal time ns for pods this cache has seen
+        # LEAVE (termination / reclaim). Claims STAMPED BEFORE the
+        # tombstone stop charging capacity (the pod's usage is gone and
+        # this cache knows it); claims stamped after are a re-placement
+        # and still protect. Pruned on the claim path after CLAIM_TTL_NS.
+        self._tombstones: dict[str, int] = {}
         # snapshot cache: scheduling state changes rarely relative to
         # Filter calls (every webhook snapshots every node), so views are
         # rebuilt only when _version moves. Mutators bump _dirty().
@@ -224,6 +230,10 @@ class NodeInfo:
     # claims older than this are abandoned bind attempts (binder crashed
     # between claim and pod-patch) and stop counting against capacity
     CLAIM_TTL_NS = 120 * 1_000_000_000
+    # how long a live claim blocks a SECOND bind attempt for the same pod:
+    # a real in-flight bind lasts seconds, so a short window bounds the
+    # stall when a failed attempt's _drop_claim lost its CAS races
+    CLAIM_INFLIGHT_NS = 15 * 1_000_000_000
 
     def _claim_chips(self, cluster, key: str, placement, demand: int,
                      t_ns: int) -> None:
@@ -272,13 +282,18 @@ class NodeInfo:
                 visible = {c.idx: set(c.pod_uids) for c in self.chips}
                 free = {c.idx: c.total_hbm_mib - c.used_hbm_mib
                         for c in self.chips}
+                # prune expired tombstones while we're here
+                for tk in [k for k, tt in self._tombstones.items()
+                           if t_ns - tt >= self.CLAIM_TTL_NS]:
+                    self._tombstones.pop(tk, None)
+                tombs = dict(self._tombstones)
             mine = claims.get(key)
             if mine is not None:
                 try:
                     if int(mine["t"]) == t_ns:
                         return  # our own write landed (client retry after
                         # a dropped response); the claim is in place
-                    fresh = (t_ns - int(mine["t"])) < self.CLAIM_TTL_NS
+                    fresh = (t_ns - int(mine["t"])) < self.CLAIM_INFLIGHT_NS
                 except (KeyError, TypeError, ValueError):
                     fresh = False
                 if fresh:
@@ -305,6 +320,13 @@ class NodeInfo:
                     continue  # expired: binder crashed or placement is
                     # long since watch-visible everywhere
                 kept[ckey] = entry
+                if ckey in tombs and int(entry["t"]) <= tombs[ckey]:
+                    # this cache SAW the pod leave (termination/reclaim)
+                    # after the claim was stamped: its usage is gone, so
+                    # the claim must not block the freed chips for the
+                    # rest of its TTL. A claim stamped AFTER the
+                    # tombstone is a re-placement and still protects.
+                    continue
                 for cid in chip_ids:
                     if cid in free and ckey not in visible.get(cid, ()):
                         # charge only chips where my cache does not
@@ -335,25 +357,33 @@ class NodeInfo:
 
     def _drop_claim(self, cluster, key: str, t_ns: int) -> None:
         """Best-effort removal of OUR claim instance after a failed bind
-        (an expired claim is dropped by later binds anyway). Stamp-guarded:
-        a claim for the same pod written by a concurrent winner must not
-        be stripped by the loser's rollback."""
-        try:
-            node = cluster.get_node(self.name)
-            rv = (node.get("metadata") or {}).get("resourceVersion")
-            raw = (node.get("metadata") or {}).get(
-                "annotations", {}).get(contract.ANN_NODE_CLAIMS)
-            claims = json.loads(raw) if raw else {}
-            entry = claims.get(key)
-            if entry is None or entry.get("t") != t_ns:
+        (CLAIM_INFLIGHT_NS bounds the stall if this loses anyway).
+        Stamp-guarded: a claim for the same pod written by a concurrent
+        winner must not be stripped by the loser's rollback. Retries CAS
+        losses a few times — a single swallowed 409 left the stale claim
+        blocking the pod's rebind for the whole in-flight window."""
+        for _ in range(4):
+            try:
+                node = cluster.get_node(self.name)
+                rv = (node.get("metadata") or {}).get("resourceVersion")
+                raw = (node.get("metadata") or {}).get(
+                    "annotations", {}).get(contract.ANN_NODE_CLAIMS)
+                claims = json.loads(raw) if raw else {}
+                entry = claims.get(key)
+                if entry is None or entry.get("t") != t_ns:
+                    return
+                claims.pop(key)
+                cluster.patch_node(self.name, {"metadata": {
+                    "resourceVersion": rv,
+                    "annotations": {contract.ANN_NODE_CLAIMS: json.dumps(
+                        claims, sort_keys=True)}}})
                 return
-            claims.pop(key)
-            cluster.patch_node(self.name, {"metadata": {
-                "resourceVersion": rv,
-                "annotations": {contract.ANN_NODE_CLAIMS: json.dumps(
-                    claims, sort_keys=True)}}})
-        except (ApiError, ValueError):
-            pass
+            except ApiError as e:
+                if e.is_conflict:
+                    continue  # CAS lost: re-read and retry
+                return
+            except ValueError:
+                return
 
     def _allocate_io(self, pod, cluster, now_ns, placement, demand,
                      uid, key, ns, name, ha_claims=False) -> Placement:
@@ -480,6 +510,7 @@ class NodeInfo:
         with self._lock:
             for c in self.chips:
                 c.remove_pod(key)
+            self._tombstones[key] = time.time_ns()
             self._dirty()
 
     def update_node(self, node: dict[str, Any]) -> bool:

@@ -104,14 +104,31 @@ def fits(chips: Sequence[ChipView], topo: MeshTopology,
     """Filter-path predicate: can this node host the request at all?
 
     Mirrors ``Assume`` (nodeinfo.go:147-181): count chips with enough free
-    HBM. For contiguity-required multi-chip requests the existence check must
-    consult the mesh, so it delegates to :func:`select_chips` — still O(mesh)
-    small on a single host (<=16 chips on v5e, 8 on v5p hosts).
+    HBM. For contiguity-required multi-chip requests the existence check
+    consults the mesh but stops at the FIRST eligible box — the same
+    early-exit bound as the C++ fleet scan (placement.cpp fits_one:
+    "existence is enough for Filter"); only the bind path pays the full
+    scoring pass.
     """
     if req.chip_count == 1 or req.allow_scatter:
         n = sum(1 for c in chips if _eligible(c, req))
         return n >= req.chip_count
-    return select_chips(chips, topo, req) is not None
+
+    if len(chips) != topo.num_chips:
+        topo = MeshTopology((len(chips),))  # partial host: 1-D fallback
+    by_idx = {c.idx: c for c in chips}
+    shapes = [req.topology] if req.topology is not None \
+        else topo.box_shapes(req.chip_count)
+    for box in shapes:
+        if len(box) != len(topo.shape):
+            continue
+        for origin in topo.box_positions(box):
+            ids = topo.box_chips(origin, box)
+            members = [by_idx[i] for i in ids if i in by_idx]
+            if len(members) == len(ids) and \
+                    all(_eligible(c, req) for c in members):
+                return True
+    return False
 
 
 def select_chips(chips: Sequence[ChipView], topo: MeshTopology,
