@@ -329,3 +329,58 @@ def test_split_brain_concurrent_binds_exactly_one_wins(cluster):
     for name, nodes in per_pod_nodes.items():
         assert len(nodes) <= 1, \
             f"{name} bound successfully to different nodes: {nodes}"
+
+
+def test_claim_conflict_metric_counts_ha_backpressure(cluster):
+    """A bind refused by a concurrent replica's claim must increment
+    tpushare_ha_claim_conflicts_total (and return a benign error, not a
+    500-with-event)."""
+    stub, a, b = cluster
+    leader = a if a.elector.is_leader() else b
+    # fill EVERY chip of s0 through the leader so any later choice on s0
+    # overlaps a live claim
+    for i in range(CHIPS):
+        pod = seed_pod(stub, f"metric-fill-{i}", 16 * GIB)
+        assert try_schedule([leader], pod, ["s0"]) == "s0"
+
+    # a replica whose cache has NEVER seen those binds (no controller,
+    # worst-case watch lag) serves a bind with a zombie-leader belief:
+    # its filter passes on the stale cache and the claim CAS must refuse
+    stale = Replica(stub, "rz")
+    stale.controller.stop()
+    stale.cache = SchedulerCache(stale.client)  # empty, watch-less
+    stale.server.stop()
+    stale.elector.stop()
+
+    class Zombie:
+        identity = "rz"
+
+        def is_leader(self):
+            return True
+
+    stale.server = ExtenderServer(stale.cache, stale.client,
+                                  host="127.0.0.1", port=0,
+                                  elector=Zombie())
+    base = f"http://127.0.0.1:{stale.server.start()}/tpushare-scheduler"
+    try:
+        pod2 = seed_pod(stub, "metric-victim", 16 * GIB)
+        status, result = post(base, "/bind", {
+            "PodName": "metric-victim", "PodNamespace": "storm",
+            "PodUID": pod2["metadata"].get("uid", ""), "Node": "s0"})
+        # bind failures are HTTP 500 + Error (reference routes.go:139-143);
+        # "benign" means no FailedScheduling-style event, not a 200
+        assert status == 500
+        assert "claim" in result.get("Error", ""), result
+        with urllib.request.urlopen(
+                base.rsplit("/", 1)[0] + "/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        value = next(
+            float(line.split()[-1]) for line in metrics.splitlines()
+            if line.startswith("tpushare_ha_claim_conflicts_total"))
+        assert value >= 1.0, metrics
+        # and the victim pod is untouched (unbound, no placement)
+        victim = stale.client.get_pod("storm", "metric-victim")
+        assert not victim.get("spec", {}).get("nodeName")
+        assert contract.chip_ids_from_annotations(victim) is None
+    finally:
+        stale.server.stop()

@@ -12,9 +12,10 @@ oversubscription-safe under concurrent binds.
 
 from tpushare.cache.chipusage import ChipUsage
 from tpushare.cache.nodeinfo import (
-    AllocationError, AlreadyBoundError, BindInFlightError, NodeInfo)
+    AllocationError, AlreadyBoundError, BindInFlightError,
+    ClaimConflictError, NodeInfo)
 from tpushare.cache.cache import SchedulerCache
 
 __all__ = ["ChipUsage", "NodeInfo", "AllocationError", "AlreadyBoundError",
-           "BindInFlightError",
+           "BindInFlightError", "ClaimConflictError",
            "SchedulerCache"]

@@ -60,6 +60,14 @@ class BindInFlightError(AllocationError):
     failure event for a pod the winner is about to bind successfully."""
 
 
+class ClaimConflictError(AllocationError):
+    """An HA claim refused this bind: a concurrent replica's in-flight
+    claim overlaps the placement (or holds this pod, or the claim CAS kept
+    losing). Benign backpressure — the scheduler retries and Filter routes
+    around it — but worth counting: sustained claim conflicts mean
+    replicas are fighting over the same nodes."""
+
+
 def request_from_pod(pod: dict[str, Any]) -> PlacementRequest | None:
     """Translate a pod's resource limits + annotations into a placement
     request. Returns None for non-tpushare pods.
@@ -259,8 +267,11 @@ class NodeInfo:
         4. CAS the set + our claim back; on 409 somebody else claimed
            concurrently -> re-read and revalidate (bounded).
 
-        Raises AllocationError when a foreign claim makes the placement
-        not fit — the scheduler retries and Filter routes elsewhere.
+        Raises ClaimConflictError (counted as
+        tpushare_ha_claim_conflicts_total, no failure event) when a
+        foreign claim makes the placement not fit, a live claim holds
+        this pod, or the CAS keeps losing — the scheduler retries and
+        Filter routes elsewhere.
         """
         for _ in range(8):
             node = cluster.get_node(self.name)
@@ -303,7 +314,7 @@ class NodeInfo:
                     # off the winner's placement — the bug behind r3's
                     # residual split-brain oversubscription. Back off; the
                     # scheduler retries after the dust settles.
-                    raise AllocationError(
+                    raise ClaimConflictError(
                         f"a concurrent bind attempt holds the claim for "
                         f"{key} on {self.name}")
             kept: dict[str, Any] = {}
@@ -335,7 +346,7 @@ class NodeInfo:
             short = [cid for cid in placement.chip_ids
                      if free.get(cid, 0) < 0]
             if short:
-                raise AllocationError(
+                raise ClaimConflictError(
                     f"chips {short} on {self.name} are claimed by "
                     f"concurrent binds (HA replica race); not placing "
                     f"{key} over them")
@@ -352,7 +363,7 @@ class NodeInfo:
                 if not e.is_conflict:
                     raise
                 continue  # another bind claimed concurrently: re-read
-        raise AllocationError(
+        raise ClaimConflictError(
             f"claim CAS on node {self.name} kept losing; giving up")
 
     def _drop_claim(self, cluster, key: str, t_ns: int) -> None:

@@ -20,7 +20,8 @@ from typing import Any
 
 from tpushare import contract
 from tpushare.cache import (
-    AllocationError, AlreadyBoundError, BindInFlightError, SchedulerCache)
+    AllocationError, AlreadyBoundError, BindInFlightError,
+    ClaimConflictError, SchedulerCache)
 from tpushare.cache.nodeinfo import no_fit_reason, request_from_pod
 from tpushare.core.native import engine as native_engine
 from tpushare.contract import pod as podlib
@@ -180,6 +181,11 @@ class BindHandler:
             "tpushare_bind_seconds",
             "Schedule-to-bind latency (the BASELINE p50<50ms metric)",
             LATENCY_BUCKETS)
+        self.claim_conflicts = registry.counter(
+            "tpushare_ha_claim_conflicts_total",
+            "Binds refused by a concurrent replica's node claim (HA "
+            "backpressure; sustained growth = replicas fighting over "
+            "the same nodes)")
 
     def handle(self, args: dict[str, Any]) -> dict[str, Any]:
         t0 = time.perf_counter()
@@ -204,6 +210,13 @@ class BindHandler:
             # Fail this request (outcome unknown here) but emit no failure
             # event — a FailedScheduling for a pod the winner is about to
             # bind successfully would mislead operators.
+            self.bind_failures.inc()
+            log.info("bind %s/%s -> %s refused: %s", ns, name, node, e)
+            return {"Error": str(e)}
+        except ClaimConflictError as e:
+            # benign HA backpressure: the scheduler retries; no
+            # FailedScheduling-style event, but counted for operators
+            self.claim_conflicts.inc()
             self.bind_failures.inc()
             log.info("bind %s/%s -> %s refused: %s", ns, name, node, e)
             return {"Error": str(e)}
