@@ -93,3 +93,74 @@ def test_ring_rejects_mismatched_kv():
     q, k, v = rand_qkv(jax.random.key(6), S=128)
     with pytest.raises(ValueError, match="must match"):
         ring_attention(q, k[:, :, :64], v[:, :, :64], mesh)
+
+
+def test_zigzag_order_roundtrip():
+    from tpushare.workloads.ringattention import zigzag_inverse, zigzag_order
+    S, n = 32, 4
+    fwd = np.asarray(zigzag_order(S, n))
+    inv = np.asarray(zigzag_inverse(S, n))
+    x = np.arange(S)
+    assert (x[fwd][inv] == x).all()
+    # rank 0 holds halves 0 and 2n-1 (positions 0..3 and 28..31)
+    assert list(fwd[:8]) == [0, 1, 2, 3, 28, 29, 30, 31]
+
+
+def test_zigzag_matches_reference_causal():
+    from tpushare.workloads.ringattention import (
+        ring_attention, zigzag_inverse, zigzag_order)
+    mesh = sp_mesh()
+    n = mesh.shape["sp"]
+    B, H, S, D = 2, 2, 64, 16
+    q, k, v = rand_qkv(jax.random.key(11), B=B, H=H, S=S, D=D)
+    perm = zigzag_order(S, n)
+    inv = zigzag_inverse(S, n)
+    out_z = ring_attention(q[:, :, perm], k[:, :, perm], v[:, :, perm],
+                           mesh, causal=True, zigzag=True)
+    out = out_z[:, :, inv]
+    ref = attention_reference(q, k, v, causal=True)
+    assert_close(out, ref)
+
+
+def test_zigzag_matches_reference_noncausal():
+    # NOTE: with causal=False the position bookkeeping is inert, so this
+    # only checks permutation equivariance of the non-causal ring — the
+    # causal tests are what exercise the zigzag math.
+    from tpushare.workloads.ringattention import (
+        ring_attention, zigzag_inverse, zigzag_order)
+    mesh = sp_mesh()
+    n = mesh.shape["sp"]
+    B, H, S, D = 1, 2, 48, 8
+    q, k, v = rand_qkv(jax.random.key(12), B=B, H=H, S=S, D=D)
+    perm = zigzag_order(S, n)
+    inv = zigzag_inverse(S, n)
+    out_z = ring_attention(q[:, :, perm], k[:, :, perm], v[:, :, perm],
+                           mesh, causal=False, zigzag=True)
+    assert_close(out_z[:, :, inv],
+                 attention_reference(q, k, v, causal=False))
+
+
+def test_zigzag_matches_reference_causal_small_ring():
+    # second causal shape on a SMALLER ring (n=2): different half-chunk
+    # arithmetic ((2n-1-r) offsets) than the n=8 case
+    from tpushare.workloads.ringattention import (
+        ring_attention, zigzag_inverse, zigzag_order)
+    mesh = sp_mesh(2)
+    B, H, S, D = 2, 3, 40, 8
+    q, k, v = rand_qkv(jax.random.key(14), B=B, H=H, S=S, D=D)
+    perm = zigzag_order(S, 2)
+    inv = zigzag_inverse(S, 2)
+    out_z = ring_attention(q[:, :, perm], k[:, :, perm], v[:, :, perm],
+                           mesh, causal=True, zigzag=True)
+    assert_close(out_z[:, :, inv],
+                 attention_reference(q, k, v, causal=True))
+
+
+def test_zigzag_rejects_odd_chunk():
+    from tpushare.workloads.ringattention import ring_attention
+    mesh = sp_mesh()
+    n = mesh.shape["sp"]
+    S = n * 3  # odd per-rank chunk
+    q, k, v = rand_qkv(jax.random.key(13), B=1, H=1, S=S, D=8)
+    with pytest.raises(ValueError, match="zigzag"):
+        ring_attention(q, k, v, mesh, causal=True, zigzag=True)

@@ -30,31 +30,71 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
+def _chunk_positions(rank, per: int, n: int, zigzag: bool):
+    """Global sequence positions of the rows rank ``rank`` holds.
+
+    Contiguous: rows [rank*per, (rank+1)*per). Zigzag: the sequence is
+    cut into 2n half-chunks and rank r holds halves r and 2n-1-r — so
+    every rank owns one early and one late stretch of the sequence and
+    causal work balances (contiguous sharding makes rank n-1 fold n
+    chunks of visible keys while rank 0 folds one: the slowest rank sets
+    the SPMD critical path).
+    """
+    if not zigzag:
+        return rank * per + jnp.arange(per)
+    h = per // 2
+    return jnp.concatenate([rank * h + jnp.arange(h),
+                            (2 * n - 1 - rank) * h + jnp.arange(h)])
+
+
 def _ring_body(carry, step, *, axis_name: str, n: int, my: jax.Array,
-               q32: jax.Array, q_pos: jax.Array, causal: bool):
+               qs: jax.Array, q_pos: jax.Array, causal: bool,
+               zigzag: bool):
     """Fold the currently-held K/V chunk into the online-softmax state,
-    then pass the chunk to the next rank (skip the send on the last step)."""
+    then pass the chunk to the next rank (skip the send on the last step).
+
+    The fold keeps inputs in their storage dtype through the MXU
+    (fp32 accumulation via preferred_element_type — pre-casting to fp32
+    halves MXU throughput, the same lesson as the Pallas kernel), and a
+    causally fully-masked chunk skips the fold entirely instead of
+    computing an all--inf score block.
+    """
     m, l, acc, kb, vb = carry
     sk = kb.shape[2]
     src = (my - step) % n                     # rank this chunk started at
-    k_pos = src * sk + jnp.arange(sk)         # global key positions
+    k_pos = _chunk_positions(src, sk, n, zigzag)   # global key positions
 
-    s = jnp.einsum("bhqd,bhkd->bhqk", q32, kb.astype(jnp.float32))
-    if causal:
-        mask = k_pos[None, :] <= q_pos[:, None]        # [Sq, Sk]
-        s = jnp.where(mask[None, None], s, -jnp.inf)
+    def fold(operand):
+        m, l, acc = operand
+        s = jax.lax.dot_general(
+            qs, kb, (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)        # [B, H, Sq, Sk]
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]    # [Sq, Sk]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
 
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    # rows with no visible key yet carry m = -inf; clamp the shift so
-    # exp(-inf - -inf) never produces NaN
-    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    p = jnp.exp(s - shift)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # rows with no visible key yet carry m = -inf; clamp the shift so
+        # exp(-inf - -inf) never produces NaN
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift)
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
     if causal:
-        p = jnp.where(mask[None, None], p, 0.0)
-    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
-    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
-                                   vb.astype(jnp.float32))
+        # a chunk whose earliest key is after my latest row contributes
+        # nothing — skip the matmuls and the exp pipeline outright
+        any_visible = jnp.min(k_pos) <= jnp.max(q_pos)
+        m, l, acc = lax.cond(any_visible, fold,
+                             lambda op: op, (m, l, acc))
+    else:
+        m, l, acc = fold((m, l, acc))
 
     def rotate(kv):
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -62,41 +102,77 @@ def _ring_body(carry, step, *, axis_name: str, n: int, my: jax.Array,
                 lax.ppermute(kv[1], axis_name, perm))
 
     kb, vb = lax.cond(step < n - 1, rotate, lambda kv: kv, (kb, vb))
-    return (m_new, l, acc, kb, vb), None
+    return (m, l, acc, kb, vb), None
 
 
 def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                          axis_name: str, causal: bool) -> jax.Array:
+                          axis_name: str, causal: bool,
+                          zigzag: bool) -> jax.Array:
     """Per-shard body (runs under shard_map): q, k, v are the local
-    [B, H, S/n, D] chunks, contiguous in ring order."""
+    [B, H, S/n, D] chunks, in ring order (contiguous or zigzag)."""
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, H, sq, d = q.shape
-    q32 = q.astype(jnp.float32) * (d ** -0.5)
-    q_pos = my * sq + jnp.arange(sq)
+    # scale folded into q off the [Sq, Sk] score path, storage dtype kept
+    qs = (q.astype(jnp.float32) * (d ** -0.5)).astype(q.dtype)
+    q_pos = _chunk_positions(my, sq, n, zigzag)
 
     m = jnp.full((B, H, sq, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((B, H, sq, 1), jnp.float32)
     acc = jnp.zeros((B, H, sq, d), jnp.float32)
 
     body = functools.partial(_ring_body, axis_name=axis_name, n=n, my=my,
-                             q32=q32, q_pos=q_pos, causal=causal)
+                             qs=qs, q_pos=q_pos, causal=causal,
+                             zigzag=zigzag)
     (m, l, acc, _, _), _ = lax.scan(body, (m, l, acc, k, v),
                                     jnp.arange(n))
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def zigzag_order(S: int, n: int):
+    """Index permutation taking a [.., S, ..] sequence from natural order
+    to zigzag ring order: the sequence is cut into 2n half-chunks and
+    rank r's shard becomes halves (r, 2n-1-r). Apply along the sequence
+    axis BEFORE sharding with ``zigzag=True``; invert with
+    :func:`zigzag_inverse`."""
+    if S % (2 * n):
+        raise ValueError(f"seq len {S} not divisible by 2*{n}")
+    h = S // (2 * n)
+    idx = []
+    for r in range(n):
+        idx.extend(range(r * h, (r + 1) * h))
+        idx.extend(range((2 * n - 1 - r) * h, (2 * n - r) * h))
+    return jnp.asarray(idx)
+
+
+def zigzag_inverse(S: int, n: int):
+    """Inverse permutation of :func:`zigzag_order`."""
+    fwd = zigzag_order(S, n)
+    inv = jnp.zeros(S, jnp.int32).at[fwd].set(jnp.arange(S, dtype=jnp.int32))
+    return inv
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mesh: jax.sharding.Mesh, axis: str = "sp",
-                   causal: bool = True) -> jax.Array:
+                   causal: bool = True, zigzag: bool = False) -> jax.Array:
     """Exact attention over [B, H, S, D] with the sequence sharded on
     ``axis``. S must divide evenly by the axis size. Jit-compatible; under
     jit the shard_map composes with outer dp/tp shardings.
+
+    ``zigzag=True`` expects the sequence axis pre-permuted with
+    :func:`zigzag_order` (output comes back in the same permuted order):
+    every rank then owns one early and one late stretch, so causal work
+    is balanced across the ring instead of rank n-1 folding n visible
+    chunks while rank 0 folds one (the llama3-style layout; the SPMD
+    critical path is the slowest rank).
     """
     B, H, S, D = q.shape
     n = mesh.shape[axis]
     if S % n:
         raise ValueError(f"seq len {S} not divisible by {axis} size {n}")
+    if zigzag and (S // n) % 2:
+        raise ValueError(
+            f"zigzag needs an even per-rank chunk (S/n = {S // n})")
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(
             f"q {q.shape} / k {k.shape} / v {v.shape} must match "
@@ -104,7 +180,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(None, None, axis, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis,
-                          causal=causal),
+                          causal=causal, zigzag=zigzag),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
