@@ -78,3 +78,17 @@ def test_differentiable():
     for a, b in zip(gu, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_ulysses_flash_matches_einsum_path():
+    """attn='flash' (the fused-kernel TPU serving path; interpret mode
+    here) must match the einsum spec path on the same sharded inputs."""
+    mesh = _mesh(4)
+    q, k, v = _qkv(S=128, seed=9)
+    out_flash = ulysses_attention(q, k, v, mesh, causal=True, attn="flash")
+    out_einsum = ulysses_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out_flash),
+                               np.asarray(out_einsum),
+                               rtol=2e-2, atol=2e-2)
+    with pytest.raises(ValueError, match="attn"):
+        ulysses_attention(q, k, v, mesh, attn="nope")

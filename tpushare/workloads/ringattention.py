@@ -64,12 +64,12 @@ def _ring_body(carry, step, *, axis_name: str, n: int, my: jax.Array,
     src = (my - step) % n                     # rank this chunk started at
     k_pos = _chunk_positions(src, sk, n, zigzag)   # global key positions
 
-    def fold(operand):
+    def fold(operand, masked: bool):
         m, l, acc = operand
         s = jax.lax.dot_general(
             qs, kb, (((3,), (3,)), ((0, 1), (0, 1))),
             preferred_element_type=jnp.float32)        # [B, H, Sq, Sk]
-        if causal:
+        if masked:
             mask = k_pos[None, :] <= q_pos[:, None]    # [Sq, Sk]
             s = jnp.where(mask[None, None], s, -jnp.inf)
 
@@ -78,7 +78,7 @@ def _ring_body(carry, step, *, axis_name: str, n: int, my: jax.Array,
         # exp(-inf - -inf) never produces NaN
         shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(s - shift)
-        if causal:
+        if masked:
             p = jnp.where(mask[None, None], p, 0.0)
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -88,13 +88,22 @@ def _ring_body(carry, step, *, axis_name: str, n: int, my: jax.Array,
         return m_new, l_new, acc_new
 
     if causal:
-        # a chunk whose earliest key is after my latest row contributes
-        # nothing — skip the matmuls and the exp pipeline outright
+        # three mask classes per chunk: fully masked (skip everything),
+        # fully visible (contiguous layout: every src < my chunk — skip
+        # the mask build and both where passes over [Sq, Sk], mirroring
+        # the Pallas kernel's unmasked fast path), diagonal (masked fold)
         any_visible = jnp.min(k_pos) <= jnp.max(q_pos)
-        m, l, acc = lax.cond(any_visible, fold,
-                             lambda op: op, (m, l, acc))
+        fully_visible = jnp.max(k_pos) <= jnp.min(q_pos)
+        branch = jnp.where(any_visible,
+                           jnp.where(fully_visible, 2, 1), 0)
+        m, l, acc = lax.switch(
+            branch,
+            [lambda op: op,
+             functools.partial(fold, masked=True),
+             functools.partial(fold, masked=False)],
+            (m, l, acc))
     else:
-        m, l, acc = fold((m, l, acc))
+        m, l, acc = fold((m, l, acc), masked=False)
 
     def rotate(kv):
         perm = [(i, (i + 1) % n) for i in range(n)]

@@ -30,7 +30,8 @@ from jax.sharding import PartitionSpec as P
 
 
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                   axis_name: str, causal: bool) -> jax.Array:
+                   axis_name: str, causal: bool, attn: str,
+                   interpret: bool) -> jax.Array:
     """Per-shard body under shard_map: q/k/v are local [B, H, S/n, D]."""
     # heads scatter, sequence gathers: [B, H, S/n, D] -> [B, H/n, S, D]
     def seq_to_head(x):
@@ -39,18 +40,26 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
 
-    # ordinary full-sequence attention over the local head subset (fp32
-    # softmax, matching attention_reference numerics)
-    d = qh.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
-                   kh.astype(jnp.float32)) * (d ** -0.5)
-    if causal:
-        S = qh.shape[2]
-        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p,
-                   vh.astype(jnp.float32)).astype(q.dtype)
+    if attn == "flash":
+        # the sequence is FULL per device after the all_to_all, so the
+        # fused Pallas kernel applies unchanged to the local head subset
+        # — O(block) residency instead of this path's [S, S] fp32 score
+        # matrix (Mosaic on TPU, interpret elsewhere)
+        from tpushare.workloads.attention import flash_attention
+        o = flash_attention(qh, kh, vh, causal=causal,
+                            interpret=interpret)
+    else:
+        # einsum spec path (fp32 softmax, attention_reference numerics)
+        d = qh.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * (d ** -0.5)
+        if causal:
+            S = qh.shape[2]
+            mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p,
+                       vh.astype(jnp.float32)).astype(q.dtype)
 
     # restore sequence sharding: [B, H/n, S, D] -> [B, H, S/n, D]
     return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
@@ -59,13 +68,26 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       mesh: jax.sharding.Mesh, axis: str = "sp",
-                      causal: bool = True) -> jax.Array:
+                      causal: bool = True,
+                      attn: str = "einsum") -> jax.Array:
     """Exact attention over [B, H, S, D] with the sequence sharded on
     ``axis`` via head/sequence all_to_all re-sharding. Requires both
     ``S`` and ``H`` divisible by the axis size (GQA callers expand K/V
     heads first, as with ring attention). Jit-compatible; composes with
     outer dp/tp shardings.
+
+    ``attn="flash"`` runs the fused Pallas kernel on each device's full-
+    sequence head subset (O(block) residency; the TPU serving path) —
+    the einsum default keeps CPU test meshes fast and is the numerics
+    spec.
     """
+    if attn not in ("einsum", "flash"):
+        raise ValueError(f"attn must be 'einsum' or 'flash', got {attn!r}")
+    # Mosaic vs interpret must follow the MESH's platform, not the process
+    # default backend: a CPU test mesh in a process whose default backend
+    # is TPU (entry() ran on the chip first) would otherwise try to lower
+    # the Mosaic kernel for CPU devices inside shard_map
+    interpret = mesh.devices.flat[0].platform != "tpu"
     B, H, S, D = q.shape
     n = mesh.shape[axis]
     if S % n:
@@ -79,7 +101,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"q {q.shape} / k {k.shape} / v {v.shape} must match")
     spec = P(None, None, axis, None)
     fn = jax.shard_map(
-        functools.partial(_ulysses_local, axis_name=axis, causal=causal),
+        functools.partial(_ulysses_local, axis_name=axis, causal=causal,
+                          attn=attn, interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
