@@ -117,3 +117,51 @@ def test_model_forward_flash_matches_einsum():
     # everywhere despite bf16 accumulation-order differences
     agree = (jnp.argmax(ref, -1) == jnp.argmax(out, -1)).mean()
     assert float(agree) >= 0.95
+
+
+def test_flash_gqa_matches_expanded_reference():
+    """GQA-native call (small kv heads) == reference on expanded heads."""
+    B, H, Hkv, S, D = 2, 8, 2, 192, 32
+    kq, kk, kv = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, Hkv, S, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, Hkv, S, D), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    g = H // Hkv
+    ref = attention_reference(q, jnp.repeat(k, g, axis=1),
+                              jnp.repeat(v, g, axis=1), causal=True)
+    assert out.shape == (B, H, S, D)
+    assert_close(out, ref)
+
+
+def test_flash_gqa_backward_matches_expanded_autodiff():
+    B, H, Hkv, S, D = 1, 4, 2, 128, 16
+    kq, kk, kv = jax.random.split(jax.random.key(8), 3)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.float32)
+    k = jax.random.normal(kk, (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(kv, (B, Hkv, S, D), jnp.float32)
+    w = jax.random.normal(jax.random.key(9), (B, H, S, D), jnp.float32)
+    g = H // Hkv
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(
+            q, jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1),
+            causal=True) * w)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_rejects_nondividing_kv_heads():
+    q, k, v = rand_qkv(jax.random.key(10), H=6)
+    with pytest.raises(ValueError, match="kv heads dividing"):
+        flash_attention(q, k[:, :4], v[:, :4], interpret=True)

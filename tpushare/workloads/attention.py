@@ -20,9 +20,12 @@ pass regenerates each probability block from the kernel's log-sum-exp
 residual and scans over K/V blocks — training configs may therefore use
 ``attn="flash"`` and keep O(S x BLOCK) attention residency in both passes.
 
-Layout contract: q, k, v are [B, H, S, D] (heads already GQA-expanded),
-D <= 128. Sequences are padded to the 128-block internally; padded KEY
-positions are masked, padded QUERY rows are sliced off on return.
+Layout contract: q is [B, H, S, D]; k/v are [B, H_kv, S_kv, D] with
+H_kv dividing H (GQA-native — pass the SMALL kv heads; the kernel's kv
+BlockSpecs divide the head index by the group size so repeated heads are
+never materialized, which is the HBM point of GQA). D <= 128. Sequences
+are padded to the 128-block internally; padded KEY positions are masked,
+padded QUERY rows are sliced off on return.
 """
 
 from __future__ import annotations
@@ -167,11 +170,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
                 causal: bool, interpret: bool,
                 block_q: int | None = None, block_kv: int | None = None):
-    """Run the kernel; returns (out [B,H,S,D], lse [B,H,S] fp32)."""
+    """Run the kernel; returns (out [B,H,S,D], lse [B,H,S] fp32).
+
+    GQA-native: k/v may carry fewer heads (H_kv dividing H); the kv
+    BlockSpec index maps divide the head index by the group size, so each
+    query-head group streams the SAME kv blocks — the kernel never
+    materializes the repeated heads, which is the whole HBM point of GQA
+    (a pre-expanded call would move group-size x more K/V per step).
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    g = H // Hkv  # query heads per kv head (validated by the caller)
     kv = k.shape[2]
     # shrink tiles to the 128-aligned sequence so short shapes don't pad
     # out to a full default tile
@@ -202,9 +214,9 @@ def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, 1, bq, D),
                          lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bk, D),
-                         lambda b, h, i, j: (b, h, j, 0)),
+                         lambda b, h, i, j, g=g: (b, h // g, j, 0)),
             pl.BlockSpec((1, 1, bk, D),
-                         lambda b, h, i, j: (b, h, j, 0)),
+                         lambda b, h, i, j, g=g: (b, h // g, j, 0)),
         ],
         out_specs=(pl.BlockSpec((1, 1, bq, D),
                                 lambda b, h, i, j: (b, h, i, 0)),
@@ -244,6 +256,16 @@ def _flash_bwd(causal, interpret, block_q, block_kv, res, do):
     """
     q, k, v, out, lse = res
     B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    g = H // Hkv
+    if g > 1:
+        # GQA: recompute with kv heads broadcast to the query heads, then
+        # sum each group's dk/dv back down. This expands K/V in the
+        # BACKWARD only (the forward kernel streams the small heads); a
+        # grouped Pallas backward could avoid it if training memory ever
+        # demands.
+        k = jnp.repeat(k, g, axis=1)
+        v = jnp.repeat(v, g, axis=1)
     kv = k.shape[2]
     scale = D ** -0.5
 
@@ -287,8 +309,12 @@ def _flash_bwd(causal, interpret, block_q, block_kv, res, do):
         block, jnp.zeros_like(qp), (jnp.arange(n_kv), kb_all, vb_all))
     dk = jnp.moveaxis(dk_b, 0, 2).reshape(B, H, kv + pad_k, D)
     dv = jnp.moveaxis(dv_b, 0, 2).reshape(B, H, kv + pad_k, D)
-    return (dq[:, :, :S].astype(q.dtype), dk[:, :, :kv].astype(k.dtype),
-            dv[:, :, :kv].astype(v.dtype))
+    dk, dv = dk[:, :, :kv], dv[:, :, :kv]
+    if g > 1:
+        dk = dk.reshape(B, Hkv, g, kv, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, g, kv, D).sum(axis=2)
+    return (dq[:, :, :S].astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -301,7 +327,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     interpret: bool | None = None,
                     block_q: int | None = None,
                     block_kv: int | None = None) -> jax.Array:
-    """Fused attention over [B, H, S, D] tensors (kv heads pre-expanded).
+    """Fused attention over [B, H, S, D] queries; k/v may carry fewer
+    (GQA) heads — H_kv must divide H and is streamed, never expanded.
 
     Runs the Pallas TPU kernel natively on TPU backends and in interpret
     mode elsewhere (tests/CPU meshes) — same code path, same numerics.
@@ -310,10 +337,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     [S, S] score matrix either.
     """
     B, H, S, D = q.shape
-    if k.shape != (B, H, k.shape[2], D) or v.shape != k.shape:
+    Hkv = k.shape[1] if k.ndim == 4 else -1
+    if k.ndim != 4 or k.shape != (B, Hkv, k.shape[2], D) \
+            or v.shape != k.shape or Hkv <= 0 or H % Hkv:
         raise ValueError(
-            f"q {q.shape} / k {k.shape} / v {v.shape} must share batch, "
-            "heads and head_dim")
+            f"q {q.shape} / k {k.shape} / v {v.shape} must share batch and "
+            "head_dim, with kv heads dividing query heads (GQA-native: "
+            "pass the SMALL kv heads, do not pre-expand)")
     if D > BLOCK:
         raise ValueError(f"head_dim {D} > {BLOCK} unsupported")
     if causal and k.shape[2] != S:

@@ -268,21 +268,23 @@ def decoder_layer(x: jax.Array, lp: dict, positions: jax.Array,
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     h = _rmsnorm(x, lp["attn_norm"])
     q, k, v = _qkv(h, lp, positions, cfg)
-    # GQA: repeat kv heads up to query heads
-    reps = nh // nkv
-    k = jnp.repeat(k, reps, axis=2)
-    v = jnp.repeat(v, reps, axis=2)
     if cfg.attn == "flash":
         if mask is not None:
             raise ValueError(
                 "the flash backend supports only the default causal mask; "
                 "use attn='einsum' for custom masks")
+        # GQA-native: the kernel streams the SMALL kv heads (no repeat —
+        # the whole HBM point of grouped-query attention at serve time)
         from tpushare.workloads.attention import flash_attention
         attn = flash_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), causal=True,
         ).transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
     else:
+        # GQA: repeat kv heads up to query heads for the einsum spec path
+        reps = nh // nkv
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
         if mask is None:
             mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
