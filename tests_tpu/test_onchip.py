@@ -113,3 +113,44 @@ def test_backward_parity_ragged_bf16():
     gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
     for a, b, name in zip(gf, gr, "qkv"):
         assert_close(a, b, atol=1e-1, rtol=5e-2)
+
+
+def test_forward_parity_gqa_compiled():
+    # GQA-native: compiled kernel streams 2 kv heads for 8 query heads;
+    # must match the reference on jnp.repeat-expanded heads
+    B, H, Hkv, S, D = 2, 8, 2, 1024, 128
+    kq, kk, kv = jax.random.split(jax.random.key(20), 3)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, Hkv, S, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, Hkv, S, D), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    g = H // Hkv
+    ref = attention_reference(q, jnp.repeat(k, g, axis=1),
+                              jnp.repeat(v, g, axis=1), causal=True)
+    assert_close(out, ref, atol=5e-2)
+
+
+def test_backward_parity_gqa_compiled():
+    B, H, Hkv, S, D = 1, 4, 2, 384, 64
+    kq, kk, kv = jax.random.split(jax.random.key(21), 3)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.float32)
+    k = jax.random.normal(kk, (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(kv, (B, Hkv, S, D), jnp.float32)
+    w = jax.random.normal(jax.random.key(22), (B, H, S, D), jnp.float32)
+    g = H // Hkv
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(
+            q, jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1),
+            causal=True) * w)
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-2, rtol=3e-2,
+                                   err_msg=f"d{name} mismatch")
