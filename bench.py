@@ -310,6 +310,25 @@ def onchip_tests(timeout_s: float = 900.0) -> dict:
         # a checkout without the correctness suite must not silently
         # publish on-chip numbers
         return {"status": "error", "summary": "tests_tpu/ missing"}
+    # fast probe first: a wedged single-client tunnel hangs backend init
+    # forever (observed after a SIGKILLed holder); fail in ~2 min with a
+    # diagnosable message instead of eating the full suite timeout
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=120)
+        if probe.returncode != 0:
+            return {"status": "error",
+                    "summary": "jax backend init failed: "
+                               + (probe.stderr or "").strip()
+                               .splitlines()[-1][:120]}
+    except subprocess.TimeoutExpired:
+        return {"status": "error",
+                "summary": "jax backend init hung >120s (TPU tunnel "
+                           "wedged? see docs/perf.md caveat)"}
+    except OSError as e:
+        return {"status": "error", "summary": f"backend probe: {e}"}
     try:
         t = subprocess.run(
             [sys.executable, "-m", "pytest", suite, "-q", "--no-header",
