@@ -328,3 +328,54 @@ def test_controller_survives_watch_drops_and_converges():
         assert chaos.injected["watch_pods"] >= 1
     finally:
         ctl.stop()
+
+
+# -- HA claim CAS under apiserver faults --------------------------------------
+
+def test_ha_claims_storm_under_node_patch_chaos():
+    """The per-node claim CAS (get_node + patch_node per bind) under
+    intermittent apiserver failures: binds may fail, but reservations
+    always roll back (no capacity leak), claims never strand a node
+    unschedulable, and nothing oversubscribes."""
+    fc = FakeCluster()
+    fc.add_tpu_node("c1", chips=2, hbm_per_chip_mib=8192, mesh="2x1")
+    chaos = ChaosCluster(fc, seed=11)
+    cache = SchedulerCache(chaos)
+    cache.build_cache()
+    info = cache.get_node_info("c1")
+
+    # intermittent 500s and 409s on the claim path + the pod writes
+    chaos.fail("patch_node", status=500, times=None, probability=0.25)
+    chaos.fail("get_node", status=503, times=None, probability=0.1)
+    chaos.fail("patch_pod", status=500, times=None, probability=0.15)
+
+    bound = 0
+    for i in range(30):
+        pod = fc.create_pod(make_pod(hbm=2048, name=f"cc-{i}"))
+        try:
+            info.allocate(pod, chaos, ha_claims=True)
+            bound += 1
+        except AllocationError:
+            fc.delete_pod("default", f"cc-{i}")
+    assert chaos.injected, "chaos injected nothing"
+    assert bound > 0, "no bind survived the fault rates"
+
+    # invariants: apiserver usage == cache usage == sum of bound pods
+    used = 0
+    for pod in fc.list_pods():
+        ids = contract.chip_ids_from_annotations(pod)
+        if ids is not None:
+            assert pod["spec"].get("nodeName") == "c1"
+            used += contract.hbm_from_annotations(pod) * len(ids)
+    assert used == bound * 2048
+    assert used <= 2 * 8192
+    tree = cache.describe()
+    assert tree["used_hbm_mib"] == used, "reservation leak after faults"
+
+    # the node must still be schedulable once faults stop: claims from
+    # failed attempts were dropped or will expire; free space is real
+    chaos.clear()
+    free = 2 * 8192 - used
+    if free >= 2048:
+        pod = fc.create_pod(make_pod(hbm=2048, name="cc-after"))
+        info.allocate(pod, chaos, ha_claims=True)
