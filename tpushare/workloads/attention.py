@@ -41,9 +41,12 @@ BLOCK = 128  # minimum q/k block edge (MXU-aligned; bf16 min tile is (16, 128))
 # (B*H*Sq/block_q*Skv/block_kv); at 128x128 a 4x8x2048 shape needs 8192
 # steps of two 128^3 matmuls (~43 ns of MXU work each) and per-step
 # dispatch overhead dominates — measured 2.6 ms vs XLA einsum's 1.9 ms on
-# v5e. Larger tiles amortize; 1024x1024 measured 0.49 ms (35% MFU, 3.3x
-# einsum) at B4 H8 S2048 D128 bf16 causal. Chosen by on-chip sweep (see
-# bench.py kernel section); tiles shrink automatically for short
+# v5e. Larger tiles amortize: a 12-config on-chip sweep (r3) put 1024x1024
+# strictly ahead of every neighbor (512x1024 35%, 512x512 27%, 1024x512
+# 26%, 2048x1024 fails to compile — the 8 MB score block overflows VMEM).
+# With the scale pre-fold and the redundant-p-remask removal, 1024x1024
+# measures 0.44 ms = 40% MFU at B4 H8 S2048 D128 bf16 causal (3.7x XLA
+# einsum's 1.65 ms, same harness). Tiles shrink automatically for short
 # sequences.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_KV = 1024
@@ -63,13 +66,14 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                  acc_ref, *, scale: float, seq: int, n_kv: int,
+                  acc_ref, *, seq: int, n_kv: int,
                   causal: bool, block_q: int, block_kv: int):
     """One (b, h, q-block i, kv-block j) grid step.
 
-    q_ref: [1, 1, block_q, D]; k_ref/v_ref: [1, 1, block_kv, D] (current
-    kv block only); o_ref: [1, 1, block_q, D]; m/l/acc: VMEM scratch
-    carrying the online-softmax state across the kv axis.
+    q_ref: [1, 1, block_q, D] (softmax scale pre-folded by the caller);
+    k_ref/v_ref: [1, 1, block_kv, D] (current kv block only); o_ref:
+    [1, 1, block_q, D]; m/l/acc: VMEM scratch carrying the online-softmax
+    state across the kv axis.
     """
     from jax.experimental import pallas as pl
 
@@ -86,27 +90,30 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     # contribute nothing
     visible = (j * block_kv <= (i + 1) * block_q - 1) if causal else (j >= 0)
 
-    def _accum(masked: bool):
+    def _accum(mask_causal: bool, mask_pad: bool):
         # inputs stay in their storage dtype (bf16) through the MXU —
         # fp32 accumulation comes from preferred_element_type; pre-casting
-        # to fp32 would halve MXU throughput. scale is folded into q
-        # ([BQ, D]) instead of s ([BQ, BK]) to keep it off the VPU-bound
-        # score-matrix path.
-        q = (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        # to fp32 would halve MXU throughput. The softmax scale is folded
+        # into q ONCE by _flash_call (not per kv step, and never on the
+        # VPU-bound [BQ, BK] score path).
+        q = q_ref[0, 0]
         bq = q.shape[0]
         kb = k_ref[0, 0]                                  # [BK, D]
         vb = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BQ, BK]
-        if masked:
-            row = i * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_kv), 0)
+        if mask_causal or mask_pad:
             col = j * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_kv), 1)
-            mask = col < seq                              # padded keys out
-            if causal:
-                mask = jnp.logical_and(mask, col <= row)
+            mask = None
+            if mask_pad:
+                mask = col < seq                          # padded keys out
+            if mask_causal:
+                row = i * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_kv), 0)
+                c = col <= row
+                mask = c if mask is None else jnp.logical_and(mask, c)
             s = jnp.where(mask, s, -jnp.inf)
 
         m = m_ref[...]
@@ -114,9 +121,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         # rows with no visible key yet keep m=-inf; exp(-inf - -inf) would
         # be NaN, so clamp the shift for those rows
         shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        # masked score entries are already -inf and exp(-inf - shift) is
+        # exactly 0.0 for any finite shift, so p needs NO re-mask — that
+        # redundant where() pass over [BQ, BK] cost ~10% of kernel time
         p = jnp.exp(s - shift)
-        if masked:
-            p = jnp.where(mask, p, 0.0)
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
         m_ref[...] = m_new
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -127,22 +135,36 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
             p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    # a kv block needs no masking when it lies entirely below the causal
-    # diagonal of its q block and contains no padded keys — the common
-    # case for long sequences, and it skips three VPU passes over the
-    # [BQ, BK] score matrix
+    # mask work is dispatched 3-way so each block class pays only for the
+    # compares it needs (each saved compare/where is a VPU pass over the
+    # [BQ, BK] score matrix):
+    #   full     — entirely below the causal diagonal, no padded keys:
+    #              no mask at all (the common case for long sequences)
+    #   diagonal — straddles the causal diagonal but no padded keys:
+    #              causal compare only
+    #   padded   — contains padded key columns: both compares
     col_end = (j + 1) * block_kv              # exclusive last col + 1
-    full = col_end <= seq
+    nopad = col_end <= seq
     if causal:
-        full = jnp.logical_and(full, col_end - 1 <= i * block_q)
+        below_diag = col_end - 1 <= i * block_q
+        full = jnp.logical_and(nopad, below_diag)
+        diag_only = jnp.logical_and(nopad, jnp.logical_not(below_diag))
+
+        @pl.when(jnp.logical_and(visible, diag_only))
+        def _step_diag():
+            _accum(mask_causal=True, mask_pad=False)
+    else:
+        # non-causal: no diagonal class exists — lowering it anyway would
+        # trace a dead duplicate of the accumulate body into every kernel
+        full = nopad
 
     @pl.when(jnp.logical_and(visible, full))
     def _step_unmasked():
-        _accum(masked=False)
+        _accum(mask_causal=False, mask_pad=False)
 
-    @pl.when(jnp.logical_and(visible, jnp.logical_not(full)))
-    def _step_masked():
-        _accum(masked=True)
+    @pl.when(jnp.logical_and(visible, jnp.logical_not(nopad)))
+    def _step_padded():
+        _accum(mask_causal=causal, mask_pad=True)
 
     # final kv step for this q block: normalize and emit. With unequal
     # block sizes and query padding the diagonal formula can point past
@@ -185,6 +207,10 @@ def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
     Hkv = k.shape[1]
     g = H // Hkv  # query heads per kv head (validated by the caller)
     kv = k.shape[2]
+    # fold the softmax scale into q once, outside the kernel (numerically
+    # identical to the former per-step fold — same f32-multiply-then-
+    # storage-dtype rounding — but paid once instead of every kv step)
+    q = (q.astype(jnp.float32) * (D ** -0.5)).astype(q.dtype)
     # shrink tiles to the 128-aligned sequence so short shapes don't pad
     # out to a full default tile
     bq = min(block_q or DEFAULT_BLOCK_Q, -(-S // BLOCK) * BLOCK)
@@ -204,7 +230,7 @@ def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
         dimension_semantics=("parallel", "parallel", "parallel",
                              "arbitrary"))
     out, lse = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=D ** -0.5, seq=kv,
+        functools.partial(_flash_kernel, seq=kv,
                           n_kv=n_kv, causal=causal, block_q=bq,
                           block_kv=bk),
         out_shape=(jax.ShapeDtypeStruct(qp.shape, q.dtype),
