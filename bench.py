@@ -504,6 +504,58 @@ def _kernel_bench_inline() -> dict | None:
         "einsum_mfu_pct": mfu(einsum_ms),
     })
 
+    # training step: fwd + full bwd (dq AND dk/dv), A/B between the
+    # Pallas backward kernel pair (causal block skip, bf16 MXU) and the
+    # XLA blockwise-scan backward. The internal functions are called
+    # DIRECTLY: going through flash_attention's custom VJP with an env
+    # flip would (a) let XLA dead-code-eliminate the dkdv kernel if only
+    # dq were requested, and (b) hit the cached transpose trace so both
+    # arms silently time the same path. All three grads feed the carry so
+    # nothing is DCE-able.
+    from tpushare.workloads.attention import (
+        _flash_bwd_pallas, _flash_bwd_xla, _flash_call)
+
+    def train_loop(pallas_bwd: bool):
+        def make(n):
+            @jax.jit
+            def loop(q, k, v):
+                def body(qq, _):
+                    o, lse = _flash_call(qq, k, v, True, False, None, None)
+                    if pallas_bwd:
+                        dq, dk, dv = _flash_bwd_pallas(
+                            qq, k, v, o, lse, o, True, interpret=False)
+                    else:
+                        dq, dk, dv = _flash_bwd_xla(
+                            True, (qq, k, v, o, lse), o)
+                    mix = (dq.astype(jnp.float32)
+                           + 0.5 * dk.astype(jnp.float32)
+                           + 0.25 * dv.astype(jnp.float32))
+                    return mix.astype(qq.dtype), ()
+                final = jax.lax.scan(body, q, None, length=n)[0]
+                return jnp.sum(final.astype(jnp.float32))
+            return loop
+        return make
+
+    train_pallas_ms = slope_ms(train_loop(True), (q, k, v), n2=105)
+    train_xla_ms = slope_ms(train_loop(False), (q, k, v), n2=105)
+    # fwd 2 matmuls + bwd 5 matmuls (s recompute, dp, dv, dk, dq) x
+    # 2 MACs x B H S^2 D, causal-halved -> 3.5x the forward's matmul
+    # FLOPs (the XLA arm executes ~2x the bwd FLOPs — no causal skip —
+    # but is charged the same useful-FLOP count: MFU measures useful work)
+    train_flops = 7.0 * B * H * S * S * D
+
+    def train_mfu(ms: float) -> float | None:
+        if peak is None or ms <= 0:
+            return None
+        return round(train_flops / (ms / 1e3) / (peak * 1e12) * 100.0, 2)
+
+    out.update({
+        "train_fwdbwd_pallas_ms": round(train_pallas_ms, 4),
+        "train_fwdbwd_xla_ms": round(train_xla_ms, 4),
+        "train_bwd_speedup": round(train_xla_ms / train_pallas_ms, 3),
+        "train_fwdbwd_mfu_pct": train_mfu(train_pallas_ms),
+    })
+
     # llama-mini forward: tokens chained through argmax(logits) so each
     # scan iteration depends on the previous forward's real output
     cfg = PRESETS["llama-mini"].validate()
@@ -708,7 +760,7 @@ def main() -> int:
         # the r2 numbers were physically impossible (741% MFU) and were
         # published anyway; any MFU outside (0, 100] now FAILS the bench
         for key in ("flash_mfu_pct", "einsum_mfu_pct",
-                    "llama_mini_fwd_mfu_pct"):
+                    "llama_mini_fwd_mfu_pct", "train_fwdbwd_mfu_pct"):
             mfu = kernel.get(key)
             if mfu is not None:
                 expect(0.0 < mfu <= 100.0,

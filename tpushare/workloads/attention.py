@@ -259,6 +259,269 @@ def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
     return out[:, :, :S, :], lse[:, :, 0, :S]
 
 
+# Backward tile sizes. The backward kernels keep three [BKV, BQ] fp32
+# intermediates (s, dp, ds) live at once, so tiles are one notch smaller
+# than the forward's 1024x1024 to fit VMEM with double buffering.
+DEFAULT_BWD_BLOCK_Q = 512
+DEFAULT_BWD_BLOCK_KV = 512
+
+
+def _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
+                i, j, seq: int, block_q: int, block_kv: int,
+                mask_causal: bool, mask_pad: bool):
+    """Shared backward block math, in TRANSPOSED score space.
+
+    Everything is [BKV, BQ] (kv positions on sublanes, q positions on
+    lanes) so the per-q-row lse and delta broadcast as [1, BQ] ROW
+    vectors — a [BQ, 1] column layout would need an in-kernel transpose
+    of the [8, BQ] residual block, which Mosaic lowers poorly.
+
+    Returns (p_T, ds_T) as [BKV, BQ]; p_T fp32, ds_T cast to the k/v
+    storage dtype ready for the MXU.
+    """
+    q = q_ref[0, 0]                                   # [BQ, D] pre-scaled
+    kb = k_ref[0, 0]                                  # [BK, D]
+    vb = v_ref[0, 0]
+    dob = do_ref[0, 0]                                # [BQ, D]
+    lse_row = lse_ref[0, 0][0:1, :]                   # [1, BQ]
+    delta_row = delta_ref[0, 0][0:1, :]               # [1, BQ]
+
+    s_t = jax.lax.dot_general(
+        kb, q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [BK, BQ]
+    if mask_causal or mask_pad:
+        kpos = j * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_kv, block_q), 0)
+        mask = None
+        if mask_pad:
+            mask = kpos < seq                         # padded keys out
+        if mask_causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, block_q), 1)
+            c = kpos <= qpos
+            mask = c if mask is None else jnp.logical_and(mask, c)
+        # exp(-inf - lse) == 0, so p needs no re-mask (forward's trick)
+        s_t = jnp.where(mask, s_t, -jnp.inf)
+
+    p_t = jnp.exp(s_t - lse_row)                      # [BK, BQ]
+    dp_t = jax.lax.dot_general(
+        vb, dob, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [BK, BQ]
+    ds_t = (p_t * (dp_t - delta_row)).astype(kb.dtype)
+    return p_t, ds_t, kb, vb, dob, q
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, seq: int, n_kv: int,
+                         causal: bool, block_q: int, block_kv: int):
+    """dq pass: grid (B, H, i, j), j innermost carrying the dq accumulator.
+
+    dq[i] = scale * sum_j ds[i,j] @ k[j]; computed transposed as
+    dot_general(ds_T, k, contract over the kv sublane axis) — an MXU
+    contraction over dim 0 on both sides, no transposes materialized.
+    The caller applies the scale factor (q arrives pre-scaled, so the
+    in-kernel gradient is w.r.t. scaled q).
+    """
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    visible = (j * block_kv <= (i + 1) * block_q - 1) if causal else (j >= 0)
+
+    def _step(mask_causal: bool, mask_pad: bool):
+        _, ds_t, kb, _, _, _ = _bwd_common(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i=i, j=j,
+            seq=seq, block_q=block_q, block_kv=block_kv,
+            mask_causal=mask_causal, mask_pad=mask_pad)
+        dq_acc[...] += jax.lax.dot_general(
+            ds_t, kb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [BQ, D]
+
+    col_end = (j + 1) * block_kv
+    nopad = col_end <= seq
+    if causal:
+        below_diag = col_end - 1 <= i * block_q
+        full = jnp.logical_and(nopad, below_diag)
+        diag_only = jnp.logical_and(nopad, jnp.logical_not(below_diag))
+
+        @pl.when(jnp.logical_and(visible, diag_only))
+        def _step_diag():
+            _step(mask_causal=True, mask_pad=False)
+    else:
+        full = nopad
+
+    @pl.when(jnp.logical_and(visible, full))
+    def _step_unmasked():
+        _step(mask_causal=False, mask_pad=False)
+
+    @pl.when(jnp.logical_and(visible, jnp.logical_not(nopad)))
+    def _step_padded():
+        _step(mask_causal=causal, mask_pad=True)
+
+    last = (jnp.minimum(((i + 1) * block_q - 1) // block_kv, n_kv - 1)
+            if causal else (n_kv - 1))
+
+    @pl.when(j == last)
+    def _emit():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *, seq: int,
+                           n_q: int, causal: bool, block_q: int,
+                           block_kv: int):
+    """dk/dv pass: grid (B, H, j, i), i innermost carrying both
+    accumulators. dv[j] = sum_i p_T[j,i] @ do[i]; dk[j] = sum_i
+    ds_T[j,i] @ q_s[i] (already transposed — plain matmuls).
+    """
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)
+    i = pl.program_id(3)
+
+    # first visible q block for this kv block: rows below j*block_kv see
+    # nothing of it under causal masking
+    i_start = (j * block_kv) // block_q if causal else 0
+
+    @pl.when(i == i_start)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    visible = (i * block_q + block_q - 1 >= j * block_kv) if causal \
+        else (i >= 0)
+
+    def _step(mask_causal: bool, mask_pad: bool):
+        p_t, ds_t, _, _, dob, q = _bwd_common(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i=i, j=j,
+            seq=seq, block_q=block_q, block_kv=block_kv,
+            mask_causal=mask_causal, mask_pad=mask_pad)
+        dv_acc[...] += jax.lax.dot_general(
+            p_t.astype(dob.dtype), dob, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [BK, D]
+        dk_acc[...] += jax.lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [BK, D]
+
+    # Mask dispatch is 2-way here (vs the forward's 3): padded KEY rows
+    # need no mask (their dk/dv rows are sliced off by the caller) and
+    # padded QUERY lanes self-zero (do/delta are zero-padded so ds == 0,
+    # and the +1e30 lse clamp makes p == 0 exactly); only beyond-causal
+    # entries of diagonal blocks would contribute garbage to the q-lane
+    # contraction, so the causal compare is the one mask required.
+    if causal:
+        below_diag = (j + 1) * block_kv - 1 <= i * block_q
+
+        @pl.when(jnp.logical_and(visible, jnp.logical_not(below_diag)))
+        def _step_diag():
+            _step(mask_causal=True, mask_pad=False)
+
+        @pl.when(jnp.logical_and(visible, below_diag))
+        def _step_unmasked():
+            _step(mask_causal=False, mask_pad=False)
+    else:
+        @pl.when(visible)
+        def _step_all():
+            _step(mask_causal=False, mask_pad=False)
+
+    @pl.when(i == n_q - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
+                      block_q: int | None = None,
+                      block_kv: int | None = None):
+    """Pallas backward (MHA only — the GQA path uses the XLA backward):
+    two kernels over the same recomputed scores, with the forward's
+    causal block skip (the XLA backward cannot skip, costing ~2x FLOPs)
+    and bf16 matmuls (the XLA backward runs fp32 at half MXU rate).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    kvlen = k.shape[2]
+    scale = D ** -0.5
+    # identical pre-scale to the forward: gradients through the matmul
+    # are then w.r.t. scaled q, fixed up by one multiply at the end
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    do = do.astype(q.dtype)
+
+    bq = min(block_q or DEFAULT_BWD_BLOCK_Q, -(-S // BLOCK) * BLOCK)
+    bk = min(block_kv or DEFAULT_BWD_BLOCK_KV, -(-kvlen // BLOCK) * BLOCK)
+    pad_q = (-S) % bq
+    pad_k = (-kvlen) % bk
+    qp = jnp.pad(qs, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    dop = jnp.pad(do, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    Sp, KVp = S + pad_q, kvlen + pad_k
+    n_q, n_kv = Sp // bq, KVp // bk
+
+    # residuals in the kernels' [.., 8, Sp] row-vector layout. lse of a
+    # fully-masked (padding) row is -inf; clamp it to +1e30 so those
+    # lanes get p = exp(s - 1e30) = exactly 0 — clamping to 0 (the XLA
+    # path's choice) would leave p = exp(s), and an adversarially large
+    # finite s could overflow p to inf, turning ds = p * 0 into NaN and
+    # poisoning whole dk rows through the contraction
+    lse_c = jnp.where(jnp.isfinite(lse), lse, 1e30)
+    lse_p = jnp.pad(lse_c, ((0, 0), (0, 0), (0, pad_q)),
+                    constant_values=1e30)  # padded q rows: p == 0 too
+    lse_b = jnp.broadcast_to(lse_p[:, :, None, :], (B, H, 8, Sp))
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    delta_p = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q)))
+    delta_b = jnp.broadcast_to(delta_p[:, :, None, :], (B, H, 8, Sp))
+
+    qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    rowspec = pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i))
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"))
+
+    dqs = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, seq=kvlen, n_kv=n_kv,
+                          causal=causal, block_q=bq, block_kv=bk),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        grid=(B, H, n_q, n_kv),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=params,
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_b, delta_b)
+
+    # dkdv grid transposes (i, j) -> (j, i): reuse the specs with the
+    # roles of the last two grid axes swapped
+    kspec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
+    qspec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
+    rowspec_t = pl.BlockSpec((1, 1, 8, bq), lambda b, h, j, i: (b, h, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, seq=kvlen, n_q=n_q,
+                          causal=causal, block_q=bq, block_kv=bk),
+        out_shape=(jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vp.shape, v.dtype)),
+        grid=(B, H, n_kv, n_q),
+        in_specs=[kspec_t, kspec_t, qspec_t, qspec_t, rowspec_t, rowspec_t],
+        out_specs=(kspec_t, kspec_t),
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=params,
+        interpret=interpret,
+    )(kp, vp, qp, dop, lse_b, delta_b)
+
+    dq = (dqs[:, :, :S].astype(jnp.float32) * scale).astype(q.dtype)
+    return dq, dk[:, :, :kvlen], dv[:, :, :kvlen]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, interpret, block_q, block_kv):
     out, _ = _flash_call(q, k, v, causal, interpret, block_q, block_kv)
@@ -271,6 +534,27 @@ def _flash_fwd(q, k, v, causal, interpret, block_q, block_kv):
 
 
 def _flash_bwd(causal, interpret, block_q, block_kv, res, do):
+    """Backward dispatch: the Pallas kernel pair on compiled TPU paths
+    (causal block skip + bf16 MXU), the XLA blockwise scan in interpret
+    mode (Pallas interpret of 4-matmul kernels is far slower than XLA on
+    CPU test meshes) and for GQA (grouped dk/dv accumulation would need a
+    5th grid axis; the XLA path expands K/V instead).
+    """
+    import os
+
+    q, k, v, out, lse = res
+    if (not interpret and k.shape[1] == q.shape[1]
+            and os.environ.get("TPUSHARE_FLASH_BWD", "pallas") != "xla"):
+        # backward tiles are chosen independently of the forward's
+        # (block_q/block_kv args tune the FORWARD; see DEFAULT_BWD_*).
+        # TPUSHARE_FLASH_BWD=xla is the operational escape hatch (and the
+        # A/B lever the bench uses).
+        return _flash_bwd_pallas(q, k, v, out, lse, do, causal,
+                                 interpret=False)
+    return _flash_bwd_xla(causal, res, do)
+
+
+def _flash_bwd_xla(causal, res, do):
     """Blockwise flash backward: scan over K/V blocks, regenerating each
     probability block from the saved LSE — residency stays O(S x BLOCK),
     nothing [S, S] is ever materialized (the point of training with the
