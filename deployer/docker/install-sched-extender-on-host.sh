@@ -29,6 +29,7 @@ clientConnection:
 extenders:
   - urlPrefix: "$EXTENDER_URL"
     filterVerb: filter
+    preemptVerb: preempt
     prioritizeVerb: prioritize
     weight: 10
     bindVerb: bind

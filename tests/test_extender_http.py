@@ -281,3 +281,31 @@ def test_debug_heap(rig):
         assert status == 200 and "live traced heap" in text and "KiB" in text
     finally:
         tracemalloc.stop()  # don't tax the rest of the suite
+
+
+def test_preempt_route_refines_victims(rig):
+    fc, cache, base = rig
+    # fill n2 (2 chips x 8000): v1 4000 + v3 2000 co-packed on one chip,
+    # v2 6000 on the other -> a 4000 pod fits nowhere on n2
+    info = cache.get_node_info("n2")
+    uids = {}
+    for name, hbm, prio in (("v1", 4000, 5), ("v3", 2000, 0),
+                            ("v2", 6000, 10)):
+        pod = make_pod(hbm=hbm, name=name)
+        pod["spec"]["priority"] = prio
+        pod = fc.create_pod(pod)
+        info.allocate(pod, fc)
+        uids[name] = pod["metadata"]["uid"]
+        # deterministic priority resolution: don't race the controller's
+        # async sync for the known-pods registry
+        cache.add_or_update_pod(fc.get_pod("default", name))
+    status, out = post(f"{base}/tpushare-scheduler/preempt", {
+        "Pod": make_pod(hbm=4000, name="high"),
+        "NodeNameToMetaVictims": {
+            "n2": {"Pods": [{"UID": uids["v1"]}, {"UID": uids["v3"]}],
+                   "NumPDBViolations": 0},
+        },
+    })
+    assert status == 200
+    assert out["NodeNameToMetaVictims"]["n2"]["Pods"] == [
+        {"UID": uids["v3"]}]

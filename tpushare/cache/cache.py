@@ -71,6 +71,13 @@ class SchedulerCache:
 
     # -- pod lifecycle --------------------------------------------------------
 
+    def pod_by_key(self, key: str) -> dict[str, Any] | None:
+        """The cached pod object for an accounting key (UID for real
+        pods), or None — the preempt verb resolves MetaPod UIDs this way
+        (nodeCacheCapable extenders receive only identifiers)."""
+        with self._lock:
+            return self._known_pods.get(key)
+
     def known_pod(self, key: str) -> bool:
         """``key`` is the accounting id (podlib.pod_cache_key)."""
         with self._lock:

@@ -177,6 +177,73 @@ class NodeInfo:
             return True, ""
         return False, no_fit_reason(req, self.name)
 
+    def victims_to_fit(self, pod: dict[str, Any],
+                       victim_uids: list[str]) -> list[str] | None:
+        """Preempt-path refinement: the minimal subset of ``victim_uids``
+        (tried in the given order — callers pass lowest-priority first)
+        whose eviction makes ``pod`` fit this node per-chip.
+
+        kube-scheduler's generic preemption picks victims against the
+        SCALAR extended resource, which has the same blind spot as its
+        Filter (SURVEY designs.md:13,34,42): evicting 4 GiB spread as
+        2+2 across chips does not make a 4 GiB single-chip request
+        schedulable. This re-runs the vector fit check against
+        hypothetical chip states, greedily evicting until the pod fits,
+        then restoring any victim whose eviction turned out unnecessary.
+
+        Returns ``[]`` if the pod already fits with no eviction, ``None``
+        if even evicting every candidate victim does not help (the
+        scheduler then drops this node as a preemption candidate). No
+        state is mutated and nothing is written — the actual evictions
+        are the scheduler's to perform.
+        """
+        req = request_from_pod(pod)
+        if req is None:
+            return []
+        with self._lock:
+            # per-victim, per-chip usage of THIS node (a victim absent
+            # from every chip frees nothing and is never selected)
+            usage: dict[str, dict[int, int]] = {}
+            for c in self.chips:
+                for uid in victim_uids:
+                    mib = c.pod_hbm(uid)
+                    if mib > 0:
+                        usage.setdefault(uid, {})[c.idx] = mib
+            base = self.snapshot()
+
+        def fits_without(evicted: list[str]) -> bool:
+            freed: dict[int, int] = {}
+            for uid in evicted:
+                for idx, mib in usage.get(uid, {}).items():
+                    freed[idx] = freed.get(idx, 0) + mib
+            chips = [
+                c.with_used(c.used_hbm_mib - freed[c.idx])
+                if c.idx in freed else c
+                for c in base
+            ]
+            return fits(chips, self.topology, req)
+
+        if fits_without([]):
+            return []
+        chosen: list[str] = []
+        for uid in victim_uids:
+            if uid not in usage:
+                continue  # frees nothing here
+            chosen.append(uid)
+            if fits_without(chosen):
+                break
+        else:
+            return None  # all victims evicted and the pod still can't fit
+        # one prune pass -> a 1-minimal set (dropping any single member
+        # breaks the fit). The last-added victim is what completed the
+        # fit, so only earlier members are candidates; trying them in
+        # reverse preference order keeps the cheapest evictions.
+        for uid in list(reversed(chosen[:-1])):
+            trial = [u for u in chosen if u != uid]
+            if fits_without(trial):
+                chosen = trial
+        return chosen
+
     def allocate(
         self,
         pod: dict[str, Any],

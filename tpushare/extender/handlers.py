@@ -164,6 +164,148 @@ class PrioritizeHandler:
         return out
 
 
+class PreemptHandler:
+    """The extender ``preempt`` verb — victim refinement the reference
+    never implemented (ExtenderConfig.PreemptVerb, reference vendored
+    types.go:183,219-254; the reference registers only filter + bind).
+
+    kube-scheduler's preemption phase picks victims per node against the
+    SCALAR extended resource, which has exactly the blind spot the whole
+    extender exists to fix (designs.md:13,34,42 — node-level free is not
+    chip-level free): its victim set can free plenty of node HBM without
+    making any single chip (or contiguous sub-slice) able to host the
+    preemptor. This verb re-checks each candidate node's victims against
+    the per-chip cache and returns, per node, a 1-minimal victim subset
+    that actually makes the pod placeable — or drops the node from the
+    candidate map entirely when no eviction helps, steering preemption
+    toward nodes where it works.
+
+    Wire shapes (types.go:219-254): ExtenderPreemptionArgs{Pod,
+    NodeNameToMetaVictims} with nodeCacheCapable:true (MetaPod carries
+    only UID; resolved via the cache's known-pods registry), or
+    NodeNameToVictims with full pod objects otherwise. The reply is
+    always the meta form, as the scheduler expects from cache-capable
+    extenders. NumPDBViolations is passed through unchanged: shrinking
+    the victim set can only remove violations, so the scheduler's count
+    is a safe upper bound (per-victim PDB attribution is not on the
+    wire).
+
+    Shrink soundness: kube-scheduler does NOT re-run its filters after
+    an extender edits a victim set — it evicts exactly what the reply
+    names. Its own victim selection satisfied EVERY constraint (CPU,
+    memory, pod count, affinity), so dropping victims is only safe when
+    TPU fit is provably the sole binding constraint: the preemptor
+    requests nothing but the managed TPU resources and carries no
+    affinity terms. Otherwise this handler VALIDATES but never shrinks —
+    the node is kept (full victim set) or dropped, so a CPU-bottlenecked
+    preemptor can never be stranded by a TPU-only refinement.
+    """
+
+    def __init__(self, cache: SchedulerCache, registry: Registry) -> None:
+        self._cache = cache
+        self._preempt_total = registry.counter(
+            "tpushare_preempt_requests_total", "Preempt webhook calls")
+        self._preempt_nodes_dropped = registry.counter(
+            "tpushare_preempt_nodes_dropped_total",
+            "Candidate nodes dropped because no victim set makes the "
+            "preemptor fit per-chip")
+        self._preempt_node_errors = registry.counter(
+            "tpushare_preempt_node_errors_total",
+            "Candidate nodes skipped because the node lookup failed "
+            "(apiserver/cache error — NOT a capacity verdict)")
+        self._preempt_latency = registry.histogram(
+            "tpushare_preempt_seconds", "Preempt latency", LATENCY_BUCKETS)
+
+    def _victim_order(self, victims: dict[str, Any],
+                      meta: bool) -> list[str]:
+        """Victim UIDs, cheapest eviction first.
+
+        When every victim's priority resolves (full pods on the wire, or
+        UIDs found in the known-pods registry), sort lowest priority
+        first, stable within ties. When ANY victim is unresolvable (meta
+        form during controller watch lag), priority-sorting with a
+        guessed default could put a priority-100 pod ahead of a
+        priority-0 one — instead fall back to REVERSING the scheduler's
+        own list, which kube-scheduler builds highest-priority-first, so
+        reversed order is still cheapest-first without inventing
+        priorities.
+        """
+        entries = (victims or {}).get("Pods") or []
+        cand: list[tuple[int, str]] = []
+        unresolved = False
+        for p in entries:
+            if meta:
+                uid = (p or {}).get("UID", "")
+                pobj = self._cache.pod_by_key(uid)
+            else:
+                uid = podlib.pod_cache_key(p or {})
+                pobj = p or {}
+            if not uid:
+                continue
+            if pobj is None:
+                unresolved = True
+                cand.append((0, uid))
+                continue
+            prio = (pobj.get("spec") or {}).get("priority") or 0
+            cand.append((prio, uid))
+        if unresolved:
+            return [uid for _, uid in reversed(cand)]
+        cand.sort(key=lambda t: t[0])
+        return [uid for _, uid in cand]
+
+    @staticmethod
+    def _tpu_only(pod: dict[str, Any]) -> bool:
+        """True when TPU fit is provably the pod's only binding
+        scheduling constraint this extender could affect by shrinking
+        victims: no unmanaged resource requests, no (anti-)affinity."""
+        spec = pod.get("spec") or {}
+        if spec.get("affinity"):
+            return False
+        managed = {contract.RESOURCE_HBM, contract.RESOURCE_COUNT}
+        for c in spec.get("containers") or []:
+            res = c.get("resources") or {}
+            for kind in ("limits", "requests"):
+                for name in res.get(kind) or {}:
+                    if name not in managed:
+                        return False
+        return True
+
+    def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+        t0 = time.perf_counter()
+        self._preempt_total.inc()
+        pod = args.get("Pod") or {}
+        meta_map = args.get("NodeNameToMetaVictims")
+        source = meta_map if meta_map is not None \
+            else (args.get("NodeNameToVictims") or {})
+        shrink = self._tpu_only(pod)
+        result: dict[str, Any] = {}
+        for node_name, victims in source.items():
+            order = self._victim_order(victims, meta_map is not None)
+            try:
+                info = self._cache.get_node_info(node_name)
+            except ApiError as e:
+                log.warning("preempt %s: node %s unavailable: %s",
+                            podlib.pod_key(pod), node_name, e)
+                self._preempt_node_errors.inc()
+                continue
+            subset = info.victims_to_fit(pod, order)
+            if subset is None:
+                # even evicting every candidate leaves no chip/sub-slice
+                # for the preemptor: preempting here would be pure damage
+                self._preempt_nodes_dropped.inc()
+                continue
+            kept = subset if shrink else order
+            result[node_name] = {
+                "Pods": [{"UID": u} for u in kept],
+                "NumPDBViolations":
+                    (victims or {}).get("NumPDBViolations", 0),
+            }
+        self._preempt_latency.observe(time.perf_counter() - t0)
+        log.debug("preempt %s: %d/%d candidate nodes kept (shrink=%s)",
+                  podlib.pod_key(pod), len(result), len(source), shrink)
+        return {"NodeNameToMetaVictims": result}
+
+
 class BindHandler:
     """The delegated bind verb: choose chips, annotate, bind
     (reference Bind.Handler -> gpusharingbinding, gpushare-bind.go:22-43)."""

@@ -23,6 +23,7 @@ from tpushare.extender.handlers import (
     BindHandler,
     FilterHandler,
     InspectHandler,
+    PreemptHandler,
     PrioritizeHandler,
 )
 from tpushare.extender.metrics import Registry
@@ -40,6 +41,7 @@ class ExtenderServer:
         self.registry = registry or Registry()
         self.filter_handler = FilterHandler(cache, self.registry)
         self.prioritize_handler = PrioritizeHandler(cache, self.registry)
+        self.preempt_handler = PreemptHandler(cache, self.registry)
         # HA (an elector is wired): binds also CAS a per-node claim so two
         # replicas in a stale-leader window cannot co-place onto one chip;
         # single-replica mode skips the two extra apiserver round-trips
@@ -99,6 +101,9 @@ class ExtenderServer:
                         self._reply(
                             200,
                             server_self.prioritize_handler.handle(args))
+                    elif self.path == f"{PREFIX}/preempt":
+                        self._reply(
+                            200, server_self.preempt_handler.handle(args))
                     elif self.path == f"{PREFIX}/bind":
                         if server_self._elector is not None and \
                                 not server_self._elector.is_leader():
