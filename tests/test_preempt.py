@@ -281,9 +281,13 @@ def test_watch_lag_never_selects_unresolvable_victims():
         },
     })
     got = out["NodeNameToMetaVictims"]["n1"]["Pods"]
-    # the lagged cache sees no placements -> nothing needs evicting; in
-    # particular the high-priority victim was never picked blind
-    assert got == []
+    # the lagged cache sees no placements -> victims_to_fit says "fits
+    # with no eviction", which contradicts the scheduler's verdict, so
+    # the handler DEFERS: the scheduler's own full victim set comes back
+    # unchanged (its choice, made with full information) rather than a
+    # blind refinement or a zero-victim reply
+    assert {e["UID"] for e in got} == {v_hi["metadata"]["uid"],
+                                       v_lo["metadata"]["uid"]}
 
 
 def test_node_error_metric_distinct_from_dropped():
@@ -299,3 +303,67 @@ def test_node_error_metric_distinct_from_dropped():
     exposed = reg.expose()
     assert "tpushare_preempt_node_errors_total 1" in exposed
     assert "tpushare_preempt_nodes_dropped_total 0" in exposed
+
+
+def test_initcontainer_cpu_blocks_shrink():
+    # unmanaged resources hiding in initContainers (or overhead/hostPort)
+    # must gate the shrink exactly like main-container cpu
+    fc, cache, info = _cluster()
+    v1 = _bind(fc, info, "v1", 4096, priority=5)
+    v3 = _bind(fc, info, "v3", 2048, priority=0)
+    for name in ("v1", "v3"):
+        cache.add_or_update_pod(fc.get_pod("default", name))
+    preemptor = make_pod(hbm=4096, name="high")
+    preemptor["spec"]["initContainers"] = [
+        {"name": "init", "resources": {"requests": {"cpu": "8"}}}]
+    out = _handler(cache).handle({
+        "Pod": preemptor,
+        "NodeNameToMetaVictims": {
+            "n1": {"Pods": [{"UID": v1["metadata"]["uid"]},
+                            {"UID": v3["metadata"]["uid"]}],
+                   "NumPDBViolations": 0},
+        },
+    })
+    assert len(out["NodeNameToMetaVictims"]["n1"]["Pods"]) == 2
+
+
+def test_hostport_blocks_shrink():
+    fc, cache, info = _cluster()
+    v3 = _bind(fc, info, "v3", 2048, priority=0)
+    v1 = _bind(fc, info, "v1", 4096, priority=5)
+    for name in ("v1", "v3"):
+        cache.add_or_update_pod(fc.get_pod("default", name))
+    preemptor = make_pod(hbm=4096, name="high")
+    preemptor["spec"]["containers"][0]["ports"] = [
+        {"containerPort": 8080, "hostPort": 8080}]
+    out = _handler(cache).handle({
+        "Pod": preemptor,
+        "NodeNameToMetaVictims": {
+            "n1": {"Pods": [{"UID": v1["metadata"]["uid"]},
+                            {"UID": v3["metadata"]["uid"]}],
+                   "NumPDBViolations": 0},
+        },
+    })
+    assert len(out["NodeNameToMetaVictims"]["n1"]["Pods"]) == 2
+
+
+def test_zero_victim_result_falls_back_to_scheduler_set():
+    # the scheduler preempted, so SOMETHING blocked scheduling; if the
+    # TPU dimension says "fits with no eviction", the blocker is a
+    # constraint this extender cannot see (max-pods, stale cache). A
+    # zero-victim reply would nominate the node and evict nobody,
+    # looping the preemptor Pending forever — the scheduler's own victim
+    # choice must be kept instead
+    fc, cache, info = _cluster()
+    v1 = _bind(fc, info, "v1", 2048, priority=0)
+    cache.add_or_update_pod(fc.get_pod("default", "v1"))
+    preemptor = make_pod(hbm=4096, name="high")  # fits per-chip already
+    out = _handler(cache).handle({
+        "Pod": preemptor,
+        "NodeNameToMetaVictims": {
+            "n1": {"Pods": [{"UID": v1["metadata"]["uid"]}],
+                   "NumPDBViolations": 0},
+        },
+    })
+    assert out["NodeNameToMetaVictims"]["n1"]["Pods"] == [
+        {"UID": v1["metadata"]["uid"]}]

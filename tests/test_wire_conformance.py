@@ -268,3 +268,82 @@ def test_httptimeout_mid_bind_completes_once_and_retry_is_idempotent(rig):
     # exactly one grant accounted in the cache
     tree = cache.describe()
     assert tree["used_hbm_mib"] == 8000
+
+
+# ExtenderPreemptionArgs from a nodeCacheCapable=true scheduler
+# (types.go:225-232): NodeNameToVictims is a nil map -> literal null (no
+# omitempty); NodeNameToMetaVictims carries MetaPod{UID} identifiers only
+# (types.go:242-254). Field names are exact: the structs carry no json
+# tags.
+PREEMPT_ARGS_TEMPLATE = (
+    '{"Pod":%s,"NodeNameToVictims":null,'
+    '"NodeNameToMetaVictims":{"n2":{"Pods":[{"UID":"%s"},{"UID":"%s"}],'
+    '"NumPDBViolations":0}}}')
+
+
+def test_preempt_metavictims_fixture(rig):
+    fc, cache, base = rig
+    info = cache.get_node_info("n2")
+    uids = []
+    for name, hbm, prio in (("vict-a", 4000, 5), ("vict-b", 2000, 0)):
+        pod = {
+            "metadata": {"name": name, "namespace": "default",
+                         "uid": f"c3a3e1f2-100{len(uids)}-4a5b-9c8d-"
+                                "aabbccddeeff",
+                         "annotations": {}},
+            "spec": {"priority": prio, "containers": [
+                {"name": "main", "resources": {
+                    "limits": {"aliyun.com/tpu-hbm": str(hbm)}}}]},
+            "status": {"phase": "Pending"},
+        }
+        pod = fc.create_pod(pod)
+        info.allocate(pod, fc)
+        cache.add_or_update_pod(fc.get_pod("default", name))
+        uids.append(pod["metadata"]["uid"])
+    # fill the second chip so the preemptor fits nowhere on n2
+    filler = {
+        "metadata": {"name": "filler", "namespace": "default",
+                     "uid": "c3a3e1f2-2000-4a5b-9c8d-aabbccddeeff",
+                     "annotations": {}},
+        "spec": {"priority": 100, "containers": [
+            {"name": "main", "resources": {
+                "limits": {"aliyun.com/tpu-hbm": "6000"}}}]},
+        "status": {"phase": "Pending"},
+    }
+    filler = fc.create_pod(filler)
+    info.allocate(filler, fc)
+    cache.add_or_update_pod(fc.get_pod("default", "filler"))
+
+    # preemptor: TPU-only requests -> the shrink path is licensed
+    preemptor = GO_POD.replace("wire-pod", "preemptor-pod").replace(
+        '"8000"', '"4000"')
+    body = PREEMPT_ARGS_TEMPLATE % (preemptor, uids[0], uids[1])
+    status, out = post_raw(f"{base}/preempt", body)
+    assert status == 200
+    # Go-side decode: the reply must carry the EXACT canonical field
+    # names; MetaVictims.Pods entries are {"UID": ...} objects
+    assert set(out) >= {"NodeNameToMetaVictims"}
+    node_map = out["NodeNameToMetaVictims"]
+    assert "n2" in node_map
+    got = node_map["n2"]
+    assert set(got) == {"Pods", "NumPDBViolations"}
+    assert isinstance(got["NumPDBViolations"], int)
+    for entry in got["Pods"]:
+        assert set(entry) == {"UID"}
+    # and the refinement itself: evicting vict-b (2000, prio 0) frees
+    # 4000 on its chip — the 1-minimal cheapest subset
+    assert [e["UID"] for e in got["Pods"]] == [uids[1]]
+
+
+def test_preempt_hopeless_node_omitted_from_reply(rig):
+    fc, cache, base = rig
+    preemptor = GO_POD.replace("wire-pod", "preemptor-pod")
+    # victims the cluster has never seen free nothing; n2 (2x8000) cannot
+    # host an 8000 pod... it can when empty — use a 9000 request instead
+    preemptor = preemptor.replace('"8000"', '"9000"')
+    body = ('{"Pod":' + preemptor + ',"NodeNameToVictims":null,'
+            '"NodeNameToMetaVictims":{"n2":{"Pods":[{"UID":"ghost"}],'
+            '"NumPDBViolations":0}}}')
+    status, out = post_raw(f"{base}/preempt", body)
+    assert status == 200
+    assert out["NodeNameToMetaVictims"] == {}

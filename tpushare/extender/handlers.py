@@ -256,18 +256,27 @@ class PreemptHandler:
     @staticmethod
     def _tpu_only(pod: dict[str, Any]) -> bool:
         """True when TPU fit is provably the pod's only binding
-        scheduling constraint this extender could affect by shrinking
-        victims: no unmanaged resource requests, no (anti-)affinity."""
+        scheduling constraint that evicting a victim could relieve: no
+        unmanaged resource requests (main AND init containers, pod
+        overhead), no host ports (freed only by evicting the holder), no
+        (anti-)affinity, no topology spread constraints."""
         spec = pod.get("spec") or {}
-        if spec.get("affinity"):
+        if spec.get("affinity") or spec.get("topologySpreadConstraints"):
             return False
         managed = {contract.RESOURCE_HBM, contract.RESOURCE_COUNT}
-        for c in spec.get("containers") or []:
+        for name in spec.get("overhead") or {}:
+            if name not in managed:
+                return False
+        for c in (spec.get("containers") or []) + \
+                (spec.get("initContainers") or []):
             res = c.get("resources") or {}
             for kind in ("limits", "requests"):
                 for name in res.get(kind) or {}:
                     if name not in managed:
                         return False
+            for port in c.get("ports") or []:
+                if port.get("hostPort"):
+                    return False
         return True
 
     def handle(self, args: dict[str, Any]) -> dict[str, Any]:
@@ -294,7 +303,15 @@ class PreemptHandler:
                 # for the preemptor: preempting here would be pure damage
                 self._preempt_nodes_dropped.inc()
                 continue
-            kept = subset if shrink else order
+            # [] means TPU fit holds even with every victim still
+            # present — the scheduler preempted for a constraint this
+            # extender cannot see (max-pods, stale cache, ...). A
+            # zero-victim reply would make the scheduler nominate the
+            # node and evict NOBODY, looping the pod Pending forever;
+            # fall back to the scheduler's own (whole-constraint) victim
+            # choice. Eviction is monotone for TPU fit, so the full set
+            # still satisfies this extender's dimension.
+            kept = subset if shrink and subset else order
             result[node_name] = {
                 "Pods": [{"UID": u} for u in kept],
                 "NumPDBViolations":
