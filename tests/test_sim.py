@@ -4,7 +4,7 @@ import json
 
 from tpushare.core.topology import MeshTopology
 from tpushare.sim import Fleet, TraceSpec, run_sim, synth_trace
-from tpushare.sim.simulator import _is_contiguous_box
+from tpushare.sim.simulator import SimPod, _is_contiguous_box
 
 
 def _fleet():
@@ -93,3 +93,48 @@ def test_cli_prints_one_json_per_policy(capsys):
     for line in lines:
         rep = json.loads(line)
         assert rep["placed"] + rep["never_placed"] == 50
+
+
+def test_preemption_refined_beats_scalar_victim_selection():
+    """The preempt verb's quantitative story: node-level (scalar) victim
+    arithmetic evicts pods that don't make the preemptor placeable —
+    per-chip refinement never does, and serves high-priority arrivals
+    faster for it."""
+    trace = synth_trace(TraceSpec(n_pods=300, arrival_rate=4.0,
+                                  high_priority_fraction=0.2, seed=0))
+
+    def run(mode):
+        return run_sim(Fleet.homogeneous(4, 4, 16384, (2, 2)), trace,
+                       "binpack", preempt=mode)
+
+    off, scalar, refined = run("off"), run("scalar"), run("refined")
+    assert off.evictions == 0
+    # scalar's blind spot is real on this trace: a majority-free node in
+    # aggregate that still can't host the request per-chip
+    assert scalar.wasted_evictions > 0
+    # the verb's guarantee: an eviction happens only when a concrete
+    # placement was proven, so none are ever wasted
+    assert refined.wasted_evictions == 0
+    # and priority traffic is served strictly better than waiting
+    assert refined.hp_mean_wait < off.hp_mean_wait
+    assert refined.hp_mean_wait <= scalar.hp_mean_wait
+    # no oversubscription ever (try_place asserts), and the fleet drains
+    for r in (off, scalar, refined):
+        assert r.never_placed == 0
+
+
+def test_preemption_evicted_pods_restart_and_finish():
+    """Evicted victims return to the pending queue and complete later —
+    nothing is lost, nothing double-frees."""
+    fleet = Fleet.homogeneous(1, 2, 8192)
+    trace = [
+        SimPod(arrival=0.0, duration=100.0, hbm_mib=6144, priority=0),
+        SimPod(arrival=1.0, duration=100.0, hbm_mib=6144, priority=0),
+        SimPod(arrival=2.0, duration=10.0, hbm_mib=6144, priority=100),
+    ]
+    r = run_sim(fleet, trace, "binpack", preempt="refined")
+    assert r.evictions == 1
+    assert r.wasted_evictions == 0
+    assert r.placed == 4          # 3 pods + 1 re-placement of the victim
+    assert r.never_placed == 0
+    assert fleet.used_hbm == 0    # everything drained cleanly

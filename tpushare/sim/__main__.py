@@ -31,6 +31,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--policy", default="all",
                     choices=["all", *POLICIES])
+    ap.add_argument("--preempt", default="off",
+                    choices=["off", "scalar", "refined"],
+                    help="priority preemption for unplaceable arrivals: "
+                         "scalar = node-level victim arithmetic (the "
+                         "no-extender failure mode), refined = per-chip "
+                         "victim refinement (the preempt verb)")
+    ap.add_argument("--high-priority-fraction", type=float, default=0.0)
     args = ap.parse_args(argv)
 
     mesh = tuple(int(d) for d in args.mesh.split("x")) if args.mesh else None
@@ -46,12 +53,13 @@ def main(argv: list[str] | None = None) -> int:
     spec = TraceSpec(n_pods=args.pods, arrival_rate=args.arrival_rate,
                      mean_duration=args.mean_duration,
                      multi_chip_fraction=args.multi_chip_fraction,
+                     high_priority_fraction=args.high_priority_fraction,
                      seed=args.seed)
     trace = synth_trace(spec)
     policies = list(POLICIES) if args.policy == "all" else [args.policy]
     for policy in policies:
         fleet = Fleet.homogeneous(args.nodes, args.chips, args.hbm, mesh)
-        report = run_sim(fleet, trace, policy)
+        report = run_sim(fleet, trace, policy, preempt=args.preempt)
         print(json.dumps(report.to_json()))
     return 0
 

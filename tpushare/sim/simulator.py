@@ -42,6 +42,7 @@ class SimPod:
     hbm_mib: int
     chip_count: int = 1
     topology: tuple[int, ...] | None = None
+    priority: int = 0
 
     @property
     def request(self) -> PlacementRequest:
@@ -59,6 +60,7 @@ class TraceSpec:
     mean_duration: float = 40.0
     sizes: tuple[int, ...] = (1024, 2048, 4096, 8192)
     multi_chip_fraction: float = 0.15  # of pods; count drawn from {2, 4}
+    high_priority_fraction: float = 0.0  # of pods; priority 100 vs 0
     seed: int = 0
 
 
@@ -70,12 +72,14 @@ def synth_trace(spec: TraceSpec) -> list[SimPod]:
         t += rng.expovariate(spec.arrival_rate)
         duration = rng.expovariate(1.0 / spec.mean_duration)
         size = rng.choice(spec.sizes)
+        prio = 100 if rng.random() < spec.high_priority_fraction else 0
         if rng.random() < spec.multi_chip_fraction:
             count = rng.choice((2, 4))
             topo = (2, 2) if count == 4 and rng.random() < 0.5 else None
-            pods.append(SimPod(t, duration, size, count, topo))
+            pods.append(SimPod(t, duration, size, count, topo,
+                               priority=prio))
         else:
-            pods.append(SimPod(t, duration, size))
+            pods.append(SimPod(t, duration, size, priority=prio))
     return pods
 
 
@@ -218,6 +222,20 @@ class SimReport:
     # exists to prevent, and the reason scatter policies' utilization
     # numbers are not comparable at face value
     contig_violations: int = 0
+    # preemption (when enabled): total evictions; evictions that did NOT
+    # make the preemptor placeable (the scalar policy's failure mode);
+    # high-priority wait stats
+    preempt_mode: str = "off"
+    evictions: int = 0
+    wasted_evictions: int = 0
+    # scalar mode: preemption "succeeded" with zero victims (aggregate
+    # free looked sufficient) but the pod still couldn't place — the
+    # real-cluster livelock of a scheduler whose preemption dry-run
+    # skips extenders without a PreemptVerb: it nominates the node,
+    # evicts nobody, and nothing ever changes
+    noop_preemptions: int = 0
+    hp_mean_wait: float = 0.0
+    hp_p99_wait: float = 0.0
     waits: list[float] = field(default_factory=list, repr=False)
 
     def to_json(self) -> dict:
@@ -226,8 +244,29 @@ class SimReport:
 
 
 def run_sim(fleet: Fleet, trace: list[SimPod],
-            policy: str = "binpack") -> SimReport:
-    """Run one policy over one trace. Deterministic for a given input."""
+            policy: str = "binpack", preempt: str = "off") -> SimReport:
+    """Run one policy over one trace. Deterministic for a given input.
+
+    ``preempt`` models priority preemption for arrivals that fit nowhere:
+
+    - ``"off"``     — they wait in the pending queue (reference behavior:
+                      the verb is unregistered).
+    - ``"scalar"``  — kube-scheduler-without-extender semantics: victims
+                      are chosen by NODE-level arithmetic (evict
+                      lowest-priority pods until aggregate free >= the
+                      request); the eviction happens even when no single
+                      chip/sub-slice becomes free enough — those are
+                      counted in ``wasted_evictions``.
+    - ``"refined"`` — the preempt verb's semantics: per-chip greedy +
+                      prune victim refinement (NodeInfo.victims_to_fit);
+                      eviction only on a node where a 1-minimal subset
+                      provably frees a placement.
+
+    Evicted pods restart: they return to the pending queue with their
+    full duration (waits keep their original arrival, so eviction cost
+    shows up in the victims' wait tail).
+    """
+    assert preempt in ("off", "scalar", "refined"), preempt
     place = POLICIES[policy]
     # event heap: (time, kind, seq, payload); kind 0=departure, 1=arrival
     # (departures first at equal times: free capacity before retrying)
@@ -236,8 +275,16 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
         heapq.heappush(heap, (pod.arrival, 1, seq, pod))
     pending: list[SimPod] = []
     waits: list[float] = []
+    hp_waits: list[float] = []
     placed = 0
     violations = 0
+    evictions = 0
+    wasted_evictions = 0
+    noop_preemptions = 0
+    # seq2 id -> (pod, node_index, chip_ids, demand); departures whose id
+    # is in `cancelled` were evicted and are skipped lazily
+    active: dict[int, tuple] = {}
+    cancelled: set[int] = set()
     now = 0.0
     util_integral = 0.0
     frag_integral = 0.0
@@ -272,21 +319,130 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
             assert node.used[cid] <= node.hbm, "sim oversubscription"
         heapq.heappush(heap, (now + pod.duration, 0, seq2,
                               (ni, chip_ids, demand)))
+        active[seq2] = (pod, ni, chip_ids, demand)
         seq2 += 1
         placed += 1
         waits.append(now - pod.arrival)
+        if pod.priority > 0:
+            hp_waits.append(now - pod.arrival)
         return True
 
+    def _evict(vid: int) -> SimPod:
+        nonlocal evictions
+        pod, ni, chip_ids, demand = active.pop(vid)
+        node = fleet.nodes[ni]
+        for cid in chip_ids:
+            node.used[cid] -= demand
+        cancelled.add(vid)
+        evictions += 1
+        return pod
+
+    def try_preempt(pod: SimPod) -> bool:
+        """Arrival that fits nowhere: evict lower-priority pods.
+        Returns True when the pod got placed."""
+        nonlocal wasted_evictions, noop_preemptions
+        req = pod.request
+        best = None  # (n_victims, freed_hbm, node_index, victim_ids)
+        for ni, node in enumerate(fleet.nodes):
+            # cheapest eviction first: (priority, total HBM, id)
+            vics = sorted(
+                ((vid, e) for vid, e in active.items()
+                 if e[1] == ni and e[0].priority < pod.priority),
+                key=lambda t: (t[1][0].priority,
+                               t[1][3] * len(t[1][2]), t[0]))
+            if preempt == "scalar":
+                # node-level arithmetic: free aggregate >= total request.
+                # chosen may come out EMPTY (aggregate already "fits"):
+                # kube-scheduler's preemption dry-run skips extenders
+                # without a PreemptVerb, so such a node is a legitimate
+                # zero-victim candidate that the scheduler PREFERS
+                # (fewest victims) — modeling it is the point
+                total_req = req.chip_demand_mib(node.hbm) * max(
+                    req.chip_count, 1)
+                free = node.hbm * len(node.used) - sum(node.used)
+                chosen = []
+                for vid, e in vics:
+                    if free >= total_req:
+                        break
+                    chosen.append(vid)
+                    free += e[3] * len(e[2])
+                if free < total_req:
+                    continue
+            else:
+                if not vics:
+                    continue
+                # refined: per-chip greedy + prune over hypothetical
+                # usage (the verb's victims_to_fit)
+                def fits_without(evicted_ids):
+                    freed = {}
+                    for vid in evicted_ids:
+                        e = active[vid]
+                        for cid in e[2]:
+                            freed[cid] = freed.get(cid, 0) + e[3]
+                    views = [ChipView(i, node.topo.coords(i), node.hbm,
+                                      u - freed.get(i, 0))
+                             for i, u in enumerate(node.used)]
+                    return select_chips_py(views, node.topo, req) is not None
+                chosen = []
+                for vid, _ in vics:
+                    chosen.append(vid)
+                    if fits_without(chosen):
+                        break
+                else:
+                    continue
+                for vid in list(reversed(chosen[:-1])):
+                    trial = [u for u in chosen if u != vid]
+                    if fits_without(trial):
+                        chosen = trial
+            freed_hbm = sum(active[v][3] * len(active[v][2])
+                            for v in chosen)
+            key = (len(chosen), freed_hbm)
+            if best is None or key < best[:2]:
+                best = (*key, ni, chosen)
+        if best is None:
+            return False
+        _, _, ni, victim_ids = best
+        for vid in victim_ids:
+            victim = _evict(vid)
+            pending.append(victim)  # restarts: full duration again
+        if try_place(pod):
+            return True
+        # scalar mode reaches here when node-level arithmetic said the
+        # node would fit but no chip/sub-slice actually works: either
+        # pods were killed for nothing, or (zero victims) the scheduler
+        # nominated a node and changed nothing — the two faces of the
+        # blind spot the preempt verb fixes
+        if victim_ids:
+            wasted_evictions += len(victim_ids)
+        else:
+            noop_preemptions += 1
+        return False
+
     while heap:
-        t, kind, _, payload = heapq.heappop(heap)
+        t, kind, seq_id, payload = heapq.heappop(heap)
         advance(t)
         now = t
         if busy_start is None:
             busy_start = t
         if kind == 1:  # arrival
             if not try_place(payload):
-                pending.append(payload)
+                if preempt == "off" or payload.priority <= 0 \
+                        or not try_preempt(payload):
+                    pending.append(payload)
+                elif pending:
+                    # a successful preemption changed capacity (victims
+                    # out, preemptor in, possibly slack left); without a
+                    # retry here, evicted victims whose cancelled
+                    # departures are the only remaining events would
+                    # starve forever
+                    pending = [q for q in pending if not try_place(q)]
         else:          # departure frees chips, retry pending FIFO
+            if seq_id in cancelled:
+                # this placement was evicted earlier; its chips were
+                # already freed at eviction time
+                cancelled.discard(seq_id)
+                continue
+            active.pop(seq_id, None)
             ni, chip_ids, demand = payload
             node = fleet.nodes[ni]
             for cid in chip_ids:
@@ -312,5 +468,12 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
         frag_time_weighted=frag_integral / span,
         makespan=span,
         contig_violations=violations,
+        preempt_mode=preempt,
+        evictions=evictions,
+        wasted_evictions=wasted_evictions,
+        noop_preemptions=noop_preemptions,
+        hp_mean_wait=sum(hp_waits) / len(hp_waits) if hp_waits else 0.0,
+        hp_p99_wait=sorted(hp_waits)[int(0.99 * (len(hp_waits) - 1))]
+        if hp_waits else 0.0,
         waits=waits,
     )
