@@ -138,3 +138,21 @@ def test_preemption_evicted_pods_restart_and_finish():
     assert r.placed == 4          # 3 pods + 1 re-placement of the victim
     assert r.never_placed == 0
     assert fleet.used_hbm == 0    # everything drained cleanly
+
+
+def test_wasted_eviction_victims_do_not_starve():
+    """A failed (wasted) preemption must still retry the pending queue:
+    the victims' cancelled departures are the only remaining heap events,
+    so without the retry they would starve forever on a free fleet."""
+    fleet = Fleet.homogeneous(1, 2, 4096)
+    trace = [
+        SimPod(arrival=0.0, duration=50.0, hbm_mib=3500, priority=0),
+        SimPod(arrival=1.0, duration=50.0, hbm_mib=3500, priority=0),
+        # aggregate arithmetic accepts (2x4096 total) but no chip can
+        # ever host 5000 MiB -> scalar evicts both victims for nothing
+        SimPod(arrival=2.0, duration=10.0, hbm_mib=5000, priority=100),
+    ]
+    r = run_sim(fleet, trace, "binpack", preempt="scalar")
+    assert r.wasted_evictions == 2
+    assert r.never_placed == 1          # only the impossible pod
+    assert fleet.used_hbm == 0          # victims re-placed AND finished

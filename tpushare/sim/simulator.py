@@ -426,15 +426,17 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
             busy_start = t
         if kind == 1:  # arrival
             if not try_place(payload):
-                if preempt == "off" or payload.priority <= 0 \
-                        or not try_preempt(payload):
+                attempted = preempt != "off" and payload.priority > 0
+                if not (attempted and try_preempt(payload)):
                     pending.append(payload)
-                elif pending:
-                    # a successful preemption changed capacity (victims
-                    # out, preemptor in, possibly slack left); without a
-                    # retry here, evicted victims whose cancelled
-                    # departures are the only remaining events would
-                    # starve forever
+                if attempted and pending:
+                    # ANY preemption attempt may have moved capacity —
+                    # victims evicted (even when the preemptor still
+                    # failed to place: the wasted-eviction case), slack
+                    # left next to a placed preemptor. Without a retry
+                    # here, evicted pods whose cancelled departures are
+                    # the only remaining heap events starve forever on a
+                    # free fleet
                     pending = [q for q in pending if not try_place(q)]
         else:          # departure frees chips, retry pending FIFO
             if seq_id in cancelled:
