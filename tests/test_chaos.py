@@ -388,3 +388,45 @@ def test_ha_claims_storm_under_node_patch_chaos():
         pod = fc.create_pod(make_pod(hbm=2048, name="cc-after"))
         after_ttl = time.time_ns() + NodeInfo.CLAIM_TTL_NS + 1_000_000_000
         info.allocate(pod, chaos, now_ns=lambda: after_ttl, ha_claims=True)
+
+
+# -- preempt verb under apiserver faults --------------------------------------
+
+def test_preempt_node_lookup_fault_counts_error_not_dropped():
+    """An apiserver fault during the preempt verb's node lookup must be
+    reported as a node ERROR (apiserver blip), never as a hopeless-node
+    drop (capacity verdict) — operators alert on the latter."""
+    from tpushare.extender.handlers import PreemptHandler
+    from tpushare.extender.metrics import Registry
+
+    fc, chaos = chaos_with_node(chips=2, hbm=8192, name="c1")
+    cache = SchedulerCache(chaos)
+    cache.build_cache()
+    info = cache.get_node_info("c1")
+    victim = fc.create_pod(make_pod(hbm=6144, name="v1"))
+    info.allocate(victim, chaos)
+    cache.add_or_update_pod(fc.get_pod("default", "v1"))
+
+    # un-warmed second node so the handler's get_node_info must hit the
+    # (faulted) apiserver
+    fc.add_tpu_node("c2", chips=2, hbm_per_chip_mib=8192)
+    chaos.fail("get_node", status=503, times=None, probability=1.0)
+
+    reg = Registry()
+    h = PreemptHandler(cache, reg)
+    out = h.handle({
+        "Pod": make_pod(hbm=4096, name="high"),
+        "NodeNameToMetaVictims": {
+            "c1": {"Pods": [{"UID": victim["metadata"]["uid"]}],
+                   "NumPDBViolations": 0},
+            "c2": {"Pods": [{"UID": victim["metadata"]["uid"]}],
+                   "NumPDBViolations": 0},
+        },
+    })
+    # c1 was already cached -> still refined despite the fault; c2's
+    # lookup failed -> skipped as an error, not a drop
+    assert "c1" in out["NodeNameToMetaVictims"]
+    assert "c2" not in out["NodeNameToMetaVictims"]
+    exposed = reg.expose()
+    assert "tpushare_preempt_node_errors_total 1" in exposed
+    assert "tpushare_preempt_nodes_dropped_total 0" in exposed
