@@ -156,13 +156,58 @@ def test_backward_parity_gqa_compiled():
                                    err_msg=f"d{name} mismatch")
 
 
-def test_backward_parity_full_tiles_bf16():
-    # S=1280 > DEFAULT_BWD_BLOCK (512): the Pallas backward runs its real
-    # multi-tile grids (diagonal blocks in both grid orders, i_start and
-    # last-j arithmetic live) rather than a single shrunken block — the
-    # configuration training at scale actually compiles
+def test_pallas_backward_compiled_full_tiles_bf16():
+    # The PALLAS backward pair, driven directly (the dispatch default
+    # stays on the XLA scan until this very test has passed on hardware).
+    # S=1280 > DEFAULT_BWD_BLOCK (512): real multi-tile grids (diagonal
+    # blocks in both grid orders, i_start and last-j arithmetic live)
+    # rather than a single shrunken block — the configuration training at
+    # scale would actually compile.
+    from tpushare.workloads.attention import _flash_bwd_pallas, _flash_call
+
     q, k, v = rand_qkv(jax.random.key(30), 1, 2, 1280, 128, jnp.bfloat16)
-    w = jax.random.normal(jax.random.key(31), q.shape, jnp.bfloat16)
+    do = jax.random.normal(jax.random.key(31), q.shape, jnp.bfloat16)
+    out, lse = _flash_call(q, k, v, True, False, None, None)
+    got = _flash_bwd_pallas(q, k, v, out, lse, do, True, interpret=False)
+    _, ref_vjp = jax.vjp(
+        lambda q, k, v: attention_reference(q, k, v, True), q, k, v)
+    ref = ref_vjp(do)
+    for a, b, name in zip(got, ref, "qkv"):
+        assert_close(a, b, atol=1e-1, rtol=5e-2)
+
+
+def _pallas_bwd_direct(S, dtype, atol, rtol=3e-2):
+    from tpushare.workloads.attention import _flash_bwd_pallas, _flash_call
+
+    q, k, v = rand_qkv(jax.random.key(32), 2, 2, S, 64, dtype)
+    do = jax.random.normal(jax.random.key(33), q.shape, dtype)
+    out, lse = _flash_call(q, k, v, True, False, None, None)
+    got = _flash_bwd_pallas(q, k, v, out, lse, do, True, interpret=False)
+    _, ref_vjp = jax.vjp(
+        lambda q, k, v: attention_reference(q, k, v, True), q, k, v)
+    for a, b, name in zip(got, ref_vjp(do), "qkv"):
+        assert_close(a, b, atol=atol, rtol=rtol)
+
+
+def test_pallas_backward_compiled_fp32():
+    # fp32 lowering of the Pallas pair (part of the rollout gate for
+    # flipping TPUSHARE_FLASH_BWD's default)
+    _pallas_bwd_direct(S=384, dtype=jnp.float32, atol=3e-2)
+
+
+def test_pallas_backward_compiled_ragged():
+    # ragged S=300 -> padded query lanes: the +1e30 lse-clamp case
+    # (perf.md calls this the delicate path — padded lanes must
+    # contribute exactly 0 to dk/dv through the q-lane contraction)
+    _pallas_bwd_direct(S=300, dtype=jnp.bfloat16, atol=1e-1, rtol=5e-2)
+
+
+def test_pallas_backward_through_dispatch(monkeypatch):
+    # the full custom_vjp + _flash_bwd dispatch route with the opt-in
+    # env set — what production training runs after the default flips
+    monkeypatch.setenv("TPUSHARE_FLASH_BWD", "pallas")
+    q, k, v = rand_qkv(jax.random.key(34), 1, 2, 640, 128, jnp.bfloat16)
+    w = jax.random.normal(jax.random.key(35), q.shape, jnp.bfloat16)
 
     def loss_flash(q, k, v):
         return jnp.sum((flash_attention(q, k, v, causal=True)

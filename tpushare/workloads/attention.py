@@ -534,21 +534,24 @@ def _flash_fwd(q, k, v, causal, interpret, block_q, block_kv):
 
 
 def _flash_bwd(causal, interpret, block_q, block_kv, res, do):
-    """Backward dispatch: the Pallas kernel pair on compiled TPU paths
-    (causal block skip + bf16 MXU), the XLA blockwise scan in interpret
-    mode (Pallas interpret of 4-matmul kernels is far slower than XLA on
-    CPU test meshes) and for GQA (grouped dk/dv accumulation would need a
-    5th grid axis; the XLA path expands K/V instead).
+    """Backward dispatch. TPUSHARE_FLASH_BWD=pallas selects the Pallas
+    kernel pair on compiled TPU MHA paths (causal block skip + bf16 MXU;
+    its algorithm is parity-proven in interpret mode and the bench A/Bs
+    it directly); the default remains the XLA blockwise scan until the
+    Pallas pair's MOSAIC COMPILATION is validated on real hardware —
+    dispatching an uncompiled-anywhere kernel by default would put every
+    training run behind an unverified compile. Interpret mode and GQA
+    always use the XLA path (Pallas interpret of 4-matmul kernels is far
+    slower than XLA on CPU test meshes; grouped dk/dv accumulation would
+    need a 5th grid axis).
     """
     import os
 
     q, k, v, out, lse = res
     if (not interpret and k.shape[1] == q.shape[1]
-            and os.environ.get("TPUSHARE_FLASH_BWD", "pallas") != "xla"):
+            and os.environ.get("TPUSHARE_FLASH_BWD", "xla") == "pallas"):
         # backward tiles are chosen independently of the forward's
-        # (block_q/block_kv args tune the FORWARD; see DEFAULT_BWD_*).
-        # TPUSHARE_FLASH_BWD=xla is the operational escape hatch (and the
-        # A/B lever the bench uses).
+        # (block_q/block_kv args tune the FORWARD; see DEFAULT_BWD_*)
         return _flash_bwd_pallas(q, k, v, out, lse, do, causal,
                                  interpret=False)
     return _flash_bwd_xla(causal, res, do)
