@@ -213,3 +213,59 @@ def test_flash_rejects_nondividing_kv_heads():
     q, k, v = rand_qkv(jax.random.key(10), H=6)
     with pytest.raises(ValueError, match="kv heads dividing"):
         flash_attention(q, k[:, :4], v[:, :4], interpret=True)
+
+
+def test_window_attention_matches_reference():
+    # sliding window: multi-block S with a window smaller than, equal to,
+    # and non-aligned with the block size
+    for S, W in ((384, 128), (384, 100), (256, 1), (512, 512)):
+        q, k, v = rand_qkv(jax.random.key(40), S=S, dtype=jnp.float32)
+        out = flash_attention(q, k, v, causal=True, window=W,
+                              interpret=True)
+        ref = attention_reference(q, k, v, causal=True, window=W)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5,
+                                   err_msg=f"S={S} W={W}")
+
+
+def test_window_floor_skip_and_relocated_init():
+    # geometry chosen so j_start > 0: bq=256, bk=128, S=640, W=300 ->
+    # q block i=2 (rows 512..639) has floor 512-299=213 -> j_start=1.
+    # An off-by-one in j_start (skipping a visible block, or stale
+    # m/l/acc because _init never fired) fails parity here
+    q, k, v = rand_qkv(jax.random.key(44), S=640, dtype=jnp.float32)
+    out = flash_attention(q, k, v, causal=True, window=300,
+                          interpret=True, block_q=256, block_kv=128)
+    ref = attention_reference(q, k, v, causal=True, window=300)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_window_attention_ragged_and_unequal_tiles():
+    q, k, v = rand_qkv(jax.random.key(41), S=300, dtype=jnp.float32)
+    out = flash_attention(q, k, v, causal=True, window=77, interpret=True,
+                          block_q=128, block_kv=256)
+    ref = attention_reference(q, k, v, causal=True, window=77)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_window_attention_grads():
+    q, k, v = rand_qkv(jax.random.key(42), S=300, dtype=jnp.float32)
+    f = lambda q, k, v: jnp.sum(jnp.sin(flash_attention(
+        q, k, v, causal=True, window=77, interpret=True)))
+    g = lambda q, k, v: jnp.sum(jnp.sin(attention_reference(
+        q, k, v, causal=True, window=77)))
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_window_requires_causal_and_positive():
+    q, k, v = rand_qkv(jax.random.key(43))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=64, interpret=True)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, causal=True, window=0, interpret=True)

@@ -221,3 +221,14 @@ def test_pallas_backward_through_dispatch(monkeypatch):
     gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
     for a, b, name in zip(gf, gr, "qkv"):
         assert_close(a, b, atol=1e-1, rtol=5e-2)
+
+
+def test_forward_parity_window_compiled():
+    # sliding-window mask classes + window-floor block skip, compiled.
+    # S=3072, W=512 at the default 1024 tiles: q block i=2 has floor
+    # 2048-511=1537 -> j_start = 1 > 0, so the relocated scratch init
+    # (j==j_start, not j==0) and the floor skip both execute for real
+    q, k, v = rand_qkv(jax.random.key(36), 1, 2, 3072, 128, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, window=512)
+    ref = attention_reference(q, k, v, causal=True, window=512)
+    assert_close(out, ref, atol=5e-2)

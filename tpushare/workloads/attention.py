@@ -11,9 +11,11 @@ the block matmuls, VPU the rescaling (see
 
 Grid: (B, H, q_blocks, kv_blocks); TPU grids execute sequentially with the
 last axis fastest, so the (m, l, acc) scratch carries across the kv axis of
-one (b, h, i) triple and is re-initialized at kv step 0. Causal q-blocks
-skip kv blocks beyond their diagonal entirely (no compute, no DMA use) —
-the standard ~2x causal FLOP saving.
+one (b, h, i) triple and is re-initialized at the first visible kv step.
+Causal q-blocks skip kv blocks beyond their diagonal entirely (no compute,
+no DMA use) — the standard ~2x causal FLOP saving — and sliding-window
+mode (``window=W``) additionally skips blocks below the window floor, so
+per-query cost is O(W) regardless of sequence length.
 
 Differentiable: :func:`flash_attention` carries a custom VJP whose backward
 pass regenerates each probability block from the kernel's log-sum-exp
@@ -53,13 +55,23 @@ DEFAULT_BLOCK_KV = 1024
 
 
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
-                        causal: bool = True) -> jax.Array:
-    """Plain einsum attention (the behavioral spec the kernel must match)."""
+                        causal: bool = True,
+                        window: int | None = None) -> jax.Array:
+    """Plain einsum attention (the behavioral spec the kernel must match).
+
+    ``window=W`` (causal only) is sliding-window attention: query i sees
+    keys [max(0, i-W+1), i] — the Mistral-style local mask for
+    long-context serving.
+    """
     d = q.shape[-1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (d ** -0.5)
     if causal:
         S = q.shape[2]
         mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        if window is not None:
+            row = jnp.arange(S)[:, None]
+            col = jnp.arange(S)[None, :]
+            mask = jnp.logical_and(mask, col >= row - (window - 1))
         s = jnp.where(mask[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
@@ -67,30 +79,49 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                   acc_ref, *, seq: int, n_kv: int,
-                  causal: bool, block_q: int, block_kv: int):
+                  causal: bool, block_q: int, block_kv: int,
+                  window: int | None):
     """One (b, h, q-block i, kv-block j) grid step.
 
     q_ref: [1, 1, block_q, D] (softmax scale pre-folded by the caller);
     k_ref/v_ref: [1, 1, block_kv, D] (current kv block only); o_ref:
     [1, 1, block_q, D]; m/l/acc: VMEM scratch carrying the online-softmax
     state across the kv axis.
+
+    ``window=W`` (sliding-window/local attention, causal only): kv
+    blocks entirely BELOW the q block's window floor are skipped the
+    same way beyond-diagonal blocks are — per-query cost is O(W), not
+    O(S), which is the whole point for long-context serving.
     """
     from jax.experimental import pallas as pl
 
     i = pl.program_id(2)
     j = pl.program_id(3)
 
-    @pl.when(j == 0)
+    # first visible kv block: 0 normally; with a window, blocks whose
+    # LAST column is older than the q block's oldest visible key
+    # ((i*bq) - W + 1) are skipped, so init moves to the window floor
+    if window is None:
+        j_start = 0
+    else:
+        floor = i * block_q - (window - 1)
+        j_start = jnp.maximum(floor, 0) // block_kv
+
+    @pl.when(j == j_start)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     # causal: kv blocks whose first column is past the q block's last row
-    # contribute nothing
+    # contribute nothing; with a window, neither do blocks whose last
+    # column is below the BLOCK's lowest window floor
     visible = (j * block_kv <= (i + 1) * block_q - 1) if causal else (j >= 0)
+    if window is not None:
+        visible = jnp.logical_and(visible, j >= j_start)
 
-    def _accum(mask_causal: bool, mask_pad: bool):
+    def _accum(mask_causal: bool, mask_pad: bool,
+               mask_window: bool = False):
         # inputs stay in their storage dtype (bf16) through the MXU —
         # fp32 accumulation comes from preferred_element_type; pre-casting
         # to fp32 would halve MXU throughput. The softmax scale is folded
@@ -103,17 +134,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BQ, BK]
-        if mask_causal or mask_pad:
+        if mask_causal or mask_pad or mask_window:
             col = j * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_kv), 1)
             mask = None
             if mask_pad:
                 mask = col < seq                          # padded keys out
-            if mask_causal:
+            if mask_causal or mask_window:
                 row = i * bq + jax.lax.broadcasted_iota(
                     jnp.int32, (bq, block_kv), 0)
-                c = col <= row
-                mask = c if mask is None else jnp.logical_and(mask, c)
+                if mask_causal:
+                    c = col <= row
+                    mask = c if mask is None else jnp.logical_and(mask, c)
+                if mask_window:
+                    w = col >= row - (window - 1)
+                    mask = w if mask is None else jnp.logical_and(mask, w)
             s = jnp.where(mask, s, -jnp.inf)
 
         m = m_ref[...]
@@ -147,12 +182,44 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     nopad = col_end <= seq
     if causal:
         below_diag = col_end - 1 <= i * block_q
-        full = jnp.logical_and(nopad, below_diag)
-        diag_only = jnp.logical_and(nopad, jnp.logical_not(below_diag))
+        # with a window, a block is compare-free only when it ALSO sits
+        # entirely above every row's window floor; edge blocks pay ONLY
+        # the compare they actually straddle (each saved compare+where
+        # is a VPU pass over [BQ, BK])
+        if window is not None:
+            above_floor = j * block_kv >= (i + 1) * block_q - window
+            clean = jnp.logical_and(below_diag, above_floor)
+            diag_only = jnp.logical_and(
+                nopad, jnp.logical_and(jnp.logical_not(below_diag),
+                                       above_floor))
+            floor_only = jnp.logical_and(
+                nopad, jnp.logical_and(below_diag,
+                                       jnp.logical_not(above_floor)))
+            both = jnp.logical_and(
+                nopad, jnp.logical_and(jnp.logical_not(below_diag),
+                                       jnp.logical_not(above_floor)))
 
-        @pl.when(jnp.logical_and(visible, diag_only))
-        def _step_diag():
-            _accum(mask_causal=True, mask_pad=False)
+            @pl.when(jnp.logical_and(visible, diag_only))
+            def _step_diag_only():
+                _accum(mask_causal=True, mask_pad=False)
+
+            @pl.when(jnp.logical_and(visible, floor_only))
+            def _step_floor_only():
+                _accum(mask_causal=False, mask_pad=False,
+                       mask_window=True)
+
+            @pl.when(jnp.logical_and(visible, both))
+            def _step_both():
+                _accum(mask_causal=True, mask_pad=False,
+                       mask_window=True)
+        else:
+            clean = below_diag
+            edge = jnp.logical_and(nopad, jnp.logical_not(clean))
+
+            @pl.when(jnp.logical_and(visible, edge))
+            def _step_edge():
+                _accum(mask_causal=True, mask_pad=False)
+        full = jnp.logical_and(nopad, clean)
     else:
         # non-causal: no diagonal class exists — lowering it anyway would
         # trace a dead duplicate of the accumulate body into every kernel
@@ -164,7 +231,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 
     @pl.when(jnp.logical_and(visible, jnp.logical_not(nopad)))
     def _step_padded():
-        _accum(mask_causal=causal, mask_pad=True)
+        _accum(mask_causal=causal, mask_pad=True,
+               mask_window=causal and window is not None)
 
     # final kv step for this q block: normalize and emit. With unequal
     # block sizes and query padding the diagonal formula can point past
@@ -191,7 +259,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 
 def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
                 causal: bool, interpret: bool,
-                block_q: int | None = None, block_kv: int | None = None):
+                block_q: int | None = None, block_kv: int | None = None,
+                window: int | None = None):
     """Run the kernel; returns (out [B,H,S,D], lse [B,H,S] fp32).
 
     GQA-native: k/v may carry fewer heads (H_kv dividing H); the kv
@@ -232,7 +301,7 @@ def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, seq=kv,
                           n_kv=n_kv, causal=causal, block_q=bq,
-                          block_kv=bk),
+                          block_kv=bk, window=window),
         out_shape=(jax.ShapeDtypeStruct(qp.shape, q.dtype),
                    jax.ShapeDtypeStruct((B, H, 8, Sp), jnp.float32)),
         grid=grid,
@@ -522,18 +591,20 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
     return dq, dk[:, :, :kvlen], dv[:, :, :kvlen]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, interpret, block_q, block_kv):
-    out, _ = _flash_call(q, k, v, causal, interpret, block_q, block_kv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, interpret, block_q, block_kv, window):
+    out, _ = _flash_call(q, k, v, causal, interpret, block_q, block_kv,
+                         window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, interpret, block_q, block_kv):
-    out, lse = _flash_call(q, k, v, causal, interpret, block_q, block_kv)
+def _flash_fwd(q, k, v, causal, interpret, block_q, block_kv, window):
+    out, lse = _flash_call(q, k, v, causal, interpret, block_q, block_kv,
+                           window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, interpret, block_q, block_kv, res, do):
+def _flash_bwd(causal, interpret, block_q, block_kv, window, res, do):
     """Backward dispatch. TPUSHARE_FLASH_BWD=pallas selects the Pallas
     kernel pair on compiled TPU MHA paths (causal block skip + bf16 MXU;
     its algorithm is parity-proven in interpret mode and the bench A/Bs
@@ -548,16 +619,18 @@ def _flash_bwd(causal, interpret, block_q, block_kv, res, do):
     import os
 
     q, k, v, out, lse = res
-    if (not interpret and k.shape[1] == q.shape[1]
+    if (not interpret and k.shape[1] == q.shape[1] and window is None
             and os.environ.get("TPUSHARE_FLASH_BWD", "xla") == "pallas"):
         # backward tiles are chosen independently of the forward's
-        # (block_q/block_kv args tune the FORWARD; see DEFAULT_BWD_*)
+        # (block_q/block_kv args tune the FORWARD; see DEFAULT_BWD_*).
+        # Sliding-window backward stays on the XLA path (the Pallas pair
+        # has no window mask class yet).
         return _flash_bwd_pallas(q, k, v, out, lse, do, causal,
                                  interpret=False)
-    return _flash_bwd_xla(causal, res, do)
+    return _flash_bwd_xla(causal, res, do, window=window)
 
 
-def _flash_bwd_xla(causal, res, do):
+def _flash_bwd_xla(causal, res, do, window: int | None = None):
     """Blockwise flash backward: scan over K/V blocks, regenerating each
     probability block from the saved LSE — residency stays O(S x BLOCK),
     nothing [S, S] is ever materialized (the point of training with the
@@ -609,6 +682,9 @@ def _flash_bwd_xla(causal, res, do):
         mask = (col < kv)[None, :]
         if causal:
             mask = jnp.logical_and(mask, col[None, :] <= row[:, None])
+            if window is not None:
+                mask = jnp.logical_and(
+                    mask, col[None, :] >= row[:, None] - (window - 1))
         s = jnp.einsum("bhqd,bhkd->bhqk", qs, kb)
         p = jnp.where(mask[None, None], jnp.exp(s - lsep), 0.0)
         dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dop)
@@ -634,12 +710,14 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "interpret",
-                                             "block_q", "block_kv"))
+                                             "block_q", "block_kv",
+                                             "window"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     interpret: bool | None = None,
                     block_q: int | None = None,
-                    block_kv: int | None = None) -> jax.Array:
+                    block_kv: int | None = None,
+                    window: int | None = None) -> jax.Array:
     """Fused attention over [B, H, S, D] queries; k/v may carry fewer
     (GQA) heads — H_kv must divide H and is streamed, never expanded.
 
@@ -648,6 +726,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Differentiable: a custom VJP regenerates probabilities blockwise from
     the kernel's log-sum-exp residual, so training never materializes the
     [S, S] score matrix either.
+
+    ``window=W`` (causal only): sliding-window/local attention — query i
+    sees keys [max(0, i-W+1), i]. KV blocks entirely below the window
+    floor are skipped like beyond-diagonal blocks, so per-query cost is
+    O(W) regardless of sequence length (Mistral-style long-context
+    serving). The backward runs on the XLA scan path.
     """
     B, H, S, D = q.shape
     Hkv = k.shape[1] if k.ndim == 4 else -1
@@ -666,6 +750,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             raise ValueError(
                 f"{name}={blk} must be a positive multiple of {BLOCK} "
                 "(MXU tile alignment)")
+    if window is not None:
+        if not causal:
+            raise ValueError("window attention requires causal=True")
+        if window < 1:
+            raise ValueError(f"window={window} must be >= 1")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, bool(causal), bool(interpret), block_q, block_kv)
+    return _flash(q, k, v, bool(causal), bool(interpret), block_q, block_kv,
+                  window)
