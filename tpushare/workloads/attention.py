@@ -63,6 +63,10 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     keys [max(0, i-W+1), i] — the Mistral-style local mask for
     long-context serving.
     """
+    if window is not None and not causal:
+        # match flash_attention: silently returning full bidirectional
+        # attention would let the spec validate the wrong computation
+        raise ValueError("window attention requires causal=True")
     d = q.shape[-1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (d ** -0.5)
     if causal:

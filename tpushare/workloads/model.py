@@ -46,6 +46,13 @@ class ModelConfig:
     # KV-cached decode path always uses the einsum core (its single-token
     # queries don't amortize a fused kernel).
     attn: str = "einsum"
+    # sliding-window (local) attention span: None = full causal. Applies
+    # to every path — the flash kernel skips blocks below the window
+    # floor (O(window) per query), einsum and the KV-cached decode mask
+    # (the cache stays prompt-bounded; a rolling buffer would only add
+    # the O(window) MEMORY saving, not change outputs) — Mistral-style
+    # long-context serving.
+    attn_window: int | None = None
     # mixture-of-experts FFN (tpushare/workloads/moe.py): 0 = dense SwiGLU;
     # >0 replaces every layer's FFN with moe_experts experts of width d_ff,
     # expert weights sharded over the "ep" mesh axis.
@@ -72,6 +79,7 @@ class ModelConfig:
     def validate(self) -> "ModelConfig":
         assert self.d_model % self.n_heads == 0
         assert self.n_heads % self.n_kv_heads == 0
+        assert self.attn_window is None or self.attn_window >= 1
         return self
 
 
@@ -279,6 +287,7 @@ def decoder_layer(x: jax.Array, lp: dict, positions: jax.Array,
         attn = flash_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), causal=True,
+            window=cfg.attn_window,
         ).transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
     else:
         # GQA: repeat kv heads up to query heads for the einsum spec path
@@ -287,6 +296,11 @@ def decoder_layer(x: jax.Array, lp: dict, positions: jax.Array,
         v = jnp.repeat(v, reps, axis=2)
         if mask is None:
             mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+            if cfg.attn_window is not None:
+                row = jnp.arange(S)[:, None]
+                col = jnp.arange(S)[None, :]
+                mask = jnp.logical_and(
+                    mask, col >= row - (cfg.attn_window - 1))
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
         scores = scores * (hd ** -0.5)
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
@@ -422,6 +436,12 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
     positions = jnp.broadcast_to(q_pos, (B, T))
     key_pos = jnp.arange(M)
     mask = key_pos[None, :] <= q_pos[:, None]                # [T, M]
+    if cfg.attn_window is not None:
+        # the prompt-bounded cache honors the window by masking (the
+        # O(window) MEMORY saving would need a rolling buffer; serving
+        # correctness does not)
+        mask = jnp.logical_and(
+            mask, key_pos[None, :] >= q_pos[:, None] - (cfg.attn_window - 1))
 
     def layer(x, xs):
         lp, ck, cv = xs
