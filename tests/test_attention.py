@@ -269,3 +269,33 @@ def test_window_requires_causal_and_positive():
         flash_attention(q, k, v, causal=False, window=64, interpret=True)
     with pytest.raises(ValueError, match="window"):
         flash_attention(q, k, v, causal=True, window=0, interpret=True)
+
+
+def test_pallas_backward_gqa_grouped_grid():
+    """The dkdv kernel's grouped (B, H_kv, j, i, g) grid vs autodiff on
+    expanded heads — GQA gradients sum per group IN the grid, no K/V
+    expansion."""
+    from tpushare.workloads.attention import _flash_bwd_pallas, _flash_call
+
+    for B, H, Hkv, S, causal in ((1, 4, 2, 256, True), (1, 4, 1, 256, False),
+                                 (2, 8, 2, 300, True)):
+        ks = jax.random.split(jax.random.key(70 + H + S), 4)
+        q = jax.random.normal(ks[0], (B, H, S, 32), jnp.float32)
+        k = jax.random.normal(ks[1], (B, Hkv, S, 32), jnp.float32)
+        v = jax.random.normal(ks[2], (B, Hkv, S, 32), jnp.float32)
+        do = jax.random.normal(ks[3], (B, H, S, 32), jnp.float32)
+        g = H // Hkv
+
+        def ref_fn(q, k, v):
+            return attention_reference(q, jnp.repeat(k, g, 1),
+                                       jnp.repeat(v, g, 1), causal)
+
+        _, ref_vjp = jax.vjp(ref_fn, q, k, v)
+        ref = ref_vjp(do)
+        out, lse = _flash_call(q, k, v, causal, True, None, None)
+        got = _flash_bwd_pallas(q, k, v, out, lse, do, causal,
+                                interpret=True)
+        for name, a, b in zip(("dq", "dk", "dv"), got, ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4,
+                err_msg=f"{name} H{H}/{Hkv} S{S} causal={causal}")

@@ -54,6 +54,16 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_KV = 1024
 
 
+def sliding_window_mask(row_pos, col_pos, window: int):
+    """THE window-visibility predicate: key ``col_pos`` is visible from
+    query ``row_pos`` iff ``col_pos >= row_pos - (window - 1)`` (W keys
+    incl. the diagonal). Single definition of the inclusive convention —
+    every path (reference, kernel, model einsum, KV-cached decode)
+    composes this, so an off-by-one fix lands everywhere at once.
+    Broadcasts over any compatible position-array shapes."""
+    return col_pos >= row_pos - (window - 1)
+
+
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True,
                         window: int | None = None) -> jax.Array:
@@ -73,9 +83,8 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
         S = q.shape[2]
         mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
         if window is not None:
-            row = jnp.arange(S)[:, None]
-            col = jnp.arange(S)[None, :]
-            mask = jnp.logical_and(mask, col >= row - (window - 1))
+            mask = jnp.logical_and(mask, sliding_window_mask(
+                jnp.arange(S)[:, None], jnp.arange(S)[None, :], window))
         s = jnp.where(mask[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
@@ -151,7 +160,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                     c = col <= row
                     mask = c if mask is None else jnp.logical_and(mask, c)
                 if mask_window:
-                    w = col >= row - (window - 1)
+                    w = sliding_window_mask(row, col, window)
                     mask = w if mask is None else jnp.logical_and(mask, w)
             s = jnp.where(mask, s, -jnp.inf)
 
@@ -446,22 +455,29 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                            dk_ref, dv_ref, dk_acc, dv_acc, *, seq: int,
-                           n_q: int, causal: bool, block_q: int,
+                           n_q: int, n_g: int, causal: bool, block_q: int,
                            block_kv: int):
-    """dk/dv pass: grid (B, H, j, i), i innermost carrying both
-    accumulators. dv[j] = sum_i p_T[j,i] @ do[i]; dk[j] = sum_i
-    ds_T[j,i] @ q_s[i] (already transposed — plain matmuls).
+    """dk/dv pass: grid (B, H_kv, j, i, g) with the (i, g) pair innermost
+    carrying both accumulators. dv[j] = sum_{i,g} p_T[j,i,g] @ do[i,g];
+    dk[j] = sum_{i,g} ds_T[j,i,g] @ q_s[i,g] (already transposed — plain
+    matmuls). The g axis is the query-head group (GQA): each kv head's
+    gradients sum over its n_g query heads IN the grid, which is what
+    lets the kernel serve grouped-query attention without expanding K/V
+    the way the XLA fallback does (the output block (b, h_kv, j) stays
+    resident across the whole consecutive (i, g) sweep, so the revisit
+    pattern remains legal). n_g == 1 is plain MHA.
     """
     from jax.experimental import pallas as pl
 
     j = pl.program_id(2)
     i = pl.program_id(3)
+    g = pl.program_id(4)
 
     # first visible q block for this kv block: rows below j*block_kv see
     # nothing of it under causal masking
     i_start = (j * block_kv) // block_q if causal else 0
 
-    @pl.when(i == i_start)
+    @pl.when(jnp.logical_and(i == i_start, g == 0))
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -502,7 +518,7 @@ def _flash_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         def _step_all():
             _step(mask_causal=False, mask_pad=False)
 
-    @pl.when(i == n_q - 1)
+    @pl.when(jnp.logical_and(i == n_q - 1, g == n_g - 1))
     def _emit():
         dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
@@ -511,15 +527,21 @@ def _flash_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
                       block_q: int | None = None,
                       block_kv: int | None = None):
-    """Pallas backward (MHA only — the GQA path uses the XLA backward):
-    two kernels over the same recomputed scores, with the forward's
-    causal block skip (the XLA backward cannot skip, costing ~2x FLOPs)
-    and bf16 matmuls (the XLA backward runs fp32 at half MXU rate).
+    """Pallas backward: two kernels over the same recomputed scores,
+    with the forward's causal block skip (the XLA backward cannot skip,
+    costing ~2x FLOPs) and bf16 matmuls (the XLA backward runs fp32 at
+    half MXU rate). GQA-native like the forward: q/do carry H query
+    heads while k/v carry H_kv — the dq kernel streams shared kv blocks
+    via h // G index maps, and the dkdv kernel sums each group IN its
+    grid (see its docstring) instead of expanding K/V in HBM the way the
+    XLA fallback must.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
     kvlen = k.shape[2]
     scale = D ** -0.5
     # identical pre-scale to the forward: gradients through the matmul
@@ -554,7 +576,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
     delta_b = jnp.broadcast_to(delta_p[:, :, None, :], (B, H, 8, Sp))
 
     qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
-    kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    kspec = pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j, G=G: (b, h // G, j, 0))
     rowspec = pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i))
     params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel",
@@ -572,22 +595,29 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
         interpret=interpret,
     )(qp, kp, vp, dop, lse_b, delta_b)
 
-    # dkdv grid transposes (i, j) -> (j, i): reuse the specs with the
-    # roles of the last two grid axes swapped
-    kspec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
-    qspec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
-    rowspec_t = pl.BlockSpec((1, 1, 8, bq), lambda b, h, j, i: (b, h, 0, i))
+    # dkdv grid: (B, H_kv, j, i, g) — kv-side blocks indexed by the kv
+    # head, q-side blocks by the group member h = h_kv * G + g
+    kspec_t = pl.BlockSpec((1, 1, bk, D),
+                           lambda b, hk, j, i, g: (b, hk, j, 0))
+    qspec_t = pl.BlockSpec((1, 1, bq, D),
+                           lambda b, hk, j, i, g, G=G: (b, hk * G + g, i, 0))
+    rowspec_t = pl.BlockSpec((1, 1, 8, bq),
+                             lambda b, hk, j, i, g, G=G:
+                             (b, hk * G + g, 0, i))
+    params_t = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary", "arbitrary"))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, seq=kvlen, n_q=n_q,
-                          causal=causal, block_q=bq, block_kv=bk),
+                          n_g=G, causal=causal, block_q=bq, block_kv=bk),
         out_shape=(jax.ShapeDtypeStruct(kp.shape, k.dtype),
                    jax.ShapeDtypeStruct(vp.shape, v.dtype)),
-        grid=(B, H, n_kv, n_q),
+        grid=(B, Hkv, n_kv, n_q, G),
         in_specs=[kspec_t, kspec_t, qspec_t, qspec_t, rowspec_t, rowspec_t],
         out_specs=(kspec_t, kspec_t),
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
-        compiler_params=params,
+        compiler_params=params_t,
         interpret=interpret,
     )(kp, vp, qp, dop, lse_b, delta_b)
 
@@ -610,25 +640,25 @@ def _flash_fwd(q, k, v, causal, interpret, block_q, block_kv, window):
 
 def _flash_bwd(causal, interpret, block_q, block_kv, window, res, do):
     """Backward dispatch. TPUSHARE_FLASH_BWD=pallas selects the Pallas
-    kernel pair on compiled TPU MHA paths (causal block skip + bf16 MXU;
-    its algorithm is parity-proven in interpret mode and the bench A/Bs
-    it directly); the default remains the XLA blockwise scan until the
-    Pallas pair's MOSAIC COMPILATION is validated on real hardware —
-    dispatching an uncompiled-anywhere kernel by default would put every
-    training run behind an unverified compile. Interpret mode and GQA
-    always use the XLA path (Pallas interpret of 4-matmul kernels is far
-    slower than XLA on CPU test meshes; grouped dk/dv accumulation would
-    need a 5th grid axis).
+    kernel pair on compiled TPU paths (causal block skip + bf16 MXU +
+    GQA-native grouped dkdv grid; its algorithm is parity-proven in
+    interpret mode and the bench A/Bs it directly); the default remains
+    the XLA blockwise scan until the Pallas pair's MOSAIC COMPILATION is
+    validated on real hardware — dispatching an uncompiled-anywhere
+    kernel by default would put every training run behind an unverified
+    compile. Interpret mode always uses the XLA path (Pallas interpret
+    of 4-matmul kernels is far slower than XLA on CPU test meshes).
     """
     import os
 
     q, k, v, out, lse = res
-    if (not interpret and k.shape[1] == q.shape[1] and window is None
+    if (not interpret and window is None
             and os.environ.get("TPUSHARE_FLASH_BWD", "xla") == "pallas"):
         # backward tiles are chosen independently of the forward's
         # (block_q/block_kv args tune the FORWARD; see DEFAULT_BWD_*).
         # Sliding-window backward stays on the XLA path (the Pallas pair
-        # has no window mask class yet).
+        # has no window mask class yet). GQA is native (grouped dkdv
+        # grid) — no K/V expansion.
         return _flash_bwd_pallas(q, k, v, out, lse, do, causal,
                                  interpret=False)
     return _flash_bwd_xla(causal, res, do, window=window)
@@ -687,8 +717,8 @@ def _flash_bwd_xla(causal, res, do, window: int | None = None):
         if causal:
             mask = jnp.logical_and(mask, col[None, :] <= row[:, None])
             if window is not None:
-                mask = jnp.logical_and(
-                    mask, col[None, :] >= row[:, None] - (window - 1))
+                mask = jnp.logical_and(mask, sliding_window_mask(
+                    row[:, None], col[None, :], window))
         s = jnp.einsum("bhqd,bhkd->bhqk", qs, kb)
         p = jnp.where(mask[None, None], jnp.exp(s - lsep), 0.0)
         dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dop)

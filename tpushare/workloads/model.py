@@ -296,11 +296,14 @@ def decoder_layer(x: jax.Array, lp: dict, positions: jax.Array,
         v = jnp.repeat(v, reps, axis=2)
         if mask is None:
             mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-            if cfg.attn_window is not None:
-                row = jnp.arange(S)[:, None]
-                col = jnp.arange(S)[None, :]
-                mask = jnp.logical_and(
-                    mask, col >= row - (cfg.attn_window - 1))
+        if cfg.attn_window is not None:
+            # composed into CUSTOM masks too — silently running full
+            # attention on one path while the flash/decode paths window
+            # would break the same-model-everywhere invariant
+            from tpushare.workloads.attention import sliding_window_mask
+            mask = jnp.logical_and(mask, sliding_window_mask(
+                jnp.arange(S)[:, None], jnp.arange(S)[None, :],
+                cfg.attn_window))
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
         scores = scores * (hd ** -0.5)
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
@@ -440,8 +443,9 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
         # the prompt-bounded cache honors the window by masking (the
         # O(window) MEMORY saving would need a rolling buffer; serving
         # correctness does not)
-        mask = jnp.logical_and(
-            mask, key_pos[None, :] >= q_pos[:, None] - (cfg.attn_window - 1))
+        from tpushare.workloads.attention import sliding_window_mask
+        mask = jnp.logical_and(mask, sliding_window_mask(
+            q_pos[:, None], key_pos[None, :], cfg.attn_window))
 
     def layer(x, xs):
         lp, ck, cv = xs
