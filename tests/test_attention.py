@@ -299,3 +299,34 @@ def test_pallas_backward_gqa_grouped_grid():
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4,
                 err_msg=f"{name} H{H}/{Hkv} S{S} causal={causal}")
+
+
+def test_pallas_backward_windowed():
+    """Window support in BOTH backward grid orders: the dq kernel's
+    relocated init/floor skip (j_start > 0 at bq=256/bk=128/W=300) and
+    the dkdv kernel's upper-i visibility cut (bq=128/bk=256/W=100),
+    against autodiff of the windowed reference."""
+    from tpushare.workloads.attention import _flash_bwd_pallas, _flash_call
+
+    for S, W, bq, bk in ((384, 100, 128, 128), (640, 300, 256, 128),
+                         (640, 100, 128, 256), (300, 77, None, None)):
+        ks = jax.random.split(jax.random.key(80 + S + W), 4)
+        q = jax.random.normal(ks[0], (1, 4, S, 32), jnp.float32)
+        k = jax.random.normal(ks[1], (1, 2, S, 32), jnp.float32)
+        v = jax.random.normal(ks[2], (1, 2, S, 32), jnp.float32)
+        do = jax.random.normal(ks[3], (1, 4, S, 32), jnp.float32)
+
+        def ref_fn(q, k, v, W=W):
+            return attention_reference(q, jnp.repeat(k, 2, 1),
+                                       jnp.repeat(v, 2, 1), True, window=W)
+
+        _, ref_vjp = jax.vjp(ref_fn, q, k, v)
+        ref = ref_vjp(do)
+        out, lse = _flash_call(q, k, v, True, True, bq, bk, window=W)
+        got = _flash_bwd_pallas(q, k, v, out, lse, do, True,
+                                interpret=True, block_q=bq, block_kv=bk,
+                                window=W)
+        for name, a, b in zip(("dq", "dk", "dv"), got, ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4,
+                err_msg=f"{name} S={S} W={W} bq={bq} bk={bk}")

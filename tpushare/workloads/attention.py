@@ -90,6 +90,52 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+def _causal_class_dispatch(pl, step, gate, i, j, block_q: int,
+                           block_kv: int, window: int | None):
+    """THE causal/window mask-class split, shared by the forward kernel
+    and both backward kernels (three hand-synced copies of this predicate
+    algebra is how off-by-ones are born). ``step(mask_causal,
+    mask_window)`` runs under ``gate`` for each class:
+
+    - clean: entirely below the diagonal and above the window floor — no
+      compares at all (the common case; each saved compare+where is a
+      VPU pass over the score matrix);
+    - diag-only / floor-only / both: pay exactly the compare(s) the
+      block straddles.
+    """
+    below_diag = (j + 1) * block_kv - 1 <= i * block_q
+    if window is not None:
+        above_floor = j * block_kv >= (i + 1) * block_q - window
+
+        @pl.when(jnp.logical_and(gate, jnp.logical_and(
+            below_diag, above_floor)))
+        def _clean():
+            step(False, False)
+
+        @pl.when(jnp.logical_and(gate, jnp.logical_and(
+            jnp.logical_not(below_diag), above_floor)))
+        def _diag_only():
+            step(True, False)
+
+        @pl.when(jnp.logical_and(gate, jnp.logical_and(
+            below_diag, jnp.logical_not(above_floor))))
+        def _floor_only():
+            step(False, True)
+
+        @pl.when(jnp.logical_and(gate, jnp.logical_and(
+            jnp.logical_not(below_diag), jnp.logical_not(above_floor))))
+        def _both():
+            step(True, True)
+    else:
+        @pl.when(jnp.logical_and(gate, below_diag))
+        def _clean():
+            step(False, False)
+
+        @pl.when(jnp.logical_and(gate, jnp.logical_not(below_diag)))
+        def _diag():
+            step(True, False)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                   acc_ref, *, seq: int, n_kv: int,
                   causal: bool, block_q: int, block_kv: int,
@@ -194,53 +240,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     col_end = (j + 1) * block_kv              # exclusive last col + 1
     nopad = col_end <= seq
     if causal:
-        below_diag = col_end - 1 <= i * block_q
-        # with a window, a block is compare-free only when it ALSO sits
-        # entirely above every row's window floor; edge blocks pay ONLY
-        # the compare they actually straddle (each saved compare+where
-        # is a VPU pass over [BQ, BK])
-        if window is not None:
-            above_floor = j * block_kv >= (i + 1) * block_q - window
-            clean = jnp.logical_and(below_diag, above_floor)
-            diag_only = jnp.logical_and(
-                nopad, jnp.logical_and(jnp.logical_not(below_diag),
-                                       above_floor))
-            floor_only = jnp.logical_and(
-                nopad, jnp.logical_and(below_diag,
-                                       jnp.logical_not(above_floor)))
-            both = jnp.logical_and(
-                nopad, jnp.logical_and(jnp.logical_not(below_diag),
-                                       jnp.logical_not(above_floor)))
-
-            @pl.when(jnp.logical_and(visible, diag_only))
-            def _step_diag_only():
-                _accum(mask_causal=True, mask_pad=False)
-
-            @pl.when(jnp.logical_and(visible, floor_only))
-            def _step_floor_only():
-                _accum(mask_causal=False, mask_pad=False,
-                       mask_window=True)
-
-            @pl.when(jnp.logical_and(visible, both))
-            def _step_both():
-                _accum(mask_causal=True, mask_pad=False,
-                       mask_window=True)
-        else:
-            clean = below_diag
-            edge = jnp.logical_and(nopad, jnp.logical_not(clean))
-
-            @pl.when(jnp.logical_and(visible, edge))
-            def _step_edge():
-                _accum(mask_causal=True, mask_pad=False)
-        full = jnp.logical_and(nopad, clean)
+        _causal_class_dispatch(
+            pl, lambda c, w: _accum(mask_causal=c, mask_pad=False,
+                                    mask_window=w),
+            jnp.logical_and(visible, nopad), i, j, block_q, block_kv,
+            window)
     else:
         # non-causal: no diagonal class exists — lowering it anyway would
         # trace a dead duplicate of the accumulate body into every kernel
-        full = nopad
-
-    @pl.when(jnp.logical_and(visible, full))
-    def _step_unmasked():
-        _accum(mask_causal=False, mask_pad=False)
+        @pl.when(jnp.logical_and(visible, nopad))
+        def _step_unmasked():
+            _accum(mask_causal=False, mask_pad=False)
 
     @pl.when(jnp.logical_and(visible, jnp.logical_not(nopad)))
     def _step_padded():
@@ -350,7 +360,8 @@ DEFAULT_BWD_BLOCK_KV = 512
 
 def _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
                 i, j, seq: int, block_q: int, block_kv: int,
-                mask_causal: bool, mask_pad: bool):
+                mask_causal: bool, mask_pad: bool,
+                mask_window: bool = False, window: int | None = None):
     """Shared backward block math, in TRANSPOSED score space.
 
     Everything is [BKV, BQ] (kv positions on sublanes, q positions on
@@ -371,17 +382,21 @@ def _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
     s_t = jax.lax.dot_general(
         kb, q, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)           # [BK, BQ]
-    if mask_causal or mask_pad:
+    if mask_causal or mask_pad or mask_window:
         kpos = j * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, (block_kv, block_q), 0)
         mask = None
         if mask_pad:
             mask = kpos < seq                         # padded keys out
-        if mask_causal:
+        if mask_causal or mask_window:
             qpos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_kv, block_q), 1)
-            c = kpos <= qpos
-            mask = c if mask is None else jnp.logical_and(mask, c)
+            if mask_causal:
+                c = kpos <= qpos
+                mask = c if mask is None else jnp.logical_and(mask, c)
+            if mask_window:
+                w = sliding_window_mask(qpos, kpos, window)
+                mask = w if mask is None else jnp.logical_and(mask, w)
         # exp(-inf - lse) == 0, so p needs no re-mask (forward's trick)
         s_t = jnp.where(mask, s_t, -jnp.inf)
 
@@ -395,7 +410,8 @@ def _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_acc, *, seq: int, n_kv: int,
-                         causal: bool, block_q: int, block_kv: int):
+                         causal: bool, block_q: int, block_kv: int,
+                         window: int | None):
     """dq pass: grid (B, H, i, j), j innermost carrying the dq accumulator.
 
     dq[i] = scale * sum_j ds[i,j] @ k[j]; computed transposed as
@@ -409,17 +425,27 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     i = pl.program_id(2)
     j = pl.program_id(3)
 
-    @pl.when(j == 0)
+    # mirror of the forward's window-floor logic: init relocates to the
+    # first visible kv block and below-floor blocks are skipped entirely
+    if window is None:
+        j_start = 0
+    else:
+        j_start = jnp.maximum(i * block_q - (window - 1), 0) // block_kv
+
+    @pl.when(j == j_start)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     visible = (j * block_kv <= (i + 1) * block_q - 1) if causal else (j >= 0)
+    if window is not None:
+        visible = jnp.logical_and(visible, j >= j_start)
 
-    def _step(mask_causal: bool, mask_pad: bool):
+    def _step(mask_causal: bool, mask_pad: bool, mask_window: bool = False):
         _, ds_t, kb, _, _, _ = _bwd_common(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i=i, j=j,
             seq=seq, block_q=block_q, block_kv=block_kv,
-            mask_causal=mask_causal, mask_pad=mask_pad)
+            mask_causal=mask_causal, mask_pad=mask_pad,
+            mask_window=mask_window, window=window)
         dq_acc[...] += jax.lax.dot_general(
             ds_t, kb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [BQ, D]
@@ -427,23 +453,20 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     col_end = (j + 1) * block_kv
     nopad = col_end <= seq
     if causal:
-        below_diag = col_end - 1 <= i * block_q
-        full = jnp.logical_and(nopad, below_diag)
-        diag_only = jnp.logical_and(nopad, jnp.logical_not(below_diag))
-
-        @pl.when(jnp.logical_and(visible, diag_only))
-        def _step_diag():
-            _step(mask_causal=True, mask_pad=False)
+        _causal_class_dispatch(
+            pl, lambda c, w: _step(mask_causal=c, mask_pad=False,
+                                   mask_window=w),
+            jnp.logical_and(visible, nopad), i, j, block_q, block_kv,
+            window)
     else:
-        full = nopad
-
-    @pl.when(jnp.logical_and(visible, full))
-    def _step_unmasked():
-        _step(mask_causal=False, mask_pad=False)
+        @pl.when(jnp.logical_and(visible, nopad))
+        def _step_unmasked():
+            _step(mask_causal=False, mask_pad=False)
 
     @pl.when(jnp.logical_and(visible, jnp.logical_not(nopad)))
     def _step_padded():
-        _step(mask_causal=causal, mask_pad=True)
+        _step(mask_causal=causal, mask_pad=True,
+              mask_window=causal and window is not None)
 
     last = (jnp.minimum(((i + 1) * block_q - 1) // block_kv, n_kv - 1)
             if causal else (n_kv - 1))
@@ -456,7 +479,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                            dk_ref, dv_ref, dk_acc, dv_acc, *, seq: int,
                            n_q: int, n_g: int, causal: bool, block_q: int,
-                           block_kv: int):
+                           block_kv: int, window: int | None):
     """dk/dv pass: grid (B, H_kv, j, i, g) with the (i, g) pair innermost
     carrying both accumulators. dv[j] = sum_{i,g} p_T[j,i,g] @ do[i,g];
     dk[j] = sum_{i,g} ds_T[j,i,g] @ q_s[i,g] (already transposed — plain
@@ -484,12 +507,18 @@ def _flash_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
     visible = (i * block_q + block_q - 1 >= j * block_kv) if causal \
         else (i >= 0)
+    if window is not None:
+        # q blocks whose lowest window floor is past this kv block's last
+        # column contribute nothing to its dk/dv
+        visible = jnp.logical_and(
+            visible, i * block_q <= (j + 1) * block_kv + window - 2)
 
-    def _step(mask_causal: bool, mask_pad: bool):
+    def _step(mask_causal: bool, mask_pad: bool, mask_window: bool = False):
         p_t, ds_t, _, _, dob, q = _bwd_common(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i=i, j=j,
             seq=seq, block_q=block_q, block_kv=block_kv,
-            mask_causal=mask_causal, mask_pad=mask_pad)
+            mask_causal=mask_causal, mask_pad=mask_pad,
+            mask_window=mask_window, window=window)
         dv_acc[...] += jax.lax.dot_general(
             p_t.astype(dob.dtype), dob, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [BK, D]
@@ -504,15 +533,12 @@ def _flash_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
     # entries of diagonal blocks would contribute garbage to the q-lane
     # contraction, so the causal compare is the one mask required.
     if causal:
-        below_diag = (j + 1) * block_kv - 1 <= i * block_q
-
-        @pl.when(jnp.logical_and(visible, jnp.logical_not(below_diag)))
-        def _step_diag():
-            _step(mask_causal=True, mask_pad=False)
-
-        @pl.when(jnp.logical_and(visible, below_diag))
-        def _step_unmasked():
-            _step(mask_causal=False, mask_pad=False)
+        # no pad class here — padded KEY rows are sliced by the caller
+        # and padded QUERY lanes self-zero (see the note above)
+        _causal_class_dispatch(
+            pl, lambda c, w: _step(mask_causal=c, mask_pad=False,
+                                   mask_window=w),
+            visible, i, j, block_q, block_kv, window)
     else:
         @pl.when(visible)
         def _step_all():
@@ -526,7 +552,8 @@ def _flash_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
                       block_q: int | None = None,
-                      block_kv: int | None = None):
+                      block_kv: int | None = None,
+                      window: int | None = None):
     """Pallas backward: two kernels over the same recomputed scores,
     with the forward's causal block skip (the XLA backward cannot skip,
     costing ~2x FLOPs) and bf16 matmuls (the XLA backward runs fp32 at
@@ -585,7 +612,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
 
     dqs = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, seq=kvlen, n_kv=n_kv,
-                          causal=causal, block_q=bq, block_kv=bk),
+                          causal=causal, block_q=bq, block_kv=bk,
+                          window=window),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         grid=(B, H, n_q, n_kv),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
@@ -609,7 +637,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
                              "arbitrary", "arbitrary"))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, seq=kvlen, n_q=n_q,
-                          n_g=G, causal=causal, block_q=bq, block_kv=bk),
+                          n_g=G, causal=causal, block_q=bq, block_kv=bk,
+                          window=window),
         out_shape=(jax.ShapeDtypeStruct(kp.shape, k.dtype),
                    jax.ShapeDtypeStruct(vp.shape, v.dtype)),
         grid=(B, Hkv, n_kv, n_q, G),
@@ -652,15 +681,14 @@ def _flash_bwd(causal, interpret, block_q, block_kv, window, res, do):
     import os
 
     q, k, v, out, lse = res
-    if (not interpret and window is None
+    if (not interpret
             and os.environ.get("TPUSHARE_FLASH_BWD", "xla") == "pallas"):
         # backward tiles are chosen independently of the forward's
         # (block_q/block_kv args tune the FORWARD; see DEFAULT_BWD_*).
-        # Sliding-window backward stays on the XLA path (the Pallas pair
-        # has no window mask class yet). GQA is native (grouped dkdv
-        # grid) — no K/V expansion.
+        # GQA (grouped dkdv grid — no K/V expansion) and sliding-window
+        # (floor block skip in both grid orders) are native.
         return _flash_bwd_pallas(q, k, v, out, lse, do, causal,
-                                 interpret=False)
+                                 interpret=False, window=window)
     return _flash_bwd_xla(causal, res, do, window=window)
 
 
@@ -765,7 +793,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     sees keys [max(0, i-W+1), i]. KV blocks entirely below the window
     floor are skipped like beyond-diagonal blocks, so per-query cost is
     O(W) regardless of sequence length (Mistral-style long-context
-    serving). The backward runs on the XLA scan path.
+    serving); both backward paths (XLA scan and the opt-in Pallas pair)
+    apply the same floor skip and mask.
     """
     B, H, S, D = q.shape
     Hkv = k.shape[1] if k.ndim == 4 else -1
