@@ -673,6 +673,23 @@ def _kernel_bench_inline() -> dict | None:
         "llama_mini_int8_decode_tokens_per_s": round(
             mb / (dec_ms_step / 1e3)),
     })
+
+    # full int8 serving stack: int8 weights AND int8 KV cache (the
+    # decode step is cache-bandwidth-bound, so halving cache bytes is
+    # the second half of the story quantize_int8 starts)
+    import dataclasses as _dc
+    cfg_q8 = _dc.replace(cfg, kv_cache_dtype="int8").validate()
+
+    def dec_loop_q8(steps):
+        return jax.jit(
+            lambda p, t: jnp.sum(greedy_decode_kv(p, t, steps, cfg_q8)))
+
+    dec_q8_ms = slope_ms(dec_loop_q8, (qparams, prompt), n1=d1, n2=d2)
+    out.update({
+        "int8_kv_decode_step_ms": round(dec_q8_ms, 4),
+        "llama_mini_int8_kv_decode_tokens_per_s": round(
+            mb / (dec_q8_ms / 1e3)),
+    })
     return out
 
 

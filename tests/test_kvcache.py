@@ -82,3 +82,58 @@ def test_windowed_decode_matches_recompute_path():
     baseline = greedy_decode(params, prompt, 8, nocfg)
     # (not guaranteed different for every prompt, but this seed is)
     assert not (full == baseline).all()
+
+
+def test_int8_kv_cache_logits_close_to_bf16_cache():
+    """kv_cache_dtype='int8' halves decode cache bandwidth; the honest
+    numeric claim is LOGIT closeness on the same cache state (~1% of the
+    logit range for per-(token, head) symmetric quantization). Sequence-
+    level agreement is NOT asserted: an untrained random model has
+    near-tie logits, so a single flipped argmax early in a decode
+    cascades — a property of the random weights, not of the cache."""
+    import dataclasses
+
+    from tpushare.workloads.model import (
+        PRESETS, forward_cached, init_kv_cache, init_params)
+
+    base = PRESETS["llama-tiny"]
+    params = init_params(base, jax.random.key(62))
+    tokens = jax.random.randint(jax.random.key(63), (2, 16), 0, base.vocab)
+    lf, _ = forward_cached(params, tokens, init_kv_cache(base, 2, 16),
+                           0, base)
+    q8cfg = dataclasses.replace(base, kv_cache_dtype="int8").validate()
+    l8, _ = forward_cached(params, tokens, init_kv_cache(q8cfg, 2, 16),
+                           0, q8cfg)
+    span = float(lf.max() - lf.min())
+    rel = float(jnp.max(jnp.abs(lf - l8))) / span
+    assert rel < 0.03, f"int8 KV cache logit error {rel:.3f} of range"
+    # most next-token predictions survive (== 1.0 observed on CPU, but a
+    # backend/accumulation-order change can flip a near-tie argmax on a
+    # RANDOM model — requiring perfection here would test the weights,
+    # not the cache)
+    assert float((jnp.argmax(lf, -1) == jnp.argmax(l8, -1)).mean()) >= 0.9
+    # the int8 cache really is int8 (storage claim, not just numerics)
+    cache = init_kv_cache(q8cfg, 2, 32)
+    assert cache["k"].dtype == jnp.int8 and "ks" in cache
+
+
+def test_int8_kv_cache_incremental_matches_prefill():
+    """Chunked prefill + decode through the int8 cache must equal one-
+    shot prefill (quantization is per-token, so chunking cannot change
+    any stored value)."""
+    import dataclasses
+
+    from tpushare.workloads.model import (
+        PRESETS, forward_cached, init_kv_cache, init_params)
+
+    cfg = dataclasses.replace(PRESETS["llama-tiny"],
+                              kv_cache_dtype="int8").validate()
+    params = init_params(cfg, jax.random.key(64))
+    tokens = jax.random.randint(jax.random.key(65), (1, 24), 0, cfg.vocab)
+    one = forward_cached(params, tokens, init_kv_cache(cfg, 1, 24), 0, cfg)
+    cache = init_kv_cache(cfg, 1, 24)
+    l1, cache = forward_cached(params, tokens[:, :10], cache, 0, cfg)
+    l2, cache = forward_cached(params, tokens[:, 10:], cache, 10, cfg)
+    np.testing.assert_allclose(
+        np.asarray(one[0][:, -1]), np.asarray(l2[:, -1]),
+        atol=1e-3, rtol=1e-3)
