@@ -214,11 +214,21 @@ def quantize_int8(params: dict) -> dict:
     return out
 
 
-def _q(w: jax.Array) -> dict:
-    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True) / 127.0
+def _sym_int8(x: jax.Array, axis: int):
+    """Symmetric int8 along ``axis``: (int8 values, fp32 scales with the
+    reduced axis kept). Shared by weight quantization (per output
+    channel, axis=-2) and KV-cache quantization (per token-and-head over
+    head_dim, axis=-1) so the floor/rounding conventions cannot drift."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                    keepdims=True) / 127.0
     scale = jnp.maximum(scale, 1e-8)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
-    return {"int8": q.astype(jnp.int8), "scale": scale.astype(jnp.float32)}
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def _q(w: jax.Array) -> dict:
+    q, scale = _sym_int8(w, axis=-2)
+    return {"int8": q, "scale": scale}
 
 
 def _matmul(x: jax.Array, w) -> jax.Array:
@@ -415,11 +425,7 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict:
 def _kv_quant(x: jax.Array):
     """Per-(token, kv-head) symmetric int8 over the head_dim axis:
     [B, T, n_kv, hd] -> (int8 values, fp32 scales [B, T, n_kv, 1])."""
-    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
-                    keepdims=True) / 127.0
-    scale = jnp.maximum(scale, 1e-8)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
-    return q.astype(jnp.int8), scale
+    return _sym_int8(x, axis=-1)
 
 
 def _qkv(h: jax.Array, lp: dict, positions: jax.Array, cfg: ModelConfig):

@@ -23,6 +23,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--no-kv-cache", action="store_true",
                     help="use the cache-free reference decode path")
+    ap.add_argument("--kv-cache-dtype", choices=["model", "int8"],
+                    default="model",
+                    help="int8 = quantized KV cache (~2x less cache HBM "
+                         "residency per replica on a shared chip)")
+    ap.add_argument("--attn-window", type=int, default=0,
+                    help="sliding-window attention span (0 = full causal)")
+    # (validated below once argparse has run: ap.error gives a usage
+    # message instead of a bare AssertionError from ModelConfig)
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel size (0 = all local devices)")
     args = ap.parse_args(argv)
@@ -30,7 +38,17 @@ def main(argv: list[str] | None = None) -> int:
     from tpushare.workloads.hbm import apply_hbm_gating
     apply_hbm_gating()
 
+    import os
+
     import jax
+
+    # honor an explicit CPU request even when a site hook pinned the
+    # config to a hardware platform before main() ran (the env var alone
+    # is read once at jax import, which predates this call — same guard
+    # as __graft_entry__.dryrun_multichip)
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+            and jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding
     from jax.sharding import PartitionSpec as P
@@ -42,7 +60,12 @@ def main(argv: list[str] | None = None) -> int:
 
     import numpy as np
 
-    cfg = dataclasses.replace(PRESETS[args.preset], attn=args.attn)
+    if args.attn_window < 0:
+        ap.error(f"--attn-window {args.attn_window} must be >= 0")
+    cfg = dataclasses.replace(
+        PRESETS[args.preset], attn=args.attn,
+        kv_cache_dtype=args.kv_cache_dtype,
+        attn_window=args.attn_window or None).validate()
     devices = jax.devices()
     tp = args.tp or len(devices)
     if cfg.moe_experts > 0:
@@ -69,6 +92,13 @@ def main(argv: list[str] | None = None) -> int:
         is_leaf=lambda x: isinstance(x, P))
     params = jax.device_put(params, shardings)
 
+    if args.no_kv_cache and args.kv_cache_dtype == "int8":
+        # same silent-conflict treatment as --attn flash below: the
+        # cache-free decode allocates no KV cache, so the operator's
+        # expected ~2x residency saving would silently not exist
+        print("note: --kv-cache-dtype int8 has no effect with "
+              "--no-kv-cache (the reference decode path allocates no KV "
+              "cache)", flush=True)
     if args.attn == "flash" and not args.no_kv_cache:
         # the KV-cached decode attends single-token queries against the
         # cache with the einsum core; the fused kernel only applies to the
