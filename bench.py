@@ -347,7 +347,7 @@ def packing_duel() -> dict:
     return {"spread": run(False), "prioritize": run(True)}
 
 
-def onchip_tests(timeout_s: float = 900.0) -> dict:
+def onchip_tests(timeout_s: float = 1800.0) -> dict:
     """Run the compiled-kernel correctness suite (tests_tpu/) in its OWN
     subprocess, sequenced before the kernel-timing subprocess — two
     processes cannot hold the TPU at once, so nesting one inside the
@@ -391,7 +391,11 @@ def onchip_tests(timeout_s: float = 900.0) -> dict:
              "-p", "no:cacheprovider"],
             capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return {"status": "error", "summary": "tests_tpu timed out"}
+        return {"status": "error",
+                "summary": f"tests_tpu timed out (> {timeout_s:.0f}s — "
+                           "the suite now compiles ~a dozen distinct "
+                           "Pallas kernels through the remote-compile "
+                           "tunnel)"}
     except OSError as e:
         return {"status": "error", "summary": f"tests_tpu: {e}"}
     tail = ""
