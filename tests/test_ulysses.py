@@ -92,3 +92,24 @@ def test_ulysses_flash_matches_einsum_path():
                                rtol=2e-2, atol=2e-2)
     with pytest.raises(ValueError, match="attn"):
         ulysses_attention(q, k, v, mesh, attn="nope")
+
+
+def test_ulysses_window_matches_reference():
+    """Sequence-parallel + sliding window: the all_to_all re-shard hands
+    each device the FULL sequence, so the window applies unchanged; both
+    local attention backends must match the windowed reference."""
+    from tpushare.workloads.attention import attention_reference
+
+    mesh = _mesh(8)
+    B, H, S, D, W = 2, 8, 128, 16, 40
+    ks = jax.random.split(jax.random.key(90), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.float32)
+    ref = attention_reference(q, k, v, causal=True, window=W)
+    for attn in ("einsum", "flash"):
+        out = jax.jit(lambda q, k, v, a=attn: ulysses_attention(
+            q, k, v, mesh, causal=True, attn=a, window=W))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"attn={attn}")

@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis_name: str, causal: bool, attn: str,
-                   interpret: bool) -> jax.Array:
+                   interpret: bool, window: int | None) -> jax.Array:
     """Per-shard body under shard_map: q/k/v are local [B, H, S/n, D]."""
     # heads scatter, sequence gathers: [B, H, S/n, D] -> [B, H/n, S, D]
     def seq_to_head(x):
@@ -47,7 +47,7 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # matrix (Mosaic on TPU, interpret elsewhere)
         from tpushare.workloads.attention import flash_attention
         o = flash_attention(qh, kh, vh, causal=causal,
-                            interpret=interpret)
+                            interpret=interpret, window=window)
     else:
         # einsum spec path (fp32 softmax, attention_reference numerics)
         d = qh.shape[-1]
@@ -56,6 +56,11 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
         if causal:
             S = qh.shape[2]
             mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+            if window is not None:
+                from tpushare.workloads.attention import sliding_window_mask
+                mask = jnp.logical_and(mask, sliding_window_mask(
+                    jnp.arange(S)[:, None], jnp.arange(S)[None, :],
+                    window))
             s = jnp.where(mask[None, None], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", p,
@@ -69,7 +74,8 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       mesh: jax.sharding.Mesh, axis: str = "sp",
                       causal: bool = True,
-                      attn: str = "einsum") -> jax.Array:
+                      attn: str = "einsum",
+                      window: int | None = None) -> jax.Array:
     """Exact attention over [B, H, S, D] with the sequence sharded on
     ``axis`` via head/sequence all_to_all re-sharding. Requires both
     ``S`` and ``H`` divisible by the axis size (GQA callers expand K/V
@@ -102,7 +108,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(None, None, axis, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=axis, causal=causal,
-                          attn=attn, interpret=interpret),
+                          attn=attn, interpret=interpret, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
