@@ -49,22 +49,12 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
         o = flash_attention(qh, kh, vh, causal=causal,
                             interpret=interpret, window=window)
     else:
-        # einsum spec path (fp32 softmax, attention_reference numerics)
-        d = qh.shape[-1]
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
-                       kh.astype(jnp.float32)) * (d ** -0.5)
-        if causal:
-            S = qh.shape[2]
-            mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-            if window is not None:
-                from tpushare.workloads.attention import sliding_window_mask
-                mask = jnp.logical_and(mask, sliding_window_mask(
-                    jnp.arange(S)[:, None], jnp.arange(S)[None, :],
-                    window))
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p,
-                       vh.astype(jnp.float32)).astype(q.dtype)
+        # the einsum spec path IS attention_reference (per-device plain
+        # arrays under shard_map) — no re-implementation to drift from,
+        # and its causal/window validation comes along for free
+        from tpushare.workloads.attention import attention_reference
+        o = attention_reference(qh, kh, vh, causal=causal,
+                                window=window).astype(q.dtype)
 
     # restore sequence sharding: [B, H/n, S, D] -> [B, H, S/n, D]
     return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
@@ -89,6 +79,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if attn not in ("einsum", "flash"):
         raise ValueError(f"attn must be 'einsum' or 'flash', got {attn!r}")
+    if window is not None:
+        # fail HERE with a usable message, not with NaNs from an all
+        # -masked softmax row inside shard_map
+        if not causal:
+            raise ValueError("window attention requires causal=True")
+        if window < 1:
+            raise ValueError(f"window={window} must be >= 1")
     # Mosaic vs interpret must follow the MESH's platform, not the process
     # default backend: a CPU test mesh in a process whose default backend
     # is TPU (entry() ran on the chip first) would otherwise try to lower
