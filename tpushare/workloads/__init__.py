@@ -14,3 +14,20 @@ JAX programs:
 - :mod:`tpushare.workloads.serve` — the BASELINE config #5 co-located
   int8 serving replica.
 """
+
+
+def honor_cpu_request() -> None:
+    """Flip jax's platform config to CPU when the ENV explicitly asks
+    for it (JAX_PLATFORMS=cpu) but a site hook pinned the config to a
+    hardware platform before user code ran. One definition for every
+    entry point (graft entry, multichip dryrun, tpushare-serve): a
+    wedged TPU tunnel otherwise hangs backend init for runs that never
+    wanted the chip. No-op when the env makes no explicit CPU request,
+    so hardware-targeted runs are unaffected."""
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+            and jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")

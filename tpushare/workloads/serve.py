@@ -38,17 +38,10 @@ def main(argv: list[str] | None = None) -> int:
     from tpushare.workloads.hbm import apply_hbm_gating
     apply_hbm_gating()
 
-    import os
-
     import jax
 
-    # honor an explicit CPU request even when a site hook pinned the
-    # config to a hardware platform before main() ran (the env var alone
-    # is read once at jax import, which predates this call — same guard
-    # as __graft_entry__.dryrun_multichip)
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
-            and jax.config.jax_platforms != "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    from tpushare.workloads import honor_cpu_request
+    honor_cpu_request()
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding
     from jax.sharding import PartitionSpec as P
