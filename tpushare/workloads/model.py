@@ -405,21 +405,43 @@ def make_train_step(cfg: ModelConfig, learning_rate: float = 3e-4,
 
 # -- KV-cache forward (serving path) ------------------------------------------
 
-def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict:
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int,
+                  rolling: bool = False) -> dict:
     """Zeroed per-layer K/V buffers: [L, B, max_len, n_kv, head_dim].
 
     With ``cfg.kv_cache_dtype == "int8"`` the buffers store int8 values
     plus per-(token, kv-head) fp32 scales ("ks"/"vs",
     [L, B, max_len, n_kv, 1]) — ~2x less HBM traffic per decode step.
+
+    ``rolling=True`` (requires ``cfg.attn_window`` and ``max_len >=
+    attn_window``) makes the buffer a RING over slots ``pos % max_len``:
+    cache memory and per-step attention cost become O(window) no matter
+    how long generation runs — the rolling-buffer cache of
+    sliding-window serving (Mistral-style). A "pos" array tracks each
+    slot's global position for masking; a slot is only ever overwritten
+    by a key at least ``max_len >= window`` positions newer, which the
+    window mask had already aged out.
     """
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if rolling:
+        assert cfg.attn_window is not None, \
+            "rolling cache requires cfg.attn_window"
+        assert max_len >= cfg.attn_window, \
+            f"rolling buffer {max_len} < window {cfg.attn_window}: " \
+            "overwritten slots would still be visible"
     if cfg.kv_cache_dtype == "int8":
         sshape = shape[:-1] + (1,)
-        return {"k": jnp.zeros(shape, jnp.int8),
-                "v": jnp.zeros(shape, jnp.int8),
-                "ks": jnp.zeros(sshape, jnp.float32),
-                "vs": jnp.zeros(sshape, jnp.float32)}
-    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+        cache = {"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "ks": jnp.zeros(sshape, jnp.float32),
+                 "vs": jnp.zeros(sshape, jnp.float32)}
+    else:
+        cache = {"k": jnp.zeros(shape, cfg.dtype),
+                 "v": jnp.zeros(shape, cfg.dtype)}
+    if rolling:
+        # slot -> global position of the key it holds (-1 = never written)
+        cache["pos"] = jnp.full((max_len,), -1, jnp.int32)
+    return cache
 
 
 def _kv_quant(x: jax.Array):
@@ -464,25 +486,58 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
     one compiled program serves both prefill (T = prompt len) and decode
     (T = 1). Returns (logits [B, T, vocab], updated cache). Cost per decode
     step is O(max_len) instead of greedy_decode's O(max_len^2) recompute.
+
+    A cache carrying "pos" (``init_kv_cache(rolling=True)``) is a RING:
+    writes land at slot ``pos % M`` and the mask derives from each slot's
+    recorded global position instead of its index, so an O(window)-sized
+    buffer bounds memory and step cost for arbitrarily long generation.
+    Chunk-size contract: T <= M always (a longer chunk overwrites its
+    own keys), and for windowed correctness mid-stream the buffer must
+    retain each query's W-1 older keys across the chunk's writes —
+    i.e. T <= M - (attn_window - 1) once positions >= window exist
+    (greedy_decode_kv's rolling mode sizes M = 2W and chunks by W).
     """
     B, T = tokens.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     reps = nh // nkv
     M = cache["k"].shape[2]
+    rolling = "pos" in cache
+    if rolling:
+        assert T <= M, f"rolling cache: chunk {T} > buffer {M}"
     x = jnp.take(params["embed"], tokens, axis=0)
     q_pos = pos_offset + jnp.arange(T)                       # [T] global
     positions = jnp.broadcast_to(q_pos, (B, T))
-    key_pos = jnp.arange(M)
-    mask = key_pos[None, :] <= q_pos[:, None]                # [T, M]
-    if cfg.attn_window is not None:
-        # the prompt-bounded cache honors the window by masking (the
-        # O(window) MEMORY saving would need a rolling buffer; serving
-        # correctness does not)
-        from tpushare.workloads.attention import sliding_window_mask
+    from tpushare.workloads.attention import sliding_window_mask
+    if rolling:
+        slots = q_pos % M                                    # [T] write ring
+        new_pos = cache["pos"].at[slots].set(q_pos)
+        key_global = new_pos[None, :]                        # [1, M]
+        mask = jnp.logical_and(key_global >= 0,
+                               key_global <= q_pos[:, None])
+        # attn_window is asserted present for rolling caches at init;
+        # masking by the slot's GLOBAL position makes wrap-around safe
         mask = jnp.logical_and(mask, sliding_window_mask(
-            q_pos[:, None], key_pos[None, :], cfg.attn_window))
+            q_pos[:, None], key_global, cfg.attn_window))
+    else:
+        slots = None
+        new_pos = None
+        key_pos = jnp.arange(M)
+        mask = key_pos[None, :] <= q_pos[:, None]            # [T, M]
+        if cfg.attn_window is not None:
+            # the prompt-bounded cache honors the window by masking (the
+            # O(window) MEMORY saving is what rolling=True adds)
+            mask = jnp.logical_and(mask, sliding_window_mask(
+                q_pos[:, None], key_pos[None, :], cfg.attn_window))
 
     int8_cache = cfg.kv_cache_dtype == "int8"
+
+    def write(buf, new):
+        """New tokens into the buffer: ring scatter (rolling) or the
+        contiguous dynamic_update_slice (prompt-bounded)."""
+        if rolling:
+            return buf.at[:, slots].set(new.astype(buf.dtype))
+        return lax.dynamic_update_slice(buf, new.astype(buf.dtype),
+                                        (0, pos_offset, 0, 0))
 
     def layer(x, xs):
         lp, c = xs  # c: this layer's cache slices (dict pytree)
@@ -491,15 +546,8 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
         if int8_cache:
             kq8, ks = _kv_quant(k)
             vq8, vs = _kv_quant(v)
-            c = dict(
-                k=lax.dynamic_update_slice(c["k"], kq8,
-                                           (0, pos_offset, 0, 0)),
-                v=lax.dynamic_update_slice(c["v"], vq8,
-                                           (0, pos_offset, 0, 0)),
-                ks=lax.dynamic_update_slice(c["ks"], ks,
-                                            (0, pos_offset, 0, 0)),
-                vs=lax.dynamic_update_slice(c["vs"], vs,
-                                            (0, pos_offset, 0, 0)))
+            c = dict(k=write(c["k"], kq8), v=write(c["v"], vq8),
+                     ks=write(c["ks"], ks), vs=write(c["vs"], vs))
             # scales factor OUT of both contractions (they are constant
             # over the contracted head_dim axis), so no dequantized
             # [B, M, n_kv, hd] buffer is ever built: the dot operands are
@@ -510,11 +558,7 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
             ks_t = jnp.moveaxis(c["ks"][..., 0], 1, 2)  # [B, n_kv, M]
             vs_t = jnp.moveaxis(c["vs"][..., 0], 1, 2)
         else:
-            c = dict(
-                k=lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
-                                           (0, pos_offset, 0, 0)),
-                v=lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
-                                           (0, pos_offset, 0, 0)))
+            c = dict(k=write(c["k"], k), v=write(c["v"], v))
             kd, vd = c["k"], c["v"]
         # grouped-query attention against the buffer without expanding the
         # cache to n_heads: group axis g = kv head, r = queries per group
@@ -533,14 +577,17 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
         x, _aux = _ffn_block(x, lp, cfg)  # aux only matters in training
         return x, c
 
-    x, new_cache = lax.scan(layer, x, (params["layers"], cache))
+    cache_kv = {n: b for n, b in cache.items() if n != "pos"}
+    x, new_cache = lax.scan(layer, x, (params["layers"], cache_kv))
+    if rolling:
+        new_cache["pos"] = new_pos
     x = _rmsnorm(x, params["final_norm"])
     logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
 
 
 def greedy_decode_kv(params: dict, prompt: jax.Array, steps: int,
-                     cfg: ModelConfig) -> jax.Array:
+                     cfg: ModelConfig, rolling: bool = False) -> jax.Array:
     """KV-cached greedy decoding: one prefill over the prompt, then one
     single-token forward_cached per generated token. Token-for-token
     equivalent to :func:`greedy_decode` at ~S x lower decode-step FLOPs —
@@ -556,14 +603,40 @@ def greedy_decode_kv(params: dict, prompt: jax.Array, steps: int,
     ``cfg.moe_capacity_factor >= n_experts / top_k`` makes every expert big
     enough for all tokens (the shipped MoE presets satisfy this). Tightly
     capacity-bound serving should use this KV path only.
+
+    ``rolling=True`` (requires ``cfg.attn_window``) serves from a ring
+    buffer of ``2 x attn_window`` slots (capped at the sequence length):
+    cache memory and per-step cost stop growing with generation length.
+    The FULL prompt is prefilled in window-sized chunks — skipping early
+    prompt tokens would be wrong even though the window hides them from
+    the final position directly, because the attention receptive field
+    grows by ``window`` per LAYER (position p's layer-2 state depends on
+    layer-1 states at p-W+1.., which depend on keys back to p-2(W-1)).
+    The ring discards old KEYS, never old computation; 2W slots keep
+    every in-chunk query's W-1 older keys alive during the chunk's own
+    writes.
     """
     B, S = prompt.shape
     total = S + steps
     buf = jnp.zeros((B, total), jnp.int32).at[:, :S].set(prompt)
     if steps <= 0:
         return buf
-    cache = init_kv_cache(cfg, B, total)
-    logits, cache = forward_cached(params, prompt, cache, 0, cfg)
+    if rolling:
+        assert cfg.attn_window is not None, \
+            "rolling decode requires cfg.attn_window"
+        W = cfg.attn_window
+        # ring of 2W (chunked-prefill retention), capped at total for
+        # short runs — but never below W itself, which init rejects
+        # (a sub-window ring would let overwrites hide visible keys)
+        cache = init_kv_cache(cfg, B, max(min(2 * W, total), W),
+                              rolling=True)
+        logits = None
+        for off in range(0, S, W):  # python loop: chunks are static
+            logits, cache = forward_cached(
+                params, prompt[:, off:off + W], cache, off, cfg)
+    else:
+        cache = init_kv_cache(cfg, B, total)
+        logits, cache = forward_cached(params, prompt, cache, 0, cfg)
     tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)   # [B]
     buf = buf.at[:, S].set(tok)
 
