@@ -29,6 +29,10 @@ def main(argv: list[str] | None = None) -> int:
                          "residency per replica on a shared chip)")
     ap.add_argument("--attn-window", type=int, default=0,
                     help="sliding-window attention span (0 = full causal)")
+    ap.add_argument("--rolling-kv", action="store_true",
+                    help="ring-buffer KV cache sized by --attn-window: "
+                         "O(window) cache memory regardless of "
+                         "generation length (requires --attn-window)")
     # (validated below once argparse has run: ap.error gives a usage
     # message instead of a bare AssertionError from ModelConfig)
     ap.add_argument("--tp", type=int, default=0,
@@ -55,6 +59,10 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.attn_window < 0:
         ap.error(f"--attn-window {args.attn_window} must be >= 0")
+    if args.rolling_kv and not args.attn_window:
+        ap.error("--rolling-kv requires --attn-window")
+    if args.rolling_kv and args.no_kv_cache:
+        ap.error("--rolling-kv conflicts with --no-kv-cache")
     cfg = dataclasses.replace(
         PRESETS[args.preset], attn=args.attn,
         kv_cache_dtype=args.kv_cache_dtype,
@@ -99,10 +107,12 @@ def main(argv: list[str] | None = None) -> int:
         print("note: --attn flash has no effect on the KV-cached decode "
               "path; pass --no-kv-cache to serve with the fused kernel",
               flush=True)
-    decode_fn = greedy_decode if args.no_kv_cache else greedy_decode_kv
-    decode = jax.jit(
-        lambda p, t, n: decode_fn(p, t, n, cfg),
-        static_argnums=2)
+    if args.no_kv_cache:
+        decode_fn = lambda p, t, n: greedy_decode(p, t, n, cfg)
+    else:
+        decode_fn = lambda p, t, n: greedy_decode_kv(
+            p, t, n, cfg, rolling=args.rolling_kv)
+    decode = jax.jit(decode_fn, static_argnums=2)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
