@@ -91,7 +91,7 @@ def test_ring_rejects_indivisible_seq():
 def test_ring_rejects_mismatched_kv():
     mesh = sp_mesh()
     q, k, v = rand_qkv(jax.random.key(6), S=128)
-    with pytest.raises(ValueError, match="must match"):
+    with pytest.raises(ValueError, match="must share"):
         ring_attention(q, k[:, :, :64], v[:, :, :64], mesh)
 
 
@@ -164,3 +164,37 @@ def test_zigzag_rejects_odd_chunk():
     q, k, v = rand_qkv(jax.random.key(13), B=1, H=1, S=S, D=8)
     with pytest.raises(ValueError, match="zigzag"):
         ring_attention(q, k, v, mesh, causal=True, zigzag=True)
+
+
+def test_ring_gqa_native_matches_expanded_reference():
+    """GQA-native ring: k/v carry the SMALL head count through the ring
+    (1/G of the ppermute bytes per hop) and must match the reference on
+    expanded heads — causal, zigzag, and non-causal."""
+    from tpushare.workloads.attention import attention_reference
+    from tpushare.workloads.ringattention import zigzag_inverse, zigzag_order
+
+    mesh = sp_mesh()
+    B, H, Hkv, S, D = 2, 8, 2, 128, 16
+    ks = jax.random.split(jax.random.key(40), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+    g = H // Hkv
+    kx, vx = jnp.repeat(k, g, 1), jnp.repeat(v, g, 1)
+
+    for causal in (True, False):
+        ref = attention_reference(q, kx, vx, causal=causal)
+        out = jax.jit(lambda q, k, v, c=causal: ring_attention(
+            q, k, v, mesh, causal=c))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"causal={causal}")
+
+    n = mesh.shape["sp"]
+    perm, inv = zigzag_order(S, n), zigzag_inverse(S, n)
+    ref = attention_reference(q, kx, vx, causal=True)
+    out_z = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh, causal=True, zigzag=True))(
+        q[:, :, perm], k[:, :, perm], v[:, :, perm])
+    np.testing.assert_allclose(np.asarray(out_z[:, :, inv]),
+                               np.asarray(ref), atol=2e-5, rtol=2e-5)

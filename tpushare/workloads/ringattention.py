@@ -66,24 +66,29 @@ def _ring_body(carry, step, *, axis_name: str, n: int, my: jax.Array,
 
     def fold(operand, masked: bool):
         m, l, acc = operand
+        # GQA-native: qs is [B, Hkv, G, Sq, D] while the ring-resident
+        # kb/vb stay [B, Hkv, Sk, D] — each kv head's chunk serves its
+        # whole query group, so ppermute moves 1/G of the pre-expanded
+        # bytes per hop (the entire ICI win of GQA at the ring level)
         s = jax.lax.dot_general(
-            qs, kb, (((3,), (3,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32)        # [B, H, Sq, Sk]
+            qs, kb, (((4,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)     # [B, Hkv, G, Sq, Sk]
         if masked:
             mask = k_pos[None, :] <= q_pos[:, None]    # [Sq, Sk]
-            s = jnp.where(mask[None, None], s, -jnp.inf)
+            s = jnp.where(mask[None, None, None], s, -jnp.inf)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # rows with no visible key yet carry m = -inf; clamp the shift so
         # exp(-inf - -inf) never produces NaN
         shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        # no p re-mask: masked scores are -inf and exp(-inf - shift) is
+        # exactly 0 for the clamped-finite shift (the same redundant
+        # [Sq, Sk] VPU pass the Pallas kernel dropped in r3)
         p = jnp.exp(s - shift)
-        if masked:
-            p = jnp.where(mask[None, None], p, 0.0)
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((3,), (2,)), ((0, 1), (0, 1))),
+            p.astype(vb.dtype), vb, (((4,), (2,)), ((0, 1), (0, 1))),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -117,25 +122,31 @@ def _ring_body(carry, step, *, axis_name: str, n: int, my: jax.Array,
 def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
                           axis_name: str, causal: bool,
                           zigzag: bool) -> jax.Array:
-    """Per-shard body (runs under shard_map): q, k, v are the local
-    [B, H, S/n, D] chunks, in ring order (contiguous or zigzag)."""
+    """Per-shard body (runs under shard_map): q is the local
+    [B, H, S/n, D] chunk, k/v are [B, H_kv, S/n, D] (H_kv dividing H —
+    GQA-native, never expanded), in ring order (contiguous or zigzag)."""
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, H, sq, d = q.shape
-    # scale folded into q off the [Sq, Sk] score path, storage dtype kept
+    Hkv = k.shape[1]
+    G = H // Hkv
+    # scale folded into q off the [Sq, Sk] score path, storage dtype
+    # kept; grouped view so kv heads batch against their query groups
     qs = (q.astype(jnp.float32) * (d ** -0.5)).astype(q.dtype)
+    qs = qs.reshape(B, Hkv, G, sq, d)
     q_pos = _chunk_positions(my, sq, n, zigzag)
 
-    m = jnp.full((B, H, sq, 1), -jnp.inf, jnp.float32)
-    l = jnp.zeros((B, H, sq, 1), jnp.float32)
-    acc = jnp.zeros((B, H, sq, d), jnp.float32)
+    m = jnp.full((B, Hkv, G, sq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, Hkv, G, sq, 1), jnp.float32)
+    acc = jnp.zeros((B, Hkv, G, sq, d), jnp.float32)
 
     body = functools.partial(_ring_body, axis_name=axis_name, n=n, my=my,
                              qs=qs, q_pos=q_pos, causal=causal,
                              zigzag=zigzag)
     (m, l, acc, _, _), _ = lax.scan(body, (m, l, acc, k, v),
                                     jnp.arange(n))
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(B, H, sq, d).astype(q.dtype)
 
 
 def zigzag_order(S: int, n: int):
@@ -182,10 +193,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if zigzag and (S // n) % 2:
         raise ValueError(
             f"zigzag needs an even per-rank chunk (S/n = {S // n})")
-    if k.shape != q.shape or v.shape != q.shape:
+    Hkv = k.shape[1] if k.ndim == 4 else -1
+    if (k.ndim != 4 or v.shape != k.shape or Hkv <= 0 or H % Hkv
+            or k.shape != (B, Hkv, S, D)):
         raise ValueError(
-            f"q {q.shape} / k {k.shape} / v {v.shape} must match "
-            "(GQA heads pre-expanded; causal ring needs equal q/kv lengths)")
+            f"q {q.shape} / k {k.shape} / v {v.shape} must share "
+            "batch/seq/head_dim with kv heads dividing query heads "
+            "(GQA-native: pass the SMALL kv heads — the ring then moves "
+            "1/G of the bytes per hop; causal ring needs equal q/kv "
+            "lengths)")
     spec = P(None, None, axis, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis,
