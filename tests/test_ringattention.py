@@ -91,7 +91,7 @@ def test_ring_rejects_indivisible_seq():
 def test_ring_rejects_mismatched_kv():
     mesh = sp_mesh()
     q, k, v = rand_qkv(jax.random.key(6), S=128)
-    with pytest.raises(ValueError, match="must share"):
+    with pytest.raises(ValueError, match="equal q/kv lengths"):
         ring_attention(q, k[:, :, :64], v[:, :, :64], mesh)
 
 

@@ -113,3 +113,36 @@ def test_ulysses_window_matches_reference():
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5,
                                    err_msg=f"attn={attn}")
+
+
+def test_ulysses_gqa_native_matches_expanded_reference():
+    """GQA-native Ulysses: the kv all_to_all moves the SMALL heads (1/G
+    of the expanded bytes) and the per-device head blocks align exactly;
+    both local backends must match the expanded-head reference."""
+    from tpushare.workloads.attention import attention_reference
+
+    mesh = _mesh(8)
+    B, H, Hkv, S, D = 2, 16, 8, 128, 16
+    ks = jax.random.split(jax.random.key(95), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+    g = H // Hkv
+    ref = attention_reference(q, jnp.repeat(k, g, 1), jnp.repeat(v, g, 1),
+                              causal=True)
+    for attn in ("einsum", "flash"):
+        out = jax.jit(lambda q, k, v, a=attn: ulysses_attention(
+            q, k, v, mesh, causal=True, attn=a))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"attn={attn}")
+
+
+def test_ulysses_rejects_scarce_kv_heads():
+    mesh = _mesh(8)
+    ks = jax.random.split(jax.random.key(96), 3)
+    q = jax.random.normal(ks[0], (1, 8, 64, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 2, 64, 16), jnp.float32)  # 2 % 8 != 0
+    v = jnp.zeros_like(k)
+    with pytest.raises(ValueError, match="kv heads not divisible"):
+        ulysses_attention(q, k, v, mesh)

@@ -54,6 +54,24 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_KV = 1024
 
 
+def validate_gqa_qkv(q, k, v, extra: str = "") -> int:
+    """THE GQA layout contract, shared by every attention frontend
+    (flash kernel, ring, Ulysses): q [B, H, S, D]; k/v [B, H_kv, S_kv, D]
+    with H_kv dividing H — pass the SMALL kv heads, never pre-expanded.
+    Returns H_kv. One definition so the predicate algebra cannot drift
+    across modules."""
+    B, H, S, D = q.shape
+    Hkv = k.shape[1] if k.ndim == 4 else -1
+    if (k.ndim != 4 or v.shape != k.shape or Hkv <= 0 or H % Hkv
+            or k.shape != (B, Hkv, k.shape[2], D)):
+        raise ValueError(
+            f"q {q.shape} / k {k.shape} / v {v.shape} must share batch "
+            "and head_dim, with kv heads dividing query heads "
+            "(GQA-native: pass the SMALL kv heads, do not pre-expand)"
+            + (f"; {extra}" if extra else ""))
+    return Hkv
+
+
 def sliding_window_mask(row_pos, col_pos, window: int):
     """THE window-visibility predicate: key ``col_pos`` is visible from
     query ``row_pos`` iff ``col_pos >= row_pos - (window - 1)`` (W keys
@@ -797,13 +815,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     apply the same floor skip and mask.
     """
     B, H, S, D = q.shape
-    Hkv = k.shape[1] if k.ndim == 4 else -1
-    if k.ndim != 4 or k.shape != (B, Hkv, k.shape[2], D) \
-            or v.shape != k.shape or Hkv <= 0 or H % Hkv:
-        raise ValueError(
-            f"q {q.shape} / k {k.shape} / v {v.shape} must share batch and "
-            "head_dim, with kv heads dividing query heads (GQA-native: "
-            "pass the SMALL kv heads, do not pre-expand)")
+    validate_gqa_qkv(q, k, v)
     if D > BLOCK:
         raise ValueError(f"head_dim {D} > {BLOCK} unsupported")
     if causal and k.shape[2] != S:

@@ -193,15 +193,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if zigzag and (S // n) % 2:
         raise ValueError(
             f"zigzag needs an even per-rank chunk (S/n = {S // n})")
-    Hkv = k.shape[1] if k.ndim == 4 else -1
-    if (k.ndim != 4 or v.shape != k.shape or Hkv <= 0 or H % Hkv
-            or k.shape != (B, Hkv, S, D)):
+    from tpushare.workloads.attention import validate_gqa_qkv
+    validate_gqa_qkv(q, k, v, extra="the ring moves 1/G of the bytes "
+                                    "per hop with the small kv heads")
+    if k.shape[2] != S:
         raise ValueError(
-            f"q {q.shape} / k {k.shape} / v {v.shape} must share "
-            "batch/seq/head_dim with kv heads dividing query heads "
-            "(GQA-native: pass the SMALL kv heads — the ring then moves "
-            "1/G of the bytes per hop; causal ring needs equal q/kv "
-            "lengths)")
+            f"ring attention needs equal q/kv lengths, got {S} vs "
+            f"{k.shape[2]}")
     spec = P(None, None, axis, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis,

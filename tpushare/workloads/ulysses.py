@@ -32,7 +32,12 @@ from jax.sharding import PartitionSpec as P
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis_name: str, causal: bool, attn: str,
                    interpret: bool, window: int | None) -> jax.Array:
-    """Per-shard body under shard_map: q/k/v are local [B, H, S/n, D]."""
+    """Per-shard body under shard_map: q is local [B, H, S/n, D], k/v
+    are [B, H_kv, S/n, D] (GQA-native — the kv all_to_all moves 1/G of
+    the expanded bytes, and head-block alignment works out exactly:
+    device d's query-head block [d*H/n, (d+1)*H/n) needs kv heads
+    [d*Hkv/n, (d+1)*Hkv/n), which is precisely the block its kv
+    all_to_all delivers, because (H/n)/G == Hkv/n)."""
     # heads scatter, sequence gathers: [B, H, S/n, D] -> [B, H/n, S, D]
     def seq_to_head(x):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
@@ -43,16 +48,22 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if attn == "flash":
         # the sequence is FULL per device after the all_to_all, so the
         # fused Pallas kernel applies unchanged to the local head subset
-        # — O(block) residency instead of this path's [S, S] fp32 score
-        # matrix (Mosaic on TPU, interpret elsewhere)
+        # (GQA streamed natively) — O(block) residency instead of this
+        # path's [S, S] fp32 score matrix (Mosaic on TPU, interpret
+        # elsewhere)
         from tpushare.workloads.attention import flash_attention
         o = flash_attention(qh, kh, vh, causal=causal,
                             interpret=interpret, window=window)
     else:
         # the einsum spec path IS attention_reference (per-device plain
         # arrays under shard_map) — no re-implementation to drift from,
-        # and its causal/window validation comes along for free
+        # and its causal/window validation comes along for free. The
+        # reference wants equal heads, so GQA expands LOCALLY (the wire
+        # already moved only the small heads)
         from tpushare.workloads.attention import attention_reference
+        g = qh.shape[1] // kh.shape[1]
+        if g > 1:
+            kh, vh = jnp.repeat(kh, g, 1), jnp.repeat(vh, g, 1)
         o = attention_reference(qh, kh, vh, causal=causal,
                                 window=window).astype(q.dtype)
 
@@ -67,9 +78,11 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       attn: str = "einsum",
                       window: int | None = None) -> jax.Array:
     """Exact attention over [B, H, S, D] with the sequence sharded on
-    ``axis`` via head/sequence all_to_all re-sharding. Requires both
-    ``S`` and ``H`` divisible by the axis size (GQA callers expand K/V
-    heads first, as with ring attention). Jit-compatible; composes with
+    ``axis`` via head/sequence all_to_all re-sharding. Requires ``S``,
+    ``H``, and ``H_kv`` divisible by the axis size. GQA-NATIVE: pass the
+    SMALL kv heads — their all_to_all moves 1/G of the pre-expanded
+    bytes, and device d's query-head block aligns exactly with the kv
+    block its all_to_all delivers. Jit-compatible; composes with
     outer dp/tp shardings.
 
     ``attn="flash"`` runs the fused Pallas kernel on each device's full-
@@ -99,9 +112,17 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"{H} heads not divisible by {axis} size {n}; use ring "
             "attention when heads are scarcer than shards")
-    if k.shape != q.shape or v.shape != q.shape:
+    from tpushare.workloads.attention import validate_gqa_qkv
+    Hkv = validate_gqa_qkv(q, k, v)
+    if k.shape[2] != S:
         raise ValueError(
-            f"q {q.shape} / k {k.shape} / v {v.shape} must match")
+            f"ulysses attention needs equal q/kv lengths, got {S} vs "
+            f"{k.shape[2]}")
+    if Hkv % n:
+        raise ValueError(
+            f"{Hkv} kv heads not divisible by {axis} size {n}; expand "
+            "K/V heads first (or use ring attention) when kv heads are "
+            "scarcer than shards")
     spec = P(None, None, axis, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=axis, causal=causal,
