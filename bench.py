@@ -389,7 +389,8 @@ def onchip_tests(timeout_s: float = 1800.0) -> dict:
         t = subprocess.run(
             [sys.executable, "-m", "pytest", suite, "-q", "--no-header",
              "-p", "no:cacheprovider"],
-            capture_output=True, text=True, timeout=timeout_s)
+            capture_output=True, text=True, timeout=timeout_s,
+            env={**os.environ, "TPUSHARE_BACKEND_PROBED": "1"})
     except subprocess.TimeoutExpired:
         return {"status": "error",
                 "summary": f"tests_tpu timed out (> {timeout_s:.0f}s — "

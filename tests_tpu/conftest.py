@@ -11,6 +11,50 @@ skips cleanly when no TPU backend is present.
 """
 
 import os
+import subprocess
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _probe_backend() -> None:
+    """Fail FAST when the TPU tunnel is wedged instead of hanging.
+
+    The test modules' skipif marks call jax.default_backend() at import,
+    which initializes the backend IN-PROCESS — on this rig a wedged
+    single-client tunnel (see docs/perf.md caveat) makes that init block
+    forever, so any pytest invocation that collects this tree would hang
+    with no diagnosis. Probe backend init in a SUBPROCESS with a timeout
+    FIRST; if it doesn't come up, abort with the diagnosis. Runs at
+    conftest import (not a collection hook) so directory-recursion entry
+    paths are covered too. bench.py's kernel runner performs the same
+    probe before invoking pytest and sets TPUSHARE_BACKEND_PROBED so the
+    init cost isn't paid twice per bench run.
+    """
+    if os.environ.get("TPUSHARE_BACKEND_PROBED"):
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        pytest.exit("jax backend init hung >120s — TPU tunnel wedged? "
+                    "(docs/perf.md caveat; tests_tpu needs a healthy "
+                    "backend or none at all to skip cleanly)",
+                    returncode=3)
+    except OSError as e:
+        pytest.exit(f"backend probe could not launch: {e}", returncode=3)
+    if probe.returncode != 0:
+        tail = "no error output"
+        for stream in (probe.stderr, probe.stdout):
+            lines = (stream or "").strip().splitlines()
+            if lines:
+                tail = lines[-1][:200]
+                break
+        pytest.exit(f"jax backend init failed: {tail}", returncode=3)
+
+
+_probe_backend()
