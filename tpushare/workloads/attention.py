@@ -33,11 +33,39 @@ padded QUERY rows are sliced off on return.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
 BLOCK = 128  # minimum q/k block edge (MXU-aligned; bf16 min tile is (16, 128))
+
+_FLASH_BWD_IMPLS = ("xla", "pallas")
+
+
+def _resolve_flash_bwd(bwd_impl: str | None) -> str:
+    """Resolve the backward implementation OUTSIDE any trace.
+
+    ``None`` reads TPUSHARE_FLASH_BWD when ``flash_attention`` itself
+    runs, and the resolved string travels into the custom_vjp as a
+    nondiff argument — i.e. it is part of ``flash_attention``'s own jit
+    cache key, so an eager caller that flips the env (or passes
+    ``bwd_impl=``) deterministically retraces rather than silently
+    reusing a previously cached backward (the hazard of reading the env
+    at trace time inside ``_flash_bwd``). Inside an OUTER jit the
+    resolution necessarily happens at that outer trace time and is NOT
+    part of the outer cache key — callers holding a jitted train step
+    across an env flip must rebuild it (or pass ``bwd_impl``
+    explicitly); standard jit closure semantics, now confined to the
+    caller's own jit instead of a process-global VJP cache.
+    """
+    if bwd_impl is None:
+        bwd_impl = os.environ.get("TPUSHARE_FLASH_BWD", "xla")
+    if bwd_impl not in _FLASH_BWD_IMPLS:
+        raise ValueError(
+            f"bwd_impl={bwd_impl!r} (or $TPUSHARE_FLASH_BWD) must be one "
+            f"of {_FLASH_BWD_IMPLS}")
+    return bwd_impl
 
 # Default tile sizes for the compiled TPU path. The grid-step count is
 # (B*H*Sq/block_q*Skv/block_kv); at 128x128 a 4x8x2048 shape needs 8192
@@ -672,35 +700,32 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
     return dq, dk[:, :, :kvlen], dv[:, :, :kvlen]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, interpret, block_q, block_kv, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, interpret, block_q, block_kv, window, bwd_impl):
     out, _ = _flash_call(q, k, v, causal, interpret, block_q, block_kv,
                          window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, interpret, block_q, block_kv, window):
+def _flash_fwd(q, k, v, causal, interpret, block_q, block_kv, window,
+               bwd_impl):
     out, lse = _flash_call(q, k, v, causal, interpret, block_q, block_kv,
                            window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, interpret, block_q, block_kv, window, res, do):
-    """Backward dispatch. TPUSHARE_FLASH_BWD=pallas selects the Pallas
-    kernel pair on compiled TPU paths (causal block skip + bf16 MXU +
-    GQA-native grouped dkdv grid; its algorithm is parity-proven in
-    interpret mode and the bench A/Bs it directly); the default remains
-    the XLA blockwise scan until the Pallas pair's MOSAIC COMPILATION is
-    validated on real hardware — dispatching an uncompiled-anywhere
-    kernel by default would put every training run behind an unverified
-    compile. Interpret mode always uses the XLA path (Pallas interpret
+def _flash_bwd(causal, interpret, block_q, block_kv, window, bwd_impl,
+               res, do):
+    """Backward dispatch. ``bwd_impl`` ("xla" | "pallas") arrives as a
+    nondiff argument resolved by :func:`_resolve_flash_bwd` at call time,
+    so the selected backward is deterministic per trace — no cached-vjp
+    hazard from reading the env here. "pallas" selects the kernel pair on
+    compiled TPU paths (causal block skip + bf16 MXU + GQA-native grouped
+    dkdv grid). Interpret mode always uses the XLA path (Pallas interpret
     of 4-matmul kernels is far slower than XLA on CPU test meshes).
     """
-    import os
-
     q, k, v, out, lse = res
-    if (not interpret
-            and os.environ.get("TPUSHARE_FLASH_BWD", "xla") == "pallas"):
+    if not interpret and bwd_impl == "pallas":
         # backward tiles are chosen independently of the forward's
         # (block_q/block_kv args tune the FORWARD; see DEFAULT_BWD_*).
         # GQA (grouped dkdv grid — no K/V expansion) and sliding-window
@@ -789,15 +814,13 @@ def _flash_bwd_xla(causal, res, do, window: int | None = None):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "interpret",
-                                             "block_q", "block_kv",
-                                             "window"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     interpret: bool | None = None,
                     block_q: int | None = None,
                     block_kv: int | None = None,
-                    window: int | None = None) -> jax.Array:
+                    window: int | None = None,
+                    bwd_impl: str | None = None) -> jax.Array:
     """Fused attention over [B, H, S, D] queries; k/v may carry fewer
     (GQA) heads — H_kv must divide H and is streamed, never expanded.
 
@@ -813,6 +836,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     O(W) regardless of sequence length (Mistral-style long-context
     serving); both backward paths (XLA scan and the opt-in Pallas pair)
     apply the same floor skip and mask.
+
+    ``bwd_impl``: "xla" (blockwise scan) or "pallas" (kernel pair);
+    ``None`` reads $TPUSHARE_FLASH_BWD when this function runs — part of
+    its jit cache key for eager callers; under an outer jit the usual
+    trace-time-closure caveat applies (see :func:`_resolve_flash_bwd`).
     """
     B, H, S, D = q.shape
     validate_gqa_qkv(q, k, v)
@@ -832,5 +860,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             raise ValueError(f"window={window} must be >= 1")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, bool(causal), bool(interpret), block_q, block_kv,
-                  window)
+    # bwd_impl is resolved HERE, outside the jit boundary below, so an
+    # env-default resolution happens per call in plain Python and the
+    # resolved string is a static argument of the jit cache key.
+    return _flash_attention_jit(q, k, v, bool(causal), bool(interpret),
+                                block_q, block_kv, window,
+                                _resolve_flash_bwd(bwd_impl))
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention_jit(q, k, v, causal, interpret, block_q, block_kv,
+                         window, bwd_impl):
+    return _flash(q, k, v, causal, interpret, block_q, block_kv,
+                  window, bwd_impl)

@@ -504,6 +504,26 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
     rolling = "pos" in cache
     if rolling:
         assert T <= M, f"rolling cache: chunk {T} > buffer {M}"
+        # Mid-stream windowed correctness needs each in-chunk query's
+        # W-1 older keys to survive the chunk's own ring writes, i.e.
+        # T <= M - (W-1). The over-wide exception is a prefill from
+        # GLOBAL position 0 (nothing older is live), only checkable when
+        # pos_offset is a concrete (untraced) zero. greedy_decode_kv's
+        # long-run sizing (M = 2W, chunks of W) satisfies the strict
+        # bound, but its short runs cap M at the total sequence length
+        # (see its `max(min(2*W, total), W)`) and then the first prefill
+        # chunk legitimately takes this concrete-zero branch — it is
+        # load-bearing, not merely an escape hatch.
+        W = cfg.attn_window
+        if W is not None and T > M - (W - 1):
+            concrete_zero = (
+                not isinstance(pos_offset, jax.core.Tracer)
+                and int(pos_offset) == 0)
+            assert concrete_zero, (
+                f"rolling cache: chunk T={T} > M-(W-1)={M - (W - 1)} "
+                f"overwrites keys still inside an in-chunk query's "
+                f"window mid-stream; chunk by <= {M - (W - 1)} (or "
+                f"prefill from a concrete pos_offset=0 with T <= M)")
     x = jnp.take(params["embed"], tokens, axis=0)
     q_pos = pos_offset + jnp.arange(T)                       # [T] global
     positions = jnp.broadcast_to(q_pos, (B, T))
