@@ -515,15 +515,18 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
         # chunk legitimately takes this concrete-zero branch — it is
         # load-bearing, not merely an escape hatch.
         W = cfg.attn_window
-        if W is not None and T > M - (W - 1):
-            concrete_zero = (
-                not isinstance(pos_offset, jax.core.Tracer)
-                and int(pos_offset) == 0)
-            assert concrete_zero, (
+        if (W is not None and T > M - (W - 1)
+                and not isinstance(pos_offset, jax.core.Tracer)):
+            # Enforceable only for a CONCRETE pos_offset: an over-wide
+            # chunk is legal exactly when it prefills from global 0,
+            # and a traced offset could be that 0 — asserting on it
+            # would reject previously-valid jitted prefills, so traced
+            # callers keep the documented contract on trust.
+            assert int(pos_offset) == 0, (
                 f"rolling cache: chunk T={T} > M-(W-1)={M - (W - 1)} "
                 f"overwrites keys still inside an in-chunk query's "
                 f"window mid-stream; chunk by <= {M - (W - 1)} (or "
-                f"prefill from a concrete pos_offset=0 with T <= M)")
+                f"prefill from pos_offset=0 with T <= M)")
     x = jnp.take(params["embed"], tokens, axis=0)
     q_pos = pos_offset + jnp.arange(T)                       # [T] global
     positions = jnp.broadcast_to(q_pos, (B, T))
