@@ -89,6 +89,18 @@ def main(argv: list[str] | None = None) -> int:
     log.info("cache built: %d pods replayed", replayed)
     controller.start()
 
+    # The replayed cache (and everything imported above it) is the
+    # process's permanent heap. Move it out of the cyclic collector's
+    # view: gen-2 sweeps otherwise walk the whole cache and were
+    # measured at >100 ms on a bench-sized fleet — long enough to blow a
+    # single bind's latency from 8 ms to ~70 ms when a collection lands
+    # mid-request (the r3 ha_p99 tail; docs/perf.md "HA p99"). The
+    # standard big-static-heap pattern: collect what's garbage now,
+    # freeze the survivors.
+    import gc
+    gc.collect()
+    gc.freeze()
+
     elector = None
     if args.ha:
         import socket as socketlib

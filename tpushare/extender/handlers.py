@@ -488,3 +488,7 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
     registry.gauge_func(
         "tpushare_node_hbm", "Per-node HBM utilization %% and fragmentation",
         per_node)
+
+    from tpushare.cache.nodeinfo import CLAIM_CAS_RETRIES
+
+    registry.register(CLAIM_CAS_RETRIES)
