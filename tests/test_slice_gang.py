@@ -1,0 +1,352 @@
+"""Multi-host slice (gang) placement kernel (docs/designs/multihost-gang.md).
+
+A v5e-16 is 4 hosts x (2x2) chips in one 4x4 ICI mesh; these tests pin
+the slice model (host boxes tile the mesh, local<->global id mapping),
+the gang selector's policy (compact shapes first; fewest hosts, then
+tightest binpack), the all-or-nothing eligibility semantics, and a
+policy duel showing why slice-awareness matters (the reference cannot
+express any of this: its allocator stops at one node,
+nodeinfo.go:312-363).
+"""
+
+import itertools
+
+import pytest
+
+from tpushare.core.chips import ChipView
+from tpushare.core.placement import PlacementRequest
+from tpushare.core.slice import (
+    GangPlacement,
+    HostBox,
+    SliceTopology,
+    fits_gang,
+    select_gang,
+)
+from tpushare.core.topology import MeshTopology
+
+HOSTS = ["h0", "h1", "h2", "h3"]
+
+
+def v5e16() -> SliceTopology:
+    return SliceTopology.from_host_grid((2, 2), (2, 2), HOSTS)
+
+
+def host_views(slice_topo, used=None, unhealthy=(), hbm=16000):
+    """Fresh per-host local snapshots; ``used`` maps (host, local_idx)
+    -> used MiB, ``unhealthy`` is a set of (host, local_idx)."""
+    used = used or {}
+    views = {}
+    for host, hb in slice_topo.hosts.items():
+        local = MeshTopology(hb.shape)
+        views[host] = [
+            ChipView(i, local.coords(i), hbm,
+                     used.get((host, i), 0),
+                     healthy=(host, i) not in unhealthy)
+            for i in range(local.num_chips)
+        ]
+    return views
+
+
+# -- topology model ---------------------------------------------------------
+
+def test_host_grid_construction_tiles_the_mesh():
+    st = v5e16()
+    assert st.mesh.shape == (4, 4)
+    assert st.hosts["h0"].origin == (0, 0)
+    assert st.hosts["h1"].origin == (0, 2)
+    assert st.hosts["h2"].origin == (2, 0)
+    assert st.hosts["h3"].origin == (2, 2)
+    # every global coordinate maps to exactly one host
+    owners = {st.host_of((r, c)) for r in range(4) for c in range(4)}
+    assert owners == set(HOSTS)
+
+
+def test_overlapping_host_boxes_rejected():
+    mesh = MeshTopology((2, 2))
+    with pytest.raises(ValueError, match="overlap"):
+        SliceTopology(mesh, {"a": HostBox((0, 0), (2, 2)),
+                             "b": HostBox((0, 0), (1, 1))})
+
+
+def test_partial_tiling_rejected():
+    mesh = MeshTopology((2, 2))
+    with pytest.raises(ValueError, match="tile"):
+        SliceTopology(mesh, {"a": HostBox((0, 0), (1, 2))})
+
+
+def test_local_global_round_trip():
+    st = v5e16()
+    for host, hb in st.hosts.items():
+        local = st.local_topology(host)
+        for i in range(local.num_chips):
+            g = tuple(o + c for o, c in zip(hb.origin, local.coords(i)))
+            assert st.host_of(g) == host
+            assert st.to_local(host, g) == local.coords(i)
+
+
+# -- gang selection ---------------------------------------------------------
+
+def test_single_host_gang_prefers_one_host():
+    st = v5e16()
+    # a 2x2 fits entirely inside any host box; the selector must not
+    # straddle hosts when it can avoid it
+    gp = select_gang(st, host_views(st), PlacementRequest(
+        hbm_mib=8000, chip_count=4))
+    assert gp is not None
+    assert gp.box == (2, 2)
+    assert gp.hosts_spanned == 1
+    (host, p), = gp.per_host.items()
+    assert p.chip_ids == (0, 1, 2, 3)  # the whole host box, local ids
+    assert p.box == (2, 2) and p.origin == (0, 0)
+
+
+def test_cross_host_gang_2x4_spans_exactly_two_hosts():
+    st = v5e16()
+    gp = select_gang(st, host_views(st), PlacementRequest(
+        hbm_mib=8000, chip_count=8, topology=(2, 4)))
+    assert gp is not None
+    assert gp.hosts_spanned == 2
+    # each host contributes its full 2x2 box, in local numbering
+    for p in gp.per_host.values():
+        assert p.box == (2, 2)
+        assert p.chip_ids == (0, 1, 2, 3)
+
+
+def test_full_slice_gang_takes_all_four_hosts():
+    st = v5e16()
+    gp = select_gang(st, host_views(st), PlacementRequest(
+        hbm_mib=0, chip_count=16))  # exclusive whole-slice
+    assert gp is not None
+    assert gp.box == (4, 4)
+    assert gp.hosts_spanned == 4
+    assert sum(len(p.chip_ids) for p in gp.per_host.values()) == 16
+
+
+def test_all_or_nothing_one_busy_chip_moves_the_box():
+    st = v5e16()
+    # h0 local chip 3 busy -> the 2x2 must land on another host
+    views = host_views(st, used={("h0", 3): 16000})
+    gp = select_gang(st, views, PlacementRequest(hbm_mib=16000,
+                                                 chip_count=4))
+    assert gp is not None
+    assert gp.hosts_spanned == 1
+    assert "h0" not in gp.per_host
+
+
+def test_shape_degrades_like_single_host_selector():
+    st = v5e16()
+    # one chip busy on EVERY host (the four host-box corners at the
+    # mesh's own corners + centers) blocks every 2x2 — but a fully-free
+    # 1x4 row remains, and the selector degrades to it exactly like
+    # select_chips_py does when the compact class is empty
+    views = host_views(st, used={(h, 0): 16000 for h in HOSTS})
+    gp = select_gang(st, views, PlacementRequest(
+        hbm_mib=16000, chip_count=4))
+    assert gp is not None
+    assert gp.box in ((1, 4), (4, 1))
+
+
+def test_all_or_nothing_no_fit_returns_none():
+    st = v5e16()
+    # pinned 2x2 (a sub-slice job): one busy chip per host kills every
+    # 2x2 position on the 4x4 mesh -> all-or-nothing refusal
+    views = host_views(st, used={(h, 0): 16000 for h in HOSTS})
+    req = PlacementRequest(hbm_mib=16000, chip_count=4, topology=(2, 2))
+    assert select_gang(st, views, req) is None
+    assert not fits_gang(st, views, req)
+
+
+def test_unhealthy_chip_blocks_its_boxes():
+    st = v5e16()
+    # a single unhealthy chip: no returned placement may contain it
+    views = host_views(st, unhealthy={("h0", 0)})
+    gp = select_gang(st, views, PlacementRequest(
+        hbm_mib=1000, chip_count=4, topology=(2, 2)))
+    assert gp is not None
+    assert "h0" not in gp.per_host or 0 not in gp.per_host["h0"].chip_ids
+    # and a slice with every chip unhealthy places nothing
+    all_sick = host_views(st, unhealthy={(h, i)
+                                         for h in HOSTS for i in range(4)})
+    assert select_gang(st, all_sick, PlacementRequest(
+        hbm_mib=1000, chip_count=4)) is None
+
+
+def test_missing_host_snapshot_degrades_not_crashes():
+    st = v5e16()
+    views = host_views(st)
+    del views["h3"]  # host down / unreported
+    gp = select_gang(st, views, PlacementRequest(hbm_mib=8000,
+                                                 chip_count=4))
+    assert gp is not None and "h3" not in gp.per_host
+    # a gang that NEEDS the missing host cannot place
+    assert select_gang(st, views, PlacementRequest(
+        hbm_mib=8000, chip_count=16, topology=(4, 4))) is None
+
+
+def test_binpack_tie_break_prefers_tighter_host():
+    st = v5e16()
+    # h1 already carries co-tenants (but still fits): tighter leftover
+    views = host_views(st, used={("h1", i): 8000 for i in range(4)})
+    gp = select_gang(st, views, PlacementRequest(hbm_mib=4000,
+                                                 chip_count=4))
+    assert gp is not None
+    assert list(gp.per_host) == ["h1"]
+
+
+def test_sharing_gang_respects_per_chip_hbm():
+    st = v5e16()
+    views = host_views(st, used={("h0", i): 10000 for i in range(4)})
+    # 8000 per chip no longer fits h0's chips (6000 free), must move
+    gp = select_gang(st, views, PlacementRequest(hbm_mib=8000,
+                                                 chip_count=4))
+    assert gp is not None and "h0" not in gp.per_host
+
+
+def test_scatter_rejected_for_gangs():
+    st = v5e16()
+    with pytest.raises(ValueError, match="scatter"):
+        select_gang(st, host_views(st), PlacementRequest(
+            hbm_mib=1000, chip_count=4, allow_scatter=True))
+
+
+def test_v5p_3d_slice_gang():
+    # 2x2x1 hosts of 2x2x4 chips -> 4x4x4 mesh (v5p-style 3-D)
+    st = SliceTopology.from_host_grid((2, 2, 1), (2, 2, 4),
+                                      ["a", "b", "c", "d"])
+    assert st.mesh.shape == (4, 4, 4)
+    gp = select_gang(st, host_views(st), PlacementRequest(
+        hbm_mib=8000, chip_count=8))
+    assert gp is not None
+    assert gp.box in ((2, 2, 2), (1, 2, 4), (2, 1, 4), (2, 2, 2))
+    # compactness-first: 2x2x2 is the most compact 8-chip box
+    assert gp.box == (2, 2, 2)
+
+
+def test_selector_matches_brute_force_on_random_states():
+    # property check: the selector's (hosts, leftover, origin) minimum
+    # equals exhaustive search over all eligible boxes of the winning
+    # shape class
+    import random
+    rng = random.Random(7)
+    st = v5e16()
+    req = PlacementRequest(hbm_mib=6000, chip_count=4)
+    for _ in range(40):
+        used = {(h, i): rng.choice((0, 4000, 12000, 16000))
+                for h in HOSTS for i in range(4)}
+        views = host_views(st, used=used)
+        got = select_gang(st, views, req)
+        merged = st.global_view(views)
+        # brute force over ALL shapes/positions
+        best = None
+        for box in st.mesh.box_shapes(4):
+            found_in_class = False
+            for origin in st.mesh.box_positions(box):
+                coords = list(itertools.product(
+                    *[range(o, o + b) for o, b in zip(origin, box)]))
+                views_in = [merged[c] for c in coords]
+                if any(v.free_hbm_mib < 6000 or not v.healthy
+                       for v in views_in):
+                    continue
+                found_in_class = True
+                hosts = {st.host_of(c) for c in coords}
+                score = sum(v.free_hbm_mib - 6000 for v in views_in)
+                key = (len(hosts), score, origin)
+                if best is None or key < best[0]:
+                    best = (key, box, origin)
+            if found_in_class:
+                break  # same compactness-first class policy
+        if best is None:
+            assert got is None
+        else:
+            assert got is not None
+            assert (got.hosts_spanned, got.score, got.origin) == best[0]
+
+
+# -- the policy payoff ------------------------------------------------------
+
+def _place_single(st, views, host_order, spread: bool):
+    """Place one 8000-MiB single-chip tenant host-locally: 'spread'
+    mimics least-allocated scoring (reference default-scheduler
+    behavior); packed uses min-free-that-fits on the slice."""
+    cands = []
+    for hi, host in enumerate(host_order):
+        for v in views[host]:
+            if v.free_hbm_mib >= 8000:
+                cands.append((hi, host, v))
+    if not cands:
+        return None
+    if spread:
+        # least-allocated, host-rotating tie-break (k8s default-scheduler
+        # spreading behavior): equal-free chips alternate hosts
+        hi, host, v = max(cands, key=lambda hv: (hv[2].free_hbm_mib,
+                                                 -hv[2].idx))
+    else:
+        # min-free-that-fits, same-host-first (the slice-aware binpack)
+        hi, host, v = min(cands, key=lambda hv: (hv[2].free_hbm_mib,
+                                                 hv[0], hv[2].idx))
+    views[host] = [c if c.idx != v.idx else
+                   c.with_used(c.used_hbm_mib + 8000)
+                   for c in views[host]]
+    return host
+
+
+def test_policy_duel_gang_aware_beats_host_local():
+    st = v5e16()
+    results = {}
+    for policy in ("spread", "pack"):
+        views = host_views(st)
+        placed = 0
+        for _ in range(6):  # six single-chip co-tenants arrive first
+            if _place_single(st, views, HOSTS, spread=(policy == "spread")):
+                placed += 1
+        assert placed == 6
+        gangs = 0
+        while True:  # then 2x2 whole-chip gangs until the slice is full
+            gp = select_gang(st, views, PlacementRequest(
+                hbm_mib=0, chip_count=4, topology=(2, 2)))
+            if gp is None:
+                break
+            for host, p in gp.per_host.items():
+                taken = set(p.chip_ids)
+                views[host] = [c if c.idx not in taken else
+                               c.with_used(c.total_hbm_mib)
+                               for c in views[host]]
+            gangs += 1
+        results[policy] = gangs
+    # spreading scatters 6 tenants over 6+ chips across all hosts and
+    # strands the slice for whole-chip gangs; packing doubles them up
+    # onto 3 chips and keeps clean 2x2 boxes available
+    assert results["pack"] > results["spread"], results
+    assert results["spread"] == 0
+    assert results["pack"] >= 2
+
+
+# -- discrete-event slice sim (docs/designs/multihost-gang.md "payoff") -----
+
+def test_slice_sim_pack_beats_spread_on_aggregate():
+    from tpushare.sim.simulator import run_slice_sim, synth_slice_trace
+
+    agg = {"spread": [0.0, 0.0], "pack": [0.0, 0.0]}  # [wait, util]
+    for seed in range(8):
+        trace = synth_slice_trace(n_pods=150, seed=seed, arrival_rate=1.0)
+        for policy in agg:
+            r = run_slice_sim(trace, policy)
+            # every gang eventually places (departures retry the queue)
+            assert r["never_placed"] == 0
+            agg[policy][0] += r["gang_mean_wait"]
+            agg[policy][1] += r["util_pct"]
+    # slice-aware packing strictly wins the aggregate on BOTH axes:
+    # gangs wait less and the slice runs fuller
+    assert agg["pack"][0] < agg["spread"][0], agg
+    assert agg["pack"][1] > agg["spread"][1], agg
+
+
+def test_slice_sim_cross_host_gangs_actually_place():
+    from tpushare.sim.simulator import run_slice_sim, synth_slice_trace
+
+    trace = synth_slice_trace(n_pods=80, seed=1)
+    r = run_slice_sim(trace, "pack")
+    # the trace contains 2x4 gangs, which cannot fit any single 2x2
+    # host — admission of ALL gangs proves cross-host placement works
+    assert r["gangs_total"] > 0
+    assert r["gang_admission_pct"] == 100.0

@@ -1,0 +1,250 @@
+"""Multi-host slice (gang) placement kernel.
+
+A physical v5e-16 is 4 hosts x (2x2) chips forming one 4x4 ICI mesh;
+v5p slices tile 3-D meshes the same way. The reference has no multi-host
+concept at all (its allocator stops at one node's device array,
+nodeinfo.go:312-363); this module places one workload's chips across
+host boundaries as an axis-aligned sub-box of the SLICE mesh, expressed
+back in each host's local chip numbering so the existing per-node
+reserve/bind machinery can execute it. Design: docs/designs/
+multihost-gang.md. Extender wiring lands in r5; this kernel is pure and
+hermetic.
+
+Scoring note: inter-host links inside a slice are ICI (full bandwidth),
+so host crossings cost COORDINATION (kubelets in the gang, failure
+blast radius), not bandwidth — hence `hosts_spanned` leads the score
+tuple rather than feeding a fake link-cost model. Gangs never span
+slices: that would put DCN inside a psum ring.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Sequence
+
+from tpushare.core.chips import ChipView
+from tpushare.core.placement import Placement, PlacementRequest, _eligible
+from tpushare.core.topology import MeshTopology
+
+
+@dataclass(frozen=True)
+class HostBox:
+    """One host's axis-aligned share of the slice mesh."""
+
+    origin: tuple[int, ...]
+    shape: tuple[int, ...]
+
+    def contains(self, coords: tuple[int, ...]) -> bool:
+        return all(o <= c < o + s
+                   for c, o, s in zip(coords, self.origin, self.shape))
+
+
+@dataclass(frozen=True)
+class GangPlacement:
+    """A cross-host decision: the global box + each host's local share.
+
+    ``per_host`` values are :class:`Placement` objects in the HOST's
+    local chip ids/coords — directly consumable by per-node reserve.
+    """
+
+    box: tuple[int, ...]
+    origin: tuple[int, ...]
+    per_host: dict[str, Placement]
+    score: int  # leftover free HBM over chosen chips (lower = tighter)
+
+    @property
+    def hosts_spanned(self) -> int:
+        return len(self.per_host)
+
+
+class SliceTopology:
+    """Global slice mesh + the host boxes that tile it.
+
+    Hosts must exactly tile the mesh with non-overlapping axis-aligned
+    boxes (that is how real slices are built: v5e-16 = 2x2 hosts of
+    2x2 chips). Chip ids are LOCAL per host (row-major within the host
+    box, the device plugin's numbering); this class owns the
+    local<->global mapping.
+    """
+
+    def __init__(self, mesh: MeshTopology,
+                 hosts: Mapping[str, HostBox]) -> None:
+        self.mesh = mesh
+        self.hosts = dict(hosts)
+        covered: dict[tuple[int, ...], str] = {}
+        for name, hb in self.hosts.items():
+            if len(hb.origin) != len(mesh.shape) \
+                    or len(hb.shape) != len(mesh.shape):
+                raise ValueError(
+                    f"host {name} box rank != mesh rank {mesh.shape}")
+            for coords in self._box_coords(hb.origin, hb.shape):
+                if any(not 0 <= c < d
+                       for c, d in zip(coords, mesh.shape)):
+                    raise ValueError(
+                        f"host {name} box {hb} exceeds mesh {mesh.shape}")
+                if coords in covered:
+                    raise ValueError(
+                        f"hosts {covered[coords]} and {name} overlap "
+                        f"at {coords}")
+                covered[coords] = name
+        if len(covered) != mesh.num_chips:
+            raise ValueError(
+                f"host boxes cover {len(covered)} of "
+                f"{mesh.num_chips} slice chips — hosts must tile the "
+                "mesh exactly")
+        self._host_of = covered  # global coords -> host name
+
+    @staticmethod
+    def _box_coords(origin, shape):
+        import itertools
+        return itertools.product(*[range(o, o + s)
+                                   for o, s in zip(origin, shape)])
+
+    @classmethod
+    def from_host_grid(cls, host_grid: tuple[int, ...],
+                       host_box: tuple[int, ...],
+                       host_names: Sequence[str]) -> "SliceTopology":
+        """The common real-world construction: hosts arranged in a grid,
+        each owning an identical box. v5e-16:
+        ``from_host_grid((2, 2), (2, 2), ["h0", "h1", "h2", "h3"])``
+        -> 4x4 mesh. Host order is row-major over the host grid."""
+        if len(host_grid) != len(host_box):
+            raise ValueError("host_grid and host_box rank differ")
+        n_hosts = 1
+        for d in host_grid:
+            n_hosts *= d
+        if n_hosts != len(host_names):
+            raise ValueError(
+                f"host grid {host_grid} needs {n_hosts} names, "
+                f"got {len(host_names)}")
+        mesh = MeshTopology(tuple(g * b for g, b in
+                                  zip(host_grid, host_box)))
+        grid = MeshTopology(host_grid)
+        hosts = {}
+        for i, name in enumerate(host_names):
+            gcoords = grid.coords(i)
+            origin = tuple(g * b for g, b in zip(gcoords, host_box))
+            hosts[name] = HostBox(origin, tuple(host_box))
+        return cls(mesh, hosts)
+
+    # -- local <-> global ---------------------------------------------------
+
+    def host_of(self, global_coords: tuple[int, ...]) -> str:
+        return self._host_of[global_coords]
+
+    def local_topology(self, host: str) -> MeshTopology:
+        return MeshTopology(self.hosts[host].shape)
+
+    def to_local(self, host: str,
+                 global_coords: tuple[int, ...]) -> tuple[int, ...]:
+        hb = self.hosts[host]
+        if not hb.contains(global_coords):
+            raise ValueError(f"{global_coords} not on host {host}")
+        return tuple(c - o for c, o in zip(global_coords, hb.origin))
+
+    def global_view(self, views: Mapping[str, Sequence[ChipView]]
+                    ) -> dict[tuple[int, ...], ChipView]:
+        """Merge per-host LOCAL snapshots into global-coords -> view.
+
+        A host missing from ``views`` (down, unreported) simply
+        contributes no chips — boxes touching it are ineligible, which
+        is the correct degraded behavior for gang placement.
+        """
+        merged: dict[tuple[int, ...], ChipView] = {}
+        for host, chips in views.items():
+            hb = self.hosts.get(host)
+            if hb is None:
+                raise ValueError(f"unknown host {host}")
+            local = self.local_topology(host)
+            for c in chips:
+                # trust idx (the device plugin's local numbering); derive
+                # global coords from it so a partial snapshot cannot
+                # shift later chips
+                gcoords = tuple(o + lc for o, lc in
+                                zip(hb.origin, local.coords(c.idx)))
+                merged[gcoords] = c
+        return merged
+
+
+def fits_gang(slice_topo: SliceTopology,
+              views: Mapping[str, Sequence[ChipView]],
+              req: PlacementRequest) -> bool:
+    """Existence check (Filter path): first eligible box, early exit."""
+    return _search_gang(slice_topo, views, req, first_only=True) is not None
+
+
+def select_gang(slice_topo: SliceTopology,
+                views: Mapping[str, Sequence[ChipView]],
+                req: PlacementRequest) -> GangPlacement | None:
+    """Bind-path gang selector (see module docstring for policy)."""
+    return _search_gang(slice_topo, views, req, first_only=False)
+
+
+def _search_gang(slice_topo: SliceTopology,
+                 views: Mapping[str, Sequence[ChipView]],
+                 req: PlacementRequest,
+                 first_only: bool) -> GangPlacement | None:
+    if req.allow_scatter:
+        raise ValueError("gangs are contiguous by definition; "
+                         "scatter placement is a single-host mode")
+    mesh = slice_topo.mesh
+    merged = slice_topo.global_view(views)
+    shapes = [req.topology] if req.topology is not None \
+        else mesh.box_shapes(req.chip_count)
+
+    best: tuple[tuple[int, int, tuple[int, ...]], GangPlacement] | None \
+        = None
+    for box in shapes:
+        if len(box) != len(mesh.shape):
+            continue
+        for origin in mesh.box_positions(box):
+            coords_list = [
+                tuple(o + d for o, d in zip(origin, delta))
+                for delta in SliceTopology._box_coords(
+                    (0,) * len(box), box)]
+            members = [merged.get(c) for c in coords_list]
+            if any(m is None or not _eligible(m, req) for m in members):
+                continue
+            placement = _build_gang(slice_topo, box, origin,
+                                    coords_list, merged, req)
+            if first_only:
+                return placement
+            key = (placement.hosts_spanned, placement.score,
+                   placement.origin)
+            if best is None or key < best[0]:
+                best = (key, placement)
+        if best is not None:
+            # shapes come most-ICI-compact first: stop at the first
+            # shape class with a placement (same policy as
+            # select_chips_py)
+            break
+    return best[1] if best else None
+
+
+def _build_gang(slice_topo: SliceTopology, box, origin, coords_list,
+                merged, req: PlacementRequest) -> GangPlacement:
+    by_host: dict[str, list[tuple[int, ...]]] = {}
+    for c in coords_list:
+        by_host.setdefault(slice_topo.host_of(c), []).append(c)
+    per_host: dict[str, Placement] = {}
+    for host, gcoords in by_host.items():
+        local = slice_topo.local_topology(host)
+        lcoords = [slice_topo.to_local(host, c) for c in gcoords]
+        # the host's share of an axis-aligned global box is itself an
+        # axis-aligned local box
+        lorigin = tuple(min(c[ax] for c in lcoords)
+                        for ax in range(len(local.shape)))
+        lshape = tuple(max(c[ax] for c in lcoords) - lorigin[ax] + 1
+                       for ax in range(len(local.shape)))
+        ids = tuple(sorted(local.index(c) for c in lcoords))
+        sub_score = sum(
+            merged[g].free_hbm_mib - req.chip_demand_mib(
+                merged[g].total_hbm_mib)
+            for g in gcoords)
+        per_host[host] = Placement(ids, box=lshape, origin=lorigin,
+                                   score=sub_score)
+    # the gang score IS the sum of its per-host shares — one formula,
+    # computed once
+    return GangPlacement(box=tuple(box), origin=tuple(origin),
+                         per_host=per_host,
+                         score=sum(p.score for p in per_host.values()))

@@ -38,7 +38,36 @@ def main(argv: list[str] | None = None) -> int:
                          "no-extender failure mode), refined = per-chip "
                          "victim refinement (the preempt verb)")
     ap.add_argument("--high-priority-fraction", type=float, default=0.0)
+    ap.add_argument("--slice", action="store_true",
+                    help="multi-host slice (gang) mode: one v5e-16 "
+                         "(2x2 hosts of 2x2 chips), mixed single-chip "
+                         "tenants + 2x2/2x4 exclusive gangs through "
+                         "core/slice.select_gang; compares the 'pack' "
+                         "and 'spread' singles policies "
+                         "(docs/designs/multihost-gang.md)")
     args = ap.parse_args(argv)
+
+    if args.slice:
+        # slice mode simulates a fixed v5e-16 (2x2 hosts of 2x2 chips)
+        # and runs BOTH singles policies; flags that would silently not
+        # apply are rejected rather than ignored
+        for flag, default in (("nodes", 8), ("chips", 4), ("hbm", 16384),
+                              ("mesh", None), ("policy", "all"),
+                              ("preempt", "off"),
+                              ("high_priority_fraction", 0.0)):
+            if getattr(args, flag) != default:
+                ap.error(f"--{flag.replace('_', '-')} does not apply to "
+                         "--slice mode (fixed v5e-16 geometry, "
+                         "pack-vs-spread duel)")
+        from tpushare.sim.simulator import run_slice_sim, synth_slice_trace
+        strace = synth_slice_trace(
+            n_pods=args.pods, seed=args.seed,
+            gang_fraction=args.multi_chip_fraction,
+            arrival_rate=args.arrival_rate,
+            mean_duration=args.mean_duration)
+        for policy in ("spread", "pack"):
+            print(json.dumps(run_slice_sim(strace, policy)))
+        return 0
 
     mesh = tuple(int(d) for d in args.mesh.split("x")) if args.mesh else None
     if mesh is not None:

@@ -64,6 +64,11 @@ class TraceSpec:
     seed: int = 0
 
 
+def _p99(xs: list[float]) -> float:
+    """Sorted-percentile idiom shared by both sim loops."""
+    return sorted(xs)[int(0.99 * (len(xs) - 1))] if xs else 0.0
+
+
 def synth_trace(spec: TraceSpec) -> list[SimPod]:
     rng = random.Random(spec.seed)
     t = 0.0
@@ -456,15 +461,13 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
             pending = still
 
     span = max(last_t - (busy_start or 0.0), 1e-9)
-    waits_sorted = sorted(waits)
     return SimReport(
         policy=policy,
         pods=len(trace),
         placed=placed,
         never_placed=len(pending),
         mean_wait=sum(waits) / len(waits) if waits else 0.0,
-        p99_wait=waits_sorted[int(0.99 * (len(waits_sorted) - 1))]
-        if waits_sorted else 0.0,
+        p99_wait=_p99(waits),
         util_pct=util_integral / (fleet.total_hbm * span) * 100.0,
         peak_util_pct=peak,
         frag_time_weighted=frag_integral / span,
@@ -475,7 +478,161 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
         wasted_evictions=wasted_evictions,
         noop_preemptions=noop_preemptions,
         hp_mean_wait=sum(hp_waits) / len(hp_waits) if hp_waits else 0.0,
-        hp_p99_wait=sorted(hp_waits)[int(0.99 * (len(hp_waits) - 1))]
-        if hp_waits else 0.0,
+        hp_p99_wait=_p99(hp_waits),
         waits=waits,
     )
+
+
+# -- multi-host slice (gang) simulation -------------------------------------
+
+def synth_slice_trace(n_pods: int = 120, seed: int = 0,
+                      gang_fraction: float = 0.3,
+                      arrival_rate: float = 1.0,
+                      mean_duration: float = 40.0) -> list[SimPod]:
+    """Mixed slice workload: single-chip sharing tenants plus 2x2 and
+    2x4 exclusive gangs (2x4 cannot fit any single v5e host — it EXISTS
+    only if placement is slice-aware)."""
+    rng = random.Random(seed)
+    t = 0.0
+    out = []
+    for _ in range(n_pods):
+        t += rng.expovariate(arrival_rate)
+        dur = rng.expovariate(1.0 / mean_duration)
+        if rng.random() < gang_fraction:
+            shape = rng.choice(((2, 2), (2, 4)))
+            n = shape[0] * shape[1]
+            out.append(SimPod(t, dur, hbm_mib=0, chip_count=n,
+                              topology=shape))
+        else:
+            out.append(SimPod(t, dur, hbm_mib=rng.choice((4096, 8192)),
+                              chip_count=1))
+    return out
+
+
+def run_slice_sim(trace: list[SimPod], singles_policy: str = "pack",
+                  host_grid=(2, 2), host_box=(2, 2)) -> dict:
+    """Discrete-event sim over ONE slice (v5e-16 default: 2x2 hosts of
+    2x2 chips) through the gang kernel (core/slice.select_gang).
+
+    ``singles_policy`` sets how single-chip tenants land, the knob the
+    policy duel measures:
+
+    - ``"pack"``   — min-free-that-fits, same-host-first (tpushare's
+                     binpack extended with slice awareness);
+    - ``"spread"`` — least-allocated with host-rotating ties (what the
+                     default scheduler's scoring does to a slice).
+
+    Gangs always go through :func:`select_gang`; what differs is how
+    much contiguous room the singles policy left. Returns admission and
+    utilization stats. Reference ceiling for context: its allocator is
+    single-node, so every cross-host gang (2x4 here) is unplaceable by
+    construction — this sim quantifies what slice-awareness buys BEYOND
+    that structural gap.
+    """
+    from tpushare.core.slice import SliceTopology, select_gang
+
+    assert singles_policy in ("pack", "spread")
+    n_hosts = 1
+    for d in host_grid:
+        n_hosts *= d
+    names = [f"host{i}" for i in range(n_hosts)]
+    st = SliceTopology.from_host_grid(tuple(host_grid), tuple(host_box),
+                                      names)
+    local = MeshTopology(tuple(host_box))
+    hbm = 16384
+    used: dict[str, list[int]] = {h: [0] * local.num_chips
+                                  for h in names}
+
+    def views():
+        return {h: [ChipView(i, local.coords(i), hbm, used[h][i])
+                    for i in range(local.num_chips)] for h in names}
+
+    heap: list[tuple] = []
+    for seq, pod in enumerate(sorted(trace, key=lambda p: p.arrival)):
+        heapq.heappush(heap, (pod.arrival, 1, seq, pod))
+    pending: list[SimPod] = []
+    placed = gangs_placed = gangs_total = singles_placed = 0
+    gang_waits: list[float] = []
+    seq2 = len(trace)
+    now = last_t = 0.0
+    util_integral = 0.0
+    busy_start = min((p.arrival for p in trace), default=0.0)
+    total_hbm = hbm * local.num_chips * n_hosts
+
+    def advance(to):
+        nonlocal util_integral, last_t
+        dt = to - last_t
+        if dt > 0:
+            util_integral += sum(sum(u) for u in used.values()) * dt
+        last_t = to
+
+    def try_place(pod: SimPod) -> bool:
+        nonlocal placed, gangs_placed, singles_placed, seq2
+        if pod.chip_count > 1:
+            req = PlacementRequest(hbm_mib=pod.hbm_mib,
+                                   chip_count=pod.chip_count,
+                                   topology=pod.topology)
+            gp = select_gang(st, views(), req)
+            if gp is None:
+                return False
+            demand = req.chip_demand_mib(hbm)  # full chip iff exclusive
+            holds = []
+            for host, p in gp.per_host.items():
+                for cid in p.chip_ids:
+                    used[host][cid] += demand
+                    holds.append((host, cid, demand))
+            gangs_placed += 1
+            gang_waits.append(now - pod.arrival)
+        else:
+            cands = [(host, i) for host in names
+                     for i in range(local.num_chips)
+                     if hbm - used[host][i] >= pod.hbm_mib]
+            if not cands:
+                return False
+            if singles_policy == "spread":
+                host, i = max(cands, key=lambda hc: (
+                    hbm - used[hc[0]][hc[1]], -hc[1]))
+            else:
+                host, i = min(cands, key=lambda hc: (
+                    hbm - used[hc[0]][hc[1]], names.index(hc[0]), hc[1]))
+            used[host][i] += pod.hbm_mib
+            holds = [(host, i, pod.hbm_mib)]
+            singles_placed += 1
+        placed += 1
+        heapq.heappush(heap, (now + pod.duration, 0, seq2, holds))
+        seq2 += 1
+        return True
+
+    while heap:
+        now, kind, _seq, payload = heapq.heappop(heap)
+        advance(now)
+        if kind == 1:
+            if payload.chip_count > 1:
+                gangs_total += 1
+            if not try_place(payload):
+                pending.append(payload)
+        else:
+            for host, cid, amount in payload:
+                used[host][cid] -= amount
+            still = []
+            for pod in pending:
+                if not try_place(pod):
+                    still.append(pod)
+            pending = still
+
+    # busy-interval denominator, same definition as run_sim's
+    span = max(last_t - busy_start, 1e-9)
+    return {
+        "singles_policy": singles_policy,
+        "pods": len(trace),
+        "placed": placed,
+        "never_placed": len(pending),
+        "gangs_total": gangs_total,
+        "gangs_placed": gangs_placed,
+        "gang_admission_pct": round(
+            gangs_placed / gangs_total * 100.0, 2) if gangs_total else 100.0,
+        "gang_mean_wait": round(sum(gang_waits) / len(gang_waits), 2)
+        if gang_waits else 0.0,
+        "gang_p99_wait": round(_p99(gang_waits), 2),
+        "util_pct": round(util_integral / (total_hbm * span) * 100.0, 2),
+    }
