@@ -679,17 +679,29 @@ def _kernel_bench_inline() -> dict | None:
     v = jax.random.normal(kv, (B, H, S, D), jnp.bfloat16)
 
     def flash(q, k, v):
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True, fwd_impl="step")
+
+    def flash_pipe(q, k, v):
+        return flash_attention(q, k, v, causal=True, fwd_impl="pipelined")
 
     def einsum(q, k, v):
         return attention_reference(q, k, v, causal=True)
 
-    # gate 2: parity at the exact shape being timed
+    # gate 2: parity at the exact shape being timed (both fwd variants)
     fo = np.asarray(jax.jit(flash)(q, k, v).astype(jnp.float32))
     eo = np.asarray(jax.jit(einsum)(q, k, v).astype(jnp.float32))
     parity = float(np.abs(fo - eo).max())
     out["flash_vs_einsum_max_abs"] = round(parity, 5)
     out["parity_ok"] = bool(np.isfinite(parity) and parity < 5e-2)
+    try:
+        po = np.asarray(jax.jit(flash_pipe)(q, k, v).astype(jnp.float32))
+        pipe_parity = float(np.abs(po - eo).max())
+        pipe_ok = bool(np.isfinite(pipe_parity) and pipe_parity < 5e-2)
+        out["flash_pipelined_vs_einsum_max_abs"] = round(pipe_parity, 5)
+    except Exception as e:  # Mosaic compile failure must not kill the
+        pipe_ok = False  # step-kernel numbers
+        out["flash_pipelined_error"] = f"{type(e).__name__}: {e}"[:200]
+    out["flash_pipelined_parity_ok"] = pipe_ok
 
     def scan_loop(attn_fn, n):
         @jax.jit
@@ -722,6 +734,17 @@ def _kernel_bench_inline() -> dict | None:
 
     flash_ms = slope_ms(lambda n: scan_loop(flash, n), (q, k, v))
     einsum_ms = slope_ms(lambda n: scan_loop(einsum, n), (q, k, v))
+    # VPU/MXU-overlap A/B (VERDICT r3 item 4): the pipelined forward is
+    # timed alongside, interleaved with the step kernel's measurement
+    # conditions; published regardless of which wins (promotion is a
+    # deliberate act, not a bench side effect)
+    pipe_ms = None
+    if pipe_ok:
+        pipe_ms = slope_ms(lambda n: scan_loop(flash_pipe, n), (q, k, v))
+        # re-measure the step kernel after (first-measured reads ~10%
+        # slow per the r3 warmup finding; keep the better of the two)
+        flash_ms = min(flash_ms,
+                       slope_ms(lambda n: scan_loop(flash, n), (q, k, v)))
     # causal attention FLOPs: 2 matmuls x 2 MACs x B H S^2 D, halved by
     # the causal triangle
     attn_flops = 2.0 * B * H * S * S * D
@@ -739,6 +762,12 @@ def _kernel_bench_inline() -> dict | None:
         "flash_mfu_pct": mfu(flash_ms),
         "einsum_mfu_pct": mfu(einsum_ms),
     })
+    if pipe_ms is not None:
+        out.update({
+            "flash_pipelined_ms": round(pipe_ms, 4),
+            "flash_pipelined_mfu_pct": mfu(pipe_ms),
+            "pipelined_vs_step": round(flash_ms / pipe_ms, 3),
+        })
 
     # training step: fwd + full bwd (dq AND dk/dv), A/B between the
     # Pallas backward kernel pair (causal block skip, bf16 MXU) and the
@@ -1023,6 +1052,7 @@ def main() -> int:
         # the r2 numbers were physically impossible (741% MFU) and were
         # published anyway; any MFU outside (0, 100] now FAILS the bench
         for key in ("flash_mfu_pct", "einsum_mfu_pct",
+                    "flash_pipelined_mfu_pct",
                     "llama_mini_fwd_mfu_pct", "train_fwdbwd_mfu_pct"):
             mfu = kernel.get(key)
             if mfu is not None:

@@ -35,21 +35,38 @@ def _probe_backend() -> None:
     """
     if os.environ.get("TPUSHARE_BACKEND_PROBED"):
         return
+    # never subprocess.run(timeout=...): its expiry path SIGKILLs the
+    # probe — and a SIGKILLed JAX client is what WEDGES this rig's
+    # single-client relay in the first place (docs/perf.md runbook).
+    # SIGINT, short grace, then abandon the blocked client to self-exit
+    # (the far end answers it with UNAVAILABLE in ~25 min).
+    import signal
     try:
-        probe = subprocess.run(
+        probe = subprocess.Popen(
             [sys.executable, "-c",
              "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=120)
-    except subprocess.TimeoutExpired:
-        pytest.exit("jax backend init hung >120s — TPU tunnel wedged? "
-                    "(docs/perf.md caveat; tests_tpu needs a healthy "
-                    "backend or none at all to skip cleanly)",
-                    returncode=3)
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True)
     except OSError as e:
         pytest.exit(f"backend probe could not launch: {e}", returncode=3)
+    try:
+        # communicate() drains both pipes while waiting — a plain wait()
+        # could deadlock against a child blocked writing a >64 KiB
+        # traceback; on timeout it does NOT kill the child
+        out, err = probe.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        try:
+            probe.send_signal(signal.SIGINT)
+            probe.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            pass  # blocked in PJRT init: leave it to self-exit, NO kill
+        pytest.exit("jax backend init hung >120s — TPU tunnel wedged? "
+                    "(docs/perf.md runbook; tests_tpu needs a healthy "
+                    "backend or none at all to skip cleanly)",
+                    returncode=3)
     if probe.returncode != 0:
         tail = "no error output"
-        for stream in (probe.stderr, probe.stdout):
+        for stream in (err, out):
             lines = (stream or "").strip().splitlines()
             if lines:
                 tail = lines[-1][:200]

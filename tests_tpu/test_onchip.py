@@ -253,3 +253,35 @@ def test_pallas_backward_compiled_gqa():
     _, ref_vjp = jax.vjp(ref_fn, q, k, v)
     for a, b, name in zip(got, ref_vjp(do), "qkv"):
         assert_close(a, b, atol=1e-1, rtol=5e-2)
+
+
+def test_pipelined_forward_compiled_bench_shape():
+    # Mosaic compilation gate for the VPU/MXU-overlap forward
+    # (TPUSHARE_FLASH_FWD=pipelined): the bench A/Bs it only when this
+    # compiles and matches. Bench shape, default 1024x1024 tiles, plus
+    # the [2, BQ, BK] fp32 score scratch (8 MiB) — the VMEM-pressure
+    # configuration that actually ships.
+    q, k, v = rand_qkv(jax.random.key(50), 4, 8, 2048, 128, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, fwd_impl="pipelined")
+    ref = attention_reference(q, k, v, causal=True)
+    assert_close(out, ref, atol=5e-2)
+
+
+def test_pipelined_forward_compiled_window_ragged():
+    # window floor + ragged padding through the pipelined consume path
+    q, k, v = rand_qkv(jax.random.key(51), 1, 2, 1920, 128, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, window=512,
+                          fwd_impl="pipelined")
+    ref = attention_reference(q, k, v, causal=True, window=512)
+    assert_close(out, ref, atol=5e-2)
+
+
+def test_pipelined_forward_compiled_gqa():
+    ks = jax.random.split(jax.random.key(52), 3)
+    q = jax.random.normal(ks[0], (1, 8, 1024, 128), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, 2, 1024, 128), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, 2, 1024, 128), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, fwd_impl="pipelined")
+    ref = attention_reference(q, jnp.repeat(k, 4, 1), jnp.repeat(v, 4, 1),
+                              causal=True)
+    assert_close(out, ref, atol=5e-2)

@@ -41,6 +41,29 @@ import jax.numpy as jnp
 BLOCK = 128  # minimum q/k block edge (MXU-aligned; bf16 min tile is (16, 128))
 
 _FLASH_BWD_IMPLS = ("xla", "pallas")
+_FLASH_FWD_IMPLS = ("step", "pipelined")
+
+
+def _resolve_flash_fwd(fwd_impl: str | None) -> str:
+    """Forward-kernel variant, resolved like :func:`_resolve_flash_bwd`.
+
+    "step" — one kv block per grid step: score matmul, softmax, p@v in
+    a single dependency chain (the r3 kernel; VPU softmax is its
+    measured critical path, docs/perf.md ablation).
+    "pipelined" — the next block's score matmul is issued in the same
+    grid step as the previous block's softmax/p@v consume, with scores
+    double-buffered in VMEM, giving Mosaic's scheduler a data-
+    independent MXU chain to overlap the VPU passes with. Identical
+    math in identical order; opt-in until its Mosaic compilation and an
+    A/B land on hardware (TPUSHARE_FLASH_FWD=pipelined).
+    """
+    if fwd_impl is None:
+        fwd_impl = os.environ.get("TPUSHARE_FLASH_FWD", "step")
+    if fwd_impl not in _FLASH_FWD_IMPLS:
+        raise ValueError(
+            f"fwd_impl={fwd_impl!r} (or $TPUSHARE_FLASH_FWD) must be "
+            f"one of {_FLASH_FWD_IMPLS}")
+    return fwd_impl
 
 
 def _resolve_flash_bwd(bwd_impl: str | None) -> str:
@@ -182,6 +205,74 @@ def _causal_class_dispatch(pl, step, gate, i, j, block_q: int,
             step(True, False)
 
 
+def _mask_scores(s, i, j, block_q, block_kv, seq, window,
+                 mask_causal: bool, mask_pad: bool, mask_window: bool):
+    """Apply the selected mask classes to a [BQ, BK] score block for
+    kv block ``j``. Shared by the step and pipelined forward kernels —
+    hand-synced copies of this predicate algebra is how off-by-ones are
+    born (same policy as _causal_class_dispatch)."""
+    if not (mask_causal or mask_pad or mask_window):
+        return s
+    bq = s.shape[0]
+    col = j * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_kv), 1)
+    mask = None
+    if mask_pad:
+        mask = col < seq                              # padded keys out
+    if mask_causal or mask_window:
+        row = i * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_kv), 0)
+        if mask_causal:
+            c = col <= row
+            mask = c if mask is None else jnp.logical_and(mask, c)
+        if mask_window:
+            w = sliding_window_mask(row, col, window)
+            mask = w if mask is None else jnp.logical_and(mask, w)
+    return jnp.where(mask, s, -jnp.inf)
+
+
+def _online_softmax_accum(s, vb, m_ref, l_ref, acc_ref):
+    """One online-softmax update of the (m, l, acc) scratch state from a
+    masked [BQ, BK] score block and its [BK, D] value block. Shared by
+    both forward kernels — the bit-identity contract between them IS
+    this function being the single copy."""
+    m = m_ref[...]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # rows with no visible key yet keep m=-inf; exp(-inf - -inf) would
+    # be NaN, so clamp the shift for those rows
+    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    # masked score entries are already -inf and exp(-inf - shift) is
+    # exactly 0.0 for any finite shift, so p needs NO re-mask — that
+    # redundant where() pass over [BQ, BK] cost ~10% of kernel time
+    p = jnp.exp(s - shift)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+    m_ref[...] = m_new
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    # p is cast to the value dtype for the second matmul (standard
+    # flash practice: probabilities are in [0,1] so bf16 truncation
+    # costs ~3 decimal digits, matching the einsum reference's p cast)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _emit_block(o_ref, lse_ref, m_ref, l_ref, acc_ref):
+    """Normalize and write the output + log-sum-exp residual for one
+    q block. Shared by both forward kernels."""
+    l = l_ref[...]
+    out = acc_ref[...] / jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+    # log-sum-exp of the scaled scores per query row (the residual the
+    # backward pass needs to regenerate p without storing it); rows
+    # with no visible key (query padding) emit -inf
+    lse = jnp.where(l > 0, m_ref[...] + jnp.log(jnp.maximum(l, 1e-30)),
+                    -jnp.inf)
+    # lse block is [1, 1, 8, block_q]: the sublane dim is padding that
+    # exists purely to satisfy Mosaic's (8, 128) min-tile rule for
+    # fp32 outputs — broadcast the row vector across it
+    lse_ref[0, 0] = jnp.broadcast_to(lse[:, 0], lse_ref.shape[2:])
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                   acc_ref, *, seq: int, n_kv: int,
                   causal: bool, block_q: int, block_kv: int,
@@ -232,48 +323,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         # to fp32 would halve MXU throughput. The softmax scale is folded
         # into q ONCE by _flash_call (not per kv step, and never on the
         # VPU-bound [BQ, BK] score path).
-        q = q_ref[0, 0]
-        bq = q.shape[0]
-        kb = k_ref[0, 0]                                  # [BK, D]
-        vb = v_ref[0, 0]
         s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BQ, BK]
-        if mask_causal or mask_pad or mask_window:
-            col = j * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_kv), 1)
-            mask = None
-            if mask_pad:
-                mask = col < seq                          # padded keys out
-            if mask_causal or mask_window:
-                row = i * bq + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, block_kv), 0)
-                if mask_causal:
-                    c = col <= row
-                    mask = c if mask is None else jnp.logical_and(mask, c)
-                if mask_window:
-                    w = sliding_window_mask(row, col, window)
-                    mask = w if mask is None else jnp.logical_and(mask, w)
-            s = jnp.where(mask, s, -jnp.inf)
-
-        m = m_ref[...]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # rows with no visible key yet keep m=-inf; exp(-inf - -inf) would
-        # be NaN, so clamp the shift for those rows
-        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        # masked score entries are already -inf and exp(-inf - shift) is
-        # exactly 0.0 for any finite shift, so p needs NO re-mask — that
-        # redundant where() pass over [BQ, BK] cost ~10% of kernel time
-        p = jnp.exp(s - shift)
-        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
-        m_ref[...] = m_new
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        # p is cast to the value dtype for the second matmul (standard
-        # flash practice: probabilities are in [0,1] so bf16 truncation
-        # costs ~3 decimal digits, matching the einsum reference's p cast)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        s = _mask_scores(s, i, j, block_q, block_kv, seq, window,
+                         mask_causal, mask_pad, mask_window)
+        _online_softmax_accum(s, v_ref[0, 0], m_ref, l_ref, acc_ref)
 
     # mask work is dispatched 3-way so each block class pays only for the
     # compares it needs (each saved compare/where is a VPU pass over the
@@ -312,24 +367,111 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 
     @pl.when(j == last)
     def _emit():
-        l = l_ref[...]
-        out = acc_ref[...] / jnp.maximum(l, 1e-30)
-        o_ref[0, 0] = out.astype(o_ref.dtype)
-        # log-sum-exp of the scaled scores per query row (the residual the
-        # backward pass needs to regenerate p without storing it);
-        # rows with no visible key (query padding) emit -inf
-        lse = jnp.where(l > 0, m_ref[...] + jnp.log(jnp.maximum(l, 1e-30)),
-                        -jnp.inf)
-        # lse block is [1, 1, 8, block_q]: the sublane dim is padding that
-        # exists purely to satisfy Mosaic's (8, 128) min-tile rule for
-        # fp32 outputs — broadcast the row vector across it
-        lse_ref[0, 0] = jnp.broadcast_to(lse[:, 0], lse_ref.shape[2:])
+        _emit_block(o_ref, lse_ref, m_ref, l_ref, acc_ref)
+
+
+def _flash_kernel_pipelined(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                            m_ref, l_ref, acc_ref, s_ref, *, seq: int,
+                            n_kv: int, causal: bool, block_q: int,
+                            block_kv: int, window: int | None):
+    """Software-pipelined grid step: COMPUTE block j's scores while
+    CONSUMING block j-1's.
+
+    The r3 ablation (docs/perf.md) measured the softmax VPU passes as
+    the critical path: within one step kernel the chain
+    score-matmul -> max/exp/sum -> p@v is strictly serial, idling the
+    MXU ~60% of each step. Here the kv grid runs ONE EXTRA step and
+    each step does two data-independent halves:
+
+      compute:  s_j = q @ k_j          (pure MXU; no masking — that is
+                VPU work and belongs to the consume phase) written to
+                scratch slot j % 2;
+      consume:  mask/softmax/accumulate block j-1 from slot (j-1) % 2,
+                with v's BlockSpec index map shifted one block BACK so
+                v_{j-1} is resident.
+
+    The two halves share no data (double-buffered scores, different
+    kv blocks), so Mosaic's scheduler is free to overlap the compute
+    matmul with the consume softmax. Numerics are IDENTICAL to
+    _flash_kernel: same operations on the same values in the same
+    online-softmax order — only issue order changes.
+    """
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    if window is None:
+        j_start = 0
+    else:
+        floor = i * block_q - (window - 1)
+        j_start = jnp.maximum(floor, 0) // block_kv
+
+    @pl.when(j == j_start)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # ---- compute phase: s_j (gated off for the extra step and for
+    # invisible blocks; k's index map clamps j so the DMA stays legal)
+    visible_j = jnp.logical_and(
+        j <= n_kv - 1,
+        (j * block_kv <= (i + 1) * block_q - 1) if causal else True)
+    if window is not None:
+        visible_j = jnp.logical_and(visible_j, j >= j_start)
+
+    @pl.when(visible_j)
+    def _compute():
+        s = jax.lax.dot_general(
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [BQ, BK]
+        s_ref[j % 2] = s
+
+    # ---- consume phase: block jj = j - 1 from the other slot
+    jj = j - 1
+    visible_jj = jnp.logical_and(
+        jj >= j_start,
+        (jj * block_kv <= (i + 1) * block_q - 1) if causal else jj >= 0)
+
+    def _consume(mask_causal: bool, mask_pad: bool,
+                 mask_window: bool = False):
+        s = _mask_scores(s_ref[jj % 2], i, jj, block_q, block_kv, seq,
+                         window, mask_causal, mask_pad, mask_window)
+        _online_softmax_accum(s, v_ref[0, 0], m_ref, l_ref, acc_ref)
+
+    col_end = (jj + 1) * block_kv
+    nopad = col_end <= seq
+    if causal:
+        _causal_class_dispatch(
+            pl, lambda c, w: _consume(mask_causal=c, mask_pad=False,
+                                      mask_window=w),
+            jnp.logical_and(visible_jj, nopad), i, jj, block_q,
+            block_kv, window)
+    else:
+        @pl.when(jnp.logical_and(visible_jj, nopad))
+        def _consume_unmasked():
+            _consume(mask_causal=False, mask_pad=False)
+
+    @pl.when(jnp.logical_and(visible_jj, jnp.logical_not(nopad)))
+    def _consume_padded():
+        _consume(mask_causal=causal, mask_pad=True,
+                 mask_window=causal and window is not None)
+
+    # ---- emit: one step AFTER the step kernel's last (the consume of
+    # the diagonal/final block happens there)
+    last = (jnp.minimum(((i + 1) * block_q - 1) // block_kv, n_kv - 1)
+            if causal else (n_kv - 1))
+
+    @pl.when(j == last + 1)
+    def _emit():
+        _emit_block(o_ref, lse_ref, m_ref, l_ref, acc_ref)
 
 
 def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
                 causal: bool, interpret: bool,
                 block_q: int | None = None, block_kv: int | None = None,
-                window: int | None = None):
+                window: int | None = None, pipelined: bool = False):
     """Run the kernel; returns (out [B,H,S,D], lse [B,H,S] fp32).
 
     GQA-native: k/v may carry fewer heads (H_kv dividing H); the kv
@@ -337,6 +479,10 @@ def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
     query-head group streams the SAME kv blocks — the kernel never
     materializes the repeated heads, which is the whole HBM point of GQA
     (a pre-expanded call would move group-size x more K/V per step).
+
+    ``pipelined=True`` selects :func:`_flash_kernel_pipelined`: the kv
+    grid runs one extra step, v's index map trails k's by one block, and
+    scores double-buffer through a [2, BQ, BK] VMEM scratch.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -361,36 +507,51 @@ def _flash_call(q: jax.Array, k: jax.Array, v: jax.Array,
     Sp, KVp = S + pad_q, kv + pad_k
     n_kv = KVp // bk
 
-    grid = (B, H, Sp // bq, n_kv)
     # b/h/q-block steps are independent; only the kv axis carries the
     # online-softmax scratch state and must stay sequential
     params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel",
                              "arbitrary"))
+    scratch = [
+        pltpu.VMEM((bq, 1), jnp.float32),   # running max m
+        pltpu.VMEM((bq, 1), jnp.float32),   # running denom l
+        pltpu.VMEM((bq, D), jnp.float32),   # output accumulator
+    ]
+    if pipelined:
+        # one extra kv step (the final consume); k is clamped to the
+        # last real block there, v trails one block behind k
+        grid = (B, H, Sp // bq, n_kv + 1)
+        kernel = functools.partial(
+            _flash_kernel_pipelined, seq=kv, n_kv=n_kv, causal=causal,
+            block_q=bq, block_kv=bk, window=window)
+        k_map = (lambda b, h, i, j, g=g, n=n_kv:
+                 (b, h // g, jnp.minimum(j, n - 1), 0))
+        v_map = (lambda b, h, i, j, g=g:
+                 (b, h // g, jnp.maximum(j - 1, 0), 0))
+        scratch = scratch + [pltpu.VMEM((2, bq, bk), jnp.float32)]
+    else:
+        grid = (B, H, Sp // bq, n_kv)
+        kernel = functools.partial(
+            _flash_kernel, seq=kv, n_kv=n_kv, causal=causal,
+            block_q=bq, block_kv=bk, window=window)
+        k_map = lambda b, h, i, j, g=g: (b, h // g, j, 0)  # noqa: E731
+        v_map = k_map
     out, lse = pl.pallas_call(
-        functools.partial(_flash_kernel, seq=kv,
-                          n_kv=n_kv, causal=causal, block_q=bq,
-                          block_kv=bk, window=window),
+        kernel,
         out_shape=(jax.ShapeDtypeStruct(qp.shape, q.dtype),
                    jax.ShapeDtypeStruct((B, H, 8, Sp), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, D),
                          lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, D),
-                         lambda b, h, i, j, g=g: (b, h // g, j, 0)),
-            pl.BlockSpec((1, 1, bk, D),
-                         lambda b, h, i, j, g=g: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), k_map),
+            pl.BlockSpec((1, 1, bk, D), v_map),
         ],
         out_specs=(pl.BlockSpec((1, 1, bq, D),
                                 lambda b, h, i, j: (b, h, i, 0)),
                    pl.BlockSpec((1, 1, 8, bq),
                                 lambda b, h, i, j: (b, h, 0, i))),
-        scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),   # running max m
-            pltpu.VMEM((bq, 1), jnp.float32),   # running denom l
-            pltpu.VMEM((bq, D), jnp.float32),   # output accumulator
-        ],
+        scratch_shapes=scratch,
         compiler_params=params,
         interpret=interpret,
     )(qp, kp, vp)
@@ -700,22 +861,23 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal: bool, interpret: bool,
     return dq, dk[:, :, :kvlen], dv[:, :, :kvlen]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, interpret, block_q, block_kv, window, bwd_impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, interpret, block_q, block_kv, window, bwd_impl,
+           fwd_impl):
     out, _ = _flash_call(q, k, v, causal, interpret, block_q, block_kv,
-                         window)
+                         window, pipelined=fwd_impl == "pipelined")
     return out
 
 
 def _flash_fwd(q, k, v, causal, interpret, block_q, block_kv, window,
-               bwd_impl):
+               bwd_impl, fwd_impl):
     out, lse = _flash_call(q, k, v, causal, interpret, block_q, block_kv,
-                           window)
+                           window, pipelined=fwd_impl == "pipelined")
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, interpret, block_q, block_kv, window, bwd_impl,
-               res, do):
+               fwd_impl, res, do):
     """Backward dispatch. ``bwd_impl`` ("xla" | "pallas") arrives as a
     nondiff argument resolved by :func:`_resolve_flash_bwd` at call time,
     so the selected backward is deterministic per trace — no cached-vjp
@@ -820,7 +982,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_q: int | None = None,
                     block_kv: int | None = None,
                     window: int | None = None,
-                    bwd_impl: str | None = None) -> jax.Array:
+                    bwd_impl: str | None = None,
+                    fwd_impl: str | None = None) -> jax.Array:
     """Fused attention over [B, H, S, D] queries; k/v may carry fewer
     (GQA) heads — H_kv must divide H and is streamed, never expanded.
 
@@ -865,11 +1028,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # resolved string is a static argument of the jit cache key.
     return _flash_attention_jit(q, k, v, bool(causal), bool(interpret),
                                 block_q, block_kv, window,
-                                _resolve_flash_bwd(bwd_impl))
+                                _resolve_flash_bwd(bwd_impl),
+                                _resolve_flash_fwd(fwd_impl))
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_attention_jit(q, k, v, causal, interpret, block_q, block_kv,
-                         window, bwd_impl):
+                         window, bwd_impl, fwd_impl):
     return _flash(q, k, v, causal, interpret, block_q, block_kv,
-                  window, bwd_impl)
+                  window, bwd_impl, fwd_impl)
