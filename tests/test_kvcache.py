@@ -222,3 +222,78 @@ def test_rolling_int8_cache_composes():
         l, ring = forward_cached(params, tokens[:, pos:pos + 1], ring,
                                  pos, cfg)
     assert bool(jnp.isfinite(l).all())
+
+
+# -- flash prefill in the serving path (VERDICT r3 item 8) ------------------
+
+def _cfg_pair(**extra):
+    import dataclasses
+
+    from tpushare.workloads.model import ModelConfig
+    base = ModelConfig(vocab=64, d_model=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, d_ff=128, dtype=jnp.float32, **extra)
+    return (dataclasses.replace(base, attn="einsum"),
+            dataclasses.replace(base, attn="flash"))
+
+
+def test_flash_prefill_matches_einsum_prefill():
+    # prefill-from-zero is plain causal self-attention over the chunk,
+    # so the fused kernel must reproduce the buffer einsum exactly (up
+    # to kernel rounding); windowed variant included
+    for extra in ({}, {"attn_window": 16}):
+        cfg_e, cfg_f = _cfg_pair(**extra)
+        p = init_params(cfg_e, jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 40), 0, 64)
+        le, ce = forward_cached(p, toks, init_kv_cache(cfg_e, 2, 64),
+                                jnp.asarray(0), cfg_e)
+        lf, cf = forward_cached(p, toks, init_kv_cache(cfg_f, 2, 64),
+                                jnp.asarray(0), cfg_f)
+        np.testing.assert_allclose(np.asarray(le), np.asarray(lf),
+                                   atol=1e-4, rtol=1e-4)
+        # caches agree to kernel-rounding: layer n>1's k/v inherit the
+        # previous layer's attention output, so flash-vs-einsum rounding
+        # (~1e-6 fp32) propagates into the stored values — identity
+        # holds only for layer 1, closeness for all
+        for name in ce:
+            np.testing.assert_allclose(np.asarray(ce[name]),
+                                       np.asarray(cf[name]),
+                                       atol=1e-4, rtol=1e-3)
+
+
+def test_flash_prefill_decode_tokens_match():
+    cfg_e, cfg_f = _cfg_pair(attn_window=16)
+    p = init_params(cfg_e, jax.random.key(2))
+    toks = jax.random.randint(jax.random.key(3), (2, 24), 0, 64)
+    oe = greedy_decode_kv(p, toks, 8, cfg_e)
+    of = greedy_decode_kv(p, toks, 8, cfg_f)
+    np.testing.assert_array_equal(np.asarray(oe), np.asarray(of))
+
+
+def test_flash_prefill_int8_cache_documented_semantics():
+    # int8 cache: the flash prefill attends PRE-quantization k/v while
+    # the einsum path reads the quantized buffer, so logits (and the
+    # cached values of layers > 1, which inherit layer 1's divergence)
+    # differ within quantization error — bounded, finite, and the
+    # decode that follows still works end to end
+    cfg_e, cfg_f = _cfg_pair(kv_cache_dtype="int8", attn_window=16)
+    p = init_params(cfg_e, jax.random.key(4))
+    toks = jax.random.randint(jax.random.key(5), (2, 24), 0, 64)
+    le, _ce = forward_cached(p, toks, init_kv_cache(cfg_e, 2, 32),
+                             jnp.asarray(0), cfg_e)
+    lf, _cf = forward_cached(p, toks, init_kv_cache(cfg_f, 2, 32),
+                             jnp.asarray(0), cfg_f)
+    np.testing.assert_allclose(np.asarray(le), np.asarray(lf), atol=0.3)
+    out = greedy_decode_kv(p, toks, 6, cfg_f)
+    assert out.shape == (2, 30)
+
+
+def test_flash_prefill_not_used_midstream_or_rolling():
+    # mid-stream chunks and ring buffers keep the einsum core (their
+    # masks are not plain causal); behavior must be identical under
+    # either attn setting there
+    cfg_e, cfg_f = _cfg_pair(attn_window=8)
+    p = init_params(cfg_e, jax.random.key(6))
+    toks = jax.random.randint(jax.random.key(7), (1, 30), 0, 64)
+    oe = greedy_decode_kv(p, toks, 6, cfg_e, rolling=True)
+    of = greedy_decode_kv(p, toks, 6, cfg_f, rolling=True)
+    np.testing.assert_array_equal(np.asarray(oe), np.asarray(of))

@@ -205,7 +205,7 @@ def _causal_class_dispatch(pl, step, gate, i, j, block_q: int,
             step(True, False)
 
 
-def _mask_scores(s, i, j, block_q, block_kv, seq, window,
+def _mask_scores(s, i, j, block_kv, seq, window,
                  mask_causal: bool, mask_pad: bool, mask_window: bool):
     """Apply the selected mask classes to a [BQ, BK] score block for
     kv block ``j``. Shared by the step and pipelined forward kernels —
@@ -326,7 +326,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BQ, BK]
-        s = _mask_scores(s, i, j, block_q, block_kv, seq, window,
+        s = _mask_scores(s, i, j, block_kv, seq, window,
                          mask_causal, mask_pad, mask_window)
         _online_softmax_accum(s, v_ref[0, 0], m_ref, l_ref, acc_ref)
 
@@ -436,7 +436,7 @@ def _flash_kernel_pipelined(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     def _consume(mask_causal: bool, mask_pad: bool,
                  mask_window: bool = False):
-        s = _mask_scores(s_ref[jj % 2], i, jj, block_q, block_kv, seq,
+        s = _mask_scores(s_ref[jj % 2], i, jj, block_kv, seq,
                          window, mask_causal, mask_pad, mask_window)
         _online_softmax_accum(s, v_ref[0, 0], m_ref, l_ref, acc_ref)
 

@@ -43,8 +43,11 @@ class ModelConfig:
     # attention implementation: "einsum" (XLA-fused) or "flash" (Pallas
     # online-softmax kernel, differentiable via its blockwise custom VJP;
     # see tpushare/workloads/attention.py). Both train and serve; the
-    # KV-cached decode path always uses the einsum core (its single-token
-    # queries don't amortize a fused kernel).
+    # KV-cached decode STEPS always use the einsum core (single-token
+    # queries don't amortize a fused kernel), but a prefill-from-zero
+    # with attn="flash" runs the Pallas kernel over the chunk itself —
+    # T x T causal instead of einsum over the full T x M buffer — which
+    # is where serving's time-to-first-token goes (forward_cached).
     attn: str = "einsum"
     # sliding-window (local) attention span: None = full causal. Applies
     # to every path — the flash kernel skips blocks below the window
@@ -477,7 +480,8 @@ def _ffn_block(x: jax.Array, lp: dict, cfg: ModelConfig):
 
 
 def forward_cached(params: dict, tokens: jax.Array, cache: dict,
-                   pos_offset: jax.Array, cfg: ModelConfig):
+                   pos_offset: jax.Array, cfg: ModelConfig,
+                   prefill_from_zero: bool | None = None):
     """Incremental forward: attend the T new tokens against the KV cache.
 
     tokens [B, T] occupy global positions pos_offset..pos_offset+T-1; their
@@ -562,6 +566,26 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
         return lax.dynamic_update_slice(buf, new.astype(buf.dtype),
                                         (0, pos_offset, 0, 0))
 
+    # flash prefill fast path: a prefill from GLOBAL position 0 attends
+    # only the T tokens being written, under exactly a causal(+window)
+    # mask — standard self-attention, so the fused kernel applies and
+    # the T x M buffer einsum (mostly masked columns) is skipped. Decode
+    # steps (T == 1) and mid-stream/ring chunks keep the einsum core.
+    # With an int8 cache the prefill then attends the PRE-quantization
+    # k/v (full precision, strictly less rounding than the einsum path's
+    # quantized-cache read); the cache still stores int8 for later steps.
+    # ``prefill_from_zero``: pass True/False to select deterministically
+    # (greedy_decode_kv does); None infers from a CONCRETE pos_offset ==
+    # 0, which a jit-traced pos_offset can never satisfy — an inferring
+    # caller that jits pos_offset as an argument silently keeps the
+    # einsum path (correct, just slower; and with int8 caches the two
+    # paths round differently), so serving code should be explicit.
+    if prefill_from_zero is None:
+        prefill_from_zero = (not isinstance(pos_offset, jax.core.Tracer)
+                             and int(pos_offset) == 0)
+    flash_prefill = (cfg.attn == "flash" and T > 1 and not rolling
+                     and prefill_from_zero)
+
     def layer(x, xs):
         lp, c = xs  # c: this layer's cache slices (dict pytree)
         h = _rmsnorm(x, lp["attn_norm"])
@@ -583,6 +607,16 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
         else:
             c = dict(k=write(c["k"], k), v=write(c["v"], v))
             kd, vd = c["k"], c["v"]
+        if flash_prefill:
+            from tpushare.workloads.attention import flash_attention
+            o = flash_attention(q.transpose(0, 2, 1, 3),   # [B, nh, T, hd]
+                                k.transpose(0, 2, 1, 3),   # GQA-native
+                                v.transpose(0, 2, 1, 3),
+                                causal=True, window=cfg.attn_window)
+            attn_flat = o.transpose(0, 2, 1, 3).reshape(B, T, nh * hd)
+            x = x + _matmul(attn_flat, lp["wo"])
+            x, _aux = _ffn_block(x, lp, cfg)
+            return x, c
         # grouped-query attention against the buffer without expanding the
         # cache to n_heads: group axis g = kv head, r = queries per group
         qg = q.reshape(B, T, nkv, reps, hd)
@@ -659,7 +693,8 @@ def greedy_decode_kv(params: dict, prompt: jax.Array, steps: int,
                 params, prompt[:, off:off + W], cache, off, cfg)
     else:
         cache = init_kv_cache(cfg, B, total)
-        logits, cache = forward_cached(params, prompt, cache, 0, cfg)
+        logits, cache = forward_cached(params, prompt, cache, 0, cfg,
+                                       prefill_from_zero=True)
     tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)   # [B]
     buf = buf.at[:, S].set(tok)
 

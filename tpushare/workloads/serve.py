@@ -101,11 +101,15 @@ def main(argv: list[str] | None = None) -> int:
               "--no-kv-cache (the reference decode path allocates no KV "
               "cache)", flush=True)
     if args.attn == "flash" and not args.no_kv_cache:
-        # the KV-cached decode attends single-token queries against the
-        # cache with the einsum core; the fused kernel only applies to the
-        # cache-free path (or training/prefill-style full-sequence runs)
-        print("note: --attn flash has no effect on the KV-cached decode "
-              "path; pass --no-kv-cache to serve with the fused kernel",
+        # decode STEPS attend single-token queries with the einsum core
+        # either way; what flash changes on the KV-cached path is the
+        # PREFILL (forward_cached's prefill-from-zero runs the fused
+        # kernel over the prompt chunk — the time-to-first-token cost).
+        # Rolling-ring prefills chunk mid-stream and keep einsum.
+        which = ("prefill only (ring chunks use einsum)"
+                 if args.rolling_kv else "prefill (time-to-first-token)")
+        print(f"note: --attn flash accelerates the {which}; decode "
+              "steps use the einsum core on any KV-cached path",
               flush=True)
     if args.no_kv_cache:
         decode_fn = lambda p, t, n: greedy_decode(p, t, n, cfg)
