@@ -6,6 +6,11 @@ first import via g++. Falls back to the pure-Python implementation in
 are behaviorally identical (tests/test_native_parity.py).
 """
 
-from tpushare.core.native.engine import available, select_chips, warmup
+from tpushare.core.native.engine import (
+    available,
+    select_chips,
+    select_gang_box,
+    warmup,
+)
 
-__all__ = ["available", "select_chips", "warmup"]
+__all__ = ["available", "select_chips", "select_gang_box", "warmup"]

@@ -412,3 +412,81 @@ def select_chips(chips: "Sequence[ChipView]", topo: "MeshTopology",
                      box=tuple(int(out_box[i]) for i in range(rank)),
                      origin=tuple(int(out_origin[i]) for i in range(rank)),
                      score=int(out_score[0]))
+
+
+def select_gang_box(slice_topo, views, req, merged=None):
+    """Native gang box search (tpushare_select_gang); returns
+    (box, origin) | None (no fit), or the string "fallback" when the
+    native engine can't express the problem — the caller
+    (slice.select_gang) then runs the Python search. The per-host
+    decomposition (GangPlacement construction) always stays in Python:
+    it runs once per decision, the SEARCH is the hot part. ``merged``
+    optionally reuses the caller's global_view merge (one O(chips)
+    pass per decision instead of two).
+    """
+    lib = _load()
+    if lib is None or req.allow_scatter:
+        return "fallback"
+    try:
+        fn = lib.tpushare_select_gang
+    except AttributeError:
+        return "fallback"  # stale prebuilt .so without the symbol
+    if not getattr(fn, "_tpushare_typed", False):
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_int,                    # n_chips (global)
+            ctypes.POINTER(ctypes.c_int64),  # free per global chip
+            ctypes.POINTER(ctypes.c_int64),  # total per global chip
+            ctypes.POINTER(ctypes.c_int64),  # host ordinal per chip
+            ctypes.c_int,                    # n_hosts
+            ctypes.c_int,                    # rank
+            ctypes.POINTER(ctypes.c_int64),  # mesh dims
+            ctypes.c_int64,                  # req hbm
+            ctypes.c_int,                    # req count
+            ctypes.c_int,                    # topo rank
+            ctypes.POINTER(ctypes.c_int64),  # topo dims
+            ctypes.POINTER(ctypes.c_int64),  # out box
+            ctypes.POINTER(ctypes.c_int64),  # out origin
+            ctypes.POINTER(ctypes.c_int64),  # out score
+            ctypes.POINTER(ctypes.c_int64),  # out hosts
+        ]
+        fn._tpushare_typed = True
+
+    mesh = slice_topo.mesh
+    rank = len(mesh.shape)
+    n = mesh.num_chips
+    if merged is None:
+        merged = slice_topo.global_view(views)
+    host_ord = {name: i for i, name in enumerate(slice_topo.hosts)}
+    free = (ctypes.c_int64 * n)(*[-1] * n)
+    total = (ctypes.c_int64 * n)()
+    host_of = (ctypes.c_int64 * n)(*[-1] * n)
+    for gcoords, view in merged.items():
+        idx = mesh.index(gcoords)
+        total[idx] = view.total_hbm_mib
+        host_of[idx] = host_ord[slice_topo.host_of(gcoords)]
+        if view.healthy and not (req.hbm_mib == 0 and view.used_hbm_mib):
+            free[idx] = view.free_hbm_mib
+    # chips with no snapshot (missing host) keep free = -1 (ineligible)
+    # but still need a valid host ordinal for the ABI
+    for gcoords, name in slice_topo._host_of.items():
+        idx = mesh.index(gcoords)
+        if host_of[idx] < 0:
+            host_of[idx] = host_ord[name]
+
+    shape = (ctypes.c_int64 * rank)(*mesh.shape)
+    t_rank = len(req.topology) if req.topology else 0
+    t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
+    out_box = (ctypes.c_int64 * rank)()
+    out_origin = (ctypes.c_int64 * rank)()
+    out_score = (ctypes.c_int64 * 1)()
+    out_hosts = (ctypes.c_int64 * 1)()
+    rc = fn(n, free, total, host_of, len(slice_topo.hosts), rank, shape,
+            req.hbm_mib, req.chip_count, t_rank, t_dims,
+            out_box, out_origin, out_score, out_hosts)
+    if rc < 0:
+        return "fallback"
+    if rc == 0:
+        return None
+    return (tuple(int(out_box[i]) for i in range(rank)),
+            tuple(int(out_origin[i]) for i in range(rank)))

@@ -333,3 +333,117 @@ scatter:
     return 1;
   }
 }
+
+// Gang selector over a multi-host SLICE mesh (tpushare/core/slice.py
+// select_gang is the behavioral spec; docs/designs/multihost-gang.md).
+// Same sub-box search as tpushare_select_chips, but the comparison key
+// is (hosts_spanned, score, origin-lex): inter-host links inside a
+// slice are ICI, so host crossings cost COORDINATION (kubelets in the
+// gang, blast radius), not bandwidth — fewest hosts leads, binpack
+// breaks ties, ascending origin iteration resolves the rest. Shape
+// classes run most-ICI-compact first with the same first-class-wins
+// early break. No scatter mode: gangs are contiguous by definition.
+//
+// host_of maps global chip idx -> host ordinal in [0, n_hosts);
+// free_hbm[i] < 0 marks an ineligible chip (unhealthy, missing host
+// snapshot, exclusive-busy — the caller folds eligibility in).
+extern "C" int tpushare_select_gang(
+    int n_chips,
+    const int64_t* free_hbm,
+    const int64_t* total_hbm,
+    const int64_t* host_of,
+    int n_hosts,
+    int rank,
+    const int64_t* mesh,
+    int64_t req_hbm,           // 0 => exclusive (demand = chip total)
+    int req_count,
+    int topo_rank,             // 0 => any shape
+    const int64_t* topo_dims,
+    int64_t* out_box,
+    int64_t* out_origin,
+    int64_t* out_score,
+    int64_t* out_hosts) {
+  if (n_chips <= 0 || rank <= 0 || req_count <= 0 || n_hosts <= 0)
+    return -1;
+  if (req_count > n_chips) return 0;
+  int64_t mesh_n = 1;
+  for (int i = 0; i < rank; ++i) mesh_n *= mesh[i];
+  if (mesh_n != n_chips) return -1;
+
+  auto demand = [&](int i) -> int64_t {
+    return req_hbm == 0 ? total_hbm[i] : req_hbm;
+  };
+  auto eligible = [&](int i) -> bool {
+    return free_hbm[i] >= 0 && free_hbm[i] >= demand(i);
+  };
+
+  std::vector<Shape> shapes;
+  if (topo_rank > 0) {
+    if (topo_rank != rank) return 0;  // rank-mismatched pin cannot match
+    Shape s; s.d.assign(topo_dims, topo_dims + topo_rank);
+    int64_t prod = 1;
+    for (auto d : s.d) prod *= d;
+    if (prod != req_count) return 0;
+    shapes.push_back(std::move(s));
+  } else {
+    std::vector<int64_t> prefix;
+    enum_shapes(mesh, rank, 0, req_count, prefix, shapes);
+    std::sort(shapes.begin(), shapes.end(), shape_less);
+  }
+
+  std::vector<int64_t> origin(rank), c(rank), abs(rank);
+  std::vector<int64_t> best_origin(rank), best_box(rank);
+  std::vector<char> host_seen(n_hosts);
+  for (const auto& shape : shapes) {
+    bool fits_mesh = true;
+    for (int i = 0; i < rank; ++i)
+      if (shape.d[i] > mesh[i]) { fits_mesh = false; break; }
+    if (!fits_mesh) continue;
+
+    bool found = false;
+    int64_t best_score = 0, best_hosts = 0;
+    std::fill(origin.begin(), origin.end(), 0);
+    while (true) {
+      int64_t score = 0, hosts = 0;
+      bool ok = true;
+      std::fill(host_seen.begin(), host_seen.end(), 0);
+      std::fill(c.begin(), c.end(), 0);
+      while (true) {
+        for (int i = 0; i < rank; ++i) abs[i] = origin[i] + c[i];
+        int64_t idx = chip_index(mesh, rank, abs.data());
+        if (!eligible((int)idx)) { ok = false; break; }
+        score += free_hbm[idx] - demand((int)idx);
+        int64_t h = host_of[idx];
+        if (h < 0 || h >= n_hosts) { ok = false; break; }
+        if (!host_seen[h]) { host_seen[h] = 1; ++hosts; }
+        int ax = rank - 1;
+        while (ax >= 0 && ++c[ax] == shape.d[ax]) c[ax--] = 0;
+        if (ax < 0) break;
+      }
+      // ascending-origin iteration + strict less keeps the earliest
+      // origin on (hosts, score) ties — matching the Python key's
+      // trailing origin-lex component
+      if (ok && (!found || hosts < best_hosts ||
+                 (hosts == best_hosts && score < best_score))) {
+        found = true;
+        best_hosts = hosts;
+        best_score = score;
+        best_origin = origin;
+        best_box = shape.d;
+      }
+      int ax = rank - 1;
+      while (ax >= 0 && ++origin[ax] > mesh[ax] - shape.d[ax]) origin[ax--] = 0;
+      if (ax < 0) break;
+    }
+    if (found) {
+      for (int i = 0; i < rank; ++i) {
+        out_box[i] = best_box[i];
+        out_origin[i] = best_origin[i];
+      }
+      *out_score = best_score;
+      *out_hosts = best_hosts;
+      return 1;
+    }
+  }
+  return 0;
+}

@@ -176,7 +176,29 @@ def fits_gang(slice_topo: SliceTopology,
 def select_gang(slice_topo: SliceTopology,
                 views: Mapping[str, Sequence[ChipView]],
                 req: PlacementRequest) -> GangPlacement | None:
-    """Bind-path gang selector (see module docstring for policy)."""
+    """Bind-path gang selector (see module docstring for policy).
+
+    The box SEARCH — the O(shapes x positions x chips) part — runs in
+    the native engine when available (placement.cpp
+    tpushare_select_gang, same relationship as select_chips /
+    select_chips_py); the per-host GangPlacement decomposition always
+    runs here. Parity: tests/test_native_parity.py.
+    """
+    if req.allow_scatter:
+        raise ValueError("gangs are contiguous by definition; "
+                         "scatter placement is a single-host mode")
+    from tpushare.core import native  # late import: optional C++ engine
+    merged = slice_topo.global_view(views)
+    r = native.select_gang_box(slice_topo, views, req, merged=merged)
+    if r != "fallback":
+        if r is None:
+            return None
+        box, origin = r
+        coords_list = [
+            tuple(o + d for o, d in zip(origin, delta))
+            for delta in SliceTopology._box_coords((0,) * len(box), box)]
+        return _build_gang(slice_topo, box, origin, coords_list, merged,
+                           req)
     return _search_gang(slice_topo, views, req, first_only=False)
 
 

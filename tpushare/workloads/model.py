@@ -595,18 +595,8 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
             vq8, vs = _kv_quant(v)
             c = dict(k=write(c["k"], kq8), v=write(c["v"], vq8),
                      ks=write(c["ks"], ks), vs=write(c["vs"], vs))
-            # scales factor OUT of both contractions (they are constant
-            # over the contracted head_dim axis), so no dequantized
-            # [B, M, n_kv, hd] buffer is ever built: the dot operands are
-            # a plain int8->bf16 convert of the cache, and the per-key
-            # scales apply to the [.., M]-shaped scores/probs instead —
-            # hd-times less elementwise work than full dequant
-            kd, vd = c["k"].astype(x.dtype), c["v"].astype(x.dtype)
-            ks_t = jnp.moveaxis(c["ks"][..., 0], 1, 2)  # [B, n_kv, M]
-            vs_t = jnp.moveaxis(c["vs"][..., 0], 1, 2)
         else:
             c = dict(k=write(c["k"], k), v=write(c["v"], v))
-            kd, vd = c["k"], c["v"]
         if flash_prefill:
             from tpushare.workloads.attention import flash_attention
             o = flash_attention(q.transpose(0, 2, 1, 3),   # [B, nh, T, hd]
@@ -614,23 +604,37 @@ def forward_cached(params: dict, tokens: jax.Array, cache: dict,
                                 v.transpose(0, 2, 1, 3),
                                 causal=True, window=cfg.attn_window)
             attn_flat = o.transpose(0, 2, 1, 3).reshape(B, T, nh * hd)
-            x = x + _matmul(attn_flat, lp["wo"])
-            x, _aux = _ffn_block(x, lp, cfg)
-            return x, c
-        # grouped-query attention against the buffer without expanding the
-        # cache to n_heads: group axis g = kv head, r = queries per group
-        qg = q.reshape(B, T, nkv, reps, hd)
-        scores = jnp.einsum("btgrd,bmgd->bgrtm", qg, kd).astype(jnp.float32)
-        if int8_cache:
-            scores = scores * ks_t[:, :, None, None, :]
-        scores = scores * (hd ** -0.5)
-        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        if int8_cache:
-            probs = probs * vs_t[:, :, None, None, :]
-        probs = probs.astype(x.dtype)
-        attn = jnp.einsum("bgrtm,bmgd->btgrd", probs, vd)
-        x = x + _matmul(attn.reshape(B, T, nh * hd), lp["wo"])
+        else:
+            if int8_cache:
+                # scales factor OUT of both contractions (constant over
+                # the contracted head_dim axis), so no dequantized
+                # [B, M, n_kv, hd] buffer is ever built: the dot
+                # operands are a plain int8->bf16 convert of the cache,
+                # and the per-key scales apply to the [.., M]-shaped
+                # scores/probs instead — hd-times less elementwise work
+                # than full dequant
+                kd, vd = c["k"].astype(x.dtype), c["v"].astype(x.dtype)
+                ks_t = jnp.moveaxis(c["ks"][..., 0], 1, 2)  # [B, n_kv, M]
+                vs_t = jnp.moveaxis(c["vs"][..., 0], 1, 2)
+            else:
+                kd, vd = c["k"], c["v"]
+            # grouped-query attention against the buffer without
+            # expanding the cache to n_heads: group axis g = kv head,
+            # r = queries per group
+            qg = q.reshape(B, T, nkv, reps, hd)
+            scores = jnp.einsum("btgrd,bmgd->bgrtm", qg,
+                                kd).astype(jnp.float32)
+            if int8_cache:
+                scores = scores * ks_t[:, :, None, None, :]
+            scores = scores * (hd ** -0.5)
+            scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            if int8_cache:
+                probs = probs * vs_t[:, :, None, None, :]
+            probs = probs.astype(x.dtype)
+            attn = jnp.einsum("bgrtm,bmgd->btgrd", probs, vd)
+            attn_flat = attn.reshape(B, T, nh * hd)
+        x = x + _matmul(attn_flat, lp["wo"])
         x, _aux = _ffn_block(x, lp, cfg)  # aux only matters in training
         return x, c
 
