@@ -1,0 +1,291 @@
+"""Multi-host gang placement through the full extender stack.
+
+docs/designs/multihost-gang.md protocol, executed over real HTTP against
+a FakeCluster v5e-16 (4 slice-labeled 2x2 hosts): Filter answers each
+member with exactly its planned host, the first Bind reserves EVERY
+member's share all-or-nothing and stamps the plan, later Binds replay
+from it, and abandonment releases the reserved shares. The reference
+cannot express any of this (single-node allocator, nodeinfo.go:312-363).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.cache.gang import GangCoordinator, GangError
+from tpushare.controller import Controller
+from tpushare.extender.metrics import Registry
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+
+HOSTS = ["s0h0", "s0h1", "s0h2", "s0h3"]
+ORIGINS = ["0x0", "0x2", "2x0", "2x2"]
+
+
+def make_slice_cluster() -> FakeCluster:
+    fc = FakeCluster()
+    for name, origin in zip(HOSTS, ORIGINS):
+        fc.add_tpu_node(name, chips=4, hbm_per_chip_mib=16000, mesh="2x2",
+                        slice_id="slc0", slice_origin=origin)
+    # plus an unrelated single-host node: gangs must never land on it
+    fc.add_tpu_node("lone", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    return fc
+
+
+def gang_pod(fc, name, rank, size=8, hbm=0, count=4, topology="2x4",
+             gang_id="g1"):
+    pod = {
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {
+                         contract.ANN_GANG: gang_id,
+                         contract.ANN_GANG_SIZE: str(size),
+                         contract.ANN_GANG_RANK: str(rank),
+                         contract.ANN_TOPOLOGY: topology,
+                     }},
+        "spec": {"containers": [{"name": "c", "resources": {"limits": {
+            contract.RESOURCE_COUNT: str(count),
+            **({contract.RESOURCE_HBM: str(hbm * count)} if hbm else {}),
+        }}}]},
+    }
+    return fc.create_pod(pod)
+
+
+@pytest.fixture
+def rig():
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    ctl.start()
+    server = ExtenderServer(cache, fc, Registry(), host="127.0.0.1",
+                            port=0)
+    port = server.start()
+    yield fc, cache, server, f"http://127.0.0.1:{port}/tpushare-scheduler"
+    server.stop()
+    ctl.stop()
+
+
+def post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def all_nodes():
+    return HOSTS + ["lone"]
+
+
+def test_gang_filter_returns_exactly_the_planned_host(rig):
+    fc, cache, server, base = rig
+    p0 = gang_pod(fc, "gp0", rank=0)
+    _, out = post(f"{base}/filter", {"Pod": p0, "NodeNames": all_nodes()})
+    assert out["Error"] == ""
+    assert len(out["NodeNames"]) == 1
+    assert out["NodeNames"][0] in HOSTS  # never the unlabeled node
+    # rank 1 gets the OTHER host of the 2x4 placement
+    p1 = gang_pod(fc, "gp1", rank=1)
+    _, out1 = post(f"{base}/filter", {"Pod": p1,
+                                      "NodeNames": all_nodes()})
+    assert len(out1["NodeNames"]) == 1
+    assert out1["NodeNames"][0] != out["NodeNames"][0]
+
+
+def test_gang_bind_end_to_end_two_members(rig):
+    fc, cache, server, base = rig
+    pods = [gang_pod(fc, f"gp{r}", rank=r) for r in (0, 1)]
+    hosts = []
+    for r, pod in enumerate(pods):
+        _, flt = post(f"{base}/filter", {"Pod": pod,
+                                         "NodeNames": all_nodes()})
+        (host,) = flt["NodeNames"]
+        status, bound = post(f"{base}/bind", {
+            "PodName": pod["metadata"]["name"], "PodNamespace": "default",
+            "PodUID": pod["metadata"]["uid"], "Node": host})
+        assert status == 200 and not bound.get("Error"), bound
+        hosts.append(host)
+    assert len(set(hosts)) == 2
+    # placement annotations landed, incl. the plan on the FIRST member
+    first = fc.get_pod("default", "gp0")
+    second = fc.get_pod("default", "gp1")
+    plan = contract.gang_plan_from_annotations(first)
+    assert plan is not None and plan["id"] == "g1"
+    assert contract.gang_plan_from_annotations(second) is None
+    for pod_obj in (first, second):
+        ids = contract.chip_ids_from_annotations(pod_obj)
+        assert ids is not None and len(ids) == 4
+        ann = pod_obj["metadata"]["annotations"]
+        assert ann[contract.ANN_GANG] == "g1"
+    # both hosts' chips are fully occupied (exclusive 2x2 each)
+    for host in hosts:
+        info = cache.get_node_info(host)
+        views = info.snapshot()
+        assert all(v.free_hbm_mib == 0 for v in views)
+    # the coordinator dropped the fully-bound plan
+    assert server.gang._plans == {}
+
+
+def test_first_bind_reserves_every_members_share(rig):
+    fc, cache, server, base = rig
+    p0 = gang_pod(fc, "gp0", rank=0)
+    _, flt = post(f"{base}/filter", {"Pod": p0, "NodeNames": all_nodes()})
+    (host0,) = flt["NodeNames"]
+    status, bound = post(f"{base}/bind", {
+        "PodName": "gp0", "PodNamespace": "default",
+        "PodUID": p0["metadata"]["uid"], "Node": host0})
+    assert status == 200 and not bound.get("Error"), bound
+    # the UNBOUND member's host is already reserved: an exclusive
+    # single-host pod no longer fits ANY slice host (the other two hosts
+    # are free, but the gang took one and reserved another... find the
+    # reserved one via the plan)
+    plan = contract.gang_plan_from_annotations(
+        fc.get_pod("default", "gp0"))
+    partner = next(m["host"] for m in plan["members"]
+                   if m["host"] != host0)
+    info = cache.get_node_info(partner)
+    assert all(v.free_hbm_mib == 0 for v in info.snapshot()), \
+        "partner host's share must be reserved before its bind arrives"
+
+
+def test_gang_no_fit_is_all_or_nothing(rig):
+    fc, cache, server, base = rig
+    # occupy one chip on every host: no 2x4 exists anywhere
+    for i, host in enumerate(HOSTS):
+        single = fc.create_pod({
+            "metadata": {"name": f"t{i}", "namespace": "default",
+                         "annotations": {}},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "limits": {contract.RESOURCE_COUNT: "1"}}}]}})
+        status, bound = post(f"{base}/bind", {
+            "PodName": f"t{i}", "PodNamespace": "default",
+            "PodUID": single["metadata"]["uid"], "Node": host})
+        assert status == 200 and not bound.get("Error")
+    p0 = gang_pod(fc, "gp0", rank=0)
+    _, out = post(f"{base}/filter", {"Pod": p0, "NodeNames": all_nodes()})
+    assert out["NodeNames"] == []
+    assert "all-or-nothing" in json.dumps(out["FailedNodes"])
+    # and nothing got reserved anywhere
+    for host in HOSTS:
+        info = cache.get_node_info(host)
+        reserved = sum(1 for v in info.snapshot()
+                       if v.used_hbm_mib not in (0, v.total_hbm_mib))
+        assert reserved == 0
+
+
+def test_bind_to_unplanned_node_refused(rig):
+    fc, cache, server, base = rig
+    p0 = gang_pod(fc, "gp0", rank=0)
+    _, flt = post(f"{base}/filter", {"Pod": p0, "NodeNames": all_nodes()})
+    (planned,) = flt["NodeNames"]
+    wrong = next(h for h in HOSTS if h != planned)
+    status, bound = post(f"{base}/bind", {
+        "PodName": "gp0", "PodNamespace": "default",
+        "PodUID": p0["metadata"]["uid"], "Node": wrong})
+    assert bound.get("Error"), bound
+    assert "planned onto" in bound["Error"]
+
+
+def test_malformed_gang_annotations_error_at_filter(rig):
+    fc, cache, server, base = rig
+    pod = fc.create_pod({
+        "metadata": {"name": "bad", "namespace": "default",
+                     "annotations": {contract.ANN_GANG: "gX"}},
+        "spec": {"containers": [{"name": "c", "resources": {
+            "limits": {contract.RESOURCE_COUNT: "4"}}}]}})
+    _, out = post(f"{base}/filter", {"Pod": pod,
+                                     "NodeNames": all_nodes()})
+    assert "gang" in out["Error"]
+
+
+def test_gang_gc_releases_abandoned_shares():
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    try:
+        gang = GangCoordinator(cache)
+        clock = [1_000_000_000]
+        p0 = gang_pod(fc, "gp0", rank=0)
+        gang.bind_member(p0, gang.filter_hosts(p0)[0][0], fc,
+                         now_ns=lambda: clock[0])
+        # rank 1 never binds; its share is reserved
+        plan_ann = contract.gang_plan_from_annotations(
+            fc.get_pod("default", "gp0"))
+        partner = next(m["host"] for m in plan_ann["members"]
+                       if m["chips"] != contract.chip_ids_from_annotations(
+                           fc.get_pod("default", "gp0"))
+                       or m["host"] != fc.get_pod(
+                           "default", "gp0")["spec"].get("nodeName"))
+        clock[0] += GangCoordinator.PLAN_TTL_NS + 1
+        assert gang.gc(now_ns=lambda: clock[0]) == 1
+        # the partner's share is free again; the bound member keeps its
+        bound_host = fc.get_pod("default", "gp0")["spec"]["nodeName"]
+        for host in HOSTS:
+            info = cache.get_node_info(host)
+            free = sum(v.free_hbm_mib for v in info.snapshot())
+            if host == bound_host:
+                assert free == 0
+            else:
+                assert free == 4 * 16000, host
+    finally:
+        pass
+
+
+def test_gang_rollback_when_a_share_cannot_reserve():
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    p0 = gang_pod(fc, "gp0", rank=0, gang_id="g2")
+    # deterministic plan->reserve race: pin the plan the coordinator
+    # will use, then steal the second member's chips BEFORE bind — the
+    # exact "slice state moved since planning" window
+    gang = GangCoordinator(cache)
+    plan_preview = gang._compute_plan("g2", p0, 8, 1)
+    victim_host, victim_chips, _b, _o = plan_preview.members[1]
+    first_host = plan_preview.members[0][0]
+    gang._compute_plan = lambda *a, **k: plan_preview
+    cache.get_node_info(victim_host).reserve_planned(
+        "foreign", victim_chips, 16000)
+    with pytest.raises(GangError, match="all-or-nothing"):
+        gang.bind_member(p0, first_host, fc, now_ns=lambda: 2)
+    # the FIRST member's reservation was rolled back: all-or-nothing
+    finfo = cache.get_node_info(first_host)
+    assert all(v.used_hbm_mib == 0 for v in finfo.snapshot())
+    # and no plan was retained
+    assert gang._plans == {}
+
+
+def test_gc_keeps_partial_plan_geometry_for_late_members():
+    # ranks 0 binds, rank 1 stalls past TTL: gc releases rank 1's
+    # reservation but KEEPS the plan — the late bind must land on the
+    # ORIGINAL geometry, not a fresh plan inconsistent with rank 0
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    Controller(fc, cache).build_cache()
+    gang = GangCoordinator(cache)
+    clock = [1_000_000_000]
+    p0 = gang_pod(fc, "gp0", rank=0)
+    gang.bind_member(p0, gang.filter_hosts(p0)[0][0], fc,
+                     now_ns=lambda: clock[0])
+    plan = gang._plans["g1"]
+    partner_host, partner_chips = plan.members[1][0], plan.members[1][1]
+    clock[0] += GangCoordinator.PLAN_TTL_NS + 1
+    assert gang.gc(now_ns=lambda: clock[0]) == 1
+    assert "g1" in gang._plans  # partially bound: geometry retained
+    info = cache.get_node_info(partner_host)
+    assert all(v.used_hbm_mib == 0 for v in info.snapshot())
+    # the late member binds to the original host, re-reserving on demand
+    p1 = gang_pod(fc, "gp1", rank=1)
+    placement = gang.bind_member(p1, partner_host, fc,
+                                 now_ns=lambda: clock[0])
+    assert placement.chip_ids == partner_chips
+    assert gang._plans == {}  # fully bound -> dropped

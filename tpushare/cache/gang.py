@@ -1,0 +1,332 @@
+"""Gang coordinator: all-or-nothing multi-host (slice) placement.
+
+Implements the protocol of docs/designs/multihost-gang.md over the
+existing per-node machinery:
+
+1. **Plan** on the gang's first Filter/Bind: assemble the slice's
+   :class:`~tpushare.core.slice.SliceTopology` from node labels
+   (LABEL_SLICE / LABEL_SLICE_ORIGIN / LABEL_MESH), snapshot every
+   member host, and run :func:`~tpushare.core.slice.select_gang`.
+2. **Reserve everywhere, then write**: every member host's share is
+   reserved under a gang-scoped key in canonical host order; any
+   failure rolls the earlier ones back — all-or-nothing before any
+   apiserver write (NodeInfo.reserve_planned / release_planned).
+3. **Stamp the plan** on the FIRST member's placement patch
+   (ANN_GANG_PLAN), so a restarted coordinator can rebuild from the
+   apiserver; member binds transfer their host's gang reservation to
+   the pod's own accounting key (NodeInfo.allocate_planned).
+4. **Expiry**: a plan whose remaining members never bind releases its
+   reserved-only shares after PLAN_TTL_NS (the gang analogue of the
+   abandoned-bind claim TTL) — a crashed scheduler cannot leak slice
+   capacity forever.
+
+The reference has no multi-node concept at all (its allocator stops at
+one node's device array, nodeinfo.go:312-363); this module is where the
+TPU-first design outgrows it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tpushare import contract
+from tpushare.cache.nodeinfo import AllocationError
+from tpushare.contract import pod as podlib
+from tpushare.core.placement import PlacementRequest
+from tpushare.core.slice import HostBox, SliceTopology, select_gang
+
+
+class GangError(AllocationError):
+    """Gang-specific bind refusal (malformed membership, plan conflict,
+    slice state moved). The scheduler retries like any AllocationError."""
+
+
+@dataclass
+class _Plan:
+    gang_id: str
+    t_ns: int
+    slice_id: str
+    box: tuple[int, ...]
+    origin: tuple[int, ...]
+    hbm_mib: int
+    # rank -> (host, local chip ids, local box, local origin)
+    members: list[tuple[str, tuple[int, ...], tuple[int, ...],
+                        tuple[int, ...]]]
+    bound: set[int] = field(default_factory=set)
+    # TTL fired: unbound ranks' reservations were released (late binds
+    # re-reserve on demand against the SAME geometry)
+    shares_released: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "id": self.gang_id, "t": self.t_ns, "slice": self.slice_id,
+            "box": list(self.box), "origin": list(self.origin),
+            "hbm": self.hbm_mib,
+            "members": [{"host": h, "chips": list(c), "box": list(b),
+                         "origin": list(o)}
+                        for h, c, b, o in self.members]}, sort_keys=True)
+
+
+def _gang_key(gang_id: str, rank: int) -> str:
+    """Accounting key for a coordinator-held (not yet pod-owned)
+    reservation. Distinct per rank so member binds release exactly
+    their own share."""
+    return f"gang:{gang_id}#{rank}"
+
+
+class GangCoordinator:
+    # reserved-only gang shares older than this are an abandoned gang
+    # (members never bound — JobSet deleted, scheduler crashed): release
+    PLAN_TTL_NS = 300 * 1_000_000_000
+
+    # provisional (Filter-time, unreserved) plans are cached briefly so
+    # an unschedulable gang's scheduling retries don't re-run the full
+    # slice search inside every Filter webhook call
+    PROVISIONAL_TTL_NS = 2 * 1_000_000_000
+
+    def __init__(self, cache) -> None:
+        self._cache = cache  # SchedulerCache
+        self._lock = threading.Lock()
+        self._plans: dict[str, _Plan] = {}
+        self._provisional: dict[str, tuple[_Plan | None, int]] = {}
+
+    # -- slice discovery ----------------------------------------------------
+
+    def slice_topology(self, slice_id: str) -> tuple[SliceTopology,
+                                                     dict] | None:
+        """Assemble (SliceTopology, views) for ``slice_id`` from the
+        cache's labeled nodes. Returns None when the labeled hosts do
+        not form a valid tiling (mis-labeled fleet: refuse to gang-place
+        rather than guess)."""
+        hosts: dict[str, HostBox] = {}
+        views: dict[str, list] = {}
+        for name in self._cache.node_names():
+            info = self._cache.get_node_info(name)
+            if info is None or getattr(info, "slice_id", None) != slice_id:
+                continue
+            origin = info.slice_origin
+            shape = info.topology.shape
+            if len(origin) != len(shape):
+                return None
+            hosts[name] = HostBox(tuple(origin), tuple(shape))
+            views[name] = info.snapshot()
+        if not hosts:
+            return None
+        rank = len(next(iter(hosts.values())).origin)
+        mesh_dims = tuple(
+            max(hb.origin[ax] + hb.shape[ax] for hb in hosts.values())
+            for ax in range(rank))
+        from tpushare.core.topology import MeshTopology
+        try:
+            st = SliceTopology(MeshTopology(mesh_dims), hosts)
+        except ValueError:
+            return None
+        return st, views
+
+    def slice_ids(self) -> list[str]:
+        out = set()
+        for name in self._cache.node_names():
+            info = self._cache.get_node_info(name)
+            sid = getattr(info, "slice_id", None)
+            if sid:
+                out.add(sid)
+        return sorted(out)
+
+    # -- planning -----------------------------------------------------------
+
+    def _request(self, pod: dict[str, Any], size: int) -> PlacementRequest:
+        hbm = contract.pod_hbm_request(pod)
+        return PlacementRequest(
+            hbm_mib=max(hbm, 0),
+            chip_count=size,
+            topology=podlib.pod_topology_request(pod))
+
+    def _compute_plan(self, gang_id: str, pod: dict[str, Any],
+                      size: int, now_ns: int) -> _Plan | None:
+        req = self._request(pod, size)
+        for sid in self.slice_ids():
+            assembled = self.slice_topology(sid)
+            if assembled is None:
+                continue
+            st, views = assembled
+            gp = select_gang(st, views, req)
+            if gp is None:
+                continue
+            members = [
+                (host, p.chip_ids, p.box, p.origin)
+                for host, p in sorted(gp.per_host.items())]
+            return _Plan(gang_id=gang_id, t_ns=now_ns, slice_id=sid,
+                         box=gp.box, origin=gp.origin,
+                         hbm_mib=req.hbm_mib, members=members)
+        return None
+
+    def filter_hosts(self, pod: dict[str, Any],
+                     now_ns: Callable[[], int] = time.time_ns
+                     ) -> tuple[list[str], str]:
+        """Filter verb for a gang member: ([host], "") or ([], reason).
+
+        Exactly ONE host is returned — the one the (provisional or
+        reserved) plan assigns to this member's rank — so the
+        scheduler's choice cannot diverge from the gang's geometry
+        (docs/designs/multihost-gang.md, protocol step 1).
+        """
+        gid, size, rank = contract.gang_membership(pod)  # caller checked
+        t = now_ns()
+        with self._lock:
+            plan = self._plans.get(gid)
+            if plan is None:
+                prov = self._provisional.get(gid)
+                if prov is not None and t - prov[1] < \
+                        self.PROVISIONAL_TTL_NS:
+                    plan = prov[0]
+                else:
+                    plan = -1  # sentinel: compute outside the lock
+        if plan == -1:
+            plan = self._compute_plan(gid, pod, size, t)
+            with self._lock:
+                self._provisional[gid] = (plan, t)
+                # opportunistic cleanup; the dict stays O(live gangs)
+                for k in [k for k, (_, pt) in self._provisional.items()
+                          if t - pt >= self.PROVISIONAL_TTL_NS]:
+                    if k != gid:
+                        self._provisional.pop(k)
+        if plan is None:
+            return [], (f"gang {gid}: no slice admits "
+                        f"{size} chips x {contract.pod_hbm_request(pod)}"
+                        " MiB (all-or-nothing)")
+        if rank >= len(plan.members):
+            return [], (f"gang {gid}: rank {rank} out of range — the "
+                        f"placement spans {len(plan.members)} hosts; "
+                        "the gang must run one member per host")
+        return [plan.members[rank][0]], ""
+
+    # -- binding ------------------------------------------------------------
+
+    def bind_member(self, pod: dict[str, Any], node_name: str, cluster,
+                    now_ns: Callable[[], int] = time.time_ns,
+                    ha_claims: bool = False):
+        """Bind one gang member to its planned share on ``node_name``.
+
+        First member: computes the plan, reserves EVERY member's share
+        (all-or-nothing), stamps the plan into this pod's placement
+        patch. Later members: replay from the reserved plan,
+        transferring their host's gang reservation to the pod.
+        """
+        membership = contract.gang_membership(pod)
+        if membership is None:
+            raise GangError("bind_member called for a non-gang pod")
+        gid, size, rank = membership
+        t = now_ns()
+        with self._lock:
+            plan = self._plans.get(gid)
+            first = plan is None
+            if first:
+                plan = self._compute_plan(gid, pod, size, t)
+                if plan is None:
+                    raise GangError(
+                        f"gang {gid}: no slice admits {size} chips "
+                        "(all-or-nothing)")
+                # reserve every member's share in canonical order;
+                # roll back on any failure
+                reserved: list[tuple[str, int]] = []
+                try:
+                    for r, (host, chips, _b, _o) in enumerate(
+                            plan.members):
+                        info = self._cache.get_node_info(host)
+                        if info is None:
+                            raise AllocationError(
+                                f"gang {gid}: host {host} left the "
+                                "cache during planning")
+                        info.reserve_planned(_gang_key(gid, r), chips,
+                                             plan.hbm_mib
+                                             or info.hbm_per_chip)
+                        reserved.append((host, r))
+                except AllocationError as e:
+                    for host, r in reserved:
+                        info = self._cache.get_node_info(host)
+                        if info is not None:
+                            info.release_planned(
+                                _gang_key(gid, r),
+                                plan.members[r][1])
+                    raise GangError(f"gang {gid}: all-or-nothing "
+                                    f"reserve failed: {e}") from None
+                self._plans[gid] = plan
+            if rank >= len(plan.members):
+                raise GangError(
+                    f"gang {gid}: rank {rank} out of range for a "
+                    f"{len(plan.members)}-host placement")
+            host, chips, box, origin = plan.members[rank]
+            if host != node_name:
+                raise GangError(
+                    f"gang {gid}: rank {rank} is planned onto {host}, "
+                    f"not {node_name} — Filter answers with the planned "
+                    "host; re-filter and retry")
+            if rank in plan.bound:
+                raise GangError(
+                    f"gang {gid}: rank {rank} already bound")
+        info = self._cache.get_node_info(node_name)
+        if info is None:
+            raise GangError(f"gang {gid}: node {node_name} not in cache")
+        extra = {contract.ANN_GANG: gid,
+                 contract.ANN_GANG_SIZE: str(size),
+                 contract.ANN_GANG_RANK: str(rank)}
+        if first:
+            extra[contract.ANN_GANG_PLAN] = plan.to_json()
+        placement = info.allocate_planned(
+            pod, cluster, chips, box, origin, now_ns=now_ns,
+            ha_claims=ha_claims, planned_key=_gang_key(gid, rank),
+            extra_annotations=extra)
+        with self._lock:
+            plan.bound.add(rank)
+            if len(plan.bound) == len(plan.members):
+                # fully bound: the per-pod accounting owns everything now
+                self._plans.pop(gid, None)
+        return placement
+
+    # -- expiry -------------------------------------------------------------
+
+    def gc(self, now_ns: Callable[[], int] = time.time_ns) -> int:
+        """Expire abandoned plans. Returns the number acted on. Wired
+        into the controller's resync cadence (the same heartbeat that
+        prunes stale claims).
+
+        Semantics by bound-state (a wholesale pop would let a late
+        member re-plan DIFFERENT geometry than its already-running
+        peers — the exact invariant gangs exist to guarantee):
+
+        - **no member bound** after PLAN_TTL_NS: release every share
+          and DROP the plan (a fresh attempt may re-plan freely);
+        - **partially bound**: release the unbound ranks' reservations
+          (stop hoarding capacity) but KEEP the plan — a late member
+          still binds to the original geometry, re-reserving on demand
+          (and failing retriably if something took the chips);
+        - a partially-bound plan is finally dropped after
+          10 x PLAN_TTL_NS so coordinator memory stays bounded; by
+          then nothing is reserved under it.
+        """
+        t = now_ns()
+        acted = 0
+        with self._lock:
+            for gid in list(self._plans):
+                plan = self._plans[gid]
+                age = t - plan.t_ns
+                if age < self.PLAN_TTL_NS:
+                    continue
+                if not plan.shares_released:
+                    for r, (host, chips, _b, _o) in enumerate(
+                            plan.members):
+                        if r in plan.bound:
+                            continue  # pod-owned; normal lifecycle
+                        info = self._cache.get_node_info(host)
+                        if info is not None:
+                            info.release_planned(_gang_key(gid, r),
+                                                 chips)
+                    plan.shares_released = True
+                    acted += 1
+                if not plan.bound or age >= 10 * self.PLAN_TTL_NS:
+                    self._plans.pop(gid)
+        return acted

@@ -144,6 +144,10 @@ class NodeInfo:
         self._version += 1
 
     def _init_chips(self, node: dict[str, Any]) -> None:
+        # slice membership (multi-host gang placement): which ICI domain
+        # this host belongs to and where its box sits in the global mesh
+        self.slice_id, self.slice_origin = (
+            contract.node_slice(node) or (None, None))
         count = contract.node_chip_count(node)
         total_hbm = contract.node_hbm_capacity(node)
         if count <= 0 and total_hbm > 0:
@@ -317,6 +321,118 @@ class NodeInfo:
             with self._lock:
                 self._inflight.discard(key)
 
+    # -- planned placements (gang coordination) -----------------------------
+
+    def reserve_planned(self, key: str, chip_ids: Sequence[int],
+                        demand: int) -> None:
+        """Reserve SPECIFIC chips under ``key`` (the gang coordinator's
+        all-or-nothing reserve: the placement was decided at slice scope,
+        this node just holds its share). Raises AllocationError if any
+        chip cannot currently host ``demand`` — the caller rolls back
+        the sibling nodes' reservations.
+        """
+        with self._lock:
+            views = {c.idx: c.view(healthy=c.idx not in self._unhealthy)
+                     for c in self.chips}
+            for cid in chip_ids:
+                v = views.get(cid)
+                if v is None or not v.healthy or (
+                        demand >= v.total_hbm_mib
+                        and v.used_hbm_mib > 0) or \
+                        v.free_hbm_mib < demand:
+                    raise AllocationError(
+                        f"chip {cid} on {self.name} cannot hold "
+                        f"{demand} MiB for {key} (slice state moved "
+                        "since planning)")
+            for cid in chip_ids:
+                self.chips[cid].reserve(key, demand)
+            self._dirty()
+
+    def release_planned(self, key: str, chip_ids: Sequence[int]) -> None:
+        """Drop a reserved-only planned share (rollback / plan expiry)."""
+        with self._lock:
+            for cid in chip_ids:
+                self.chips[cid].remove_reserved(key)
+            self._dirty()
+
+    def allocate_planned(self, pod, cluster, chip_ids: Sequence[int],
+                         box, origin,
+                         now_ns: Callable[[], int] = time.time_ns,
+                         ha_claims: bool = False,
+                         planned_key: str | None = None,
+                         extra_annotations: dict | None = None):
+        """Bind ``pod`` to PRE-DECIDED chips on this node (a gang
+        member's share). Mirrors :meth:`allocate` phases, but the
+        placement comes from the gang plan instead of select_chips;
+        ``planned_key`` names an existing coordinator reservation to
+        transfer to the pod's own key (released on success or failure —
+        the pod's reservation takes over). ``extra_annotations`` merges
+        into the placement patch (the first member carries the plan).
+        """
+        req = request_from_pod(pod)
+        if req is None:
+            raise AllocationError(f"pod {podlib.pod_key(pod)} requests no TPU")
+        if podlib.pod_node_name(pod):
+            raise AlreadyBoundError(
+                f"pod {podlib.pod_key(pod)} already bound to "
+                f"{podlib.pod_node_name(pod)}")
+        uid = podlib.pod_uid(pod)
+        key = podlib.pod_cache_key(pod)
+        ns, name = podlib.pod_namespace(pod), podlib.pod_name(pod)
+        demand = req.chip_demand_mib(self.hbm_per_chip)
+        placement = Placement(tuple(chip_ids), box=tuple(box),
+                              origin=tuple(origin) if origin else None)
+        with self._lock:
+            if key in self._inflight:
+                raise BindInFlightError(
+                    f"bind already in flight for {podlib.pod_key(pod)} "
+                    f"on {self.name}")
+            held = {c.idx: c for c in self.chips}
+            for cid in placement.chip_ids:
+                c = held.get(cid)
+                if c is None:
+                    raise AllocationError(
+                        f"planned chip {cid} does not exist on {self.name}")
+                # room check EXCLUDING the coordinator's own reservation,
+                # which this pod's reservation replaces
+                free = (c.view(healthy=cid not in self._unhealthy)
+                        .free_hbm_mib)
+                if planned_key is not None and c.has_pod(planned_key):
+                    free += c.pod_hbm(planned_key)
+                if cid in self._unhealthy or free < demand:
+                    raise AllocationError(
+                        f"planned chip {cid} on {self.name} can no "
+                        f"longer hold {demand} MiB for {key}")
+            for cid in placement.chip_ids:
+                if planned_key is not None:
+                    self.chips[cid].remove_reserved(planned_key)
+                self.chips[cid].reserve(key, demand)
+            self._inflight.add(key)
+            self._dirty()
+        try:
+            return self._allocate_io(pod, cluster, now_ns, placement,
+                                     demand, uid, key, ns, name, ha_claims,
+                                     extra_annotations=extra_annotations)
+        except (AllocationError, ApiError):
+            # a transient I/O failure must NOT strip the gang's
+            # protection: _allocate_io rolled back the pod-key
+            # reservation, so restore the coordinator's planned_key one
+            # (checked — if a racer grabbed the space in the rollback
+            # window, that chip's share is lost exactly as it would have
+            # been without a gang, and the retry fails loudly)
+            if planned_key is not None:
+                with self._lock:
+                    for cid in placement.chip_ids:
+                        c = self.chips[cid]
+                        if not c.has_pod(planned_key) and \
+                                c.view().free_hbm_mib >= demand:
+                            c.reserve(planned_key, demand)
+                    self._dirty()
+            raise
+        finally:
+            with self._lock:
+                self._inflight.discard(key)
+
     # claims older than this are abandoned bind attempts (binder crashed
     # between claim and pod-patch) and stop counting against capacity
     CLAIM_TTL_NS = 120 * 1_000_000_000
@@ -480,7 +596,8 @@ class NodeInfo:
                 return
 
     def _allocate_io(self, pod, cluster, now_ns, placement, demand,
-                     uid, key, ns, name, ha_claims=False) -> Placement:
+                     uid, key, ns, name, ha_claims=False,
+                     extra_annotations=None) -> Placement:
         """Phases 2-3 of allocate: apiserver writes + confirm/rollback."""
         # phase 2: apiserver writes (no lock held)
         t_ns = now_ns()
@@ -491,6 +608,8 @@ class NodeInfo:
             box=placement.box,
             now_ns=t_ns,
         )
+        if extra_annotations:
+            ann = dict(ann, **extra_annotations)
         # remember prior values so a failed bind can revert the patch
         # (None = key absent -> delete on revert)
         old_ann = podlib.annotations(pod)
@@ -617,6 +736,11 @@ class NodeInfo:
         per_chip = total // count if count > 0 else 0
         topo = contract.node_mesh_topology(node)
         with self._lock:
+            # slice labels refresh on EVERY node update — relabeling a
+            # host's slice membership must not wait for a chip rebuild
+            # (gang geometry would be computed from stale coordinates)
+            self.slice_id, self.slice_origin = (
+                contract.node_slice(node) or (None, None))
             if (count == self.chip_count and per_chip == self.hbm_per_chip
                     and (topo is None or topo.shape == self.topology.shape)):
                 return False

@@ -19,7 +19,13 @@ from tpushare.contract.constants import (
     ANN_ASSUME_TIME,
     ANN_TOPOLOGY,
     ANN_NODE_CLAIMS,
+    ANN_GANG,
+    ANN_GANG_PLAN,
+    ANN_GANG_RANK,
+    ANN_GANG_SIZE,
     LABEL_MESH,
+    LABEL_SLICE,
+    LABEL_SLICE_ORIGIN,
     LABEL_TPUSHARE_NODE,
     ENV_VISIBLE_CHIPS,
     ENV_HBM_LIMIT,
@@ -41,11 +47,14 @@ from tpushare.contract.pod import (
     placement_patch,
     assigned_patch,
     strip_placement,
+    gang_membership,
+    gang_plan_from_annotations,
 )
 from tpushare.contract.node import (
     node_hbm_capacity,
     node_chip_count,
     node_mesh_topology,
+    node_slice,
     is_tpushare_node,
 )
 
@@ -63,5 +72,8 @@ __all__ = [
     "placement_annotations", "placement_patch", "assigned_patch",
     "strip_placement",
     "node_hbm_capacity", "node_chip_count", "node_mesh_topology",
+    "node_slice", "ANN_GANG", "ANN_GANG_PLAN", "ANN_GANG_RANK",
+    "ANN_GANG_SIZE", "LABEL_SLICE", "LABEL_SLICE_ORIGIN",
+    "gang_membership", "gang_plan_from_annotations",
     "is_tpushare_node",
 ]

@@ -46,9 +46,24 @@ ANN_TOPOLOGY = _PREFIX + "topology"         # granted sub-slice shape, "2x2"
 # NodeInfo._claim_chips.
 ANN_NODE_CLAIMS = _PREFIX + "claims"
 
+# -- multi-host gang (slice) placement (docs/designs/multihost-gang.md) ------
+# A gang is a SET of pods, one per participating host, linked by id. The
+# whole gang's geometry lives on every member; the coordinator assigns
+# member ranks to hosts and stamps the authoritative plan on the FIRST
+# bound member (ANN_GANG_PLAN), from which the remaining binds replay.
+ANN_GANG = _PREFIX + "gang"                 # gang id (e.g. JobSet uid)
+ANN_GANG_SIZE = _PREFIX + "gang-size"       # TOTAL chip count of the gang
+ANN_GANG_RANK = _PREFIX + "gang-rank"       # member index, 0-based
+ANN_GANG_PLAN = _PREFIX + "gang-plan"       # JSON plan (first member only)
+
 # -- node labels (published by the device plugin) ----------------------------
 LABEL_TPUSHARE_NODE = "tpushare"            # "true" enables the DaemonSet
 LABEL_MESH = _PREFIX + "mesh"               # host ICI mesh shape, e.g. "4x4"
+# Slice membership (multi-host ICI domain): which slice this host belongs
+# to and where its chip box sits in the slice's GLOBAL mesh. E.g. a
+# v5e-16 host at the top-right quadrant: slice="slc0", slice-origin="0x2".
+LABEL_SLICE = _PREFIX + "slice"
+LABEL_SLICE_ORIGIN = _PREFIX + "slice-origin"
 
 # -- container env (injected by the device plugin at Allocate) ---------------
 ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"         # e.g. "0,1,4,5"

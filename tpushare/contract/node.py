@@ -12,6 +12,8 @@ from typing import Any, Mapping
 
 from tpushare.contract.constants import (
     LABEL_MESH,
+    LABEL_SLICE,
+    LABEL_SLICE_ORIGIN,
     RESOURCE_COUNT,
     RESOURCE_HBM,
 )
@@ -68,3 +70,23 @@ def node_mesh_topology(node: Node) -> MeshTopology | None:
     if count and topo.num_chips != count:
         return None  # stale label; geometry no longer trustworthy
     return topo
+
+
+def node_slice(node: Node) -> tuple[str, tuple[int, ...]] | None:
+    """(slice_id, host_box_origin) from the slice labels, or None for a
+    single-host node (docs/designs/multihost-gang.md). The origin uses
+    the same "RxC" encoding as the mesh label; a malformed origin
+    behaves like no slice membership (the node still schedules
+    single-host work; gang placement just cannot use it)."""
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    sid = labels.get(LABEL_SLICE)
+    raw = labels.get(LABEL_SLICE_ORIGIN)
+    if not sid or raw is None:
+        return None
+    try:
+        origin = tuple(int(p) for p in raw.lower().split("x"))
+    except ValueError:
+        return None
+    if any(o < 0 for o in origin):
+        return None
+    return sid, origin

@@ -20,6 +20,10 @@ from tpushare.contract.constants import (
     ANN_ASSIGNED,
     ANN_ASSUME_TIME,
     ANN_CHIP_IDS,
+    ANN_GANG,
+    ANN_GANG_PLAN,
+    ANN_GANG_RANK,
+    ANN_GANG_SIZE,
     ANN_HBM_CHIP,
     ANN_HBM_POD,
     ANN_TOPOLOGY,
@@ -246,3 +250,42 @@ def strip_placement(pod: Pod) -> dict[str, Any]:
         for key in PLACEMENT_ANNOTATION_KEYS:
             ann.pop(key, None)
     return out
+
+
+# -- multi-host gang membership (docs/designs/multihost-gang.md) -------------
+
+def gang_membership(pod: Pod) -> tuple[str, int, int] | None:
+    """(gang_id, total_chip_count, member_rank) from the gang
+    annotations, or None for a non-gang pod. Malformed gang annotations
+    raise ValueError — a half-labeled gang member silently scheduled as
+    a single-host pod would strand its peers (all-or-nothing is the
+    point), so the error must surface at Filter time."""
+    ann = annotations(pod)
+    gid = ann.get(ANN_GANG)
+    if gid is None:
+        return None
+    try:
+        size = int(ann[ANN_GANG_SIZE])
+        rank = int(ann[ANN_GANG_RANK])
+    except (KeyError, ValueError) as e:
+        raise ValueError(
+            f"pod {pod_key(pod)}: gang {gid!r} annotations must carry "
+            f"integer {ANN_GANG_SIZE} and "
+            f"{ANN_GANG_RANK}: {e}") from None
+    if size <= 0 or rank < 0:
+        raise ValueError(
+            f"pod {pod_key(pod)}: gang {gid!r} size {size} / rank "
+            f"{rank} out of range")
+    return gid, size, rank
+
+
+def gang_plan_from_annotations(pod: Pod) -> dict | None:
+    """The stamped authoritative plan (first bound member), or None."""
+    raw = annotations(pod).get(ANN_GANG_PLAN)
+    if raw is None:
+        return None
+    try:
+        plan = json.loads(raw)
+    except ValueError:
+        return None
+    return plan if isinstance(plan, dict) else None

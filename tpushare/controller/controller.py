@@ -60,6 +60,11 @@ class Controller:
         self._queue = WorkQueue()
         self._workers = workers
         self._resync_seconds = resync_seconds
+        # extra anti-entropy work ridden on the resync heartbeat (e.g.
+        # the gang coordinator's abandoned-plan expiry); hooks must be
+        # cheap and exception-safe burdens are on the caller side — a
+        # failing hook is logged and never takes resync down
+        self.resync_hooks: list = []
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # last-seen copy of every queued pod so deletes can clean the cache
@@ -232,6 +237,11 @@ class Controller:
             self.cache.remove_pod(pod)  # missed DELETED / replaced UID
         for name in self.cache.node_names():
             self._load_unhealthy(name)
+        for hook in list(self.resync_hooks):
+            try:
+                hook()
+            except Exception as e:  # noqa: BLE001 — anti-entropy must
+                log.warning("resync hook failed: %s", e)  # never die
 
     def _load_unhealthy(self, node_name: str) -> None:
         try:

@@ -30,7 +30,8 @@ from tpushare.extender.server import ExtenderServer
 
 
 def parse_fake_nodes(spec: str):
-    """``name:CHIPSxHBM[:MESH]`` comma-separated, e.g. ``n1:4x16000:2x2``."""
+    """``name:CHIPSxHBM[:MESH[:SLICE@ORIGIN]]`` comma-separated, e.g.
+    ``n1:4x16000:2x2`` or (a v5e-16 host) ``h0:4x16000:2x2:slc0@0x2``."""
     from tpushare.k8s import FakeCluster
     fc = FakeCluster()
     for item in filter(None, (s.strip() for s in spec.split(","))):
@@ -40,8 +41,15 @@ def parse_fake_nodes(spec: str):
         name = parts[0]
         chips_s, _, hbm_s = parts[1].partition("x")
         mesh = parts[2] if len(parts) > 2 else None
+        slice_id = slice_origin = None
+        if len(parts) > 3:
+            slice_id, sep, slice_origin = parts[3].partition("@")
+            if not sep or not slice_id or not slice_origin:
+                raise ValueError(f"bad slice spec in {item!r} "
+                                 "(want SLICE@ORIGIN, e.g. slc0@0x2)")
         fc.add_tpu_node(name, chips=int(chips_s),
-                        hbm_per_chip_mib=int(hbm_s), mesh=mesh)
+                        hbm_per_chip_mib=int(hbm_s), mesh=mesh,
+                        slice_id=slice_id, slice_origin=slice_origin)
     return fc
 
 
@@ -120,6 +128,9 @@ def main(argv: list[str] | None = None) -> int:
                             allow_debug_seed=bool(args.fake_nodes),
                             elector=elector)
     register_cache_gauges(registry, cache)
+    # abandoned-gang expiry rides the controller's 30 s anti-entropy
+    # heartbeat (docs/designs/multihost-gang.md protocol step 5)
+    controller.resync_hooks.append(server.gang.gc)
 
     stop = threading.Event()
 

@@ -36,8 +36,10 @@ class FilterHandler:
     """Per-scheduling-attempt fit check over candidate nodes
     (reference Predicate.Handler, predicate.go:15-39)."""
 
-    def __init__(self, cache: SchedulerCache, registry: Registry) -> None:
+    def __init__(self, cache: SchedulerCache, registry: Registry,
+                 gang=None) -> None:
         self._cache = cache
+        self._gang = gang  # GangCoordinator | None
         self._filter_total = registry.counter(
             "tpushare_filter_requests_total", "Filter webhook calls")
         self._filter_latency = registry.histogram(
@@ -52,6 +54,28 @@ class FilterHandler:
             items = (args.get("Nodes") or {}).get("items") or []
             node_names = [n.get("metadata", {}).get("name", "")
                           for n in items]
+        # gang members route through the coordinator: exactly one host
+        # (the planned one for this member's rank) comes back, so the
+        # default scheduler cannot diverge from the gang geometry
+        # (docs/designs/multihost-gang.md protocol step 1)
+        if self._gang is not None:
+            try:
+                membership = podlib.gang_membership(pod)
+            except ValueError as e:
+                self._filter_latency.observe(time.perf_counter() - t0)
+                return {"NodeNames": [], "FailedNodes": {},
+                        "Error": str(e)}
+            if membership is not None:
+                hosts, reason = self._gang.filter_hosts(pod)
+                hosts = [h for h in hosts if h in set(node_names)]
+                failed = {} if hosts else {
+                    n: reason or "not the planned gang host"
+                    for n in node_names if n}
+                self._filter_latency.observe(time.perf_counter() - t0)
+                log.debug("filter gang %s: -> %s",
+                          podlib.pod_key(pod), hosts)
+                return {"NodeNames": hosts, "FailedNodes": failed,
+                        "Error": ""}
         ok_nodes: list[str] = []
         failed: dict[str, str] = {}
         req = request_from_pod(pod)
@@ -328,10 +352,12 @@ class BindHandler:
     (reference Bind.Handler -> gpusharingbinding, gpushare-bind.go:22-43)."""
 
     def __init__(self, cache: SchedulerCache, cluster,
-                 registry: Registry, ha_claims: bool = False) -> None:
+                 registry: Registry, ha_claims: bool = False,
+                 gang=None) -> None:
         self._cache = cache
         self._cluster = cluster
         self._ha_claims = ha_claims
+        self._gang = gang  # GangCoordinator | None
         self.bind_total = registry.counter(
             "tpushare_bind_requests_total", "Bind webhook calls")
         self.bind_failures = registry.counter(
@@ -358,9 +384,21 @@ class BindHandler:
         bound_node = ""
         try:
             pod = self._get_pod(ns, name, uid)
-            info = self._cache.get_node_info(node)
-            placement = info.allocate(pod, self._cluster,
-                                      ha_claims=self._ha_claims)
+            try:
+                membership = (podlib.gang_membership(pod)
+                              if self._gang is not None else None)
+            except ValueError as e:
+                raise AllocationError(str(e)) from None
+            if membership is not None:
+                # gang member: all-or-nothing slice placement through
+                # the coordinator (reserve-everywhere on first member,
+                # planned-replay for the rest)
+                placement = self._gang.bind_member(
+                    pod, node, self._cluster, ha_claims=self._ha_claims)
+            else:
+                info = self._cache.get_node_info(node)
+                placement = info.allocate(pod, self._cluster,
+                                          ha_claims=self._ha_claims)
         except AlreadyBoundError as e:
             err = e
             bound_node = podlib.pod_node_name(pod)

@@ -39,14 +39,21 @@ class ExtenderServer:
                  allow_debug_seed: bool = False,
                  elector=None) -> None:
         self.registry = registry or Registry()
-        self.filter_handler = FilterHandler(cache, self.registry)
+        # multi-host gang placement (docs/designs/multihost-gang.md):
+        # engages only for pods carrying the gang annotations, on nodes
+        # labeled into slices — zero cost otherwise
+        from tpushare.cache.gang import GangCoordinator
+        self.gang = GangCoordinator(cache)
+        self.filter_handler = FilterHandler(cache, self.registry,
+                                            gang=self.gang)
         self.prioritize_handler = PrioritizeHandler(cache, self.registry)
         self.preempt_handler = PreemptHandler(cache, self.registry)
         # HA (an elector is wired): binds also CAS a per-node claim so two
         # replicas in a stale-leader window cannot co-place onto one chip;
         # single-replica mode skips the two extra apiserver round-trips
         self.bind_handler = BindHandler(cache, cluster, self.registry,
-                                        ha_claims=elector is not None)
+                                        ha_claims=elector is not None,
+                                        gang=self.gang)
         self.inspect_handler = InspectHandler(cache)
         self.host, self.port = host, port
         self._httpd: ThreadingHTTPServer | None = None

@@ -64,15 +64,24 @@ class FakeCluster:
     # -- seeding helpers -----------------------------------------------------
 
     def add_tpu_node(self, name: str, chips: int, hbm_per_chip_mib: int,
-                     mesh: str | None = None) -> dict[str, Any]:
+                     mesh: str | None = None,
+                     slice_id: str | None = None,
+                     slice_origin: str | None = None) -> dict[str, Any]:
         """Register a TPU host the way the device plugin would: aggregate
         tpu-hbm, tpu-count, and the mesh topology label (designs.md:57-63
-        reports count x mem through ListAndWatch)."""
+        reports count x mem through ListAndWatch). ``slice_id`` +
+        ``slice_origin`` ("RxC") label the host into a multi-host ICI
+        slice for gang placement."""
+        labels = ({LABEL_MESH: mesh} if mesh else {}) | {"tpushare": "true"}
+        if slice_id is not None and slice_origin is not None:
+            from tpushare.contract import LABEL_SLICE, LABEL_SLICE_ORIGIN
+            labels |= {LABEL_SLICE: slice_id,
+                       LABEL_SLICE_ORIGIN: slice_origin}
         node = {
             "apiVersion": "v1", "kind": "Node",
             "metadata": {
                 "name": name,
-                "labels": ({LABEL_MESH: mesh} if mesh else {}) | {"tpushare": "true"},
+                "labels": labels,
             },
             "status": {
                 "allocatable": {
