@@ -34,9 +34,17 @@ def test_all_samples_parse_and_request_tpu():
             continue
         docs = load_all(f"samples/{name}")
         for doc in docs:
-            tmpl = doc["spec"]["template"]["spec"]
+            # workload controllers nest the pod spec under
+            # spec.template; bare Pods (the gang sample's explicit
+            # members) carry it directly
+            spec = doc["spec"]
+            tmpl = spec["template"]["spec"] if "template" in spec else spec
             limits = tmpl["containers"][0]["resources"]["limits"]
-            assert contract.RESOURCE_HBM in limits, name
+            # sharing pods request tpu-hbm; exclusive whole-chip pods
+            # (e.g. the gang sample) request tpu-count only — either
+            # routes the pod to the extender via managedResources
+            assert contract.RESOURCE_HBM in limits \
+                or contract.RESOURCE_COUNT in limits, name
 
 
 def test_policy_config_matches_contract():

@@ -210,33 +210,23 @@ def test_gang_gc_releases_abandoned_shares():
     cache = SchedulerCache(fc)
     ctl = Controller(fc, cache)
     ctl.build_cache()
-    try:
-        gang = GangCoordinator(cache)
-        clock = [1_000_000_000]
-        p0 = gang_pod(fc, "gp0", rank=0)
-        gang.bind_member(p0, gang.filter_hosts(p0)[0][0], fc,
-                         now_ns=lambda: clock[0])
-        # rank 1 never binds; its share is reserved
-        plan_ann = contract.gang_plan_from_annotations(
-            fc.get_pod("default", "gp0"))
-        partner = next(m["host"] for m in plan_ann["members"]
-                       if m["chips"] != contract.chip_ids_from_annotations(
-                           fc.get_pod("default", "gp0"))
-                       or m["host"] != fc.get_pod(
-                           "default", "gp0")["spec"].get("nodeName"))
-        clock[0] += GangCoordinator.PLAN_TTL_NS + 1
-        assert gang.gc(now_ns=lambda: clock[0]) == 1
-        # the partner's share is free again; the bound member keeps its
-        bound_host = fc.get_pod("default", "gp0")["spec"]["nodeName"]
-        for host in HOSTS:
-            info = cache.get_node_info(host)
-            free = sum(v.free_hbm_mib for v in info.snapshot())
-            if host == bound_host:
-                assert free == 0
-            else:
-                assert free == 4 * 16000, host
-    finally:
-        pass
+    gang = GangCoordinator(cache)
+    clock = [1_000_000_000]
+    p0 = gang_pod(fc, "gp0", rank=0)
+    gang.bind_member(p0, gang.filter_hosts(p0)[0][0], fc,
+                     now_ns=lambda: clock[0])
+    # rank 1 never binds; its share stays reserved until the TTL
+    clock[0] += GangCoordinator.PLAN_TTL_NS + 1
+    assert gang.gc(now_ns=lambda: clock[0]) == 1
+    # the partner's share is free again; the bound member keeps its
+    bound_host = fc.get_pod("default", "gp0")["spec"]["nodeName"]
+    for host in HOSTS:
+        info = cache.get_node_info(host)
+        free = sum(v.free_hbm_mib for v in info.snapshot())
+        if host == bound_host:
+            assert free == 0
+        else:
+            assert free == 4 * 16000, host
 
 
 def test_gang_rollback_when_a_share_cannot_reserve():
@@ -289,3 +279,42 @@ def test_gc_keeps_partial_plan_geometry_for_late_members():
                                  now_ns=lambda: clock[0])
     assert placement.chip_ids == partner_chips
     assert gang._plans == {}  # fully bound -> dropped
+
+
+def test_topology_pin_mismatch_sanitized_not_500():
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    Controller(fc, cache).build_cache()
+    gang = GangCoordinator(cache)
+    # gang-size 8 with a 2x2 pin (product 4): the pin is ignored, the
+    # gang still plans (matching request_from_pod's single-host policy)
+    p0 = gang_pod(fc, "gp0", rank=0, size=8, topology="2x2")
+    hosts, reason = gang.filter_hosts(p0)
+    assert hosts and reason == ""
+
+
+def test_chip_rebuild_preserves_gang_reservation():
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    Controller(fc, cache).build_cache()
+    gang = GangCoordinator(cache)
+    clock = [1_000_000_000]
+    p0 = gang_pod(fc, "gp0", rank=0)
+    gang.bind_member(p0, gang.filter_hosts(p0)[0][0], fc,
+                     now_ns=lambda: clock[0])
+    plan = gang._plans["g1"]
+    partner_host, partner_chips = plan.members[1][0], plan.members[1][1]
+    info = cache.get_node_info(partner_host)
+    # device plugin restarts the partner host with a different chip
+    # count -> rebuild; the gang's reservation must survive AS a
+    # reservation (a confirmed entry could never be released)
+    node = dict(fc.get_node(partner_host))
+    node["status"] = {"capacity": {
+        contract.RESOURCE_HBM: str(8 * 16000),
+        contract.RESOURCE_COUNT: "8"}}
+    assert info.update_node(node) is True
+    # TTL expiry can still release it
+    clock[0] += GangCoordinator.PLAN_TTL_NS + 1
+    gang.gc(now_ns=lambda: clock[0])
+    free = sum(v.free_hbm_mib for v in info.snapshot())
+    assert free == 8 * 16000, "reservation must release after rebuild"

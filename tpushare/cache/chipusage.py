@@ -51,6 +51,14 @@ class ChipUsage:
         e = self._pods.get(uid)
         return e.hbm_mib if e else 0
 
+    def entries(self) -> list[tuple[str, int, bool]]:
+        """(uid, hbm_mib, reserved) triples — for state carry-over
+        across a chip rebuild (NodeInfo.update_node), which must
+        preserve reserved-ness: a reservation silently promoted to a
+        confirmed entry could never be released by remove_reserved."""
+        return [(uid, e.hbm_mib, e.reserved)
+                for uid, e in self._pods.items()]
+
     def view(self, healthy: bool = True) -> ChipView:
         return ChipView(self.idx, self.coords, self.total_hbm_mib,
                         self.used_hbm_mib, healthy)

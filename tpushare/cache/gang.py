@@ -140,10 +140,21 @@ class GangCoordinator:
 
     def _request(self, pod: dict[str, Any], size: int) -> PlacementRequest:
         hbm = contract.pod_hbm_request(pod)
+        topology = podlib.pod_topology_request(pod)
+        if topology is not None:
+            n = 1
+            for d in topology:
+                n *= d
+            if n != size:
+                # inconsistent pin: ignore rather than reject, matching
+                # request_from_pod's single-host policy — an uncaught
+                # ValueError from PlacementRequest would turn a user
+                # config error into HTTP 500s on every retry
+                topology = None
         return PlacementRequest(
             hbm_mib=max(hbm, 0),
             chip_count=size,
-            topology=podlib.pod_topology_request(pod))
+            topology=topology)
 
     def _compute_plan(self, gang_id: str, pod: dict[str, Any],
                       size: int, now_ns: int) -> _Plan | None:

@@ -749,8 +749,15 @@ class NodeInfo:
             for oc in old:
                 if oc.idx < len(self.chips):
                     nc = self.chips[oc.idx]
-                    for uid in oc.pod_uids:
-                        nc.add_pod(uid, oc.pod_hbm(uid))
+                    for uid, hbm, reserved in oc.entries():
+                        # reserved-ness survives the rebuild: an
+                        # in-flight (or gang-coordinator) reservation
+                        # promoted to confirmed could never be released
+                        # by remove_reserved and would leak forever
+                        if reserved:
+                            nc.reserve(uid, hbm)
+                        else:
+                            nc.add_pod(uid, hbm)
             self._dirty()
             return True
 
