@@ -365,3 +365,56 @@ def test_full_scheduling_to_runtime_cycle():
     packed = node_desc["chips"][r1["chip_ids"][0]]
     assert packed["used_hbm_mib"] == packed["total_hbm_mib"] == 16000
     assert cache.describe()["used_hbm_mib"] == 16000
+
+
+def test_slice_labels_published():
+    from tpushare.contract import LABEL_SLICE, LABEL_SLICE_ORIGIN
+    from tpushare.k8s import FakeCluster
+
+    fc = FakeCluster()
+    fc.add_tpu_node("h1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    plugin = DevicePlugin(fc, "h1", FakeEnumerator(4, 16000, "2x2"),
+                          slice_id="slc0", slice_origin="0x2")
+    plugin.register_node()
+    labels = fc.get_node("h1")["metadata"]["labels"]
+    assert labels[LABEL_SLICE] == "slc0"
+    assert labels[LABEL_SLICE_ORIGIN] == "0x2"
+    # and the scheduler side parses them back
+    from tpushare.contract import node_slice
+    assert node_slice(fc.get_node("h1")) == ("slc0", (0, 2))
+
+
+def test_slice_labels_require_both_and_valid_origin():
+    from tpushare.k8s import FakeCluster
+
+    fc = FakeCluster()
+    fc.add_tpu_node("h1", chips=4, hbm_per_chip_mib=16000)
+    with pytest.raises(ValueError, match="together"):
+        DevicePlugin(fc, "h1", FakeEnumerator(4, 16000, "2x2"),
+                     slice_id="slc0")
+    with pytest.raises(ValueError, match="coordinates"):
+        DevicePlugin(fc, "h1", FakeEnumerator(4, 16000, "2x2"),
+                     slice_id="slc0", slice_origin="left-top")
+    # rank mismatch with the host mesh is caught at STARTUP — published
+    # as-is it would silently disable the whole slice's gang scheduling
+    # at the coordinator's rank check instead
+    with pytest.raises(ValueError, match="matching this host's mesh"):
+        DevicePlugin(fc, "h1", FakeEnumerator(4, 16000, "2x2"),
+                     slice_id="slc0", slice_origin="02")
+
+
+def test_slice_labels_cleared_when_unconfigured():
+    from tpushare.contract import LABEL_SLICE, LABEL_SLICE_ORIGIN
+    from tpushare.k8s import FakeCluster
+
+    fc = FakeCluster()
+    fc.add_tpu_node("h1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    DevicePlugin(fc, "h1", FakeEnumerator(4, 16000, "2x2"),
+                 slice_id="slc0", slice_origin="0x2").register_node()
+    assert LABEL_SLICE in fc.get_node("h1")["metadata"]["labels"]
+    # plugin restarts WITHOUT slice config: stale membership must go
+    DevicePlugin(fc, "h1",
+                 FakeEnumerator(4, 16000, "2x2")).register_node()
+    labels = fc.get_node("h1")["metadata"]["labels"]
+    assert LABEL_SLICE not in labels
+    assert LABEL_SLICE_ORIGIN not in labels

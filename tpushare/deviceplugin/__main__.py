@@ -61,6 +61,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--hbm", type=int, default=16 * 1024,
                     help="per-chip HBM MiB for --fake-chips")
     ap.add_argument("--mesh", default=None)
+    ap.add_argument("--slice-id", default=os.environ.get("TPUSHARE_SLICE"),
+                    help="multi-host ICI slice this host belongs to "
+                         "(published as a node label for gang placement)")
+    ap.add_argument("--slice-origin",
+                    default=os.environ.get("TPUSHARE_SLICE_ORIGIN"),
+                    help="this host's box origin in the slice mesh, "
+                         "'RxC' (e.g. 0x2); required with --slice-id")
     ap.add_argument("--fake-cluster", action="store_true",
                     help="run against an in-memory cluster (dev only)")
     ap.add_argument("--apiserver", default=None)
@@ -101,7 +108,9 @@ def main(argv: list[str] | None = None) -> int:
             cluster = InClusterClient.autodetect(kubeconfig=args.kubeconfig)
 
     plugin = DevicePlugin(cluster, args.node_name, enumerator,
-                          unit_mib=args.hbm_unit)
+                          unit_mib=args.hbm_unit,
+                          slice_id=args.slice_id,
+                          slice_origin=args.slice_origin)
     plugin.register_node()
 
     debug_server = None

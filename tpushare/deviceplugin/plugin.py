@@ -32,6 +32,8 @@ from tpushare.contract.constants import (
     ENV_MEM_FRACTION,
     ENV_VISIBLE_CHIPS,
     LABEL_MESH,
+    LABEL_SLICE,
+    LABEL_SLICE_ORIGIN,
     LABEL_TPUSHARE_NODE,
     RESOURCE_COUNT,
     RESOURCE_HBM,
@@ -127,10 +129,33 @@ class DevicePlugin:
     """
 
     def __init__(self, cluster, node_name: str, enumerator,
-                 unit_mib: int | str = 1) -> None:
+                 unit_mib: int | str = 1,
+                 slice_id: str | None = None,
+                 slice_origin: str | None = None) -> None:
         self._cluster = cluster
         self.node_name = node_name
         self._enumerator = enumerator
+        # multi-host slice membership (docs/designs/multihost-gang.md):
+        # operator-configured (TPU runtime metadata / install flags) —
+        # published as node labels so the extender's gang coordinator
+        # can assemble the slice mesh. Both or neither.
+        if (slice_id is None) != (slice_origin is None):
+            raise ValueError("slice-id and slice-origin must be set "
+                             "together (or neither)")
+        if slice_origin is not None:
+            parts = slice_origin.lower().split("x")
+            if any(not p.isdigit() for p in parts) or                     len(parts) != len(enumerator.mesh.shape):
+                # rank must match THIS host's mesh, or the scheduler's
+                # slice assembly silently rejects the whole slice
+                # (gang.py slice_topology rank check) with no error
+                # anywhere near the typo that caused it
+                raise ValueError(
+                    f"slice-origin {slice_origin!r} must be "
+                    f"{len(enumerator.mesh.shape)} 'x'-separated "
+                    f"coordinates matching this host's mesh "
+                    f"{enumerator.mesh.label()} (e.g. 0x2)")
+        self.slice_id = slice_id
+        self.slice_origin = slice_origin
         self._chips = enumerator.enumerate()
         if not self._chips:
             raise RuntimeError("no TPU chips found on this host")
@@ -179,11 +204,18 @@ class DevicePlugin:
             RESOURCE_HBM: str(total_units),
             RESOURCE_COUNT: str(len(self._chips)),
         }
+        # slice labels are DELETED (merge-patch null) when this host is
+        # not slice-configured: a host pulled out of a slice must stop
+        # counting as a member on re-registration, or the coordinator
+        # keeps planning gangs onto it from stale labels
+        labels = {
+            LABEL_TPUSHARE_NODE: "true",
+            LABEL_MESH: self._enumerator.mesh.label(),
+            LABEL_SLICE: self.slice_id,
+            LABEL_SLICE_ORIGIN: self.slice_origin,
+        }
         return {
-            "metadata": {"labels": {
-                LABEL_TPUSHARE_NODE: "true",
-                LABEL_MESH: self._enumerator.mesh.label(),
-            }},
+            "metadata": {"labels": labels},
             "status": {"capacity": resources, "allocatable": resources},
         }
 
