@@ -779,6 +779,13 @@ class NodeInfo:
                         p = pod_index[uid]
                         entry["name"] = podlib.pod_name(p)
                         entry["namespace"] = podlib.pod_namespace(p)
+                        try:
+                            membership = podlib.gang_membership(p)
+                        except ValueError:
+                            membership = None
+                        if membership is not None:
+                            entry["gang"] = membership[0]
+                            entry["gang_rank"] = membership[2]
                     pods.append(entry)
                 used_total += c.used_hbm_mib
                 chips.append({

@@ -55,6 +55,7 @@ from tpushare.contract.node import (
     node_chip_count,
     node_mesh_topology,
     node_slice,
+    parse_origin,
     is_tpushare_node,
 )
 
@@ -72,7 +73,7 @@ __all__ = [
     "placement_annotations", "placement_patch", "assigned_patch",
     "strip_placement",
     "node_hbm_capacity", "node_chip_count", "node_mesh_topology",
-    "node_slice", "ANN_GANG", "ANN_GANG_PLAN", "ANN_GANG_RANK",
+    "node_slice", "parse_origin", "ANN_GANG", "ANN_GANG_PLAN", "ANN_GANG_RANK",
     "ANN_GANG_SIZE", "LABEL_SLICE", "LABEL_SLICE_ORIGIN",
     "gang_membership", "gang_plan_from_annotations",
     "is_tpushare_node",

@@ -72,21 +72,29 @@ def node_mesh_topology(node: Node) -> MeshTopology | None:
     return topo
 
 
+def parse_origin(raw: str) -> tuple[int, ...] | None:
+    """THE slice-origin grammar: non-negative "RxC" coordinates (same
+    encoding as the mesh label). One parser shared by the device plugin
+    (startup validation) and the scheduler (node_slice) so the two
+    sides cannot drift into a publish-what-the-other-rejects split."""
+    try:
+        origin = tuple(int(p) for p in raw.lower().split("x"))
+    except (AttributeError, ValueError):
+        return None
+    return origin if all(o >= 0 for o in origin) else None
+
+
 def node_slice(node: Node) -> tuple[str, tuple[int, ...]] | None:
     """(slice_id, host_box_origin) from the slice labels, or None for a
-    single-host node (docs/designs/multihost-gang.md). The origin uses
-    the same "RxC" encoding as the mesh label; a malformed origin
-    behaves like no slice membership (the node still schedules
+    single-host node (docs/designs/multihost-gang.md). A malformed
+    origin behaves like no slice membership (the node still schedules
     single-host work; gang placement just cannot use it)."""
     labels = (node.get("metadata") or {}).get("labels") or {}
     sid = labels.get(LABEL_SLICE)
     raw = labels.get(LABEL_SLICE_ORIGIN)
     if not sid or raw is None:
         return None
-    try:
-        origin = tuple(int(p) for p in raw.lower().split("x"))
-    except ValueError:
-        return None
-    if any(o < 0 for o in origin):
+    origin = parse_origin(raw)
+    if origin is None:
         return None
     return sid, origin

@@ -61,11 +61,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--hbm", type=int, default=16 * 1024,
                     help="per-chip HBM MiB for --fake-chips")
     ap.add_argument("--mesh", default=None)
-    ap.add_argument("--slice-id", default=os.environ.get("TPUSHARE_SLICE"),
+    ap.add_argument("--slice-id", default=os.environ.get("TPUSHARE_SLICE") or None,
                     help="multi-host ICI slice this host belongs to "
                          "(published as a node label for gang placement)")
     ap.add_argument("--slice-origin",
-                    default=os.environ.get("TPUSHARE_SLICE_ORIGIN"),
+                    default=os.environ.get("TPUSHARE_SLICE_ORIGIN") or None,
                     help="this host's box origin in the slice mesh, "
                          "'RxC' (e.g. 0x2); required with --slice-id")
     ap.add_argument("--fake-cluster", action="store_true",

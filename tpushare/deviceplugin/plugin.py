@@ -138,7 +138,12 @@ class DevicePlugin:
         # multi-host slice membership (docs/designs/multihost-gang.md):
         # operator-configured (TPU runtime metadata / install flags) —
         # published as node labels so the extender's gang coordinator
-        # can assemble the slice mesh. Both or neither.
+        # can assemble the slice mesh. Both or neither; empty strings
+        # (unset Helm values rendering as "") mean unset — publishing
+        # LABEL_SLICE="" would read as no membership on the scheduler
+        # side, the exact silent gang-disable this validation prevents.
+        slice_id = slice_id or None
+        slice_origin = slice_origin or None
         if (slice_id is None) != (slice_origin is None):
             raise ValueError("slice-id and slice-origin must be set "
                              "together (or neither)")

@@ -47,6 +47,8 @@ def render_table(tree: dict[str, Any], details: bool = False) -> str:
                 pods = ", ".join(
                     f"{p.get('namespace', '?')}/{p.get('name', p['uid'][:8])}"
                     f"={p['hbm_mib']}"
+                    + (f" [gang {p['gang']}#{p['gang_rank']}]"
+                       if "gang" in p else "")
                     for p in chip.get("pods", [])) or "-"
                 crows.append([
                     f"  {chip['idx']}",
