@@ -318,3 +318,94 @@ def test_chip_rebuild_preserves_gang_reservation():
     gang.gc(now_ns=lambda: clock[0])
     free = sum(v.free_hbm_mib for v in info.snapshot())
     assert free == 8 * 16000, "reservation must release after rebuild"
+
+
+def test_plan_recovery_after_coordinator_restart():
+    # rank 0 binds through coordinator A (plan stamped on the pod);
+    # coordinator B (fresh state — HA takeover or extender restart)
+    # must bind rank 1 to the ORIGINAL geometry recovered from the
+    # stamp, never a fresh plan
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    Controller(fc, cache).build_cache()
+    a = GangCoordinator(cache)
+    p0 = gang_pod(fc, "gp0", rank=0)
+    a.bind_member(p0, a.filter_hosts(p0)[0][0], fc, now_ns=lambda: 1)
+    partner_host, partner_chips = (a._plans["g1"].members[1][0],
+                                   a._plans["g1"].members[1][1])
+
+    b = GangCoordinator(cache)  # fresh coordinator, no in-memory plan
+    p1 = gang_pod(fc, "gp1", rank=1)
+    placement = b.bind_member(p1, partner_host, fc, now_ns=lambda: 2)
+    assert placement.chip_ids == partner_chips
+    # recovery marked rank 0 bound from its annotations: the recovered
+    # plan completed and was dropped
+    assert b._plans == {}
+    # both pods visibly placed, same gang
+    for name in ("gp0", "gp1"):
+        assert contract.chip_ids_from_annotations(
+            fc.get_pod("default", name)) is not None
+
+
+def test_recovery_refuses_rebinding_a_bound_rank():
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    Controller(fc, cache).build_cache()
+    a = GangCoordinator(cache)
+    p0 = gang_pod(fc, "gp0", rank=0)
+    host0 = a.filter_hosts(p0)[0][0]
+    a.bind_member(p0, host0, fc, now_ns=lambda: 1)
+
+    b = GangCoordinator(cache)
+    dup = gang_pod(fc, "gp0b", rank=0)  # another pod claiming rank 0
+    with pytest.raises(GangError, match="already bound"):
+        b.bind_member(dup, host0, fc, now_ns=lambda: 2)
+
+
+def test_filter_recovers_stamped_plan_after_takeover():
+    # rank 0 bound via coordinator A and OCCUPIES its chips; a fresh
+    # coordinator's Filter for rank 1 must answer from the stamped
+    # geometry — a fresh full-gang plan may not even exist anymore
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    Controller(fc, cache).build_cache()
+    a = GangCoordinator(cache)
+    p0 = gang_pod(fc, "gp0", rank=0)
+    a.bind_member(p0, a.filter_hosts(p0)[0][0], fc, now_ns=lambda: 1)
+    partner_host = a._plans["g1"].members[1][0]
+
+    b = GangCoordinator(cache)
+    p1 = gang_pod(fc, "gp1", rank=1)
+    hosts, reason = b.filter_hosts(p1)
+    assert hosts == [partner_host], reason
+    # and the recovered plan is authoritative in-memory now
+    assert "g1" in b._plans and 0 in b._plans["g1"].bound
+
+
+def test_finished_gang_does_not_block_resubmission():
+    # a completed gang's Succeeded pods linger with their stamp; a new
+    # gang under the SAME id must re-plan fresh, not recover the corpse
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    a = GangCoordinator(cache)
+    olds = []
+    for r in (0, 1):
+        p = gang_pod(fc, f"old{r}", rank=r)
+        a.bind_member(p, a.filter_hosts(p)[0][0], fc, now_ns=lambda: 1)
+        olds.append(p)
+    # gang finishes: pods go Succeeded (chips release via the normal
+    # pod lifecycle — simulate both)
+    for p in olds:
+        stored = fc.get_pod("default", p["metadata"]["name"])
+        stored["status"] = {"phase": "Succeeded"}
+        fc.replace_pod("default", p["metadata"]["name"], stored)
+        cache.remove_pod(stored)
+
+    b = GangCoordinator(cache)  # restarted coordinator
+    p0 = gang_pod(fc, "new0", rank=0)
+    hosts, reason = b.filter_hosts(p0)
+    assert hosts, reason  # re-planned fresh, not "already bound"
+    placement = b.bind_member(p0, hosts[0], fc, now_ns=lambda: 2)
+    assert placement.chip_ids

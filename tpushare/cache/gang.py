@@ -37,6 +37,7 @@ from tpushare import contract
 from tpushare.cache.nodeinfo import AllocationError
 from tpushare.contract import pod as podlib
 from tpushare.core.placement import PlacementRequest
+from tpushare.k8s.client import ApiError
 from tpushare.core.slice import HostBox, SliceTopology, select_gang
 
 
@@ -88,8 +89,12 @@ class GangCoordinator:
     # slice search inside every Filter webhook call
     PROVISIONAL_TTL_NS = 2 * 1_000_000_000
 
-    def __init__(self, cache) -> None:
+    def __init__(self, cache, cluster=None) -> None:
         self._cache = cache  # SchedulerCache
+        # the apiserver client, for plan recovery (listing gang peers
+        # after a coordinator restart); defaults to the cache's own
+        self._cluster = cluster if cluster is not None \
+            else getattr(cache, "_cluster", None)
         self._lock = threading.Lock()
         self._plans: dict[str, _Plan] = {}
         self._provisional: dict[str, tuple[_Plan | None, int]] = {}
@@ -197,14 +202,26 @@ class GangCoordinator:
                 else:
                     plan = -1  # sentinel: compute outside the lock
         if plan == -1:
-            plan = self._compute_plan(gid, pod, size, t)
-            with self._lock:
-                self._provisional[gid] = (plan, t)
-                # opportunistic cleanup; the dict stays O(live gangs)
-                for k in [k for k, (_, pt) in self._provisional.items()
-                          if t - pt >= self.PROVISIONAL_TTL_NS]:
-                    if k != gid:
-                        self._provisional.pop(k)
+            # no in-memory plan: first try RECOVERY (a takeover must
+            # answer late members from the stamped geometry — a fresh
+            # plan may not even exist once bound peers occupy their
+            # chips), then fall back to planning fresh
+            plan = self._recover_plan(gid, self._cluster)
+            if plan is not None:
+                # recovered plans are authoritative (they carry the
+                # bound set), not provisional
+                with self._lock:
+                    plan = self._plans.setdefault(gid, plan)
+            else:
+                plan = self._compute_plan(gid, pod, size, t)
+                with self._lock:
+                    self._provisional[gid] = (plan, t)
+                    # opportunistic cleanup; stays O(live gangs)
+                    for k in [k for k, (_, pt)
+                              in self._provisional.items()
+                              if t - pt >= self.PROVISIONAL_TTL_NS]:
+                        if k != gid:
+                            self._provisional.pop(k)
         if plan is None:
             return [], (f"gang {gid}: no slice admits "
                         f"{size} chips x {contract.pod_hbm_request(pod)}"
@@ -217,6 +234,59 @@ class GangCoordinator:
 
     # -- binding ------------------------------------------------------------
 
+    def _recover_plan(self, gid: str, cluster) -> _Plan | None:
+        """Rebuild a lost plan from the FIRST member's stamped
+        annotation (coordinator restart / HA leader takeover mid-gang).
+
+        The stamp carries the full geometry; the bound-set rebuilds
+        from which LIVE members already carry placement annotations on
+        the plan's hosts (terminated peers are ignored — a finished
+        gang's lingering Succeeded pods must not block a resubmission
+        under the same id). Without recovery, a takeover would re-plan
+        fresh geometry inconsistent with already-running members.
+        Called WITHOUT the coordinator lock (it LISTs the apiserver);
+        recovered plans hold NO coordinator reservations (the bound
+        members' capacity is pod-owned; unbound members re-reserve at
+        their own bind, failing retriably if the slice moved).
+        """
+        if cluster is None:
+            return None
+        try:
+            peers = [p for p in cluster.list_pods()
+                     if podlib.annotations(p).get(contract.ANN_GANG)
+                     == gid
+                     and not contract.is_complete_pod(p)]
+        except ApiError:
+            return None
+        stamped = None
+        for p in peers:
+            raw = contract.gang_plan_from_annotations(p)
+            if raw is not None:
+                stamped = raw
+                break
+        if stamped is None:
+            return None
+        try:
+            members = [(m["host"], tuple(int(c) for c in m["chips"]),
+                        tuple(int(b) for b in m["box"]),
+                        tuple(int(o) for o in m["origin"]))
+                       for m in stamped["members"]]
+            plan = _Plan(gang_id=gid, t_ns=int(stamped["t"]),
+                         slice_id=str(stamped["slice"]),
+                         box=tuple(int(b) for b in stamped["box"]),
+                         origin=tuple(int(o) for o in stamped["origin"]),
+                         hbm_mib=int(stamped["hbm"]), members=members,
+                         shares_released=True)
+        except (KeyError, TypeError, ValueError):
+            return None  # corrupted stamp: treat as no plan
+        host_rank = {h: r for r, (h, _c, _b, _o) in enumerate(members)}
+        for p in peers:
+            node = podlib.pod_node_name(p)
+            if node in host_rank and \
+                    contract.chip_ids_from_annotations(p) is not None:
+                plan.bound.add(host_rank[node])
+        return plan
+
     def bind_member(self, pod: dict[str, Any], node_name: str, cluster,
                     now_ns: Callable[[], int] = time.time_ns,
                     ha_claims: bool = False):
@@ -224,14 +294,24 @@ class GangCoordinator:
 
         First member: computes the plan, reserves EVERY member's share
         (all-or-nothing), stamps the plan into this pod's placement
-        patch. Later members: replay from the reserved plan,
-        transferring their host's gang reservation to the pod.
+        patch. Later members: replay from the reserved plan (or one
+        RECOVERED from the stamped annotation after a coordinator
+        restart), transferring their host's gang reservation to the pod.
         """
         membership = contract.gang_membership(pod)
         if membership is None:
             raise GangError("bind_member called for a non-gang pod")
         gid, size, rank = membership
         t = now_ns()
+        with self._lock:
+            have_plan = gid in self._plans
+        if not have_plan:
+            # recovery LISTs the apiserver — outside the lock (same
+            # discipline as filter_hosts' compute-outside sentinel)
+            recovered = self._recover_plan(gid, cluster)
+            if recovered is not None:
+                with self._lock:
+                    self._plans.setdefault(gid, recovered)
         with self._lock:
             plan = self._plans.get(gid)
             first = plan is None
@@ -327,15 +407,18 @@ class GangCoordinator:
                 age = t - plan.t_ns
                 if age < self.PLAN_TTL_NS:
                     continue
+                # release is IDEMPOTENT and runs on every sweep past
+                # the TTL (not only the first): a failed bind's
+                # restored gang-key reservation (allocate_planned's
+                # transient-error path) must also drain eventually,
+                # even on plans recovered with shares_released set
+                for r, (host, chips, _b, _o) in enumerate(plan.members):
+                    if r in plan.bound:
+                        continue  # pod-owned; normal lifecycle
+                    info = self._cache.get_node_info(host)
+                    if info is not None:
+                        info.release_planned(_gang_key(gid, r), chips)
                 if not plan.shares_released:
-                    for r, (host, chips, _b, _o) in enumerate(
-                            plan.members):
-                        if r in plan.bound:
-                            continue  # pod-owned; normal lifecycle
-                        info = self._cache.get_node_info(host)
-                        if info is not None:
-                            info.release_planned(_gang_key(gid, r),
-                                                 chips)
                     plan.shares_released = True
                     acted += 1
                 if not plan.bound or age >= 10 * self.PLAN_TTL_NS:

@@ -148,17 +148,28 @@ class DevicePlugin:
             raise ValueError("slice-id and slice-origin must be set "
                              "together (or neither)")
         if slice_origin is not None:
-            parts = slice_origin.lower().split("x")
-            if any(not p.isdigit() for p in parts) or                     len(parts) != len(enumerator.mesh.shape):
-                # rank must match THIS host's mesh, or the scheduler's
-                # slice assembly silently rejects the whole slice
-                # (gang.py slice_topology rank check) with no error
-                # anywhere near the typo that caused it
+            # fail at STARTUP, near the typo: a bad origin published
+            # as-is would silently disable the whole slice's gang
+            # scheduling at the coordinator's assembly checks. THE
+            # shared grammar (contract.parse_origin) does the parsing —
+            # the scheduler reads labels with the same function, so the
+            # two sides cannot drift.
+            origin = contract.parse_origin(slice_origin)
+            shape = enumerator.mesh.shape
+            if origin is None or len(origin) != len(shape):
                 raise ValueError(
                     f"slice-origin {slice_origin!r} must be "
-                    f"{len(enumerator.mesh.shape)} 'x'-separated "
+                    f"{len(shape)} non-negative 'x'-separated "
                     f"coordinates matching this host's mesh "
                     f"{enumerator.mesh.label()} (e.g. 0x2)")
+            if any(o % s for o, s in zip(origin, shape)):
+                # real slices tile homogeneously (every host the same
+                # box), so origins sit at multiples of the box dims; a
+                # misaligned origin cannot tile with same-shape peers
+                raise ValueError(
+                    f"slice-origin {slice_origin!r} is not aligned to "
+                    f"this host's box {enumerator.mesh.label()} — "
+                    "hosts tile the slice at box-size multiples")
         self.slice_id = slice_id
         self.slice_origin = slice_origin
         self._chips = enumerator.enumerate()
