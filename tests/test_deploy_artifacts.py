@@ -39,6 +39,9 @@ def test_all_samples_parse_and_request_tpu():
             # members) carry it directly
             spec = doc["spec"]
             tmpl = spec["template"]["spec"] if "template" in spec else spec
+            if "containers" not in tmpl:
+                continue  # supporting objects (e.g. the gang sample's
+                # headless Service) carry no workload
             limits = tmpl["containers"][0]["resources"]["limits"]
             # sharing pods request tpu-hbm; exclusive whole-chip pods
             # (e.g. the gang sample) request tpu-count only — either

@@ -178,3 +178,17 @@ def test_attn_window_config_flash_matches_einsum():
     full = forward(params, tokens,
                    dataclasses.replace(base, attn_window=None))
     assert float(jnp.max(jnp.abs(full - ref))) > 1e-3
+
+
+def test_player_modes_run():
+    # the player is what sample pods actually execute; all three modes
+    # must drive end to end on the hermetic mesh (train = gang member,
+    # sp ring = long-context member, default forward = sharing tenant)
+    from tpushare.workloads import player
+
+    assert player.main(["--steps", "1", "--mode", "train",
+                        "--batch", "1", "--seq", "32"]) == 0
+    assert player.main(["--steps", "1", "--sp", "ring",
+                        "--batch", "1", "--seq", "128"]) == 0
+    assert player.main(["--steps", "1", "--batch", "1",
+                        "--seq", "32"]) == 0

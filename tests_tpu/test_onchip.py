@@ -285,3 +285,45 @@ def test_pipelined_forward_compiled_gqa():
     ref = attention_reference(q, jnp.repeat(k, 4, 1), jnp.repeat(v, 4, 1),
                               causal=True)
     assert_close(out, ref, atol=5e-2)
+
+
+def test_flash_prefill_serving_parity_compiled():
+    # the serving prefill path (forward_cached prefill-from-zero with
+    # attn="flash") against the einsum config, compiled on chip at a
+    # serving-ish shape — licenses the bench's prefill TTFT A/B
+    import dataclasses
+
+    from tpushare.workloads.model import (ModelConfig, forward_cached,
+                                          init_kv_cache, init_params)
+
+    base = ModelConfig(vocab=512, d_model=256, n_layers=2, n_heads=4,
+                       n_kv_heads=2, d_ff=512, attn_window=256)
+    cfg_e = dataclasses.replace(base, attn="einsum")
+    cfg_f = dataclasses.replace(base, attn="flash")
+    p = init_params(cfg_e, jax.random.key(60))
+    toks = jax.random.randint(jax.random.key(61), (2, 384), 0, 512)
+    le, _ = jax.jit(lambda t: forward_cached(
+        p, t, init_kv_cache(cfg_e, 2, 512), 0, cfg_e,
+        prefill_from_zero=True))(toks)
+    lf, _ = jax.jit(lambda t: forward_cached(
+        p, t, init_kv_cache(cfg_f, 2, 512), 0, cfg_f,
+        prefill_from_zero=True))(toks)
+    assert_close(le, lf, atol=5e-2)
+
+
+def test_full_stack_decode_runs_compiled():
+    # window + int8 weights + int8 KV + rolling ring, compiled end to
+    # end on chip (the samples/5-serving.yaml stack the bench times)
+    from tpushare.workloads.model import (ModelConfig, greedy_decode_kv,
+                                          init_params, quantize_int8)
+
+    cfg = ModelConfig(vocab=512, d_model=256, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=512, attn_window=128,
+                      kv_cache_dtype="int8")
+    qp = quantize_int8(init_params(cfg, jax.random.key(62)))
+    toks = jax.random.randint(jax.random.key(63), (2, 96), 0, 512)
+    out = jax.jit(lambda t: greedy_decode_kv(qp, t, 16, cfg,
+                                             rolling=True))(toks)
+    out = np.asarray(out)
+    assert out.shape == (2, 112)
+    assert (out >= 0).all() and (out < 512).all()

@@ -18,9 +18,26 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="tpushare-player")
     ap.add_argument("--preset", default="llama-tiny")
     ap.add_argument("--steps", type=int, default=0,
-                    help="forward passes to run (0 = run forever)")
+                    help="forward/train passes to run (0 = run forever)")
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--mode", choices=["forward", "train"],
+                    default="forward",
+                    help="train = full fwd+bwd+adamw step (what a gang "
+                         "member runs; samples/6-gang.yaml)")
+    ap.add_argument("--attn", choices=["einsum", "flash"],
+                    default="einsum")
+    ap.add_argument("--sp", choices=["none", "ring"], default="none",
+                    help="sequence-parallel attention over the local "
+                         "devices (ring = GQA-native ring attention)")
+    # Multi-host gang members: each pod is one JAX process of the gang's
+    # shared mesh. jax.distributed.initialize is driven entirely by env
+    # (set by the launcher/JobSet): COORDINATOR_ADDRESS, NUM_PROCESSES,
+    # PROCESS_ID — absent env means single-process (every test/dev run).
+    ap.add_argument("--multihost", action="store_true",
+                    help="call jax.distributed.initialize() from the "
+                         "standard env (COORDINATOR_ADDRESS, "
+                         "NUM_PROCESSES, PROCESS_ID) before device init")
     args = ap.parse_args(argv)
 
     from tpushare.contract import constants as c
@@ -35,22 +52,108 @@ def main(argv: list[str] | None = None) -> int:
         print(f"gating applied: {applied}", flush=True)
 
     import jax
+
+    if args.multihost:
+        # one process per gang member; the standard JAX env contract
+        # (GKE/JobSet set these; jax.distributed reads them when called
+        # with no arguments)
+        jax.distributed.initialize()
+        print(f"multihost: process {jax.process_index()} of "
+              f"{jax.process_count()}", flush=True)
+
+    import dataclasses
+
     import jax.numpy as jnp
-    from tpushare.workloads.model import PRESETS, forward, init_params
+    from tpushare.workloads.model import (PRESETS, forward, init_params,
+                                          make_train_step)
 
-    cfg = PRESETS[args.preset]
-    params = init_params(cfg, jax.random.key(0))
-    step = jax.jit(lambda p, t: forward(p, t, cfg))
-    tokens = jnp.zeros((args.batch, args.seq), jnp.int32)
+    cfg = dataclasses.replace(PRESETS[args.preset], attn=args.attn)
 
-    n = 0
+    if args.sp == "ring":
+        if args.mode == "train":
+            ap.error("--sp ring runs the ring-attention loop (the "
+                     "long-context hot op); it does not train the "
+                     "model — drop --mode train or --sp ring")
+        # long-context mode: the hot op is ring attention over the
+        # sequence-parallel mesh (all visible devices; across gang
+        # members when --multihost made them one process group)
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        from tpushare.workloads.ringattention import ring_attention
+        devs = jax.devices()
+        n = len(devs)
+        # ring needs S divisible by the sp size; round UP to a
+        # 128-aligned per-device chunk so any --seq works
+        chunk = -(-max(args.seq, 128 * n) // (128 * n)) * 128
+        S = chunk * n
+        hd = cfg.head_dim
+        if jax.process_count() > 1:
+            # multi-controller: each process holds only ITS slice of
+            # the sequence axis — build the global arrays from
+            # process-local shards (a host-local full array cannot be
+            # fed to a jit spanning other processes' devices)
+            mesh = Mesh(np.asarray(devs).reshape(n), ("sp",))
+            spec = PartitionSpec(None, None, "sp", None)
+            sharding = NamedSharding(mesh, spec)
+            rng = np.random.default_rng(jax.process_index())
+            local_S = S // jax.process_count()
+
+            def make(heads):
+                local = rng.standard_normal(
+                    (args.batch, heads, local_S, hd), dtype=np.float32)
+                return jax.make_array_from_process_local_data(
+                    sharding, local.astype(jnp.bfloat16))
+
+            q, k, v = (make(cfg.n_heads), make(cfg.n_kv_heads),
+                       make(cfg.n_kv_heads))
+        else:
+            mesh = Mesh(devs, ("sp",))
+            q = jax.random.normal(jax.random.key(1),
+                                  (args.batch, cfg.n_heads, S, hd),
+                                  jnp.bfloat16)
+            k = jax.random.normal(jax.random.key(2),
+                                  (args.batch, cfg.n_kv_heads, S, hd),
+                                  jnp.bfloat16)
+            v = jax.random.normal(jax.random.key(3), k.shape,
+                                  jnp.bfloat16)
+        ring_jit = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))
+
+        def run_once():
+            return ring_jit(q, k, v)
+
+        unit = f"ring/s (S={S} over {n} devices)"
+    elif args.mode == "train":
+        params = init_params(cfg, jax.random.key(0))
+        tokens = jnp.zeros((args.batch, args.seq), jnp.int32)
+        tx, train_step = make_train_step(cfg)
+        opt_state = tx.init(params)
+        step_jit = jax.jit(train_step)
+
+        def run_once():
+            nonlocal params, opt_state
+            params, opt_state, loss = step_jit(params, opt_state, tokens)
+            return loss
+
+        unit = "train/s"
+    else:
+        params = init_params(cfg, jax.random.key(0))
+        tokens = jnp.zeros((args.batch, args.seq), jnp.int32)
+        fwd_jit = jax.jit(lambda p, t: forward(p, t, cfg))
+
+        def run_once():
+            return fwd_jit(params, tokens)
+
+        unit = "fwd/s"
+
+    done = 0
     t0 = time.perf_counter()
-    while args.steps == 0 or n < args.steps:
-        step(params, tokens).block_until_ready()
-        n += 1
-        if n % 50 == 0 or n == args.steps:
+    while args.steps == 0 or done < args.steps:
+        jax.block_until_ready(run_once())
+        done += 1
+        if done % 50 == 0 or done == args.steps:
             dt = time.perf_counter() - t0
-            print(f"step {n}: {n / dt:.1f} fwd/s on "
+            print(f"step {done}: {done / dt:.1f} {unit} on "
                   f"{jax.devices()[0].platform}", flush=True)
     return 0
 
