@@ -483,8 +483,12 @@ def _probe_backend_resilient(probe_cmd: list | None = None) -> dict:
     on hang, SIGINT (recovers the pre-C-call window), wait out a truly
     blocked probe up to TPUSHARE_WEDGE_WAIT seconds (its self-exit
     yields the far end's real error and frees its queue slot), pause,
-    and retry exactly once. Knobs: TPUSHARE_PROBE_TIMEOUT (150 s),
-    TPUSHARE_WEDGE_WAIT (1800 s; 0 = don't wait for self-exit),
+    and retry exactly once. The diagnostic patience applies to ATTEMPT
+    1 only: the retry abandons a blocked client after the SIGINT grace
+    (a recovered backend answers in seconds; a second ~25-minute wait
+    on a dead one adds nothing and risks the caller's own timeout).
+    Knobs: TPUSHARE_PROBE_TIMEOUT (150 s), TPUSHARE_WEDGE_WAIT
+    (1800 s; 0 = don't wait for self-exit; attempt 1 only),
     TPUSHARE_WEDGE_PAUSE (120 s).
     """
     import time as _time
@@ -502,7 +506,14 @@ def _probe_backend_resilient(probe_cmd: list | None = None) -> dict:
         try:
             rc, out, err, note = _run_tpu_subprocess(
                 cmd, probe_s, label=f"probe{attempt}",
-                self_exit_wait_s=wedge_wait_s)
+                # the FIRST attempt carries the diagnostic patience
+                # (waiting out a blocked client yields the far end's
+                # real error, observed after ~25 min); the retry only
+                # needs the fast path — if the backend recovered it
+                # answers in seconds, and a second 25-minute wait on a
+                # still-dead backend would tell us nothing new while
+                # risking the driver's own bench timeout
+                self_exit_wait_s=wedge_wait_s if attempt == 1 else 0.0)
         except OSError as e:
             return {"ok": False, "summary": f"backend probe: {e}",
                     "attempts": attempts}
