@@ -121,9 +121,16 @@ def seed_pod(stub, name: str, hbm_mib: int) -> dict:
             "limits": {"aliyun.com/tpu-hbm": str(hbm_mib)}}}]}})
 
 
-def try_schedule(replicas, pod, node_names, attempts=30) -> str | None:
+def try_schedule(replicas, pod, node_names, attempts=80) -> str | None:
     """kube-scheduler's behavior across HA replicas: try one, and on 503 /
-    error / timeout retry (the service would round-robin endpoints)."""
+    error / timeout retry (the service would round-robin endpoints).
+
+    The retry budget must comfortably cover a leader takeover: the real
+    scheduler retries failed pods for minutes, while a loaded CI
+    machine can stretch this rig's sub-second lease handoff past a few
+    seconds — a skimpy budget here turns takeover jitter into test
+    flakes (observed: 30 x 0.02 s gave up mid-failover).
+    """
     name = pod["metadata"]["name"]
     for i in range(attempts):
         rep = replicas[i % len(replicas)]
@@ -141,7 +148,7 @@ def try_schedule(replicas, pod, node_names, attempts=30) -> str | None:
             timeout=5)
         if status == 200 and not result.get("Error"):
             return ok[0]
-        time.sleep(0.02)
+        time.sleep(0.05)
     return None
 
 
@@ -212,10 +219,23 @@ def test_storm_with_midflight_failover(cluster):
     assert wait_until(other.elector.is_leader, timeout=5), \
         "failover must complete"
 
+    # kube-scheduler retries pending pods indefinitely; the storm
+    # workers' bounded budgets model only its fast path, and takeover
+    # latency varies (the tight retry loops themselves starve the
+    # elector thread of the GIL in-process). Model the scheduler's
+    # retry horizon: whatever the storm left pending gets retried
+    # against the surviving leader before judging the outcome.
+    for pod in pods:
+        name = pod["metadata"]["name"]
+        if name not in bound:
+            node = try_schedule([other], pod, names)
+            if node:
+                bound[name] = node
+
     # capacity: 4 nodes x 4 chips x 16 GiB / 2 GiB = 128 slots >> 36 pods.
-    # Binds issued to the dying leader in its abdication instant may fail
-    # and the scheduler-side retry loop may exhaust, so demand a strong
-    # majority rather than all 36.
+    # Binds issued at the abdication instant may have burned retries on
+    # both replicas; after the post-failover retry pass, a strong
+    # majority must have landed.
     assert len(bound) >= 30, f"storm bound only {len(bound)}/36"
     per_chip = assert_apiserver_invariants(stub, a.client)
     # every bound pod's annotation node matches its binding
@@ -384,3 +404,86 @@ def test_claim_conflict_metric_counts_ha_backpressure(cluster):
         assert contract.chip_ids_from_annotations(victim) is None
     finally:
         stale.server.stop()
+
+
+def test_gang_survives_leader_failover_midgang(cluster):
+    # rank 0 binds through the leader; the leader dies before rank 1;
+    # the SURVIVOR (fresh coordinator state) must recover the stamped
+    # plan through the real HA stack and complete the gang on the
+    # ORIGINAL geometry — docs/designs/multihost-gang.md recovery.
+    stub, a, b = cluster
+    # relabel the 4 stub nodes into one slice (2x2 hosts of 2x2 chips)
+    for i, origin in enumerate(("0x0", "0x2", "2x0", "2x2")):
+        stub.seed("nodes", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"s{i}",
+                         "labels": {
+                             "tpushare": "true",
+                             "tpushare.aliyun.com/mesh": "2x2",
+                             contract.LABEL_SLICE: "slc0",
+                             contract.LABEL_SLICE_ORIGIN: origin}},
+            "status": {"capacity": {
+                "aliyun.com/tpu-hbm": str(CHIPS * HBM),
+                "aliyun.com/tpu-count": str(CHIPS)}}})
+    # the relabel may fall into the list->watch gap (the first watch
+    # connects from "now"); the 30 s resync heals it in production —
+    # trigger it directly here, then confirm both caches see the slice
+    for r in (a, b):
+        r.controller.resync_once()
+    assert wait_until(lambda: all(
+        getattr(r.cache.get_node_info("s0"), "slice_id", None) == "slc0"
+        for r in (a, b)), timeout=5.0)
+
+    def gang_pod(name, rank):
+        return stub.seed("pods", {
+            "metadata": {"name": name, "namespace": "storm",
+                         "annotations": {
+                             contract.ANN_GANG: "hag",
+                             contract.ANN_GANG_SIZE: "8",
+                             contract.ANN_GANG_RANK: str(rank),
+                             contract.ANN_TOPOLOGY: "2x4"}},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "limits": {"aliyun.com/tpu-count": "4"}}}]}})
+
+    replicas = [a, b]
+    names = [f"s{i}" for i in range(NODES)]
+
+    p0 = gang_pod("hag-0", 0)
+    host0 = leader = None
+    for r in replicas:
+        _, flt = post(r.base, "/filter", {"Pod": p0, "NodeNames": names})
+        cands = flt.get("NodeNames") or []
+        if not cands:
+            continue
+        status, bound = post(r.base, "/bind", {
+            "PodName": "hag-0", "PodNamespace": "storm",
+            "PodUID": p0["metadata"].get("uid", ""), "Node": cands[0]})
+        if status == 200 and not bound.get("Error"):
+            host0, leader = cands[0], r
+            break
+    assert leader is not None, "no replica bound gang rank 0"
+
+    # the leader that bound rank 0 dies (coordinator state lost)
+    survivor = b if leader is a else a
+    leader.stop()
+    assert wait_until(lambda: survivor.elector.is_leader(), timeout=10.0)
+    # survivor's watch must see rank 0's placement before recovery
+    assert wait_until(lambda: contract.chip_ids_from_annotations(
+        survivor.client.get_pod("storm", "hag-0")) is not None,
+        timeout=5.0)
+
+    p1 = gang_pod("hag-1", 1)
+    _, flt = post(survivor.base, "/filter",
+                  {"Pod": p1, "NodeNames": names})
+    assert flt.get("NodeNames"), flt
+    (host1,) = flt["NodeNames"]
+    assert host1 != host0  # original geometry's OTHER host
+    status, bound = post(survivor.base, "/bind", {
+        "PodName": "hag-1", "PodNamespace": "storm",
+        "PodUID": p1["metadata"].get("uid", ""), "Node": host1})
+    assert status == 200 and not bound.get("Error"), bound
+    # both members fully placed, distinct hosts, 4 chips each
+    for name in ("hag-0", "hag-1"):
+        pod = survivor.client.get_pod("storm", name)
+        ids = contract.chip_ids_from_annotations(pod)
+        assert ids is not None and len(ids) == 4

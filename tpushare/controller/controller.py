@@ -235,6 +235,27 @@ class Controller:
                     self._seen.pop(k, None)
         for _, pod in stale:
             self.cache.remove_pod(pod)  # missed DELETED / replaced UID
+        # nodes re-list too: the first watch connects from "now", so a
+        # node update committed between build_cache's LIST and the watch
+        # connecting is in a gap only anti-entropy can heal (observed:
+        # slice relabels invisible forever without this — capacity
+        # changes eventually repeat via the device plugin's periodic
+        # report, but labels don't)
+        try:
+            nodes = self._cluster.list_nodes()
+            live_names = set()
+            for node in nodes:
+                live_names.add(nodelib.node_name(node))
+                if contract.is_tpushare_node(node):
+                    self.cache.update_node(node)
+            # and the reverse gap: a node DELETED while the watch was
+            # down would otherwise haunt the cache forever (ghost hosts
+            # keep receiving gang plans and unhealthy-probe traffic)
+            for name in self.cache.node_names():
+                if name not in live_names:
+                    self.cache.remove_node(name)
+        except ApiError as e:
+            log.warning("controller: node resync failed: %s", e)
         for name in self.cache.node_names():
             self._load_unhealthy(name)
         for hook in list(self.resync_hooks):
