@@ -1,0 +1,155 @@
+"""Randomized gang-coordinator chaos: capacity can never leak.
+
+The single-node chaos suite (test_chaos.py) hammers allocate/reclaim on
+one NodeInfo; this drives the GANG layer the same way: random gangs
+(sizes, topologies, sharing/exclusive) bind member-by-member in random
+interleavings, with random mid-gang abandonment, plan-TTL expiry, pod
+deletions, and coordinator restarts (plan recovery) — asserting after
+every step that no chip is oversubscribed, and at the end that a full
+teardown returns the slice to pristine (the no-leak property that
+matters for a long-lived extender).
+"""
+
+import random
+
+import pytest
+
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.cache.gang import GangCoordinator, GangError
+from tpushare.cache.nodeinfo import AllocationError
+from tpushare.controller import Controller
+from tpushare.k8s import FakeCluster
+from tpushare.k8s.client import ApiError
+
+HOSTS = ["c0", "c1", "c2", "c3"]
+HBM = 16000
+
+
+def make_cluster():
+    fc = FakeCluster()
+    for name, origin in zip(HOSTS, ("0x0", "0x2", "2x0", "2x2")):
+        fc.add_tpu_node(name, chips=4, hbm_per_chip_mib=HBM, mesh="2x2",
+                        slice_id="slc", slice_origin=origin)
+    return fc
+
+
+def assert_no_oversubscription(cache):
+    for host in HOSTS:
+        for v in cache.get_node_info(host).snapshot():
+            assert v.used_hbm_mib <= v.total_hbm_mib, (host, v)
+
+
+def total_used(cache):
+    return sum(v.used_hbm_mib for host in HOSTS
+               for v in cache.get_node_info(host).snapshot())
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_gang_chaos_no_capacity_leak(seed):
+    rng = random.Random(seed)
+    fc = make_cluster()
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    gang = GangCoordinator(cache)
+    clock = [1_000_000_000]
+
+    def now():
+        return clock[0]
+
+    live: dict[str, dict] = {}   # gang id -> {size, members: {rank: pod}}
+    gang_n = 0
+
+    def spawn_gang():
+        nonlocal gang_n
+        gang_n += 1
+        gid = f"cg{gang_n}"
+        size, topo = rng.choice(((4, "2x2"), (8, "2x4"), (8, None),
+                                 (16, "4x4"), (4, None)))
+        hbm = rng.choice((0, 4000, 8000))
+        live[gid] = {"size": size, "topo": topo, "hbm": hbm,
+                     "members": {}, "bound": {}}
+        return gid
+
+    def member_pod(gid, rank):
+        spec = live[gid]
+        per_host = 4  # every shape here tiles as <=4 chips per host
+        ann = {contract.ANN_GANG: gid,
+               contract.ANN_GANG_SIZE: str(spec["size"]),
+               contract.ANN_GANG_RANK: str(rank)}
+        if spec["topo"]:
+            ann[contract.ANN_TOPOLOGY] = spec["topo"]
+        limits = {contract.RESOURCE_COUNT: str(per_host)}
+        if spec["hbm"]:
+            limits[contract.RESOURCE_HBM] = str(spec["hbm"])
+        return fc.create_pod({
+            "metadata": {"name": f"{gid}-m{rank}", "namespace": "chaos",
+                         "annotations": ann},
+            "spec": {"containers": [{"name": "c", "resources":
+                     {"limits": limits}}]}})
+
+    def try_bind_next(gid):
+        spec = live[gid]
+        n_members = spec["size"] // 4
+        unbound = [r for r in range(n_members)
+                   if r not in spec["bound"]]
+        if not unbound:
+            return
+        rank = rng.choice(unbound)
+        pod = spec["members"].get(rank)
+        if pod is None:
+            pod = member_pod(gid, rank)
+            spec["members"][rank] = pod
+        hosts, _reason = gang.filter_hosts(pod, now_ns=now)
+        if not hosts:
+            return
+        try:
+            placement = gang.bind_member(pod, hosts[0], fc, now_ns=now)
+            spec["bound"][rank] = (hosts[0], placement.chip_ids)
+        except (GangError, AllocationError, ApiError):
+            pass  # refusals are fine; invariants checked below
+
+    def delete_gang(gid):
+        spec = live.pop(gid)
+        for rank, pod in spec["members"].items():
+            name = pod["metadata"]["name"]
+            try:
+                stored = fc.get_pod("chaos", name)
+            except ApiError:
+                continue
+            fc.delete_pod("chaos", name)
+            if rank in spec["bound"]:
+                cache.remove_pod(stored)  # what the watch would do
+
+    for _ in range(60):
+        op = rng.random()
+        if op < 0.35 or not live:
+            gid = spawn_gang()
+            try_bind_next(gid)
+        elif op < 0.75:
+            try_bind_next(rng.choice(list(live)))
+        elif op < 0.85:
+            # abandon a gang mid-bind (pods deleted; reservations must
+            # drain via the plan TTL)
+            delete_gang(rng.choice(list(live)))
+        elif op < 0.95:
+            # time passes; expiry sweeps
+            clock[0] += rng.choice((1, GangCoordinator.PLAN_TTL_NS + 1))
+            gang.gc(now_ns=now)
+        else:
+            # coordinator restart: all in-memory plans lost; recovery
+            # must rebuild from stamped annotations
+            gang = GangCoordinator(cache)
+        assert_no_oversubscription(cache)
+
+    # teardown: delete every pod, expire every plan — the slice must
+    # return to pristine. THE invariant: nothing leaks, ever.
+    for gid in list(live):
+        delete_gang(gid)
+    clock[0] += 10 * GangCoordinator.PLAN_TTL_NS + 1
+    gang.gc(now_ns=now)
+    assert_no_oversubscription(cache)
+    assert total_used(cache) == 0, (
+        f"seed {seed}: {total_used(cache)} MiB leaked after teardown")
+    assert gang._plans == {}

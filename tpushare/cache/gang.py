@@ -423,4 +423,25 @@ class GangCoordinator:
                     acted += 1
                 if not plan.bound or age >= 10 * self.PLAN_TTL_NS:
                     self._plans.pop(gid)
+            # reconcile: any gang-keyed RESERVATION whose gang has no
+            # live plan in THIS coordinator is an orphan (coordinator
+            # restarted with stale cache state, or a bind-failure
+            # restore raced plan expiry) — release it. Own live plans'
+            # reservations are kept; in HA, a survivor's cache never
+            # held the dead leader's reservations, so this only ever
+            # frees capacity nothing can claim.
+            for name in self._cache.node_names():
+                try:
+                    info = self._cache.get_node_info(name)
+                except ApiError:
+                    continue  # node deleted between listing and fetch
+                orphans: dict[str, list[int]] = {}
+                for cid, key, _hbm in info.reserved_entries():
+                    if not key.startswith("gang:"):
+                        continue  # a pod's own in-flight bind
+                    gid = key[len("gang:"):].rsplit("#", 1)[0]
+                    if gid not in self._plans:
+                        orphans.setdefault(key, []).append(cid)
+                for key, cids in orphans.items():
+                    info.release_planned(key, cids)
         return acted

@@ -355,6 +355,17 @@ class NodeInfo:
                 self.chips[cid].remove_reserved(key)
             self._dirty()
 
+    def reserved_entries(self) -> list[tuple[int, str, int]]:
+        """(chip idx, key, hbm) for every RESERVED entry — the gang
+        coordinator's gc reconciles these against its live plans so an
+        orphaned coordinator reservation (restart, or a bind-failure
+        restore racing plan expiry) cannot phantom-occupy chips
+        forever."""
+        with self._lock:
+            return [(c.idx, uid, hbm)
+                    for c in self.chips
+                    for uid, hbm, reserved in c.entries() if reserved]
+
     def allocate_planned(self, pod, cluster, chip_ids: Sequence[int],
                          box, origin,
                          now_ns: Callable[[], int] = time.time_ns,
