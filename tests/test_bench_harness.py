@@ -37,9 +37,13 @@ def test_runner_success_captures_stdout():
 
 
 def test_runner_sigint_interrupts_python_level_hang():
+    # -S skips site processing: this rig's sitecustomize imports jax
+    # (seconds of uninterruptible C), which under load can outlast the
+    # SIGINT grace and flake the test — the runner's signal protocol is
+    # what's under test here, not the rig's interpreter startup
     t0 = time.time()
     rc, out, err, note = bench._run_tpu_subprocess(
-        [sys.executable, "-c", "import time; time.sleep(60)"],
+        [sys.executable, "-S", "-c", "import time; time.sleep(60)"],
         timeout_s=1.0, sigint_grace_s=10.0)
     assert rc is not None and rc != 0  # KeyboardInterrupt exit
     assert "SIGINT" in note
