@@ -7,8 +7,9 @@ nodeinfo.go:312-363); this module places one workload's chips across
 host boundaries as an axis-aligned sub-box of the SLICE mesh, expressed
 back in each host's local chip numbering so the existing per-node
 reserve/bind machinery can execute it. Design: docs/designs/
-multihost-gang.md. Extender wiring lands in r5; this kernel is pure and
-hermetic.
+multihost-gang.md. This kernel is pure and hermetic; the extender wiring
+(GangCoordinator, filter/bind verbs, annotation contract, device-plugin
+labels) lives in tpushare/cache/gang.py + tpushare/extender/handlers.py.
 
 Scoring note: inter-host links inside a slice are ICI (full bandwidth),
 so host crossings cost COORDINATION (kubelets in the gang, failure
