@@ -203,8 +203,10 @@ def test_pallas_backward_compiled_ragged():
 
 
 def test_pallas_backward_through_dispatch(monkeypatch):
-    # the full custom_vjp + _flash_bwd dispatch route with the opt-in
-    # env set — what production training runs after the default flips
+    # the full custom_vjp + _flash_bwd dispatch route with the env
+    # pinned — same path the default ("pallas" since the 2026-07-31
+    # on-chip capture) takes, kept pinned so the gate is invariant to
+    # future default changes
     monkeypatch.setenv("TPUSHARE_FLASH_BWD", "pallas")
     q, k, v = rand_qkv(jax.random.key(34), 1, 2, 640, 128, jnp.bfloat16)
     w = jax.random.normal(jax.random.key(35), q.shape, jnp.bfloat16)

@@ -54,8 +54,11 @@ def _resolve_flash_fwd(fwd_impl: str | None) -> str:
     grid step as the previous block's softmax/p@v consume, with scores
     double-buffered in VMEM, giving Mosaic's scheduler a data-
     independent MXU chain to overlap the VPU passes with. Identical
-    math in identical order; opt-in until its Mosaic compilation and an
-    A/B land on hardware (TPUSHARE_FLASH_FWD=pipelined).
+    math in identical order; stays opt-in (TPUSHARE_FLASH_FWD=pipelined)
+    because the captured on-chip A/B (2026-07-31, TPU v5 lite) put it at
+    34.5% MFU vs the step kernel's 49.2% — the double-buffered score
+    scratch halves the usable VMEM working set and costs more than the
+    VPU/MXU overlap recovers at the winning 1024x1024 tile.
     """
     if fwd_impl is None:
         fwd_impl = os.environ.get("TPUSHARE_FLASH_FWD", "step")
@@ -83,7 +86,7 @@ def _resolve_flash_bwd(bwd_impl: str | None) -> str:
     caller's own jit instead of a process-global VJP cache.
     """
     if bwd_impl is None:
-        bwd_impl = os.environ.get("TPUSHARE_FLASH_BWD", "xla")
+        bwd_impl = os.environ.get("TPUSHARE_FLASH_BWD", "pallas")
     if bwd_impl not in _FLASH_BWD_IMPLS:
         raise ValueError(
             f"bwd_impl={bwd_impl!r} (or $TPUSHARE_FLASH_BWD) must be one "
@@ -1003,10 +1006,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     sees keys [max(0, i-W+1), i]. KV blocks entirely below the window
     floor are skipped like beyond-diagonal blocks, so per-query cost is
     O(W) regardless of sequence length (Mistral-style long-context
-    serving); both backward paths (XLA scan and the opt-in Pallas pair)
+    serving); both backward paths (XLA scan and the default Pallas pair)
     apply the same floor skip and mask.
 
-    ``bwd_impl``: "xla" (blockwise scan) or "pallas" (kernel pair);
+    ``bwd_impl``: "pallas" (kernel pair, the default on TPU — x1.72
+    train fwd+bwd over the XLA scan, captured on chip 2026-07-31, 19/19
+    tests_tpu green; interpret mode always runs the XLA path) or "xla"
+    (blockwise scan, the escape hatch);
     ``None`` reads $TPUSHARE_FLASH_BWD when this function runs — part of
     its jit cache key for eager callers; under an outer jit the usual
     trace-time-closure caveat applies (see :func:`_resolve_flash_bwd`).
