@@ -1,0 +1,237 @@
+"""Sharding-aware training checkpoint/resume (orbax-backed).
+
+The control plane already survives restarts (cache replay /
+``build_cache``, the analogue of the reference's sync loop) and can
+PREEMPT a gang member mid-run (extender preempt verb); this module is the
+workload-side half of that story: a gang member that gets preempted and
+re-placed resumes training from the latest durable step instead of from
+scratch. The reference has no training loop at all, so there is nothing
+to port — this is TPU-first by construction:
+
+- **Sharded save/restore, no host gather**: checkpoints are written from
+  and restored onto ``jax.sharding`` meshes directly (orbax handles
+  per-shard IO); an 8B-parameter state never has to fit one host.
+- **Cross-mesh restore**: the target mesh may differ from the one that
+  saved (e.g. dp=4 x tp=2 -> dp=2 x tp=4 after a re-placement grants a
+  different slice shape). The restore target is described abstractly —
+  shapes + NamedShardings — so orbax reshards on read.
+- **Optimizer state gets real shardings too**: optax's adamw state
+  (``mu``/``nu``) mirrors the params pytree, so every leaf's
+  PartitionSpec is derived by path-suffix match against
+  :func:`tpushare.workloads.model.param_specs` (scalars like ``count``
+  fall back to replicated). No sharding-propagation compile needed —
+  the mapping is deterministic and testable.
+- **Geometry guard**: the model geometry is stored next to the state and
+  checked at restore; resuming a d_model=512 run from a d_model=4096
+  checkpoint fails loudly, not with a shape error 40 frames deep.
+
+Retention (``keep``) and atomicity (tmp-dir rename, partial writes never
+visible as a step) come from ``ocp.CheckpointManager`` — the same
+discipline the scheduler cache gets from CAS + rollback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpushare.workloads.model import (
+    ModelConfig, init_params, make_train_step, param_specs)
+
+# geometry fields that must match between the checkpoint and the resuming
+# process; dtype is deliberately absent (a bf16 run may resume an fp32
+# experiment) and attn/attn_window too (serving knobs, not state shape)
+_GEOMETRY_FIELDS = ("vocab", "d_model", "n_layers", "n_heads",
+                    "n_kv_heads", "d_ff", "moe_experts", "moe_top_k")
+
+
+def _geometry(cfg: ModelConfig) -> dict:
+    return {f: getattr(cfg, f) for f in _GEOMETRY_FIELDS}
+
+
+def _key_str(entry: Any) -> str:
+    """One tree-path entry as its plain key string (dict key, namedtuple
+    field, or sequence index)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def _path_spec_index(cfg: ModelConfig) -> dict:
+    """Map each params tree path (tuple of key strings) to its spec."""
+    specs = param_specs(cfg)
+    flat = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    return {tuple(_key_str(e) for e in path): spec for path, spec in flat}
+
+
+def opt_specs_like(cfg: ModelConfig, abstract_opt: Any) -> Any:
+    """PartitionSpec tree for an optimizer-state pytree.
+
+    adamw's ``mu``/``nu`` embed the params pytree whole, so a leaf at
+    ``(0, 'mu', 'layers', 'wq')`` takes the spec of params leaf
+    ``('layers', 'wq')`` — the longest path SUFFIX that names a param.
+    Leaves with no matching suffix (step counters, empty states) are
+    replicated. Works for any optax chain that stores param-shaped
+    moments under param-named paths, which is optax's convention.
+    """
+    index = _path_spec_index(cfg)
+    suffix_lens = sorted({len(k) for k in index}, reverse=True)
+
+    def spec_for(path, leaf):
+        names = tuple(_key_str(e) for e in path)
+        for n in suffix_lens:
+            spec = index.get(names[-n:]) if n <= len(names) else None
+            if spec is not None and leaf.ndim == len(spec):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, abstract_opt)
+
+
+def abstract_train_state(cfg: ModelConfig, tx: Any,
+                         mesh: jax.sharding.Mesh | None = None) -> dict:
+    """The restore target: {"params", "opt_state"} as ShapeDtypeStructs,
+    carrying NamedShardings for ``mesh`` (or no shardings when None —
+    single-device runs). This is what makes restore cross-mesh: orbax
+    reads each shard straight onto the TARGET layout."""
+    cfg.validate()
+    a_params = jax.eval_shape(lambda k: init_params(cfg, k),
+                              jax.random.key(0))
+    a_opt = jax.eval_shape(tx.init, a_params)
+    if mesh is None:
+        return {"params": a_params, "opt_state": a_opt}
+
+    def with_sharding(a, spec):
+        return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                    sharding=NamedSharding(mesh, spec))
+
+    p_specs = param_specs(cfg)
+    return {
+        "params": jax.tree.map(with_sharding, a_params, p_specs),
+        "opt_state": jax.tree.map(with_sharding, a_opt,
+                                  opt_specs_like(cfg, a_opt)),
+    }
+
+
+class TrainCheckpointer:
+    """Checkpoint/resume for ``make_train_step`` state.
+
+    >>> ckpt = TrainCheckpointer(dir, keep=3)
+    >>> params, opt_state, start = ckpt.resume_or_init(cfg, tx, key)
+    >>> for step in range(start, total):
+    ...     params, opt_state, loss = train_step(params, opt_state, toks)
+    ...     ckpt.maybe_save(step + 1, params, opt_state, cfg, every=50)
+    >>> ckpt.close()
+
+    Saves are atomic (orbax writes to a tmp dir and renames) and pruned
+    to the newest ``keep`` steps. ``save`` blocks until durable — a gang
+    member acking a preempt AFTER save() returns cannot lose that step.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def steps(self) -> list[int]:
+        """All retained checkpoint steps, ascending (at most ``keep``)."""
+        return sorted(self._mgr.all_steps())
+
+    def save(self, step: int, params: Any, opt_state: Any,
+             cfg: ModelConfig) -> None:
+        ocp = self._ocp
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(
+                    {"params": params, "opt_state": opt_state}),
+                meta=ocp.args.JsonSave(_geometry(cfg))))
+        self._mgr.wait_until_finished()
+
+    def maybe_save(self, step: int, params: Any, opt_state: Any,
+                   cfg: ModelConfig, every: int) -> bool:
+        if every <= 0 or step % every:
+            return False
+        self.save(step, params, opt_state, cfg)
+        return True
+
+    def restore(self, cfg: ModelConfig, tx: Any,
+                mesh: jax.sharding.Mesh | None = None,
+                step: int | None = None) -> tuple[Any, Any, int]:
+        """Returns (params, opt_state, step) at ``step`` (default latest),
+        laid out for ``mesh``. Raises FileNotFoundError when the
+        directory holds no checkpoint and ValueError on geometry
+        mismatch."""
+        ocp = self._ocp
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError("no checkpoint to restore")
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(
+                    abstract_train_state(cfg, tx, mesh)),
+                meta=ocp.args.JsonRestore()))
+        saved_geo = dict(restored["meta"])
+        want_geo = _geometry(cfg)
+        if saved_geo != want_geo:
+            raise ValueError(
+                f"checkpoint geometry {saved_geo} != resuming config "
+                f"{want_geo} — refusing to load mismatched state")
+        state = restored["state"]
+        return state["params"], state["opt_state"], step
+
+    def resume_or_init(self, cfg: ModelConfig, tx: Any, key: jax.Array,
+                       mesh: jax.sharding.Mesh | None = None,
+                       ) -> tuple[Any, Any, int]:
+        """Latest checkpoint if one exists, else a fresh init — the one
+        call a preemptable trainer makes at startup. Returns
+        (params, opt_state, start_step); start_step 0 means fresh."""
+        step = self.latest_step()
+        if step is not None:
+            params, opt_state, step = self.restore(cfg, tx, mesh=mesh)
+            return params, opt_state, step
+        if mesh is None:
+            params = init_params(cfg, key)
+        else:
+            # init INSIDE jit with out_shardings: the params materialize
+            # directly as global sharded arrays — correct in multi-process
+            # meshes too, where device_put of a host-local array onto a
+            # sharding spanning non-addressable devices is not
+            p_sh = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                param_specs(cfg),
+                                is_leaf=lambda x: isinstance(x, P))
+            params = jax.jit(lambda k: init_params(cfg, k),
+                             out_shardings=p_sh)(key)
+        opt_state = tx.init(params)
+        return params, opt_state, 0
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def make_resumable_trainer(cfg: ModelConfig, directory: str,
+                           keep: int = 3, learning_rate: float = 3e-4):
+    """Convenience wiring: (ckpt, tx, train_step) ready for the player's
+    train mode or any custom loop."""
+    cfg = dataclasses.replace(cfg).validate()
+    tx, train_step = make_train_step(cfg, learning_rate=learning_rate)
+    return TrainCheckpointer(directory, keep=keep), tx, train_step

@@ -38,6 +38,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="call jax.distributed.initialize() from the "
                          "standard env (COORDINATOR_ADDRESS, "
                          "NUM_PROCESSES, PROCESS_ID) before device init")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="train mode: checkpoint/resume directory — on "
+                         "start the latest step there is restored (a "
+                         "preempted-and-replaced gang member continues "
+                         "instead of restarting), and every --ckpt-every "
+                         "steps the state is saved durably")
+    ap.add_argument("--ckpt-every", type=int, default=50)
     args = ap.parse_args(argv)
 
     from tpushare.contract import constants as c
@@ -124,15 +131,54 @@ def main(argv: list[str] | None = None) -> int:
 
         unit = f"ring/s (S={S} over {n} devices)"
     elif args.mode == "train":
-        params = init_params(cfg, jax.random.key(0))
         tokens = jnp.zeros((args.batch, args.seq), jnp.int32)
         tx, train_step = make_train_step(cfg)
-        opt_state = tx.init(params)
+        ckpt = None
+        trained = 0
+        if args.ckpt_dir:
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from tpushare.workloads.checkpoint import TrainCheckpointer
+            # checkpointing needs GLOBAL arrays: under a multi-process
+            # gang every member saves into the same directory, which is
+            # only coherent when the state is one sharded global pytree
+            # (each process then writes exactly its own shards). Dense
+            # presets shard megatron-style over "tp" across the whole
+            # gang; MoE shards over "ep", which this wiring doesn't
+            # build — refuse rather than corrupt a shared directory.
+            if cfg.moe_experts:
+                raise SystemExit(
+                    "--ckpt-dir train mode supports dense presets; MoE "
+                    "state shards over 'ep' (use TrainCheckpointer with "
+                    "your own mesh)")
+            import numpy as np
+            mesh = Mesh(np.array(jax.devices()).reshape(1, -1),
+                        ("dp", "tp"))
+            ckpt = TrainCheckpointer(args.ckpt_dir)
+            params, opt_state, trained = ckpt.resume_or_init(
+                cfg, tx, jax.random.key(0), mesh=mesh)
+            if trained:
+                print(f"resumed from step {trained} ({args.ckpt_dir})",
+                      flush=True)
+            if jax.process_count() > 1:
+                # every process feeds the same token block; lift it to a
+                # replicated global array so the pjit accepts it
+                tokens = jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, P()),
+                    np.zeros((args.batch, args.seq), np.int32))
+        else:
+            params = init_params(cfg, jax.random.key(0))
+            opt_state = tx.init(params)
         step_jit = jax.jit(train_step)
 
         def run_once():
-            nonlocal params, opt_state
+            nonlocal params, opt_state, trained
             params, opt_state, loss = step_jit(params, opt_state, tokens)
+            trained += 1
+            if ckpt is not None:
+                ckpt.maybe_save(trained, params, opt_state, cfg,
+                                every=args.ckpt_every)
             return loss
 
         unit = "train/s"
@@ -146,14 +192,17 @@ def main(argv: list[str] | None = None) -> int:
 
         unit = "fwd/s"
 
-    done = 0
+    # --steps is a TOTAL budget: a resumed trainer finishes the REMAINDER
+    # (resume at 900 of --steps 1000 runs 100 more, not 1000 — the
+    # userguide's "costs at most --ckpt-every steps" promise)
+    done = resumed = trained if args.mode == "train" else 0
     t0 = time.perf_counter()
     while args.steps == 0 or done < args.steps:
         jax.block_until_ready(run_once())
         done += 1
         if done % 50 == 0 or done == args.steps:
             dt = time.perf_counter() - t0
-            print(f"step {done}: {done / dt:.1f} {unit} on "
+            print(f"step {done}: {(done - resumed) / dt:.1f} {unit} on "
                   f"{jax.devices()[0].platform}", flush=True)
     return 0
 
