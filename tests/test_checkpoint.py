@@ -100,6 +100,11 @@ def test_geometry_mismatch_refuses_restore(tmp_path):
         tx2, _ = make_train_step(wider)
         with pytest.raises(ValueError, match="geometry"):
             ckpt.restore(wider, tx2)
+        # the guard must fire on the MESH path too (the one player.py
+        # uses) — i.e. BEFORE StandardRestore's strict shape check,
+        # whose error names a tensor instead of the mistake
+        with pytest.raises(ValueError, match="geometry"):
+            ckpt.restore(wider, tx2, mesh=mesh(2, 4))
 
 
 def test_retention_keeps_newest_n(tmp_path):

@@ -1,0 +1,126 @@
+"""End-to-end continuous-batching serving (serve.py --engine).
+
+Drives the real CLI surface in a subprocess — HTTP wire, engine thread,
+slot admission — not the library. The subprocess is forced hermetic:
+unsetting PALLAS_AXON_POOL_IPS disables the rig's TPU sitecustomize
+registration, and JAX_PLATFORMS=cpu then selects the CPU backend
+normally (conftest.py can't reach into a child process).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpushare.workloads.engine import DecodeEngine
+from tpushare.workloads.model import PRESETS, init_params
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAX_LEN = 64
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(port, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def serve_proc():
+    port = _free_port()
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU registration
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tpushare.workloads.serve",
+         "--preset", "llama-tiny", "--quant", "none", "--engine",
+         "--engine-slots", "4", "--engine-max-len", str(MAX_LEN),
+         "--engine-quantum", "2", "--port", str(port)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 90
+    last = ""
+    while time.time() < deadline:
+        if p.poll() is not None:
+            pytest.fail(f"serve exited rc={p.returncode}: "
+                        f"{p.stdout.read()[-2000:]}")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2) as r:
+                if r.status == 200:
+                    break
+        except OSError as e:
+            last = str(e)
+            time.sleep(0.5)
+    else:
+        pytest.fail(f"serve never became healthy: {last}")
+    yield port
+    p.send_signal(signal.SIGINT)
+    try:
+        p.wait(20)
+    except subprocess.TimeoutExpired:
+        p.kill()  # CPU-only child: no TPU claim to wedge
+
+
+def _expected(prompts, steps):
+    """The engine's own numerics in-process (same seed, same geometry —
+    CPU either side), giving the wire test a bitwise target."""
+    cfg = PRESETS["llama-tiny"].validate()
+    params = init_params(cfg, jax.random.key(0))
+    eng = DecodeEngine(params, cfg, max_slots=4, max_len=MAX_LEN,
+                       quantum=2)
+    rids = [eng.submit(list(map(int, p)), steps) for p in prompts]
+    done = eng.drain()
+    return [list(p) + done[r] for p, r in zip(prompts, rids)]
+
+
+def test_single_and_batch_generation(serve_proc):
+    port = serve_proc
+    # single flat prompt: accepted, answered with prompt + steps tokens
+    out = _post(port, {"tokens": [7, 3, 9], "steps": 4})["tokens"]
+    assert len(out) == 1 and len(out[0]) == 3 + 4
+    assert out[0][:3] == [7, 3, 9]
+    assert out == _expected([[7, 3, 9]], 4)
+
+    # ragged batch in one POST: all prompts co-resident, each row equals
+    # its solo decode (continuous batching must not cross-pollute)
+    prompts = [[5, 9], [100, 2, 77, 31], [240] * 7]
+    rows = _post(port, {"tokens": prompts, "steps": 3})["tokens"]
+    assert rows == _expected(prompts, 3)
+
+
+def test_deterministic_across_requests(serve_proc):
+    port = serve_proc
+    a = _post(port, {"tokens": [12, 8, 4], "steps": 5})
+    b = _post(port, {"tokens": [12, 8, 4], "steps": 5})
+    assert a == b
+
+
+def test_oversized_request_is_rejected_not_fatal(serve_proc):
+    port = serve_proc
+    bad = [1] * (MAX_LEN + 1)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, {"tokens": bad, "steps": 4})
+    assert ei.value.code == 400
+    # server still serves afterwards
+    ok = _post(port, {"tokens": [1, 2], "steps": 2})["tokens"]
+    assert len(ok[0]) == 4

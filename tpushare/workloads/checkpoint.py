@@ -178,18 +178,23 @@ class TrainCheckpointer:
             step = self._mgr.latest_step()
             if step is None:
                 raise FileNotFoundError("no checkpoint to restore")
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(
-                    abstract_train_state(cfg, tx, mesh)),
-                meta=ocp.args.JsonRestore()))
-        saved_geo = dict(restored["meta"])
+        # geometry first, state second: the guard must fire BEFORE
+        # StandardRestore's own strict shape check (whose error names a
+        # tensor, not the mistake) — and a wrong-geometry state never
+        # gets read off disk at all
+        saved_geo = dict(self._mgr.restore(
+            step, args=ocp.args.Composite(
+                meta=ocp.args.JsonRestore()))["meta"])
         want_geo = _geometry(cfg)
         if saved_geo != want_geo:
             raise ValueError(
                 f"checkpoint geometry {saved_geo} != resuming config "
                 f"{want_geo} — refusing to load mismatched state")
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(
+                    abstract_train_state(cfg, tx, mesh))))
         state = restored["state"]
         return state["params"], state["opt_state"], step
 

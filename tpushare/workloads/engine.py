@@ -1,0 +1,258 @@
+"""Continuous-batching decode engine (slot-based, static shapes).
+
+The serve path so far decodes one fixed batch start-to-finish; real
+serving traffic is ragged — requests arrive mid-flight with different
+prompt lengths and generation budgets. The GPU-world answer (vLLM-style
+continuous batching) leans on dynamic batch reshaping; on TPU that would
+mean recompilation per batch shape. This engine is the TPU-first
+formulation, built so EVERY compiled program has a static shape:
+
+- **Slots, not batches**: the KV cache is pre-allocated once as
+  ``max_slots`` rows (`init_kv_cache(cfg, S, max_len)`); a request
+  occupies a free slot, decodes in lock-step with whatever else is
+  resident, and frees its slot on completion. No shape ever changes.
+- **Per-slot positions via vmap**: one compiled step advances all S
+  slots one token, each at its OWN position — ``jax.vmap`` of the
+  tested single-stream :func:`forward_cached` over the slot axis, so
+  numerics are the cached path's (parity-tested), and the per-slot
+  cache write lowers to one scatter.
+- **Decode quantum**: host sync once per ``quantum`` steps, not per
+  token — ``lax.scan`` runs k masked steps on device and returns the
+  [k, S] token block. Arrivals join at quantum boundaries; inactive
+  slots compute-and-discard (the standard static-shape trade: HBM-bound
+  decode makes the wasted lanes cheap, and XLA never re-specializes).
+- **Bucketed prefill**: prompts pad to the next power-of-two bucket and
+  run one B=1 ``forward_cached`` prefill; pad positions land BEYOND the
+  slot's position watermark, so they are invisible to the position mask
+  and later overwritten in place as decode advances. One compile per
+  bucket, ~log2(max_len) compiles total.
+
+Works with the bf16 and int8 KV caches. Rolling (ring) caches and MoE
+presets are excluded: a ring's wraparound watermark is per-slot state
+the vmapped write doesn't thread yet, and capacity routing couples
+tokens across slots (the same caveat as greedy_decode_kv).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpushare.workloads.model import (
+    ModelConfig, forward_cached, init_kv_cache)
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    slot: int
+    tokens: list  # generated so far (host copy)
+    budget: int   # max new tokens
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class DecodeEngine:
+    """Greedy continuous-batching decoder over a fixed slot pool.
+
+    >>> eng = DecodeEngine(params, cfg, max_slots=8, max_len=256)
+    >>> rid = eng.submit([1, 17, 23], max_new=32)   # joins mid-flight
+    >>> finished = eng.run_quantum()                 # {rid: [tokens...]}
+
+    ``submit`` raises RuntimeError when no slot is free (callers queue;
+    tpushare.workloads.serve does). Completion = budget exhausted or
+    ``eos_id`` emitted. Deterministic: a request's tokens equal a solo
+    :func:`greedy_decode_kv` run of the same prompt regardless of which
+    co-tenants share the quantum (tests/test_engine.py asserts this).
+    """
+
+    def __init__(self, params: dict, cfg: ModelConfig, max_slots: int,
+                 max_len: int, quantum: int = 8,
+                 eos_id: int | None = None):
+        cfg.validate()
+        if cfg.moe_experts:
+            raise ValueError("continuous batching excludes MoE presets "
+                             "(capacity routing couples slots)")
+        self._params = params
+        self._cfg = cfg
+        self._S = int(max_slots)
+        self._M = int(max_len)
+        self._quantum = int(quantum)
+        self._eos = -1 if eos_id is None else int(eos_id)
+        self._cache = init_kv_cache(cfg, self._S, self._M)
+        self._pos = jnp.zeros((self._S,), jnp.int32)
+        self._last = jnp.zeros((self._S,), jnp.int32)
+        self._active = jnp.zeros((self._S,), bool)
+        self._remaining = jnp.zeros((self._S,), jnp.int32)
+        self._free = list(range(self._S))
+        self._by_slot: dict[int, _Request] = {}
+        self._next_rid = 0
+        # requests completed by their own prefill (budget 1 / instant
+        # eos), surfaced by the next run_quantum/drain
+        self._done_now: dict[int, list[int]] = {}
+
+    # -- compiled programs (cached per engine: shapes are fixed) -------------
+
+    @functools.cached_property
+    def _quantum_fn(self):
+        params, cfg, eos = self._params, self._cfg, self._eos
+
+        def slot_step(cache, last, pos):
+            def one(cache_slot, tok, p):
+                cb = jax.tree.map(lambda x: x[:, None], cache_slot)
+                logits, nc = forward_cached(params, tok[None, None], cb,
+                                            p, cfg)
+                return logits[0, -1], jax.tree.map(lambda x: x[:, 0], nc)
+
+            return jax.vmap(one, in_axes=(1, 0, 0),
+                            out_axes=(0, 1))(cache, last, pos)
+
+        def step(carry, _):
+            cache, pos, last, active, remaining = carry
+            logits, new_cache = slot_step(cache, last, pos)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # inactive slots keep their cache/position/token untouched
+            sel = active.reshape(1, -1, *([1] * 3))
+            cache = jax.tree.map(
+                lambda new, old: jnp.where(sel, new, old),
+                new_cache, cache)
+            emitted = jnp.where(active, nxt, -1)
+            pos = pos + active.astype(jnp.int32)
+            remaining = remaining - active.astype(jnp.int32)
+            done = active & ((nxt == eos) | (remaining <= 0))
+            last = jnp.where(active, nxt, last)
+            active = active & ~done
+            return (cache, pos, last, active, remaining), emitted
+
+        def run(cache, pos, last, active, remaining, k_steps):
+            carry = (cache, pos, last, active, remaining)
+            carry, emitted = lax.scan(step, carry, None, length=k_steps)
+            return carry, emitted  # emitted [k, S]
+
+        return jax.jit(run, static_argnums=(5,))
+
+    @functools.cached_property
+    def _prefill_fn(self):
+        params, cfg = self._params, self._cfg
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def prefill(tokens_padded, bucket_len, plen):
+            cache1 = init_kv_cache(cfg, 1, self._M)
+            logits, cache1 = forward_cached(
+                params, tokens_padded.reshape(1, bucket_len), cache1,
+                jnp.int32(0), cfg)
+            first = jnp.argmax(
+                lax.dynamic_index_in_dim(logits, plen - 1, axis=1,
+                                         keepdims=False)[0], axis=-1)
+            return first.astype(jnp.int32), cache1
+
+        return prefill
+
+    @functools.cached_property
+    def _insert_fn(self):
+        @jax.jit
+        def insert(cache, pos, last, active, remaining, cache1, slot,
+                   plen, first, budget):
+            cache = jax.tree.map(
+                lambda big, one: lax.dynamic_update_index_in_dim(
+                    big, one[:, 0], slot, axis=1),
+                cache, cache1)
+            pos = pos.at[slot].set(plen)
+            last = last.at[slot].set(first)
+            active = active.at[slot].set(budget > 1)
+            remaining = remaining.at[slot].set(budget - 1)
+            return cache, pos, last, active, remaining
+
+        return insert
+
+    # -- host API ------------------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def resident(self) -> int:
+        return self._S - len(self._free)
+
+    def submit(self, prompt: list[int], max_new: int) -> int:
+        """Prefill ``prompt`` into a free slot; returns the request id.
+        The first generated token is produced by the prefill itself."""
+        if not self._free:
+            raise RuntimeError("no free slot (queue upstream)")
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        if len(prompt) + max_new > self._M:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                f"max_len {self._M}")
+        slot = self._free.pop()
+        plen = len(prompt)
+        # the bucket must stay inside the slot's KV buffer: a non-pow2
+        # max_len would otherwise round a valid prompt past it (e.g.
+        # plen 17 -> bucket 32 > max_len 24) and crash the cache write
+        bucket = min(_bucket(plen), self._M)
+        padded = jnp.zeros((bucket,), jnp.int32).at[:plen].set(
+            jnp.asarray(prompt, jnp.int32))
+        first, cache1 = self._prefill_fn(padded, bucket,
+                                         jnp.int32(plen))
+        (self._cache, self._pos, self._last, self._active,
+         self._remaining) = self._insert_fn(
+            self._cache, self._pos, self._last, self._active,
+            self._remaining, cache1, jnp.int32(slot), jnp.int32(plen),
+            first, jnp.int32(max_new))
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid=rid, slot=slot, tokens=[int(first)],
+                       budget=max_new)
+        self._by_slot[slot] = req
+        if max_new == 1 or int(first) == self._eos:
+            # completed by the prefill itself; slot never decodes
+            self._free.append(slot)
+            del self._by_slot[slot]
+            self._done_now[rid] = req.tokens
+        return rid
+
+    def run_quantum(self, k: int | None = None) -> dict[int, list[int]]:
+        """Advance all resident requests up to ``k`` (default: the
+        engine's quantum) tokens; returns {rid: full token list} for
+        requests that finished during this quantum (or at submit)."""
+        finished: dict[int, list[int]] = self._done_now
+        self._done_now = {}
+        if not self._by_slot:
+            return finished
+        k = self._quantum if k is None else int(k)
+        (carry, emitted) = self._quantum_fn(
+            self._cache, self._pos, self._last, self._active,
+            self._remaining, k)
+        (self._cache, self._pos, self._last, self._active,
+         self._remaining) = carry
+        emitted_host = jax.device_get(emitted)  # [k, S], -1 = idle lane
+        active_host = jax.device_get(self._active)
+        for slot, req in list(self._by_slot.items()):
+            toks = [int(t) for t in emitted_host[:, slot] if t >= 0]
+            req.tokens.extend(toks)
+            if not active_host[slot]:
+                finished[req.rid] = req.tokens
+                del self._by_slot[slot]
+                self._free.append(slot)
+        return finished
+
+    def drain(self) -> dict[int, list[int]]:
+        """Run quanta until every resident request completes."""
+        out: dict[int, list[int]] = {}
+        while self._by_slot or self._done_now:
+            out.update(self.run_quantum())
+        return out

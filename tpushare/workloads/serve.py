@@ -9,9 +9,98 @@ from __future__ import annotations
 
 import argparse
 import json
+import queue
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _EngineFrontend:
+    """Queue + single engine thread between HTTP handlers and a
+    DecodeEngine. All JAX calls happen on the engine thread (the
+    handlers only enqueue and wait), so slot admission, prefill, and
+    quanta never race. Admission is work-conserving: every quantum
+    boundary first fills free slots from the queue, then advances."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="decode-engine")
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def generate(self, prompt: list[int], max_new: int,
+                 timeout: float = 300.0) -> list[int]:
+        """Called from handler threads; blocks until the request's
+        generation completes. Raises ValueError for requests the engine
+        cannot ever place (oversized prompt etc.)."""
+        return self.generate_many([prompt], max_new, timeout)[0]
+
+    def generate_many(self, prompts: list[list[int]], max_new: int,
+                      timeout: float = 300.0) -> list[list[int]]:
+        """Enqueue ALL prompts before waiting on any — co-resident
+        decoding is the engine's whole point; a sequential
+        submit-and-wait would serialize the batch."""
+        pairs = [(threading.Event(), {}) for _ in prompts]
+        for p, (done, box) in zip(prompts, pairs):
+            self._q.put((list(p), max_new, done, box))
+        out = []
+        for done, box in pairs:
+            if not done.wait(timeout):
+                raise TimeoutError("generation timed out")
+            if "error" in box:
+                raise ValueError(box["error"])
+            out.append(box["tokens"])
+        return out
+
+    def _loop(self):
+        inflight: dict[int, tuple] = {}  # rid -> (done, box)
+        while not self._stop.is_set():
+            # admit as many queued requests as there are free slots;
+            # park until work arrives when fully idle
+            while self._engine.free_slots:
+                try:
+                    item = self._q.get(block=not (inflight or
+                                                  self._engine.resident),
+                                       timeout=0.5)
+                except queue.Empty:
+                    break
+                prompt, max_new, done, box = item
+                try:
+                    rid = self._engine.submit(prompt, max_new)
+                except Exception as e:  # noqa: BLE001 — an uncaught
+                    # exception would kill this daemon thread silently
+                    # and hang every later request at its timeout
+                    box["error"] = f"{type(e).__name__}: {e}"
+                    done.set()
+                    continue
+                inflight[rid] = (done, box)
+            if not inflight:
+                continue
+            try:
+                finished = self._engine.run_quantum()
+            except Exception as e:  # noqa: BLE001 — same thread-death
+                # hazard; fail the resident requests loudly and keep
+                # serving (their slots stay burned: engine state after a
+                # mid-quantum fault is unknown)
+                print(f"decode engine quantum failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
+                for done, box in inflight.values():
+                    box["error"] = f"engine failure: {e}"
+                    done.set()
+                inflight.clear()
+                continue
+            for rid, tokens in finished.items():
+                done, box = inflight.pop(rid)
+                box["tokens"] = tokens
+                done.set()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -37,6 +126,20 @@ def main(argv: list[str] | None = None) -> int:
     # message instead of a bare AssertionError from ModelConfig)
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel size (0 = all local devices)")
+    ap.add_argument("--engine", action="store_true",
+                    help="continuous batching: requests join a fixed "
+                         "slot pool mid-flight instead of decoding one "
+                         "batch start-to-finish (workloads/engine.py)")
+    ap.add_argument("--engine-slots", type=int, default=8)
+    ap.add_argument("--engine-max-len", type=int, default=512,
+                    help="per-slot KV budget: prompt + generation must "
+                         "fit (static shapes — allocated once)")
+    ap.add_argument("--engine-quantum", type=int, default=8,
+                    help="decode steps per host sync; arrivals join at "
+                         "quantum boundaries")
+    ap.add_argument("--eos-id", type=int, default=-1,
+                    help="engine mode: token id that ends a generation "
+                         "early (-1 = generate to budget)")
     args = ap.parse_args(argv)
 
     from tpushare.workloads.hbm import apply_hbm_gating
@@ -118,6 +221,22 @@ def main(argv: list[str] | None = None) -> int:
             p, t, n, cfg, rolling=args.rolling_kv)
     decode = jax.jit(decode_fn, static_argnums=2)
 
+    engine_front = None
+    if args.engine:
+        if args.no_kv_cache or args.rolling_kv:
+            ap.error("--engine requires the plain KV-cached path "
+                     "(conflicts with --no-kv-cache/--rolling-kv)")
+        if cfg.moe_experts:
+            ap.error("--engine excludes MoE presets (capacity routing "
+                     "couples slots)")
+        from tpushare.workloads.engine import DecodeEngine
+        eos = None if args.eos_id < 0 else args.eos_id
+        engine_front = _EngineFrontend(
+            DecodeEngine(params, cfg, args.engine_slots,
+                         args.engine_max_len,
+                         quantum=args.engine_quantum, eos_id=eos))
+        engine_front.start()
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
@@ -129,10 +248,21 @@ def main(argv: list[str] | None = None) -> int:
             try:
                 body = json.loads(self.rfile.read(
                     int(self.headers.get("Content-Length", 0))))
-                tokens = jnp.asarray(body["tokens"], jnp.int32)
                 steps = int(body.get("steps", 8))
-                out = decode(params, tokens, steps)
-                resp = json.dumps({"tokens": out.tolist()}).encode()
+                if engine_front is not None:
+                    prompts = body["tokens"]
+                    if prompts and isinstance(prompts[0], int):
+                        prompts = [prompts]  # single sequence accepted
+                    # response rows = prompt + generation, the same
+                    # shape contract as the batch decode below
+                    gen = engine_front.generate_many(
+                        [list(p) for p in prompts], steps)
+                    rows = [list(p) + g for p, g in zip(prompts, gen)]
+                    resp = json.dumps({"tokens": rows}).encode()
+                else:
+                    tokens = jnp.asarray(body["tokens"], jnp.int32)
+                    out = decode(params, tokens, steps)
+                    resp = json.dumps({"tokens": out.tolist()}).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(resp)))
@@ -155,9 +285,12 @@ def main(argv: list[str] | None = None) -> int:
                 self.send_error(404)
 
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
+    front = (f", engine slots={args.engine_slots} "
+             f"quantum={args.engine_quantum}" if engine_front else "")
     print(f"tpushare-serve ready on :{httpd.server_address[1]} "
           f"(preset={args.preset}, quant={args.quant}, "
-          f"mesh {'x'.join(f'{n}={s}' for n, s in zip(mesh.axis_names, mesh.devices.shape))})",
+          f"mesh {'x'.join(f'{n}={s}' for n, s in zip(mesh.axis_names, mesh.devices.shape))}"
+          f"{front})",
           flush=True)
     try:
         httpd.serve_forever()
