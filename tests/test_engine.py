@@ -143,3 +143,57 @@ def test_quantized_weights_engine():
     eng = DecodeEngine(qparams, CFG, max_slots=2, max_len=32)
     rid = eng.submit([2, 4, 8], 3)
     assert eng.drain()[rid] == solo_reference([2, 4, 8], 3, 32, qparams)
+
+
+def test_sampling_topk1_equals_greedy():
+    # top-1 masking leaves one finite logit: categorical must pick it,
+    # so temperature>0 + top_k=1 reproduces the greedy stream exactly
+    prompt, n = [3, 141, 59], 6
+    greedy = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32)
+    rg = greedy.submit(prompt, n)
+    sampled = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32,
+                           temperature=0.8, top_k=1)
+    rs = sampled.submit(prompt, n)
+    assert greedy.drain()[rg] == sampled.drain()[rs]
+
+
+def test_sampling_is_reproducible_and_residency_independent():
+    # the sample key is (seed, request id, position): with the same
+    # submission order, a request draws the same stream whether it runs
+    # alone or with co-tenants joining around it
+    prompt, n = [9, 9, 2], 10
+    kw = dict(temperature=1.5, top_k=8, seed=7)
+    solo = DecodeEngine(PARAMS, CFG, max_slots=3, max_len=48, **kw)
+    r_solo = solo.submit(prompt, n)         # rid 0
+    out_solo = solo.drain()[r_solo]
+
+    mixed = DecodeEngine(PARAMS, CFG, max_slots=3, max_len=48,
+                         quantum=3, **kw)
+    r_mix = mixed.submit(prompt, n)         # rid 0, same stream
+    mixed.submit([44, 1], 5)
+    mixed.run_quantum()
+    mixed.submit([7] * 6, 4)                # joins mid-flight
+    out_mix = mixed.drain()[r_mix]
+    assert out_solo == out_mix
+    assert len(out_solo) == n
+
+
+def test_sampling_seed_changes_stream():
+    prompt, n = [5, 80, 3], 16
+    outs = []
+    for seed in (0, 1):
+        eng = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32,
+                           temperature=2.0, seed=seed)
+        rid = eng.submit(prompt, n)
+        outs.append(eng.drain()[rid])
+    assert outs[0] != outs[1]
+
+
+def test_sampling_validation():
+    with pytest.raises(ValueError, match="temperature"):
+        DecodeEngine(PARAMS, CFG, 1, 16, temperature=-0.1)
+    with pytest.raises(ValueError, match="top_k"):
+        DecodeEngine(PARAMS, CFG, 1, 16, top_k=CFG.vocab + 1)
+    # top_k alone would silently greedy-decode: refuse the footgun
+    with pytest.raises(ValueError, match="top_k requires"):
+        DecodeEngine(PARAMS, CFG, 1, 16, top_k=8)

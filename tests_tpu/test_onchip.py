@@ -313,6 +313,47 @@ def test_flash_prefill_serving_parity_compiled():
     assert_close(le, lf, atol=5e-2)
 
 
+def test_engine_cotenant_parity_compiled():
+    # continuous-batching engine on chip: the vmapped per-slot decode
+    # (engine.py) must emit exactly what the single-stream cached path
+    # emits, with a request joining mid-flight — the static-shape slot
+    # machinery is only sound if residency stays invisible to numerics
+    from tpushare.workloads.engine import DecodeEngine
+    from tpushare.workloads.model import (ModelConfig, forward_cached,
+                                          init_kv_cache, init_params)
+
+    cfg = ModelConfig(vocab=512, d_model=256, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=512)
+    params = init_params(cfg, jax.random.key(70))
+    M = 48
+
+    def solo(prompt, n):
+        cache = init_kv_cache(cfg, 1, M)
+        logits, cache = forward_cached(
+            params, jnp.asarray(prompt, jnp.int32)[None], cache,
+            jnp.int32(0), cfg)
+        toks = [int(jnp.argmax(logits[0, -1]))]
+        pos = len(prompt)
+        while len(toks) < n:
+            logits, cache = forward_cached(
+                params, jnp.asarray([[toks[-1]]], jnp.int32), cache,
+                jnp.int32(pos), cfg)
+            toks.append(int(jnp.argmax(logits[0, -1])))
+            pos += 1
+        return toks
+
+    eng = DecodeEngine(params, cfg, max_slots=3, max_len=M, quantum=4)
+    ra = eng.submit([5, 9], 6)
+    rb = eng.submit([100, 2, 77, 31, 8], 3)
+    out = dict(eng.run_quantum())
+    rc = eng.submit([240] * 7, 5)           # joins mid-flight
+    out.update(eng.drain())
+    for rid, prompt, n in ((ra, [5, 9], 6),
+                           (rb, [100, 2, 77, 31, 8], 3),
+                           (rc, [240] * 7, 5)):
+        assert out[rid] == solo(prompt, n), rid
+
+
 def test_full_stack_decode_runs_compiled():
     # window + int8 weights + int8 KV + rolling ring, compiled end to
     # end on chip (the samples/5-serving.yaml stack the bench times)

@@ -74,21 +74,50 @@ class DecodeEngine:
     ``eos_id`` emitted. Deterministic: a request's tokens equal a solo
     :func:`greedy_decode_kv` run of the same prompt regardless of which
     co-tenants share the quantum (tests/test_engine.py asserts this).
+
+    ``temperature > 0`` switches selection to sampling (optionally
+    top-k-masked), still fully reproducible AND residency-independent:
+    the sample key is ``fold_in(fold_in(seed, request_id), position)``,
+    a function of the request and the query position only — never of
+    the slot index, the co-tenants, or where quantum boundaries fall.
     """
 
     def __init__(self, params: dict, cfg: ModelConfig, max_slots: int,
                  max_len: int, quantum: int = 8,
-                 eos_id: int | None = None):
+                 eos_id: int | None = None, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0):
         cfg.validate()
         if cfg.moe_experts:
             raise ValueError("continuous batching excludes MoE presets "
                              "(capacity routing couples slots)")
+        if temperature < 0:
+            raise ValueError(f"temperature {temperature} must be >= 0")
+        if top_k < 0 or top_k > cfg.vocab:
+            raise ValueError(f"top_k {top_k} outside [0, vocab]")
+        if top_k > 0 and temperature == 0.0:
+            raise ValueError(
+                "top_k requires temperature > 0 (temperature 0 is "
+                "greedy argmax and would silently ignore top_k)")
         self._params = params
         self._cfg = cfg
         self._S = int(max_slots)
         self._M = int(max_len)
         self._quantum = int(quantum)
         self._eos = -1 if eos_id is None else int(eos_id)
+        # sampling is static per engine (baked into the compiled step);
+        # temperature 0 = greedy argmax, the deterministic default.
+        # Randomness is keyed per (request, position): each request gets
+        # fold_in(seed, rid) at submit and every emitted token folds in
+        # its query position — so a request's sample stream is identical
+        # no matter which slot it lands in or where quanta fall
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        self._seed = int(seed)
+        # key buffer shaped for the ACTIVE prng impl (threefry keys are
+        # uint32[2], rbg uint32[4] — hardcoding one breaks the other)
+        proto = jax.random.PRNGKey(0)
+        self._slot_keys = jnp.zeros((self._S,) + proto.shape,
+                                    proto.dtype)
         self._cache = init_kv_cache(cfg, self._S, self._M)
         self._pos = jnp.zeros((self._S,), jnp.int32)
         self._last = jnp.zeros((self._S,), jnp.int32)
@@ -103,9 +132,29 @@ class DecodeEngine:
 
     # -- compiled programs (cached per engine: shapes are fixed) -------------
 
+    def _pick_fn(self):
+        """Token selection from final-position logits, static per
+        engine: greedy argmax at temperature 0, else top-k-masked
+        categorical keyed by (request key, query position)."""
+        temperature, top_k = self._temperature, self._top_k
+
+        def pick(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = (logits / temperature).astype(jnp.float32)
+            if top_k > 0:
+                vals, _ = lax.top_k(scaled, top_k)
+                floor = vals[..., -1:]
+                scaled = jnp.where(scaled >= floor, scaled, -jnp.inf)
+            return jax.random.categorical(key, scaled,
+                                          axis=-1).astype(jnp.int32)
+
+        return pick
+
     @functools.cached_property
     def _quantum_fn(self):
         params, cfg, eos = self._params, self._cfg, self._eos
+        pick = self._pick_fn()
 
         def slot_step(cache, last, pos):
             def one(cache_slot, tok, p):
@@ -118,9 +167,12 @@ class DecodeEngine:
                             out_axes=(0, 1))(cache, last, pos)
 
         def step(carry, _):
-            cache, pos, last, active, remaining = carry
+            cache, pos, last, active, remaining, keys = carry
             logits, new_cache = slot_step(cache, last, pos)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # per-(request, position) sample keys: quantum boundaries
+            # and slot placement can't shift a request's stream
+            step_keys = jax.vmap(jax.random.fold_in)(keys, pos)
+            nxt = jax.vmap(pick)(logits, step_keys)
             # inactive slots keep their cache/position/token untouched
             sel = active.reshape(1, -1, *([1] * 3))
             cache = jax.tree.map(
@@ -132,28 +184,31 @@ class DecodeEngine:
             done = active & ((nxt == eos) | (remaining <= 0))
             last = jnp.where(active, nxt, last)
             active = active & ~done
-            return (cache, pos, last, active, remaining), emitted
+            return (cache, pos, last, active, remaining, keys), emitted
 
-        def run(cache, pos, last, active, remaining, k_steps):
-            carry = (cache, pos, last, active, remaining)
+        def run(cache, pos, last, active, remaining, keys, k_steps):
+            carry = (cache, pos, last, active, remaining, keys)
             carry, emitted = lax.scan(step, carry, None, length=k_steps)
             return carry, emitted  # emitted [k, S]
 
-        return jax.jit(run, static_argnums=(5,))
+        return jax.jit(run, static_argnums=(6,))
 
     @functools.cached_property
     def _prefill_fn(self):
         params, cfg = self._params, self._cfg
+        pick = self._pick_fn()
 
         @functools.partial(jax.jit, static_argnums=(1,))
-        def prefill(tokens_padded, bucket_len, plen):
+        def prefill(tokens_padded, bucket_len, plen, key):
             cache1 = init_kv_cache(cfg, 1, self._M)
             logits, cache1 = forward_cached(
                 params, tokens_padded.reshape(1, bucket_len), cache1,
                 jnp.int32(0), cfg)
-            first = jnp.argmax(
-                lax.dynamic_index_in_dim(logits, plen - 1, axis=1,
-                                         keepdims=False)[0], axis=-1)
+            final = lax.dynamic_index_in_dim(logits, plen - 1, axis=1,
+                                             keepdims=False)[0]
+            # the prefill emits for query position plen-1; decode then
+            # starts folding at plen — streams never collide
+            first = pick(final, jax.random.fold_in(key, plen - 1))
             return first.astype(jnp.int32), cache1
 
         return prefill
@@ -161,8 +216,8 @@ class DecodeEngine:
     @functools.cached_property
     def _insert_fn(self):
         @jax.jit
-        def insert(cache, pos, last, active, remaining, cache1, slot,
-                   plen, first, budget):
+        def insert(cache, pos, last, active, remaining, keys, cache1,
+                   slot, plen, first, budget, rkey):
             cache = jax.tree.map(
                 lambda big, one: lax.dynamic_update_index_in_dim(
                     big, one[:, 0], slot, axis=1),
@@ -171,7 +226,8 @@ class DecodeEngine:
             last = last.at[slot].set(first)
             active = active.at[slot].set(budget > 1)
             remaining = remaining.at[slot].set(budget - 1)
-            return cache, pos, last, active, remaining
+            keys = keys.at[slot].set(rkey)
+            return cache, pos, last, active, remaining, keys
 
         return insert
 
@@ -206,15 +262,16 @@ class DecodeEngine:
         bucket = min(_bucket(plen), self._M)
         padded = jnp.zeros((bucket,), jnp.int32).at[:plen].set(
             jnp.asarray(prompt, jnp.int32))
-        first, cache1 = self._prefill_fn(padded, bucket,
-                                         jnp.int32(plen))
-        (self._cache, self._pos, self._last, self._active,
-         self._remaining) = self._insert_fn(
-            self._cache, self._pos, self._last, self._active,
-            self._remaining, cache1, jnp.int32(slot), jnp.int32(plen),
-            first, jnp.int32(max_new))
         rid = self._next_rid
         self._next_rid += 1
+        rkey = jax.random.fold_in(jax.random.PRNGKey(self._seed), rid)
+        first, cache1 = self._prefill_fn(padded, bucket,
+                                         jnp.int32(plen), rkey)
+        (self._cache, self._pos, self._last, self._active,
+         self._remaining, self._slot_keys) = self._insert_fn(
+            self._cache, self._pos, self._last, self._active,
+            self._remaining, self._slot_keys, cache1, jnp.int32(slot),
+            jnp.int32(plen), first, jnp.int32(max_new), rkey)
         req = _Request(rid=rid, slot=slot, tokens=[int(first)],
                        budget=max_new)
         self._by_slot[slot] = req
@@ -236,9 +293,9 @@ class DecodeEngine:
         k = self._quantum if k is None else int(k)
         (carry, emitted) = self._quantum_fn(
             self._cache, self._pos, self._last, self._active,
-            self._remaining, k)
+            self._remaining, self._slot_keys, k)
         (self._cache, self._pos, self._last, self._active,
-         self._remaining) = carry
+         self._remaining, self._slot_keys) = carry
         emitted_host = jax.device_get(emitted)  # [k, S], -1 = idle lane
         active_host = jax.device_get(self._active)
         for slot, req in list(self._by_slot.items()):

@@ -140,6 +140,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--eos-id", type=int, default=-1,
                     help="engine mode: token id that ends a generation "
                          "early (-1 = generate to budget)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="engine mode: 0 = greedy (default); >0 samples "
+                         "(reproducibly — keyed by request + position)")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="engine mode: restrict sampling to the k "
+                         "highest logits (0 = full vocab)")
+    ap.add_argument("--sample-seed", type=int, default=0)
     args = ap.parse_args(argv)
 
     from tpushare.workloads.hbm import apply_hbm_gating
@@ -234,7 +241,9 @@ def main(argv: list[str] | None = None) -> int:
         engine_front = _EngineFrontend(
             DecodeEngine(params, cfg, args.engine_slots,
                          args.engine_max_len,
-                         quantum=args.engine_quantum, eos_id=eos))
+                         quantum=args.engine_quantum, eos_id=eos,
+                         temperature=args.temperature,
+                         top_k=args.top_k, seed=args.sample_seed))
         engine_front.start()
 
     class Handler(BaseHTTPRequestHandler):
