@@ -115,11 +115,31 @@ def test_deterministic_across_requests(serve_proc):
     assert a == b
 
 
+def test_metrics_scrape(serve_proc):
+    port = serve_proc
+    _post(port, {"tokens": [6, 6], "steps": 3})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "tpushare_serve_requests_total" in text
+    # engine mode exposes slot occupancy; all slots idle between requests
+    assert 'tpushare_serve_engine_slots{state="free"} 4.0' in text
+    # generated tokens counted (excludes echoed prompts)
+    tok = [l for l in text.splitlines()
+           if l.startswith("tpushare_serve_tokens_generated_total ")]
+    assert tok and float(tok[0].split()[-1]) >= 3
+
+
 def test_oversized_request_is_rejected_not_fatal(serve_proc):
     port = serve_proc
     bad = [1] * (MAX_LEN + 1)
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(port, {"tokens": bad, "steps": 4})
+    assert ei.value.code == 400
+    # non-positive steps rejected on every path (a negative value would
+    # drive the monotonic token counter backwards on the plain path)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, {"tokens": [1, 2], "steps": -3})
     assert ei.value.code == 400
     # server still serves afterwards
     ok = _post(port, {"tokens": [1, 2], "steps": 2})["tokens"]

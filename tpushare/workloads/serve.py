@@ -12,6 +12,7 @@ import json
 import queue
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
@@ -22,12 +23,21 @@ class _EngineFrontend:
     quanta never race. Admission is work-conserving: every quantum
     boundary first fills free slots from the queue, then advances."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, tokens_counter=None):
         self._engine = engine
+        self._tokens = tokens_counter
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="decode-engine")
+
+    @property
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    @property
+    def engine(self):
+        return self._engine
 
     def start(self):
         self._thread.start()
@@ -100,6 +110,8 @@ class _EngineFrontend:
             for rid, tokens in finished.items():
                 done, box = inflight.pop(rid)
                 box["tokens"] = tokens
+                if self._tokens is not None:
+                    self._tokens.inc(len(tokens))
                 done.set()
 
 
@@ -228,6 +240,25 @@ def main(argv: list[str] | None = None) -> int:
             p, t, n, cfg, rolling=args.rolling_kv)
     decode = jax.jit(decode_fn, static_argnums=2)
 
+    # observability: the serving tenant exposes the same wire format the
+    # extender does (tpushare/metrics.py) — replicas-per-chip decisions
+    # need tokens/s and slot pressure, not just extender-side placement
+    from tpushare.metrics import LATENCY_BUCKETS, Registry
+    registry = Registry()
+    m_requests = registry.counter(
+        "tpushare_serve_requests_total",
+        "generate requests received (incl. ones answered 400)")
+    m_errors = registry.counter(
+        "tpushare_serve_request_errors_total",
+        "generate requests answered with an error")
+    m_tokens = registry.counter(
+        "tpushare_serve_tokens_generated_total",
+        "tokens generated (excludes echoed prompt tokens)")
+    m_latency = registry.histogram(
+        "tpushare_serve_generate_seconds",
+        "wall time per generate request",
+        tuple(b * 100 for b in LATENCY_BUCKETS))  # decode >> bind scales
+
     engine_front = None
     if args.engine:
         if args.no_kv_cache or args.rolling_kv:
@@ -243,8 +274,20 @@ def main(argv: list[str] | None = None) -> int:
                          args.engine_max_len,
                          quantum=args.engine_quantum, eos_id=eos,
                          temperature=args.temperature,
-                         top_k=args.top_k, seed=args.sample_seed))
+                         top_k=args.top_k, seed=args.sample_seed),
+            tokens_counter=m_tokens)
         engine_front.start()
+        registry.gauge_func(
+            "tpushare_serve_engine_slots",
+            "decode-engine slot pool occupancy",
+            lambda: [('{state="free"}',
+                      float(engine_front.engine.free_slots)),
+                     ('{state="resident"}',
+                      float(engine_front.engine.resident))])
+        registry.gauge_func(
+            "tpushare_serve_engine_queue_depth",
+            "requests waiting for a free slot",
+            lambda: [("", float(engine_front.queue_depth))])
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -254,10 +297,17 @@ def main(argv: list[str] | None = None) -> int:
             if self.path != "/generate":
                 self.send_error(404)
                 return
+            m_requests.inc()
+            t_req = time.perf_counter()
             try:
                 body = json.loads(self.rfile.read(
                     int(self.headers.get("Content-Length", 0))))
                 steps = int(body.get("steps", 8))
+                if steps < 1:
+                    # the engine path rejects this in submit(); the
+                    # plain path must too (a negative value would also
+                    # drive the monotonic token counter backwards)
+                    raise ValueError(f"steps {steps} must be >= 1")
                 if engine_front is not None:
                     prompts = body["tokens"]
                     if prompts and isinstance(prompts[0], int):
@@ -271,18 +321,33 @@ def main(argv: list[str] | None = None) -> int:
                 else:
                     tokens = jnp.asarray(body["tokens"], jnp.int32)
                     out = decode(params, tokens, steps)
+                    m_tokens.inc(out.shape[0] * steps)
                     resp = json.dumps({"tokens": out.tolist()}).encode()
+                m_latency.observe(time.perf_counter() - t_req)
+            except Exception as e:  # noqa: BLE001 — serving surface
+                m_errors.inc()
+                msg = json.dumps({"error": str(e)}).encode()
+                try:
+                    self.send_response(400)
+                    self.send_header("Content-Length", str(len(msg)))
+                    self.end_headers()
+                    self.wfile.write(msg)
+                except OSError:
+                    pass  # client already gone
+                return
+            try:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(resp)))
                 self.end_headers()
                 self.wfile.write(resp)
-            except Exception as e:  # noqa: BLE001 — serving surface
-                msg = json.dumps({"error": str(e)}).encode()
-                self.send_response(400)
-                self.send_header("Content-Length", str(len(msg)))
-                self.end_headers()
-                self.wfile.write(msg)
+            except OSError:
+                # a client that hung up after generation succeeded is
+                # not a serving error: the error counter feeds the
+                # replicas-per-chip signal and must not count client
+                # disconnects (the request is already in the latency
+                # histogram as a success)
+                pass
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -290,6 +355,14 @@ def main(argv: list[str] | None = None) -> int:
                 self.send_header("Content-Length", "2")
                 self.end_headers()
                 self.wfile.write(b"ok")
+            elif self.path == "/metrics":
+                body = registry.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self.send_error(404)
 
