@@ -189,6 +189,23 @@ def test_sampling_seed_changes_stream():
     assert outs[0] != outs[1]
 
 
+def test_streaming_hooks_cover_every_token_exactly_once():
+    # peek_tokens right after submit + last_quantum_tokens per quantum
+    # must reconstruct the final stream with no gaps or duplicates —
+    # the contract serve.py's NDJSON streaming is built on
+    eng = DecodeEngine(PARAMS, CFG, max_slots=2, max_len=32, quantum=3)
+    rid = eng.submit([3, 141, 59], 8)
+    seen = list(eng.peek_tokens(rid))     # the prefill's token
+    assert len(seen) == 1
+    final = None
+    while final is None:
+        done = eng.run_quantum()
+        seen.extend(eng.last_quantum_tokens.get(rid, []))
+        final = done.get(rid)
+    assert seen == final == solo_reference([3, 141, 59], 8, 32)
+    assert eng.peek_tokens(rid) is None   # reported => gone
+
+
 def test_sampling_validation():
     with pytest.raises(ValueError, match="temperature"):
         DecodeEngine(PARAMS, CFG, 1, 16, temperature=-0.1)

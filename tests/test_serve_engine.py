@@ -115,6 +115,90 @@ def test_deterministic_across_requests(serve_proc):
     assert a == b
 
 
+def test_streaming_ndjson(serve_proc):
+    port = serve_proc
+    prompt = [7, 3, 9]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"tokens": prompt, "steps": 6,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    deltas, done_line = [], None
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers["Content-Type"] == "application/x-ndjson"
+        for raw in r:
+            ev = json.loads(raw)
+            if "delta" in ev:
+                assert done_line is None, "delta after done"
+                deltas.append(ev["delta"])
+            else:
+                done_line = ev
+    # >1 delta event = tokens actually arrived incrementally (quantum 2,
+    # 6 tokens => prefill + >=2 quanta), and the stream reassembles to
+    # exactly the non-streamed result
+    assert len(deltas) >= 3
+    flat = [t for d in deltas for t in d]
+    assert done_line["done"] is True
+    assert done_line["tokens"] == prompt + flat
+    assert done_line["tokens"] == _expected([prompt], 6)[0]
+
+
+def test_streaming_rejects_batch(serve_proc):
+    port = serve_proc
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, {"tokens": [[1, 2], [3, 4]], "steps": 2,
+                     "stream": True})
+    assert ei.value.code == 400
+
+
+def test_streaming_invalid_request_gets_400_not_200_body(serve_proc):
+    # the status line is deferred until the first stream event, so a
+    # submit-time rejection keeps the non-streaming path's 400 contract
+    port = serve_proc
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, {"tokens": [1] * (MAX_LEN - 2), "steps": 10,
+                     "stream": True})
+    assert ei.value.code == 400
+
+
+def test_stream_without_engine_is_rejected():
+    # a non-engine replica must refuse "stream": true loudly, not fall
+    # through to a buffered json response the client will misparse
+    port = _free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tpushare.workloads.serve",
+         "--preset", "llama-tiny", "--quant", "none",
+         "--port", str(port)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if p.poll() is not None:
+                pytest.fail(f"serve exited rc={p.returncode}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=2) as r:
+                    if r.status == 200:
+                        break
+            except OSError:
+                time.sleep(0.5)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, {"tokens": [1, 2], "steps": 2, "stream": True})
+        assert ei.value.code == 400
+        assert b"requires --engine" in ei.value.read()
+    finally:
+        p.send_signal(signal.SIGINT)
+        try:
+            p.wait(20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
 def test_metrics_scrape(serve_proc):
     port = serve_proc
     _post(port, {"tokens": [6, 6], "steps": 3})

@@ -125,10 +125,15 @@ class DecodeEngine:
         self._remaining = jnp.zeros((self._S,), jnp.int32)
         self._free = list(range(self._S))
         self._by_slot: dict[int, _Request] = {}
+        self._by_rid: dict[int, _Request] = {}
         self._next_rid = 0
         # requests completed by their own prefill (budget 1 / instant
         # eos), surfaced by the next run_quantum/drain
         self._done_now: dict[int, list[int]] = {}
+        # tokens emitted per rid by the MOST RECENT run_quantum (incl.
+        # a finishing request's final chunk) — the streaming hook;
+        # valid until the next call, same-thread use only
+        self.last_quantum_tokens: dict[int, list[int]] = {}
 
     # -- compiled programs (cached per engine: shapes are fixed) -------------
 
@@ -275,6 +280,7 @@ class DecodeEngine:
         req = _Request(rid=rid, slot=slot, tokens=[int(first)],
                        budget=max_new)
         self._by_slot[slot] = req
+        self._by_rid[rid] = req
         if max_new == 1 or int(first) == self._eos:
             # completed by the prefill itself; slot never decodes
             self._free.append(slot)
@@ -282,13 +288,24 @@ class DecodeEngine:
             self._done_now[rid] = req.tokens
         return rid
 
+    def peek_tokens(self, rid: int) -> list[int] | None:
+        """Tokens generated so far for an unreported request (None once
+        it has been reported finished, or for an unknown rid). Same
+        thread as run_quantum — this is the streaming frontend's view
+        of a request between quanta."""
+        req = self._by_rid.get(rid)
+        return list(req.tokens) if req is not None else None
+
     def run_quantum(self, k: int | None = None) -> dict[int, list[int]]:
         """Advance all resident requests up to ``k`` (default: the
         engine's quantum) tokens; returns {rid: full token list} for
         requests that finished during this quantum (or at submit)."""
         finished: dict[int, list[int]] = self._done_now
         self._done_now = {}
+        self.last_quantum_tokens = {}
         if not self._by_slot:
+            for rid in finished:
+                self._by_rid.pop(rid, None)
             return finished
         k = self._quantum if k is None else int(k)
         (carry, emitted) = self._quantum_fn(
@@ -301,10 +318,14 @@ class DecodeEngine:
         for slot, req in list(self._by_slot.items()):
             toks = [int(t) for t in emitted_host[:, slot] if t >= 0]
             req.tokens.extend(toks)
+            if toks:
+                self.last_quantum_tokens[req.rid] = toks
             if not active_host[slot]:
                 finished[req.rid] = req.tokens
                 del self._by_slot[slot]
                 self._free.append(slot)
+        for rid in finished:
+            self._by_rid.pop(rid, None)
         return finished
 
     def drain(self) -> dict[int, list[int]]:

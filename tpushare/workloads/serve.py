@@ -52,6 +52,28 @@ class _EngineFrontend:
         cannot ever place (oversized prompt etc.)."""
         return self.generate_many([prompt], max_new, timeout)[0]
 
+    def generate_stream(self, prompt: list[int], max_new: int,
+                        timeout: float = 300.0):
+        """Yields lists of newly generated tokens as decode quanta
+        complete (the first yield is the prefill's token). Terminates
+        when the request finishes; raises ValueError on rejection. The
+        per-yield timeout bounds ENGINE stall, not total generation."""
+        stream_q: queue.Queue = queue.Queue()
+        done = threading.Event()
+        box: dict = {"stream": stream_q}
+        self._q.put((list(prompt), max_new, done, box))
+        while True:
+            try:
+                kind, payload = stream_q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError("stream stalled") from None
+            if kind == "delta":
+                yield payload
+            elif kind == "error":
+                raise ValueError(payload)
+            else:  # "done"
+                return
+
     def generate_many(self, prompts: list[list[int]], max_new: int,
                       timeout: float = 300.0) -> list[list[int]]:
         """Enqueue ALL prompts before waiting on any — co-resident
@@ -88,8 +110,14 @@ class _EngineFrontend:
                     # exception would kill this daemon thread silently
                     # and hang every later request at its timeout
                     box["error"] = f"{type(e).__name__}: {e}"
+                    if "stream" in box:
+                        box["stream"].put(("error", box["error"]))
                     done.set()
                     continue
+                if "stream" in box:
+                    # the prefill already produced the first token
+                    box["stream"].put(
+                        ("delta", self._engine.peek_tokens(rid) or []))
                 inflight[rid] = (done, box)
             if not inflight:
                 continue
@@ -104,14 +132,22 @@ class _EngineFrontend:
                       flush=True)
                 for done, box in inflight.values():
                     box["error"] = f"engine failure: {e}"
+                    if "stream" in box:
+                        box["stream"].put(("error", box["error"]))
                     done.set()
                 inflight.clear()
                 continue
+            for rid, delta in self._engine.last_quantum_tokens.items():
+                done_box = inflight.get(rid)
+                if done_box is not None and "stream" in done_box[1]:
+                    done_box[1]["stream"].put(("delta", delta))
             for rid, tokens in finished.items():
                 done, box = inflight.pop(rid)
                 box["tokens"] = tokens
                 if self._tokens is not None:
                     self._tokens.inc(len(tokens))
+                if "stream" in box:
+                    box["stream"].put(("done", tokens))
                 done.set()
 
 
@@ -308,6 +344,15 @@ def main(argv: list[str] | None = None) -> int:
                     # plain path must too (a negative value would also
                     # drive the monotonic token counter backwards)
                     raise ValueError(f"steps {steps} must be >= 1")
+                if body.get("stream") and engine_front is None:
+                    raise ValueError("stream requires --engine")
+                if engine_front is not None and body.get("stream"):
+                    prompts = body["tokens"]
+                    if not (prompts and isinstance(prompts[0], int)):
+                        raise ValueError(
+                            "stream mode takes ONE flat prompt")
+                    self._stream(list(prompts), steps, t_req)
+                    return
                 if engine_front is not None:
                     prompts = body["tokens"]
                     if prompts and isinstance(prompts[0], int):
@@ -348,6 +393,48 @@ def main(argv: list[str] | None = None) -> int:
                 # disconnects (the request is already in the latency
                 # histogram as a success)
                 pass
+
+        def _stream(self, prompt, steps, t_req):
+            """NDJSON token streaming: one {"delta": [...]} line per
+            decode quantum as it lands, closed by {"done": true,
+            "tokens": [prompt + generation]}. The body is delimited by
+            connection close (no Content-Length) — curl -N or any
+            line-reader consumes it incrementally.
+
+            The status line is deferred until the FIRST event: a
+            submit-time rejection (oversized prompt etc.) is always the
+            first event available, so invalid requests get the same
+            HTTP 400 as the non-streaming path instead of an error
+            object inside a 200 body."""
+            gen = engine_front.generate_stream(prompt, steps)
+            events = iter(gen)
+            first = next(events, None)  # ValueError/TimeoutError -> 400
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            generated: list[int] = []
+            try:
+                deltas = ([] if first is None else [first])
+                for delta in (d for src in (deltas, events)
+                              for d in src):
+                    generated.extend(delta)
+                    self.wfile.write(
+                        json.dumps({"delta": delta}).encode() + b"\n")
+                    self.wfile.flush()
+                m_latency.observe(time.perf_counter() - t_req)
+                self.wfile.write(json.dumps(
+                    {"done": True,
+                     "tokens": list(prompt) + generated}).encode()
+                    + b"\n")
+            except (ValueError, TimeoutError) as e:
+                # mid-stream engine failure: 200 already sent, append
+                # the error event and close
+                m_errors.inc()
+                self.wfile.write(
+                    json.dumps({"error": str(e)}).encode() + b"\n")
+            except OSError:
+                pass  # client hung up mid-stream; not a serving error
 
         def do_GET(self):
             if self.path == "/healthz":
