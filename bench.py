@@ -972,6 +972,49 @@ def _kernel_bench_inline() -> dict | None:
         "llama_mini_full_stack_decode_tokens_per_s": round(
             mb / (full_ms / 1e3)),
     })
+
+    # continuous-batching engine at the same serving config (int8
+    # weights + int8 KV + window): 8 resident ragged-capable slots in
+    # lock-step. Timed as a slope over the quantum length k — each
+    # run_quantum call costs one dispatch + one [k, S] readback over
+    # the tunnel, so (t(k2) - t(k1)) / (k2 - k1) cancels the RTT the
+    # same way the in-jit scan slope does. Fail-soft: an engine fault
+    # publishes engine_error instead of failing the bench.
+    try:
+        import time as _time
+
+        from tpushare.workloads.engine import DecodeEngine
+
+        slots = 8
+        eng = DecodeEngine(qparams, cfg_srv_e, max_slots=slots,
+                           max_len=512, quantum=8)
+        eprompt = [int(t) for t in np.asarray(tokens[0, :128])]
+        for _ in range(slots):
+            # 128 prompt + 380 budget = 508 <= max_len 512
+            eng.submit(list(eprompt), max_new=380)
+        k1, k2, reps = 4, 68, 3
+        eng.run_quantum(k1)  # compile both quantum lengths
+        eng.run_quantum(k2)
+        t_by_k = {k1: [], k2: []}
+        for _ in range(reps):
+            for k in (k1, k2):
+                t0 = _time.perf_counter()
+                eng.run_quantum(k)
+                t_by_k[k].append(_time.perf_counter() - t0)
+        # budget audit: (1 + reps) * (k1 + k2) = 288 decode steps, and
+        # every slot has 379 post-prefill steps of budget — no slot
+        # deactivates inside a timed quantum
+        step_ms = (min(t_by_k[k2]) - min(t_by_k[k1])) / (k2 - k1) * 1e3
+        if step_ms <= 0:
+            raise RuntimeError(f"non-positive slope ({step_ms} ms)")
+        out.update({
+            "engine_slots": slots,
+            "engine_decode_step_ms": round(step_ms, 4),
+            "engine_decode_tokens_per_s": round(
+                slots / (step_ms / 1e3)),
+        })
+    except Exception as e:  # noqa: BLE001
+        out["engine_error"] = f"{type(e).__name__}: {e}"[:200]
     return out
 
 
