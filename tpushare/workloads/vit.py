@@ -1,0 +1,198 @@
+"""Vision Transformer encoder — the second workload family, TPU-first.
+
+The reference schedules opaque GPU pods and ships no models at all; this
+repo's workload families exist to prove the scheduler hosts REAL tenants
+under fractional HBM grants. The llama family covers autoregressive
+decoding; this one covers the encoder/vision shape of traffic (dense
+non-causal attention, no KV cache, classification head) with the same
+TPU-first discipline:
+
+- **Patch embedding is a matmul, not a conv op**: a stride-p pxp conv
+  over non-overlapping patches IS exactly reshape-to-patches @ W — so it
+  is written that way and lands on the MXU as one [B*N, p*p*C] x
+  [p*p*C, d] matmul with zero im2col overhead.
+- **Stacked layers + ``lax.scan``**: one compiled pre-LN block body
+  regardless of depth (same pattern as model.py).
+- **Attention reuses the flash kernel** (``attn="flash"``,
+  ``causal=False`` — the kernel's non-causal grid visits all blocks) or
+  the einsum reference; MHA is the GQA contract's H_kv == H case.
+- **bf16 matmuls, fp32 LayerNorm/softmax** accumulations.
+- **dp x tp sharding** via the megatron layout: in-projections shard
+  the head/hidden OUTPUT dim, out-projections the INPUT dim, one ICI
+  all-reduce per block (after wo, after w2); batch shards over dp.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpushare.workloads.attention import attention_reference, flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image: int = 224
+    patch: int = 16
+    channels: int = 3
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    attn: str = "einsum"  # or "flash" (Pallas kernel, causal=False)
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image // self.patch) ** 2
+
+    @property
+    def seq(self) -> int:
+        return self.n_patches + 1  # + [CLS]
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def validate(self) -> "ViTConfig":
+        assert self.image % self.patch == 0
+        assert self.d_model % self.n_heads == 0
+        assert self.attn in ("einsum", "flash")
+        return self
+
+
+PRESETS_VIT = {
+    # ViT-B/16 geometry (the standard encoder serving/finetune tenant)
+    "vit-b16": ViTConfig(),
+    # small config for tests and CPU meshes
+    "vit-tiny": ViTConfig(image=32, patch=8, d_model=64, n_layers=2,
+                          n_heads=4, d_ff=128, classes=10),
+}
+
+
+def init_vit_params(cfg: ViTConfig, key: jax.Array) -> dict:
+    """Stacked-layer pytree (leading axis = layer), bf16 weights."""
+    cfg.validate()
+    k = iter(jax.random.split(key, 10))
+    L, d, f = cfg.n_layers, cfg.d_model, cfg.d_ff
+    pdim = cfg.patch * cfg.patch * cfg.channels
+
+    def w(key, *shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    return {
+        "patch_embed": w(next(k), pdim, d, fan_in=pdim),
+        "cls_token": jnp.zeros((1, 1, d), cfg.dtype),
+        # learned position embedding, fp32 like the norms (added once)
+        "pos_embed": (jax.random.normal(next(k), (1, cfg.seq, d),
+                                        jnp.float32) * 0.02),
+        "layers": {
+            "ln1": jnp.ones((L, d), jnp.float32),
+            "ln1_b": jnp.zeros((L, d), jnp.float32),
+            "wq": w(next(k), L, d, d, fan_in=d),
+            "wk": w(next(k), L, d, d, fan_in=d),
+            "wv": w(next(k), L, d, d, fan_in=d),
+            "wo": w(next(k), L, d, d, fan_in=d),
+            "ln2": jnp.ones((L, d), jnp.float32),
+            "ln2_b": jnp.zeros((L, d), jnp.float32),
+            "w1": w(next(k), L, d, f, fan_in=d),
+            "w2": w(next(k), L, f, d, fan_in=f),
+        },
+        "final_ln": jnp.ones((d,), jnp.float32),
+        "final_ln_b": jnp.zeros((d,), jnp.float32),
+        "head": w(next(k), d, cfg.classes, fan_in=d),
+    }
+
+
+def vit_param_specs(cfg: ViTConfig) -> dict:
+    """Megatron tp layout (cf. model.py:param_specs; one all-reduce
+    after wo and after w2 per block), batch over dp at the call site."""
+    return {
+        "patch_embed": P(None, None),
+        "cls_token": P(None, None, None),
+        "pos_embed": P(None, None, None),
+        "layers": {
+            "ln1": P(None, None), "ln1_b": P(None, None),
+            "wq": P(None, None, "tp"), "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"), "wo": P(None, "tp", None),
+            "ln2": P(None, None), "ln2_b": P(None, None),
+            "w1": P(None, None, "tp"), "w2": P(None, "tp", None),
+        },
+        "final_ln": P(None), "final_ln_b": P(None),
+        "head": P(None, None),
+    }
+
+
+def _layernorm(x, g, b):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + 1e-6) * g + b).astype(x.dtype)
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] -> [B, N, p*p*C]: the reshape a stride-p conv is."""
+    B, H, W, C = images.shape
+    p = cfg.patch
+    x = images.reshape(B, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [B, gh, gw, p, p, C]
+    return x.reshape(B, (H // p) * (W // p), p * p * C)
+
+
+def vit_forward(params: dict, images: jax.Array,
+                cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] images -> [B, classes] logits."""
+    B = images.shape[0]
+    nh, hd = cfg.n_heads, cfg.head_dim
+
+    x = patchify(images.astype(cfg.dtype), cfg) @ params["patch_embed"]
+    cls = jnp.broadcast_to(params["cls_token"], (B, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = (x.astype(jnp.float32) + params["pos_embed"]).astype(cfg.dtype)
+
+    def block(x, layer):
+        h = _layernorm(x, layer["ln1"], layer["ln1_b"])
+        q = (h @ layer["wq"]).reshape(B, -1, nh, hd).transpose(0, 2, 1, 3)
+        k = (h @ layer["wk"]).reshape(B, -1, nh, hd).transpose(0, 2, 1, 3)
+        v = (h @ layer["wv"]).reshape(B, -1, nh, hd).transpose(0, 2, 1, 3)
+        if cfg.attn == "flash":
+            o = flash_attention(q, k, v, causal=False)
+        else:
+            o = attention_reference(q, k, v, causal=False)
+        o = o.transpose(0, 2, 1, 3).reshape(B, -1, cfg.d_model)
+        x = x + o @ layer["wo"]
+        h = _layernorm(x, layer["ln2"], layer["ln2_b"])
+        x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+        return x, None
+
+    x, _ = lax.scan(block, x, params["layers"])
+    x = _layernorm(x, params["final_ln"], params["final_ln_b"])
+    return (x[:, 0] @ params["head"]).astype(jnp.float32)  # [CLS] head
+
+
+def make_vit_train_step(cfg: ViTConfig, learning_rate: float = 1e-3):
+    """(tx, train_step) for softmax-cross-entropy classification —
+    same contract shape as model.make_train_step."""
+    import optax
+
+    tx = optax.adamw(learning_rate)
+
+    def loss_fn(params, images, labels):
+        logits = vit_forward(params, images, cfg)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    def train_step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, images, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return tx, train_step
