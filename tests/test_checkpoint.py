@@ -134,6 +134,79 @@ def test_resume_or_init_discovers_prior_process_state(tmp_path):
         leaves_equal(params, rp)
 
 
+def test_vit_family_checkpoint_cross_mesh(tmp_path):
+    # the checkpointer dispatches by config type: the ViT family gets
+    # the same cross-mesh restore + geometry guard as llama, and a
+    # llama config can never load a vit checkpoint (family recorded in
+    # the geometry meta)
+    from tpushare.workloads.vit import PRESETS_VIT
+    vcfg = PRESETS_VIT["vit-tiny"]
+    ckpt, tx, train_step = make_resumable_trainer(vcfg, str(tmp_path))
+    params, opt, start = ckpt.resume_or_init(vcfg, tx, jax.random.key(0),
+                                             mesh=mesh(2, 4))
+    assert start == 0
+    images = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    labels = jnp.array([1, 2], jnp.int32)
+    step_jit = jax.jit(train_step)
+    for _ in range(2):
+        params, opt, _ = step_jit(params, opt, images, labels)
+    ckpt.save(2, params, opt, vcfg)
+
+    rp, ro, rstep = ckpt.restore(vcfg, tx, mesh=mesh(4, 2))
+    assert rstep == 2
+    leaves_equal(params, rp)
+    wq = rp["layers"]["wq"]
+    assert wq.sharding.spec == P(None, None, "tp")
+    assert dict(wq.sharding.mesh.shape) == {"dp": 4, "tp": 2}
+
+    # cross-family restore refused via the geometry meta
+    ltx, _ = make_train_step(CFG)
+    with pytest.raises(ValueError, match="geometry"):
+        ckpt.restore(CFG, ltx)
+    ckpt.close()
+
+
+def test_unknown_config_type_fails_loudly(tmp_path):
+    class WeirdConfig:
+        pass
+
+    with TrainCheckpointer(str(tmp_path)) as ckpt:
+        tx, _ = make_train_step(CFG)
+        with pytest.raises(TypeError, match="unknown workload family"):
+            ckpt.save(1, {}, {}, WeirdConfig())
+
+
+def test_pre_family_tag_checkpoint_still_restores(tmp_path):
+    # checkpoints written before the family tag existed carry no
+    # 'family' key; an upgrade mid-run must not strand a preempted
+    # trainer's own valid checkpoint
+    import glob
+    import json as _json
+    tx, _ = make_train_step(CFG)
+    with TrainCheckpointer(str(tmp_path)) as ckpt:
+        params, opt, _ = ckpt.resume_or_init(CFG, tx, jax.random.key(0))
+        ckpt.save(1, params, opt, CFG)
+    meta_files = glob.glob(str(tmp_path) + "/**/metadata", recursive=True)
+    stripped = 0
+    for f in glob.glob(str(tmp_path) + "/**/*", recursive=True):
+        try:
+            with open(f) as fh:
+                doc = _json.load(fh)
+        except (IsADirectoryError, UnicodeDecodeError, ValueError,
+                PermissionError):
+            continue
+        if isinstance(doc, dict) and doc.get("family") == "llama":
+            del doc["family"]
+            with open(f, "w") as fh:
+                _json.dump(doc, fh)
+            stripped += 1
+    assert stripped, f"no meta JSON found to strip (saw {meta_files})"
+    with TrainCheckpointer(str(tmp_path)) as ckpt2:
+        rp, _, step = ckpt2.restore(CFG, tx)
+        assert step == 1
+        leaves_equal(params, rp)
+
+
 def test_opt_specs_mirror_param_specs():
     tx, _ = make_train_step(CFG)
     abstract = abstract_train_state(CFG, tx)

@@ -46,10 +46,35 @@ from tpushare.workloads.model import (
 # experiment) and attn/attn_window too (serving knobs, not state shape)
 _GEOMETRY_FIELDS = ("vocab", "d_model", "n_layers", "n_heads",
                     "n_kv_heads", "d_ff", "moe_experts", "moe_top_k")
+_VIT_GEOMETRY_FIELDS = ("image", "patch", "channels", "d_model",
+                        "n_layers", "n_heads", "d_ff", "classes")
 
 
-def _geometry(cfg: ModelConfig) -> dict:
-    return {f: getattr(cfg, f) for f in _GEOMETRY_FIELDS}
+def _family(cfg):
+    """(family_name, init_fn, specs_fn, geometry_fields, make_train) —
+    ONE dispatch point for every call site (state shapes, shardings,
+    geometry meta, and the train-step factory must all agree on the
+    family). The vit import stays lazy so llama-only runs never load
+    it; an unrecognized config type fails loudly here instead of as an
+    AttributeError deep inside init."""
+    if isinstance(cfg, ModelConfig):
+        return ("llama", init_params, param_specs, _GEOMETRY_FIELDS,
+                make_train_step)
+    if type(cfg).__name__ == "ViTConfig":
+        from tpushare.workloads.vit import (
+            init_vit_params, make_vit_train_step, vit_param_specs)
+        return ("vit", init_vit_params, vit_param_specs,
+                _VIT_GEOMETRY_FIELDS, make_vit_train_step)
+    raise TypeError(
+        f"unknown workload family for config type "
+        f"{type(cfg).__qualname__} — teach _family() about it")
+
+
+def _geometry(cfg) -> dict:
+    name, _, _, fields, _ = _family(cfg)
+    geo = {f: getattr(cfg, f) for f in fields}
+    geo["family"] = name
+    return geo
 
 
 def _key_str(entry: Any) -> str:
@@ -61,15 +86,15 @@ def _key_str(entry: Any) -> str:
     return str(entry)
 
 
-def _path_spec_index(cfg: ModelConfig) -> dict:
+def _path_spec_index(cfg) -> dict:
     """Map each params tree path (tuple of key strings) to its spec."""
-    specs = param_specs(cfg)
+    specs = _family(cfg)[2](cfg)
     flat = jax.tree_util.tree_flatten_with_path(
         specs, is_leaf=lambda x: isinstance(x, P))[0]
     return {tuple(_key_str(e) for e in path): spec for path, spec in flat}
 
 
-def opt_specs_like(cfg: ModelConfig, abstract_opt: Any) -> Any:
+def opt_specs_like(cfg, abstract_opt: Any) -> Any:
     """PartitionSpec tree for an optimizer-state pytree.
 
     adamw's ``mu``/``nu`` embed the params pytree whole, so a leaf at
@@ -93,14 +118,15 @@ def opt_specs_like(cfg: ModelConfig, abstract_opt: Any) -> Any:
     return jax.tree_util.tree_map_with_path(spec_for, abstract_opt)
 
 
-def abstract_train_state(cfg: ModelConfig, tx: Any,
+def abstract_train_state(cfg, tx: Any,
                          mesh: jax.sharding.Mesh | None = None) -> dict:
     """The restore target: {"params", "opt_state"} as ShapeDtypeStructs,
     carrying NamedShardings for ``mesh`` (or no shardings when None —
     single-device runs). This is what makes restore cross-mesh: orbax
     reads each shard straight onto the TARGET layout."""
     cfg.validate()
-    a_params = jax.eval_shape(lambda k: init_params(cfg, k),
+    _, init_fn, specs_fn, _, _ = _family(cfg)
+    a_params = jax.eval_shape(lambda k: init_fn(cfg, k),
                               jax.random.key(0))
     a_opt = jax.eval_shape(tx.init, a_params)
     if mesh is None:
@@ -110,7 +136,7 @@ def abstract_train_state(cfg: ModelConfig, tx: Any,
         return jax.ShapeDtypeStruct(a.shape, a.dtype,
                                     sharding=NamedSharding(mesh, spec))
 
-    p_specs = param_specs(cfg)
+    p_specs = specs_fn(cfg)
     return {
         "params": jax.tree.map(with_sharding, a_params, p_specs),
         "opt_state": jax.tree.map(with_sharding, a_opt,
@@ -149,7 +175,7 @@ class TrainCheckpointer:
         return sorted(self._mgr.all_steps())
 
     def save(self, step: int, params: Any, opt_state: Any,
-             cfg: ModelConfig) -> None:
+             cfg) -> None:
         ocp = self._ocp
         self._mgr.save(
             step,
@@ -160,13 +186,13 @@ class TrainCheckpointer:
         self._mgr.wait_until_finished()
 
     def maybe_save(self, step: int, params: Any, opt_state: Any,
-                   cfg: ModelConfig, every: int) -> bool:
+                   cfg, every: int) -> bool:
         if every <= 0 or step % every:
             return False
         self.save(step, params, opt_state, cfg)
         return True
 
-    def restore(self, cfg: ModelConfig, tx: Any,
+    def restore(self, cfg, tx: Any,
                 mesh: jax.sharding.Mesh | None = None,
                 step: int | None = None) -> tuple[Any, Any, int]:
         """Returns (params, opt_state, step) at ``step`` (default latest),
@@ -185,6 +211,10 @@ class TrainCheckpointer:
         saved_geo = dict(self._mgr.restore(
             step, args=ocp.args.Composite(
                 meta=ocp.args.JsonRestore()))["meta"])
+        # checkpoints written before the family tag existed are llama
+        # (the only family then) — an upgrade mid-run must not strand a
+        # preempted trainer's own valid checkpoint
+        saved_geo.setdefault("family", "llama")
         want_geo = _geometry(cfg)
         if saved_geo != want_geo:
             raise ValueError(
@@ -198,7 +228,7 @@ class TrainCheckpointer:
         state = restored["state"]
         return state["params"], state["opt_state"], step
 
-    def resume_or_init(self, cfg: ModelConfig, tx: Any, key: jax.Array,
+    def resume_or_init(self, cfg, tx: Any, key: jax.Array,
                        mesh: jax.sharding.Mesh | None = None,
                        ) -> tuple[Any, Any, int]:
         """Latest checkpoint if one exists, else a fresh init — the one
@@ -208,17 +238,18 @@ class TrainCheckpointer:
         if step is not None:
             params, opt_state, step = self.restore(cfg, tx, mesh=mesh)
             return params, opt_state, step
+        _, init_fn, specs_fn, _, _ = _family(cfg)
         if mesh is None:
-            params = init_params(cfg, key)
+            params = init_fn(cfg, key)
         else:
             # init INSIDE jit with out_shardings: the params materialize
             # directly as global sharded arrays — correct in multi-process
             # meshes too, where device_put of a host-local array onto a
             # sharding spanning non-addressable devices is not
             p_sh = jax.tree.map(lambda s: NamedSharding(mesh, s),
-                                param_specs(cfg),
+                                specs_fn(cfg),
                                 is_leaf=lambda x: isinstance(x, P))
-            params = jax.jit(lambda k: init_params(cfg, k),
+            params = jax.jit(lambda k: init_fn(cfg, k),
                              out_shardings=p_sh)(key)
         opt_state = tx.init(params)
         return params, opt_state, 0
@@ -233,10 +264,12 @@ class TrainCheckpointer:
         self.close()
 
 
-def make_resumable_trainer(cfg: ModelConfig, directory: str,
+def make_resumable_trainer(cfg, directory: str,
                            keep: int = 3, learning_rate: float = 3e-4):
     """Convenience wiring: (ckpt, tx, train_step) ready for the player's
-    train mode or any custom loop."""
+    train mode or any custom loop. Dispatches the train step by family
+    (llama LM loss / ViT classification loss)."""
     cfg = dataclasses.replace(cfg).validate()
-    tx, train_step = make_train_step(cfg, learning_rate=learning_rate)
+    make_train = _family(cfg)[4]
+    tx, train_step = make_train(cfg, learning_rate=learning_rate)
     return TrainCheckpointer(directory, keep=keep), tx, train_step
