@@ -206,11 +206,42 @@ def test_streaming_hooks_cover_every_token_exactly_once():
     assert eng.peek_tokens(rid) is None   # reported => gone
 
 
+def test_nucleus_tiny_p_equals_greedy():
+    # top_p -> 0 keeps exactly the first-crossing (= highest-prob)
+    # token, so sampling degenerates to argmax — the boundary that
+    # proves the crossing token is INCLUDED in the nucleus
+    prompt, n = [3, 141, 59], 6
+    greedy = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32)
+    rg = greedy.submit(prompt, n)
+    nucleus = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32,
+                           temperature=1.3, top_p=1e-6)
+    rn = nucleus.submit(prompt, n)
+    assert greedy.drain()[rg] == nucleus.drain()[rn]
+
+
+def test_nucleus_off_is_identical_to_plain_temperature():
+    # top_p=1.0 must compile the exact same selection as no top_p arg
+    prompt, n = [9, 9, 2], 10
+    outs = []
+    for kw in ({}, {"top_p": 1.0}):
+        eng = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32,
+                           temperature=1.5, seed=11, **kw)
+        rid = eng.submit(prompt, n)
+        outs.append(eng.drain()[rid])
+    assert outs[0] == outs[1]
+
+
 def test_sampling_validation():
     with pytest.raises(ValueError, match="temperature"):
         DecodeEngine(PARAMS, CFG, 1, 16, temperature=-0.1)
     with pytest.raises(ValueError, match="top_k"):
         DecodeEngine(PARAMS, CFG, 1, 16, top_k=CFG.vocab + 1)
-    # top_k alone would silently greedy-decode: refuse the footgun
-    with pytest.raises(ValueError, match="top_k requires"):
+    with pytest.raises(ValueError, match="top_p"):
+        DecodeEngine(PARAMS, CFG, 1, 16, top_p=1.5)
+    with pytest.raises(ValueError, match="top_p"):
+        DecodeEngine(PARAMS, CFG, 1, 16, top_p=0.0)
+    # top_k/top_p alone would silently greedy-decode: refuse the footgun
+    with pytest.raises(ValueError, match="require"):
         DecodeEngine(PARAMS, CFG, 1, 16, top_k=8)
+    with pytest.raises(ValueError, match="require"):
+        DecodeEngine(PARAMS, CFG, 1, 16, top_p=0.9)

@@ -76,7 +76,8 @@ class DecodeEngine:
     co-tenants share the quantum (tests/test_engine.py asserts this).
 
     ``temperature > 0`` switches selection to sampling (optionally
-    top-k-masked), still fully reproducible AND residency-independent:
+    top-k- and/or nucleus/top-p-masked), still fully reproducible AND
+    residency-independent:
     the sample key is ``fold_in(fold_in(seed, request_id), position)``,
     a function of the request and the query position only — never of
     the slot index, the co-tenants, or where quantum boundaries fall.
@@ -85,7 +86,7 @@ class DecodeEngine:
     def __init__(self, params: dict, cfg: ModelConfig, max_slots: int,
                  max_len: int, quantum: int = 8,
                  eos_id: int | None = None, temperature: float = 0.0,
-                 top_k: int = 0, seed: int = 0):
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
         cfg.validate()
         if cfg.moe_experts:
             raise ValueError("continuous batching excludes MoE presets "
@@ -94,10 +95,12 @@ class DecodeEngine:
             raise ValueError(f"temperature {temperature} must be >= 0")
         if top_k < 0 or top_k > cfg.vocab:
             raise ValueError(f"top_k {top_k} outside [0, vocab]")
-        if top_k > 0 and temperature == 0.0:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p {top_p} outside (0, 1]")
+        if (top_k > 0 or top_p < 1.0) and temperature == 0.0:
             raise ValueError(
-                "top_k requires temperature > 0 (temperature 0 is "
-                "greedy argmax and would silently ignore top_k)")
+                "top_k/top_p require temperature > 0 (temperature 0 is "
+                "greedy argmax and would silently ignore them)")
         self._params = params
         self._cfg = cfg
         self._S = int(max_slots)
@@ -112,6 +115,7 @@ class DecodeEngine:
         # no matter which slot it lands in or where quanta fall
         self._temperature = float(temperature)
         self._top_k = int(top_k)
+        self._top_p = float(top_p)
         self._seed = int(seed)
         # key buffer shaped for the ACTIVE prng impl (threefry keys are
         # uint32[2], rbg uint32[4] — hardcoding one breaks the other)
@@ -139,9 +143,11 @@ class DecodeEngine:
 
     def _pick_fn(self):
         """Token selection from final-position logits, static per
-        engine: greedy argmax at temperature 0, else top-k-masked
-        categorical keyed by (request key, query position)."""
-        temperature, top_k = self._temperature, self._top_k
+        engine: greedy argmax at temperature 0, else categorical over
+        top-k and/or nucleus (top-p) masked logits, keyed by
+        (request key, query position)."""
+        temperature, top_k, top_p = (self._temperature, self._top_k,
+                                     self._top_p)
 
         def pick(logits, key):
             if temperature == 0.0:
@@ -150,6 +156,20 @@ class DecodeEngine:
             if top_k > 0:
                 vals, _ = lax.top_k(scaled, top_k)
                 floor = vals[..., -1:]
+                scaled = jnp.where(scaled >= floor, scaled, -jnp.inf)
+            if top_p < 1.0:
+                # nucleus: keep the smallest descending-prob prefix
+                # whose mass reaches top_p (the crossing token
+                # INCLUDED, so at least one survives). Value-floor
+                # form, the same idiom as the top_k branch above —
+                # sort + cumsum only, no index gather/scatter in the
+                # vmapped decode hot loop; boundary TIES share the
+                # floor value and all survive, like top_k's ties
+                svals = -jnp.sort(-scaled)
+                probs = jax.nn.softmax(svals)
+                cum = jnp.cumsum(probs)
+                kth = jnp.sum((cum - probs) < top_p)  # mass BEFORE tok
+                floor = svals[kth - 1]
                 scaled = jnp.where(scaled >= floor, scaled, -jnp.inf)
             return jax.random.categorical(key, scaled,
                                           axis=-1).astype(jnp.int32)

@@ -194,6 +194,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--top-k", type=int, default=0,
                     help="engine mode: restrict sampling to the k "
                          "highest logits (0 = full vocab)")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="engine mode: nucleus sampling — keep the "
+                         "smallest probability mass >= p (1.0 = off; "
+                         "composes with --top-k)")
     ap.add_argument("--sample-seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -310,7 +314,8 @@ def main(argv: list[str] | None = None) -> int:
                          args.engine_max_len,
                          quantum=args.engine_quantum, eos_id=eos,
                          temperature=args.temperature,
-                         top_k=args.top_k, seed=args.sample_seed),
+                         top_k=args.top_k, top_p=args.top_p,
+                         seed=args.sample_seed),
             tokens_counter=m_tokens)
         engine_front.start()
         registry.gauge_func(
