@@ -231,6 +231,61 @@ def test_nucleus_off_is_identical_to_plain_temperature():
     assert outs[0] == outs[1]
 
 
+def test_per_request_sampling_mixed_traffic():
+    # one compiled program, mixed traffic: a no-override request in a
+    # per-request engine decodes the EXACT greedy stream while a
+    # sampled co-tenant shares its quanta
+    prompt_g, prompt_s, n = [3, 141, 59], [9, 9, 2], 7
+    eng = DecodeEngine(PARAMS, CFG, max_slots=2, max_len=32, quantum=3,
+                       per_request_sampling=True)
+    rg = eng.submit(prompt_g, n)                       # inherits temp 0
+    rs = eng.submit(prompt_s, n, temperature=2.0, top_p=0.9)
+    out = eng.drain()
+    assert out[rg] == solo_reference(prompt_g, n, 32)  # bitwise greedy
+    assert len(out[rs]) == n
+
+
+def test_per_request_overrides_are_reproducible():
+    prompt, n = [5, 80, 3], 8
+    outs = []
+    for _ in range(2):
+        eng = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32,
+                           seed=5, per_request_sampling=True)
+        rid = eng.submit(prompt, n, temperature=1.7, top_p=0.8)
+        outs.append(eng.drain()[rid])
+    assert outs[0] == outs[1] and len(outs[0]) == n
+
+
+def test_per_request_engine_default_greedy_matches_static():
+    prompt, n = [2, 4, 8], 5
+    static = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32)
+    rs = static.submit(prompt, n)
+    dyn = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32,
+                       per_request_sampling=True)
+    rd = dyn.submit(prompt, n)
+    assert static.drain()[rs] == dyn.drain()[rd]
+
+
+def test_per_request_overrides_rejected_on_static_engine():
+    eng = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=16)
+    with pytest.raises(ValueError, match="per_request_sampling"):
+        eng.submit([1, 2], 2, temperature=1.0)
+    with pytest.raises(ValueError, match="per_request_sampling"):
+        eng.submit([1, 2], 2, top_p=0.5)
+    dyn = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=16,
+                       per_request_sampling=True)
+    with pytest.raises(ValueError, match="top_p"):
+        dyn.submit([1, 2], 2, temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError, match="temperature"):
+        dyn.submit([1, 2], 2, temperature=-1.0)
+    # explicit nucleus directive at effective temperature 0 would be
+    # silently greedy: refused, mirroring the static ctor guard
+    with pytest.raises(ValueError, match="requires temperature"):
+        dyn.submit([1, 2], 2, top_p=0.9)
+    with pytest.raises(ValueError, match="requires temperature"):
+        dyn.submit([1, 2], 2, temperature=0.0, top_p=0.9)
+
+
 def test_sampling_validation():
     with pytest.raises(ValueError, match="temperature"):
         DecodeEngine(PARAMS, CFG, 1, 16, temperature=-0.1)

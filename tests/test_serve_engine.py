@@ -54,7 +54,8 @@ def serve_proc():
         [sys.executable, "-m", "tpushare.workloads.serve",
          "--preset", "llama-tiny", "--quant", "none", "--engine",
          "--engine-slots", "4", "--engine-max-len", str(MAX_LEN),
-         "--engine-quantum", "2", "--port", str(port)],
+         "--engine-quantum", "2", "--per-request-sampling",
+         "--port", str(port)],
         cwd=REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)
     deadline = time.time() + 90
@@ -197,6 +198,27 @@ def test_stream_without_engine_is_rejected():
             p.wait(20)
         except subprocess.TimeoutExpired:
             p.kill()
+
+
+def test_per_request_sampling_override(serve_proc):
+    # the replica's flags default to greedy; a request carrying
+    # temperature/top_p samples, and a plain request on the same
+    # replica still gets the deterministic greedy stream
+    port = serve_proc
+    greedy1 = _post(port, {"tokens": [7, 3, 9], "steps": 6})
+    sampled = _post(port, {"tokens": [7, 3, 9], "steps": 6,
+                           "temperature": 1.8, "top_p": 0.9})
+    greedy2 = _post(port, {"tokens": [7, 3, 9], "steps": 6})
+    assert greedy1 == greedy2                  # greedy path untouched
+    assert len(sampled["tokens"][0]) == 9
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, {"tokens": [1, 2], "steps": 2, "top_p": 1.7})
+    assert ei.value.code == 400
+    # an explicit nucleus directive on a greedy request would be
+    # silently discarded by the argmax branch: refused instead
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, {"tokens": [1, 2], "steps": 2, "top_p": 0.9})
+    assert ei.value.code == 400
 
 
 def test_metrics_scrape(serve_proc):

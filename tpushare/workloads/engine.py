@@ -86,7 +86,8 @@ class DecodeEngine:
     def __init__(self, params: dict, cfg: ModelConfig, max_slots: int,
                  max_len: int, quantum: int = 8,
                  eos_id: int | None = None, temperature: float = 0.0,
-                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 per_request_sampling: bool = False):
         cfg.validate()
         if cfg.moe_experts:
             raise ValueError("continuous batching excludes MoE presets "
@@ -97,10 +98,16 @@ class DecodeEngine:
             raise ValueError(f"top_k {top_k} outside [0, vocab]")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p {top_p} outside (0, 1]")
-        if (top_k > 0 or top_p < 1.0) and temperature == 0.0:
+        if (top_k > 0 or top_p < 1.0) and temperature == 0.0 \
+                and not per_request_sampling:
             raise ValueError(
                 "top_k/top_p require temperature > 0 (temperature 0 is "
                 "greedy argmax and would silently ignore them)")
+        # per-request mode trades a per-step sort for runtime control:
+        # temperature/top_p become per-slot traced state so one compiled
+        # program serves mixed greedy and sampled traffic; the default
+        # static mode keeps the pure-argmax program for greedy engines
+        self._per_request = bool(per_request_sampling)
         self._params = params
         self._cfg = cfg
         self._S = int(max_slots)
@@ -127,6 +134,8 @@ class DecodeEngine:
         self._last = jnp.zeros((self._S,), jnp.int32)
         self._active = jnp.zeros((self._S,), bool)
         self._remaining = jnp.zeros((self._S,), jnp.int32)
+        self._slot_temp = jnp.zeros((self._S,), jnp.float32)
+        self._slot_topp = jnp.ones((self._S,), jnp.float32)
         self._free = list(range(self._S))
         self._by_slot: dict[int, _Request] = {}
         self._by_rid: dict[int, _Request] = {}
@@ -142,35 +151,61 @@ class DecodeEngine:
     # -- compiled programs (cached per engine: shapes are fixed) -------------
 
     def _pick_fn(self):
-        """Token selection from final-position logits, static per
-        engine: greedy argmax at temperature 0, else categorical over
-        top-k and/or nucleus (top-p) masked logits, keyed by
-        (request key, query position)."""
+        """Token selection from final-position 1-D logits, keyed by
+        (request key, query position). Returned signature is always
+        ``pick(logits, key, temp, top_p)``:
+
+        - static mode (default): temp/top_p args are ignored; the
+          engine-level temperature bakes in greedy argmax (pure, no
+          sort) or fixed-knob sampling at trace time.
+        - per-request mode: temp/top_p are traced per-slot scalars —
+          temp 0 selects the argmax via ``where`` (one program serves
+          mixed greedy + sampled traffic), and top_p 1.0 naturally
+          keeps the whole vocab (the cumulative mass before the last
+          finite token is always < 1).
+        """
         temperature, top_k, top_p = (self._temperature, self._top_k,
                                      self._top_p)
 
-        def pick(logits, key):
+        def topk_mask(scaled):
+            if top_k > 0:  # engine-static: lax.top_k needs a static k
+                vals, _ = lax.top_k(scaled, top_k)
+                return jnp.where(scaled >= vals[..., -1:], scaled,
+                                 -jnp.inf)
+            return scaled
+
+        def nucleus_mask(scaled, p):
+            # keep the smallest descending-prob prefix whose mass
+            # reaches p (crossing token INCLUDED, so one always
+            # survives). Value-floor form — sort + cumsum only, no
+            # index gather/scatter in the vmapped decode hot loop;
+            # boundary TIES share the floor and all survive
+            svals = -jnp.sort(-scaled)
+            probs = jax.nn.softmax(svals)
+            cum = jnp.cumsum(probs)
+            kth = jnp.sum((cum - probs) < p)  # mass BEFORE token < p
+            floor = svals[kth - 1]
+            return jnp.where(scaled >= floor, scaled, -jnp.inf)
+
+        if self._per_request:
+            def pick(logits, key, temp, p):
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                scaled = logits.astype(jnp.float32) / jnp.maximum(
+                    temp, 1e-6)
+                scaled = nucleus_mask(topk_mask(scaled), p)
+                sampled = jax.random.categorical(
+                    key, scaled, axis=-1).astype(jnp.int32)
+                return jnp.where(temp > 0.0, sampled, greedy)
+
+            return pick
+
+        def pick(logits, key, temp, p):  # noqa: ARG001 — static knobs
             if temperature == 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            scaled = (logits / temperature).astype(jnp.float32)
-            if top_k > 0:
-                vals, _ = lax.top_k(scaled, top_k)
-                floor = vals[..., -1:]
-                scaled = jnp.where(scaled >= floor, scaled, -jnp.inf)
+            scaled = topk_mask((logits / temperature).astype(
+                jnp.float32))
             if top_p < 1.0:
-                # nucleus: keep the smallest descending-prob prefix
-                # whose mass reaches top_p (the crossing token
-                # INCLUDED, so at least one survives). Value-floor
-                # form, the same idiom as the top_k branch above —
-                # sort + cumsum only, no index gather/scatter in the
-                # vmapped decode hot loop; boundary TIES share the
-                # floor value and all survive, like top_k's ties
-                svals = -jnp.sort(-scaled)
-                probs = jax.nn.softmax(svals)
-                cum = jnp.cumsum(probs)
-                kth = jnp.sum((cum - probs) < top_p)  # mass BEFORE tok
-                floor = svals[kth - 1]
-                scaled = jnp.where(scaled >= floor, scaled, -jnp.inf)
+                scaled = nucleus_mask(scaled, top_p)
             return jax.random.categorical(key, scaled,
                                           axis=-1).astype(jnp.int32)
 
@@ -192,12 +227,13 @@ class DecodeEngine:
                             out_axes=(0, 1))(cache, last, pos)
 
         def step(carry, _):
-            cache, pos, last, active, remaining, keys = carry
+            (cache, pos, last, active, remaining, keys, temp,
+             topp) = carry
             logits, new_cache = slot_step(cache, last, pos)
             # per-(request, position) sample keys: quantum boundaries
             # and slot placement can't shift a request's stream
             step_keys = jax.vmap(jax.random.fold_in)(keys, pos)
-            nxt = jax.vmap(pick)(logits, step_keys)
+            nxt = jax.vmap(pick)(logits, step_keys, temp, topp)
             # inactive slots keep their cache/position/token untouched
             sel = active.reshape(1, -1, *([1] * 3))
             cache = jax.tree.map(
@@ -209,14 +245,17 @@ class DecodeEngine:
             done = active & ((nxt == eos) | (remaining <= 0))
             last = jnp.where(active, nxt, last)
             active = active & ~done
-            return (cache, pos, last, active, remaining, keys), emitted
+            return (cache, pos, last, active, remaining, keys, temp,
+                    topp), emitted
 
-        def run(cache, pos, last, active, remaining, keys, k_steps):
-            carry = (cache, pos, last, active, remaining, keys)
+        def run(cache, pos, last, active, remaining, keys, temp, topp,
+                k_steps):
+            carry = (cache, pos, last, active, remaining, keys, temp,
+                     topp)
             carry, emitted = lax.scan(step, carry, None, length=k_steps)
             return carry, emitted  # emitted [k, S]
 
-        return jax.jit(run, static_argnums=(6,))
+        return jax.jit(run, static_argnums=(8,))
 
     @functools.cached_property
     def _prefill_fn(self):
@@ -224,7 +263,7 @@ class DecodeEngine:
         pick = self._pick_fn()
 
         @functools.partial(jax.jit, static_argnums=(1,))
-        def prefill(tokens_padded, bucket_len, plen, key):
+        def prefill(tokens_padded, bucket_len, plen, key, temp, topp):
             cache1 = init_kv_cache(cfg, 1, self._M)
             logits, cache1 = forward_cached(
                 params, tokens_padded.reshape(1, bucket_len), cache1,
@@ -233,7 +272,8 @@ class DecodeEngine:
                                              keepdims=False)[0]
             # the prefill emits for query position plen-1; decode then
             # starts folding at plen — streams never collide
-            first = pick(final, jax.random.fold_in(key, plen - 1))
+            first = pick(final, jax.random.fold_in(key, plen - 1),
+                         temp, topp)
             return first.astype(jnp.int32), cache1
 
         return prefill
@@ -241,8 +281,9 @@ class DecodeEngine:
     @functools.cached_property
     def _insert_fn(self):
         @jax.jit
-        def insert(cache, pos, last, active, remaining, keys, cache1,
-                   slot, plen, first, budget, rkey):
+        def insert(cache, pos, last, active, remaining, keys, temp,
+                   topp, cache1, slot, plen, first, budget, rkey,
+                   r_temp, r_topp):
             cache = jax.tree.map(
                 lambda big, one: lax.dynamic_update_index_in_dim(
                     big, one[:, 0], slot, axis=1),
@@ -252,7 +293,9 @@ class DecodeEngine:
             active = active.at[slot].set(budget > 1)
             remaining = remaining.at[slot].set(budget - 1)
             keys = keys.at[slot].set(rkey)
-            return cache, pos, last, active, remaining, keys
+            temp = temp.at[slot].set(r_temp)
+            topp = topp.at[slot].set(r_topp)
+            return cache, pos, last, active, remaining, keys, temp, topp
 
         return insert
 
@@ -266,9 +309,16 @@ class DecodeEngine:
     def resident(self) -> int:
         return self._S - len(self._free)
 
-    def submit(self, prompt: list[int], max_new: int) -> int:
+    def submit(self, prompt: list[int], max_new: int,
+               temperature: float | None = None,
+               top_p: float | None = None) -> int:
         """Prefill ``prompt`` into a free slot; returns the request id.
-        The first generated token is produced by the prefill itself."""
+        The first generated token is produced by the prefill itself.
+
+        ``temperature``/``top_p`` override the engine defaults for THIS
+        request (requires ``per_request_sampling=True``); None inherits
+        the engine-level knobs. top_k stays engine-static (lax.top_k
+        needs a static k)."""
         if not self._free:
             raise RuntimeError("no free slot (queue upstream)")
         if not prompt:
@@ -279,6 +329,27 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new} exceeds "
                 f"max_len {self._M}")
+        if (temperature is not None or top_p is not None) \
+                and not self._per_request:
+            raise ValueError(
+                "per-request temperature/top_p need "
+                "per_request_sampling=True (the static engine bakes "
+                "its knobs into the compiled step)")
+        r_temp = self._temperature if temperature is None \
+            else float(temperature)
+        r_topp = self._top_p if top_p is None else float(top_p)
+        if r_temp < 0:
+            raise ValueError(f"temperature {r_temp} must be >= 0")
+        if not 0.0 < r_topp <= 1.0:
+            raise ValueError(f"top_p {r_topp} outside (0, 1]")
+        if top_p is not None and r_topp < 1.0 and r_temp == 0.0:
+            # mirror of the static constructor's guard: an EXPLICIT
+            # nucleus directive at temperature 0 would be silently
+            # discarded by the greedy argmax branch
+            raise ValueError(
+                "top_p requires temperature > 0 for this request "
+                "(temperature 0 is greedy argmax and would silently "
+                "ignore it)")
         slot = self._free.pop()
         plen = len(prompt)
         # the bucket must stay inside the slot's KV buffer: a non-pow2
@@ -290,13 +361,19 @@ class DecodeEngine:
         rid = self._next_rid
         self._next_rid += 1
         rkey = jax.random.fold_in(jax.random.PRNGKey(self._seed), rid)
+        t_arr = jnp.float32(r_temp)
+        p_arr = jnp.float32(r_topp)
         first, cache1 = self._prefill_fn(padded, bucket,
-                                         jnp.int32(plen), rkey)
+                                         jnp.int32(plen), rkey,
+                                         t_arr, p_arr)
         (self._cache, self._pos, self._last, self._active,
-         self._remaining, self._slot_keys) = self._insert_fn(
+         self._remaining, self._slot_keys, self._slot_temp,
+         self._slot_topp) = self._insert_fn(
             self._cache, self._pos, self._last, self._active,
-            self._remaining, self._slot_keys, cache1, jnp.int32(slot),
-            jnp.int32(plen), first, jnp.int32(max_new), rkey)
+            self._remaining, self._slot_keys, self._slot_temp,
+            self._slot_topp, cache1, jnp.int32(slot),
+            jnp.int32(plen), first, jnp.int32(max_new), rkey,
+            t_arr, p_arr)
         req = _Request(rid=rid, slot=slot, tokens=[int(first)],
                        budget=max_new)
         self._by_slot[slot] = req
@@ -330,9 +407,11 @@ class DecodeEngine:
         k = self._quantum if k is None else int(k)
         (carry, emitted) = self._quantum_fn(
             self._cache, self._pos, self._last, self._active,
-            self._remaining, self._slot_keys, k)
+            self._remaining, self._slot_keys, self._slot_temp,
+            self._slot_topp, k)
         (self._cache, self._pos, self._last, self._active,
-         self._remaining, self._slot_keys) = carry
+         self._remaining, self._slot_keys, self._slot_temp,
+         self._slot_topp) = carry
         emitted_host = jax.device_get(emitted)  # [k, S], -1 = idle lane
         active_host = jax.device_get(self._active)
         for slot, req in list(self._by_slot.items()):

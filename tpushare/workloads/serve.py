@@ -46,14 +46,17 @@ class _EngineFrontend:
         self._stop.set()
 
     def generate(self, prompt: list[int], max_new: int,
-                 timeout: float = 300.0) -> list[int]:
+                 timeout: float = 300.0,
+                 sampling: dict | None = None) -> list[int]:
         """Called from handler threads; blocks until the request's
         generation completes. Raises ValueError for requests the engine
         cannot ever place (oversized prompt etc.)."""
-        return self.generate_many([prompt], max_new, timeout)[0]
+        return self.generate_many([prompt], max_new, timeout,
+                                  sampling)[0]
 
     def generate_stream(self, prompt: list[int], max_new: int,
-                        timeout: float = 300.0):
+                        timeout: float = 300.0,
+                        sampling: dict | None = None):
         """Yields lists of newly generated tokens as decode quanta
         complete (the first yield is the prefill's token). Terminates
         when the request finishes; raises ValueError on rejection. The
@@ -61,7 +64,7 @@ class _EngineFrontend:
         stream_q: queue.Queue = queue.Queue()
         done = threading.Event()
         box: dict = {"stream": stream_q}
-        self._q.put((list(prompt), max_new, done, box))
+        self._q.put((list(prompt), max_new, sampling or {}, done, box))
         while True:
             try:
                 kind, payload = stream_q.get(timeout=timeout)
@@ -75,13 +78,14 @@ class _EngineFrontend:
                 return
 
     def generate_many(self, prompts: list[list[int]], max_new: int,
-                      timeout: float = 300.0) -> list[list[int]]:
+                      timeout: float = 300.0,
+                      sampling: dict | None = None) -> list[list[int]]:
         """Enqueue ALL prompts before waiting on any — co-resident
         decoding is the engine's whole point; a sequential
         submit-and-wait would serialize the batch."""
         pairs = [(threading.Event(), {}) for _ in prompts]
         for p, (done, box) in zip(prompts, pairs):
-            self._q.put((list(p), max_new, done, box))
+            self._q.put((list(p), max_new, sampling or {}, done, box))
         out = []
         for done, box in pairs:
             if not done.wait(timeout):
@@ -103,9 +107,10 @@ class _EngineFrontend:
                                        timeout=0.5)
                 except queue.Empty:
                     break
-                prompt, max_new, done, box = item
+                prompt, max_new, sampling, done, box = item
                 try:
-                    rid = self._engine.submit(prompt, max_new)
+                    rid = self._engine.submit(prompt, max_new,
+                                              **sampling)
                 except Exception as e:  # noqa: BLE001 — an uncaught
                     # exception would kill this daemon thread silently
                     # and hang every later request at its timeout
@@ -199,6 +204,11 @@ def main(argv: list[str] | None = None) -> int:
                          "smallest probability mass >= p (1.0 = off; "
                          "composes with --top-k)")
     ap.add_argument("--sample-seed", type=int, default=0)
+    ap.add_argument("--per-request-sampling", action="store_true",
+                    help="engine mode: let each request override "
+                         "temperature/top_p in the POST body (costs a "
+                         "per-slot vocab sort every decode step, so "
+                         "greedy-only replicas should leave it off)")
     args = ap.parse_args(argv)
 
     from tpushare.workloads.hbm import apply_hbm_gating
@@ -315,7 +325,8 @@ def main(argv: list[str] | None = None) -> int:
                          quantum=args.engine_quantum, eos_id=eos,
                          temperature=args.temperature,
                          top_k=args.top_k, top_p=args.top_p,
-                         seed=args.sample_seed),
+                         seed=args.sample_seed,
+                         per_request_sampling=args.per_request_sampling),
             tokens_counter=m_tokens)
         engine_front.start()
         registry.gauge_func(
@@ -351,12 +362,21 @@ def main(argv: list[str] | None = None) -> int:
                     raise ValueError(f"steps {steps} must be >= 1")
                 if body.get("stream") and engine_front is None:
                     raise ValueError("stream requires --engine")
+                # per-request sampling overrides (engine mode): the
+                # flags set the defaults, the body can override both
+                sampling = {k: float(body[k])
+                            for k in ("temperature", "top_p")
+                            if k in body}
+                if sampling and engine_front is None:
+                    raise ValueError(
+                        "temperature/top_p need --engine")
                 if engine_front is not None and body.get("stream"):
                     prompts = body["tokens"]
                     if not (prompts and isinstance(prompts[0], int)):
                         raise ValueError(
                             "stream mode takes ONE flat prompt")
-                    self._stream(list(prompts), steps, t_req)
+                    self._stream(list(prompts), steps, t_req,
+                                 sampling)
                     return
                 if engine_front is not None:
                     prompts = body["tokens"]
@@ -365,7 +385,8 @@ def main(argv: list[str] | None = None) -> int:
                     # response rows = prompt + generation, the same
                     # shape contract as the batch decode below
                     gen = engine_front.generate_many(
-                        [list(p) for p in prompts], steps)
+                        [list(p) for p in prompts], steps,
+                        sampling=sampling)
                     rows = [list(p) + g for p, g in zip(prompts, gen)]
                     resp = json.dumps({"tokens": rows}).encode()
                 else:
@@ -399,7 +420,7 @@ def main(argv: list[str] | None = None) -> int:
                 # histogram as a success)
                 pass
 
-        def _stream(self, prompt, steps, t_req):
+        def _stream(self, prompt, steps, t_req, sampling=None):
             """NDJSON token streaming: one {"delta": [...]} line per
             decode quantum as it lands, closed by {"done": true,
             "tokens": [prompt + generation]}. The body is delimited by
@@ -411,7 +432,8 @@ def main(argv: list[str] | None = None) -> int:
             first event available, so invalid requests get the same
             HTTP 400 as the non-streaming path instead of an error
             object inside a 200 body."""
-            gen = engine_front.generate_stream(prompt, steps)
+            gen = engine_front.generate_stream(prompt, steps,
+                                               sampling=sampling)
             events = iter(gen)
             first = next(events, None)  # ValueError/TimeoutError -> 400
             self.send_response(200)
