@@ -270,6 +270,20 @@ def test_player_resumed_budget_already_spent_runs_zero_steps(tmp_path,
         assert ckpt.latest_step() == 2  # nothing re-run
 
 
+def test_player_vit_train_mode_resumes(tmp_path, capsys):
+    # the vit family rides the same player train wiring: preset name
+    # selects the family, checkpoint/resume dispatches via _family
+    from tpushare.workloads.player import main
+    base = ["--preset", "vit-tiny", "--mode", "train", "--batch", "2",
+            "--ckpt-dir", str(tmp_path), "--ckpt-every", "1"]
+    assert main(base + ["--steps", "2"]) == 0
+    capsys.readouterr()
+    assert main(base + ["--steps", "3"]) == 0
+    assert "resumed from step 2" in capsys.readouterr().out
+    with TrainCheckpointer(str(tmp_path)) as ckpt:
+        assert ckpt.latest_step() == 3
+
+
 def test_player_refuses_moe_checkpoint_wiring(tmp_path):
     from tpushare.workloads.player import main
     with pytest.raises(SystemExit, match="dense"):

@@ -74,7 +74,37 @@ def main(argv: list[str] | None = None) -> int:
     from tpushare.workloads.model import (PRESETS, forward, init_params,
                                           make_train_step)
 
-    cfg = dataclasses.replace(PRESETS[args.preset], attn=args.attn)
+    import numpy as np
+
+    # preset name selects the workload family; this block is the ONE
+    # family dispatch site (mirroring checkpoint._family): it fixes the
+    # config, init fn, train-step factory, forward fn, and batch shape
+    # together so they can never pair across families. llama presets
+    # speak tokens, vit presets speak images (forward/train only — the
+    # ring long-context mode is a llama-attention op); vit stays a lazy
+    # import for llama-only runs.
+    vit = args.preset not in PRESETS
+    if vit:
+        from tpushare.workloads.vit import (
+            PRESETS_VIT, init_vit_params, make_vit_train_step,
+            vit_forward)
+        if args.preset not in PRESETS_VIT:
+            ap.error(f"unknown preset {args.preset!r}")
+        if args.sp == "ring":
+            ap.error("--sp ring is a llama-attention mode; vit presets "
+                     "run --mode forward/train")
+        cfg = dataclasses.replace(PRESETS_VIT[args.preset],
+                                  attn=args.attn)
+        init_fn, make_train = init_vit_params, make_vit_train_step
+        fwd_fn = lambda p, x: vit_forward(p, x, cfg)  # noqa: E731
+        batch_np = (np.zeros((args.batch, cfg.image, cfg.image,
+                              cfg.channels), np.float32),
+                    np.zeros((args.batch,), np.int32))
+    else:
+        cfg = dataclasses.replace(PRESETS[args.preset], attn=args.attn)
+        init_fn, make_train = init_params, make_train_step
+        fwd_fn = lambda p, t: forward(p, t, cfg)  # noqa: E731
+        batch_np = (np.zeros((args.batch, args.seq), np.int32),)
 
     if args.sp == "ring":
         if args.mode == "train":
@@ -131,8 +161,8 @@ def main(argv: list[str] | None = None) -> int:
 
         unit = f"ring/s (S={S} over {n} devices)"
     elif args.mode == "train":
-        tokens = jnp.zeros((args.batch, args.seq), jnp.int32)
-        tx, train_step = make_train_step(cfg)
+        tx, train_step = make_train(cfg)
+        batch = tuple(jnp.asarray(b) for b in batch_np)
         ckpt = None
         trained = 0
         if args.ckpt_dir:
@@ -147,12 +177,11 @@ def main(argv: list[str] | None = None) -> int:
             # presets shard megatron-style over "tp" across the whole
             # gang; MoE shards over "ep", which this wiring doesn't
             # build — refuse rather than corrupt a shared directory.
-            if cfg.moe_experts:
+            if getattr(cfg, "moe_experts", 0):
                 raise SystemExit(
                     "--ckpt-dir train mode supports dense presets; MoE "
                     "state shards over 'ep' (use TrainCheckpointer with "
                     "your own mesh)")
-            import numpy as np
             mesh = Mesh(np.array(jax.devices()).reshape(1, -1),
                         ("dp", "tp"))
             ckpt = TrainCheckpointer(args.ckpt_dir)
@@ -162,19 +191,19 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"resumed from step {trained} ({args.ckpt_dir})",
                       flush=True)
             if jax.process_count() > 1:
-                # every process feeds the same token block; lift it to a
-                # replicated global array so the pjit accepts it
-                tokens = jax.make_array_from_process_local_data(
-                    NamedSharding(mesh, P()),
-                    np.zeros((args.batch, args.seq), np.int32))
+                # every process feeds the same batch; lift it to
+                # replicated global arrays so the pjit accepts it
+                batch = tuple(jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, P()), b) for b in batch_np)
         else:
-            params = init_params(cfg, jax.random.key(0))
+            params = init_fn(cfg, jax.random.key(0))
             opt_state = tx.init(params)
         step_jit = jax.jit(train_step)
 
         def run_once():
             nonlocal params, opt_state, trained
-            params, opt_state, loss = step_jit(params, opt_state, tokens)
+            params, opt_state, loss = step_jit(params, opt_state,
+                                               *batch)
             trained += 1
             if ckpt is not None:
                 ckpt.maybe_save(trained, params, opt_state, cfg,
@@ -183,12 +212,12 @@ def main(argv: list[str] | None = None) -> int:
 
         unit = "train/s"
     else:
-        params = init_params(cfg, jax.random.key(0))
-        tokens = jnp.zeros((args.batch, args.seq), jnp.int32)
-        fwd_jit = jax.jit(lambda p, t: forward(p, t, cfg))
+        params = init_fn(cfg, jax.random.key(0))
+        data = jnp.asarray(batch_np[0])
+        fwd_jit = jax.jit(fwd_fn)
 
         def run_once():
-            return fwd_jit(params, tokens)
+            return fwd_jit(params, data)
 
         unit = "fwd/s"
 
