@@ -286,6 +286,21 @@ def test_per_request_overrides_rejected_on_static_engine():
         dyn.submit([1, 2], 2, temperature=0.0, top_p=0.9)
 
 
+def test_static_greedy_program_compiles_no_sort():
+    # the per-request mode's cost (a per-slot vocab sort every step) is
+    # documented as opt-in; guard that the static greedy engine's
+    # compiled quantum really contains no sort, and the dynamic one does
+    def quantum_hlo(**kw):
+        eng = DecodeEngine(PARAMS, CFG, max_slots=2, max_len=32, **kw)
+        return eng._quantum_fn.lower(
+            eng._cache, eng._pos, eng._last, eng._active,
+            eng._remaining, eng._slot_keys, eng._slot_temp,
+            eng._slot_topp, 2).as_text()
+
+    assert "sort(" not in quantum_hlo()
+    assert "sort(" in quantum_hlo(per_request_sampling=True)
+
+
 def test_sampling_validation():
     with pytest.raises(ValueError, match="temperature"):
         DecodeEngine(PARAMS, CFG, 1, 16, temperature=-0.1)
