@@ -138,6 +138,24 @@ def test_non_pow2_max_len_bucket_caps():
     assert eng.drain()[rid] == solo_reference(prompt, 4, M)
 
 
+def test_flash_prefill_config_parity():
+    # cfg.attn="flash" routes the engine's bucketed prefill through the
+    # fused kernel (forward_cached's prefill-from-zero path — serving's
+    # time-to-first-token cost); outputs must match the einsum config.
+    # fp32 configs: on bf16 the two paths differ by kernel rounding and
+    # an untrained model's near-tie argmaxes would flake (the same
+    # discipline as tests/test_kvcache.py's flash comparisons)
+    cfg32 = dataclasses.replace(CFG, dtype=jnp.float32).validate()
+    params32 = init_params(cfg32, jax.random.key(0))
+    cfg_f = dataclasses.replace(cfg32, attn="flash").validate()
+    prompt, n = [3, 141, 59, 7, 7, 7, 7, 7], 4
+    ef = DecodeEngine(params32, cfg_f, max_slots=1, max_len=32)
+    rf = ef.submit(prompt, n)
+    ee = DecodeEngine(params32, cfg32, max_slots=1, max_len=32)
+    re_ = ee.submit(prompt, n)
+    assert ef.drain()[rf] == ee.drain()[re_]
+
+
 def test_quantized_weights_engine():
     qparams = quantize_int8(PARAMS)
     eng = DecodeEngine(qparams, CFG, max_slots=2, max_len=32)

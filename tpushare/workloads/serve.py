@@ -277,7 +277,9 @@ def main(argv: list[str] | None = None) -> int:
         # either way; what flash changes on the KV-cached path is the
         # PREFILL (forward_cached's prefill-from-zero runs the fused
         # kernel over the prompt chunk — the time-to-first-token cost).
-        # Rolling-ring prefills chunk mid-stream and keep einsum.
+        # Rolling-ring prefills chunk mid-stream and keep einsum. The
+        # engine's bucketed prefill honors the same config
+        # (tests/test_engine.py::test_flash_prefill_config_parity).
         which = ("prefill only (ring chunks use einsum)"
                  if args.rolling_kv else "prefill (time-to-first-token)")
         print(f"note: --attn flash accelerates the {which}; decode "
