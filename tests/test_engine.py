@@ -96,6 +96,27 @@ def test_eos_frees_slot_early():
     assert out[rid] == [first] and eng.free_slots == 1
 
 
+def test_per_request_eos_override():
+    # stop tokens vary per request: one co-tenant stops at ITS second
+    # prediction, the other (same prompt, engine-default eos) runs its
+    # whole budget — and the compare target being per-slot state means
+    # this works in the default static mode too
+    prompt, n = [7, 7, 3], 5
+    full = solo_reference(prompt, n, 32)
+    second = full[1]
+    eng = DecodeEngine(PARAMS, CFG, max_slots=2, max_len=32, quantum=2)
+    r_stop = eng.submit(prompt, n, eos_id=second)
+    r_full = eng.submit(prompt, n)
+    out = eng.drain()
+    assert out[r_stop] == full[:2]      # stopped at its own eos
+    assert out[r_full] == full          # engine default (-1): no stop
+    # prefill-time eos: a request whose FIRST token is its stop token
+    # completes at submit
+    r_instant = eng.submit(prompt, n, eos_id=full[0])
+    assert eng.free_slots == 2
+    assert eng.drain()[r_instant] == full[:1]
+
+
 def test_budget_one_completes_at_submit():
     eng = DecodeEngine(PARAMS, CFG, max_slots=1, max_len=32)
     rid = eng.submit([1, 2, 3], max_new=1)
@@ -313,7 +334,7 @@ def test_static_greedy_program_compiles_no_sort():
         return eng._quantum_fn.lower(
             eng._cache, eng._pos, eng._last, eng._active,
             eng._remaining, eng._slot_keys, eng._slot_temp,
-            eng._slot_topp, 2).as_text()
+            eng._slot_topp, eng._slot_eos, 2).as_text()
 
     assert "sort(" not in quantum_hlo()
     assert "sort(" in quantum_hlo(per_request_sampling=True)

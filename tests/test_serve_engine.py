@@ -162,6 +162,51 @@ def test_streaming_invalid_request_gets_400_not_200_body(serve_proc):
     assert ei.value.code == 400
 
 
+def test_eos_id_works_on_static_mode_replica():
+    # the userguide's claim: eos_id needs NO --per-request-sampling
+    # (the stop compare is per-slot state, not compiled structure);
+    # guard the wire path on a default static-mode engine replica
+    port = _free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tpushare.workloads.serve",
+         "--preset", "llama-tiny", "--quant", "none", "--engine",
+         "--engine-slots", "2", "--engine-max-len", "32",
+         "--port", str(port)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if p.poll() is not None:
+                pytest.fail(f"serve exited rc={p.returncode}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=2) as r:
+                    if r.status == 200:
+                        break
+            except OSError:
+                time.sleep(0.5)
+        full = _post(port, {"tokens": [7, 3], "steps": 4})["tokens"][0]
+        stopped = _post(port, {"tokens": [7, 3], "steps": 4,
+                               "eos_id": full[2]})["tokens"][0]
+        assert stopped == full[:3]     # first generated token is eos
+        # sampling overrides DO need the opt-in on this replica
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, {"tokens": [1, 2], "steps": 2,
+                         "temperature": 1.0})
+        assert ei.value.code == 400
+    finally:
+        p.send_signal(signal.SIGINT)
+        try:
+            p.wait(20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
 def test_stream_without_engine_is_rejected():
     # a non-engine replica must refuse "stream": true loudly, not fall
     # through to a buffered json response the client will misparse
@@ -219,6 +264,26 @@ def test_per_request_sampling_override(serve_proc):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(port, {"tokens": [1, 2], "steps": 2, "top_p": 0.9})
     assert ei.value.code == 400
+
+
+def test_per_request_eos_over_http(serve_proc):
+    port = serve_proc
+    prompt = [7, 3, 9]
+    steps = 8
+    full = _post(port, {"tokens": prompt, "steps": steps})["tokens"][0]
+    gen = full[len(prompt):]
+    # a stop token must not already appear earlier in the stream, or it
+    # fires at its first occurrence; pick one whose FIRST occurrence is
+    # mid-stream (the untrained model can repeat tokens)
+    stop_at = next((i for i, t in enumerate(gen) if t not in gen[:i]
+                    and i > 0), None)
+    if stop_at is None:
+        pytest.skip("stream repeats one token; no mid-stream stop")
+    stopped = _post(port, {"tokens": prompt, "steps": steps,
+                           "eos_id": gen[stop_at]})["tokens"][0]
+    assert stopped == full[:len(prompt) + stop_at + 1]
+    again = _post(port, {"tokens": prompt, "steps": steps})["tokens"][0]
+    assert again == full                       # co-tenants unaffected
 
 
 def test_metrics_scrape(serve_proc):

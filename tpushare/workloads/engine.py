@@ -136,6 +136,9 @@ class DecodeEngine:
         self._remaining = jnp.zeros((self._S,), jnp.int32)
         self._slot_temp = jnp.zeros((self._S,), jnp.float32)
         self._slot_topp = jnp.ones((self._S,), jnp.float32)
+        # per-slot eos: requests may carry their own stop token (both
+        # modes — the compare target is a carried array either way)
+        self._slot_eos = jnp.full((self._S,), self._eos, jnp.int32)
         self._free = list(range(self._S))
         self._by_slot: dict[int, _Request] = {}
         self._by_rid: dict[int, _Request] = {}
@@ -213,7 +216,7 @@ class DecodeEngine:
 
     @functools.cached_property
     def _quantum_fn(self):
-        params, cfg, eos = self._params, self._cfg, self._eos
+        params, cfg = self._params, self._cfg
         pick = self._pick_fn()
 
         def slot_step(cache, last, pos):
@@ -228,7 +231,7 @@ class DecodeEngine:
 
         def step(carry, _):
             (cache, pos, last, active, remaining, keys, temp,
-             topp) = carry
+             topp, eos) = carry
             logits, new_cache = slot_step(cache, last, pos)
             # per-(request, position) sample keys: quantum boundaries
             # and slot placement can't shift a request's stream
@@ -246,16 +249,16 @@ class DecodeEngine:
             last = jnp.where(active, nxt, last)
             active = active & ~done
             return (cache, pos, last, active, remaining, keys, temp,
-                    topp), emitted
+                    topp, eos), emitted
 
         def run(cache, pos, last, active, remaining, keys, temp, topp,
-                k_steps):
+                eos, k_steps):
             carry = (cache, pos, last, active, remaining, keys, temp,
-                     topp)
+                     topp, eos)
             carry, emitted = lax.scan(step, carry, None, length=k_steps)
             return carry, emitted  # emitted [k, S]
 
-        return jax.jit(run, static_argnums=(8,))
+        return jax.jit(run, static_argnums=(9,))
 
     @functools.cached_property
     def _prefill_fn(self):
@@ -282,8 +285,8 @@ class DecodeEngine:
     def _insert_fn(self):
         @jax.jit
         def insert(cache, pos, last, active, remaining, keys, temp,
-                   topp, cache1, slot, plen, first, budget, rkey,
-                   r_temp, r_topp):
+                   topp, eos, cache1, slot, plen, first, budget, rkey,
+                   r_temp, r_topp, r_eos):
             cache = jax.tree.map(
                 lambda big, one: lax.dynamic_update_index_in_dim(
                     big, one[:, 0], slot, axis=1),
@@ -295,7 +298,9 @@ class DecodeEngine:
             keys = keys.at[slot].set(rkey)
             temp = temp.at[slot].set(r_temp)
             topp = topp.at[slot].set(r_topp)
-            return cache, pos, last, active, remaining, keys, temp, topp
+            eos = eos.at[slot].set(r_eos)
+            return (cache, pos, last, active, remaining, keys, temp,
+                    topp, eos)
 
         return insert
 
@@ -311,14 +316,17 @@ class DecodeEngine:
 
     def submit(self, prompt: list[int], max_new: int,
                temperature: float | None = None,
-               top_p: float | None = None) -> int:
+               top_p: float | None = None,
+               eos_id: int | None = None) -> int:
         """Prefill ``prompt`` into a free slot; returns the request id.
         The first generated token is produced by the prefill itself.
 
         ``temperature``/``top_p`` override the engine defaults for THIS
         request (requires ``per_request_sampling=True``); None inherits
         the engine-level knobs. top_k stays engine-static (lax.top_k
-        needs a static k)."""
+        needs a static k). ``eos_id`` overrides the stop token for this
+        request in EITHER mode (the compare target is per-slot state,
+        not compiled structure)."""
         if not self._free:
             raise RuntimeError("no free slot (queue upstream)")
         if not prompt:
@@ -338,6 +346,7 @@ class DecodeEngine:
         r_temp = self._temperature if temperature is None \
             else float(temperature)
         r_topp = self._top_p if top_p is None else float(top_p)
+        r_eos = self._eos if eos_id is None else int(eos_id)
         if r_temp < 0:
             raise ValueError(f"temperature {r_temp} must be >= 0")
         if not 0.0 < r_topp <= 1.0:
@@ -368,17 +377,17 @@ class DecodeEngine:
                                          t_arr, p_arr)
         (self._cache, self._pos, self._last, self._active,
          self._remaining, self._slot_keys, self._slot_temp,
-         self._slot_topp) = self._insert_fn(
+         self._slot_topp, self._slot_eos) = self._insert_fn(
             self._cache, self._pos, self._last, self._active,
             self._remaining, self._slot_keys, self._slot_temp,
-            self._slot_topp, cache1, jnp.int32(slot),
+            self._slot_topp, self._slot_eos, cache1, jnp.int32(slot),
             jnp.int32(plen), first, jnp.int32(max_new), rkey,
-            t_arr, p_arr)
+            t_arr, p_arr, jnp.int32(r_eos))
         req = _Request(rid=rid, slot=slot, tokens=[int(first)],
                        budget=max_new)
         self._by_slot[slot] = req
         self._by_rid[rid] = req
-        if max_new == 1 or int(first) == self._eos:
+        if max_new == 1 or int(first) == r_eos:
             # completed by the prefill itself; slot never decodes
             self._free.append(slot)
             del self._by_slot[slot]
@@ -408,10 +417,10 @@ class DecodeEngine:
         (carry, emitted) = self._quantum_fn(
             self._cache, self._pos, self._last, self._active,
             self._remaining, self._slot_keys, self._slot_temp,
-            self._slot_topp, k)
+            self._slot_topp, self._slot_eos, k)
         (self._cache, self._pos, self._last, self._active,
          self._remaining, self._slot_keys, self._slot_temp,
-         self._slot_topp) = carry
+         self._slot_topp, self._slot_eos) = carry
         emitted_host = jax.device_get(emitted)  # [k, S], -1 = idle lane
         active_host = jax.device_get(self._active)
         for slot, req in list(self._by_slot.items()):

@@ -364,14 +364,18 @@ def main(argv: list[str] | None = None) -> int:
                     raise ValueError(f"steps {steps} must be >= 1")
                 if body.get("stream") and engine_front is None:
                     raise ValueError("stream requires --engine")
-                # per-request sampling overrides (engine mode): the
-                # flags set the defaults, the body can override both
+                # per-request overrides (engine mode): the flags set
+                # the defaults, the body can override sampling (needs
+                # --per-request-sampling) and the stop token (any
+                # engine replica)
                 sampling = {k: float(body[k])
                             for k in ("temperature", "top_p")
                             if k in body}
+                if "eos_id" in body:
+                    sampling["eos_id"] = int(body["eos_id"])
                 if sampling and engine_front is None:
                     raise ValueError(
-                        "temperature/top_p need --engine")
+                        "temperature/top_p/eos_id need --engine")
                 if engine_front is not None and body.get("stream"):
                     prompts = body["tokens"]
                     if not (prompts and isinstance(prompts[0], int)):
