@@ -228,6 +228,35 @@ def test_sampling_seed_changes_stream():
     assert outs[0] != outs[1]
 
 
+def test_random_ragged_traffic_invariants():
+    # property-style churn: 12 ragged requests trickle into 3 slots
+    # across many quanta. Invariants: every request completes exactly
+    # once with exactly its budget (no eos configured), the pool drains
+    # back to empty, and a sample of outputs is bitwise the solo stream
+    import random
+    rng = random.Random(0)
+    M = 48
+    eng = DecodeEngine(PARAMS, CFG, max_slots=3, max_len=M, quantum=2)
+    pending = [([rng.randrange(1, CFG.vocab) for _ in
+                 range(rng.randrange(1, 12))], rng.randrange(1, 9))
+               for _ in range(12)]
+    meta, results = {}, {}
+    while pending or eng.resident:
+        while eng.free_slots and pending and rng.random() < 0.7:
+            prompt, budget = pending.pop()
+            rid = eng.submit(list(prompt), budget)
+            meta[rid] = (prompt, budget)
+        results.update(eng.run_quantum())
+    results.update(eng.run_quantum())   # flush any submit-time finishes
+    assert set(results) == set(meta)
+    assert eng.free_slots == 3 and eng.resident == 0
+    for rid, toks in results.items():
+        assert len(toks) == meta[rid][1], rid
+    for rid in list(results)[::5]:      # spot-check parity
+        prompt, budget = meta[rid]
+        assert results[rid] == solo_reference(prompt, budget, M), rid
+
+
 def test_streaming_hooks_cover_every_token_exactly_once():
     # peek_tokens right after submit + last_quantum_tokens per quantum
     # must reconstruct the final stream with no gaps or duplicates —
