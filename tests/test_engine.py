@@ -246,8 +246,10 @@ def test_random_ragged_traffic_invariants():
             prompt, budget = pending.pop()
             rid = eng.submit(list(prompt), budget)
             meta[rid] = (prompt, budget)
-        results.update(eng.run_quantum())
-    results.update(eng.run_quantum())   # flush any submit-time finishes
+        done = eng.run_quantum()
+        # exactly-once: a rid must never be reported by two quanta
+        assert not (results.keys() & done.keys())
+        results.update(done)
     assert set(results) == set(meta)
     assert eng.free_slots == 3 and eng.resident == 0
     for rid, toks in results.items():
