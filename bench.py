@@ -408,11 +408,18 @@ def packing_duel() -> dict:
 
 
 def _wedge_wait_s() -> float:
-    """Seconds to wait for a blocked TPU client's self-exit (observed
-    ~25 min to the far end's UNAVAILABLE answer; docs/perf.md runbook).
-    Single reader of TPUSHARE_WEDGE_WAIT so the default can't diverge
-    across the three call sites."""
-    return float(os.environ.get("TPUSHARE_WEDGE_WAIT", "1800"))
+    """Seconds to wait for a blocked TPU client's self-exit.
+
+    Default 600, deliberately BELOW the ~25-28 min init-block self-exit
+    observed on this rig (docs/perf.md runbook): r4 measured that even
+    a full 1800 s wait + retry does not recover a hard wedge (the
+    dangling claim is server-side), so the long wait buys diagnosis,
+    not recovery — while pushing the bench's worst-case wall time past
+    what a driver capture window may allow. A bench that emits its
+    error JSON beats one killed mid-wait with no artifact. Interactive
+    deep-waits: TPUSHARE_WEDGE_WAIT=1800. Single reader so the default
+    can't diverge across the three call sites."""
+    return float(os.environ.get("TPUSHARE_WEDGE_WAIT", "600"))
 
 
 def _run_tpu_subprocess(cmd: list, timeout_s: float, env: dict | None = None,
@@ -476,20 +483,25 @@ def _probe_backend_resilient(probe_cmd: list | None = None) -> dict:
 
     Wedge phenomenology on this rig (docs/perf.md "tunnel wedge"): a
     healthy init answers in seconds; a wedged relay blocks init inside
-    the PJRT C call where SIGINT cannot be processed, and the blocked
-    client is answered with UNAVAILABLE only after ~25 min, then exits
-    by itself. Clean interruption is impossible, and SIGKILL is the very
-    act that creates dangling claims. So: probe with a patient deadline;
-    on hang, SIGINT (recovers the pre-C-call window), wait out a truly
-    blocked probe up to TPUSHARE_WEDGE_WAIT seconds (its self-exit
-    yields the far end's real error and frees its queue slot), pause,
-    and retry exactly once. The diagnostic patience applies to ATTEMPT
-    1 only: the retry abandons a blocked client after the SIGINT grace
-    (a recovered backend answers in seconds; a second ~25-minute wait
-    on a dead one adds nothing and risks the caller's own timeout).
+    the PJRT C call where SIGINT cannot be processed; an init-blocked
+    client has been observed to self-exit after ~25-28 min, but a hard
+    wedge (dangling claim server-side) is not recovered even by waiting
+    that out and retrying — r4 measured both. Clean interruption is
+    impossible, and SIGKILL is the very act that creates dangling
+    claims. So: probe with a patient deadline; on hang, SIGINT
+    (recovers the pre-C-call window), wait up to TPUSHARE_WEDGE_WAIT
+    for a self-exit, and retry once ONLY if the client resolved (a
+    still-blocked client holds the single-client queue — a retry
+    behind it cannot answer, and running two clients is the discipline
+    violation). At the bounded 600 s default the wait usually expires
+    first and ONE attempt is made — the bench emits its error JSON
+    inside a driver capture window instead of spending ~37 min to
+    learn nothing new; the abandoned client is left running and exits
+    on its own. Interactive diagnosis (the far end's real error after
+    the ~25-min self-exit): TPUSHARE_WEDGE_WAIT=1800.
     Knobs: TPUSHARE_PROBE_TIMEOUT (150 s), TPUSHARE_WEDGE_WAIT
-    (1800 s; 0 = don't wait for self-exit; attempt 1 only),
-    TPUSHARE_WEDGE_PAUSE (120 s).
+    (600 s default, see _wedge_wait_s; 0 = don't wait for self-exit;
+    attempt 1 only), TPUSHARE_WEDGE_PAUSE (120 s).
     """
     import time as _time
     probe_s = float(os.environ.get("TPUSHARE_PROBE_TIMEOUT", "150"))
@@ -506,13 +518,14 @@ def _probe_backend_resilient(probe_cmd: list | None = None) -> dict:
         try:
             rc, out, err, note = _run_tpu_subprocess(
                 cmd, probe_s, label=f"probe{attempt}",
-                # the FIRST attempt carries the diagnostic patience
-                # (waiting out a blocked client yields the far end's
-                # real error, observed after ~25 min); the retry only
-                # needs the fast path — if the backend recovered it
-                # answers in seconds, and a second 25-minute wait on a
-                # still-dead backend would tell us nothing new while
-                # risking the driver's own bench timeout
+                # the FIRST attempt carries whatever wedge-wait the
+                # knob allows (at 1800 it can catch the ~25-min
+                # self-exit and the far end's real error; at the 600 s
+                # default it bounds the bench's wall time instead —
+                # see _wedge_wait_s); the retry only needs the fast
+                # path: a recovered backend answers in seconds, and a
+                # second long wait on a dead one tells us nothing new
+                # while risking the driver's own bench timeout
                 self_exit_wait_s=wedge_wait_s if attempt == 1 else 0.0)
         except OSError as e:
             return {"ok": False, "summary": f"backend probe: {e}",
@@ -535,8 +548,10 @@ def _probe_backend_resilient(probe_cmd: list | None = None) -> dict:
         if attempt == 1:
             _time.sleep(pause_s)
     return {"ok": False,
-            "summary": "jax backend init failed/hung twice "
-                       "(TPU tunnel wedged? see docs/perf.md runbook): "
+            "summary": f"jax backend init failed/hung "
+                       f"({len(attempts)} attempt"
+                       f"{'s' if len(attempts) != 1 else ''}; TPU "
+                       "tunnel wedged? see docs/perf.md runbook): "
                        + " | ".join(attempts),
             "attempts": attempts}
 
