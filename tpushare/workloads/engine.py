@@ -63,7 +63,8 @@ def _bucket(n: int, lo: int = 8) -> int:
 
 
 class DecodeEngine:
-    """Greedy continuous-batching decoder over a fixed slot pool.
+    """Continuous-batching decoder over a fixed slot pool (greedy by
+    default; per-engine or per-request sampling optional).
 
     >>> eng = DecodeEngine(params, cfg, max_slots=8, max_len=256)
     >>> rid = eng.submit([1, 17, 23], max_new=32)   # joins mid-flight
