@@ -27,6 +27,16 @@ Also covered: the scheduler's HTTPTimeout firing mid-bind (types.go:199 —
 the client gives up while the extender is still writing) must leave the
 system consistent: the bind completes exactly once and the scheduler's
 retry gets an idempotent success.
+
+ENVIRONMENT LIMITATION (kept on the books deliberately): this image has
+no Go toolchain (``which go`` fails), so no REAL ``encoding/json``
+marshal of the vendored structs has ever been exchanged with the live
+extender. The fixtures here and the machine-derived schema
+(tests/tools/gen_wire_schema.py → tests/fixtures/extender_wire_schema.json,
+drift-checked) are the honest ceiling of a Go-less image. If a Go
+toolchain ever appears: ``go run`` a one-file client that marshals
+ExtenderArgs/ExtenderBindingArgs against a live extender, commit the
+captured exchange as a fixture here, and assert byte-level compatibility.
 """
 
 import json

@@ -294,7 +294,11 @@ class DecodeEngine:
                 cache, cache1)
             pos = pos.at[slot].set(plen)
             last = last.at[slot].set(first)
-            active = active.at[slot].set(budget > 1)
+            # a prefill-time eos completes the request on the host side
+            # (submit frees the slot immediately); the lane must go
+            # inactive on device too, or run_quantum would decode a
+            # ghost lane for up to budget-1 steps until slot reuse
+            active = active.at[slot].set((budget > 1) & (first != r_eos))
             remaining = remaining.at[slot].set(budget - 1)
             keys = keys.at[slot].set(rkey)
             temp = temp.at[slot].set(r_temp)

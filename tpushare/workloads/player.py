@@ -47,6 +47,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--ckpt-every", type=int, default=50)
     args = ap.parse_args(argv)
 
+    if args.ckpt_dir is not None and args.mode != "train":
+        # only train mode checkpoints; a user passing --ckpt-dir with
+        # forward (or --sp ring) would silently get no durable resume
+        # and discover it after an eviction
+        ap.error("--ckpt-dir requires --mode train (forward and "
+                 "--sp ring modes do not checkpoint)")
+
     from tpushare.contract import constants as c
     from tpushare.workloads.hbm import apply_hbm_gating
     applied = apply_hbm_gating()

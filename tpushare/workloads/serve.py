@@ -45,6 +45,13 @@ class _EngineFrontend:
     def stop(self):
         self._stop.set()
 
+    def join(self, timeout: float | None = None):
+        """Wait for the engine thread to finish its in-flight quantum
+        and observe the stop flag (bounded; the thread is a daemon, so
+        a stuck dispatch cannot block process exit)."""
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
     def generate(self, prompt: list[int], max_new: int,
                  timeout: float = 300.0,
                  sampling: dict | None = None) -> list[int]:
@@ -154,6 +161,24 @@ class _EngineFrontend:
                 if "stream" in box:
                     box["stream"].put(("done", tokens))
                 done.set()
+        # stop flag observed: wake every still-blocked client with a
+        # terminal signal — without this, handlers parked in
+        # generate/generate_stream would sleep to their timeout and the
+        # process exit would reset their connections mid-wait
+        while True:
+            try:
+                _p, _m, _s, done, box = self._q.get_nowait()
+            except queue.Empty:
+                break
+            box["error"] = "server shutting down"
+            if "stream" in box:
+                box["stream"].put(("error", box["error"]))
+            done.set()
+        for done, box in inflight.values():
+            box["error"] = "server shutting down (request interrupted)"
+            if "stream" in box:
+                box["stream"].put(("error", box["error"]))
+            done.set()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -498,6 +523,13 @@ def main(argv: list[str] | None = None) -> int:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        if engine_front is not None:
+            # drain at a quantum boundary: without this, SIGINT
+            # abandons an in-flight quantum mid-dispatch and waiting
+            # clients see connection resets instead of a clean stop
+            engine_front.stop()
+            engine_front.join(timeout=10.0)
     return 0
 
 
