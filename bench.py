@@ -1030,6 +1030,42 @@ def _kernel_bench_inline() -> dict | None:
         })
     except Exception as e:  # noqa: BLE001
         out["engine_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # the same engine over ROLLING ring slots (r5): per-slot O(window)
+    # HBM — the bound the scheduler's HBM accounting assumes — with the
+    # ring exactly 2*window (chunked-prefill retention). Same slope
+    # methodology; budgets far past the ring prove fixed-cost long runs.
+    try:
+        import time as _time
+
+        from tpushare.workloads.engine import DecodeEngine
+
+        slots = 8
+        eng = DecodeEngine(qparams, cfg_srv_e, max_slots=slots,
+                           max_len=512, quantum=8, rolling=True)
+        eprompt = [int(t) for t in np.asarray(tokens[0, :128])]
+        for _ in range(slots):
+            # rolling lifts the prompt+budget bound: 800 > max_len 512
+            eng.submit(list(eprompt), max_new=800)
+        k1, k2, reps = 4, 68, 3
+        eng.run_quantum(k1)
+        eng.run_quantum(k2)
+        t_by_k = {k1: [], k2: []}
+        for _ in range(reps):
+            for k in (k1, k2):
+                t0 = _time.perf_counter()
+                eng.run_quantum(k)
+                t_by_k[k].append(_time.perf_counter() - t0)
+        step_ms = (min(t_by_k[k2]) - min(t_by_k[k1])) / (k2 - k1) * 1e3
+        if step_ms <= 0:
+            raise RuntimeError(f"non-positive slope ({step_ms} ms)")
+        out.update({
+            "engine_decode_rolling_step_ms": round(step_ms, 4),
+            "engine_decode_rolling_tokens_per_s": round(
+                slots / (step_ms / 1e3)),
+        })
+    except Exception as e:  # noqa: BLE001
+        out["engine_rolling_error"] = f"{type(e).__name__}: {e}"[:200]
     return out
 
 

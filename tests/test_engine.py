@@ -385,3 +385,87 @@ def test_sampling_validation():
         DecodeEngine(PARAMS, CFG, 1, 16, top_k=8)
     with pytest.raises(ValueError, match="require"):
         DecodeEngine(PARAMS, CFG, 1, 16, top_p=0.9)
+
+
+# -- rolling (ring) slots ------------------------------------------------------
+
+ROLL_CFG = dataclasses.replace(CFG, attn_window=8)
+ROLL_PARAMS = init_params(ROLL_CFG, jax.random.key(0))
+
+
+def _greedy_rolling_ref(prompt, steps, params=ROLL_PARAMS, cfg=ROLL_CFG):
+    """Solo rolling reference at matched ring geometry: total >= 2W makes
+    greedy_decode_kv's ring exactly 2W — the engine's max_len in these
+    tests — so position->slot layout (hence fp reduction order) is
+    identical and parity is bitwise."""
+    assert len(prompt) + steps >= 2 * cfg.attn_window
+    buf = greedy_decode_kv(params, jnp.asarray(prompt, jnp.int32)[None],
+                           steps, cfg, rolling=True)
+    return [int(t) for t in np.asarray(buf)[0, len(prompt):]]
+
+
+def test_rolling_engine_matches_greedy_rolling_under_churn():
+    # 6 ragged requests through 3 rolling slots: prompts spanning
+    # sub-window, window-straddling, and multi-chunk lengths; slots are
+    # freed and re-used (churn) while co-tenants keep decoding
+    W = ROLL_CFG.attn_window
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, ROLL_CFG.vocab, size=n).tolist()
+               for n in (3, 5, 9, 13, 17, 21)]
+    budgets = [13, 20, 9, 25, 14, 30]
+    refs = [_greedy_rolling_ref(p, b) for p, b in zip(prompts, budgets)]
+    eng = DecodeEngine(ROLL_PARAMS, ROLL_CFG, max_slots=3,
+                       max_len=2 * W, rolling=True)
+    rids, out, pending = {}, {}, list(range(len(prompts)))
+    while pending or rids:
+        while pending and eng.free_slots:
+            i = pending.pop(0)
+            rids[eng.submit(prompts[i], budgets[i])] = i
+        for rid, toks in eng.run_quantum().items():
+            out[rids.pop(rid)] = toks
+    for i, ref in enumerate(refs):
+        assert out[i] == ref, f"request {i} diverged from solo rolling"
+
+
+def test_rolling_engine_generation_runs_past_the_ring():
+    # the composition's whole point: generation 5x the buffer length
+    # with cache HBM pinned at O(window) — and still bitwise the solo
+    # rolling stream (prompt+generation cross the wraparound repeatedly)
+    for kvd in ("bf16", "int8"):
+        cfg = (dataclasses.replace(ROLL_CFG, kv_cache_dtype="int8")
+               if kvd == "int8" else ROLL_CFG)
+        params = (ROLL_PARAMS if kvd == "bf16"
+                  else init_params(cfg, jax.random.key(0)))
+        prompt = np.random.default_rng(1).integers(
+            1, cfg.vocab, size=11).tolist()
+        ref = _greedy_rolling_ref(prompt, 90, params, cfg)
+        eng = DecodeEngine(params, cfg, max_slots=2, max_len=16,
+                           rolling=True)
+        rid = eng.submit(prompt, 90)
+        assert eng.drain()[rid] == ref, kvd
+        assert eng._cache["k"].shape[2] == 16  # ring never grew
+
+
+def test_rolling_engine_prompt_longer_than_ring():
+    # a prompt longer than the ring itself: chunked prefill ages early
+    # keys out exactly like greedy_decode_kv's chunked prefill does
+    prompt = np.random.default_rng(3).integers(
+        1, ROLL_CFG.vocab, size=37).tolist()  # 37 > M = 16
+    ref = _greedy_rolling_ref(prompt, 12)
+    eng = DecodeEngine(ROLL_PARAMS, ROLL_CFG, max_slots=2, max_len=16,
+                       rolling=True)
+    rid = eng.submit(prompt, 12)
+    assert eng.drain()[rid] == ref
+
+
+def test_rolling_engine_validation():
+    with pytest.raises(ValueError, match="attn_window"):
+        DecodeEngine(PARAMS, CFG, 2, 64, rolling=True)  # no window
+    with pytest.raises(ValueError, match="2\\*attn_window"):
+        DecodeEngine(ROLL_PARAMS, ROLL_CFG, 2,
+                     2 * ROLL_CFG.attn_window - 1, rolling=True)
+    # rolling lifts the prompt+budget<=max_len bound instead of
+    # enforcing it
+    eng = DecodeEngine(ROLL_PARAMS, ROLL_CFG, 1, 16, rolling=True)
+    rid = eng.submit(list(range(1, 30)), max_new=40)  # 29+40 >> 16
+    assert len(eng.drain()[rid]) == 40

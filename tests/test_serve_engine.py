@@ -315,3 +315,57 @@ def test_oversized_request_is_rejected_not_fatal(serve_proc):
     # server still serves afterwards
     ok = _post(port, {"tokens": [1, 2], "steps": 2})["tokens"]
     assert len(ok[0]) == 4
+
+
+def test_rolling_engine_replica():
+    """--engine --rolling-kv end to end: continuous batching with
+    O(window) slot HBM. Generation runs past the ring length and the
+    wire result is bitwise the in-process rolling engine's."""
+    import dataclasses
+    port = _free_port()
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tpushare.workloads.serve",
+         "--preset", "llama-tiny", "--quant", "none", "--engine",
+         "--engine-slots", "2", "--engine-max-len", "16",
+         "--attn-window", "8", "--rolling-kv",
+         "--engine-quantum", "4", "--port", str(port)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if p.poll() is not None:
+                pytest.fail(f"serve exited rc={p.returncode}: "
+                            f"{p.stdout.read()[-2000:]}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=2) as r:
+                    if r.status == 200:
+                        break
+            except OSError:
+                time.sleep(0.5)
+        else:
+            pytest.fail("rolling serve never became healthy")
+        prompts, steps = [[3, 141, 59], [9, 9, 2, 7, 1]], 40
+        got = _post(port, {"tokens": prompts, "steps": steps},
+                    timeout=300)["tokens"]
+        cfg = dataclasses.replace(PRESETS["llama-tiny"],
+                                  attn_window=8).validate()
+        params = init_params(cfg, jax.random.key(0))
+        eng = DecodeEngine(params, cfg, max_slots=2, max_len=16,
+                           quantum=4, rolling=True)
+        rids = [eng.submit(pr, steps) for pr in prompts]
+        done = eng.drain()
+        want = [pr + done[r] for pr, r in zip(prompts, rids)]
+        assert got == want
+    finally:
+        p.send_signal(signal.SIGINT)
+        try:
+            p.wait(20)
+        except subprocess.TimeoutExpired:
+            p.kill()  # CPU-only child: no TPU claim to wedge

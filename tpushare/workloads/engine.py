@@ -27,10 +27,33 @@ formulation, built so EVERY compiled program has a static shape:
   and later overwritten in place as decode advances. One compile per
   bucket, ~log2(max_len) compiles total.
 
-Works with the bf16 and int8 KV caches. Rolling (ring) caches and MoE
-presets are excluded: a ring's wraparound watermark is per-slot state
-the vmapped write doesn't thread yet, and capacity routing couples
-tokens across slots (the same caveat as greedy_decode_kv).
+Works with the bf16 and int8 KV caches, prompt-bounded or ROLLING:
+
+- **Rolling (ring) slots** (``rolling=True``, requires
+  ``cfg.attn_window`` and ``max_len >= 2*attn_window`` — the same
+  retention sizing as ``greedy_decode_kv(rolling=True)``): each slot's
+  KV buffer is a ring over ``position % max_len`` with its OWN
+  wraparound watermark (``pos`` [S, max_len], threaded through the
+  vmapped step with its own vmap axis), so continuous-batching serving
+  holds O(window) HBM per slot no matter how long any request runs —
+  the resource bound the scheduler's HBM accounting assumes.
+- Rolling prefill chunks the prompt by ``attn_window`` (static chunk
+  count per prompt, ~plen/W compiles worst case, shared across equal
+  lengths): pads are confined to the FINAL chunk, whose positions are
+  < plen + W and therefore can never wrap far enough
+  (>= plen + (M - W) + 1) to clobber a ring key still inside a live
+  query's window.
+- Parity scoping for rolling: co-tenant invariance is BITWISE at any
+  scale (fixed S, varying traffic — per-slot watermark rows never
+  bleed), and S=1 matches solo ``greedy_decode_kv(rolling=True)``
+  bitwise at matched ring geometry. S>1 vs the UNBATCHED solo stream
+  is bitwise at llama-tiny scale (tests/test_engine.py) but can drift
+  ~2e-5 at d_model 256: XLA reassociates an fp32 reduction in the
+  vmapped rolling lane body that it happens not to touch in the
+  non-rolling one. Claims are tested at the scopes that hold.
+
+MoE presets stay excluded: capacity routing couples tokens across
+slots (the same caveat as greedy_decode_kv).
 """
 
 from __future__ import annotations
@@ -88,11 +111,22 @@ class DecodeEngine:
                  max_len: int, quantum: int = 8,
                  eos_id: int | None = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-                 per_request_sampling: bool = False):
+                 per_request_sampling: bool = False,
+                 rolling: bool = False):
         cfg.validate()
         if cfg.moe_experts:
             raise ValueError("continuous batching excludes MoE presets "
                              "(capacity routing couples slots)")
+        if rolling:
+            if cfg.attn_window is None:
+                raise ValueError("rolling slots require cfg.attn_window")
+            if max_len < 2 * cfg.attn_window:
+                # greedy_decode_kv's retention sizing: 2W keeps every
+                # in-chunk query's W-1 older keys alive through the
+                # chunk's own ring writes during chunked prefill
+                raise ValueError(
+                    f"rolling max_len {max_len} < 2*attn_window "
+                    f"{2 * cfg.attn_window} (chunked-prefill retention)")
         if temperature < 0:
             raise ValueError(f"temperature {temperature} must be >= 0")
         if top_k < 0 or top_k > cfg.vocab:
@@ -109,6 +143,7 @@ class DecodeEngine:
         # program serves mixed greedy and sampled traffic; the default
         # static mode keeps the pure-argmax program for greedy engines
         self._per_request = bool(per_request_sampling)
+        self._rolling = bool(rolling)
         self._params = params
         self._cfg = cfg
         self._S = int(max_slots)
@@ -131,6 +166,12 @@ class DecodeEngine:
         self._slot_keys = jnp.zeros((self._S,) + proto.shape,
                                     proto.dtype)
         self._cache = init_kv_cache(cfg, self._S, self._M)
+        if rolling:
+            # per-SLOT ring watermark [S, M] (init_kv_cache's rolling
+            # "pos" is one [M] row shared across a lockstep batch; engine
+            # slots advance independently, so each carries its own)
+            self._cache["pos"] = jnp.full((self._S, self._M), -1,
+                                          jnp.int32)
         self._pos = jnp.zeros((self._S,), jnp.int32)
         self._last = jnp.zeros((self._S,), jnp.int32)
         self._active = jnp.zeros((self._S,), bool)
@@ -222,13 +263,20 @@ class DecodeEngine:
 
         def slot_step(cache, last, pos):
             def one(cache_slot, tok, p):
-                cb = jax.tree.map(lambda x: x[:, None], cache_slot)
+                # kv leaves arrive [L, M, nkv, hd] and need a B=1 axis;
+                # a rolling "pos" leaf arrives [M] and forward_cached
+                # takes it batch-free (one watermark per B=1 stream)
+                cb = {n: (b if n == "pos" else b[:, None])
+                      for n, b in cache_slot.items()}
                 logits, nc = forward_cached(params, tok[None, None], cb,
                                             p, cfg)
-                return logits[0, -1], jax.tree.map(lambda x: x[:, 0], nc)
+                out = {n: (b if n == "pos" else b[:, 0])
+                       for n, b in nc.items()}
+                return logits[0, -1], out
 
-            return jax.vmap(one, in_axes=(1, 0, 0),
-                            out_axes=(0, 1))(cache, last, pos)
+            axes = {n: (0 if n == "pos" else 1) for n in cache}
+            return jax.vmap(one, in_axes=(axes, 0, 0),
+                            out_axes=(0, axes))(cache, last, pos)
 
         def step(carry, _):
             (cache, pos, last, active, remaining, keys, temp,
@@ -240,9 +288,9 @@ class DecodeEngine:
             nxt = jax.vmap(pick)(logits, step_keys, temp, topp)
             # inactive slots keep their cache/position/token untouched
             sel = active.reshape(1, -1, *([1] * 3))
-            cache = jax.tree.map(
-                lambda new, old: jnp.where(sel, new, old),
-                new_cache, cache)
+            cache = {n: jnp.where(active[:, None] if n == "pos"
+                                  else sel, new, cache[n])
+                     for n, new in new_cache.items()}
             emitted = jnp.where(active, nxt, -1)
             pos = pos + active.astype(jnp.int32)
             remaining = remaining - active.astype(jnp.int32)
@@ -263,8 +311,38 @@ class DecodeEngine:
 
     @functools.cached_property
     def _prefill_fn(self):
-        params, cfg = self._params, self._cfg
+        params, cfg, M = self._params, self._cfg, self._M
         pick = self._pick_fn()
+
+        if self._rolling:
+            W = cfg.attn_window
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def prefill(tokens_padded, padded_len, plen, key, temp,
+                        topp):
+                # mirror greedy_decode_kv's chunked ring prefill: W-wide
+                # chunks (each <= M - (W-1), satisfied by M >= 2W), the
+                # LAST chunk alone carrying pads. The final real
+                # position plen-1 lands in exactly one chunk; its
+                # logits row is carried out via a where-accumulator so
+                # no [padded_len, vocab] buffer is ever materialized.
+                cache1 = init_kv_cache(cfg, 1, M, rolling=True)
+                row = jnp.zeros((cfg.vocab,), jnp.float32)
+                for off in range(0, padded_len, W):
+                    chunk = tokens_padded[off:off + W]
+                    logits, cache1 = forward_cached(
+                        params, chunk[None], cache1, off, cfg)
+                    t_c = logits.shape[1]
+                    idx = jnp.clip(plen - 1 - off, 0, t_c - 1)
+                    hit = (plen - 1 >= off) & (plen - 1 < off + t_c)
+                    final = lax.dynamic_index_in_dim(
+                        logits, idx, axis=1, keepdims=False)[0]
+                    row = jnp.where(hit, final, row)
+                first = pick(row, jax.random.fold_in(key, plen - 1),
+                             temp, topp)
+                return first.astype(jnp.int32), cache1
+
+            return prefill
 
         @functools.partial(jax.jit, static_argnums=(1,))
         def prefill(tokens_padded, bucket_len, plen, key, temp, topp):
@@ -288,10 +366,15 @@ class DecodeEngine:
         def insert(cache, pos, last, active, remaining, keys, temp,
                    topp, eos, cache1, slot, plen, first, budget, rkey,
                    r_temp, r_topp, r_eos):
-            cache = jax.tree.map(
-                lambda big, one: lax.dynamic_update_index_in_dim(
-                    big, one[:, 0], slot, axis=1),
-                cache, cache1)
+            new = {n: lax.dynamic_update_index_in_dim(
+                       cache[n], cache1[n][:, 0], slot, axis=1)
+                   for n in cache if n != "pos"}
+            if "pos" in cache:
+                # the prefill's B=1 ring watermark [M] becomes this
+                # slot's row of the per-slot watermark [S, M]
+                new["pos"] = lax.dynamic_update_index_in_dim(
+                    cache["pos"], cache1["pos"], slot, axis=0)
+            cache = new
             pos = pos.at[slot].set(plen)
             last = last.at[slot].set(first)
             # a prefill-time eos completes the request on the host side
@@ -338,7 +421,9 @@ class DecodeEngine:
             raise ValueError("empty prompt")
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
-        if len(prompt) + max_new > self._M:
+        if not self._rolling and len(prompt) + max_new > self._M:
+            # rolling slots have no such bound: the ring ages keys out,
+            # so prompt + generation may run past the buffer length
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new} exceeds "
                 f"max_len {self._M}")
@@ -366,10 +451,21 @@ class DecodeEngine:
                 "ignore it)")
         slot = self._free.pop()
         plen = len(prompt)
-        # the bucket must stay inside the slot's KV buffer: a non-pow2
-        # max_len would otherwise round a valid prompt past it (e.g.
-        # plen 17 -> bucket 32 > max_len 24) and crash the cache write
-        bucket = min(_bucket(plen), self._M)
+        if self._rolling:
+            # pad to covering W-chunks (pow2-bucketed below one chunk):
+            # pads stay inside the FINAL chunk, so their ring writes sit
+            # at positions < plen + W and can never reach the wrap
+            # distance (plen + (M - W) + 1, M >= 2W) that would clobber
+            # a key still inside a live query's window
+            W = self._cfg.attn_window
+            n_chunks = -(-plen // W)
+            bucket = min(_bucket(plen), n_chunks * W)
+        else:
+            # the bucket must stay inside the slot's KV buffer: a
+            # non-pow2 max_len would otherwise round a valid prompt past
+            # it (e.g. plen 17 -> bucket 32 > max_len 24) and crash the
+            # cache write
+            bucket = min(_bucket(plen), self._M)
         padded = jnp.zeros((bucket,), jnp.int32).at[:plen].set(
             jnp.asarray(prompt, jnp.int32))
         rid = self._next_rid

@@ -338,12 +338,16 @@ def main(argv: list[str] | None = None) -> int:
 
     engine_front = None
     if args.engine:
-        if args.no_kv_cache or args.rolling_kv:
-            ap.error("--engine requires the plain KV-cached path "
-                     "(conflicts with --no-kv-cache/--rolling-kv)")
+        if args.no_kv_cache:
+            ap.error("--engine requires a KV-cached path "
+                     "(conflicts with --no-kv-cache)")
         if cfg.moe_experts:
             ap.error("--engine excludes MoE presets (capacity routing "
                      "couples slots)")
+        if args.rolling_kv and args.engine_max_len < 2 * args.attn_window:
+            ap.error(f"--engine --rolling-kv needs --engine-max-len >= "
+                     f"2*attn-window ({2 * args.attn_window}): the ring "
+                     "must retain chunked-prefill keys")
         from tpushare.workloads.engine import DecodeEngine
         eos = None if args.eos_id < 0 else args.eos_id
         engine_front = _EngineFrontend(
@@ -353,7 +357,8 @@ def main(argv: list[str] | None = None) -> int:
                          temperature=args.temperature,
                          top_k=args.top_k, top_p=args.top_p,
                          seed=args.sample_seed,
-                         per_request_sampling=args.per_request_sampling),
+                         per_request_sampling=args.per_request_sampling,
+                         rolling=args.rolling_kv),
             tokens_counter=m_tokens)
         engine_front.start()
         registry.gauge_func(
