@@ -418,3 +418,128 @@ def test_slice_labels_cleared_when_unconfigured():
     labels = fc.get_node("h1")["metadata"]["labels"]
     assert LABEL_SLICE not in labels
     assert LABEL_SLICE_ORIGIN not in labels
+
+
+# -- gang runtime env (VERDICT r4 item 4) -------------------------------------
+
+def _gang_rig():
+    """A bound 2-host gang on a slice fleet, plus a DevicePlugin on each
+    member host — the runtime side of tests/test_gang.py's scheduling."""
+    from tpushare.cache import SchedulerCache
+    from tpushare.cache.gang import GangCoordinator
+    from tpushare.k8s import FakeCluster
+
+    fc = FakeCluster()
+    for name, origin in zip(("h00", "h02", "h20", "h22"),
+                            ("0x0", "0x2", "2x0", "2x2")):
+        fc.add_tpu_node(name, chips=4, hbm_per_chip_mib=16000, mesh="2x2",
+                        slice_id="slc0", slice_origin=origin)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    gang = GangCoordinator(cache)
+    pods = []
+    for rank in (0, 1):
+        pod = fc.create_pod({
+            "metadata": {"name": f"gm{rank}", "namespace": "default",
+                         "annotations": {
+                             contract.ANN_GANG: "gj",
+                             contract.ANN_GANG_SIZE: "8",
+                             contract.ANN_GANG_RANK: str(rank),
+                             contract.ANN_TOPOLOGY: "2x4",
+                         }},
+            "spec": {"hostname": f"gj-{rank}", "subdomain": "gj",
+                     "containers": [{"name": "c", "resources": {
+                         "limits": {contract.RESOURCE_COUNT: "4"}}}]},
+        })
+        pods.append(pod)
+    hosts = []
+    for pod in pods:
+        (host,), why = gang.filter_hosts(pod)
+        assert not why
+        gang.bind_member(pod, host, fc)
+        hosts.append(host)
+    return fc, hosts
+
+
+def test_allocate_injects_gang_runtime_env():
+    fc, hosts = _gang_rig()
+    for rank, host in enumerate(hosts):
+        plugin = DevicePlugin(fc, host, FakeEnumerator(4, 16000, "2x2"))
+        resp = plugin.allocate_exclusive(4)
+        env = resp["env"]
+        # identity
+        assert env[contract.ENV_GANG_ID] == "gj"
+        assert env[contract.ENV_GANG_SIZE] == "8"
+        assert env[contract.ENV_PROCESS_ID] == str(rank)
+        assert env[contract.ENV_CLOUD_TPU_TASK_ID] == str(rank)
+        # geometry from the stamped plan (both members — rank 1's pod
+        # carries no stamp itself; the plugin reads it off the peer)
+        assert env[contract.ENV_GANG_BOX] == "2x4"
+        assert env[contract.ENV_GANG_LOCAL_BOX] == "2x2"
+        assert env[contract.ENV_NUM_PROCESSES] == "2"
+        # libtpu sub-slice pair: 2x4 global over 2x2 locals = 1x2 grid
+        assert env[contract.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS] == "2,2,1"
+        assert env[contract.ENV_TPU_PROCESS_BOUNDS] == "1,2,1"
+        # member origin inside the gang box (rank 0 at 0x0, rank 1 at
+        # 0x2 — slice-origin + host-local origin - gang origin)
+        assert env[contract.ENV_GANG_MEMBER_ORIGIN] == \
+            ("0x0" if rank == 0 else "0x2")
+        # addresses via the hostname.subdomain convention
+        port = contract.GANG_COORDINATOR_PORT
+        assert env[contract.ENV_COORDINATOR_ADDRESS] == f"gj-0.gj:{port}"
+        assert env[contract.ENV_TPU_PROCESS_ADDRESSES] == \
+            f"gj-0.gj:{port},gj-1.gj:{port}"
+        # the single-host env contract still holds alongside
+        assert len(env[contract.ENV_VISIBLE_CHIPS].split(",")) == 4
+
+
+def test_allocate_gang_env_degrades_without_plan_stamp():
+    """A gang member whose plan stamp is unreachable still allocates,
+    with identity env only (best-effort: never fail the Allocate)."""
+    fc, hosts = _gang_rig()
+    # strip the stamp from member 0 (simulates a stamped peer deleted
+    # before this member's container started)
+    p0 = fc.get_pod("default", "gm0")
+    body = dict(p0)
+    body["metadata"]["annotations"].pop(contract.ANN_GANG_PLAN)
+    fc.replace_pod("default", "gm0", body)
+    plugin = DevicePlugin(fc, hosts[1], FakeEnumerator(4, 16000, "2x2"))
+    resp = plugin.allocate_exclusive(4)
+    env = resp["env"]
+    assert env[contract.ENV_GANG_ID] == "gj"
+    assert env[contract.ENV_PROCESS_ID] == "1"
+    assert contract.ENV_TPU_PROCESS_BOUNDS not in env
+    assert contract.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS not in env
+    assert contract.ENV_COORDINATOR_ADDRESS not in env
+
+
+def test_allocate_gang_env_survives_stale_out_of_range_peer():
+    """A lingering same-gang pod with an out-of-range rank (e.g. from a
+    previous, larger incarnation of the job) must not break the
+    best-effort contract: allocate still succeeds and the address list
+    is still assembled from the in-range ranks."""
+    fc, hosts = _gang_rig()
+    fc.create_pod({
+        "metadata": {"name": "stale", "namespace": "default",
+                     "annotations": {
+                         contract.ANN_GANG: "gj",
+                         contract.ANN_GANG_SIZE: "8",
+                         contract.ANN_GANG_RANK: "5",  # out of range
+                     }},
+        "spec": {"hostname": "gj-5", "subdomain": "gj",
+                 "containers": [{"name": "c", "resources": {
+                     "limits": {}}}]},
+    })
+    plugin = DevicePlugin(fc, hosts[0], FakeEnumerator(4, 16000, "2x2"))
+    env = plugin.allocate_exclusive(4)["env"]
+    port = contract.GANG_COORDINATOR_PORT
+    assert env[contract.ENV_TPU_PROCESS_ADDRESSES] == \
+        f"gj-0.gj:{port},gj-1.gj:{port}"
+
+
+def test_allocate_non_gang_pod_gets_no_gang_env():
+    fc, plugin = rig()
+    place(fc, "w1", hbm=2048)
+    env = plugin.allocate(hbm_mib=2048)["env"]
+    assert contract.ENV_GANG_ID not in env
+    assert contract.ENV_PROCESS_ID not in env

@@ -31,6 +31,21 @@ from tpushare.contract.constants import (
     ENV_HBM_LIMIT,
     ENV_HBM_CHIP_TOTAL,
     ENV_MEM_FRACTION,
+    ENV_GANG_ID,
+    ENV_GANG_SIZE,
+    ENV_GANG_BOX,
+    ENV_GANG_ORIGIN,
+    ENV_GANG_LOCAL_BOX,
+    ENV_GANG_LOCAL_ORIGIN,
+    ENV_GANG_MEMBER_ORIGIN,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_COORDINATOR_ADDRESS,
+    ENV_TPU_PROCESS_BOUNDS,
+    ENV_TPU_CHIPS_PER_PROCESS_BOUNDS,
+    ENV_TPU_PROCESS_ADDRESSES,
+    ENV_CLOUD_TPU_TASK_ID,
+    GANG_COORDINATOR_PORT,
 )
 from tpushare.contract.pod import (
     pod_hbm_request,
@@ -66,6 +81,12 @@ __all__ = [
     "LABEL_MESH", "LABEL_TPUSHARE_NODE",
     "ENV_VISIBLE_CHIPS", "ENV_HBM_LIMIT", "ENV_HBM_CHIP_TOTAL",
     "ENV_MEM_FRACTION",
+    "ENV_GANG_ID", "ENV_GANG_SIZE", "ENV_GANG_BOX", "ENV_GANG_ORIGIN",
+    "ENV_GANG_LOCAL_BOX", "ENV_GANG_LOCAL_ORIGIN",
+    "ENV_GANG_MEMBER_ORIGIN", "ENV_NUM_PROCESSES",
+    "ENV_PROCESS_ID", "ENV_COORDINATOR_ADDRESS", "ENV_TPU_PROCESS_BOUNDS",
+    "ENV_TPU_CHIPS_PER_PROCESS_BOUNDS", "ENV_TPU_PROCESS_ADDRESSES",
+    "ENV_CLOUD_TPU_TASK_ID", "GANG_COORDINATOR_PORT",
     "pod_hbm_request", "pod_chip_count_request", "pod_topology_request",
     "chip_ids_from_annotations", "hbm_from_annotations",
     "assume_time_from_annotations", "is_assigned",

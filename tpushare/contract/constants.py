@@ -74,6 +74,37 @@ ENV_HBM_CHIP_TOTAL = "TPUSHARE_HBM_CHIP_TOTAL_MIB"
 # reference's userguide.md:67-77:
 ENV_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
 
+# -- gang runtime env (injected at Allocate for gang members, r5) ------------
+# The scheduling half of a gang ends at the stamped plan annotations; the
+# runtime half starts here: Allocate turns the plan geometry into the env
+# a multi-host JAX/libtpu process needs, so a JobSet can form the gang's
+# mesh without hand-wiring env (the reference's Allocate is likewise
+# where placement becomes env, designs.md:95-101).
+ENV_GANG_ID = "TPUSHARE_GANG_ID"
+ENV_GANG_SIZE = "TPUSHARE_GANG_SIZE"            # TOTAL chips in the gang
+ENV_GANG_BOX = "TPUSHARE_GANG_BOX"              # global box, "2x4"
+ENV_GANG_ORIGIN = "TPUSHARE_GANG_ORIGIN"        # global origin in slice
+ENV_GANG_LOCAL_BOX = "TPUSHARE_GANG_LOCAL_BOX"  # this host's share box
+ENV_GANG_LOCAL_ORIGIN = "TPUSHARE_GANG_LOCAL_ORIGIN"
+# where this member's chip box sits inside the GANG box (slice-origin
+# label + host-local origin - gang origin):
+ENV_GANG_MEMBER_ORIGIN = "TPUSHARE_GANG_MEMBER_ORIGIN"
+# The standard JAX multi-controller contract (jax.distributed.initialize
+# reads these names from the environment):
+ENV_NUM_PROCESSES = "NUM_PROCESSES"             # = gang host count
+ENV_PROCESS_ID = "PROCESS_ID"                   # = gang rank
+ENV_COORDINATOR_ADDRESS = "COORDINATOR_ADDRESS"
+# libtpu's own sub-slice contract (TPU_PROCESS_BOUNDS-class): how the
+# member processes tile the gang's global box, and each process's chip
+# box — comma-separated, padded to 3 axes the way libtpu spells them.
+ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
+ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
+ENV_TPU_PROCESS_ADDRESSES = "TPU_PROCESS_ADDRESSES"
+ENV_CLOUD_TPU_TASK_ID = "CLOUD_TPU_TASK_ID"
+# jax.distributed's default coordinator port; samples/6-gang.yaml binds
+# its headless-Service coordinator on the same number
+GANG_COORDINATOR_PORT = 8476
+
 # -- unhealthy-chip configmap (operator-maintained, kube-system) -------------
 # reference: configmap "unhealthy-gpu-<node>" key "gpus" = CSV device ids
 # (/root/reference/pkg/cache/nodeinfo.go:406-431, configmap.go:20-34)
