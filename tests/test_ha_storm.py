@@ -487,3 +487,97 @@ def test_gang_survives_leader_failover_midgang(cluster):
         pod = survivor.client.get_pod("storm", name)
         ids = contract.chip_ids_from_annotations(pod)
         assert ids is not None and len(ids) == 4
+
+
+def test_gang_filter_bind_interleaves_across_replicas_with_takeover(cluster):
+    """VERDICT r4 item 5, HA leg: a 16-chip gang's four members race
+    filter/bind through BOTH replicas from four threads while the
+    initial leader abdicates mid-gang (takeover between reserve and the
+    remaining binds). The stamped plan must keep every member on one
+    geometry: all four bound, distinct hosts, disjoint full-host chip
+    sets — regardless of which replica answered which member."""
+    stub, a, b = cluster
+    for i, origin in enumerate(("0x0", "0x2", "2x0", "2x2")):
+        stub.seed("nodes", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"s{i}",
+                         "labels": {
+                             "tpushare": "true",
+                             "tpushare.aliyun.com/mesh": "2x2",
+                             contract.LABEL_SLICE: "slc0",
+                             contract.LABEL_SLICE_ORIGIN: origin}},
+            "status": {"capacity": {
+                "aliyun.com/tpu-hbm": str(CHIPS * HBM),
+                "aliyun.com/tpu-count": str(CHIPS)}}})
+    for r in (a, b):
+        r.controller.resync_once()
+    assert wait_until(lambda: all(
+        getattr(r.cache.get_node_info("s0"), "slice_id", None) == "slc0"
+        for r in (a, b)), timeout=5.0)
+
+    def gang_pod(name, rank):
+        return stub.seed("pods", {
+            "metadata": {"name": name, "namespace": "storm",
+                         "annotations": {
+                             contract.ANN_GANG: "igang",
+                             contract.ANN_GANG_SIZE: "16",
+                             contract.ANN_GANG_RANK: str(rank),
+                             contract.ANN_TOPOLOGY: "4x4"}},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "limits": {"aliyun.com/tpu-count": "4"}}}]}})
+
+    pods = [gang_pod(f"igang-{r}", r) for r in range(4)]
+    names = [f"s{i}" for i in range(NODES)]
+    replicas = [a, b]
+    bound_hosts: dict[int, str | None] = {}
+    lock = threading.Lock()
+    first_bound = threading.Event()
+
+    def drive(rank):
+        host = try_schedule(replicas, pods[rank], names, attempts=160)
+        with lock:
+            bound_hosts[rank] = host
+        if host is not None:
+            first_bound.set()
+
+    threads = [threading.Thread(target=drive, args=(r,))
+               for r in range(4)]
+    for t in threads:
+        t.start()
+    # takeover mid-gang: once any member is bound, the current leader
+    # abdicates (elector stopped, server kept answering — its remaining
+    # binds must be refused as non-leader, not half-applied)
+    assert first_bound.wait(timeout=30.0), "no member ever bound"
+    leader = a if a.elector.is_leader() else b
+    leader.elector.stop()
+    for t in threads:
+        t.join()
+
+    assert all(h is not None for h in bound_hosts.values()), bound_hosts
+    assert sorted(bound_hosts.values()) == sorted(names)  # 4 distinct
+    # one geometry: every member sits on the FIRST stamped plan's host
+    # for its rank, with its full-host chip set
+    stamped = None
+    for r in range(4):
+        pod = (b if b.elector.is_leader() else a).client.get_pod(
+            "storm", f"igang-{r}")
+        plan = contract.gang_plan_from_annotations(pod)
+        if plan is not None:
+            stamped = plan
+            break
+    assert stamped is not None, "no member carries the stamped plan"
+    plan_hosts = [m["host"] for m in stamped["members"]]
+    seen_chips: dict[str, set] = {}
+    for r in range(4):
+        pod = (b if b.elector.is_leader() else a).client.get_pod(
+            "storm", f"igang-{r}")
+        ids = contract.chip_ids_from_annotations(pod)
+        assert ids is not None and len(ids) == 4
+        node = pod.get("spec", {}).get("nodeName")
+        assert node == bound_hosts[r] == plan_hosts[r], (
+            r, node, bound_hosts[r], plan_hosts[r])
+        overlap = seen_chips.setdefault(node, set()) & set(ids)
+        assert not overlap, (node, overlap)
+        seen_chips[node] |= set(ids)
+    assert_apiserver_invariants(stub, (b if b.elector.is_leader()
+                                       else a).client)
