@@ -185,11 +185,17 @@ def wire_latency(ha: bool = False) -> dict:
     makes dual-replica binds oversubscription-safe — measured separately
     so the HA tax is a published number, not a surprise.
     """
+    from tpushare.cache.cache import MEMO_REQUESTS
     from tpushare.k8s.incluster import InClusterClient
+    from tpushare.k8s.informer import Informer, LISTER_REQUESTS
+    from tpushare.k8s.stats import (
+        APISERVER_REQUESTS, READ_VERBS, WRITE_VERBS, CountingCluster,
+        delta)
     from tpushare.k8s.stubapi import StubApiServer
 
     stub = StubApiServer().start()
-    client = InClusterClient(base_url=stub.base_url, timeout=10.0)
+    client = CountingCluster(
+        InClusterClient(base_url=stub.base_url, timeout=10.0))
     for i in range(4):
         stub.seed("nodes", {
             "apiVersion": "v1", "kind": "Node",
@@ -199,7 +205,11 @@ def wire_latency(ha: bool = False) -> dict:
             "status": {"capacity": {
                 "aliyun.com/tpu-hbm": str(4 * V5E_HBM),
                 "aliyun.com/tpu-count": "4"}}})
-    cache = SchedulerCache(client)
+    # deployment parity with extender/__main__.py: watch-warmed listers
+    # serve Bind's pod fetch and the cache's node fetch, so the measured
+    # hot path carries ZERO synchronous apiserver reads
+    informer = Informer(client).start()
+    cache = SchedulerCache(client, node_lister=informer.nodes)
     ctl = Controller(client, cache)
     ctl.build_cache()
     ctl.start()
@@ -217,7 +227,7 @@ def wire_latency(ha: bool = False) -> dict:
                 "HA wire bench: elector failed to acquire leadership in "
                 "10s — binds would all 503")
     server = ExtenderServer(cache, client, host="127.0.0.1", port=0,
-                            elector=elector)
+                            elector=elector, informer=informer)
     port = server.start()
     # deployment parity with extender/__main__.py: the service freezes
     # its post-build heap so gen-2 GC sweeps stay off the bind path.
@@ -263,11 +273,25 @@ def wire_latency(ha: bool = False) -> dict:
 
     gc.callbacks.append(_gc_cb)
     windows: list[tuple[float, float]] = []
+    # apiserver round-trip budget over the measured binds: snapshot the
+    # per-(verb, origin) counters and diff after the loop — this is the
+    # number the informer/memo work exists to drive to zero reads
+    api_before = APISERVER_REQUESTS.snapshot()
+    lister_before = LISTER_REQUESTS.snapshot()
+    memo_before = MEMO_REQUESTS.snapshot()
     try:
         for i in range(60):
             pod = make_pod(1 * GIB)
             pod["metadata"]["namespace"] = "bench"
             created = stub.seed("pods", pod)
+            # steady-state parity: kube-scheduler only webhooks a pod its
+            # own informer has seen, so ours has seen it too — wait (off
+            # the timed window) for the watch to deliver it
+            uid = created["metadata"].get("uid", "")
+            sync_deadline = clock() + 2.0
+            while informer.pods.by_uid(uid) is None \
+                    and clock() < sync_deadline:
+                time.sleep(0.0005)
             t0 = clock()
             ok = post("/filter", {"Pod": created,
                                   "NodeNames": names})["NodeNames"]
@@ -284,6 +308,11 @@ def wire_latency(ha: bool = False) -> dict:
             lat_ms.append((t1 - t0) * 1e3)
             if result.get("Error"):
                 break
+        # budget accounting BEFORE the preempt section (whose seeding
+        # binds would pollute the per-bind attribution)
+        api_after = APISERVER_REQUESTS.snapshot()
+        lister_after = LISTER_REQUESTS.snapshot()
+        memo_after = MEMO_REQUESTS.snapshot()
         # preempt verb latency on the same wire (non-HA run only: the
         # verb mutates nothing, the claim CAS adds nothing to measure,
         # and main() reads just the non-HA stats): a dedicated 2-chip
@@ -299,7 +328,24 @@ def wire_latency(ha: bool = False) -> dict:
         if elector is not None:
             elector.stop()
         ctl.stop()
+        informer.stop()
         stub.stop()
+
+    def _rate(before, after):
+        moved = {k: after.get(k, 0.0) - before.get(k, 0.0)
+                 for k in after}
+        hits = sum(v for k, v in moved.items() if k[-1] == "hit")
+        misses = sum(v for k, v in moved.items() if k[-1] == "miss")
+        if hits + misses == 0:
+            return None
+        return round(hits / (hits + misses), 4)
+
+    hot_origins = ("filter", "prioritize", "bind")
+    n_binds = max(1, len(lat_ms))
+    reads = sum(delta(api_before, api_after, verbs=READ_VERBS, origin=o)
+                for o in hot_origins)
+    writes = sum(delta(api_before, api_after, verbs=WRITE_VERBS, origin=o)
+                 for o in hot_origins)
     # attribute the worst bind: GC time CLIPPED to its window (a pause
     # merely straddling the edge must not out-count the bind itself)
     order = sorted(range(len(lat_ms)), key=lambda j: lat_ms[j])
@@ -320,6 +366,15 @@ def wire_latency(ha: bool = False) -> dict:
         # delta over THIS run (the counter is process-wide)
         "cas_retries_total": _claim_cas_retries_value()
         - cas_retries_start,
+        # apiserver round-trip budget over the measured binds (docs/
+        # perf.md "apiserver round-trip budget"): reads MUST be 0 for
+        # plain binds — the pod GET and node fetches are lister-served
+        "apiserver_reads_per_bind": round(reads / n_binds, 4),
+        "apiserver_writes_per_bind": round(writes / n_binds, 4),
+        "apiserver_requests_per_bind": round((reads + writes) / n_binds,
+                                             4),
+        "lister_hit_rate": _rate(lister_before, lister_after),
+        "memo_hit_rate": _rate(memo_before, memo_after),
         **preempt_stats,
     }
 
@@ -1224,6 +1279,19 @@ def main() -> int:
     expect(wire["p50"] < 50.0,
            f"wire bind p50 {wire['p50']:.2f} ms < 50 ms "
            f"(filter+prioritize+bind incl. PATCH+POST on the wire)")
+    # the apiserver round-trip budget (ISSUE 1 acceptance): a plain
+    # (non-gang, non-HA) bind's hot path is allowed 2 writes (placement
+    # PATCH + binding POST) and ZERO synchronous reads — the pod GET and
+    # node fetches must come from the watch-warmed listers
+    expect(wire["apiserver_reads_per_bind"] == 0,
+           f"plain bind issued 0 apiserver reads/bind "
+           f"(got {wire['apiserver_reads_per_bind']})")
+    expect(wire["apiserver_writes_per_bind"] <= 2,
+           f"plain bind issued <= 2 apiserver writes/bind "
+           f"(got {wire['apiserver_writes_per_bind']})")
+    expect((wire["memo_hit_rate"] or 0) > 0,
+           f"placement memo served the Prioritize/Bind reuse "
+           f"(hit rate {wire['memo_hit_rate']})")
     expect(wire.get("preempt_victims_out", -1) == 1,
            f"preempt verb refined 4 victims to 1 on the wire "
            f"(p50 {wire.get('preempt_p50', -1):.2f} ms)")
@@ -1337,6 +1405,15 @@ def main() -> int:
             "p50_bind_ms": round(wire["p50"], 3),
             "p99_bind_ms": round(wire["p99"], 3),
             "gc_ms_in_worst_bind": wire["gc_ms_in_worst_bind"],
+            # the read-path budget (informer/lister/memo work): reads
+            # are lister-served, so a plain bind pays only its 2 writes
+            "apiserver_requests_per_bind":
+                wire["apiserver_requests_per_bind"],
+            "apiserver_reads_per_bind": wire["apiserver_reads_per_bind"],
+            "apiserver_writes_per_bind":
+                wire["apiserver_writes_per_bind"],
+            "lister_hit_rate": wire["lister_hit_rate"],
+            "memo_hit_rate": wire["memo_hit_rate"],
             "p50_preempt_ms": round(wire["preempt_p50"], 3),
             # HA mode engages the per-node claim CAS (dual-replica
             # oversubscription safety): +1 GET +1 PATCH per bind

@@ -1,0 +1,572 @@
+"""Read-path tests: informer/listers, singleflight coalescing, the
+cross-verb placement memo, and the apiserver round-trip budget.
+
+The perf claim of the informer/memo work is only real if it is
+falsifiable — these tests pin the budget with the same counters bench.py
+publishes: a plain bind's hot path issues ZERO synchronous apiserver
+reads, a gang member's Allocate issues at most one namespace-scoped pods
+LIST, and any cache mutation invalidates the memo.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import MEMO_REQUESTS, SchedulerCache
+from tpushare.cache.nodeinfo import request_from_pod
+from tpushare.extender.handlers import (
+    BindHandler, FilterHandler, PrioritizeHandler)
+from tpushare.extender.metrics import Registry
+from tpushare.k8s import ApiError, FakeCluster
+from tpushare.k8s.informer import (
+    Informer, LISTER_REQUESTS, PodLister, lister_hit_rate)
+from tpushare.k8s.singleflight import SINGLEFLIGHT_TOTAL, Singleflight
+from tpushare.k8s.stats import (
+    APISERVER_REQUESTS, CountingCluster, READ_VERBS, WRITE_VERBS,
+    api_origin, delta)
+
+
+def cluster_with_node(chips=4, hbm=16000, mesh=None, name="n1"):
+    fc = FakeCluster()
+    fc.add_tpu_node(name, chips=chips, hbm_per_chip_mib=hbm, mesh=mesh)
+    return fc
+
+
+# -- singleflight -------------------------------------------------------------
+
+def test_singleflight_coalesces_concurrent_callers():
+    """Two threads hitting the same key during one burst observe exactly
+    one upstream call and share its result."""
+    sf = Singleflight()
+    calls = []
+    entered = threading.Event()
+    release = threading.Event()
+
+    def upstream():
+        calls.append(threading.get_ident())
+        entered.set()
+        release.wait(5)
+        return "answer"
+
+    results = []
+
+    def worker():
+        results.append(sf.do("k", upstream))
+
+    t1 = threading.Thread(target=worker)
+    t1.start()
+    assert entered.wait(5)  # leader is inside upstream
+    t2 = threading.Thread(target=worker)
+    t2.start()
+    # give the follower time to park on the leader's event
+    time.sleep(0.05)
+    release.set()
+    t1.join(5)
+    t2.join(5)
+    assert results == ["answer", "answer"]
+    assert len(calls) == 1
+
+
+def test_singleflight_sequential_calls_are_not_cached():
+    sf = Singleflight()
+    calls = []
+    assert sf.do("k", lambda: calls.append(1) or "a") == "a"
+    assert sf.do("k", lambda: calls.append(2) or "b") == "b"
+    assert len(calls) == 2  # coalescing, not caching
+
+
+def test_singleflight_shares_the_leaders_exception():
+    sf = Singleflight()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def boom():
+        entered.set()
+        release.wait(5)
+        raise ApiError(404, "gone")
+
+    errors = []
+
+    def worker():
+        try:
+            sf.do("k", boom)
+        except ApiError as e:
+            errors.append(e.status)
+
+    t1 = threading.Thread(target=worker)
+    t1.start()
+    assert entered.wait(5)
+    t2 = threading.Thread(target=worker)
+    t2.start()
+    time.sleep(0.05)
+    release.set()
+    t1.join(5)
+    t2.join(5)
+    assert errors == [404, 404]
+
+
+def test_singleflight_counters_track_leader_and_shared():
+    before = SINGLEFLIGHT_TOTAL.snapshot()
+    sf = Singleflight()
+    gate = threading.Event()
+    started = threading.Event()
+
+    def slow():
+        started.set()
+        gate.wait(5)
+        return 1
+
+    t = threading.Thread(target=lambda: sf.do("k", slow))
+    t.start()
+    assert started.wait(5)
+    t2 = threading.Thread(target=lambda: sf.do("k", slow))
+    t2.start()
+    time.sleep(0.05)
+    gate.set()
+    t.join(5)
+    t2.join(5)
+    after = SINGLEFLIGHT_TOTAL.snapshot()
+    assert after.get(("leader",), 0) - before.get(("leader",), 0) == 1
+    assert after.get(("shared",), 0) - before.get(("shared",), 0) == 1
+
+
+# -- informer / listers -------------------------------------------------------
+
+def test_pod_lister_indexes_and_unindexes():
+    lister = PodLister()
+    pod = make_pod(hbm=1024, name="a", node="n1",
+                   ann={contract.ANN_GANG: "g1"})
+    lister.apply("ADDED", pod)
+    assert lister.get("default", "a") is pod
+    assert lister.by_uid(pod["metadata"]["uid"]) is pod
+    assert lister.on_node("n1") == [pod]
+    assert lister.gang_peers("default", "g1") == [pod]
+    # gang index is namespace-scoped by construction
+    assert lister.gang_peers("other", "g1") == []
+    moved = dict(pod, spec=dict(pod["spec"], nodeName="n2"))
+    lister.apply("MODIFIED", moved)
+    assert lister.on_node("n1") == []
+    assert lister.on_node("n2") == [moved]
+    lister.apply("DELETED", moved)
+    assert lister.get("default", "a") is None
+    assert lister.by_uid(pod["metadata"]["uid"]) is None
+    assert lister.gang_peers("default", "g1") == []
+    assert len(lister) == 0
+
+
+def test_informer_syncs_and_follows_watch_events():
+    fc = cluster_with_node()
+    seeded = fc.create_pod(make_pod(hbm=1024, name="pre"))
+    informer = Informer(fc).start()
+    try:
+        # initial LIST is synchronous: both stores are warm at return
+        assert informer.synced
+        assert informer.nodes.get("n1") is not None
+        assert informer.pods.get("default", "pre") is not None
+        assert informer.pods.by_uid(seeded["metadata"]["uid"]) is not None
+        # watch events flow into the stores
+        fc.create_pod(make_pod(hbm=1024, name="post"))
+        deadline = time.time() + 5
+        while informer.pods.get("default", "post") is None \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert informer.pods.get("default", "post") is not None
+    finally:
+        informer.stop()
+
+
+def test_informer_relists_after_watch_break():
+    """A broken watch stream heals by re-LISTing: objects created while
+    the stream was down appear after the relist."""
+    fc = cluster_with_node()
+
+    class BreakingCluster:
+        """Delegates to FakeCluster but serves each watch stream as an
+        immediate EOF — every event must arrive via relist."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def watch_pods(self, stop):
+            return iter(())
+
+        def watch_nodes(self, stop):
+            return iter(())
+
+    informer = Informer(BreakingCluster(fc))
+    informer.BACKOFF_BASE_S = 0.01
+    informer.BACKOFF_CAP_S = 0.02
+    informer.start()
+    try:
+        fc.create_pod(make_pod(hbm=1024, name="missed"))
+        deadline = time.time() + 5
+        while informer.pods.get("default", "missed") is None \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert informer.pods.get("default", "missed") is not None
+    finally:
+        informer.stop()
+
+
+def test_lister_hit_rate_counts():
+    before_h = LISTER_REQUESTS.total(outcome="hit")
+    before_m = LISTER_REQUESTS.total(outcome="miss")
+    from tpushare.k8s.informer import lookup
+    lister = PodLister()
+    pod = make_pod(hbm=1024, name="x")
+    lister.apply("ADDED", pod)
+    assert lookup(lister, "pods", "default", "x") is pod
+    assert lookup(lister, "pods", "default", "absent") is None
+    assert lookup(None, "pods", "default", "x") is None  # no lister
+    assert LISTER_REQUESTS.total(outcome="hit") - before_h == 1
+    assert LISTER_REQUESTS.total(outcome="miss") - before_m == 2
+    assert lister_hit_rate() is not None
+
+
+# -- placement memo -----------------------------------------------------------
+
+def rig_handlers(fc, node_lister=None, pod_lister=None):
+    cache = SchedulerCache(fc, node_lister=node_lister)
+    registry = Registry()
+    return (cache,
+            FilterHandler(cache, registry),
+            PrioritizeHandler(cache, registry),
+            BindHandler(cache, fc, registry, pod_lister=pod_lister))
+
+
+def _memo_score_counts():
+    return (MEMO_REQUESTS.get("score", "hit"),
+            MEMO_REQUESTS.get("score", "miss"))
+
+
+def test_prioritize_reuses_filters_memoized_scores():
+    fc = cluster_with_node()
+    cache, flt, prio, _ = rig_handlers(fc)
+    pod = fc.create_pod(make_pod(hbm=2048, name="m1"))
+    h0, m0 = _memo_score_counts()
+    assert flt.handle({"Pod": pod, "NodeNames": ["n1"]})["NodeNames"] \
+        == ["n1"]
+    h1, m1 = _memo_score_counts()
+    assert (h1 - h0, m1 - m0) == (0, 1)  # Filter computed
+    ranked = prio.handle({"Pod": pod, "NodeNames": ["n1"]})
+    assert [r["Host"] for r in ranked] == ["n1"]
+    h2, m2 = _memo_score_counts()
+    assert (h2 - h1, m2 - m1) == (1, 0)  # Prioritize served from memo
+
+
+@pytest.mark.parametrize("mutate", ["bind", "remove_pod", "node_update"])
+def test_memo_invalidated_by_cache_mutations(mutate):
+    """A Prioritize served after an intervening allocate/remove_pod/node
+    change must recompute — asserted via the memo hit/miss counters."""
+    fc = cluster_with_node()
+    cache, flt, prio, _ = rig_handlers(fc)
+    pod = fc.create_pod(make_pod(hbm=2048, name="victim"))
+    other = fc.create_pod(make_pod(hbm=4096, name="other"))
+    flt.handle({"Pod": pod, "NodeNames": ["n1"]})
+
+    if mutate == "bind":
+        info = cache.get_node_info("n1")
+        info.allocate(other, fc)
+    elif mutate == "remove_pod":
+        info = cache.get_node_info("n1")
+        info.allocate(other, fc)
+        bound = fc.get_pod("default", "other")
+        cache.add_or_update_pod(bound)
+        cache.remove_pod(bound)
+    else:  # node_update: capacity change rebuilds chips
+        node = fc.get_node("n1")
+        for field in ("capacity", "allocatable"):
+            node["status"][field][contract.RESOURCE_HBM] = str(2 * 16000)
+            node["status"][field][contract.RESOURCE_COUNT] = "2"
+        cache.update_node(node)
+
+    h0, m0 = _memo_score_counts()
+    prio.handle({"Pod": pod, "NodeNames": ["n1"]})
+    h1, m1 = _memo_score_counts()
+    assert (h1 - h0, m1 - m0) == (0, 1), \
+        f"stale memo served after {mutate}"
+
+
+def test_bind_seeds_allocate_from_memoized_placement():
+    fc = cluster_with_node()
+    cache, flt, prio, bind = rig_handlers(fc)
+    pod = fc.create_pod(make_pod(hbm=2048, name="s1"))
+    flt.handle({"Pod": pod, "NodeNames": ["n1"]})
+    prio.handle({"Pod": pod, "NodeNames": ["n1"]})
+    seed_h0 = MEMO_REQUESTS.get("seed", "hit")
+    out = bind.handle({"PodName": "s1", "PodNamespace": "default",
+                       "PodUID": pod["metadata"]["uid"], "Node": "n1"})
+    assert not out.get("Error")
+    assert MEMO_REQUESTS.get("seed", "hit") - seed_h0 == 1
+    bound = fc.get_pod("default", "s1")
+    assert contract.chip_ids_from_annotations(bound) is not None
+
+
+def test_memo_seed_miss_after_intervening_mutation():
+    """The seed hint is generation-stamped: a mutation between
+    Prioritize and Bind discards it (Bind re-searches, never trusts a
+    stale placement)."""
+    fc = cluster_with_node()
+    cache, flt, prio, bind = rig_handlers(fc)
+    pod = fc.create_pod(make_pod(hbm=2048, name="s2"))
+    other = fc.create_pod(make_pod(hbm=4096, name="s2other"))
+    flt.handle({"Pod": pod, "NodeNames": ["n1"]})
+    prio.handle({"Pod": pod, "NodeNames": ["n1"]})
+    cache.get_node_info("n1").allocate(other, fc)  # bumps generation
+    seed_m0 = MEMO_REQUESTS.get("seed", "miss")
+    out = bind.handle({"PodName": "s2", "PodNamespace": "default",
+                       "PodUID": pod["metadata"]["uid"], "Node": "n1"})
+    assert not out.get("Error")
+    assert MEMO_REQUESTS.get("seed", "miss") - seed_m0 == 1
+
+
+def test_memo_differentiates_request_signatures():
+    """Same pod key, different request shape (e.g. after a spec edit)
+    must not serve the old entry."""
+    fc = cluster_with_node()
+    cache = SchedulerCache(fc)
+    pod = fc.create_pod(make_pod(hbm=2048, name="sig"))
+    req = request_from_pod(pod)
+    scores, _ = cache.score_nodes(pod, req, ["n1"])
+    assert scores["n1"] is not None
+    import dataclasses
+    bigger = dataclasses.replace(req, hbm_mib=4096)
+    h0, m0 = _memo_score_counts()
+    cache.score_nodes(pod, bigger, ["n1"])
+    h1, m1 = _memo_score_counts()
+    assert (h1 - h0, m1 - m0) == (0, 1)
+
+
+# -- apiserver round-trip budget ---------------------------------------------
+
+def test_plain_bind_hot_path_issues_zero_apiserver_reads():
+    """The acceptance bar: with the informer wired, a plain (non-gang,
+    non-HA) filter->prioritize->bind cycle issues 0 synchronous reads
+    and at most 2 writes (placement PATCH + binding POST)."""
+    fc = cluster_with_node()
+    counting = CountingCluster(fc)
+    informer = Informer(counting).start()
+    try:
+        cache, flt, prio, bind = rig_handlers(
+            counting, node_lister=informer.nodes,
+            pod_lister=informer.pods)
+        pod = fc.create_pod(make_pod(hbm=2048, name="hot"))
+        # wait for the watch to deliver the pod (deployment steady state:
+        # the informer has seen every pod by the time kube-scheduler
+        # calls the webhook for it)
+        deadline = time.time() + 5
+        while informer.pods.get("default", "hot") is None \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        before = APISERVER_REQUESTS.snapshot()
+        flt.handle({"Pod": pod, "NodeNames": ["n1"]})
+        prio.handle({"Pod": pod, "NodeNames": ["n1"]})
+        out = bind.handle({"PodName": "hot", "PodNamespace": "default",
+                           "PodUID": pod["metadata"]["uid"],
+                           "Node": "n1"})
+        after = APISERVER_REQUESTS.snapshot()
+        assert not out.get("Error")
+        hot_origins = ("filter", "prioritize", "bind")
+        reads = sum(delta(before, after, verbs=READ_VERBS, origin=o)
+                    for o in hot_origins)
+        writes = sum(delta(before, after, verbs=WRITE_VERBS, origin=o)
+                     for o in hot_origins)
+        assert reads == 0, f"hot path issued {reads} apiserver reads"
+        assert writes <= 2, f"hot path issued {writes} apiserver writes"
+    finally:
+        informer.stop()
+
+
+def test_bind_pod_fetch_falls_back_on_lister_miss():
+    """A pod the informer has not seen yet still binds — via exactly one
+    coalesced apiserver GET."""
+    fc = cluster_with_node()
+    counting = CountingCluster(fc)
+    # informer deliberately NOT started: every lister read misses
+    empty = Informer(counting)
+    cache, flt, prio, bind = rig_handlers(
+        counting, node_lister=empty.nodes, pod_lister=empty.pods)
+    pod = fc.create_pod(make_pod(hbm=2048, name="cold"))
+    flt.handle({"Pod": pod, "NodeNames": ["n1"]})
+    before = APISERVER_REQUESTS.snapshot()
+    out = bind.handle({"PodName": "cold", "PodNamespace": "default",
+                       "PodUID": pod["metadata"]["uid"], "Node": "n1"})
+    after = APISERVER_REQUESTS.snapshot()
+    assert not out.get("Error")
+    assert delta(before, after, verbs=frozenset({"get_pod"}),
+                 origin="bind") == 1
+
+
+def test_gang_allocate_issues_at_most_one_namespace_scoped_list():
+    """ISSUE acceptance: a gang member's Allocate without listers wired
+    issues at most ONE pods LIST, namespace-scoped — never the two
+    cluster-wide LISTs the old _gang_env paid."""
+    from tests.test_deviceplugin import _gang_rig
+    from tpushare.deviceplugin import DevicePlugin, FakeEnumerator
+
+    fc, hosts = _gang_rig()
+    counting = CountingCluster(fc)
+    plugin = DevicePlugin(counting, hosts[1],
+                          FakeEnumerator(4, 16000, "2x2"))
+    before = APISERVER_REQUESTS.snapshot()
+    resp = plugin.allocate_exclusive(4)
+    after = APISERVER_REQUESTS.snapshot()
+    assert resp["env"][contract.ENV_GANG_ID] == "gj"
+    assert delta(before, after, verbs=frozenset({"list_pods"})) == 0, \
+        "gang allocate issued a cluster-wide pods LIST"
+    assert delta(before, after,
+                 verbs=frozenset({"list_pods_ns"})) <= 1
+
+
+def test_gang_allocate_with_listers_issues_zero_pod_lists():
+    from tests.test_deviceplugin import _gang_rig
+    from tpushare.deviceplugin import DevicePlugin, FakeEnumerator
+
+    fc, hosts = _gang_rig()
+    counting = CountingCluster(fc)
+    informer = Informer(counting).start()
+    try:
+        plugin = DevicePlugin(counting, hosts[0],
+                              FakeEnumerator(4, 16000, "2x2"),
+                              pod_lister=informer.pods,
+                              node_lister=informer.nodes)
+        before = APISERVER_REQUESTS.snapshot()
+        resp = plugin.allocate_exclusive(4)
+        after = APISERVER_REQUESTS.snapshot()
+        assert resp["env"][contract.ENV_GANG_ID] == "gj"
+        lists = delta(before, after, verbs=frozenset(
+            {"list_pods", "list_pods_ns", "list_pods_node"}))
+        assert lists == 0, f"lister-wired allocate issued {lists} LISTs"
+        assert delta(before, after,
+                     verbs=frozenset({"get_node"})) == 0
+    finally:
+        informer.stop()
+
+
+def test_allocate_falls_back_past_watch_lag():
+    """A placement stamped AFTER the lister's last sync still allocates:
+    the rendezvous miss triggers one real LIST."""
+    from tests.test_deviceplugin import place
+    from tpushare.deviceplugin import DevicePlugin, FakeEnumerator
+
+    fc = cluster_with_node()
+    stale = Informer(fc)  # never started: permanently empty listers
+    plugin = DevicePlugin(fc, "n1", FakeEnumerator(4, 16000, "2x2"),
+                          pod_lister=stale.pods,
+                          node_lister=stale.nodes)
+    place(fc, "lagged", hbm=2048)
+    resp = plugin.allocate(hbm_mib=2048)
+    assert resp["pod"]["name"] == "lagged"
+
+
+def test_gang_duplicate_rank_prefers_plan_host():
+    """A stale same-rank pod (e.g. Terminating predecessor in the SAME
+    namespace) must not hijack the rank's address: the pod on the
+    stamped plan's host wins."""
+    from tests.test_deviceplugin import _gang_rig
+    from tpushare.deviceplugin import DevicePlugin, FakeEnumerator
+
+    fc, hosts = _gang_rig()
+    # impostor claims rank 1, sits on no plan host, newest timestamp
+    fc.create_pod({
+        "metadata": {"name": "impostor", "namespace": "default",
+                     "creationTimestamp": "2099-01-01T00:00:00Z",
+                     "annotations": {
+                         contract.ANN_GANG: "gj",
+                         contract.ANN_GANG_SIZE: "8",
+                         contract.ANN_GANG_RANK: "1",
+                     }},
+        "spec": {"hostname": "impostor", "subdomain": "gj",
+                 "containers": [{"name": "c",
+                                 "resources": {"limits": {}}}]},
+    })
+    plugin = DevicePlugin(fc, hosts[0], FakeEnumerator(4, 16000, "2x2"))
+    env = plugin.allocate_exclusive(4)["env"]
+    port = contract.GANG_COORDINATOR_PORT
+    assert env[contract.ENV_TPU_PROCESS_ADDRESSES] == \
+        f"gj-0.gj:{port},gj-1.gj:{port}"
+
+
+def test_gang_peers_scoped_to_namespace():
+    """A same-gang-id pod in ANOTHER namespace is invisible to peer
+    discovery (the cross-namespace wrong-plan hazard)."""
+    from tests.test_deviceplugin import _gang_rig
+    from tpushare.deviceplugin import DevicePlugin, FakeEnumerator
+
+    fc, hosts = _gang_rig()
+    foreign = fc.create_pod({
+        "metadata": {"name": "foreign", "namespace": "other-ns",
+                     "annotations": {
+                         contract.ANN_GANG: "gj",
+                         contract.ANN_GANG_SIZE: "8",
+                         contract.ANN_GANG_RANK: "0",
+                     }},
+        "spec": {"hostname": "evil-0", "subdomain": "gj",
+                 "containers": [{"name": "c",
+                                 "resources": {"limits": {}}}]},
+    })
+    assert foreign["metadata"]["namespace"] == "other-ns"
+    plugin = DevicePlugin(fc, hosts[0], FakeEnumerator(4, 16000, "2x2"))
+    env = plugin.allocate_exclusive(4)["env"]
+    port = contract.GANG_COORDINATOR_PORT
+    # rank 0's address resolves to the real member, not the foreign pod
+    assert env[contract.ENV_COORDINATOR_ADDRESS] == f"gj-0.gj:{port}"
+
+
+def test_gang_env_warns_when_process_grid_cannot_fill(caplog):
+    """When the member count cannot fill the process grid the box/local
+    ratio implies, the TPU_PROCESS_BOUNDS pair is omitted WITH a warning
+    (silent omission was the round-5 finding)."""
+    import json as jsonlib
+    import logging
+
+    from tpushare.deviceplugin import DevicePlugin, FakeEnumerator
+
+    fc = FakeCluster()
+    fc.add_tpu_node("h0", chips=4, hbm_per_chip_mib=16000, mesh="2x2",
+                    slice_id="s", slice_origin="0x0")
+    plugin = DevicePlugin(fc, "h0", FakeEnumerator(4, 16000, "2x2"))
+    # a 2x4 gang box over 2x2 local boxes implies a 2-process grid, but
+    # the stamped plan lists only ONE member
+    plan = {"box": [2, 4], "origin": [0, 0],
+            "members": [{"host": "h0", "box": [2, 2],
+                         "origin": [0, 0]}]}
+    chosen = make_pod(count=4, name="lone", ann={
+        contract.ANN_GANG: "g-under",
+        contract.ANN_GANG_SIZE: "8",
+        contract.ANN_GANG_RANK: "0",
+        contract.ANN_GANG_PLAN: jsonlib.dumps(plan),
+    })
+    with caplog.at_level(logging.WARNING, "tpushare.deviceplugin"):
+        env = plugin._gang_env(chosen)
+    assert contract.ENV_TPU_PROCESS_BOUNDS not in env
+    assert any("cannot fill" in r.message for r in caplog.records)
+
+
+# -- serve engine shutdown drain (satellite) ---------------------------------
+
+def test_serve_frontend_rejects_requests_after_stop():
+    from tpushare.workloads.serve import _EngineFrontend
+
+    class IdleEngine:
+        free_slots = 0
+        resident = ()
+
+    fe = _EngineFrontend(IdleEngine())
+    fe.start()
+    fe.stop()
+    fe.join(5)
+    # a late generate_many fails fast with the shutdown error instead of
+    # parking until the client timeout
+    t0 = time.time()
+    with pytest.raises(ValueError, match="shutting down"):
+        fe.generate_many([[1, 2]], max_new=4, timeout=30)
+    assert time.time() - t0 < 5
+    with pytest.raises(ValueError, match="shutting down"):
+        list(fe.generate_stream([1, 2], max_new=4, timeout=30))

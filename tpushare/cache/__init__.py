@@ -14,8 +14,9 @@ from tpushare.cache.chipusage import ChipUsage
 from tpushare.cache.nodeinfo import (
     AllocationError, AlreadyBoundError, BindInFlightError,
     ClaimConflictError, NodeInfo)
-from tpushare.cache.cache import SchedulerCache
+from tpushare.cache.cache import (
+    MEMO_REQUESTS, SchedulerCache, memo_hit_rate)
 
 __all__ = ["ChipUsage", "NodeInfo", "AllocationError", "AlreadyBoundError",
            "BindInFlightError", "ClaimConflictError",
-           "SchedulerCache"]
+           "SchedulerCache", "MEMO_REQUESTS", "memo_hit_rate"]

@@ -5,6 +5,19 @@ a known-pods UID set, lock-guarded; `build_cache` replays assigned tpushare
 pods from their annotations at startup so a crashed/restarted extender
 reconstructs exact chip assignments from the apiserver (cache.go:49-74 — the
 annotations are the durable write-ahead state, SURVEY §5.3b/§5.4).
+
+Two read-path additions keep the apiserver out of the scheduling loop:
+
+- ``get_node_info``'s lazy node fetch reads a watch-warmed
+  :class:`~tpushare.k8s.informer.NodeLister` first (apiserver GET only on
+  a miss, coalesced through singleflight so a gang storm issues one GET
+  per node, not one per member);
+- a generation-stamped **placement memo**: Filter's fleet-wide native
+  scoring pass is memoized per (pod, cache generation), so Prioritize
+  reuses it verbatim and Bind seeds its chip selection from the
+  memoized best placement. Any allocation, release, or node change bumps
+  the generation (NodeInfo._dirty -> on_dirty) and invalidates every
+  entry — the memo can serve stale data for at most zero mutations.
 """
 
 from __future__ import annotations
@@ -14,22 +27,91 @@ import threading
 from typing import Any
 
 from tpushare import contract
-from tpushare.cache.nodeinfo import NodeInfo
+from tpushare.cache.nodeinfo import NodeInfo, request_from_pod
 from tpushare.contract import node as nodelib
 from tpushare.contract import pod as podlib
+from tpushare.core.placement import Placement, PlacementRequest
 from tpushare.k8s.client import ApiError
+from tpushare.k8s.informer import lookup as lister_lookup
+from tpushare.k8s.singleflight import Singleflight
+from tpushare.metrics import LabeledCounter
 
 log = logging.getLogger("tpushare.cache")
 
+# process-wide (the CLAIM_CAS_RETRIES pattern): op=score is the Filter->
+# Prioritize reuse of the fleet scoring pass, op=seed is Bind consuming
+# the pre-computed best placement. Registered by register_cache_gauges.
+MEMO_REQUESTS = LabeledCounter(
+    "tpushare_placement_memo_total",
+    "Placement-memo lookups by operation and outcome (a miss re-runs "
+    "the native fleet scan / chip selection)",
+    ("op", "outcome"))
+
+
+def memo_hit_rate() -> float | None:
+    """Fraction of score lookups served from the memo (None = none)."""
+    hits = MEMO_REQUESTS.get("score", "hit")
+    misses = MEMO_REQUESTS.get("score", "miss")
+    if hits + misses == 0:
+        return None
+    return hits / (hits + misses)
+
+
+class _MemoEntry:
+    __slots__ = ("generation", "req_sig", "scores", "errors",
+                 "placement_node", "placement")
+
+    def __init__(self, generation: int, req_sig: tuple) -> None:
+        self.generation = generation
+        self.req_sig = req_sig
+        self.scores: dict[str, int | None] = {}
+        self.errors: dict[str, str] = {}
+        self.placement_node: str | None = None
+        self.placement: Placement | None = None
+
+
+def _req_sig(req: PlacementRequest) -> tuple:
+    return (req.hbm_mib, req.chip_count, req.topology, req.allow_scatter)
+
 
 class SchedulerCache:
-    def __init__(self, cluster) -> None:
+    # memo entries are per PENDING pod within one cache generation; the
+    # cap only matters if thousands of pods filter without ever binding
+    MEMO_CAP = 4096
+
+    def __init__(self, cluster, node_lister=None) -> None:
         self._cluster = cluster
         self._lock = threading.RLock()
         self._nodes: dict[str, NodeInfo] = {}
         self._known_pods: dict[str, dict[str, Any]] = {}  # uid -> pod object
+        # read path: watch-warmed node store + GET coalescing (see module
+        # docstring); None = every lazy node fetch GETs the apiserver
+        self._node_lister = node_lister
+        self._sf = Singleflight()
+        # placement memo (see module docstring). generation is read
+        # without the lock (a torn read just causes one extra recompute).
+        self.generation = 0
+        self._gen_lock = threading.Lock()
+        self._memo: dict[str, _MemoEntry] = {}
+        self._memo_lock = threading.Lock()
+
+    def _bump_generation(self) -> None:
+        """Wired as NodeInfo.on_dirty: ANY mutation of per-chip state —
+        allocate/confirm/release, pod add/remove, capacity rebuild,
+        health flips — invalidates every memoized placement decision."""
+        with self._gen_lock:
+            self.generation += 1
 
     # -- node access ----------------------------------------------------------
+
+    def _fetch_node(self, node_name: str) -> dict[str, Any]:
+        node = lister_lookup(self._node_lister, "nodes", node_name)
+        if node is not None:
+            return node
+        # miss: real GET, coalesced — a gang's N members faulting the
+        # same node in concurrently issue ONE apiserver round-trip
+        return self._sf.do(f"get_node/{node_name}",
+                           lambda: self._cluster.get_node(node_name))
 
     def get_node_info(self, node_name: str) -> NodeInfo:
         """Fetch-or-create the NodeInfo (reference GetNodeInfo,
@@ -38,15 +120,19 @@ class SchedulerCache:
             info = self._nodes.get(node_name)
         if info is not None:
             return info
-        node = self._cluster.get_node(node_name)  # may raise ApiError(404)
+        node = self._fetch_node(node_name)  # may raise ApiError(404)
         with self._lock:
             # double-checked: another thread may have built it meanwhile
             info = self._nodes.get(node_name)
             if info is None:
                 info = NodeInfo(node)
+                info.on_dirty = self._bump_generation
                 self._nodes[node_name] = info
                 log.debug("cache: created NodeInfo %s (%d chips x %d MiB)",
                           node_name, info.chip_count, info.hbm_per_chip)
+        # no generation bump: a newly-tracked node changes no existing
+        # node's scores — memo entries simply don't cover it yet, and
+        # score_nodes computes uncovered names on demand
         return info
 
     def update_node(self, node: dict[str, Any]) -> None:
@@ -63,11 +149,141 @@ class SchedulerCache:
 
     def remove_node(self, node_name: str) -> None:
         with self._lock:
-            self._nodes.pop(node_name, None)
+            removed = self._nodes.pop(node_name, None)
+        if removed is not None:
+            self._bump_generation()  # memoized scores may name the ghost
 
     def node_names(self) -> list[str]:
         with self._lock:
             return list(self._nodes)
+
+    # -- placement memo -------------------------------------------------------
+
+    def score_nodes(self, pod: dict[str, Any], req: PlacementRequest,
+                    node_names: list[str]
+                    ) -> tuple[dict[str, int | None], dict[str, str]]:
+        """Fleet scores for ``pod`` over ``node_names``, memoized per
+        (pod, cache generation, request signature).
+
+        Returns ``(scores, errors)``: ``scores[name]`` is the native
+        engine's best binpack score (lower = tighter; None = no
+        placement); ``errors[name]`` carries the reason a node could not
+        be evaluated at all (apiserver failure, not a TPU node). Filter
+        derives its pass/fail verdict and Prioritize its ranking from the
+        SAME entry, so the second webhook of a scheduling cycle runs zero
+        native scans — and any intervening allocate/release/node change
+        bumps the generation and forces a recompute.
+        """
+        from tpushare.core.native import engine as native_engine
+
+        key = podlib.pod_cache_key(pod)
+        gen = self.generation
+        sig = _req_sig(req)
+        with self._memo_lock:
+            entry = self._memo.get(key)
+            if entry is not None and (entry.generation != gen
+                                      or entry.req_sig != sig):
+                self._memo.pop(key, None)
+                entry = None
+            covered = entry is not None and all(
+                n in entry.scores or n in entry.errors
+                for n in node_names)
+            if covered:
+                MEMO_REQUESTS.inc("score", "hit")
+                return ({n: entry.scores[n] for n in node_names
+                         if n in entry.scores},
+                        {n: entry.errors[n] for n in node_names
+                         if n in entry.errors})
+            missing = [n for n in node_names
+                       if entry is None or (n not in entry.scores
+                                            and n not in entry.errors)]
+        MEMO_REQUESTS.inc("score", "miss")
+        scores: dict[str, int | None] = {}
+        errors: dict[str, str] = {}
+        known: list[str] = []
+        snapshots = []
+        for name in missing:
+            try:
+                info = self.get_node_info(name)
+            except ApiError as e:
+                errors[name] = f"node unavailable: {e}"
+                continue
+            if info.chip_count <= 0:
+                errors[name] = "not a TPU-share node"
+                continue
+            known.append(name)
+            snapshots.append((info.snapshot(), info.topology))
+        for name, score in zip(known,
+                               native_engine.score_fleet(snapshots, req)):
+            scores[name] = score
+        with self._memo_lock:
+            entry = self._memo.get(key)
+            if entry is None or entry.generation != gen \
+                    or entry.req_sig != sig:
+                if len(self._memo) >= self.MEMO_CAP:
+                    self._memo.pop(next(iter(self._memo)))
+                entry = _MemoEntry(gen, sig)
+                self._memo[key] = entry
+            entry.scores.update(scores)
+            entry.errors.update(errors)
+            return ({n: entry.scores[n] for n in node_names
+                     if n in entry.scores},
+                    {n: entry.errors[n] for n in node_names
+                     if n in entry.errors})
+
+    def memo_best_placement(self, pod: dict[str, Any],
+                            req: PlacementRequest, node_name: str) -> None:
+        """Pre-compute the chip selection Bind will need on ``node_name``
+        (Prioritize calls this for its top-ranked node, which is almost
+        always the scheduler's eventual choice). Stored under the same
+        generation stamp as the scores — NodeInfo.allocate re-validates
+        the chips under its own lock before trusting the seed, so a
+        generation race costs a recompute, never a bad placement."""
+        from tpushare.core.placement import select_chips
+
+        try:
+            info = self.get_node_info(node_name)
+        except ApiError:
+            return
+        gen = self.generation
+        placement = select_chips(info.snapshot(), info.topology, req)
+        if placement is None:
+            return
+        key = podlib.pod_cache_key(pod)
+        sig = _req_sig(req)
+        with self._memo_lock:
+            entry = self._memo.get(key)
+            if entry is None or entry.generation != gen \
+                    or entry.req_sig != sig:
+                return  # scores were invalidated meanwhile; don't seed
+            entry.placement_node = node_name
+            entry.placement = placement
+
+    def placement_hint(self, pod: dict[str, Any],
+                       node_name: str) -> Placement | None:
+        """The memoized best placement for Bind to seed allocate with,
+        or None when the memo is cold/stale/for a different node."""
+        req = request_from_pod(pod)
+        if req is None:
+            return None
+        key = podlib.pod_cache_key(pod)
+        gen = self.generation
+        with self._memo_lock:
+            entry = self._memo.get(key)
+            if entry is None or entry.generation != gen \
+                    or entry.req_sig != _req_sig(req) \
+                    or entry.placement_node != node_name \
+                    or entry.placement is None:
+                MEMO_REQUESTS.inc("seed", "miss")
+                return None
+            MEMO_REQUESTS.inc("seed", "hit")
+            return entry.placement
+
+    def forget_memo(self, pod: dict[str, Any]) -> None:
+        """Drop a bound/terminated pod's memo entry (the generation bump
+        already invalidated it; this just frees the slot)."""
+        with self._memo_lock:
+            self._memo.pop(podlib.pod_cache_key(pod), None)
 
     # -- pod lifecycle --------------------------------------------------------
 
@@ -128,7 +344,9 @@ class SchedulerCache:
                 name = nodelib.node_name(node)
                 with self._lock:
                     if name not in self._nodes:
-                        self._nodes[name] = NodeInfo(node)
+                        info = NodeInfo(node)
+                        info.on_dirty = self._bump_generation
+                        self._nodes[name] = info
         replayed = 0
         for pod in (self._cluster.list_pods() if pods is None else pods):
             if not contract.is_tpushare_pod(pod):

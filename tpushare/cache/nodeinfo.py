@@ -137,11 +137,17 @@ class NodeInfo:
         self._version = 0
         self._snap_version = -1
         self._snap: list[ChipView] = []
+        # SchedulerCache wires this to its generation bump so ANY chip
+        # mutation invalidates the cross-verb placement memo
+        self.on_dirty: Callable[[], None] | None = None
         self._init_chips(node)
 
     def _dirty(self) -> None:
         """Caller holds self._lock."""
         self._version += 1
+        cb = self.on_dirty
+        if cb is not None:
+            cb()
 
     def _init_chips(self, node: dict[str, Any]) -> None:
         # slice membership (multi-host gang placement): which ICI domain
@@ -263,12 +269,32 @@ class NodeInfo:
                 chosen = trial
         return chosen
 
+    def _hint_valid(self, hint: Placement, req: PlacementRequest,
+                    demand: int) -> bool:
+        """Caller holds self._lock. A memoized placement is trusted only
+        if every chip it names still exists, is healthy, and can hold the
+        demand RIGHT NOW — the same admission reserve_planned applies to
+        gang shares. Anything less re-runs the search."""
+        if len(hint.chip_ids) != req.chip_count:
+            return False
+        for cid in hint.chip_ids:
+            if not (0 <= cid < len(self.chips)) or cid in self._unhealthy:
+                return False
+            c = self.chips[cid]
+            free = c.total_hbm_mib - c.used_hbm_mib
+            if free < demand:
+                return False
+            if req.hbm_mib == 0 and c.used_hbm_mib > 0:
+                return False  # exclusive chips must be completely free
+        return True
+
     def allocate(
         self,
         pod: dict[str, Any],
         cluster,
         now_ns: Callable[[], int] = time.time_ns,
         ha_claims: bool = False,
+        hint: Placement | None = None,
     ) -> Placement:
         """Bind-path: select chips, reserve, patch annotations, bind, confirm.
 
@@ -276,6 +302,10 @@ class NodeInfo:
         that serializes same-node placements across extender REPLICAS; the
         in-process lock + reservations already make a single replica safe,
         so single-replica deployments skip its two apiserver round-trips.
+
+        ``hint`` is the memoized best placement from the Prioritize pass
+        (SchedulerCache.placement_hint): validated under the lock and used
+        verbatim when still admissible, skipping the chip search.
 
         Raises AllocationError when no placement exists or the apiserver
         writes fail (after rolling back the reservation).
@@ -303,9 +333,13 @@ class NodeInfo:
                 raise BindInFlightError(
                     f"bind already in flight for {podlib.pod_key(pod)} "
                     f"on {self.name}")
-            views = [c.view(healthy=c.idx not in self._unhealthy)
-                     for c in self.chips]
-            placement = select_chips(views, self.topology, req)
+            if hint is not None and self._hint_valid(
+                    hint, req, req.chip_demand_mib(self.hbm_per_chip)):
+                placement = hint
+            else:
+                views = [c.view(healthy=c.idx not in self._unhealthy)
+                         for c in self.chips]
+                placement = select_chips(views, self.topology, req)
             if placement is None:
                 raise AllocationError(
                     f"no placement for {podlib.pod_key(pod)} on {self.name}")

@@ -75,6 +75,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="out-of-cluster kubeconfig path (default: "
                          "$KUBECONFIG, else in-cluster SA)")
     ap.add_argument("--health-interval", type=float, default=30.0)
+    ap.add_argument("--no-informer", action="store_true",
+                    help="skip the watch-driven pod/node listers and LIST "
+                         "the apiserver on every Allocate (debug only)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
@@ -107,10 +110,24 @@ def main(argv: list[str] | None = None) -> int:
         else:
             cluster = InClusterClient.autodetect(kubeconfig=args.kubeconfig)
 
-    plugin = DevicePlugin(cluster, args.node_name, enumerator,
-                          unit_mib=args.hbm_unit,
-                          slice_id=args.slice_id,
-                          slice_origin=args.slice_origin)
+    # per-verb apiserver round-trip accounting + watch-warmed listers:
+    # the Allocate hot path (rendezvous scan, gang peer/geometry reads)
+    # is served from local indexes, with singleflight-coalesced
+    # apiserver fallbacks only on watch-lag misses
+    from tpushare.k8s.informer import Informer
+    from tpushare.k8s.stats import CountingCluster
+    cluster = CountingCluster(cluster)
+    informer = None
+    if not args.no_informer:
+        informer = Informer(cluster).start()
+
+    plugin = DevicePlugin(
+        cluster, args.node_name, enumerator,
+        unit_mib=args.hbm_unit,
+        slice_id=args.slice_id,
+        slice_origin=args.slice_origin,
+        pod_lister=informer.pods if informer is not None else None,
+        node_lister=informer.nodes if informer is not None else None)
     plugin.register_node()
 
     debug_server = None
@@ -147,6 +164,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if debug_server is not None:
         debug_server.stop()
+    if informer is not None:
+        informer.stop()
     return 0
 
 

@@ -42,6 +42,8 @@ from tpushare.contract.constants import (
     UNHEALTHY_CM_PREFIX,
 )
 from tpushare.k8s.client import ApiError
+from tpushare.k8s.informer import LISTER_REQUESTS
+from tpushare.k8s.singleflight import Singleflight
 
 log = logging.getLogger("tpushare.deviceplugin")
 
@@ -141,10 +143,20 @@ class DevicePlugin:
     def __init__(self, cluster, node_name: str, enumerator,
                  unit_mib: int | str = 1,
                  slice_id: str | None = None,
-                 slice_origin: str | None = None) -> None:
+                 slice_origin: str | None = None,
+                 pod_lister=None, node_lister=None) -> None:
         self._cluster = cluster
         self.node_name = node_name
         self._enumerator = enumerator
+        # watch-warmed local stores (k8s/informer.py, already start()ed
+        # by the caller): the Allocate hot path reads these instead of
+        # LISTing the apiserver, falling back only when a rendezvous
+        # misses (watch lag behind a just-stamped placement). The
+        # fallback LIST/GETs are singleflight-coalesced so a gang storm
+        # (N members allocating at once) issues one round-trip, not N.
+        self._pod_lister = pod_lister
+        self._node_lister = node_lister
+        self._sf = Singleflight()
         # multi-host slice membership (docs/designs/multihost-gang.md):
         # operator-configured (TPU runtime metadata / install flags) —
         # published as node labels so the extender's gang coordinator
@@ -277,11 +289,21 @@ class DevicePlugin:
                                 podlib.pod_uid(p)))
         return out
 
-    def _list_node_pods(self) -> list[dict[str, Any]]:
-        """One node-scoped LIST (apiserver fieldSelector where supported):
-        the Allocate hot path must not transfer the whole cluster's pods."""
+    def _list_node_pods(self, force_apiserver: bool = False
+                        ) -> list[dict[str, Any]]:
+        """This node's pods: lister read when an informer is wired (zero
+        round-trips), else one node-scoped LIST (apiserver fieldSelector
+        where supported — the Allocate hot path must not transfer the
+        whole cluster's pods), singleflight-coalesced across concurrent
+        Allocates. ``force_apiserver`` is the rendezvous-miss fallback:
+        re-snapshot past any watch lag before failing a container start."""
+        if self._pod_lister is not None and not force_apiserver:
+            LISTER_REQUESTS.inc("pods", "hit")
+            return self._pod_lister.on_node(self.node_name)
         try:
-            return self._cluster.list_pods(node_name=self.node_name)
+            return self._sf.do(
+                f"list_pods_node/{self.node_name}",
+                lambda: self._cluster.list_pods(node_name=self.node_name))
         except TypeError:  # older/simpler client without the selector
             return self._cluster.list_pods()
 
@@ -389,8 +411,25 @@ class DevicePlugin:
         pod and the amount heuristic is skipped entirely — this is what
         makes same-size rendezvous deterministic at the device level.
         """
-        snapshot = self._list_node_pods()  # one LIST serves all passes
+        try:
+            return self._allocate_from(self._list_node_pods(),
+                                       hbm_mib, pod_uid, device_ids)
+        except AllocateError:
+            if self._pod_lister is None:
+                raise
+            # lister-served miss: the placement the scheduler just
+            # stamped may not have reached the watch stream yet — one
+            # real LIST re-grounds the snapshot before failing the
+            # container start
+            LISTER_REQUESTS.inc("pods", "miss")
+            return self._allocate_from(
+                self._list_node_pods(force_apiserver=True),
+                hbm_mib, pod_uid, device_ids)
 
+    def _allocate_from(self, snapshot: list[dict[str, Any]],
+                       hbm_mib: int | None, pod_uid: str | None,
+                       device_ids: list[str] | None) -> dict[str, Any]:
+        """One matching pass of :meth:`allocate` over ``snapshot``."""
         if pod_uid is None and device_ids:
             granted = set(device_ids)
             exact = [pod for pod, r in self.placement_unit_ranges(snapshot)
@@ -442,7 +481,19 @@ class DevicePlugin:
         4. otherwise raise, so a genuinely unmatched exclusive container
            fails container start instead of silently running without TPUs.
         """
-        snapshot = self._list_node_pods()  # one LIST serves all passes
+        try:
+            return self._allocate_exclusive_from(self._list_node_pods(),
+                                                 count)
+        except AllocateError:
+            if self._pod_lister is None:
+                raise
+            LISTER_REQUESTS.inc("pods", "miss")  # watch lag; see allocate
+            return self._allocate_exclusive_from(
+                self._list_node_pods(force_apiserver=True), count)
+
+    def _allocate_exclusive_from(self, snapshot: list[dict[str, Any]],
+                                 count: int) -> dict[str, Any] | None:
+        """One matching pass of :meth:`allocate_exclusive`."""
         pending = self.pending_pods(snapshot)
         assigned = self.assigned_pods(snapshot)
 
@@ -530,6 +581,48 @@ class DevicePlugin:
             "env": env,
         }
 
+    def _gang_peers(self, ns: str, gid: str) -> list[dict[str, Any]]:
+        """One namespace-scoped view of a gang's live pods.
+
+        Scoped to the chosen pod's namespace BY CONSTRUCTION — two gangs
+        that reuse an id across namespaces can never contaminate each
+        other's plan or address discovery. Lister read when an informer
+        is wired (its gang index is (namespace, gang-id)-keyed); else a
+        single namespace-scoped LIST, singleflight-coalesced so all N
+        members of a gang storm share one apiserver round-trip.
+        """
+        if self._pod_lister is not None:
+            LISTER_REQUESTS.inc("pods", "hit")
+            peers = self._pod_lister.gang_peers(ns, gid)
+            return [p for p in peers if not contract.is_complete_pod(p)]
+        try:
+            try:
+                pods = self._sf.do(
+                    f"gang_peers/{ns}/{gid}",
+                    lambda: self._cluster.list_pods(namespace=ns))
+            except TypeError:  # client without namespace scoping
+                pods = self._sf.do("gang_peers/all",
+                                   lambda: self._cluster.list_pods())
+        except ApiError:
+            return []
+        return [p for p in pods
+                if podlib.pod_namespace(p) == ns
+                and podlib.annotations(p).get(contract.ANN_GANG) == gid
+                and not contract.is_complete_pod(p)]
+
+    def _get_node(self, name: str) -> dict[str, Any]:
+        """Node read for gang geometry: lister first, singleflight-
+        coalesced GET on a miss (the slice labels it reads are stable, so
+        a watch-warmed copy is always current enough)."""
+        if self._node_lister is not None:
+            node = self._node_lister.get(name)
+            LISTER_REQUESTS.inc("nodes",
+                                "hit" if node is not None else "miss")
+            if node is not None:
+                return node
+        return self._sf.do(f"get_node/{name}",
+                           lambda: self._cluster.get_node(name))
+
     def _gang_env(self, chosen) -> dict[str, str]:
         """The runtime half of a gang (VERDICT r4 item 4): derive the
         member's mesh-formation env from the plan the bind stamped
@@ -566,18 +659,14 @@ class DevicePlugin:
                contract.ENV_GANG_SIZE: str(size),
                contract.ENV_CLOUD_TPU_TASK_ID: str(rank),
                contract.ENV_PROCESS_ID: str(rank)}
+        ns = podlib.pod_namespace(chosen)
         plan = contract.gang_plan_from_annotations(chosen)
         peers: list | None = None
         if plan is None:
             # only the FIRST bound member carries the stamp; everyone
             # else reads it off a live peer (same source of truth the
             # coordinator's own recovery uses, cache/gang.py)
-            try:
-                peers = [p for p in self._cluster.list_pods()
-                         if podlib.annotations(p).get(contract.ANN_GANG)
-                         == gid and not contract.is_complete_pod(p)]
-            except ApiError:
-                peers = []
+            peers = self._gang_peers(ns, gid)
             for p in peers:
                 plan = contract.gang_plan_from_annotations(p)
                 if plan is not None:
@@ -622,7 +711,7 @@ class DevicePlugin:
         gang_coords: list[tuple[int, ...]] | None = []
         for h, _b, o in members:
             try:
-                node = self._cluster.get_node(h)
+                node = self._get_node(h)
             except ApiError:
                 gang_coords = None
                 break
@@ -659,28 +748,54 @@ class DevicePlugin:
                     "gang %s: member rank order is not row-major over "
                     "the process grid; omitting the %s pair", gid,
                     contract.ENV_TPU_PROCESS_BOUNDS)
+            else:
+                # same silent-degradation hazard as the non-row-major
+                # case: say WHY libtpu won't get its topology hints
+                log.warning(
+                    "gang %s: %d members cannot fill the %d-process "
+                    "grid the box/local-box ratio implies; omitting "
+                    "the %s pair", gid, len(members), n,
+                    contract.ENV_TPU_PROCESS_BOUNDS)
         # rank -> address, from each member pod's hostname.subdomain
         # (the stable-DNS convention samples/6-gang.yaml demonstrates)
         if peers is None:
-            try:
-                peers = [p for p in self._cluster.list_pods()
-                         if podlib.annotations(p).get(contract.ANN_GANG)
-                         == gid and not contract.is_complete_pod(p)]
-            except ApiError:
-                peers = []
-        addr: dict[int, str] = {}
+            peers = self._gang_peers(ns, gid)
+        by_rank: dict[int, list[dict[str, Any]]] = {}
+        seen_uids: set[str] = set()
         for p in peers + [chosen]:
+            uid = podlib.pod_uid(p)
+            if uid and uid in seen_uids:
+                continue  # chosen usually appears in peers too
+            seen_uids.add(uid)
             try:
                 m = contract.gang_membership(p)
             except ValueError:
                 continue
             if m is None or m[0] != gid:
                 continue
+            by_rank.setdefault(m[2], []).append(p)
+        addr: dict[int, str] = {}
+        for r, claimants in by_rank.items():
+            if len(claimants) > 1:
+                # duplicate ranks: a Terminating predecessor from a
+                # restarted gang can linger beside its replacement.
+                # Trust the pod sitting on the host the stamped plan
+                # assigned this rank; among equals, the newest wins.
+                want = members[r][0] if 0 <= r < len(members) else None
+                claimants.sort(key=lambda p: (
+                    podlib.pod_node_name(p) == want,
+                    (p.get("metadata") or {})
+                    .get("creationTimestamp") or ""), reverse=True)
+                log.warning(
+                    "gang %s: %d pods claim rank %d; using %s "
+                    "(plan-host/newest preference)", gid,
+                    len(claimants), r, podlib.pod_key(claimants[0]))
+            p = claimants[0]
             spec = p.get("spec") or {}
             hn, sd = spec.get("hostname"), spec.get("subdomain")
             if hn and sd:
-                addr[m[2]] = (f"{hn}.{sd}:"
-                              f"{contract.GANG_COORDINATOR_PORT}")
+                addr[r] = (f"{hn}.{sd}:"
+                           f"{contract.GANG_COORDINATOR_PORT}")
         if 0 in addr:
             env[contract.ENV_COORDINATOR_ADDRESS] = addr[0]
         if set(addr) >= set(range(len(members))):

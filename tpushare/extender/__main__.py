@@ -91,7 +91,17 @@ def main(argv: list[str] | None = None) -> int:
             cluster = InClusterClient.autodetect(kubeconfig=args.kubeconfig)
 
     # (native engine warmup happens inside ExtenderServer start/serve)
-    cache = SchedulerCache(cluster)
+    # every apiserver round-trip is counted per (verb, origin) — the
+    # tpushare_apiserver_requests_total series on /metrics is how an
+    # operator verifies the hot path stays off the apiserver
+    from tpushare.k8s.stats import CountingCluster
+    cluster = CountingCluster(cluster)
+    # read-path informer: watch-warmed pod/node listers serve Bind's pod
+    # fetch and the cache's lazy node fetch, so the scheduling hot path
+    # issues no synchronous apiserver reads (fallback on miss only)
+    from tpushare.k8s.informer import Informer
+    informer = Informer(cluster).start()
+    cache = SchedulerCache(cluster, node_lister=informer.nodes)
     controller = Controller(cluster, cache, workers=args.workers)
     replayed = controller.build_cache()
     log.info("cache built: %d pods replayed", replayed)
@@ -126,7 +136,7 @@ def main(argv: list[str] | None = None) -> int:
     server = ExtenderServer(cache, cluster, registry,
                             host=args.host, port=args.port,
                             allow_debug_seed=bool(args.fake_nodes),
-                            elector=elector)
+                            elector=elector, informer=informer)
     register_cache_gauges(registry, cache)
     # abandoned-gang expiry rides the controller's 30 s anti-entropy
     # heartbeat (docs/designs/multihost-gang.md protocol step 5)
@@ -150,6 +160,7 @@ def main(argv: list[str] | None = None) -> int:
     if elector is not None:
         elector.stop()
     controller.stop()
+    informer.stop()
     return 0
 
 

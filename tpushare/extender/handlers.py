@@ -23,11 +23,13 @@ from tpushare.cache import (
     AllocationError, AlreadyBoundError, BindInFlightError,
     ClaimConflictError, SchedulerCache)
 from tpushare.cache.nodeinfo import no_fit_reason, request_from_pod
-from tpushare.core.native import engine as native_engine
 from tpushare.contract import pod as podlib
 from tpushare.core.placement import fragmentation, utilization_pct
 from tpushare.extender.metrics import LATENCY_BUCKETS, Registry
 from tpushare.k8s.client import ApiError
+from tpushare.k8s.informer import LISTER_REQUESTS
+from tpushare.k8s.singleflight import Singleflight
+from tpushare.k8s.stats import api_origin
 
 log = logging.getLogger("tpushare.extender")
 
@@ -46,6 +48,10 @@ class FilterHandler:
             "tpushare_filter_seconds", "Filter latency", LATENCY_BUCKETS)
 
     def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+        with api_origin("filter"):
+            return self._handle(args)
+
+    def _handle(self, args: dict[str, Any]) -> dict[str, Any]:
         t0 = time.perf_counter()
         self._filter_total.inc()
         pod = args.get("Pod") or {}
@@ -79,31 +85,26 @@ class FilterHandler:
         ok_nodes: list[str] = []
         failed: dict[str, str] = {}
         req = request_from_pod(pod)
-        candidates: list[tuple[str, Any]] = []  # (name, NodeInfo)
-        for name in node_names:
-            if not name:
-                continue
-            try:
-                info = self._cache.get_node_info(name)
-            except ApiError as e:
-                failed[name] = f"node unavailable: {e}"
-                continue
-            if req is not None and info.chip_count <= 0:
-                failed[name] = "not a TPU-share node"
-                continue
-            candidates.append((name, info))
+        node_names = [n for n in node_names if n]
         if req is None:
             # not a tpushare pod: nothing to check (handler shouldn't even
             # be consulted thanks to managedResources, but be permissive)
-            ok_nodes.extend(name for name, _ in candidates)
+            for name in node_names:
+                try:
+                    self._cache.get_node_info(name)
+                except ApiError as e:
+                    failed[name] = f"node unavailable: {e}"
+                    continue
+                ok_nodes.append(name)
         else:
-            # one native call evaluates the whole fleet (hot loops #1+#2
-            # of SURVEY §3.2 fused; flat wrt node count)
-            snapshots = [(info.snapshot(), info.topology)
-                         for _, info in candidates]
-            mask = native_engine.fits_fleet(snapshots, req)
-            for (name, _), ok in zip(candidates, mask):
-                if ok:
+            # one memoized native call evaluates the whole fleet (hot
+            # loops #1+#2 of SURVEY §3.2 fused; flat wrt node count) —
+            # Prioritize and Bind reuse this exact pass via the memo
+            scores, errors = self._cache.score_nodes(pod, req, node_names)
+            for name in node_names:
+                if name in errors:
+                    failed[name] = errors[name]
+                elif scores.get(name) is not None:
                     ok_nodes.append(name)
                 else:
                     failed[name] = no_fit_reason(req, name)
@@ -142,6 +143,10 @@ class PrioritizeHandler:
             LATENCY_BUCKETS)
 
     def handle(self, args: dict[str, Any]) -> list[dict[str, Any]]:
+        with api_origin("prioritize"):
+            return self._handle(args)
+
+    def _handle(self, args: dict[str, Any]) -> list[dict[str, Any]]:
         t0 = time.perf_counter()
         self._prioritize_total.inc()
         pod = args.get("Pod") or {}
@@ -154,24 +159,16 @@ class PrioritizeHandler:
         req = request_from_pod(pod)
         raw: dict[str, int | None] = {}  # name -> leftover score (lower=tighter)
         if req is not None:
-            known: list[str] = []
-            snapshots = []
+            # the memoized fleet pass: when Filter just ran for this pod
+            # (the normal webhook sequence), this is a pure dict read —
+            # zero native scans, zero snapshot assembly
+            scores, errors = self._cache.score_nodes(pod, req, node_names)
             for name in node_names:
-                try:
-                    info = self._cache.get_node_info(name)
-                except ApiError:
-                    raw[name] = None
-                    continue
-                known.append(name)
-                snapshots.append((info.snapshot(), info.topology))
-            # one native call scores the whole candidate set (the ranking
-            # analogue of Filter's fused fleet scan)
-            for name, score in zip(known,
-                                   native_engine.score_fleet(snapshots, req)):
-                raw[name] = score
+                raw[name] = None if name in errors else scores.get(name)
         fitting = [s for s in raw.values() if s is not None]
         lo, hi = (min(fitting), max(fitting)) if fitting else (0, 0)
         out = []
+        best_name: str | None = None
         for name in node_names:
             s = raw.get(name)
             if req is None:
@@ -183,7 +180,16 @@ class PrioritizeHandler:
             else:
                 # tightest (lowest leftover) -> 10, loosest -> 0
                 score = round(self.MAX_PRIORITY * (hi - s) / (hi - lo))
+            if s is not None and best_name is None:
+                best_name = name  # ties resolve to the first, like max()
+            elif s is not None and s < raw[best_name]:  # type: ignore[index]
+                best_name = name
             out.append({"Host": name, "Score": score})
+        if req is not None and best_name is not None:
+            # pre-compute the chip selection for the top-ranked node: the
+            # scheduler's weighted choice almost always lands there, and
+            # Bind then seeds allocate from this instead of re-searching
+            self._cache.memo_best_placement(pod, req, best_name)
         self._prioritize_latency.observe(time.perf_counter() - t0)
         return out
 
@@ -304,6 +310,10 @@ class PreemptHandler:
         return True
 
     def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+        with api_origin("preempt"):
+            return self._handle(args)
+
+    def _handle(self, args: dict[str, Any]) -> dict[str, Any]:
         t0 = time.perf_counter()
         self._preempt_total.inc()
         pod = args.get("Pod") or {}
@@ -353,11 +363,17 @@ class BindHandler:
 
     def __init__(self, cache: SchedulerCache, cluster,
                  registry: Registry, ha_claims: bool = False,
-                 gang=None) -> None:
+                 gang=None, pod_lister=None) -> None:
         self._cache = cache
         self._cluster = cluster
         self._ha_claims = ha_claims
         self._gang = gang  # GangCoordinator | None
+        # watch-warmed pod store (k8s/informer.py): bind-path pod reads
+        # are answered locally, with the apiserver GET kept only as the
+        # miss/UID-mismatch fallback — coalesced so duplicate deliveries
+        # of the same bind share one round-trip
+        self._pod_lister = pod_lister
+        self._sf = Singleflight()
         self.bind_total = registry.counter(
             "tpushare_bind_requests_total", "Bind webhook calls")
         self.bind_failures = registry.counter(
@@ -373,6 +389,10 @@ class BindHandler:
             "the same nodes)")
 
     def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+        with api_origin("bind"):
+            return self._handle(args)
+
+    def _handle(self, args: dict[str, Any]) -> dict[str, Any]:
         t0 = time.perf_counter()
         self.bind_total.inc()
         ns = args.get("PodNamespace", "default")
@@ -397,8 +417,10 @@ class BindHandler:
                     pod, node, self._cluster, ha_claims=self._ha_claims)
             else:
                 info = self._cache.get_node_info(node)
-                placement = info.allocate(pod, self._cluster,
-                                          ha_claims=self._ha_claims)
+                placement = info.allocate(
+                    pod, self._cluster, ha_claims=self._ha_claims,
+                    hint=self._cache.placement_hint(pod, node))
+            self._cache.forget_memo(pod)
         except AlreadyBoundError as e:
             err = e
             bound_node = podlib.pod_node_name(pod)
@@ -480,10 +502,19 @@ class BindHandler:
             log.debug("event emit failed for %s/%s: %s", ns, name, e)
 
     def _get_pod(self, ns: str, name: str, uid: str) -> dict[str, Any]:
-        """Fetch with UID recheck (reference getPod, gpushare-bind.go:45-70:
-        lister first, apiserver fallback, UID-mismatch refetch — here the
-        apiserver read doubles as both)."""
-        pod = self._cluster.get_pod(ns, name)
+        """Fetch with UID recheck (reference getPod, gpushare-bind.go:45-70):
+        lister first; apiserver GET only on a miss or when the lister's
+        copy carries a different UID (watch lag across a delete/recreate).
+        The fallback is singleflight-coalesced, so a retry storm for one
+        pod costs one round-trip."""
+        if self._pod_lister is not None:
+            pod = self._pod_lister.get(ns, name)
+            if pod is not None and (not uid or podlib.pod_uid(pod) == uid):
+                LISTER_REQUESTS.inc("pods", "hit")
+                return pod
+            LISTER_REQUESTS.inc("pods", "miss")
+        pod = self._sf.do(f"get_pod/{ns}/{name}",
+                          lambda: self._cluster.get_pod(ns, name))
         if uid and podlib.pod_uid(pod) != uid:
             raise AllocationError(
                 f"pod {ns}/{name} UID changed (got {podlib.pod_uid(pod)}, "
@@ -527,6 +558,20 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
         "tpushare_node_hbm", "Per-node HBM utilization %% and fragmentation",
         per_node)
 
+    from tpushare.cache.cache import MEMO_REQUESTS
     from tpushare.cache.nodeinfo import CLAIM_CAS_RETRIES
+    from tpushare.k8s.informer import (
+        INFORMER_EVENTS, INFORMER_RELISTS, LISTER_REQUESTS as _LISTER)
+    from tpushare.k8s.singleflight import SINGLEFLIGHT_TOTAL
+    from tpushare.k8s.stats import APISERVER_REQUESTS
 
     registry.register(CLAIM_CAS_RETRIES)
+    # the read-path observability set: apiserver round-trips per verb,
+    # lister hit/miss, memo hit/miss, singleflight coalescing — the
+    # counters that PROVE the hot path stays off the apiserver
+    registry.register(APISERVER_REQUESTS)
+    registry.register(_LISTER)
+    registry.register(MEMO_REQUESTS)
+    registry.register(SINGLEFLIGHT_TOTAL)
+    registry.register(INFORMER_EVENTS)
+    registry.register(INFORMER_RELISTS)

@@ -37,7 +37,7 @@ class ExtenderServer:
     def __init__(self, cache, cluster, registry: Registry | None = None,
                  host: str = "0.0.0.0", port: int = 39999,
                  allow_debug_seed: bool = False,
-                 elector=None) -> None:
+                 elector=None, informer=None) -> None:
         self.registry = registry or Registry()
         # multi-host gang placement (docs/designs/multihost-gang.md):
         # engages only for pods carrying the gang annotations, on nodes
@@ -50,10 +50,14 @@ class ExtenderServer:
         self.preempt_handler = PreemptHandler(cache, self.registry)
         # HA (an elector is wired): binds also CAS a per-node claim so two
         # replicas in a stale-leader window cannot co-place onto one chip;
-        # single-replica mode skips the two extra apiserver round-trips
-        self.bind_handler = BindHandler(cache, cluster, self.registry,
-                                        ha_claims=elector is not None,
-                                        gang=self.gang)
+        # single-replica mode skips the two extra apiserver round-trips.
+        # An informer (k8s/informer.py, lifecycle owned by the caller)
+        # serves Bind's pod fetch from its watch-warmed lister instead of
+        # a per-bind apiserver GET.
+        self.bind_handler = BindHandler(
+            cache, cluster, self.registry,
+            ha_claims=elector is not None, gang=self.gang,
+            pod_lister=informer.pods if informer is not None else None)
         self.inspect_handler = InspectHandler(cache)
         self.host, self.port = host, port
         self._httpd: ThreadingHTTPServer | None = None

@@ -40,7 +40,8 @@ class ClusterClient(Protocol):
     """
 
     # reads
-    def list_pods(self, node_name: str | None = None
+    def list_pods(self, node_name: str | None = None,
+                  namespace: str | None = None
                   ) -> list[dict[str, Any]]: ...
     def get_pod(self, namespace: str, name: str) -> dict[str, Any]: ...
     def list_nodes(self) -> list[dict[str, Any]]: ...

@@ -150,12 +150,17 @@ class FakeCluster:
 
     # -- ClusterClient reads -------------------------------------------------
 
-    def list_pods(self, node_name: str | None = None) -> list[dict[str, Any]]:
+    def list_pods(self, node_name: str | None = None,
+                  namespace: str | None = None) -> list[dict[str, Any]]:
         with self._lock:
             pods = list(self._pods.values())
         if node_name:
             pods = [p for p in pods
                     if (p.get("spec") or {}).get("nodeName") == node_name]
+        if namespace:
+            pods = [p for p in pods
+                    if (p.get("metadata") or {}).get("namespace")
+                    == namespace]
         return copy.deepcopy(pods)
 
     def get_pod(self, namespace: str, name: str) -> dict[str, Any]:

@@ -221,11 +221,14 @@ class InClusterClient:
 
     # -- reads ---------------------------------------------------------------
 
-    def list_pods(self, node_name: str | None = None) -> list[dict[str, Any]]:
-        """LIST pods cluster-wide, or — the device-plugin hot path — only
-        one node's pods via an apiserver-side fieldSelector (an Allocate
-        on a 5000-pod cluster must not transfer the whole pod list)."""
-        path = "/api/v1/pods"
+    def list_pods(self, node_name: str | None = None,
+                  namespace: str | None = None) -> list[dict[str, Any]]:
+        """LIST pods cluster-wide, one node's pods via an apiserver-side
+        fieldSelector (the device-plugin rendezvous path — an Allocate on
+        a 5000-pod cluster must not transfer the whole pod list), or one
+        namespace's pods (the gang peer scan)."""
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
         if node_name:
             path += "?" + urllib.parse.urlencode(
                 {"fieldSelector": f"spec.nodeName={node_name}"})

@@ -1,0 +1,323 @@
+"""Watch-driven local object stores (the client-go informer/lister shape).
+
+The reference scheduler never GETs a pod on its bind path — client-go
+listers answer from a watch-maintained local index, and the apiserver is
+only consulted on a miss or a UID mismatch (gpushare-bind.go:45-70).
+tpushare's hot paths originally paid synchronous round-trips instead:
+every Bind re-GET the pod, ``SchedulerCache.get_node_info`` lazily GET
+nodes, and a gang member's Allocate LISTed the whole cluster's pods
+twice. This module closes that gap:
+
+- :class:`PodLister` — pods indexed by (namespace, name), by UID, by
+  node, and by (namespace, gang-id), maintained from watch events;
+- :class:`NodeLister` — nodes by name;
+- :class:`Informer` — owns both stores: one initial LIST each, then watch
+  streams applied as they arrive. A broken stream relists (heals any gap,
+  including 410 Gone compactions the client absorbs internally) after a
+  jittered exponential backoff, so a flapping apiserver sees a spread-out
+  trickle of relists instead of a reconnect stampede.
+
+resourceVersion bookkeeping: the underlying ``ClusterClient.watch_*``
+implementations own rv resume (incluster.py reconnects from the last
+seen rv and restarts from "now" on 410); the informer tracks the last
+applied rv for observability and treats *any* stream termination as a
+potential gap — relist, don't guess.
+
+Listers are best-effort by contract: readers MUST fall back to the
+apiserver on miss or staleness signals (UID mismatch). The hit/miss
+counters below are how bench.py proves the fallback is the exception.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Any
+
+from tpushare.contract.constants import ANN_GANG
+from tpushare.metrics import LabeledCounter
+
+log = logging.getLogger("tpushare.k8s.informer")
+
+# process-wide, like CLAIM_CAS_RETRIES: every lister consumer reports
+# here so bench.py and /metrics see one hit-rate regardless of wiring
+LISTER_REQUESTS = LabeledCounter(
+    "tpushare_lister_requests_total",
+    "Lister lookups by resource and outcome (miss = apiserver fallback)",
+    ("resource", "outcome"))
+INFORMER_EVENTS = LabeledCounter(
+    "tpushare_informer_events_total",
+    "Watch events applied to the local stores", ("resource",))
+INFORMER_RELISTS = LabeledCounter(
+    "tpushare_informer_relists_total",
+    "Full re-LISTs after a watch stream break (gap healing)",
+    ("resource",))
+
+
+def lister_hit_rate() -> float | None:
+    """Fraction of lister lookups served locally (None = no lookups)."""
+    hits = LISTER_REQUESTS.total(outcome="hit")
+    misses = LISTER_REQUESTS.total(outcome="miss")
+    if hits + misses == 0:
+        return None
+    return hits / (hits + misses)
+
+
+def _meta(obj: dict[str, Any]) -> dict[str, Any]:
+    return obj.get("metadata") or {}
+
+
+class PodLister:
+    """Thread-safe pod store with the three indexes the hot paths need."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_key: dict[tuple[str, str], dict[str, Any]] = {}
+        self._by_uid: dict[str, tuple[str, str]] = {}
+        self._by_node: dict[str, set[tuple[str, str]]] = {}
+        self._by_gang: dict[tuple[str, str], set[tuple[str, str]]] = {}
+
+    @staticmethod
+    def _pod_key(pod: dict[str, Any]) -> tuple[str, str]:
+        meta = _meta(pod)
+        return meta.get("namespace", "default"), meta.get("name", "")
+
+    def _unindex(self, key: tuple[str, str], pod: dict[str, Any]) -> None:
+        uid = _meta(pod).get("uid", "")
+        if uid and self._by_uid.get(uid) == key:
+            del self._by_uid[uid]
+        node = (pod.get("spec") or {}).get("nodeName", "")
+        if node:
+            bucket = self._by_node.get(node)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._by_node[node]
+        gid = (_meta(pod).get("annotations") or {}).get(ANN_GANG, "")
+        if gid:
+            gkey = (key[0], gid)
+            bucket = self._by_gang.get(gkey)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._by_gang[gkey]
+
+    def _index(self, key: tuple[str, str], pod: dict[str, Any]) -> None:
+        uid = _meta(pod).get("uid", "")
+        if uid:
+            self._by_uid[uid] = key
+        node = (pod.get("spec") or {}).get("nodeName", "")
+        if node:
+            self._by_node.setdefault(node, set()).add(key)
+        gid = (_meta(pod).get("annotations") or {}).get(ANN_GANG, "")
+        if gid:
+            self._by_gang.setdefault((key[0], gid), set()).add(key)
+
+    def apply(self, etype: str, pod: dict[str, Any]) -> None:
+        key = self._pod_key(pod)
+        with self._lock:
+            old = self._by_key.pop(key, None)
+            if old is not None:
+                self._unindex(key, old)
+            if etype != "DELETED":
+                self._by_key[key] = pod
+                self._index(key, pod)
+
+    def replace(self, pods: list[dict[str, Any]]) -> None:
+        with self._lock:
+            self._by_key.clear()
+            self._by_uid.clear()
+            self._by_node.clear()
+            self._by_gang.clear()
+            for pod in pods:
+                key = self._pod_key(pod)
+                self._by_key[key] = pod
+                self._index(key, pod)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._by_key)
+
+    def get(self, namespace: str, name: str) -> dict[str, Any] | None:
+        with self._lock:
+            return self._by_key.get((namespace, name))
+
+    def by_uid(self, uid: str) -> dict[str, Any] | None:
+        with self._lock:
+            key = self._by_uid.get(uid)
+            return self._by_key.get(key) if key is not None else None
+
+    def on_node(self, node_name: str) -> list[dict[str, Any]]:
+        with self._lock:
+            keys = self._by_node.get(node_name, ())
+            return [self._by_key[k] for k in keys if k in self._by_key]
+
+    def gang_peers(self, namespace: str, gang_id: str
+                   ) -> list[dict[str, Any]]:
+        """Live view of one gang's pods, namespace-scoped by construction
+        (the cross-namespace same-gang-id hazard cannot reach callers)."""
+        with self._lock:
+            keys = self._by_gang.get((namespace, gang_id), ())
+            return [self._by_key[k] for k in keys if k in self._by_key]
+
+
+class NodeLister:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_name: dict[str, dict[str, Any]] = {}
+
+    def apply(self, etype: str, node: dict[str, Any]) -> None:
+        name = _meta(node).get("name", "")
+        if not name:
+            return
+        with self._lock:
+            if etype == "DELETED":
+                self._by_name.pop(name, None)
+            else:
+                self._by_name[name] = node
+
+    def replace(self, nodes: list[dict[str, Any]]) -> None:
+        with self._lock:
+            self._by_name = {
+                _meta(n).get("name", ""): n for n in nodes
+                if _meta(n).get("name")}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._by_name)
+
+    def get(self, name: str) -> dict[str, Any] | None:
+        with self._lock:
+            return self._by_name.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return list(self._by_name)
+
+
+class Informer:
+    """Keeps a PodLister + NodeLister warm from one ClusterClient.
+
+    ``start()`` performs the initial LISTs synchronously (so callers see
+    a populated store immediately — the same guarantee cache.WaitForCacheSync
+    gives client-go consumers) and then spawns one daemon watch thread
+    per resource.
+    """
+
+    BACKOFF_BASE_S = 0.2
+    BACKOFF_CAP_S = 10.0
+
+    def __init__(self, cluster, resync_seconds: float = 0.0,
+                 rng: random.Random | None = None) -> None:
+        self._cluster = cluster
+        self.pods = PodLister()
+        self.nodes = NodeLister()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._resync_seconds = resync_seconds
+        self._rng = rng or random.Random()
+        self.synced = False
+        # last applied resourceVersion per resource (observability only;
+        # rv resume itself lives in the client's watch implementation)
+        self.last_rv: dict[str, str] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Informer":
+        self._relist("pods")
+        self._relist("nodes")
+        self.synced = True
+        for resource in ("pods", "nodes"):
+            t = threading.Thread(target=self._run, args=(resource,),
+                                 name=f"tpushare-informer-{resource}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self._resync_seconds > 0:
+            t = threading.Thread(target=self._resync_loop,
+                                 name="tpushare-informer-resync",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- internals -----------------------------------------------------------
+
+    def _store(self, resource: str):
+        return self.pods if resource == "pods" else self.nodes
+
+    def _list(self, resource: str) -> list[dict[str, Any]]:
+        if resource == "pods":
+            return self._cluster.list_pods()
+        return self._cluster.list_nodes()
+
+    def _watch(self, resource: str):
+        if resource == "pods":
+            return self._cluster.watch_pods(self._stop)
+        return self._cluster.watch_nodes(self._stop)
+
+    def _relist(self, resource: str) -> None:
+        self._store(resource).replace(self._list(resource))
+        INFORMER_RELISTS.inc(resource)
+
+    def _run(self, resource: str) -> None:
+        """Watch loop: apply events; on ANY stream termination while the
+        stop flag is clear, back off (jittered exponential) and relist —
+        the k8s watch API does not replay gaps, so termination means the
+        store may have missed events and only a fresh LIST re-grounds it."""
+        failures = 0
+        while not self._stop.is_set():
+            try:
+                for ev in self._watch(resource):
+                    self._store(resource).apply(ev.type, ev.object)
+                    rv = _meta(ev.object).get("resourceVersion")
+                    if rv:
+                        self.last_rv[resource] = rv
+                    INFORMER_EVENTS.inc(resource)
+                    failures = 0
+            except Exception as e:  # noqa: BLE001 — the stream must heal
+                log.warning("informer: %s watch broke: %s", resource, e)
+            if self._stop.is_set():
+                return
+            failures += 1
+            # full jitter: delay uniform in (0, base * 2^n], capped —
+            # a fleet of replicas reconnecting after one apiserver blip
+            # must not relist in lockstep
+            cap = min(self.BACKOFF_CAP_S,
+                      self.BACKOFF_BASE_S * (2 ** min(failures, 8)))
+            if self._stop.wait(self._rng.uniform(0, cap)):
+                return
+            try:
+                self._relist(resource)
+            except Exception as e:  # noqa: BLE001
+                log.warning("informer: %s relist failed: %s", resource, e)
+
+    def _resync_loop(self) -> None:
+        """Optional periodic anti-entropy relist (for deployments without
+        a Controller heartbeat watching the same streams)."""
+        while not self._stop.wait(self._resync_seconds):
+            for resource in ("pods", "nodes"):
+                try:
+                    self._relist(resource)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("informer: %s resync failed: %s",
+                                resource, e)
+
+
+def lookup(lister, resource: str, *args: Any,
+           counter: LabeledCounter = LISTER_REQUESTS):
+    """Counted lister read: returns the object or None, incrementing the
+    shared hit/miss counter. ``lister`` may be None (always a miss —
+    callers without an informer fall straight through)."""
+    if lister is None:
+        counter.inc(resource, "miss")
+        return None
+    obj = lister.get(*args)
+    counter.inc(resource, "hit" if obj is not None else "miss")
+    return obj

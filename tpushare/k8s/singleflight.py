@@ -1,0 +1,78 @@
+"""Singleflight: coalesce concurrent identical apiserver calls.
+
+A gang storm (N members of one gang hitting Allocate/Bind within the same
+watch-lag window) used to issue N identical LISTs/GETs — each one a full
+apiserver round-trip carrying the same answer. With singleflight, the
+first caller for a key becomes the *leader* and executes the upstream
+call; every concurrent caller for the same key parks on the leader's
+event and shares its result (or its exception). The key leaves the table
+as soon as the leader finishes, so sequential calls are never served
+stale data — this is request coalescing, not a cache.
+
+Mirrors golang.org/x/sync/singleflight, which client-go-based schedulers
+lean on for exactly this fan-in.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+from tpushare.metrics import LabeledCounter
+
+# process-wide: every Singleflight instance reports here so one scrape
+# (and bench.py) sees the whole coalescing picture. outcome=leader is an
+# upstream call that actually happened; outcome=shared is a round-trip
+# that singleflight saved.
+SINGLEFLIGHT_TOTAL = LabeledCounter(
+    "tpushare_singleflight_total",
+    "Coalesced-call outcomes: leader = upstream call executed, "
+    "shared = concurrent duplicate served from the leader's result",
+    ("outcome",))
+
+
+class _Call:
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: BaseException | None = None
+
+
+class Singleflight:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._calls: dict[str, _Call] = {}
+
+    def do(self, key: str, fn: Callable[[], Any]) -> Any:
+        """Run ``fn`` once per concurrent burst of callers sharing
+        ``key``; every caller gets the leader's result or exception."""
+        with self._lock:
+            call = self._calls.get(key)
+            if call is not None:
+                leader = False
+            else:
+                call = _Call()
+                self._calls[key] = call
+                leader = True
+        if not leader:
+            SINGLEFLIGHT_TOTAL.inc("shared")
+            call.event.wait()
+            if call.error is not None:
+                raise call.error
+            return call.result
+        SINGLEFLIGHT_TOTAL.inc("leader")
+        try:
+            call.result = fn()
+        except BaseException as e:
+            call.error = e
+            raise
+        finally:
+            # remove BEFORE waking waiters: a caller arriving after the
+            # leader finished must start a fresh upstream call (coalescing
+            # only within a burst — never serving stale results)
+            with self._lock:
+                self._calls.pop(key, None)
+            call.event.set()
+        return call.result

@@ -1,0 +1,120 @@
+"""Per-verb apiserver round-trip accounting.
+
+The whole point of the informer/lister/memo work is to take the apiserver
+out of the scheduling hot path — which is only provable if every
+round-trip is counted. :class:`CountingCluster` wraps any ClusterClient
+and increments ``tpushare_apiserver_requests_total{verb,origin}`` on
+every call; ``origin`` comes from a thread-local scope the hot paths set
+(``with api_origin("bind"): ...``), so one shared client can attribute
+traffic to bind vs filter vs controller vs allocate without plumbing a
+tag through every call site.
+
+bench.py diffs snapshots of the counter around its measured windows to
+publish ``apiserver_requests_per_bind`` and to FAIL when a plain bind's
+hot path issues any synchronous read.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Iterator
+
+from tpushare.metrics import LabeledCounter
+
+APISERVER_REQUESTS = LabeledCounter(
+    "tpushare_apiserver_requests_total",
+    "Apiserver round-trips by verb and originating code path "
+    "(origin set via tpushare.k8s.stats.api_origin)",
+    ("verb", "origin"))
+
+# verbs that transfer state FROM the apiserver on a request/response call
+# (watches are long-lived streams, counted once at attach, and excluded
+# from the read budget — they are the mechanism that REMOVES reads)
+READ_VERBS = frozenset({
+    "list_pods", "list_pods_node", "list_pods_ns", "list_nodes",
+    "get_pod", "get_node", "get_configmap", "get_lease"})
+WRITE_VERBS = frozenset({
+    "patch_pod", "replace_pod", "bind_pod", "patch_node",
+    "put_configmap", "create_lease", "update_lease"})
+# create_event is a write too, but it is explicitly post-latency
+# best-effort observability — tracked under its own verb so the bind
+# write budget (patch+bind) stays honest without hiding event traffic.
+
+_local = threading.local()
+
+
+def current_origin() -> str:
+    return getattr(_local, "origin", "other")
+
+
+@contextlib.contextmanager
+def api_origin(origin: str) -> Iterator[None]:
+    """Attribute every apiserver call made by this thread inside the
+    scope to ``origin`` (nesting restores the outer scope on exit)."""
+    prev = getattr(_local, "origin", None)
+    _local.origin = origin
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _local.origin
+        else:
+            _local.origin = prev
+
+
+class CountingCluster:
+    """Transparent ClusterClient proxy that counts every round-trip.
+
+    ``list_pods`` is split by scope (cluster / node / namespace) because
+    the three differ by orders of magnitude in transferred bytes — the
+    gang-Allocate acceptance bar is specifically "at most one
+    namespace-scoped LIST", which a single verb could not verify.
+    """
+
+    def __init__(self, inner: Any,
+                 stats: LabeledCounter = APISERVER_REQUESTS) -> None:
+        self._inner = inner
+        self._stats = stats
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._inner, name)
+        if not callable(attr) or name.startswith("_"):
+            return attr
+        if name == "list_pods":
+            def counted_list(*args: Any, **kwargs: Any) -> Any:
+                verb = "list_pods"
+                if kwargs.get("node_name") or (args and args[0]):
+                    verb = "list_pods_node"
+                elif kwargs.get("namespace") or len(args) > 1:
+                    verb = "list_pods_ns"
+                self._stats.inc(verb, current_origin())
+                return attr(*args, **kwargs)
+            return counted_list
+        if name.startswith("watch_"):
+            def counted_watch(*args: Any, **kwargs: Any) -> Any:
+                self._stats.inc(name, current_origin())
+                return attr(*args, **kwargs)
+            return counted_watch
+
+        def counted(*args: Any, **kwargs: Any) -> Any:
+            self._stats.inc(name, current_origin())
+            return attr(*args, **kwargs)
+        return counted
+
+
+def delta(before: dict[tuple[str, ...], float],
+          after: dict[tuple[str, ...], float],
+          verbs: frozenset[str] | None = None,
+          origin: str | None = None) -> float:
+    """Sum of counter movement between two APISERVER_REQUESTS.snapshot()
+    calls, optionally filtered by verb set and/or origin."""
+    out = 0.0
+    for key, v in after.items():
+        verb, org = key
+        if verbs is not None and verb not in verbs:
+            continue
+        if origin is not None and org != origin:
+            continue
+        out += v - before.get(key, 0.0)
+    return out

@@ -34,6 +34,57 @@ class Counter:
                 f"{self.name} {self.value}\n")
 
 
+class LabeledCounter:
+    """Counter with a fixed label set, one series per label-value tuple
+    (the Prometheus `name{a="x",b="y"} v` exposition). Series are created
+    on first increment, so an idle verb/origin pair costs nothing."""
+
+    def __init__(self, name: str, help_: str,
+                 labelnames: tuple[str, ...]) -> None:
+        self.name, self.help = name, help_
+        self.labelnames = tuple(labelnames)
+        self._series: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, *labelvalues: str, n: float = 1.0) -> None:
+        if len(labelvalues) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} label "
+                f"values {self.labelnames}, got {labelvalues!r}")
+        key = tuple(str(v) for v in labelvalues)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + n
+
+    def get(self, *labelvalues: str) -> float:
+        key = tuple(str(v) for v in labelvalues)
+        with self._lock:
+            return self._series.get(key, 0.0)
+
+    def snapshot(self) -> dict[tuple[str, ...], float]:
+        """Copy of every series — bench/tests diff two snapshots to
+        attribute counts to one measured window."""
+        with self._lock:
+            return dict(self._series)
+
+    def total(self, **match: str) -> float:
+        """Sum of all series whose labels match ``match`` (subset)."""
+        idx = {self.labelnames.index(k): v for k, v in match.items()}
+        with self._lock:
+            return sum(v for key, v in self._series.items()
+                       if all(key[i] == want for i, want in idx.items()))
+
+    def expose(self) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with self._lock:
+            series = sorted(self._series.items())
+        for key, v in series:
+            labels = ",".join(f'{n}="{val}"'
+                              for n, val in zip(self.labelnames, key))
+            out.append(f"{self.name}{{{labels}}} {v}")
+        return "\n".join(out) + "\n"
+
+
 class Histogram:
     """Cumulative-bucket histogram (Prometheus semantics)."""
 
@@ -79,6 +130,12 @@ class Registry:
 
     def counter(self, name: str, help_: str) -> Counter:
         c = Counter(name, help_)
+        self._metrics.append(c)
+        return c
+
+    def labeled_counter(self, name: str, help_: str,
+                        labelnames: tuple[str, ...]) -> LabeledCounter:
+        c = LabeledCounter(name, help_, labelnames)
         self._metrics.append(c)
         return c
 

@@ -71,7 +71,7 @@ class _EngineFrontend:
         stream_q: queue.Queue = queue.Queue()
         done = threading.Event()
         box: dict = {"stream": stream_q}
-        self._q.put((list(prompt), max_new, sampling or {}, done, box))
+        self._submit((list(prompt), max_new, sampling or {}, done, box))
         while True:
             try:
                 kind, payload = stream_q.get(timeout=timeout)
@@ -92,7 +92,7 @@ class _EngineFrontend:
         submit-and-wait would serialize the batch."""
         pairs = [(threading.Event(), {}) for _ in prompts]
         for p, (done, box) in zip(prompts, pairs):
-            self._q.put((list(p), max_new, sampling or {}, done, box))
+            self._submit((list(p), max_new, sampling or {}, done, box))
         out = []
         for done, box in pairs:
             if not done.wait(timeout):
@@ -101,6 +101,30 @@ class _EngineFrontend:
                 raise ValueError(box["error"])
             out.append(box["tokens"])
         return out
+
+    def _submit(self, item) -> None:
+        """Enqueue one request, failing fast when the engine is stopping.
+
+        Checked on BOTH sides of the put: the engine thread observes the
+        stop flag, drains the queue once, and exits — a request enqueued
+        after that drain would otherwise sit unanswered until the
+        client's timeout. Rejecting after the put as well closes the
+        check-then-enqueue race (the drain and this rejection write the
+        same terminal state, so double delivery is harmless)."""
+        done, box = item[3], item[4]
+        if self._stop.is_set():
+            self._reject(done, box)
+            return
+        self._q.put(item)
+        if self._stop.is_set():
+            self._reject(done, box)
+
+    @staticmethod
+    def _reject(done, box) -> None:
+        box["error"] = "server shutting down"
+        if "stream" in box:
+            box["stream"].put(("error", box["error"]))
+        done.set()
 
     def _loop(self):
         inflight: dict[int, tuple] = {}  # rid -> (done, box)
