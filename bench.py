@@ -186,16 +186,30 @@ def wire_latency(ha: bool = False) -> dict:
     so the HA tax is a published number, not a surprise.
     """
     from tpushare.cache.cache import MEMO_REQUESTS
+    from tpushare.extender.handlers import BIND_DEADLINE_EXCEEDED
+    from tpushare.k8s.breaker import CircuitBreaker, harden
     from tpushare.k8s.incluster import InClusterClient
     from tpushare.k8s.informer import Informer, LISTER_REQUESTS
+    from tpushare.k8s.retry import RetryPolicy
     from tpushare.k8s.stats import (
         APISERVER_REQUESTS, READ_VERBS, WRITE_VERBS, CountingCluster,
         delta)
     from tpushare.k8s.stubapi import StubApiServer
 
     stub = StubApiServer().start()
-    client = CountingCluster(
-        InClusterClient(base_url=stub.base_url, timeout=10.0))
+    # deployment parity with extender/__main__.py: the full fault-
+    # containment stack (retry policy + circuit breaker) sits over the
+    # counting proxy, so every RETRIED round-trip is counted — which is
+    # what makes the write-amplification self-check meaningful. On this
+    # clean (no-chaos) run the stack must be pure overhead: zero
+    # retries, zero deadline hits, amplification exactly 1.0.
+    retry_budget = 4
+    breaker = CircuitBreaker()
+    client = harden(
+        CountingCluster(InClusterClient(base_url=stub.base_url,
+                                        timeout=10.0)),
+        breaker=breaker, policy=RetryPolicy(max_attempts=retry_budget))
+    deadline_exceeded_start = BIND_DEADLINE_EXCEEDED.value
     for i in range(4):
         stub.seed("nodes", {
             "apiVersion": "v1", "kind": "Node",
@@ -227,7 +241,8 @@ def wire_latency(ha: bool = False) -> dict:
                 "HA wire bench: elector failed to acquire leadership in "
                 "10s — binds would all 503")
     server = ExtenderServer(cache, client, host="127.0.0.1", port=0,
-                            elector=elector, informer=informer)
+                            elector=elector, informer=informer,
+                            breaker=breaker)
     port = server.start()
     # deployment parity with extender/__main__.py: the service freezes
     # its post-build heap so gen-2 GC sweeps stay off the bind path.
@@ -375,6 +390,15 @@ def wire_latency(ha: bool = False) -> dict:
                                              4),
         "lister_hit_rate": _rate(lister_before, lister_after),
         "memo_hit_rate": _rate(memo_before, memo_after),
+        # fault-containment honesty on the clean run (ISSUE 2): no bind
+        # may have hit its deadline, and write amplification (actual
+        # writes / the 2 a bind needs) must stay within the retry
+        # budget — 1.0 when the apiserver is healthy
+        "bind_deadline_exceeded_total":
+            BIND_DEADLINE_EXCEEDED.value - deadline_exceeded_start,
+        "write_amplification": round(writes / (2.0 * n_binds), 4),
+        "retry_budget": retry_budget,
+        "breaker_state": breaker.state,
         **preempt_stats,
     }
 
@@ -1292,6 +1316,17 @@ def main() -> int:
     expect((wire["memo_hit_rate"] or 0) > 0,
            f"placement memo served the Prioritize/Bind reuse "
            f"(hit rate {wire['memo_hit_rate']})")
+    # fault-containment self-checks (ISSUE 2): the clean run must show
+    # the containment stack as pure overhead
+    expect(wire["bind_deadline_exceeded_total"] == 0,
+           f"no bind hit its deadline on the clean run "
+           f"(got {wire['bind_deadline_exceeded_total']})")
+    expect(wire["write_amplification"] <= wire["retry_budget"],
+           f"write amplification {wire['write_amplification']} <= retry "
+           f"budget {wire['retry_budget']} on the clean run")
+    expect(wire["breaker_state"] == "closed",
+           f"breaker stayed closed on the clean run "
+           f"(state {wire['breaker_state']})")
     expect(wire.get("preempt_victims_out", -1) == 1,
            f"preempt verb refined 4 victims to 1 on the wire "
            f"(p50 {wire.get('preempt_p50', -1):.2f} ms)")
@@ -1414,6 +1449,12 @@ def main() -> int:
                 wire["apiserver_writes_per_bind"],
             "lister_hit_rate": wire["lister_hit_rate"],
             "memo_hit_rate": wire["memo_hit_rate"],
+            # fault containment (docs/ops.md): both must be trivial on a
+            # healthy apiserver — nonzero here would mean the retry/
+            # breaker stack itself is costing binds
+            "bind_deadline_exceeded_total":
+                wire["bind_deadline_exceeded_total"],
+            "write_amplification": wire["write_amplification"],
             "p50_preempt_ms": round(wire["preempt_p50"], 3),
             # HA mode engages the per-node claim CAS (dual-replica
             # oversubscription safety): +1 GET +1 PATCH per bind

@@ -1,0 +1,569 @@
+"""Write-path fault containment: retry policy, circuit breaker, deadline
+propagation, degraded mode, and the crash-consistency seams.
+
+The reference ships zero fault injection and no write-retry policy
+(SURVEY §5.3) — every transient 5xx/timeout is a terminal bind failure.
+These tests pin down the containment layer's contracts:
+
+- retryable-status classification (409 NEVER retried at transport level,
+  429 honors Retry-After, 5xx/network within budget);
+- deadline propagation (a bind never retries past the caller's patience);
+- breaker state machine (closed -> open -> half-open -> closed) and the
+  degraded-mode behavior of each scheduling verb while open;
+- the transport layer's POST replay safety (k8s/incluster.py);
+- crash-consistency: an interrupted bind is healed by rebind or by
+  gc_stale_assignments + resync_once, and duplicate bind deliveries stay
+  idempotent through breaker transitions.
+"""
+
+import http.client
+import threading
+import time
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import AllocationError, SchedulerCache
+from tpushare.controller import Controller
+from tpushare.extender.handlers import BindHandler
+from tpushare.extender.metrics import Registry
+from tpushare.k8s import (
+    ApiError,
+    BreakerOpenError,
+    ChaosCluster,
+    CircuitBreaker,
+    FakeCluster,
+    RetryPolicy,
+    RetryingCluster,
+    harden,
+    request_deadline,
+)
+from tpushare.k8s.breaker import CLOSED, HALF_OPEN, OPEN
+from tpushare.k8s.retry import DeadlineExceeded, deadline_remaining
+
+
+def no_sleep_policy(**kw) -> RetryPolicy:
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("base_s", 0.001)
+    kw.setdefault("cap_s", 0.002)
+    return RetryPolicy(**kw)
+
+
+def cluster_with_node(name="n1", chips=4, hbm=16000, seed=0):
+    fc = FakeCluster()
+    fc.add_tpu_node(name, chips=chips, hbm_per_chip_mib=hbm)
+    return fc, ChaosCluster(fc, seed=seed)
+
+
+# -- retry policy -------------------------------------------------------------
+
+def test_retry_heals_transient_5xx_within_budget():
+    fc, chaos = cluster_with_node()
+    cl = RetryingCluster(chaos, no_sleep_policy(max_attempts=4))
+    chaos.fail("get_node", status=503, times=3)
+    assert cl.get_node("n1")["metadata"]["name"] == "n1"
+    assert chaos.injected["get_node"] == 3
+
+
+def test_retry_budget_exhaustion_surfaces_last_error():
+    fc, chaos = cluster_with_node()
+    cl = RetryingCluster(chaos, no_sleep_policy(max_attempts=3))
+    chaos.fail("get_node", status=500, times=None)
+    with pytest.raises(ApiError) as ei:
+        cl.get_node("n1")
+    assert ei.value.status == 500
+    # total attempts == budget, not budget + 1
+    assert chaos.injected["get_node"] == 3
+
+
+def test_409_is_never_retried_at_transport_level():
+    """A conflict is a correctness signal (another writer moved the
+    object); replaying the same body would overwrite the winner."""
+    fc, chaos = cluster_with_node()
+    cl = RetryingCluster(chaos, no_sleep_policy())
+    chaos.fail("patch_pod", status=409, times=None)
+    fc.create_pod(make_pod(hbm=100, name="p"))
+    with pytest.raises(ApiError) as ei:
+        cl.patch_pod("default", "p", {"metadata": {}})
+    assert ei.value.status == 409
+    assert chaos.injected["patch_pod"] == 1  # exactly one attempt
+
+
+def test_4xx_is_not_retried():
+    fc, chaos = cluster_with_node()
+    cl = RetryingCluster(chaos, no_sleep_policy())
+    chaos.fail("get_pod", status=404, times=None)
+    with pytest.raises(ApiError):
+        cl.get_pod("default", "nope")
+    assert chaos.injected["get_pod"] == 1
+
+
+def test_429_honors_retry_after_over_backoff_curve():
+    fc, chaos = cluster_with_node()
+    slept = []
+    cl = RetryingCluster(chaos, RetryPolicy(
+        max_attempts=3, base_s=50.0, cap_s=50.0,  # curve would sleep ~50s
+        sleep=slept.append))
+    chaos.fail("get_node", status=429, retry_after=0.2, times=1)
+    cl.get_node("n1")
+    assert slept == [0.2]
+
+
+def test_network_error_status_0_is_retried():
+    fc, chaos = cluster_with_node()
+    cl = RetryingCluster(chaos, no_sleep_policy())
+    chaos.fail("get_node", status=0, times=2)
+    assert cl.get_node("n1")["metadata"]["name"] == "n1"
+
+
+# -- deadline propagation -----------------------------------------------------
+
+def test_deadline_stops_retries_before_caller_gives_up():
+    fc, chaos = cluster_with_node()
+    slept = []
+    cl = RetryingCluster(chaos, RetryPolicy(
+        max_attempts=10, base_s=5.0, cap_s=5.0, sleep=slept.append))
+    chaos.fail("get_node", status=503, times=None)
+    t0 = time.monotonic()
+    with request_deadline(0.05):
+        with pytest.raises(DeadlineExceeded):
+            cl.get_node("n1")
+    # no multi-second sleep happened: the loop saw the 5s backoff would
+    # outlive the 50ms deadline and gave up immediately
+    assert time.monotonic() - t0 < 1.0
+    assert slept == []
+
+
+def test_nested_deadline_scopes_only_shorten():
+    with request_deadline(10.0):
+        outer = deadline_remaining()
+        with request_deadline(0.01):
+            inner = deadline_remaining()
+            assert inner < 1.0
+        with request_deadline(60.0):
+            # inner scope cannot outlive the caller's patience
+            assert deadline_remaining() <= outer
+    assert deadline_remaining() is None
+
+
+def test_deadline_exceeded_is_not_retryable_itself():
+    from tpushare.k8s.retry import is_retryable
+    assert not is_retryable(DeadlineExceeded("x"))
+    assert not is_retryable(BreakerOpenError("x"))
+    assert is_retryable(ApiError(503))
+    assert is_retryable(ApiError(0))
+    assert is_retryable(ApiError(429))
+    assert not is_retryable(ApiError(409))
+    assert not is_retryable(ApiError(404))
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+def fast_breaker(**kw) -> CircuitBreaker:
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("reset_timeout_s", 0.05)
+    kw.setdefault("probe_successes", 2)
+    return CircuitBreaker(**kw)
+
+
+def test_breaker_opens_on_consecutive_failures_and_fast_fails():
+    fc, chaos = cluster_with_node()
+    br = fast_breaker()
+    cl = harden(chaos, breaker=br, policy=no_sleep_policy(max_attempts=1))
+    chaos.fail("get_node", status=500, times=None)
+    for _ in range(3):
+        with pytest.raises(ApiError):
+            cl.get_node("n1")
+    assert br.state == OPEN
+    injected_before = chaos.injected["get_node"]
+    with pytest.raises(BreakerOpenError):
+        cl.get_node("n1")
+    # the fast-fail issued ZERO round-trips
+    assert chaos.injected["get_node"] == injected_before
+
+
+def test_breaker_half_open_probe_closes_on_success():
+    fc, chaos = cluster_with_node()
+    br = fast_breaker()
+    cl = harden(chaos, breaker=br, policy=no_sleep_policy(max_attempts=1))
+    chaos.fail("get_node", status=500, times=3)
+    for _ in range(3):
+        with pytest.raises(ApiError):
+            cl.get_node("n1")
+    assert br.state == OPEN
+    time.sleep(0.06)
+    assert br.state == HALF_OPEN
+    cl.get_node("n1")
+    cl.get_node("n1")
+    assert br.state == CLOSED
+
+
+def test_breaker_half_open_failure_reopens():
+    br = fast_breaker()
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == OPEN
+    time.sleep(0.06)
+    assert br.state == HALF_OPEN
+    assert br.allow()
+    br.record_failure()
+    assert br.state == OPEN
+
+
+def test_409_counts_as_success_for_the_breaker():
+    """404/409 are successful communication carrying a verdict — a storm
+    of optimistic-lock losers must not open the circuit."""
+    fc, chaos = cluster_with_node()
+    br = fast_breaker(failure_threshold=2)
+    cl = harden(chaos, breaker=br, policy=no_sleep_policy())
+    chaos.fail("patch_node", status=409, times=None)
+    for _ in range(6):
+        with pytest.raises(ApiError):
+            cl.patch_node("n1", {"metadata": {"resourceVersion": "x"}})
+    assert br.state == CLOSED
+
+
+def test_breaker_open_error_is_not_retried():
+    fc, chaos = cluster_with_node()
+    br = fast_breaker()
+    cl = harden(chaos, breaker=br, policy=no_sleep_policy(max_attempts=8))
+    for _ in range(3):
+        br.record_failure()
+    injected_before = chaos.injected["get_node"]
+    with pytest.raises(BreakerOpenError):
+        cl.get_node("n1")
+    assert chaos.injected["get_node"] == injected_before
+
+
+def test_watches_bypass_the_breaker():
+    fc, chaos = cluster_with_node()
+    br = fast_breaker()
+    cl = harden(chaos, breaker=br)
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == OPEN
+    stop = threading.Event()
+    it = cl.watch_pods(stop)  # must NOT raise BreakerOpenError
+    stop.set()
+    assert it is not None
+
+
+# -- chaos extensions (the harness the soak depends on) -----------------------
+
+def test_chaos_fail_carries_retry_after():
+    fc, chaos = cluster_with_node()
+    chaos.fail("get_node", status=429, retry_after=7.5)
+    with pytest.raises(ApiError) as ei:
+        chaos.get_node("n1")
+    assert ei.value.status == 429
+    assert ei.value.retry_after == 7.5
+
+
+def test_chaos_brownout_ramps_and_dies():
+    fc, chaos = cluster_with_node()
+    t = [0.0]
+    chaos.brownout("get_node", seconds=10.0, peak=1.0,
+                   clock=lambda: t[0])
+    t[0] = 5.0  # crest: p == peak == 1.0 -> must fire
+    with pytest.raises(ApiError):
+        chaos.get_node("n1")
+    assert chaos.injected["get_node"] == 1
+    t[0] = 11.0  # window over: rule dead, calls pass, count unchanged
+    chaos.get_node("n1")
+    chaos.get_node("n1")
+    assert chaos.injected["get_node"] == 1
+
+
+def test_chaos_brownout_edges_are_quiet():
+    fc, chaos = cluster_with_node(seed=5)
+    t = [0.0]
+    chaos.brownout("get_node", seconds=10.0, peak=0.9,
+                   clock=lambda: t[0])
+    # at t=0 the ramp is exactly 0: never fires
+    for _ in range(50):
+        chaos.get_node("n1")
+    assert chaos.injected["get_node"] == 0
+
+
+# -- transport replay safety (k8s/incluster.py) -------------------------------
+
+class _DeadConn:
+    sock = None
+    timeout = None
+
+    def request(self, *a, **k):
+        raise http.client.CannotSendRequest("stale keep-alive")
+
+    def close(self):
+        pass
+
+
+class _GoodResp:
+    status = 200
+    will_close = True
+
+    def read(self):
+        return b"{}"
+
+    def getheader(self, name):
+        return None
+
+
+class _GoodConn:
+    sock = None
+    timeout = None
+
+    def __init__(self, log):
+        self._log = log
+
+    def request(self, method, path, body=None, headers=None):
+        self._log.append(method)
+
+    def getresponse(self):
+        return _GoodResp()
+
+    def close(self):
+        pass
+
+
+def _pool_with_stale_conn(replay_log):
+    from tpushare.k8s.incluster import _ConnPool
+    pool = _ConnPool("h", 80, False, None)
+    pool._idle.append(_DeadConn())
+    pool._new_conn = lambda timeout: _GoodConn(replay_log)
+    return pool
+
+
+def test_pool_replays_idempotent_verbs_on_stale_connection():
+    for method in ("GET", "PUT", "PATCH", "DELETE"):
+        log = []
+        pool = _pool_with_stale_conn(log)
+        status, data, retry_after = pool.request(method, "/x", None, {}, 1.0)
+        assert status == 200 and log == [method]
+
+
+def test_pool_never_replays_post_on_stale_connection():
+    """The satellite fix: a binding/event POST whose response was lost
+    may have LANDED — a blind transport resend would duplicate it. The
+    ambiguous error surfaces and the retry policy (whose call sites
+    tolerate duplicates) decides."""
+    log = []
+    pool = _pool_with_stale_conn(log)
+    with pytest.raises(http.client.HTTPException):
+        pool.request("POST", "/x", b"{}", {}, 1.0)
+    assert log == []  # nothing was resent
+
+
+# -- crash-consistency seams --------------------------------------------------
+
+def test_interrupted_bind_with_failed_rollback_heals_on_rebind():
+    """Bind interrupted between placement PATCH and binding POST, with
+    the rollback ALSO failing (the extender 'crashed' mid-seam): the pod
+    is left annotated-but-unbound, the cache holds nothing, and the
+    scheduler's retry overwrites the stale annotations and binds
+    cleanly."""
+    fc, chaos = cluster_with_node()
+    cache = SchedulerCache(chaos)
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2048, name="p"))
+    chaos.fail("bind_pod", status=500, times=1)
+    chaos.fail("get_pod", status=500, times=None)  # rollback blocked
+    with pytest.raises(AllocationError):
+        info.allocate(pod, chaos)
+    chaos.clear()
+    stranded = fc.get_pod("default", "p")
+    assert contract.chip_ids_from_annotations(stranded) is not None
+    assert not stranded["spec"].get("nodeName")
+    assert info.describe()["used_hbm_mib"] == 0  # reservation rolled back
+    # the scheduler retries: the seam heals by overwrite
+    placement = info.allocate(stranded, chaos)
+    live = fc.get_pod("default", "p")
+    assert live["spec"]["nodeName"] == "n1"
+    assert contract.chip_ids_from_annotations(live) == placement.chip_ids
+    assert info.describe()["used_hbm_mib"] == 2048
+
+
+def _plugin_for(fc, node="n1", chips=4, hbm=16000):
+    from tpushare.deviceplugin.enumerator import FakeEnumerator
+    from tpushare.deviceplugin.plugin import DevicePlugin
+    return DevicePlugin(fc, node, FakeEnumerator(chips, hbm))
+
+
+def test_gc_plus_resync_heal_bound_never_started_placement():
+    """A bound pod whose container start never reached Allocate holds
+    its chips forever without gc; gc_stale_assignments reclaims the
+    placement (CAS) and resync_once frees the chips in the cache."""
+    fc, chaos = cluster_with_node()
+    cache = SchedulerCache(chaos)
+    ctl = Controller(chaos, cache)
+    ctl.build_cache()
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2048, name="stuck"))
+    info.allocate(pod, chaos)
+    # deliver the bound pod to the cache the way the watch would (the
+    # controller isn't started in this test)
+    ctl._sync_pod("default/stuck")
+    assert cache.describe()["used_hbm_mib"] == 2048
+    plugin = _plugin_for(fc)
+    # boundary timing: a placement exactly AT the window edge is kept
+    # (<=), one past it is reclaimed. The annotation timestamp is ns.
+    live = fc.get_pod("default", "stuck")
+    t = contract.assume_time_from_annotations(live)
+    age_s = (time.time_ns() - t) / 1e9
+    assert plugin.gc_stale_assignments(
+        max_pending_seconds=age_s + 30.0) == 0  # inside window: kept
+    assert plugin.gc_stale_assignments(
+        max_pending_seconds=0.0) == 1  # past window: reclaimed
+    live = fc.get_pod("default", "stuck")
+    assert contract.chip_ids_from_annotations(live) is None
+    # resync observes the lost placement and frees the chips
+    ctl.resync_once()
+    # resync enqueues; process synchronously for determinism
+    ctl._sync_pod("default/stuck")
+    assert cache.describe()["used_hbm_mib"] == 0
+
+
+def test_gc_loses_cas_race_to_late_allocate():
+    """gc re-reads and CAS-PUTs; a late Allocate that flipped
+    assigned=true in between must win (the placement stands)."""
+    fc, chaos = cluster_with_node()
+    cache = SchedulerCache(chaos)
+    info = cache.get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2048, name="racy"))
+    info.allocate(pod, chaos)
+    plugin = _plugin_for(fc)
+    out = plugin.allocate(hbm_mib=2048)  # the late container start
+    assert out["pod"]["name"] == "racy"
+    assert plugin.gc_stale_assignments(max_pending_seconds=0.0) == 0
+    live = fc.get_pod("default", "racy")
+    assert contract.chip_ids_from_annotations(live) is not None
+    assert contract.is_assigned(live)
+
+
+def test_duplicate_bind_delivery_during_half_open_stays_idempotent():
+    """A duplicate bind webhook delivery arriving while the breaker is
+    half-open (recovering from a brownout) must be recognized as
+    already-bound-as-requested: idempotent success, no second write
+    storm, no failure event."""
+    fc, chaos = cluster_with_node()
+    br = fast_breaker()
+    cl = harden(chaos, breaker=br,
+                policy=no_sleep_policy(max_attempts=2))
+    cache = SchedulerCache(cl)
+    registry = Registry()
+    binder = BindHandler(cache, cl, registry, breaker=br)
+    pod = fc.create_pod(make_pod(hbm=2048, name="dup"))
+    args = {"PodNamespace": "default", "PodName": "dup",
+            "PodUID": pod["metadata"]["uid"], "Node": "n1"}
+    assert binder.handle(args) == {"Error": ""}
+    used_before = cache.describe()["used_hbm_mib"]
+    # brownout trips the breaker, then cools into half-open
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == OPEN
+    time.sleep(0.06)
+    assert br.state == HALF_OPEN
+    out = binder.handle(args)  # duplicate delivery
+    assert out == {"Error": ""}  # idempotent success, not a failure
+    assert cache.describe()["used_hbm_mib"] == used_before
+    live = fc.get_pod("default", "dup")
+    assert live["spec"]["nodeName"] == "n1"
+
+
+def test_bind_fails_fast_with_distinct_error_while_open():
+    fc, chaos = cluster_with_node()
+    br = fast_breaker(reset_timeout_s=60.0)
+    cl = harden(chaos, breaker=br)
+    cache = SchedulerCache(cl)
+    cache.build_cache()
+    binder = BindHandler(cache, cl, Registry(), breaker=br)
+    for _ in range(3):
+        br.record_failure()
+    pod = fc.create_pod(make_pod(hbm=2048, name="p"))
+    t0 = time.monotonic()
+    out = binder.handle({"PodNamespace": "default", "PodName": "p",
+                         "PodUID": pod["metadata"]["uid"], "Node": "n1"})
+    assert "circuit open" in out["Error"]
+    assert time.monotonic() - t0 < 0.5  # no webhook-timeout burn
+    # nothing was reserved or written
+    assert cache.describe()["used_hbm_mib"] == 0
+    assert not fc.get_pod("default", "p")["spec"].get("nodeName")
+
+
+def test_bind_deadline_exceeded_counted_and_rolled_back():
+    from tpushare.extender.handlers import BIND_DEADLINE_EXCEEDED
+    fc, chaos = cluster_with_node()
+    cl = RetryingCluster(chaos, RetryPolicy(
+        max_attempts=5, base_s=5.0, cap_s=5.0, sleep=lambda s: None))
+    cache = SchedulerCache(cl)
+    cache.build_cache()
+    binder = BindHandler(cache, cl, Registry())
+    chaos.fail("bind_pod", status=503, times=None)
+    pod = fc.create_pod(make_pod(hbm=2048, name="p"))
+    before = BIND_DEADLINE_EXCEEDED.value
+    with request_deadline(0.05):
+        out = binder.handle({"PodNamespace": "default", "PodName": "p",
+                             "PodUID": pod["metadata"]["uid"],
+                             "Node": "n1"})
+    assert out["Error"]
+    assert BIND_DEADLINE_EXCEEDED.value == before + 1
+    # clean failure: reservation rolled back, annotations reverted
+    assert cache.describe()["used_hbm_mib"] == 0
+    live = fc.get_pod("default", "p")
+    assert contract.chip_ids_from_annotations(live) is None
+
+
+# -- /healthz + /readyz -------------------------------------------------------
+
+def test_readyz_gates_on_cache_build_and_reports_degraded_state():
+    import json
+    import urllib.error
+    import urllib.request
+
+    from tpushare.extender.server import ExtenderServer
+    from tpushare.k8s import Informer
+
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=16000)
+    br = fast_breaker(reset_timeout_s=60.0)
+    cl = harden(fc, breaker=br)
+    informer = Informer(cl).start()
+    cache = SchedulerCache(cl, node_lister=informer.nodes)
+    srv = ExtenderServer(cache, cl, host="127.0.0.1", port=0,
+                         informer=informer, breaker=br)
+    port = srv.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/readyz", timeout=5)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["ready"] is False and body["cache_built"] is False
+
+        cache.build_cache()
+        with urllib.request.urlopen(f"{base}/readyz", timeout=5) as r:
+            body = json.loads(r.read())
+        assert r.status == 200 and body["ready"] is True
+        assert body["informer_synced"] is True
+        assert body["breaker_state"] == "closed"
+        assert body["informer_staleness_s"] is not None
+
+        # liveness stays dumb: still 200 whatever the breaker says
+        for _ in range(3):
+            br.record_failure()
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert r.status == 200
+        # readiness stays 200 too (degraded mode still serves Filter)
+        # but reports the open circuit
+        with urllib.request.urlopen(f"{base}/readyz", timeout=5) as r:
+            body = json.loads(r.read())
+        assert body["breaker_state"] == "open" and body["degraded"] is True
+
+        # /metrics exposes the breaker gauge
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            exposed = r.read().decode()
+        assert "tpushare_breaker_state 2.0" in exposed
+    finally:
+        srv.stop()
+        informer.stop()

@@ -94,6 +94,10 @@ class SchedulerCache:
         self._gen_lock = threading.Lock()
         self._memo: dict[str, _MemoEntry] = {}
         self._memo_lock = threading.Lock()
+        # flipped by build_cache: /readyz refuses traffic until the
+        # startup replay has reconstructed chip assignments (a bind
+        # against an un-replayed cache could oversubscribe)
+        self.built = False
 
     def _bump_generation(self) -> None:
         """Wired as NodeInfo.on_dirty: ANY mutation of per-chip state —
@@ -311,9 +315,11 @@ class SchedulerCache:
             log.warning("cache: node %s for pod %s unavailable: %s",
                         node_name, podlib.pod_key(pod), e)
             return
-        # update = remove + re-add (annotations may have changed)
-        info.remove_pod(pod)
-        if info.add_or_update_pod(pod):
+        # update = remove + re-add (annotations may have changed) — ONE
+        # lock acquisition (NodeInfo.sync_pod): a gap between the two
+        # would let a concurrent bind binpack into the phantom free
+        # space and oversubscribe the chip for real
+        if info.sync_pod(pod):
             with self._lock:
                 self._known_pods[podlib.pod_cache_key(pod)] = pod
 
@@ -361,6 +367,7 @@ class SchedulerCache:
             replayed += 1
         log.info("cache: replayed %d assigned pods onto %d nodes",
                  replayed, len(self._nodes))
+        self.built = True
         return replayed
 
     def _replay_node_pods(self, info: NodeInfo) -> None:

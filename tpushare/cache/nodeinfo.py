@@ -763,6 +763,31 @@ class NodeInfo:
             self._dirty()
         return True
 
+    def sync_pod(self, pod: dict[str, Any]) -> bool:
+        """Atomic remove + re-add from annotations — the controller's
+        update path. The two-call version (remove_pod, then
+        add_or_update_pod, each taking the lock separately) opened a
+        window in which a concurrent bind's placement saw the chip
+        WITHOUT this pod and binpacked into the phantom free space; the
+        re-add then restored the entry and the chip was really
+        oversubscribed on the apiserver (tightest-fit packing steers
+        binds toward exactly the nearly-full chips that sync churns, so
+        the chaos soak hit this reliably). No tombstone is written: the
+        pod is live — this is an update, not a departure. Returns True
+        if the pod occupies chips here."""
+        ids = contract.chip_ids_from_annotations(pod)
+        hbm = contract.hbm_from_annotations(pod)
+        key = podlib.pod_cache_key(pod)
+        with self._lock:
+            for c in self.chips:
+                c.remove_pod(key)
+            if ids is not None:
+                for cid in ids:
+                    if 0 <= cid < len(self.chips):
+                        self.chips[cid].add_pod(key, hbm)
+            self._dirty()
+        return ids is not None
+
     def remove_pod(self, pod: dict[str, Any]) -> None:
         key = podlib.pod_cache_key(pod)
         with self._lock:

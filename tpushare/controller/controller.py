@@ -217,6 +217,18 @@ class Controller:
                 log.warning("controller: resync failed: %s", e)
 
     def resync_once(self) -> None:
+        # Snapshot the stash BEFORE the LIST: only a pod observed before
+        # the LIST and absent from it is provably gone. A pod created
+        # (and bound) AFTER the LIST lands in _seen via its watch event
+        # while this loop runs — judging that newer stash against the
+        # older LIST flagged it "missed DELETED" and freed a LIVE bound
+        # pod's chips, which the next bind then double-booked (real
+        # oversubscription; caught by the chaos soak's churn storm).
+        # Such a pod is simply not a candidate this round; a genuinely
+        # deleted pod is caught by the NEXT resync, whose pre-snapshot
+        # will contain it.
+        with self._seen_lock:
+            pre = dict(self._seen)
         pods = self._cluster.list_pods()
         live: dict[str, str] = {}
         for pod in pods:
@@ -227,11 +239,17 @@ class Controller:
             with self._seen_lock:
                 self._seen[key] = pod
             self._queue.add(key)
+        # uids never resurrect, so (pre-LIST stash, LIST) disagreement
+        # is conclusive for THAT uid regardless of later stash updates
+        stale = [(k, p) for k, p in pre.items()
+                 if live.get(k) != podlib.pod_uid(p)]
         with self._seen_lock:
-            stale = [(k, p) for k, p in self._seen.items()
-                     if live.get(k) != podlib.pod_uid(p)]
-            for k, _ in stale:
-                if k not in live:
+            for k, p in stale:
+                cur = self._seen.get(k)
+                if k not in live and cur is not None and \
+                        podlib.pod_uid(cur) == podlib.pod_uid(p):
+                    # drop the stash only if it still holds the same
+                    # uid we judged (a recreate's newer stash stays)
                     self._seen.pop(k, None)
         for _, pod in stale:
             self.cache.remove_pod(pod)  # missed DELETED / replaced UID

@@ -117,6 +117,24 @@ def main(argv: list[str] | None = None) -> int:
     from tpushare.k8s.informer import Informer
     from tpushare.k8s.stats import CountingCluster
     cluster = CountingCluster(cluster)
+    # same fault-containment stack as the extender (k8s/breaker.py):
+    # the plugin's write paths — node registration, assigned-flag CAS,
+    # health configmap, gc reclaim — retry transient failures within a
+    # budget and fail fast while the apiserver circuit is open. The
+    # periodic loops (health_loop, kubelet re-registration) then act as
+    # the queue: a write refused this tick is re-attempted next tick
+    # instead of being lost.
+    from tpushare.k8s.breaker import CircuitBreaker, harden
+    from tpushare.k8s.retry import RetryPolicy
+    cluster = harden(
+        cluster,
+        breaker=CircuitBreaker(
+            failure_threshold=int(os.environ.get(
+                "TPUSHARE_BREAKER_THRESHOLD", "5")),
+            reset_timeout_s=float(os.environ.get(
+                "TPUSHARE_BREAKER_RESET_S", "5.0"))),
+        policy=RetryPolicy(max_attempts=int(os.environ.get(
+            "TPUSHARE_RETRY_BUDGET", "4"))))
     informer = None
     if not args.no_informer:
         informer = Informer(cluster).start()

@@ -96,6 +96,20 @@ def main(argv: list[str] | None = None) -> int:
     # operator verifies the hot path stays off the apiserver
     from tpushare.k8s.stats import CountingCluster
     cluster = CountingCluster(cluster)
+    # write-path fault containment (docs/ops.md): a circuit breaker over
+    # every request/response verb plus deadline-bounded retries with
+    # exponential backoff. Counting sits INSIDE so every real attempt is
+    # one counted round-trip (write amplification stays observable), and
+    # watches bypass both layers (their healing is reconnect+relist).
+    from tpushare.k8s.breaker import CircuitBreaker, harden
+    from tpushare.k8s.retry import RetryPolicy
+    breaker = CircuitBreaker(
+        failure_threshold=int(os.environ.get(
+            "TPUSHARE_BREAKER_THRESHOLD", "5")),
+        reset_timeout_s=float(os.environ.get(
+            "TPUSHARE_BREAKER_RESET_S", "5.0")))
+    cluster = harden(cluster, breaker=breaker, policy=RetryPolicy(
+        max_attempts=int(os.environ.get("TPUSHARE_RETRY_BUDGET", "4"))))
     # read-path informer: watch-warmed pod/node listers serve Bind's pod
     # fetch and the cache's lazy node fetch, so the scheduling hot path
     # issues no synchronous apiserver reads (fallback on miss only)
@@ -136,7 +150,8 @@ def main(argv: list[str] | None = None) -> int:
     server = ExtenderServer(cache, cluster, registry,
                             host=args.host, port=args.port,
                             allow_debug_seed=bool(args.fake_nodes),
-                            elector=elector, informer=informer)
+                            elector=elector, informer=informer,
+                            breaker=breaker)
     register_cache_gauges(registry, cache)
     # abandoned-gang expiry rides the controller's 30 s anti-entropy
     # heartbeat (docs/designs/multihost-gang.md protocol step 5)

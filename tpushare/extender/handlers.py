@@ -26,12 +26,33 @@ from tpushare.cache.nodeinfo import no_fit_reason, request_from_pod
 from tpushare.contract import pod as podlib
 from tpushare.core.placement import fragmentation, utilization_pct
 from tpushare.extender.metrics import LATENCY_BUCKETS, Registry
+from tpushare.k8s.breaker import OPEN as BREAKER_IS_OPEN
 from tpushare.k8s.client import ApiError
 from tpushare.k8s.informer import LISTER_REQUESTS
+from tpushare.k8s.retry import DeadlineExceeded
 from tpushare.k8s.singleflight import Singleflight
 from tpushare.k8s.stats import api_origin
+from tpushare.metrics import Counter, LabeledCounter
 
 log = logging.getLogger("tpushare.extender")
+
+# process-wide (the CLAIM_CAS_RETRIES pattern; attached to the registry
+# by register_cache_gauges): the fault-containment observability set.
+BIND_DEADLINE_EXCEEDED = Counter(
+    "tpushare_bind_deadline_exceeded_total",
+    "Binds abandoned because the per-request deadline expired before "
+    "the apiserver writes could complete (alert: the scheduler is "
+    "giving up on webhook calls; check breaker_state and retry totals)")
+BIND_FASTFAIL = Counter(
+    "tpushare_bind_fastfail_total",
+    "Binds refused immediately because the apiserver circuit was open "
+    "(degraded mode: fail fast instead of burning the webhook timeout)")
+DEGRADED_SERVES = LabeledCounter(
+    "tpushare_degraded_serves_total",
+    "Webhook calls served from the informer-warmed cache while the "
+    "apiserver circuit was open (answers are bounded-stale; the bound "
+    "is the informer staleness /readyz reports)",
+    ("verb",))
 
 
 class FilterHandler:
@@ -39,9 +60,15 @@ class FilterHandler:
     (reference Predicate.Handler, predicate.go:15-39)."""
 
     def __init__(self, cache: SchedulerCache, registry: Registry,
-                 gang=None) -> None:
+                 gang=None, breaker=None, staleness_fn=None) -> None:
         self._cache = cache
         self._gang = gang  # GangCoordinator | None
+        # degraded mode: when the apiserver circuit is open this verb
+        # keeps answering from the informer-warmed cache — correct up to
+        # the staleness bound staleness_fn reports — and the serve is
+        # counted so operators can see how much traffic ran degraded
+        self._breaker = breaker
+        self._staleness_fn = staleness_fn
         self._filter_total = registry.counter(
             "tpushare_filter_requests_total", "Filter webhook calls")
         self._filter_latency = registry.histogram(
@@ -54,6 +81,13 @@ class FilterHandler:
     def _handle(self, args: dict[str, Any]) -> dict[str, Any]:
         t0 = time.perf_counter()
         self._filter_total.inc()
+        if self._breaker is not None and \
+                self._breaker.state == BREAKER_IS_OPEN:
+            DEGRADED_SERVES.inc("filter")
+            stale = self._staleness_fn() if self._staleness_fn else None
+            log.debug("filter: serving degraded from cache (apiserver "
+                      "circuit open; staleness bound %s s)",
+                      f"{stale:.1f}" if stale is not None else "unknown")
         pod = args.get("Pod") or {}
         node_names = args.get("NodeNames")
         if node_names is None:
@@ -134,8 +168,10 @@ class PrioritizeHandler:
 
     MAX_PRIORITY = 10  # k8s MaxExtenderPriority
 
-    def __init__(self, cache: SchedulerCache, registry: Registry) -> None:
+    def __init__(self, cache: SchedulerCache, registry: Registry,
+                 breaker=None) -> None:
         self._cache = cache
+        self._breaker = breaker  # degraded-mode accounting, like Filter
         self._prioritize_total = registry.counter(
             "tpushare_prioritize_requests_total", "Prioritize webhook calls")
         self._prioritize_latency = registry.histogram(
@@ -149,6 +185,9 @@ class PrioritizeHandler:
     def _handle(self, args: dict[str, Any]) -> list[dict[str, Any]]:
         t0 = time.perf_counter()
         self._prioritize_total.inc()
+        if self._breaker is not None and \
+                self._breaker.state == BREAKER_IS_OPEN:
+            DEGRADED_SERVES.inc("prioritize")
         pod = args.get("Pod") or {}
         node_names = args.get("NodeNames")
         if node_names is None:
@@ -363,11 +402,16 @@ class BindHandler:
 
     def __init__(self, cache: SchedulerCache, cluster,
                  registry: Registry, ha_claims: bool = False,
-                 gang=None, pod_lister=None) -> None:
+                 gang=None, pod_lister=None, breaker=None) -> None:
         self._cache = cache
         self._cluster = cluster
         self._ha_claims = ha_claims
         self._gang = gang  # GangCoordinator | None
+        # degraded mode: an open apiserver circuit makes every bind
+        # write doomed — refuse up front (distinct error, ~0 ms) instead
+        # of reserving chips, failing the writes, and rolling back while
+        # the scheduler's webhook timeout burns
+        self._breaker = breaker
         # watch-warmed pod store (k8s/informer.py): bind-path pod reads
         # are answered locally, with the apiserver GET kept only as the
         # miss/UID-mismatch fallback — coalesced so duplicate deliveries
@@ -399,6 +443,21 @@ class BindHandler:
         name = args.get("PodName", "")
         uid = args.get("PodUID", "")
         node = args.get("Node", "")
+        if self._breaker is not None and \
+                self._breaker.state == BREAKER_IS_OPEN:
+            # fail fast with a DISTINCT error: the scheduler re-binds
+            # after its own timeout, by which time the breaker's probe
+            # may have closed the circuit. No failure event (the event
+            # POST would fail-fast too) and no chip reservation churn.
+            BIND_FASTFAIL.inc()
+            self.bind_failures.inc()
+            self.bind_latency.observe(time.perf_counter() - t0)
+            log.warning("bind %s/%s -> %s refused fast: apiserver "
+                        "circuit open", ns, name, node)
+            return {"Error":
+                    f"degraded: apiserver circuit open; bind of "
+                    f"{ns}/{name} refused without burning the webhook "
+                    f"timeout (retry after breaker reset)"}
         err: Exception | None = None
         placement = None
         bound_node = ""
@@ -441,6 +500,13 @@ class BindHandler:
             return {"Error": str(e)}
         except (AllocationError, ApiError) as e:
             self.bind_failures.inc()
+            if isinstance(e, DeadlineExceeded) or \
+                    isinstance(getattr(e, "__cause__", None),
+                               DeadlineExceeded):
+                # the deadline tripped mid-write (possibly wrapped into
+                # an AllocationError by the rollback path): the headline
+                # "every bind resolves within its deadline" counter
+                BIND_DEADLINE_EXCEEDED.inc()
             err = e
         finally:
             # latency observed on EVERY exit (including unexpected
@@ -562,10 +628,20 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
     from tpushare.cache.nodeinfo import CLAIM_CAS_RETRIES
     from tpushare.k8s.informer import (
         INFORMER_EVENTS, INFORMER_RELISTS, LISTER_REQUESTS as _LISTER)
+    from tpushare.k8s.retry import (
+        DEADLINE_EXCEEDED_TOTAL, RETRY_ATTEMPTS, RETRY_BUDGET_EXHAUSTED)
     from tpushare.k8s.singleflight import SINGLEFLIGHT_TOTAL
     from tpushare.k8s.stats import APISERVER_REQUESTS
 
     registry.register(CLAIM_CAS_RETRIES)
+    # fault-containment set: retry volume, budget exhaustion, deadline
+    # hits, degraded serves — what docs/ops.md says to alert on
+    registry.register(RETRY_ATTEMPTS)
+    registry.register(RETRY_BUDGET_EXHAUSTED)
+    registry.register(DEADLINE_EXCEEDED_TOTAL)
+    registry.register(BIND_DEADLINE_EXCEEDED)
+    registry.register(BIND_FASTFAIL)
+    registry.register(DEGRADED_SERVES)
     # the read-path observability set: apiserver round-trips per verb,
     # lister hit/miss, memo hit/miss, singleflight coalescing — the
     # counters that PROVE the hot path stays off the apiserver

@@ -34,19 +34,40 @@ PREFIX = "/tpushare-scheduler"
 
 
 class ExtenderServer:
+    # per-request deadline default: safely under the kube-scheduler's
+    # extender httpTimeout (30 s in config/kube-scheduler-config.yaml) —
+    # retries stop, and the webhook answers, BEFORE the caller hangs up
+    DEFAULT_REQUEST_DEADLINE_S = 25.0
+
     def __init__(self, cache, cluster, registry: Registry | None = None,
                  host: str = "0.0.0.0", port: int = 39999,
                  allow_debug_seed: bool = False,
-                 elector=None, informer=None) -> None:
+                 elector=None, informer=None, breaker=None,
+                 request_deadline_s: float | None = None) -> None:
         self.registry = registry or Registry()
+        self._cache = cache
+        self._informer = informer
+        # apiserver circuit breaker (k8s/breaker.py): Bind fail-fasts
+        # while it is open, Filter/Prioritize count degraded serves, and
+        # /readyz reports its state. None = no degraded-mode wiring.
+        self._breaker = breaker
+        if request_deadline_s is None:
+            import os
+            request_deadline_s = float(os.environ.get(
+                "TPUSHARE_REQUEST_DEADLINE_S",
+                self.DEFAULT_REQUEST_DEADLINE_S))
+        self.request_deadline_s = request_deadline_s
+        staleness_fn = informer.staleness_s if informer is not None else None
         # multi-host gang placement (docs/designs/multihost-gang.md):
         # engages only for pods carrying the gang annotations, on nodes
         # labeled into slices — zero cost otherwise
         from tpushare.cache.gang import GangCoordinator
         self.gang = GangCoordinator(cache)
         self.filter_handler = FilterHandler(cache, self.registry,
-                                            gang=self.gang)
-        self.prioritize_handler = PrioritizeHandler(cache, self.registry)
+                                            gang=self.gang, breaker=breaker,
+                                            staleness_fn=staleness_fn)
+        self.prioritize_handler = PrioritizeHandler(cache, self.registry,
+                                                    breaker=breaker)
         self.preempt_handler = PreemptHandler(cache, self.registry)
         # HA (an elector is wired): binds also CAS a per-node claim so two
         # replicas in a stale-leader window cannot co-place onto one chip;
@@ -57,8 +78,12 @@ class ExtenderServer:
         self.bind_handler = BindHandler(
             cache, cluster, self.registry,
             ha_claims=elector is not None, gang=self.gang,
-            pod_lister=informer.pods if informer is not None else None)
+            pod_lister=informer.pods if informer is not None else None,
+            breaker=breaker)
         self.inspect_handler = InspectHandler(cache)
+        if breaker is not None:
+            from tpushare.k8s.breaker import register_breaker_gauge
+            register_breaker_gauge(self.registry, breaker)
         self.host, self.port = host, port
         self._httpd: ThreadingHTTPServer | None = None
         # development-mode only (--fake-nodes): lets an operator seed pods
@@ -106,37 +131,45 @@ class ExtenderServer:
                     # Content-Length bytes in the socket would make the
                     # leftover body parse as the next request line
                     args = self._read_json()
-                    if self.path == f"{PREFIX}/filter":
-                        self._reply(200, server_self.filter_handler.handle(args))
-                    elif self.path == f"{PREFIX}/prioritize":
-                        self._reply(
-                            200,
-                            server_self.prioritize_handler.handle(args))
-                    elif self.path == f"{PREFIX}/preempt":
-                        self._reply(
-                            200, server_self.preempt_handler.handle(args))
-                    elif self.path == f"{PREFIX}/bind":
-                        if server_self._elector is not None and \
-                                not server_self._elector.is_leader():
-                            # retryable: the default scheduler re-binds
-                            # after its timeout and reaches the leader
-                            self._reply(503, {
-                                "Error": "not the leader; retry"})
-                            return
-                        result = server_self.bind_handler.handle(args)
-                        # reference returns 500 on bind failure (routes.go:139)
-                        self._reply(500 if result.get("Error") else 200, result)
-                    elif self.path == "/debug/pods" and server_self._seed_cluster:
-                        pod = server_self._seed_cluster.create_pod(args)
-                        self._reply(201, pod)
-                    else:
-                        self._reply(404, {"error": f"no route {self.path}"})
+                    # stamp the per-request deadline: every retry loop
+                    # underneath this handler (k8s/retry.py) consults it
+                    # and stops before the scheduler's httpTimeout fires
+                    from tpushare.k8s.retry import request_deadline
+                    with request_deadline(server_self.request_deadline_s):
+                        self._do_post_routed(args)
                 except json.JSONDecodeError as e:
                     self._reply(400, {"error": f"bad JSON: {e}"})
                 except Exception as e:  # noqa: BLE001 — webhook must answer
                     log.error("POST %s crashed: %s\n%s", self.path, e,
                               traceback.format_exc())
                     self._reply(500, {"Error": f"internal error: {e}"})
+
+            def _do_post_routed(self, args):
+                if self.path == f"{PREFIX}/filter":
+                    self._reply(200, server_self.filter_handler.handle(args))
+                elif self.path == f"{PREFIX}/prioritize":
+                    self._reply(
+                        200,
+                        server_self.prioritize_handler.handle(args))
+                elif self.path == f"{PREFIX}/preempt":
+                    self._reply(
+                        200, server_self.preempt_handler.handle(args))
+                elif self.path == f"{PREFIX}/bind":
+                    if server_self._elector is not None and \
+                            not server_self._elector.is_leader():
+                        # retryable: the default scheduler re-binds
+                        # after its timeout and reaches the leader
+                        self._reply(503, {
+                            "Error": "not the leader; retry"})
+                        return
+                    result = server_self.bind_handler.handle(args)
+                    # reference returns 500 on bind failure (routes.go:139)
+                    self._reply(500 if result.get("Error") else 200, result)
+                elif self.path == "/debug/pods" and server_self._seed_cluster:
+                    pod = server_self._seed_cluster.create_pod(args)
+                    self._reply(201, pod)
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
 
             def do_GET(self):
                 try:
@@ -147,7 +180,14 @@ class ExtenderServer:
                             info["identity"] = server_self._elector.identity
                         self._reply(200, info)
                     elif self.path == "/healthz":
+                        # liveness only: the process is up and serving.
+                        # Everything state-dependent belongs to /readyz —
+                        # restarting a pod because the APISERVER browned
+                        # out would make the outage strictly worse.
                         self._reply(200, "ok", content_type="text/plain")
+                    elif self.path == "/readyz":
+                        ready, body = server_self.readiness()
+                        self._reply(200 if ready else 503, body)
                     elif self.path == "/metrics":
                         self._reply(200, server_self.registry.expose(),
                                     content_type="text/plain; version=0.0.4")
@@ -188,6 +228,37 @@ class ExtenderServer:
                     self._reply(500, {"error": str(e)})
 
         return Handler
+
+    # -- readiness ------------------------------------------------------------
+
+    def readiness(self) -> tuple[bool, dict[str, Any]]:
+        """The /readyz verdict + report.
+
+        Ready = the startup cache replay completed and the informer's
+        initial sync happened (when one is wired) — the two conditions
+        under which a served verdict cannot oversubscribe. Breaker state
+        and informer staleness are REPORTED but do not gate readiness:
+        an open circuit means degraded mode (Filter still serves from
+        cache; Bind fail-fasts with an honest error), and flipping the
+        replica unready then would take even the degraded service away.
+        """
+        cache_built = bool(getattr(self._cache, "built", True))
+        informer_synced = (self._informer.synced
+                          if self._informer is not None else None)
+        staleness = (self._informer.staleness_s()
+                     if self._informer is not None else None)
+        breaker_state = (self._breaker.state
+                         if self._breaker is not None else None)
+        ready = cache_built and informer_synced is not False
+        return ready, {
+            "ready": ready,
+            "cache_built": cache_built,
+            "informer_synced": informer_synced,
+            "informer_staleness_s": (round(staleness, 3)
+                                     if staleness is not None else None),
+            "breaker_state": breaker_state,
+            "degraded": breaker_state == "open",
+        }
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -15,13 +15,28 @@ needs, in two implementations:
 Everything speaks dict-shaped JSON objects; no typed model classes.
 """
 
+from tpushare.k8s.breaker import (
+    BreakerCluster,
+    BreakerOpenError,
+    CircuitBreaker,
+    harden,
+)
 from tpushare.k8s.chaos import ChaosCluster
 from tpushare.k8s.client import ApiError, ClusterClient, WatchEvent
 from tpushare.k8s.fake import FakeCluster
 from tpushare.k8s.informer import Informer, NodeLister, PodLister
+from tpushare.k8s.retry import (
+    DeadlineExceeded,
+    RetryingCluster,
+    RetryPolicy,
+    request_deadline,
+)
 from tpushare.k8s.singleflight import Singleflight
 from tpushare.k8s.stats import CountingCluster, api_origin
 
 __all__ = ["ApiError", "ChaosCluster", "ClusterClient", "WatchEvent",
            "FakeCluster", "Informer", "NodeLister", "PodLister",
-           "Singleflight", "CountingCluster", "api_origin"]
+           "Singleflight", "CountingCluster", "api_origin",
+           "RetryPolicy", "RetryingCluster", "DeadlineExceeded",
+           "request_deadline", "CircuitBreaker", "BreakerCluster",
+           "BreakerOpenError", "harden"]

@@ -1,0 +1,253 @@
+"""Circuit breaker over the cluster-client surface.
+
+During an apiserver brownout every write pays its full retry budget
+before failing — N binds in flight means N * budget doomed round-trips
+against a server that is already drowning, and every Bind burns most of
+the kube-scheduler's webhook timeout before reporting anything. The
+breaker converts that into *fault containment*:
+
+- **closed** — traffic flows; consecutive transport-level failures
+  (network errors, 5xx, 429) are counted, any success resets the count;
+- **open** — after ``failure_threshold`` consecutive failures (or the
+  rolling error rate crossing ``error_rate_threshold`` with enough
+  samples) calls fail fast with :class:`BreakerOpenError` and zero
+  round-trips, for ``reset_timeout_s``;
+- **half-open** — after the cooldown, up to ``probe_calls`` trial calls
+  pass through; ``probe_successes`` consecutive successes close the
+  breaker, any failure re-opens it.
+
+What counts as a failure is deliberately narrow: 409/404/403 are
+*successful communication* carrying a correctness verdict — only
+status 0 (network), 5xx, and 429 indicate the apiserver itself is in
+trouble.
+
+Degraded mode while open (wired in extender/server.py + handlers.py):
+Filter/Prioritize keep serving from the informer-warmed cache (their
+verdicts are cache reads; the staleness bound is whatever the informer
+reports), Bind fails fast with the distinct BreakerOpenError instead of
+burning the webhook timeout, and the device plugin's write paths
+queue-and-retry behind the same breaker on their periodic loops.
+
+Layering: :func:`harden` composes the canonical stack
+``RetryingCluster(BreakerCluster(inner))`` — the breaker sits INSIDE the
+retry loop so every real attempt reports one outcome to it, and a
+breaker-open fast-fail is classified non-retryable and surfaces
+immediately instead of being retried against a known-bad server.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable
+
+from tpushare.k8s.client import ApiError
+from tpushare.metrics import Counter, LabeledCounter
+
+BREAKER_TRANSITIONS = LabeledCounter(
+    "tpushare_breaker_transitions_total",
+    "Circuit-breaker state transitions (open->half_open->closed is the "
+    "healthy recovery path; repeated closed->open flapping means the "
+    "apiserver is oscillating)",
+    ("from_state", "to_state"))
+BREAKER_FASTFAIL = Counter(
+    "tpushare_breaker_fastfail_total",
+    "Calls refused locally (zero round-trips) while the breaker was open")
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+_STATE_VALUE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class BreakerOpenError(ApiError):
+    """Fail-fast refusal: the apiserver is considered down and this call
+    was never sent. Distinct from a real apiserver error so Bind can
+    answer the webhook immediately with an honest reason. Never retried
+    (is_retryable special-cases it) — retrying a refusal would just spin
+    on the local breaker."""
+
+    breaker_open = True  # retry.is_retryable keys on this, not the type
+    # (no import edge: breaker -> retry exists only lazily in harden())
+
+    def __init__(self, message: str):
+        super().__init__(0, message)
+
+
+def _is_transport_failure(e: ApiError) -> bool:
+    # BreakerOpenError is status 0 but represents NO round-trip: it must
+    # not feed back into the failure count that opened the breaker.
+    if isinstance(e, BreakerOpenError):
+        return False
+    return e.status == 0 or e.status == 429 or e.status >= 500
+
+
+class CircuitBreaker:
+    """State machine + outcome accounting, shared by every verb of one
+    cluster client (the apiserver is one backend; per-verb breakers
+    would each have to rediscover the same outage)."""
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 5.0,
+                 probe_calls: int = 2,
+                 probe_successes: int = 2,
+                 error_rate_threshold: float | None = 0.5,
+                 window: int = 20,
+                 min_samples: int = 10,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.probe_calls = probe_calls
+        self.probe_successes = probe_successes
+        self.error_rate_threshold = error_rate_threshold
+        self.min_samples = min_samples
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = 0
+        self._probe_ok = 0
+        self._outcomes: collections.deque[bool] = collections.deque(
+            maxlen=window)
+
+    # -- observability --------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def state_value(self) -> float:
+        """0 = closed, 1 = half-open, 2 = open (the breaker_state gauge)."""
+        return _STATE_VALUE[self.state]
+
+    # -- state machine --------------------------------------------------------
+
+    def _transition_locked(self, to: str) -> None:
+        if to == self._state:
+            return
+        BREAKER_TRANSITIONS.inc(self._state, to)
+        self._state = to
+        if to == OPEN:
+            self._opened_at = self._clock()
+        elif to == HALF_OPEN:
+            self._probe_inflight = 0
+            self._probe_ok = 0
+        elif to == CLOSED:
+            self._consecutive_failures = 0
+            self._outcomes.clear()
+
+    def _maybe_half_open_locked(self) -> None:
+        if self._state == OPEN and \
+                self._clock() - self._opened_at >= self.reset_timeout_s:
+            self._transition_locked(HALF_OPEN)
+
+    def allow(self) -> bool:
+        """Admission check for one call; half-open admits at most
+        ``probe_calls`` concurrent probes (the rest fail fast so a
+        thundering herd cannot re-drown a recovering apiserver)."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                return False
+            if self._probe_inflight >= self.probe_calls:
+                return False
+            self._probe_inflight += 1
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._outcomes.append(True)
+            if self._state == HALF_OPEN:
+                self._probe_inflight = max(0, self._probe_inflight - 1)
+                self._probe_ok += 1
+                if self._probe_ok >= self.probe_successes:
+                    self._transition_locked(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # the probe proved the backend is still down
+                self._probe_inflight = max(0, self._probe_inflight - 1)
+                self._transition_locked(OPEN)
+                return
+            self._consecutive_failures += 1
+            self._outcomes.append(False)
+            trip = self._consecutive_failures >= self.failure_threshold
+            if not trip and self.error_rate_threshold is not None \
+                    and len(self._outcomes) >= self.min_samples:
+                failures = sum(1 for ok in self._outcomes if not ok)
+                trip = failures / len(self._outcomes) \
+                    >= self.error_rate_threshold
+            if trip and self._state == CLOSED:
+                self._transition_locked(OPEN)
+
+
+# watches are exempt: a breaker-refused watch would silently freeze the
+# informer, which is the exact component degraded mode depends on
+_GUARDED_VERBS = frozenset({
+    "list_pods", "get_pod", "list_nodes", "get_node", "get_configmap",
+    "patch_pod", "replace_pod", "bind_pod", "create_event", "patch_node",
+    "put_configmap", "get_lease", "create_lease", "update_lease",
+})
+
+
+class BreakerCluster:
+    """Transparent ClusterClient proxy feeding call outcomes into a
+    shared :class:`CircuitBreaker` and fail-fasting while it is open."""
+
+    def __init__(self, inner: Any,
+                 breaker: CircuitBreaker | None = None) -> None:
+        self._inner = inner
+        self.breaker = breaker or CircuitBreaker()
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._inner, name)
+        if name not in _GUARDED_VERBS or not callable(attr):
+            return attr
+
+        def guarded(*args: Any, **kwargs: Any) -> Any:
+            if not self.breaker.allow():
+                BREAKER_FASTFAIL.inc()
+                raise BreakerOpenError(
+                    f"{name}: apiserver circuit open (failing fast; "
+                    f"reset probe in <= {self.breaker.reset_timeout_s}s)")
+            try:
+                result = attr(*args, **kwargs)
+            except ApiError as e:
+                if _is_transport_failure(e):
+                    self.breaker.record_failure()
+                else:
+                    self.breaker.record_success()  # server answered
+                raise
+            self.breaker.record_success()
+            return result
+        return guarded
+
+
+def harden(cluster: Any, breaker: CircuitBreaker | None = None,
+           policy=None):
+    """The canonical fault-containment stack over any cluster client:
+    retries outside, breaker inside, so each real attempt is one breaker
+    outcome and an open breaker stops the retry loop immediately.
+    Returns the wrapped client; reach the breaker via ``.breaker`` on
+    the inner proxy or pass your own instance."""
+    from tpushare.k8s.retry import RetryingCluster
+    return RetryingCluster(BreakerCluster(cluster, breaker), policy)
+
+
+def register_breaker_gauge(registry, breaker: CircuitBreaker) -> None:
+    """Expose ``tpushare_breaker_state`` (0 closed / 1 half-open /
+    2 open) plus the transition/fast-fail counters on a Registry."""
+    registry.gauge_func(
+        "tpushare_breaker_state",
+        "Apiserver circuit state: 0 closed, 1 half-open, 2 open "
+        "(alert on sustained 2: binds are failing fast and Filter "
+        "serves from the informer cache)",
+        lambda: [("", breaker.state_value())])
+    registry.register(BREAKER_TRANSITIONS)
+    registry.register(BREAKER_FASTFAIL)

@@ -28,7 +28,7 @@ import random
 import threading
 import time
 from collections import Counter
-from typing import Any
+from typing import Any, Callable
 
 from tpushare.k8s.client import ApiError
 
@@ -38,12 +38,14 @@ _WATCH_KINDS = {"pods": "watch_pods", "nodes": "watch_nodes",
 
 class _Rule:
     __slots__ = ("action", "status", "message", "seconds", "after",
-                 "remaining", "probability")
+                 "remaining", "probability", "retry_after", "prob_fn")
 
     def __init__(self, action: str, *, status: int = 500,
                  message: str | None = None, seconds: float = 0.0,
                  after: int = 0, times: int | None = 1,
-                 probability: float = 1.0) -> None:
+                 probability: float = 1.0,
+                 retry_after: float | None = None,
+                 prob_fn: Callable[[], float | None] | None = None) -> None:
         self.action = action          # "fail" | "delay" | "drop"
         self.status = status
         self.message = message
@@ -51,6 +53,10 @@ class _Rule:
         self.after = after
         self.remaining = float("inf") if times is None else int(times)
         self.probability = probability
+        self.retry_after = retry_after  # attached to injected ApiErrors
+        # time-varying probability (brownout ramps); None return = the
+        # window is over and the rule is dead
+        self.prob_fn = prob_fn
 
 
 class ChaosCluster:
@@ -69,15 +75,41 @@ class ChaosCluster:
 
     def fail(self, method: str, *, status: int = 500,
              message: str | None = None, times: int | None = 1,
-             probability: float = 1.0) -> None:
+             probability: float = 1.0,
+             retry_after: float | None = None) -> None:
         """Make the next ``times`` calls of ``method`` raise
         ``ApiError(status)`` (each with ``probability``; times=None =
-        forever). At most one fail rule fires per call, so stacked rules
+        forever). ``retry_after`` rides on the error the way a 429's
+        Retry-After header would (``fail(..., status=429,
+        retry_after=0.2)`` is how the retry policy's header honoring is
+        tested). At most one fail rule fires per call, so stacked rules
         (e.g. a 500 rule and a 409 rule) take turns rather than the later
         ones being consumed-but-ignored."""
         self._check_not_watch(method)
         self._add(method, _Rule("fail", status=status, message=message,
-                                times=times, probability=probability))
+                                times=times, probability=probability,
+                                retry_after=retry_after))
+
+    def brownout(self, method: str, *, seconds: float, peak: float = 0.9,
+                 status: int = 500, retry_after: float | None = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        """A rolling apiserver brownout on ``method``: the failure
+        probability ramps 0 -> ``peak`` -> 0 over a ``seconds``-long
+        window (triangular ramp, starting now), then the rule dies. This
+        is the soak test's storm shape — a burst that worsens, crests,
+        and recedes, which is what exercises breaker open/half-open/close
+        transitions rather than a flat failure rate."""
+        self._check_not_watch(method)
+        t0 = clock()
+
+        def prob() -> float | None:
+            t = clock() - t0
+            if t >= seconds:
+                return None  # window over: rule is dead
+            return peak * (1.0 - abs(2.0 * t / seconds - 1.0))
+
+        self._add(method, _Rule("fail", status=status, times=None,
+                                retry_after=retry_after, prob_fn=prob))
 
     def delay(self, method: str, *, seconds: float,
               times: int | None = None, probability: float = 1.0) -> None:
@@ -124,8 +156,13 @@ class ChaosCluster:
                     continue
                 if rule.action == "fail" and fail_taken:
                     continue
-                if rule.probability < 1.0 and \
-                        self._rng.random() >= rule.probability:
+                p = rule.probability
+                if rule.prob_fn is not None:
+                    p = rule.prob_fn()
+                    if p is None:  # brownout window over: rule is dead
+                        rule.remaining = 0
+                        continue
+                if p < 1.0 and self._rng.random() >= p:
                     continue
                 rule.remaining -= 1
                 self.injected[method] += 1
@@ -156,7 +193,8 @@ class ChaosCluster:
                 raise ApiError(
                     failure.status,
                     failure.message or f"chaos: injected {failure.status} "
-                                       f"on {name}")
+                                       f"on {name}",
+                    retry_after=failure.retry_after)
             return fn(*args, **kwargs)
         return call
 

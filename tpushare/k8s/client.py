@@ -7,12 +7,18 @@ from typing import Any, Iterator, Protocol
 
 
 class ApiError(Exception):
-    """Kubernetes API failure with its HTTP status code."""
+    """Kubernetes API failure with its HTTP status code.
 
-    def __init__(self, status: int, message: str = ""):
+    ``retry_after`` carries the server's Retry-After header in seconds
+    when one was sent (429 priority-and-fairness rejections do); the
+    retry policy honors it over its own backoff curve."""
+
+    def __init__(self, status: int, message: str = "",
+                 retry_after: float | None = None):
         super().__init__(f"{status}: {message}" if message else str(status))
         self.status = status
         self.message = message
+        self.retry_after = retry_after
 
     @property
     def is_conflict(self) -> bool:  # optimistic-lock loser (409)

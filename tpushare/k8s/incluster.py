@@ -34,6 +34,18 @@ from tpushare.k8s.client import ApiError, WatchEvent
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
+def _parse_retry_after(raw: str | None) -> float | None:
+    """Retry-After in delta-seconds (the form the apiserver sends); the
+    HTTP-date form is ignored rather than misparsed."""
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v >= 0 else None
+
+
 class _ConnPool:
     """Keep-alive HTTP(S) connection pool for the request/response calls.
 
@@ -68,9 +80,17 @@ class _ConnPool:
         conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return conn
 
+    # verbs whose replay cannot duplicate a side effect: reads, and the
+    # PUT/PATCH writes that are CAS-guarded (resourceVersion) or
+    # last-writer-wins. POST is excluded — a binding or event POST whose
+    # response was lost may have LANDED, and a blind transport resend
+    # would duplicate it; those route through the retry policy
+    # (k8s/retry.py), whose call sites tolerate duplicates explicitly.
+    _REPLAY_SAFE = frozenset({"GET", "HEAD", "PUT", "PATCH", "DELETE"})
+
     def request(self, method: str, path: str, body: bytes | None,
                 headers: dict[str, str], timeout: float
-                ) -> tuple[int, bytes]:
+                ) -> tuple[int, bytes, str | None]:
         with self._lock:
             conn = self._idle.pop() if self._idle else None
         fresh = conn is None
@@ -86,14 +106,20 @@ class _ConnPool:
             data = resp.read()
         except (http.client.HTTPException, OSError):
             conn.close()
-            if fresh:
+            if fresh or method not in self._REPLAY_SAFE:
+                # a fresh-socket failure is a real transport error; a
+                # reused-socket failure on a non-idempotent verb is
+                # AMBIGUOUS (the request may have been processed before
+                # the connection died) — surface it rather than risk a
+                # duplicate POST, and let the retry policy decide
                 raise
             # stale keep-alive connection (apiserver idle-closed it):
-            # retry exactly once on a fresh socket
+            # safe-to-replay request, retry exactly once on a fresh socket
             conn = self._new_conn(timeout)
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
+        retry_after = resp.getheader("Retry-After")
         if resp.will_close:
             conn.close()
         else:
@@ -102,7 +128,7 @@ class _ConnPool:
                     self._idle.append(conn)
                 else:
                     conn.close()
-        return resp.status, data
+        return resp.status, data, retry_after
 
 
 class InClusterClient:
@@ -196,7 +222,8 @@ class InClusterClient:
                 detail = e.read().decode(errors="replace")[:512]
             except Exception:
                 pass
-            raise ApiError(e.code, detail) from None
+            raise ApiError(e.code, detail, retry_after=_parse_retry_after(
+                e.headers.get("Retry-After"))) from None
         except (urllib.error.URLError, socket.timeout, OSError) as e:
             raise ApiError(0, str(e)) from None
 
@@ -211,12 +238,13 @@ class InClusterClient:
             headers["Content-Type"] = content_type
         headers.update(self._auth_header())
         try:
-            status, raw = self._pool.request(
+            status, raw, retry_after = self._pool.request(
                 method, path, data, headers, self.timeout)
         except (http.client.HTTPException, OSError) as e:
             raise ApiError(0, str(e)) from None
         if status >= 400:
-            raise ApiError(status, raw.decode(errors="replace")[:512])
+            raise ApiError(status, raw.decode(errors="replace")[:512],
+                           retry_after=_parse_retry_after(retry_after))
         return json.loads(raw) if raw else {}
 
     # -- reads ---------------------------------------------------------------

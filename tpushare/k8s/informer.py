@@ -33,6 +33,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 from typing import Any
 
 from tpushare.contract.constants import ANN_GANG
@@ -221,6 +222,12 @@ class Informer:
         # last applied resourceVersion per resource (observability only;
         # rv resume itself lives in the client's watch implementation)
         self.last_rv: dict[str, str] = {}
+        # freshness: monotonic timestamp of the last moment each store
+        # was KNOWN current (a relist grounds it absolutely; an applied
+        # watch event proves the stream is alive). /readyz reports the
+        # worst-resource age as the degraded-mode staleness bound.
+        self._fresh_lock = threading.Lock()
+        self._last_fresh: dict[str, float] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -262,8 +269,25 @@ class Informer:
             return self._cluster.watch_pods(self._stop)
         return self._cluster.watch_nodes(self._stop)
 
+    def _mark_fresh(self, resource: str) -> None:
+        with self._fresh_lock:
+            self._last_fresh[resource] = time.monotonic()
+
+    def staleness_s(self) -> float | None:
+        """Age of the STALEST store's last freshness proof (relist or
+        applied event), or None before the initial sync. On a quiet
+        cluster this grows between events even though nothing was
+        missed — it is an upper BOUND on staleness, which is exactly
+        what degraded-mode consumers need to report honestly."""
+        with self._fresh_lock:
+            if len(self._last_fresh) < 2:  # pods + nodes
+                return None
+            oldest = min(self._last_fresh.values())
+        return max(0.0, time.monotonic() - oldest)
+
     def _relist(self, resource: str) -> None:
         self._store(resource).replace(self._list(resource))
+        self._mark_fresh(resource)
         INFORMER_RELISTS.inc(resource)
 
     def _run(self, resource: str) -> None:
@@ -279,6 +303,7 @@ class Informer:
                     rv = _meta(ev.object).get("resourceVersion")
                     if rv:
                         self.last_rv[resource] = rv
+                    self._mark_fresh(resource)
                     INFORMER_EVENTS.inc(resource)
                     failures = 0
             except Exception as e:  # noqa: BLE001 — the stream must heal

@@ -1,0 +1,240 @@
+"""Deadline-bounded retry policy for apiserver writes.
+
+The reference treats every transient apiserver failure as a terminal
+bind failure (SURVEY §5.3: no write-retry policy anywhere) — a single
+5xx during an apiserver brownout fails the bind and burns a full
+kube-scheduler webhook timeout before the pod is retried. This module is
+the write-path half of the fault-containment layer:
+
+- :class:`RetryPolicy` — exponential backoff with FULL jitter and a
+  per-operation attempt budget. Classification is strict:
+
+  * **409 is never retried at this level.** A conflict is an
+    optimistic-concurrency *correctness signal* (another writer moved the
+    object); replaying the same body would overwrite the winner. The
+    call sites that can retry a 409 safely (claim CAS, assigned-flag
+    CAS) re-read and re-validate first — that loop belongs to them.
+  * **429 honors ``Retry-After``** when the server sent one (the
+    apiserver's priority-and-fairness rejections do), falling back to
+    the computed backoff otherwise.
+  * **5xx and network errors (status 0)** retry within the budget.
+  * Everything else (4xx) surfaces immediately.
+
+- **Deadline propagation** — the extender's HTTP server stamps a
+  per-request deadline into a thread-local scope
+  (:func:`request_deadline`); the retry loop consults it and never
+  sleeps past the point where the caller has already given up, raising
+  :class:`DeadlineExceeded` instead of burning the webhook timeout.
+
+- :class:`RetryingCluster` — a transparent proxy applying the policy to
+  every ClusterClient request/response verb. Watches pass through
+  untouched (they have their own reconnect/relist healing in the client
+  and informer layers).
+
+POST replay safety: the transport layer (incluster.py) never auto-resends
+a POST on a reused-connection error — it surfaces ApiError(0) and THIS
+layer decides. Retrying here is safe because every POST the framework
+issues tolerates duplicates one level up: a duplicate binding POST gets
+409 and the bind path treats bound-to-the-requested-node as idempotent
+success; events use generateName and are best-effort; lease creation 409
+is the elector's normal lost-race path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tpushare.k8s.client import ApiError
+from tpushare.metrics import Counter, LabeledCounter
+
+# process-wide (the CLAIM_CAS_RETRIES pattern): attached to the extender
+# registry by register_cache_gauges so /metrics exposes them.
+RETRY_ATTEMPTS = LabeledCounter(
+    "tpushare_apiserver_retry_attempts_total",
+    "Transient-failure retries by verb and trigger status class "
+    "(each count is one EXTRA round-trip beyond the first attempt)",
+    ("verb", "status"))
+RETRY_BUDGET_EXHAUSTED = LabeledCounter(
+    "tpushare_retry_budget_exhausted_total",
+    "Operations that failed after spending their whole retry budget "
+    "(sustained growth = the apiserver is down harder than the budget "
+    "assumes; alert alongside breaker_state)",
+    ("verb",))
+DEADLINE_EXCEEDED_TOTAL = Counter(
+    "tpushare_request_deadline_exceeded_total",
+    "Apiserver operations abandoned because the caller's request "
+    "deadline left no room for another attempt")
+
+
+class DeadlineExceeded(ApiError):
+    """The per-request deadline expired before the operation could
+    complete (or before another retry attempt would fit). Status 504 so
+    existing ApiError handling (rollback, failure accounting) engages;
+    callers that care (BindHandler) distinguish it by type."""
+
+    def __init__(self, message: str = "request deadline exceeded"):
+        super().__init__(504, message)
+
+
+# -- per-request deadline scope (thread-local, like stats.api_origin) ---------
+
+_local = threading.local()
+
+
+def current_deadline() -> float | None:
+    """Monotonic deadline of the active request scope, or None."""
+    return getattr(_local, "deadline", None)
+
+
+def deadline_remaining(clock: Callable[[], float] = time.monotonic
+                       ) -> float | None:
+    """Seconds left in the active request scope (may be negative), or
+    None when no deadline is stamped."""
+    d = current_deadline()
+    return None if d is None else d - clock()
+
+
+class request_deadline:
+    """Stamp a deadline over everything this thread does inside the
+    scope::
+
+        with request_deadline(9.0):
+            handler.handle(args)   # retries stop before t0 + 9.0
+
+    Nested scopes only ever SHORTEN the deadline (an inner scope cannot
+    outlive its caller's patience). Usable as a context manager."""
+
+    def __init__(self, seconds: float | None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._seconds = seconds
+        self._clock = clock
+        self._prev: float | None = None
+
+    def __enter__(self) -> "request_deadline":
+        self._prev = getattr(_local, "deadline", None)
+        if self._seconds is not None:
+            mine = self._clock() + self._seconds
+            _local.deadline = mine if self._prev is None \
+                else min(self._prev, mine)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._prev is None:
+            if hasattr(_local, "deadline"):
+                del _local.deadline
+        else:
+            _local.deadline = self._prev
+
+
+# -- the policy ---------------------------------------------------------------
+
+def is_retryable(e: ApiError) -> bool:
+    """Transient-failure classification (see module docstring).
+    DeadlineExceeded is terminal by definition even though it rides a
+    5xx status, and a breaker fast-fail (no round-trip happened) must
+    surface immediately instead of spinning on the local breaker."""
+    if isinstance(e, DeadlineExceeded) or getattr(e, "breaker_open", False):
+        return False
+    return e.status == 0 or e.status == 429 or e.status >= 500
+
+
+def _status_class(e: ApiError) -> str:
+    if e.status == 0:
+        return "network"
+    if e.status == 429:
+        return "429"
+    return "5xx"
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff + full jitter with a per-operation budget.
+
+    ``max_attempts`` counts TOTAL attempts (first try included), so the
+    write amplification of one logical operation is bounded by it — the
+    invariant bench.py and the chaos soak check.
+    """
+
+    max_attempts: int = 4
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    rng: random.Random = field(default_factory=random.Random)
+    sleep: Callable[[float], None] = time.sleep
+    clock: Callable[[], float] = time.monotonic
+
+    def backoff_s(self, attempt: int, e: ApiError | None = None) -> float:
+        """Delay before attempt ``attempt + 1`` (0-based). Full jitter:
+        uniform in (0, min(cap, base * 2^attempt)] — a storm of binds
+        retrying after one apiserver blip must not re-arrive in
+        lockstep. A 429's Retry-After overrides the computed value (the
+        server knows its own overload better than our curve does)."""
+        if e is not None and e.status == 429 and \
+                getattr(e, "retry_after", None) is not None:
+            return float(e.retry_after)
+        cap = min(self.cap_s, self.base_s * (2 ** attempt))
+        return self.rng.uniform(0.0, cap) if cap > 0 else 0.0
+
+    def call(self, fn: Callable[[], Any], verb: str = "op") -> Any:
+        """Run ``fn`` under the policy. Raises the last error when the
+        budget is spent, the error is not transient, or the active
+        request deadline leaves no room for another attempt."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except ApiError as e:
+                if not is_retryable(e):
+                    raise
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    RETRY_BUDGET_EXHAUSTED.inc(verb)
+                    raise
+                delay = self.backoff_s(attempt - 1, e)
+                remaining = deadline_remaining(self.clock)
+                if remaining is not None and delay >= remaining:
+                    # the caller will have given up before the retry
+                    # could land: stop burning its timeout and say so
+                    DEADLINE_EXCEEDED_TOTAL.inc()
+                    raise DeadlineExceeded(
+                        f"{verb}: deadline leaves {remaining:.3f}s, next "
+                        f"retry needs {delay:.3f}s (last error: {e})"
+                    ) from e
+                RETRY_ATTEMPTS.inc(verb, _status_class(e))
+                if delay > 0:
+                    self.sleep(delay)
+
+
+# -- the proxy ----------------------------------------------------------------
+
+# every ClusterClient request/response verb (watches excluded by design —
+# their healing is reconnect+relist, not replay)
+_RETRIED_VERBS = frozenset({
+    "list_pods", "get_pod", "list_nodes", "get_node", "get_configmap",
+    "patch_pod", "replace_pod", "bind_pod", "create_event", "patch_node",
+    "put_configmap", "get_lease", "create_lease", "update_lease",
+})
+
+
+class RetryingCluster:
+    """Transparent ClusterClient proxy applying ``policy`` to every
+    request/response verb. Non-protocol attributes (seeding helpers,
+    ``injected`` counters on a wrapped ChaosCluster, ...) pass through
+    untouched, so tests can stack this over FakeCluster/ChaosCluster."""
+
+    def __init__(self, inner: Any, policy: RetryPolicy | None = None) -> None:
+        self._inner = inner
+        self.policy = policy or RetryPolicy()
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._inner, name)
+        if name not in _RETRIED_VERBS or not callable(attr):
+            return attr
+
+        def retried(*args: Any, **kwargs: Any) -> Any:
+            return self.policy.call(lambda: attr(*args, **kwargs),
+                                    verb=name)
+        return retried
