@@ -26,3 +26,18 @@ jax.config.update("jax_platforms", "cpu")
 
 # repo root on sys.path so `import tpushare` works without installation
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def native_engine():
+    """The C++ placement engine, compiled/loaded ONCE per test session
+    (warmup() pays the g++ build and ctypes setup here, off every
+    individual test's clock). Tests that REQUIRE the native path — not
+    the Python fallback — take this fixture and assert on it, so a
+    broken compiler fails loudly instead of silently testing the slow
+    path."""
+    from tpushare.core.native import engine
+    engine.warmup()
+    return engine

@@ -1,0 +1,353 @@
+"""Fleet-scale concurrency tests: sharded cache locks, per-node memo
+delta invalidation, the parallel native fleet scan, and the native-path
+regression guard.
+
+The tentpole claims are only real if falsifiable:
+
+- different pods' Filter/Prioritize/Bind proceed concurrently without a
+  cache-wide lock — proven by a storm that must finish under a watchdog
+  (no deadlock) with zero oversubscription on the FAKE APISERVER TRUTH
+  (not the cache's own view);
+- an allocate on node A invalidates only A's memoized score — proven by
+  the delta-invalidation counters and by reuse staying > 0 under a storm
+  of concurrent binds;
+- no memoized score is ever served for a node state it was not computed
+  from — proven under TPUSHARE_MEMO_VERIFY, which recomputes every
+  memo-served score and counts disagreements;
+- the sharded parallel scan returns bit-identical results to the serial
+  single-call scan;
+- the native engine (not the silent Python fallback) actually scored a
+  fleet in this test session — the g++-regression tripwire.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import (
+    MEMO_DELTA_INVALIDATIONS, MEMO_NODE_SCORES, MEMO_REQUESTS,
+    MEMO_STALE_SERVES, AllocationError, SchedulerCache)
+from tpushare.cache.nodeinfo import request_from_pod
+from tpushare.extender.handlers import (
+    BindHandler, FilterHandler, PrioritizeHandler)
+from tpushare.extender.metrics import Registry
+from tpushare.k8s import FakeCluster
+
+HBM = 16000
+
+
+def fleet(n_nodes=4, chips=4, mesh="2x2"):
+    fc = FakeCluster()
+    names = [f"n{i}" for i in range(n_nodes)]
+    for n in names:
+        fc.add_tpu_node(n, chips=chips, hbm_per_chip_mib=HBM, mesh=mesh)
+    return fc, names
+
+
+def rig(fc):
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    registry = Registry()
+    return (cache,
+            FilterHandler(cache, registry),
+            PrioritizeHandler(cache, registry),
+            BindHandler(cache, fc, registry))
+
+
+# -- native-path regression guard (CI satellite) ------------------------------
+
+def test_native_path_scored_a_fleet(native_engine):
+    """Tier-1 tripwire: the native engine must be loadable AND actually
+    score a fleet — a missing compiler silently degrading every scan to
+    the O(nodes) Python fallback is a perf regression this test turns
+    into a red build."""
+    assert native_engine.available(), \
+        "native engine unavailable (g++/.so build failed?) — fleet " \
+        "scans would silently run the Python fallback; see " \
+        "tpushare_native_fallback_total"
+    assert native_engine.abi_version() is not None
+    from tpushare.core.chips import ChipView
+    from tpushare.core.placement import PlacementRequest
+    from tpushare.core.topology import MeshTopology
+
+    topo = MeshTopology((2, 2))
+    node = ([ChipView(idx=i, coords=topo.coords(i), total_hbm_mib=HBM,
+                      used_hbm_mib=0, healthy=True) for i in range(4)],
+            topo)
+    before = native_engine.NATIVE_FLEET_SCANS.get("score", "native") + \
+        native_engine.NATIVE_FLEET_SCANS.get("score", "native_parallel")
+    scores = native_engine.score_fleet([node] * 8,
+                                       PlacementRequest(hbm_mib=1024))
+    after = native_engine.NATIVE_FLEET_SCANS.get("score", "native") + \
+        native_engine.NATIVE_FLEET_SCANS.get("score", "native_parallel")
+    assert all(s is not None for s in scores)
+    assert after == before + 1, \
+        "fleet scan did not run on the native engine"
+
+
+def test_parallel_scan_matches_serial(native_engine):
+    """The sharded scan is a pure partition of the serial one: same
+    fleet, same request -> identical scores and fit verdicts, with the
+    parallel engine actually engaged (counter-verified)."""
+    if not native_engine.available():
+        pytest.skip("native engine unavailable")
+    from tpushare.core.chips import ChipView
+    from tpushare.core.placement import PlacementRequest
+    from tpushare.core.topology import MeshTopology
+
+    topo = MeshTopology((2, 2))
+    nodes = []
+    for i in range(1400):  # > 2 * _MIN_SHARD so sharding engages
+        used = (i * 977) % HBM  # deterministic variety
+        nodes.append((
+            [ChipView(idx=j, coords=topo.coords(j), total_hbm_mib=HBM,
+                      used_hbm_mib=(used + j * 1111) % HBM, healthy=True)
+             for j in range(4)], topo))
+    req = PlacementRequest(hbm_mib=4096, chip_count=4, topology=(2, 2))
+    serial = native_engine.score_fleet(nodes, req, workers=1)
+    par_before = native_engine.NATIVE_FLEET_SCANS.get(
+        "score", "native_parallel")
+    parallel = native_engine.score_fleet(nodes, req, workers=4)
+    assert native_engine.NATIVE_FLEET_SCANS.get(
+        "score", "native_parallel") == par_before + 1
+    assert parallel == serial
+    fits_serial = native_engine.fits_fleet(nodes, req, workers=1)
+    fits_parallel = native_engine.fits_fleet(nodes, req, workers=4)
+    assert fits_parallel == fits_serial
+    assert fits_serial == [s is not None for s in serial]
+
+
+# -- per-node memo: delta invalidation + LRU ---------------------------------
+
+def test_delta_invalidation_spares_untouched_nodes():
+    """An allocate on n1 must drop ONLY n1's memoized score: the next
+    lookup reuses the other nodes and recomputes exactly one."""
+    fc, names = fleet(n_nodes=4)
+    cache, flt, prio, _ = rig(fc)
+    pod = fc.create_pod(make_pod(hbm=2048, name="watcher"))
+    flt.handle({"Pod": pod, "NodeNames": names})
+
+    other = fc.create_pod(make_pod(hbm=4096, name="churn"))
+    cache.get_node_info("n1").allocate(other, fc)
+
+    inv0 = MEMO_DELTA_INVALIDATIONS.value
+    reused0 = MEMO_NODE_SCORES.get("reused")
+    computed0 = MEMO_NODE_SCORES.get("computed")
+    scores, errors = cache.score_nodes(pod, request_from_pod(pod), names)
+    assert not errors
+    assert MEMO_DELTA_INVALIDATIONS.value - inv0 == 1
+    assert MEMO_NODE_SCORES.get("reused") - reused0 == 3
+    assert MEMO_NODE_SCORES.get("computed") - computed0 == 1
+    # and the recomputed score reflects the allocate (tighter chip)
+    assert scores["n1"] != scores["n0"]
+
+
+def test_removed_node_memoized_score_never_served():
+    """A removed node's stamps can never validate again: the lookup
+    recomputes (and here re-faults the node from the apiserver)."""
+    fc, names = fleet(n_nodes=2)
+    cache, flt, _, _ = rig(fc)
+    pod = fc.create_pod(make_pod(hbm=2048, name="ghost"))
+    cache.score_nodes(pod, request_from_pod(pod), names)
+    cache.remove_node("n1")
+    h0 = MEMO_REQUESTS.get("score", "hit")
+    scores, errors = cache.score_nodes(pod, request_from_pod(pod), names)
+    assert MEMO_REQUESTS.get("score", "hit") == h0  # not a full hit
+    assert scores.get("n1") is not None  # re-faulted and re-scored
+
+
+def test_memo_is_lru_hot_entry_survives_full_table():
+    """Eviction at MEMO_CAP drops the LEAST RECENTLY USED entry, not the
+    oldest-inserted: a hot pod that keeps scoring survives a flood of
+    one-shot pods."""
+    fc, names = fleet(n_nodes=1)
+    cache, *_ = rig(fc)
+    cache.MEMO_CAP = 8
+    hot = fc.create_pod(make_pod(hbm=1024, name="hot"))
+    req = request_from_pod(hot)
+    cache.score_nodes(hot, req, names)
+    for i in range(20):
+        cold = fc.create_pod(make_pod(hbm=1024, name=f"cold{i}"))
+        cache.score_nodes(cold, req, names)
+        # the hot pod keeps getting scheduled-cycle traffic
+        h0 = MEMO_REQUESTS.get("score", "hit")
+        cache.score_nodes(hot, req, names)
+        assert MEMO_REQUESTS.get("score", "hit") == h0 + 1, \
+            f"hot entry evicted by cold flood at i={i} (FIFO, not LRU)"
+    assert len(cache._memo) <= cache.MEMO_CAP
+
+
+# -- cold-miss singleflight (bugfix satellite) --------------------------------
+
+def test_cold_node_miss_issues_one_fetch_for_concurrent_threads():
+    """N threads faulting the same cold node in must produce ONE
+    apiserver fetch and ONE NodeInfo (the miss path is singleflighted
+    end to end, not just per-burst on the GET)."""
+    fc, names = fleet(n_nodes=1)
+    fetches = []
+    gate = threading.Event()
+
+    class SlowCluster:
+        def __getattr__(self, name):
+            return getattr(fc, name)
+
+        def get_node(self, name):
+            fetches.append(name)
+            gate.wait(5)  # hold the leader so all threads pile up
+            return fc.get_node(name)
+
+    cache = SchedulerCache(SlowCluster())
+    infos = []
+    threads = [threading.Thread(
+        target=lambda: infos.append(cache.get_node_info("n0")))
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)  # let every thread reach the miss path
+    gate.set()
+    for t in threads:
+        t.join(5)
+    assert len(fetches) == 1, f"cold miss issued {len(fetches)} fetches"
+    assert len(infos) == 8
+    assert all(i is infos[0] for i in infos), "duplicate NodeInfo built"
+
+
+# -- the storm property test --------------------------------------------------
+
+def _storm(n_nodes, n_workers, cycles, churn_iters):
+    """N scheduler threads running full filter->prioritize->bind->
+    terminate cycles against a shared cache while a churn thread
+    allocates/releases out-of-band. Returns (binds, filter_latencies,
+    overcommit_samples). Invariants asserted by the callers:
+    completion under a watchdog (no deadlock), zero oversubscription on
+    the fake apiserver truth at any sampled instant, zero stale-positive
+    memo serves (TPUSHARE_MEMO_VERIFY), reuse rate > 0 (delta
+    invalidation pays off under churn)."""
+    fc, names = fleet(n_nodes=n_nodes)
+    cache, flt, prio, bind = rig(fc)
+    assert cache._verify_serves, "storm must run with TPUSHARE_MEMO_VERIFY"
+
+    binds = [0] * n_workers
+    filter_ms: list[float] = []
+    filter_ms_lock = threading.Lock()
+    errors: list[str] = []
+    overcommit: list = []
+    stop = threading.Event()
+
+    def truth_sampler():
+        while not stop.is_set():
+            per: dict = {}
+            for pod in fc.list_pods():
+                if contract.is_complete_pod(pod):
+                    continue
+                node = pod["spec"].get("nodeName")
+                ids = contract.chip_ids_from_annotations(pod)
+                if not node or ids is None:
+                    continue
+                h = contract.hbm_from_annotations(pod)
+                for c in ids:
+                    per[(node, c)] = per.get((node, c), 0) + h
+            for k, v in per.items():
+                if v > HBM:
+                    overcommit.append((k, v))
+            time.sleep(0.002)
+
+    def worker(w):
+        try:
+            for i in range(cycles):
+                pod = fc.create_pod(make_pod(hbm=2048, name=f"w{w}-{i}"))
+                t0 = time.perf_counter()
+                ok = flt.handle({"Pod": pod, "NodeNames": names})
+                with filter_ms_lock:
+                    filter_ms.append((time.perf_counter() - t0) * 1e3)
+                if not ok["NodeNames"]:
+                    continue
+                ranked = prio.handle({"Pod": pod,
+                                      "NodeNames": ok["NodeNames"]})
+                best = max(r["Score"] for r in ranked)
+                node = next(r["Host"] for r in ranked
+                            if r["Score"] == best)
+                out = bind.handle({
+                    "PodName": pod["metadata"]["name"],
+                    "PodNamespace": "default",
+                    "PodUID": pod["metadata"]["uid"], "Node": node})
+                if out.get("Error"):
+                    continue
+                # terminate: release the chips so the storm sustains
+                bound = fc.get_pod("default", pod["metadata"]["name"])
+                cache.add_or_update_pod(bound)
+                cache.remove_pod(bound)
+                fc.delete_pod("default", pod["metadata"]["name"])
+                binds[w] += 1
+        except Exception as e:  # noqa: BLE001 — surfaced by the caller
+            errors.append(f"worker {w}: {type(e).__name__}: {e}")
+
+    def churn():
+        try:
+            for i in range(churn_iters):
+                node = names[i % len(names)]
+                pod = fc.create_pod(make_pod(hbm=4096, name=f"churn-{i}"))
+                try:
+                    cache.get_node_info(node).allocate(pod, fc)
+                except AllocationError:
+                    fc.delete_pod("default", f"churn-{i}")
+                    continue
+                bound = fc.get_pod("default", f"churn-{i}")
+                cache.add_or_update_pod(bound)
+                cache.remove_pod(bound)
+                fc.delete_pod("default", f"churn-{i}")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"churn: {type(e).__name__}: {e}")
+
+    sampler_t = threading.Thread(target=truth_sampler, daemon=True)
+    sampler_t.start()
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(n_workers)]
+    threads.append(threading.Thread(target=churn, daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)  # the no-deadlock watchdog
+    alive = [t for t in threads if t.is_alive()]
+    stop.set()
+    sampler_t.join(timeout=5)
+    assert not alive, "storm deadlocked: threads still alive at watchdog"
+    assert not errors, f"storm raised: {errors[:3]}"
+    return sum(binds), filter_ms, overcommit
+
+
+@pytest.fixture()
+def memo_verify(monkeypatch):
+    monkeypatch.setenv("TPUSHARE_MEMO_VERIFY", "1")
+
+
+def test_concurrent_scheduling_storm_invariants(memo_verify):
+    """Tier-1 deterministic-size storm: 4 workers x 12 cycles + churn
+    over 4 nodes. No deadlock, no oversubscription, no stale-positive
+    serve, and delta invalidation reuses untouched-node scores."""
+    stale0 = MEMO_STALE_SERVES.value
+    reused0 = MEMO_NODE_SCORES.get("reused")
+    binds, filter_ms, overcommit = _storm(
+        n_nodes=4, n_workers=4, cycles=12, churn_iters=30)
+    assert binds > 0
+    assert not overcommit, \
+        f"apiserver-truth oversubscription: {overcommit[:3]}"
+    assert MEMO_STALE_SERVES.value == stale0, \
+        "memo served a stale-positive score under churn"
+    assert MEMO_NODE_SCORES.get("reused") > reused0, \
+        "delta invalidation never reused an untouched node's score"
+
+
+@pytest.mark.slow
+def test_bind_storm_soak(memo_verify):
+    """The soak sibling: more nodes, more workers, longer churn."""
+    stale0 = MEMO_STALE_SERVES.value
+    binds, filter_ms, overcommit = _storm(
+        n_nodes=16, n_workers=8, cycles=40, churn_iters=200)
+    assert binds > 50
+    assert not overcommit, \
+        f"apiserver-truth oversubscription: {overcommit[:3]}"
+    assert MEMO_STALE_SERVES.value == stale0

@@ -15,8 +15,12 @@ from tpushare.cache.nodeinfo import (
     AllocationError, AlreadyBoundError, BindInFlightError,
     ClaimConflictError, NodeInfo)
 from tpushare.cache.cache import (
-    MEMO_REQUESTS, SchedulerCache, memo_hit_rate)
+    MEMO_DELTA_INVALIDATIONS, MEMO_NODE_SCORES, MEMO_REQUESTS,
+    MEMO_STALE_SERVES, SchedulerCache, memo_hit_rate,
+    memo_node_reuse_rate)
 
 __all__ = ["ChipUsage", "NodeInfo", "AllocationError", "AlreadyBoundError",
            "BindInFlightError", "ClaimConflictError",
-           "SchedulerCache", "MEMO_REQUESTS", "memo_hit_rate"]
+           "SchedulerCache", "MEMO_REQUESTS", "MEMO_NODE_SCORES",
+           "MEMO_DELTA_INVALIDATIONS", "MEMO_STALE_SERVES",
+           "memo_hit_rate", "memo_node_reuse_rate"]

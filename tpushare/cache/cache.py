@@ -1,29 +1,49 @@
 """SchedulerCache: the cluster-wide allocation state.
 
 Reference: /root/reference/pkg/cache/cache.go. Node-name -> NodeInfo map plus
-a known-pods UID set, lock-guarded; `build_cache` replays assigned tpushare
-pods from their annotations at startup so a crashed/restarted extender
-reconstructs exact chip assignments from the apiserver (cache.go:49-74 — the
+a known-pods UID set; `build_cache` replays assigned tpushare pods from
+their annotations at startup so a crashed/restarted extender reconstructs
+exact chip assignments from the apiserver (cache.go:49-74 — the
 annotations are the durable write-ahead state, SURVEY §5.3b/§5.4).
 
-Two read-path additions keep the apiserver out of the scheduling loop:
+Concurrency model (the fleet-scale redesign — lock ORDER is stripe ->
+node -> memo, and nothing ever acquires leftward while holding rightward):
 
-- ``get_node_info``'s lazy node fetch reads a watch-warmed
-  :class:`~tpushare.k8s.informer.NodeLister` first (apiserver GET only on
-  a miss, coalesced through singleflight so a gang storm issues one GET
-  per node, not one per member);
-- a generation-stamped **placement memo**: Filter's fleet-wide native
-  scoring pass is memoized per (pod, cache generation), so Prioritize
-  reuses it verbatim and Bind seeds its chip selection from the
-  memoized best placement. Any allocation, release, or node change bumps
-  the generation (NodeInfo._dirty -> on_dirty) and invalidates every
-  entry — the memo can serve stale data for at most zero mutations.
+- **Striped node map.** The node map is guarded by a small array of
+  stripe locks (hash(node name) -> stripe) taken only to insert/remove a
+  NodeInfo; lookups read the dict lock-free (a CPython dict get/`list()`
+  is atomic under the GIL). Filter/Prioritize/Bind for different pods
+  therefore never serialize on a cache-wide lock — per-chip state is
+  guarded by each NodeInfo's own lock, and the stripes only collide for
+  names in the same hash bucket during creation/removal.
+- **Per-node generation stamps.** Every memoized score carries the
+  stamp (NodeInfo.version) of the exact node state it was computed from.
+  Lookups revalidate stamp-by-stamp: an allocate/release on node A
+  invalidates only A's memoized score (counted in
+  ``tpushare_memo_delta_invalidations_total``) and a concurrent
+  scheduling cycle reuses the other N-1 entries instead of re-scanning
+  the fleet (``tpushare_memo_node_scores_total{outcome}`` makes the
+  reuse rate falsifiable). A removed node has no live NodeInfo, so its
+  stamps can never match again — ghosts invalidate themselves.
+- **Known-pods map** has its own leaf lock (never held across calls
+  into stripe/node/memo locks).
+
+Two read-path properties carried over from the informer work:
+
+- ``get_node_info``'s lazy miss path is singleflight-coalesced END TO
+  END (lister lookup, apiserver GET, NodeInfo construction), so a cold
+  fleet warm-up issues one fetch per node no matter how many webhook
+  threads fault the same node in;
+- the placement memo is a true LRU (move-to-end on hit), so a hot pod's
+  entry survives a full table.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
+from collections import OrderedDict
 from typing import Any
 
 from tpushare import contract
@@ -34,7 +54,7 @@ from tpushare.core.placement import Placement, PlacementRequest
 from tpushare.k8s.client import ApiError
 from tpushare.k8s.informer import lookup as lister_lookup
 from tpushare.k8s.singleflight import Singleflight
-from tpushare.metrics import LabeledCounter
+from tpushare.metrics import Counter, LabeledCounter
 
 log = logging.getLogger("tpushare.cache")
 
@@ -44,12 +64,33 @@ log = logging.getLogger("tpushare.cache")
 MEMO_REQUESTS = LabeledCounter(
     "tpushare_placement_memo_total",
     "Placement-memo lookups by operation and outcome (a miss re-runs "
-    "the native fleet scan / chip selection)",
+    "the native fleet scan / chip selection for the stale nodes)",
     ("op", "outcome"))
+# per-NODE granularity of the same story: reused = a node's score served
+# under a still-valid stamp, computed = a node (re)scanned. Under a bind
+# storm, reused staying ~0 would mean delta invalidation is not working
+# and every allocate still costs a fleet re-scan.
+MEMO_NODE_SCORES = LabeledCounter(
+    "tpushare_memo_node_scores_total",
+    "Per-node placement-memo outcomes: reused = served under a valid "
+    "per-node stamp, computed = (re)scanned by the native engine",
+    ("outcome",))
+MEMO_DELTA_INVALIDATIONS = Counter(
+    "tpushare_memo_delta_invalidations_total",
+    "Memoized per-node scores dropped because that node's generation "
+    "stamp moved (allocate/release/rebuild on THAT node) — the other "
+    "nodes' scores stay served, which is the whole point of per-node "
+    "generations")
+MEMO_STALE_SERVES = Counter(
+    "tpushare_memo_stale_serves_total",
+    "Self-check failures under TPUSHARE_MEMO_VERIFY: a memoized score "
+    "served under a matching stamp disagreed with a fresh recompute of "
+    "the same node state. MUST stay 0 — nonzero means the stamp "
+    "protocol has a hole")
 
 
 def memo_hit_rate() -> float | None:
-    """Fraction of score lookups served from the memo (None = none)."""
+    """Fraction of score lookups served fully from the memo (None = none)."""
     hits = MEMO_REQUESTS.get("score", "hit")
     misses = MEMO_REQUESTS.get("score", "miss")
     if hits + misses == 0:
@@ -57,54 +98,80 @@ def memo_hit_rate() -> float | None:
     return hits / (hits + misses)
 
 
-class _MemoEntry:
-    __slots__ = ("generation", "req_sig", "scores", "errors",
-                 "placement_node", "placement")
+def memo_node_reuse_rate() -> float | None:
+    """Per-node reuse fraction (None = no lookups yet)."""
+    reused = MEMO_NODE_SCORES.get("reused")
+    computed = MEMO_NODE_SCORES.get("computed")
+    if reused + computed == 0:
+        return None
+    return reused / (reused + computed)
 
-    def __init__(self, generation: int, req_sig: tuple) -> None:
-        self.generation = generation
+
+class _MemoEntry:
+    __slots__ = ("req_sig", "scores", "errors", "stamps",
+                 "placement_node", "placement", "placement_stamp")
+
+    def __init__(self, req_sig: tuple) -> None:
         self.req_sig = req_sig
         self.scores: dict[str, int | None] = {}
         self.errors: dict[str, str] = {}
+        # node name -> NodeInfo.version stamp ((epoch, counter) tuple)
+        # the score/error was computed at
+        self.stamps: dict[str, tuple[int, int]] = {}
         self.placement_node: str | None = None
         self.placement: Placement | None = None
+        self.placement_stamp: tuple[int, int] | None = None
 
 
 def _req_sig(req: PlacementRequest) -> tuple:
     return (req.hbm_mib, req.chip_count, req.topology, req.allow_scatter)
 
 
+class _LockStripes:
+    """Fixed array of locks addressed by key hash. Creation/removal of
+    map entries for different nodes only contend when their names land
+    in the same stripe; reads don't take a stripe at all."""
+
+    __slots__ = ("_locks", "_n")
+
+    def __init__(self, n: int) -> None:
+        self._n = n
+        self._locks = tuple(threading.Lock() for _ in range(n))
+
+    def for_key(self, key: str) -> threading.Lock:
+        return self._locks[hash(key) % self._n]
+
+
 class SchedulerCache:
-    # memo entries are per PENDING pod within one cache generation; the
-    # cap only matters if thousands of pods filter without ever binding
+    # memo entries are per PENDING pod; the cap only matters if
+    # thousands of pods filter without ever binding (LRU beyond it)
     MEMO_CAP = 4096
+    LOCK_STRIPES = 16
 
     def __init__(self, cluster, node_lister=None) -> None:
         self._cluster = cluster
-        self._lock = threading.RLock()
+        # lock order: stripe -> node (NodeInfo._lock) -> memo. The
+        # stripes guard node-map structure only; _pods_lock is a leaf.
+        self._stripes = _LockStripes(self.LOCK_STRIPES)
         self._nodes: dict[str, NodeInfo] = {}
+        self._pods_lock = threading.Lock()
         self._known_pods: dict[str, dict[str, Any]] = {}  # uid -> pod object
         # read path: watch-warmed node store + GET coalescing (see module
         # docstring); None = every lazy node fetch GETs the apiserver
         self._node_lister = node_lister
         self._sf = Singleflight()
-        # placement memo (see module docstring). generation is read
-        # without the lock (a torn read just causes one extra recompute).
-        self.generation = 0
-        self._gen_lock = threading.Lock()
-        self._memo: dict[str, _MemoEntry] = {}
+        # placement memo: LRU of per-pod entries, scores stamped with
+        # per-node generations (see module docstring)
+        self._memo: OrderedDict[str, _MemoEntry] = OrderedDict()
         self._memo_lock = threading.Lock()
+        # paranoia mode for the bench/property tests: every memo-served
+        # score is recomputed from the node's current stamped snapshot
+        # and a mismatch under a matching stamp counts as a stale serve
+        self._verify_serves = bool(os.environ.get("TPUSHARE_MEMO_VERIFY"))
         # flipped by build_cache: /readyz refuses traffic until the
         # startup replay has reconstructed chip assignments (a bind
         # against an un-replayed cache could oversubscribe)
         self.built = False
-
-    def _bump_generation(self) -> None:
-        """Wired as NodeInfo.on_dirty: ANY mutation of per-chip state —
-        allocate/confirm/release, pod add/remove, capacity rebuild,
-        health flips — invalidates every memoized placement decision."""
-        with self._gen_lock:
-            self.generation += 1
 
     # -- node access ----------------------------------------------------------
 
@@ -112,39 +179,45 @@ class SchedulerCache:
         node = lister_lookup(self._node_lister, "nodes", node_name)
         if node is not None:
             return node
-        # miss: real GET, coalesced — a gang's N members faulting the
-        # same node in concurrently issue ONE apiserver round-trip
-        return self._sf.do(f"get_node/{node_name}",
-                           lambda: self._cluster.get_node(node_name))
+        return self._cluster.get_node(node_name)
 
-    def get_node_info(self, node_name: str) -> NodeInfo:
-        """Fetch-or-create the NodeInfo (reference GetNodeInfo,
-        cache.go:130-165, including lazy creation on first touch)."""
-        with self._lock:
-            info = self._nodes.get(node_name)
+    def _fault_node_info(self, node_name: str) -> NodeInfo:
+        """Singleflight leader body for a node-map miss: fetch + build
+        exactly once per concurrent burst (waiters share the result or
+        the ApiError)."""
+        info = self._nodes.get(node_name)
         if info is not None:
-            return info
+            return info  # lost a race benignly: another leader built it
         node = self._fetch_node(node_name)  # may raise ApiError(404)
-        with self._lock:
-            # double-checked: another thread may have built it meanwhile
+        with self._stripes.for_key(node_name):
             info = self._nodes.get(node_name)
             if info is None:
                 info = NodeInfo(node)
-                info.on_dirty = self._bump_generation
                 self._nodes[node_name] = info
                 log.debug("cache: created NodeInfo %s (%d chips x %d MiB)",
                           node_name, info.chip_count, info.hbm_per_chip)
-        # no generation bump: a newly-tracked node changes no existing
-        # node's scores — memo entries simply don't cover it yet, and
-        # score_nodes computes uncovered names on demand
+        # a newly-tracked node changes no existing node's scores — memo
+        # entries simply don't cover it yet, and score_nodes computes
+        # uncovered names on demand
         return info
+
+    def get_node_info(self, node_name: str) -> NodeInfo:
+        """Fetch-or-create the NodeInfo (reference GetNodeInfo,
+        cache.go:130-165, including lazy creation on first touch). The
+        hot path is a lock-free dict read; the miss path is coalesced so
+        N threads warming the same cold node issue ONE fetch and build
+        ONE NodeInfo (previously each thread could fetch sequentially)."""
+        info = self._nodes.get(node_name)
+        if info is not None:
+            return info
+        return self._sf.do(f"nodeinfo/{node_name}",
+                           lambda: self._fault_node_info(node_name))
 
     def update_node(self, node: dict[str, Any]) -> None:
         name = nodelib.node_name(node)
         if not contract.is_tpushare_node(node):
             return
-        with self._lock:
-            info = self._nodes.get(name)
+        info = self._nodes.get(name)
         if info is None:
             return  # will be built lazily with fresh data when needed
         if info.update_node(node):
@@ -152,14 +225,19 @@ class SchedulerCache:
             self._replay_node_pods(info)
 
     def remove_node(self, node_name: str) -> None:
-        with self._lock:
-            removed = self._nodes.pop(node_name, None)
-        if removed is not None:
-            self._bump_generation()  # memoized scores may name the ghost
+        with self._stripes.for_key(node_name):
+            self._nodes.pop(node_name, None)
+        # no fleet-wide invalidation: a removed node has no live
+        # NodeInfo, so its memoized stamps can never validate again
 
     def node_names(self) -> list[str]:
-        with self._lock:
-            return list(self._nodes)
+        return list(self._nodes)  # GIL-atomic copy of the keys
+
+    def _node_version(self, node_name: str) -> tuple[int, int] | None:
+        """Current generation stamp, or None when untracked (removed /
+        never seen) — None never matches a stored stamp."""
+        info = self._nodes.get(node_name)
+        return None if info is None else info.version
 
     # -- placement memo -------------------------------------------------------
 
@@ -167,7 +245,7 @@ class SchedulerCache:
                     node_names: list[str]
                     ) -> tuple[dict[str, int | None], dict[str, str]]:
         """Fleet scores for ``pod`` over ``node_names``, memoized per
-        (pod, cache generation, request signature).
+        (pod, request signature) with per-node generation stamps.
 
         Returns ``(scores, errors)``: ``scores[name]`` is the native
         engine's best binpack score (lower = tighter; None = no
@@ -175,117 +253,189 @@ class SchedulerCache:
         be evaluated at all (apiserver failure, not a TPU node). Filter
         derives its pass/fail verdict and Prioritize its ranking from the
         SAME entry, so the second webhook of a scheduling cycle runs zero
-        native scans — and any intervening allocate/release/node change
-        bumps the generation and forces a recompute.
+        native scans — and an intervening allocate/release invalidates
+        ONLY the touched node's score (delta invalidation): the lookup
+        re-scans that node and serves the rest from the memo.
+
+        Fetch errors (ApiError) are returned but never memoized: with
+        per-node stamps there is no node version to invalidate them by,
+        and serving "unavailable" forever for a node that recovered
+        would strand the pod. Structural errors ("not a TPU-share
+        node") are stamped against the live NodeInfo like scores.
         """
         from tpushare.core.native import engine as native_engine
 
         key = podlib.pod_cache_key(pod)
-        gen = self.generation
         sig = _req_sig(req)
+        reused = 0
+        verify: list[tuple[str, int, int | None]] = []
         with self._memo_lock:
             entry = self._memo.get(key)
-            if entry is not None and (entry.generation != gen
-                                      or entry.req_sig != sig):
+            if entry is not None and entry.req_sig != sig:
                 self._memo.pop(key, None)
                 entry = None
-            covered = entry is not None and all(
-                n in entry.scores or n in entry.errors
-                for n in node_names)
-            if covered:
+            missing: list[str] = []
+            if entry is None:
+                missing = list(node_names)
+            else:
+                self._memo.move_to_end(key)  # LRU: a hot pod stays hot
+                for n in node_names:
+                    stamp = entry.stamps.get(n)
+                    if stamp is not None and stamp == self._node_version(n):
+                        reused += 1
+                        if self._verify_serves and n in entry.scores:
+                            verify.append((n, stamp, entry.scores[n]))
+                    else:
+                        if n in entry.scores or n in entry.errors:
+                            entry.scores.pop(n, None)
+                            entry.errors.pop(n, None)
+                            entry.stamps.pop(n, None)
+                            MEMO_DELTA_INVALIDATIONS.inc()
+                        missing.append(n)
+            full_hit = not missing
+            if full_hit:
                 MEMO_REQUESTS.inc("score", "hit")
-                return ({n: entry.scores[n] for n in node_names
-                         if n in entry.scores},
-                        {n: entry.errors[n] for n in node_names
-                         if n in entry.errors})
-            missing = [n for n in node_names
-                       if entry is None or (n not in entry.scores
-                                            and n not in entry.errors)]
+                if reused:
+                    MEMO_NODE_SCORES.inc("reused", n=reused)
+                out = ({n: entry.scores[n] for n in node_names
+                        if n in entry.scores},
+                       {n: entry.errors[n] for n in node_names
+                        if n in entry.errors})
+        if full_hit:
+            # verification takes node locks; never do that while holding
+            # the memo lock (lock order is stripe -> node -> memo)
+            self._verify_served(verify, req)
+            return out
         MEMO_REQUESTS.inc("score", "miss")
         scores: dict[str, int | None] = {}
-        errors: dict[str, str] = {}
+        fetch_errors: dict[str, str] = {}
+        node_errors: dict[str, str] = {}
+        stamps: dict[str, tuple[int, int]] = {}
         known: list[str] = []
         snapshots = []
         for name in missing:
             try:
                 info = self.get_node_info(name)
             except ApiError as e:
-                errors[name] = f"node unavailable: {e}"
+                fetch_errors[name] = f"node unavailable: {e}"
                 continue
+            # stamp and views captured atomically under the node lock:
+            # the stamp is exactly the generation of the scored state
+            stamp, snap = info.stamped_snapshot()
+            stamps[name] = stamp
             if info.chip_count <= 0:
-                errors[name] = "not a TPU-share node"
+                node_errors[name] = "not a TPU-share node"
                 continue
             known.append(name)
-            snapshots.append((info.snapshot(), info.topology))
+            snapshots.append((snap, info.topology))
         for name, score in zip(known,
                                native_engine.score_fleet(snapshots, req)):
             scores[name] = score
         with self._memo_lock:
             entry = self._memo.get(key)
-            if entry is None or entry.generation != gen \
-                    or entry.req_sig != sig:
-                if len(self._memo) >= self.MEMO_CAP:
-                    self._memo.pop(next(iter(self._memo)))
-                entry = _MemoEntry(gen, sig)
+            if entry is None or entry.req_sig != sig:
+                while len(self._memo) >= self.MEMO_CAP:
+                    self._memo.popitem(last=False)  # evict least recent
+                entry = _MemoEntry(sig)
                 self._memo[key] = entry
+            else:
+                self._memo.move_to_end(key)
             entry.scores.update(scores)
-            entry.errors.update(errors)
-            return ({n: entry.scores[n] for n in node_names
-                     if n in entry.scores},
-                    {n: entry.errors[n] for n in node_names
-                     if n in entry.errors})
+            entry.errors.update(node_errors)
+            entry.stamps.update(stamps)
+            if reused:
+                MEMO_NODE_SCORES.inc("reused", n=reused)
+            if missing:
+                MEMO_NODE_SCORES.inc("computed", n=len(missing))
+            out = ({n: entry.scores[n] for n in node_names
+                    if n in entry.scores},
+                   {n: entry.errors[n] for n in node_names
+                    if n in entry.errors})
+            for n, msg in fetch_errors.items():
+                out[1][n] = msg
+        self._verify_served(verify, req)
+        return out
+
+    def _verify_served(self, served: list[tuple[str, int, int | None]],
+                       req: PlacementRequest) -> None:
+        """TPUSHARE_MEMO_VERIFY: recompute every memo-served score from
+        the node's CURRENT stamped snapshot; if the node has not moved
+        (stamp still matches) the recompute must agree — a disagreement
+        is a stale-positive and increments MEMO_STALE_SERVES."""
+        if not served:
+            return
+        from tpushare.core.native import engine as native_engine
+
+        for name, stamp, score in served:
+            info = self._nodes.get(name)
+            if info is None:
+                continue
+            now_stamp, snap = info.stamped_snapshot()
+            if now_stamp != stamp:
+                continue  # node moved after the serve; recompute would
+                # legitimately differ — not a staleness verdict
+            fresh = native_engine.score_fleet([(snap, info.topology)],
+                                              req)[0]
+            if fresh != score:
+                MEMO_STALE_SERVES.inc()
+                log.error("memo served stale score for %s: served %s, "
+                          "fresh %s at stamp %d", name, score, fresh,
+                          stamp)
 
     def memo_best_placement(self, pod: dict[str, Any],
                             req: PlacementRequest, node_name: str) -> None:
         """Pre-compute the chip selection Bind will need on ``node_name``
         (Prioritize calls this for its top-ranked node, which is almost
-        always the scheduler's eventual choice). Stored under the same
-        generation stamp as the scores — NodeInfo.allocate re-validates
-        the chips under its own lock before trusting the seed, so a
-        generation race costs a recompute, never a bad placement."""
+        always the scheduler's eventual choice). Stored under the node's
+        generation stamp — NodeInfo.allocate re-validates the chips
+        under its own lock before trusting the seed, so a stamp race
+        costs a recompute, never a bad placement."""
         from tpushare.core.placement import select_chips
 
         try:
             info = self.get_node_info(node_name)
         except ApiError:
             return
-        gen = self.generation
-        placement = select_chips(info.snapshot(), info.topology, req)
+        stamp, snap = info.stamped_snapshot()
+        placement = select_chips(snap, info.topology, req)
         if placement is None:
             return
         key = podlib.pod_cache_key(pod)
         sig = _req_sig(req)
         with self._memo_lock:
             entry = self._memo.get(key)
-            if entry is None or entry.generation != gen \
-                    or entry.req_sig != sig:
+            if entry is None or entry.req_sig != sig:
                 return  # scores were invalidated meanwhile; don't seed
             entry.placement_node = node_name
             entry.placement = placement
+            entry.placement_stamp = stamp
 
     def placement_hint(self, pod: dict[str, Any],
                        node_name: str) -> Placement | None:
         """The memoized best placement for Bind to seed allocate with,
-        or None when the memo is cold/stale/for a different node."""
+        or None when the memo is cold / for a different node / the node
+        mutated since the hint's stamp."""
         req = request_from_pod(pod)
         if req is None:
             return None
         key = podlib.pod_cache_key(pod)
-        gen = self.generation
         with self._memo_lock:
             entry = self._memo.get(key)
-            if entry is None or entry.generation != gen \
-                    or entry.req_sig != _req_sig(req) \
+            if entry is None or entry.req_sig != _req_sig(req) \
                     or entry.placement_node != node_name \
-                    or entry.placement is None:
+                    or entry.placement is None \
+                    or entry.placement_stamp \
+                    != self._node_version(node_name):
                 MEMO_REQUESTS.inc("seed", "miss")
                 return None
+            self._memo.move_to_end(key)
             MEMO_REQUESTS.inc("seed", "hit")
             return entry.placement
 
     def forget_memo(self, pod: dict[str, Any]) -> None:
-        """Drop a bound/terminated pod's memo entry (the generation bump
-        already invalidated it; this just frees the slot)."""
+        """Drop a bound/terminated pod's memo entry (its node's stamp
+        bump already invalidated the touched score; this frees the
+        slot and the untouched-node scores nobody will ask for again)."""
         with self._memo_lock:
             self._memo.pop(podlib.pod_cache_key(pod), None)
 
@@ -295,12 +445,12 @@ class SchedulerCache:
         """The cached pod object for an accounting key (UID for real
         pods), or None — the preempt verb resolves MetaPod UIDs this way
         (nodeCacheCapable extenders receive only identifiers)."""
-        with self._lock:
+        with self._pods_lock:
             return self._known_pods.get(key)
 
     def known_pod(self, key: str) -> bool:
         """``key`` is the accounting id (podlib.pod_cache_key)."""
-        with self._lock:
+        with self._pods_lock:
             return key in self._known_pods
 
     def add_or_update_pod(self, pod: dict[str, Any]) -> None:
@@ -320,7 +470,7 @@ class SchedulerCache:
         # would let a concurrent bind binpack into the phantom free
         # space and oversubscribe the chip for real
         if info.sync_pod(pod):
-            with self._lock:
+            with self._pods_lock:
                 self._known_pods[podlib.pod_cache_key(pod)] = pod
 
     def remove_pod(self, pod: dict[str, Any]) -> None:
@@ -328,11 +478,10 @@ class SchedulerCache:
         release their chips."""
         node_name = podlib.pod_node_name(pod)
         if node_name:
-            with self._lock:
-                info = self._nodes.get(node_name)
+            info = self._nodes.get(node_name)
             if info is not None:
                 info.remove_pod(pod)
-        with self._lock:
+        with self._pods_lock:
             self._known_pods.pop(podlib.pod_cache_key(pod), None)
 
     # -- startup replay -------------------------------------------------------
@@ -348,11 +497,9 @@ class SchedulerCache:
         for node in self._cluster.list_nodes():
             if contract.is_tpushare_node(node):
                 name = nodelib.node_name(node)
-                with self._lock:
+                with self._stripes.for_key(name):
                     if name not in self._nodes:
-                        info = NodeInfo(node)
-                        info.on_dirty = self._bump_generation
-                        self._nodes[name] = info
+                        self._nodes[name] = NodeInfo(node)
         replayed = 0
         for pod in (self._cluster.list_pods() if pods is None else pods):
             if not contract.is_tpushare_pod(pod):
@@ -371,7 +518,7 @@ class SchedulerCache:
         return replayed
 
     def _replay_node_pods(self, info: NodeInfo) -> None:
-        with self._lock:
+        with self._pods_lock:
             pods = [p for p in self._known_pods.values()
                     if podlib.pod_node_name(p) == info.name]
         for p in pods:
@@ -382,9 +529,9 @@ class SchedulerCache:
     def describe(self) -> dict[str, Any]:
         """Full cluster allocation tree for the inspect API
         (reference Inspect.Handler, inspect.go:8-69)."""
-        with self._lock:
-            infos = list(self._nodes.values())
-            pod_index = {uid: p for uid, p in self._known_pods.items()}
+        infos = list(self._nodes.values())  # GIL-atomic copy
+        with self._pods_lock:
+            pod_index = dict(self._known_pods)
         nodes = [info.describe(pod_index) for info in infos]
         total = sum(n["total_hbm_mib"] for n in nodes)
         used = sum(n["used_hbm_mib"] for n in nodes)

@@ -26,6 +26,7 @@ deliberate redesigns:
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -116,9 +117,16 @@ def no_fit_reason(req: PlacementRequest, node_name: str) -> str:
         f" on {node_name}")
 
 
+# per-NodeInfo epoch source: a REBUILT NodeInfo (node removed then
+# re-faulted) must never produce a stamp equal to its predecessor's —
+# both start _version at 0, so the epoch disambiguates the instances
+_EPOCHS = itertools.count(1)
+
+
 class NodeInfo:
     def __init__(self, node: dict[str, Any]) -> None:
         self._lock = threading.RLock()
+        self._epoch = next(_EPOCHS)
         self.name = nodelib.node_name(node)
         self._unhealthy: set[int] = set()
         # pod UIDs with a bind in flight on this node: a concurrent
@@ -134,20 +142,30 @@ class NodeInfo:
         # snapshot cache: scheduling state changes rarely relative to
         # Filter calls (every webhook snapshots every node), so views are
         # rebuilt only when _version moves. Mutators bump _dirty().
+        # _version doubles as THIS NODE's generation stamp: the
+        # SchedulerCache memo stores it next to each memoized score and
+        # revalidates stamp-by-stamp, so an allocate here invalidates
+        # only this node's entries, not the fleet's.
         self._version = 0
         self._snap_version = -1
         self._snap: list[ChipView] = []
-        # SchedulerCache wires this to its generation bump so ANY chip
-        # mutation invalidates the cross-verb placement memo
-        self.on_dirty: Callable[[], None] | None = None
         self._init_chips(node)
 
     def _dirty(self) -> None:
         """Caller holds self._lock."""
         self._version += 1
-        cb = self.on_dirty
-        if cb is not None:
-            cb()
+
+    @property
+    def version(self) -> tuple[int, int]:
+        """This node's generation stamp, (instance epoch, mutation
+        counter) — the counter bumps under the node lock on every
+        per-chip mutation (allocate/confirm/release, pod add/remove,
+        capacity rebuild, health flip); the epoch makes stamps from a
+        torn-down-and-refaulted NodeInfo incomparable to its
+        predecessor's. Read lock-free: an in-flight mutation linearizes
+        at its bump, so a torn read only costs one extra memo recompute,
+        never a stale serve (stamps are compared by equality only)."""
+        return (self._epoch, self._version)
 
     def _init_chips(self, node: dict[str, Any]) -> None:
         # slice membership (multi-host gang placement): which ICI domain
@@ -180,13 +198,22 @@ class NodeInfo:
         """Chip views for placement. The returned list is cached and
         SHARED between calls until the next mutation — callers iterate it,
         never mutate it (ChipView itself is frozen)."""
+        return self.stamped_snapshot()[1]
+
+    def stamped_snapshot(self) -> tuple[tuple[int, int], list[ChipView]]:
+        """(version stamp, snapshot), consistent under the node lock:
+        the stamp is exactly the generation of the state the views
+        describe. The memo stores scores under this stamp; a stamp
+        captured any other way (version read before/after an unlocked
+        snapshot) could pair a post-mutation stamp with pre-mutation
+        views and turn into a stale-positive serve."""
         with self._lock:
             if self._snap_version != self._version:
                 self._snap = ChipSnapshot(
                     c.view(healthy=c.idx not in self._unhealthy)
                     for c in self.chips)
                 self._snap_version = self._version
-            return self._snap
+            return (self._epoch, self._version), self._snap
 
     # -- scheduling operations ------------------------------------------------
 

@@ -3,14 +3,22 @@
 Built from placement.cpp by ``make -C tpushare/core/native`` or lazily on
 first import via g++. Falls back to the pure-Python implementation in
 :mod:`tpushare.core.placement` when the shared object is unavailable — both
-are behaviorally identical (tests/test_native_parity.py).
+are behaviorally identical (tests/test_native_parity.py). The fallback is
+counted (``tpushare_native_fallback_total``) and availability is exported
+as a gauge, so the degradation is diagnosable rather than silent.
 """
 
 from tpushare.core.native.engine import (
+    NATIVE_FALLBACKS,
+    NATIVE_FLEET_SCANS,
+    abi_version,
     available,
+    describe,
     select_chips,
     select_gang_box,
     warmup,
 )
 
-__all__ = ["available", "select_chips", "select_gang_box", "warmup"]
+__all__ = ["NATIVE_FALLBACKS", "NATIVE_FLEET_SCANS", "abi_version",
+           "available", "describe", "select_chips", "select_gang_box",
+           "warmup"]

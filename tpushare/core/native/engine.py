@@ -10,6 +10,23 @@ Protocol: chips are flattened to parallel int64 arrays; the result is the
 chosen chip-id list (length written through an out-param), box shape and
 score. A return of 0 means "no placement"; -1 means "engine error" (treated
 as unavailable, falls back to Python).
+
+Fleet scans are PARALLEL at scale: the ctypes calls release the GIL, and
+the fleet ABI's node offsets are absolute into the concatenated chip
+arrays, so one marshalled fleet can be sharded into disjoint [a, b) node
+ranges scored concurrently by a small worker pool (see
+``_fleet_call``). Small fleets stay on the serial single-call path —
+thread dispatch overhead beats the win below ~2 shards of _MIN_SHARD
+nodes. ``TPUSHARE_SCAN_WORKERS`` caps (or forces) the shard count;
+default min(cpu_count, 8).
+
+Every degradation to the Python path is observable:
+``tpushare_native_fallback_total{reason}`` counts them,
+``tpushare_native_fleet_scans_total{call,engine}`` attributes each fleet
+scan to the engine that ran it, and ``available()`` backs the
+``tpushare_native_engine_available`` gauge — so a perf regression from a
+missing compiler/numpy shows up in /metrics, /inspect and bench output
+instead of silently halving throughput.
 """
 
 from __future__ import annotations
@@ -19,6 +36,8 @@ import os
 import subprocess
 import threading
 from typing import Sequence, TYPE_CHECKING
+
+from tpushare.metrics import LabeledCounter
 
 if TYPE_CHECKING:  # placement imports us lazily; avoid cycle at runtime
     from tpushare.core.chips import ChipView
@@ -32,6 +51,25 @@ _SRC = os.path.join(_HERE, "placement.cpp")
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
+
+# why a scan ran in Python instead of C: no_lib = .so missing/unbuildable,
+# no_numpy = fleet packing impossible, not_expressible = node shape the
+# dense ABI can't carry (gappy chip ids, mesh mismatch), engine_error =
+# the native call returned -1. no_lib/engine_error are the diagnosable
+# regressions the ISSUE satellite names; the other two are per-node
+# structural fallbacks.
+NATIVE_FALLBACKS = LabeledCounter(
+    "tpushare_native_fallback_total",
+    "Placement evaluations that fell back to the Python path, by reason "
+    "(no_lib and engine_error mean the native engine is broken — check "
+    "g++ and the .so build log)",
+    ("reason",))
+# engine=native is the serial single-call scan, native_parallel the
+# sharded multi-thread scan, python the O(nodes) interpreter fallback
+NATIVE_FLEET_SCANS = LabeledCounter(
+    "tpushare_native_fleet_scans_total",
+    "Fleet-wide scans by call (fits/score) and executing engine",
+    ("call", "engine"))
 
 
 def _build() -> bool:
@@ -118,6 +156,103 @@ def _load() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return _load() is not None
+
+
+def abi_version() -> int | None:
+    """The loaded engine's ABI stamp (placement.cpp
+    tpushare_abi_version), or None when unavailable / prebuilt before
+    the stamp existed. Surfaced via /inspect so "which .so is this
+    process actually running" is answerable in production."""
+    lib = _load()
+    if lib is None:
+        return None
+    try:
+        fn = lib.tpushare_abi_version
+    except AttributeError:
+        return None
+    fn.restype = ctypes.c_int64
+    return int(fn())
+
+
+def describe() -> "dict":
+    """Observability snapshot for /inspect and bench: availability, ABI,
+    scan worker config, and the fallback/scan counters."""
+    return {
+        "available": available(),
+        "abi_version": abi_version(),
+        "scan_workers": _scan_workers(),
+        "fleet_scans": {f"{call}/{engine}": v for (call, engine), v
+                        in NATIVE_FLEET_SCANS.snapshot().items()},
+        "fallbacks": {reason: v for (reason,), v
+                      in NATIVE_FALLBACKS.snapshot().items()},
+    }
+
+
+# -- parallel fleet scan ------------------------------------------------------
+
+# a shard below this many nodes costs more in thread dispatch than the
+# GIL-released C call saves; 2 * _MIN_SHARD is therefore the smallest
+# fleet that ever goes parallel
+_MIN_SHARD = 512
+
+_pool = None
+_pool_lock = threading.Lock()
+_pool_size = 0
+
+
+def _scan_workers() -> int:
+    env = os.environ.get("TPUSHARE_SCAN_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return min(os.cpu_count() or 1, 8)
+
+
+def _get_pool(workers: int):
+    """Shared scan pool, grown (rebuilt) if a caller asks for more
+    workers than it was created with — the pool is tiny and long-lived,
+    so growth happens at most a handful of times per process."""
+    global _pool, _pool_size
+    from concurrent.futures import ThreadPoolExecutor
+
+    with _pool_lock:
+        if _pool is None or _pool_size < workers:
+            if _pool is not None:
+                _pool.shutdown(wait=False)  # idle workers exit promptly
+            _pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="tpushare-scan")
+            _pool_size = workers
+        return _pool
+
+
+def _fleet_call(call_range, n_nodes: int, call: str,
+                workers: int | None = None) -> int:
+    """Run ``call_range(a, b) -> rc`` over [0, n_nodes), sharded across
+    the scan pool when the fleet is large enough. The fleet ABI's
+    node_chip_offsets / mesh_rank_offsets are ABSOLUTE into the
+    concatenated arrays (placement.cpp documents this as the sharding
+    contract), so each shard passes pointers offset to its own range and
+    writes a disjoint slice of the out array — no merging, no copies.
+    The ctypes calls release the GIL, so shards run truly concurrently.
+    Returns the first nonzero rc (0 = all shards ok)."""
+    if workers is None:
+        workers = _scan_workers()
+    shards = min(workers, n_nodes // _MIN_SHARD)
+    if shards <= 1:
+        NATIVE_FLEET_SCANS.inc(call, "native")
+        return call_range(0, n_nodes)
+    NATIVE_FLEET_SCANS.inc(call, "native_parallel")
+    pool = _get_pool(workers)
+    step = (n_nodes + shards - 1) // shards
+    bounds = [(a, min(a + step, n_nodes))
+              for a in range(0, n_nodes, step)]
+    futures = [pool.submit(call_range, a, b) for a, b in bounds[1:]]
+    rc = call_range(*bounds[0])  # this thread scores the first shard
+    for f in futures:
+        rc = rc or f.result()
+    return rc
 
 
 def warmup() -> bool:
@@ -209,20 +344,25 @@ def _i64p(arr) -> "ctypes._Pointer":
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 
 
-def fits_fleet(nodes, req: "PlacementRequest") -> "list[bool]":
-    """Fleet-wide Filter in ONE native call.
+def fits_fleet(nodes, req: "PlacementRequest",
+               workers: int | None = None) -> "list[bool]":
+    """Fleet-wide Filter in one (sharded) native scan.
 
     ``nodes`` is a list of (chips, topo) snapshots. Nodes the native ABI
     can't express fall back to the Python ``fits`` individually;
-    everything else is evaluated in a single C scan over numpy-packed
-    arrays — per-node packs are cached against the (stable) snapshot
-    objects, so a quiescent fleet re-marshals nothing. This is what keeps
-    Filter flat as fleets grow (per-node Python loops dominated before).
+    everything else is evaluated in a C scan over numpy-packed arrays —
+    per-node packs are cached against the (stable) snapshot objects, so
+    a quiescent fleet re-marshals nothing, and large fleets shard the
+    scan across the worker pool (see ``_fleet_call``). This is what
+    keeps Filter flat as fleets grow (per-node Python loops dominated
+    before).
     """
     from tpushare.core.placement import fits as fits_py
 
     lib = _load()
     if lib is None:
+        NATIVE_FALLBACKS.inc("no_lib")
+        NATIVE_FLEET_SCANS.inc("fits", "python")
         return [fits_py(chips, topo, req) for chips, topo in nodes]
     try:
         import numpy as np
@@ -237,10 +377,14 @@ def fits_fleet(nodes, req: "PlacementRequest") -> "list[bool]":
                 "numpy unavailable: fleet Filter runs the per-node Python "
                 "scan (O(nodes) slower at fleet scale); install numpy to "
                 "restore the single-call native path")
+        NATIVE_FALLBACKS.inc("no_numpy")
+        NATIVE_FLEET_SCANS.inc("fits", "python")
         return [fits_py(chips, topo, req) for chips, topo in nodes]
 
     marshalled = _marshal_fleet(np, nodes, req)
     if marshalled is None:
+        NATIVE_FALLBACKS.inc("not_expressible")
+        NATIVE_FLEET_SCANS.inc("fits", "python")
         return [fits_py(chips, topo, req) for chips, topo in nodes]
     dense_idx, free, total, dims, chip_offsets, mesh_offsets = marshalled
 
@@ -249,13 +393,21 @@ def fits_fleet(nodes, req: "PlacementRequest") -> "list[bool]":
     t_rank = len(req.topology) if req.topology else 0
     t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
     out = np.zeros(n, np.uint8)
-    rc = lib.tpushare_fits_fleet(
-        n, _i64p(chip_offsets), _i64p(free), _i64p(total),
-        _i64p(mesh_offsets), _i64p(dims),
-        req.hbm_mib, req.chip_count, t_rank, t_dims,
-        1 if req.allow_scatter else 0,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+
+    def call_range(a: int, b: int) -> int:
+        # offsets are absolute into free/total/dims, so a shard passes
+        # the full chip arrays and its own offset/out windows
+        return lib.tpushare_fits_fleet(
+            b - a, _i64p(chip_offsets[a:]), _i64p(free), _i64p(total),
+            _i64p(mesh_offsets[a:]), _i64p(dims),
+            req.hbm_mib, req.chip_count, t_rank, t_dims,
+            1 if req.allow_scatter else 0,
+            out[a:].ctypes.data_as(u8))
+
+    rc = _fleet_call(call_range, n, "fits", workers)
     if rc != 0:
+        NATIVE_FALLBACKS.inc("engine_error")
         return [fits_py(chips, topo, req) for chips, topo in nodes]
     for pos, i in enumerate(dense_idx):
         results[i] = bool(out[pos])
@@ -314,11 +466,12 @@ def _marshal_fleet(np, nodes, req):
     return dense_idx, free, total, dims, chip_offsets, mesh_offsets
 
 
-def score_fleet(nodes, req: "PlacementRequest") -> "list[int | None]":
-    """Fleet-wide Prioritize in ONE native call: the best binpack score
-    per node (lower = tighter; None = no placement), the ranking analogue
-    of :func:`fits_fleet`. Falls back to the per-node Python selector
-    where the native path is unavailable."""
+def score_fleet(nodes, req: "PlacementRequest",
+                workers: int | None = None) -> "list[int | None]":
+    """Fleet-wide Prioritize in one (sharded) native scan: the best
+    binpack score per node (lower = tighter; None = no placement), the
+    ranking analogue of :func:`fits_fleet`. Falls back to the per-node
+    Python selector where the native path is unavailable."""
     from tpushare.core.placement import select_chips_py
 
     def py_score(chips, topo):
@@ -327,13 +480,19 @@ def score_fleet(nodes, req: "PlacementRequest") -> "list[int | None]":
 
     lib = _load()
     if lib is None:
+        NATIVE_FALLBACKS.inc("no_lib")
+        NATIVE_FLEET_SCANS.inc("score", "python")
         return [py_score(chips, topo) for chips, topo in nodes]
     try:
         import numpy as np
     except ImportError:
+        NATIVE_FALLBACKS.inc("no_numpy")
+        NATIVE_FLEET_SCANS.inc("score", "python")
         return [py_score(chips, topo) for chips, topo in nodes]
     marshalled = _marshal_fleet(np, nodes, req)
     if marshalled is None:
+        NATIVE_FALLBACKS.inc("not_expressible")
+        NATIVE_FLEET_SCANS.inc("score", "python")
         return [py_score(chips, topo) for chips, topo in nodes]
     dense_idx, free, total, dims, chip_offsets, mesh_offsets = marshalled
 
@@ -343,12 +502,17 @@ def score_fleet(nodes, req: "PlacementRequest") -> "list[int | None]":
     t_rank = len(req.topology) if req.topology else 0
     t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
     out = np.zeros(n, np.int64)
-    rc = lib.tpushare_score_fleet(
-        n, _i64p(chip_offsets), _i64p(free), _i64p(total),
-        _i64p(mesh_offsets), _i64p(dims),
-        req.hbm_mib, req.chip_count, t_rank, t_dims,
-        1 if req.allow_scatter else 0, _i64p(out))
+
+    def call_range(a: int, b: int) -> int:
+        return lib.tpushare_score_fleet(
+            b - a, _i64p(chip_offsets[a:]), _i64p(free), _i64p(total),
+            _i64p(mesh_offsets[a:]), _i64p(dims),
+            req.hbm_mib, req.chip_count, t_rank, t_dims,
+            1 if req.allow_scatter else 0, _i64p(out[a:]))
+
+    rc = _fleet_call(call_range, n, "score", workers)
     if rc != 0:
+        NATIVE_FALLBACKS.inc("engine_error")
         return [py_score(chips, topo) for chips, topo in nodes]
     for pos, i in enumerate(dense_idx):
         s = int(out[pos])
@@ -370,7 +534,11 @@ def select_chips(chips: "Sequence[ChipView]", topo: "MeshTopology",
     from tpushare.core.placement import Placement, select_chips_py
 
     lib = _load()
-    if lib is None or len(chips) != topo.num_chips:
+    if lib is None:
+        NATIVE_FALLBACKS.inc("no_lib")
+        return select_chips_py(chips, topo, req)
+    if len(chips) != topo.num_chips:
+        NATIVE_FALLBACKS.inc("not_expressible")
         return select_chips_py(chips, topo, req)
 
     n = len(chips)
@@ -380,6 +548,7 @@ def select_chips(chips: "Sequence[ChipView]", topo: "MeshTopology",
     # chip ids (e.g. 0,1,2,4 after an RMA) must take the Python path, which
     # handles the mismatch via its by_idx map.
     if any(c.idx != i for i, c in enumerate(by_idx)):
+        NATIVE_FALLBACKS.inc("not_expressible")
         return select_chips_py(chips, topo, req)
     free = (ctypes.c_int64 * n)(*[
         c.free_hbm_mib if c.healthy else -1 for c in by_idx])
@@ -402,6 +571,7 @@ def select_chips(chips: "Sequence[ChipView]", topo: "MeshTopology",
         1 if req.allow_scatter else 0,
         out_ids, out_box, out_origin, out_score)
     if rc < 0:
+        NATIVE_FALLBACKS.inc("engine_error")
         return select_chips_py(chips, topo, req)
     if rc == 0:
         return None

@@ -132,10 +132,26 @@ bool fits_one(int n_chips, const int64_t* free_hbm, const int64_t* total_hbm,
 
 }  // namespace
 
+// ABI stamp for the loaded .so: engine.py surfaces it via /inspect so a
+// stale prebuilt library (missing newer symbols, pre-sharding layout) is
+// identifiable in production. Bump on any exported-signature or
+// fleet-contract change.
+extern "C" int64_t tpushare_abi_version() { return 3; }
+
 // Fleet-wide Filter: one call evaluates every candidate node, avoiding
 // per-node FFI marshalling (the reference's hot loop #1 x #2,
 // SURVEY §3.2, fused into native code). Chip arrays are concatenated;
 // node_chip_offsets/mesh_rank_offsets are prefix offsets (n_nodes+1).
+//
+// SHARDING CONTRACT (parallel fleet scan, engine.py _fleet_call): the
+// offsets are ABSOLUTE indexes into the concatenated free/total/mesh
+// arrays, and each node's evaluation is independent. A caller may
+// therefore split one marshalled fleet into disjoint node ranges
+// [a, b) and invoke this function concurrently from multiple threads,
+// passing offsets+a / out+a with the SAME full chip arrays — each call
+// reads shared immutable input and writes only its own out window.
+// Both fleet entry points keep this property; do not introduce shared
+// mutable state here.
 extern "C" int tpushare_fits_fleet(
     int n_nodes,
     const int64_t* node_chip_offsets,

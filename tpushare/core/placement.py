@@ -142,9 +142,11 @@ def select_chips(chips: Sequence[ChipView], topo: MeshTopology,
     the anti-fragmentation numbers in bench.py.
     """
     from tpushare.core import native  # late import: optional C++ engine
-    if native.available():
-        return native.select_chips(chips, topo, req)
-    return select_chips_py(chips, topo, req)
+    # native.select_chips itself degrades to select_chips_py when the
+    # engine is unavailable or the node isn't ABI-expressible — and
+    # COUNTS the fallback (tpushare_native_fallback_total), which a
+    # pre-check here would silently bypass
+    return native.select_chips(chips, topo, req)
 
 
 def select_chips_py(chips: Sequence[ChipView], topo: MeshTopology,

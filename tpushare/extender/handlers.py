@@ -602,6 +602,11 @@ class InspectHandler:
             if not nodes:
                 return {"error": f"node {node_name} not found in cache"}
             return nodes[0]
+        # engine health rides along: "is this extender actually running
+        # the native scan, and if not, why" — the silent-fallback
+        # regression the availability satellite exists to catch
+        from tpushare.core import native as native_engine
+        tree["native_engine"] = native_engine.describe()
         return tree
 
 
@@ -624,8 +629,11 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
         "tpushare_node_hbm", "Per-node HBM utilization %% and fragmentation",
         per_node)
 
-    from tpushare.cache.cache import MEMO_REQUESTS
+    from tpushare.cache.cache import (
+        MEMO_DELTA_INVALIDATIONS, MEMO_NODE_SCORES, MEMO_REQUESTS,
+        MEMO_STALE_SERVES)
     from tpushare.cache.nodeinfo import CLAIM_CAS_RETRIES
+    from tpushare.core.native import engine as _native
     from tpushare.k8s.informer import (
         INFORMER_EVENTS, INFORMER_RELISTS, LISTER_REQUESTS as _LISTER)
     from tpushare.k8s.retry import (
@@ -651,3 +659,17 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
     registry.register(SINGLEFLIGHT_TOTAL)
     registry.register(INFORMER_EVENTS)
     registry.register(INFORMER_RELISTS)
+    # fleet-scale set: per-node memo delta invalidation (reuse rate under
+    # concurrent binds), the stale-serve self-check, and the native
+    # engine's availability/fallback story
+    registry.register(MEMO_NODE_SCORES)
+    registry.register(MEMO_DELTA_INVALIDATIONS)
+    registry.register(MEMO_STALE_SERVES)
+    registry.register(_native.NATIVE_FLEET_SCANS)
+    registry.register(_native.NATIVE_FALLBACKS)
+    registry.gauge_func(
+        "tpushare_native_engine_available",
+        "1 when the C++ placement engine is loaded, 0 when scans run "
+        "the Python fallback (check g++/numpy; see "
+        "tpushare_native_fallback_total for the reason)",
+        lambda: [("", 1.0 if _native.available() else 0.0)])

@@ -103,6 +103,21 @@ class CountingCluster:
         return counted
 
 
+def hit_rate(before: dict[tuple[str, ...], float],
+             after: dict[tuple[str, ...], float],
+             hit: str = "hit", miss: str = "miss") -> float | None:
+    """hits / (hits + misses) over the movement between two
+    LabeledCounter snapshots whose LAST label is the outcome — the
+    shared shape of the lister / memo / per-node-reuse counters. None
+    when nothing moved (no traffic in the window)."""
+    moved = {k: after.get(k, 0.0) - before.get(k, 0.0) for k in after}
+    hits = sum(v for k, v in moved.items() if k[-1] == hit)
+    misses = sum(v for k, v in moved.items() if k[-1] == miss)
+    if hits + misses == 0:
+        return None
+    return round(hits / (hits + misses), 4)
+
+
 def delta(before: dict[tuple[str, ...], float],
           after: dict[tuple[str, ...], float],
           verbs: frozenset[str] | None = None,
