@@ -1,0 +1,265 @@
+"""/metrics exposition correctness + registry hardening (ISSUE 4
+satellites): a strict Prometheus text-format checker over the FULL
+extender output, the counter naming convention, histogram bucket
+monotonicity, and the cardinality-bomb containment proof.
+"""
+
+import re
+import urllib.request
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import metrics as metricslib
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller
+from tpushare.extender.handlers import register_cache_gauges
+from tpushare.extender.metrics import Registry
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+from tpushare.metrics import (
+    METRIC_SERIES_CLAMPED, Histogram, LabeledCounter)
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram|"
+                      r"summary|untyped)$")
+# one sample line: name, optional {labels}, value
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\")*\})?"
+    r" (-?[0-9.eE+-]+|NaN|[+-]Inf)$")
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+def strict_parse(text: str) -> dict:
+    """Parse Prometheus text format 0.0.4 STRICTLY: every sample line
+    must match the grammar, every family must carry HELP+TYPE before
+    its first sample, no family may be declared twice. Returns
+    {family: {"type": ..., "samples": [(name, labels, value)]}}."""
+    families: dict = {}
+    current = None
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        m = _HELP_RE.match(line)
+        if m:
+            name = m.group(1)
+            assert name not in families, \
+                f"line {ln}: duplicate HELP for {name}"
+            families[name] = {"type": None, "samples": [], "help":
+                              m.group(2)}
+            current = name
+            continue
+        m = _TYPE_RE.match(line)
+        if m:
+            name = m.group(1)
+            assert name == current, \
+                f"line {ln}: TYPE {name} without preceding HELP"
+            assert families[name]["type"] is None, \
+                f"line {ln}: duplicate TYPE for {name}"
+            families[name]["type"] = m.group(2)
+            continue
+        assert not line.startswith("#"), f"line {ln}: stray comment"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line {ln}: malformed sample: {line!r}"
+        sample_name = m.group(1)
+        family = sample_name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if family.endswith(suffix) and family[:-len(suffix)] \
+                    in families:
+                family = family[:-len(suffix)]
+                break
+        assert family in families, \
+            f"line {ln}: sample {sample_name} has no HELP/TYPE family"
+        assert families[family]["type"] is not None, \
+            f"line {ln}: family {family} sampled before its TYPE"
+        families[family]["samples"].append(
+            (sample_name, m.group(2) or "", float(m.group(4))))
+    return families
+
+
+def check_conventions(families: dict) -> None:
+    for name, fam in families.items():
+        ftype = fam["type"]
+        assert ftype is not None, f"{name}: no TYPE line"
+        if ftype == "counter":
+            assert name.endswith("_total"), \
+                f"counter {name} violates the _total suffix convention"
+        if ftype == "histogram":
+            buckets = [(s, v) for s, labels, v in fam["samples"]
+                       if s == f"{name}_bucket"
+                       for s, v in [(labels, v)]]
+            # bucket cumulative counts must be monotonically
+            # nondecreasing in le order, ending at +Inf == _count
+            les = []
+            for labels, v in buckets:
+                le = _LE_RE.search(labels).group(1)
+                les.append((float("inf") if le == "+Inf" else float(le),
+                            v))
+            assert les, f"{name}: histogram with no buckets"
+            values = [v for _, v in sorted(les, key=lambda t: t[0])]
+            assert all(a <= b for a, b in zip(values, values[1:])), \
+                f"{name}: bucket counts not monotonic: {values}"
+            count = next(v for s, _l, v in fam["samples"]
+                         if s == f"{name}_count")
+            assert values[-1] == count, \
+                f"{name}: +Inf bucket {values[-1]} != _count {count}"
+            assert any(s == f"{name}_sum" for s, _l, _v in
+                       fam["samples"]), f"{name}: missing _sum"
+
+
+@pytest.fixture
+def rig():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    ctl.start()
+    registry = Registry()
+    server = ExtenderServer(cache, fc, registry, host="127.0.0.1", port=0)
+    register_cache_gauges(registry, cache)
+    port = server.start()
+    yield fc, registry, f"http://127.0.0.1:{port}"
+    server.stop()
+    ctl.stop()
+
+
+def _scrape(base):
+    with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+        return r.read().decode()
+
+
+def test_full_exposition_is_strictly_parseable(rig):
+    fc, registry, base = rig
+    # drive one bind so histograms and labeled series are non-empty
+    import json as _json
+    pod = fc.create_pod(make_pod(hbm=2000, name="m"))
+    req = urllib.request.Request(
+        f"{base}/tpushare-scheduler/bind",
+        data=_json.dumps({"PodName": "m", "PodNamespace": "default",
+                          "PodUID": pod["metadata"]["uid"],
+                          "Node": "n1"}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    urllib.request.urlopen(req, timeout=5).read()
+    families = strict_parse(_scrape(base))
+    check_conventions(families)
+    # the families the observability layer added are present and typed
+    assert families["tpushare_build_info"]["type"] == "gauge"
+    assert families["tpushare_traces_total"]["type"] == "counter"
+    assert families["tpushare_bind_seconds"]["type"] == "histogram"
+
+
+def test_build_info_labels(rig):
+    import platform
+
+    import tpushare
+
+    fc, registry, base = rig
+    text = _scrape(base)
+    line = next(l for l in text.splitlines()
+                if l.startswith("tpushare_build_info{"))
+    assert f'version="{tpushare.__version__}"' in line
+    assert f'python="{platform.python_version()}"' in line
+    assert 'native_abi="' in line
+    assert line.endswith(" 1.0")
+
+
+def test_informer_staleness_gauge_scrapeable():
+    """Staleness was /readyz-only; now it is a first-class gauge when an
+    informer is wired."""
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=8000)
+    from tpushare.k8s.informer import Informer
+    informer = Informer(fc).start()
+    try:
+        cache = SchedulerCache(fc, node_lister=informer.nodes)
+        cache.build_cache()
+        registry = Registry()
+        server = ExtenderServer(cache, fc, registry, host="127.0.0.1",
+                                port=0, informer=informer)
+        port = server.start()
+        try:
+            text = _scrape(f"http://127.0.0.1:{port}")
+        finally:
+            server.stop()
+    finally:
+        informer.stop()
+    families = strict_parse(text)
+    fam = families["tpushare_informer_staleness_seconds"]
+    assert fam["type"] == "gauge"
+    name, labels, value = fam["samples"][0]
+    assert value >= 0.0
+
+
+# -- registry hardening -------------------------------------------------------
+
+def test_cardinality_bomb_is_refused():
+    """Pod-name-shaped label abuse: 5000 distinct values must NOT become
+    5000 series — the cap folds the overflow into one sentinel series
+    and the clamp counter names the offender."""
+    bomb = LabeledCounter("tpushare_test_bomb_total", "t", ("pod",),
+                          max_series=64)
+    clamped_before = METRIC_SERIES_CLAMPED.get("tpushare_test_bomb_total")
+    for i in range(5000):
+        bomb.inc(f"pod-{i}")
+    series = bomb.snapshot()
+    assert len(series) == 65  # 64 real + 1 _overflow
+    assert series[("_overflow",)] == 5000 - 64
+    assert METRIC_SERIES_CLAMPED.get("tpushare_test_bomb_total") \
+        - clamped_before == 5000 - 64
+    # the exposition stays bounded and parseable
+    families = strict_parse(bomb.expose())
+    assert len(families["tpushare_test_bomb_total"]["samples"]) == 65
+
+
+def test_label_values_are_truncated_and_escaped():
+    c = LabeledCounter("tpushare_test_escape_total", "t", ("v",))
+    c.inc('bad"value\nwith\\stuff')
+    c.inc("x" * 500)
+    families = strict_parse(c.expose())
+    samples = families["tpushare_test_escape_total"]["samples"]
+    assert len(samples) == 2
+    # truncated to the cap, not 500 chars
+    assert all(len(labels) < 200 for _n, labels, _v in samples)
+
+
+def test_histogram_quantile_estimate():
+    h = Histogram("tpushare_test_seconds", "t", (0.01, 0.1, 1.0))
+    assert h.quantile(0.5) is None
+    for _ in range(90):
+        h.observe(0.005)
+    for _ in range(10):
+        h.observe(0.5)
+    p50 = h.quantile(0.5)
+    assert 0.0 < p50 <= 0.01
+    p99 = h.quantile(0.99)
+    assert 0.1 < p99 <= 1.0
+
+
+def test_histogram_exemplars_ride_the_json_side():
+    h = Histogram("tpushare_test_ex_seconds", "t", (0.01, 1.0))
+    h.observe(0.002, exemplar="uid-1-1")
+    h.observe(0.5, exemplar="uid-2-1")
+    h.observe(0.003)  # no exemplar: keeps the previous one
+    ex = h.exemplars()
+    assert ex["0.01"]["trace_id"] == "uid-1-1"
+    assert ex["1.0"]["trace_id"] == "uid-2-1"
+    # exposition carries NO exemplar syntax (strict 0.0.4)
+    assert "#" not in h.expose().replace("# HELP", "").replace(
+        "# TYPE", "")
+
+
+def test_metric_series_clamped_is_in_default_registry():
+    """The clamp counter itself must be scrapeable, or the bomb is
+    contained silently."""
+    registry = Registry()
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=8000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    register_cache_gauges(registry, cache)
+    assert registry.get("tpushare_metric_series_clamped_total") \
+        is metricslib.METRIC_SERIES_CLAMPED

@@ -1,0 +1,351 @@
+"""Observability subsystem tests (ISSUE 4 tentpole acceptance).
+
+The headline assertion: ONE bind exercised through the extender webhook
+AND the device plugin yields ONE trace in /debug/traces containing
+Filter, Prioritize, Bind and Allocate spans, joined across the
+component boundary by the pod-annotation trace context — and
+/inspect/explain/<pod> reports a per-node reason for every candidate
+considered.
+"""
+
+import io
+import json
+import logging
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller
+from tpushare.deviceplugin import DevicePlugin, FakeEnumerator
+from tpushare.extender.handlers import BindHandler, register_cache_gauges
+from tpushare.extender.metrics import Registry
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+from tpushare.k8s.stats import CountingCluster
+from tpushare.obs import ExplainStore, FlightRecorder, Trace
+from tpushare.obs.trace import TRACER
+
+
+@pytest.fixture(autouse=True)
+def fresh_tracer():
+    """The tracer is process-global by design (every layer appends to
+    the same traces); tests isolate by resetting around each one."""
+    TRACER.enabled = True
+    TRACER.reset()
+    yield
+    TRACER.enabled = True
+    TRACER.reset()
+
+
+@pytest.fixture
+def rig():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    fc.add_tpu_node("n2", chips=2, hbm_per_chip_mib=8000)
+    # CountingCluster: deployment parity — it is also what annotates
+    # apiserver round-trips onto the active span
+    cluster = CountingCluster(fc)
+    cache = SchedulerCache(cluster)
+    ctl = Controller(cluster, cache)
+    ctl.build_cache()
+    ctl.start()
+    registry = Registry()
+    server = ExtenderServer(cache, cluster, registry,
+                            host="127.0.0.1", port=0)
+    register_cache_gauges(registry, cache)
+    port = server.start()
+    yield fc, cache, server, f"http://127.0.0.1:{port}"
+    server.stop()
+    ctl.stop()
+
+
+def post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, json.loads(r.read())
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, json.loads(r.read())
+
+
+def run_cycle(fc, base, name="p", hbm=2000, node="n1"):
+    """One full scheduler cycle over the webhook wire: filter ->
+    prioritize -> bind to ``node``. Returns the created pod."""
+    pod = fc.create_pod(make_pod(hbm=hbm, name=name))
+    _, flt = post(f"{base}/tpushare-scheduler/filter",
+                  {"Pod": pod, "NodeNames": ["n1", "n2"]})
+    assert node in flt["NodeNames"]
+    _, ranked = post(f"{base}/tpushare-scheduler/prioritize",
+                     {"Pod": pod, "NodeNames": flt["NodeNames"]})
+    assert {h["Host"] for h in ranked} == set(flt["NodeNames"])
+    status, bind = post(f"{base}/tpushare-scheduler/bind", {
+        "PodName": name, "PodNamespace": "default",
+        "PodUID": pod["metadata"]["uid"], "Node": node})
+    assert status == 200 and not bind.get("Error")
+    return pod
+
+
+# -- the tentpole acceptance test ---------------------------------------------
+
+def test_single_bind_yields_one_trace_with_allocate_span(rig):
+    fc, cache, server, base = rig
+    pod = run_cycle(fc, base)
+    bound = fc.get_pod("default", "p")
+    ctx = bound["metadata"]["annotations"].get(contract.ANN_TRACE_CONTEXT)
+    assert ctx, "bind must stamp the trace-context annotation"
+    assert ctx.startswith(pod["metadata"]["uid"])
+
+    # the device plugin (in production: another process on the node)
+    # joins the SAME trace via the annotation channel
+    plugin = DevicePlugin(fc, "n1", FakeEnumerator(4, 16000, "2x2"))
+    result = plugin.allocate(hbm_mib=2000)
+    assert result["pod"]["name"] == "p"
+    assert result["trace_context"] == ctx
+
+    status, dump = get(f"{base}/debug/traces")
+    assert status == 200
+    mine = [t for t in dump["traces"] if t["trace_id"] == ctx]
+    assert len(mine) == 1, \
+        f"expected ONE trace for the cycle, got {len(mine)}"
+    trace = mine[0]
+    names = [s["name"] for s in trace["spans"]]
+    for phase in ("filter", "prioritize", "bind", "allocate"):
+        assert phase in names, f"trace missing {phase} span: {names}"
+    assert trace["outcome"] == "bound"
+    # every span carries a duration; the cache scan child span rode along
+    assert all(s["duration_ms"] is not None for s in trace["spans"])
+    assert "cache.score_nodes" in names
+
+    # the bind span recorded its apiserver round-trips as events
+    bind_span = next(s for s in trace["spans"] if s["name"] == "bind")
+    verbs = {e.get("verb") for e in bind_span.get("events", [])
+             if e.get("event") == "api"}
+    assert {"patch_pod", "bind_pod"} <= verbs
+    assert bind_span["tags"]["node"] == "n1"
+    assert bind_span["tags"]["chip_ids"]
+
+    # the scan span says whether the memo served and which engine scanned
+    scan = next(s for s in trace["spans"]
+                if s["name"] == "cache.score_nodes")
+    assert scan["tags"]["memo"] in ("hit", "miss")
+    assert any(e.get("event") == "native_scan"
+               for e in scan.get("events", []))
+
+
+def test_explain_reports_every_candidate(rig):
+    fc, cache, server, base = rig
+    run_cycle(fc, base, name="exp", hbm=10000)  # n2's chips are 8000 MiB
+    status, out = get(f"{base}/inspect/explain/default/exp")
+    assert status == 200
+    cycle = out["cycles"][-1]
+    nodes = cycle["filter"]["nodes"]
+    assert set(nodes) == {"n1", "n2"}, \
+        "every candidate must get a verdict"
+    assert nodes["n1"]["verdict"] == "ok"
+    assert isinstance(nodes["n1"]["score"], int)
+    assert nodes["n1"]["source"] in ("memo", "computed")
+    assert nodes["n2"]["verdict"] == "rejected"
+    assert "no fit" in nodes["n2"]["reason"]
+    assert cycle["prioritize"]["best"] == "n1"
+    assert cycle["bind"]["node"] == "n1"
+    assert cycle["bind"]["outcome"] == "bound"
+    assert cycle["bind"]["chip_ids"]
+    # the cycle's trace id links the audit to /debug/traces
+    assert cycle["trace_id"]
+
+    # selector flexibility: bare name and uid both resolve
+    for sel in ("exp", fc.get_pod("default", "exp")["metadata"]["uid"]):
+        status, again = get(f"{base}/inspect/explain/{sel}")
+        assert status == 200 and again["cycles"]
+    # bare listing names the pod
+    status, listing = get(f"{base}/inspect/explain")
+    assert any(p["pod"].get("name") == "exp" for p in listing["pods"])
+    # unknown pod -> 404 with a bounded-retention hint
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(f"{base}/inspect/explain/ghost-pod")
+    assert e.value.code == 404
+
+
+def test_explain_memo_provenance_on_second_cycle(rig):
+    """Prioritize reuses Filter's scan via the memo; a second pod's
+    filter right after a bind shows the delta-invalidation story in the
+    explain source fields (touched node recomputed, others reused)."""
+    fc, cache, server, base = rig
+    run_cycle(fc, base, name="p1", hbm=1000, node="n1")
+    pod2 = fc.create_pod(make_pod(hbm=1000, name="p2"))
+    post(f"{base}/tpushare-scheduler/filter",
+         {"Pod": pod2, "NodeNames": ["n1", "n2"]})
+    status, out = get(f"{base}/inspect/explain/default/p2")
+    nodes = out["cycles"][-1]["filter"]["nodes"]
+    # a fresh pod key means a fresh memo entry: everything computed
+    assert all(v["source"] == "computed" for v in nodes.values())
+    # same pod filtered again with nothing mutated: all served from memo
+    post(f"{base}/tpushare-scheduler/filter",
+         {"Pod": pod2, "NodeNames": ["n1", "n2"]})
+    status, out = get(f"{base}/inspect/explain/default/p2")
+    nodes = out["cycles"][-1]["filter"]["nodes"]
+    assert all(v["source"] == "memo" for v in nodes.values())
+
+
+def test_trace_superseded_and_finished_outcomes(rig):
+    fc, cache, server, base = rig
+    pod = fc.create_pod(make_pod(hbm=500, name="s"))
+    body = {"Pod": pod, "NodeNames": ["n1", "n2"]}
+    post(f"{base}/tpushare-scheduler/filter", body)
+    post(f"{base}/tpushare-scheduler/filter", body)  # new cycle
+    _, dump = get(f"{base}/debug/traces")
+    superseded = [t for t in dump["traces"]
+                  if t["outcome"] == "superseded"]
+    assert len(superseded) == 1 and superseded[0]["cycle"] == 1
+    post(f"{base}/tpushare-scheduler/bind", {
+        "PodName": "s", "PodNamespace": "default",
+        "PodUID": pod["metadata"]["uid"], "Node": "n1"})
+    _, dump = get(f"{base}/debug/traces")
+    bound = [t for t in dump["traces"] if t["outcome"] == "bound"]
+    assert len(bound) == 1 and bound[0]["cycle"] == 2
+
+
+def test_bind_failure_trace_and_explain(rig):
+    fc, cache, server, base = rig
+    pod = fc.create_pod(make_pod(hbm=99999, name="big"))
+    with pytest.raises(urllib.error.HTTPError):
+        post(f"{base}/tpushare-scheduler/bind", {
+            "PodName": "big", "PodNamespace": "default",
+            "PodUID": pod["metadata"]["uid"], "Node": "n1"})
+    _, dump = get(f"{base}/debug/traces")
+    failed = [t for t in dump["traces"] if t["outcome"] == "bind_failed"]
+    assert len(failed) == 1
+    bind_span = next(s for s in failed[0]["spans"] if s["name"] == "bind")
+    assert "no placement" in bind_span["tags"]["error"]
+    _, out = get(f"{base}/inspect/explain/default/big")
+    rec = out["cycles"][-1]["bind"]
+    assert rec["outcome"] == "bind_failed"
+    assert "no placement" in rec["error"]
+
+
+def test_breaker_fastfail_recorded_in_explain():
+    """A breaker-open refusal never reaches a node; the audit still says
+    exactly why the bind failed."""
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=8000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+
+    class OpenBreaker:
+        state = "open"
+
+    explain = ExplainStore()
+    handler = BindHandler(cache, fc, Registry(), breaker=OpenBreaker(),
+                          explain=explain)
+    out = handler.handle({"PodName": "x", "PodNamespace": "default",
+                          "PodUID": "u-ff", "Node": "n1"})
+    assert "circuit open" in out["Error"]
+    rec = explain.get("u-ff")["cycles"][-1]["bind"]
+    assert rec["outcome"] == "bind_failed"
+    assert rec["error"].startswith("breaker fast-fail")
+    # and the trace closed with the failure
+    recorded = TRACER.recorder.traces()
+    assert recorded and recorded[-1].outcome == "bind_failed"
+
+
+def test_tracer_disabled_is_invisible(rig):
+    fc, cache, server, base = rig
+    TRACER.enabled = False
+    run_cycle(fc, base, name="quiet")
+    bound = fc.get_pod("default", "quiet")
+    assert contract.ANN_TRACE_CONTEXT not in \
+        bound["metadata"]["annotations"]
+    _, dump = get(f"{base}/debug/traces")
+    assert dump["recorded_total"] == 0 and dump["traces"] == []
+
+
+def test_flight_recorder_ring_eviction_and_slow_pinning():
+    rec = FlightRecorder(capacity=4, pinned_capacity=4, slow_ms=10.0)
+    slow = Trace("slow-1", "slow", 1)
+    slow.duration_ms = 25.0
+    assert rec.record(slow) is True
+    for i in range(10):
+        fast = Trace(f"fast-{i}", "fast", 1)
+        fast.duration_ms = 1.0
+        assert rec.record(fast) is False
+    dump = rec.dump()
+    assert len(dump["traces"]) == 4  # ring rolled over
+    assert dump["recorded_total"] == 11
+    # the slow trace survived eviction via the pinned list
+    assert [t["trace_id"] for t in dump["pinned"]] == ["slow-1"]
+    assert rec.find("slow-1") is slow
+    assert rec.find("fast-0") is None  # evicted
+    assert rec.slowest(1)[0] is slow
+
+
+def test_trace_metrics_exported(rig):
+    fc, cache, server, base = rig
+    run_cycle(fc, base, name="m")
+    with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert 'tpushare_traces_total{outcome="recorded"}' in text
+    assert "tpushare_allocate_seconds_bucket" in text  # registered
+
+
+def test_remote_allocate_without_local_trace_records_own_trace():
+    """Cross-process case: the plugin's process never opened the trace,
+    so the Allocate span lands in a single-span trace under the SAME id
+    (joinable offline on trace_id)."""
+    TRACER.record_remote_span("uid-remote-7", "allocate", 3.2,
+                              node="n9", chip_ids=[0])
+    dump = TRACER.recorder.dump()
+    assert len(dump["traces"]) == 1
+    t = dump["traces"][0]
+    assert t["trace_id"] == "uid-remote-7" and t["outcome"] == "remote"
+    assert t["spans"][0]["name"] == "allocate"
+
+
+def test_json_logger_stamps_trace_id():
+    from tpushare.obs.logging import setup
+
+    root = logging.getLogger()
+    prev_handlers = root.handlers[:]
+    prev_level = root.level
+    buf = io.StringIO()
+    handler = setup("INFO", json_format=True, stream=buf)
+    try:
+        trace = TRACER.begin_cycle("uid-log")
+        with TRACER.root_span(trace, "filter"):
+            logging.getLogger("tpushare.obs-test").info(
+                "placing %s", "pod-a")
+        logging.getLogger("tpushare.obs-test").warning("outside")
+    finally:
+        root.removeHandler(handler)
+        for h in prev_handlers:
+            root.addHandler(h)
+        root.setLevel(prev_level)
+    lines = [json.loads(l) for l in buf.getvalue().strip().splitlines()]
+    inside = next(l for l in lines if l["msg"] == "placing pod-a")
+    assert inside["trace_id"] == "uid-log-1"
+    assert inside["level"] == "INFO"
+    assert inside["logger"] == "tpushare.obs-test"
+    outside = next(l for l in lines if l["msg"] == "outside")
+    assert "trace_id" not in outside
+
+
+def test_span_event_cap_bounds_memory():
+    from tpushare.obs.trace import MAX_EVENTS_PER_SPAN, Span
+
+    t = Trace("cap-1", "cap", 1)
+    s = Span("bind")
+    t.spans.append(s)
+    for i in range(MAX_EVENTS_PER_SPAN + 50):
+        s.annotate("api", verb="patch_pod", i=i)
+    assert len(s.events) == MAX_EVENTS_PER_SPAN
+    assert s.events_dropped == 50
+    s.finish()
+    d = s.to_dict(t)
+    assert d["events_dropped"] == 50

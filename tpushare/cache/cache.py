@@ -55,6 +55,7 @@ from tpushare.k8s.client import ApiError
 from tpushare.k8s.informer import lookup as lister_lookup
 from tpushare.k8s.singleflight import Singleflight
 from tpushare.metrics import Counter, LabeledCounter
+from tpushare.obs.trace import TRACER, annotate_current
 
 log = logging.getLogger("tpushare.cache")
 
@@ -242,10 +243,17 @@ class SchedulerCache:
     # -- placement memo -------------------------------------------------------
 
     def score_nodes(self, pod: dict[str, Any], req: PlacementRequest,
-                    node_names: list[str]
+                    node_names: list[str],
+                    provenance: dict[str, str] | None = None
                     ) -> tuple[dict[str, int | None], dict[str, str]]:
         """Fleet scores for ``pod`` over ``node_names``, memoized per
         (pod, request signature) with per-node generation stamps.
+
+        ``provenance`` (optional out-param) is filled with
+        ``node -> "memo" | "computed"`` — which verdicts were served
+        under a still-valid stamp vs recomputed this call. The explain
+        audit (obs/explain.py) records it per decision, and the
+        cache.score_nodes trace span carries the aggregate counts.
 
         Returns ``(scores, errors)``: ``scores[name]`` is the native
         engine's best binpack score (lower = tighter; None = no
@@ -262,6 +270,12 @@ class SchedulerCache:
         and serving "unavailable" forever for a node that recovered
         would strand the pod. Structural errors ("not a TPU-share
         node") are stamped against the live NodeInfo like scores.
+
+        Tracing: a full memo hit is a dict read — it lands as one event
+        on the caller's phase span. Only a scan that actually computes
+        (memo miss / stale nodes) opens a ``cache.score_nodes`` child
+        span, so the timeline shows real work, and the hit path stays
+        span-free (the bind-storm overhead budget is counted in spans).
         """
         from tpushare.core.native import engine as native_engine
 
@@ -283,6 +297,8 @@ class SchedulerCache:
                     stamp = entry.stamps.get(n)
                     if stamp is not None and stamp == self._node_version(n):
                         reused += 1
+                        if provenance is not None:
+                            provenance[n] = "memo"
                         if self._verify_serves and n in entry.scores:
                             verify.append((n, stamp, entry.scores[n]))
                     else:
@@ -302,35 +318,21 @@ class SchedulerCache:
                        {n: entry.errors[n] for n in node_names
                         if n in entry.errors})
         if full_hit:
+            annotate_current("score_nodes", memo="hit",
+                             nodes_reused=reused)
             # verification takes node locks; never do that while holding
             # the memo lock (lock order is stripe -> node -> memo)
             self._verify_served(verify, req)
             return out
+        if provenance is not None:
+            for n in missing:
+                provenance[n] = "computed"
         MEMO_REQUESTS.inc("score", "miss")
-        scores: dict[str, int | None] = {}
-        fetch_errors: dict[str, str] = {}
-        node_errors: dict[str, str] = {}
-        stamps: dict[str, tuple[int, int]] = {}
-        known: list[str] = []
-        snapshots = []
-        for name in missing:
-            try:
-                info = self.get_node_info(name)
-            except ApiError as e:
-                fetch_errors[name] = f"node unavailable: {e}"
-                continue
-            # stamp and views captured atomically under the node lock:
-            # the stamp is exactly the generation of the scored state
-            stamp, snap = info.stamped_snapshot()
-            stamps[name] = stamp
-            if info.chip_count <= 0:
-                node_errors[name] = "not a TPU-share node"
-                continue
-            known.append(name)
-            snapshots.append((snap, info.topology))
-        for name, score in zip(known,
-                               native_engine.score_fleet(snapshots, req)):
-            scores[name] = score
+        with TRACER.span("cache.score_nodes", memo="miss",
+                         nodes_reused=reused,
+                         nodes_computed=len(missing)):
+            scores, fetch_errors, node_errors, stamps = \
+                self._compute_missing(missing, req, native_engine)
         with self._memo_lock:
             entry = self._memo.get(key)
             if entry is None or entry.req_sig != sig:
@@ -355,6 +357,39 @@ class SchedulerCache:
                 out[1][n] = msg
         self._verify_served(verify, req)
         return out
+
+    def _compute_missing(self, missing: list[str], req: PlacementRequest,
+                         native_engine) -> tuple[
+                             dict[str, int | None], dict[str, str],
+                             dict[str, str], dict[str, tuple[int, int]]]:
+        """The recompute half of :meth:`score_nodes`: snapshot every
+        stale/uncovered node and run the native fleet scan. Returns
+        (scores, fetch_errors, node_errors, stamps)."""
+        scores: dict[str, int | None] = {}
+        fetch_errors: dict[str, str] = {}
+        node_errors: dict[str, str] = {}
+        stamps: dict[str, tuple[int, int]] = {}
+        known: list[str] = []
+        snapshots = []
+        for name in missing:
+            try:
+                info = self.get_node_info(name)
+            except ApiError as e:
+                fetch_errors[name] = f"node unavailable: {e}"
+                continue
+            # stamp and views captured atomically under the node lock:
+            # the stamp is exactly the generation of the scored state
+            stamp, snap = info.stamped_snapshot()
+            stamps[name] = stamp
+            if info.chip_count <= 0:
+                node_errors[name] = "not a TPU-share node"
+                continue
+            known.append(name)
+            snapshots.append((snap, info.topology))
+        for name, score in zip(known,
+                               native_engine.score_fleet(snapshots, req)):
+            scores[name] = score
+        return scores, fetch_errors, node_errors, stamps
 
     def _verify_served(self, served: list[tuple[str, int, int | None]],
                        req: PlacementRequest) -> None:

@@ -289,7 +289,8 @@ class GangCoordinator:
 
     def bind_member(self, pod: dict[str, Any], node_name: str, cluster,
                     now_ns: Callable[[], int] = time.time_ns,
-                    ha_claims: bool = False):
+                    ha_claims: bool = False,
+                    extra_annotations: dict | None = None):
         """Bind one gang member to its planned share on ``node_name``.
 
         First member: computes the plan, reserves EVERY member's share
@@ -365,6 +366,8 @@ class GangCoordinator:
         extra = {contract.ANN_GANG: gid,
                  contract.ANN_GANG_SIZE: str(size),
                  contract.ANN_GANG_RANK: str(rank)}
+        if extra_annotations:
+            extra.update(extra_annotations)
         if first:
             extra[contract.ANN_GANG_PLAN] = plan.to_json()
         placement = info.allocate_planned(

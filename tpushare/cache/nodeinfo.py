@@ -322,6 +322,7 @@ class NodeInfo:
         now_ns: Callable[[], int] = time.time_ns,
         ha_claims: bool = False,
         hint: Placement | None = None,
+        extra_annotations: dict | None = None,
     ) -> Placement:
         """Bind-path: select chips, reserve, patch annotations, bind, confirm.
 
@@ -377,7 +378,8 @@ class NodeInfo:
             self._dirty()
         try:
             return self._allocate_io(pod, cluster, now_ns, placement,
-                                     demand, uid, key, ns, name, ha_claims)
+                                     demand, uid, key, ns, name, ha_claims,
+                                     extra_annotations=extra_annotations)
         finally:
             with self._lock:
                 self._inflight.discard(key)

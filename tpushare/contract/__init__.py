@@ -18,6 +18,7 @@ from tpushare.contract.constants import (
     ANN_ASSIGNED,
     ANN_ASSUME_TIME,
     ANN_TOPOLOGY,
+    ANN_TRACE_CONTEXT,
     ANN_NODE_CLAIMS,
     ANN_GANG,
     ANN_GANG_PLAN,

@@ -40,6 +40,11 @@ ANN_HBM_CHIP = _PREFIX + "hbm-chip"         # per-chip HBM total, MiB
 ANN_ASSIGNED = _PREFIX + "assigned"         # "false" at bind; "true" at runtime
 ANN_ASSUME_TIME = _PREFIX + "assume-time"   # bind timestamp, ns since epoch
 ANN_TOPOLOGY = _PREFIX + "topology"         # granted sub-slice shape, "2x2"
+# Trace context (obs/trace.py): the scheduling-cycle trace id stamped by
+# Bind into the placement patch, so the device plugin's Allocate joins
+# the SAME trace across the process boundary — the placement-handoff
+# annotation channel doubling as the Dapper context carrier.
+ANN_TRACE_CONTEXT = _PREFIX + "trace-context"
 # NODE annotation: JSON map of in-flight bind claims (pod accounting key ->
 # {"c": [chip ids], "h": per-chip MiB, "t": claim ns}). CAS-updated on every
 # bind to serialize same-node placements across HA replicas; see

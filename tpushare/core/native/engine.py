@@ -38,6 +38,7 @@ import threading
 from typing import Sequence, TYPE_CHECKING
 
 from tpushare.metrics import LabeledCounter
+from tpushare.obs.trace import annotate_current
 
 if TYPE_CHECKING:  # placement imports us lazily; avoid cycle at runtime
     from tpushare.core.chips import ChipView
@@ -242,17 +243,30 @@ def _fleet_call(call_range, n_nodes: int, call: str,
     shards = min(workers, n_nodes // _MIN_SHARD)
     if shards <= 1:
         NATIVE_FLEET_SCANS.inc(call, "native")
+        annotate_current("native_scan", call=call, engine="native",
+                         shards=1, nodes=n_nodes)
         return call_range(0, n_nodes)
     NATIVE_FLEET_SCANS.inc(call, "native_parallel")
     pool = _get_pool(workers)
     step = (n_nodes + shards - 1) // shards
     bounds = [(a, min(a + step, n_nodes))
               for a in range(0, n_nodes, step)]
+    annotate_current("native_scan", call=call, engine="native_parallel",
+                     shards=len(bounds), nodes=n_nodes)
     futures = [pool.submit(call_range, a, b) for a, b in bounds[1:]]
     rc = call_range(*bounds[0])  # this thread scores the first shard
     for f in futures:
         rc = rc or f.result()
     return rc
+
+
+def _fleet_fallback(call: str, reason: str) -> None:
+    """Account one whole-fleet degradation to the Python scan (counters
+    + the active trace span, so a slow Filter's timeline says WHY)."""
+    NATIVE_FALLBACKS.inc(reason)
+    NATIVE_FLEET_SCANS.inc(call, "python")
+    annotate_current("native_scan", call=call, engine="python",
+                     reason=reason)
 
 
 def warmup() -> bool:
@@ -361,8 +375,7 @@ def fits_fleet(nodes, req: "PlacementRequest",
 
     lib = _load()
     if lib is None:
-        NATIVE_FALLBACKS.inc("no_lib")
-        NATIVE_FLEET_SCANS.inc("fits", "python")
+        _fleet_fallback("fits", "no_lib")
         return [fits_py(chips, topo, req) for chips, topo in nodes]
     try:
         import numpy as np
@@ -377,14 +390,12 @@ def fits_fleet(nodes, req: "PlacementRequest",
                 "numpy unavailable: fleet Filter runs the per-node Python "
                 "scan (O(nodes) slower at fleet scale); install numpy to "
                 "restore the single-call native path")
-        NATIVE_FALLBACKS.inc("no_numpy")
-        NATIVE_FLEET_SCANS.inc("fits", "python")
+        _fleet_fallback("fits", "no_numpy")
         return [fits_py(chips, topo, req) for chips, topo in nodes]
 
     marshalled = _marshal_fleet(np, nodes, req)
     if marshalled is None:
-        NATIVE_FALLBACKS.inc("not_expressible")
-        NATIVE_FLEET_SCANS.inc("fits", "python")
+        _fleet_fallback("fits", "not_expressible")
         return [fits_py(chips, topo, req) for chips, topo in nodes]
     dense_idx, free, total, dims, chip_offsets, mesh_offsets = marshalled
 
@@ -480,19 +491,16 @@ def score_fleet(nodes, req: "PlacementRequest",
 
     lib = _load()
     if lib is None:
-        NATIVE_FALLBACKS.inc("no_lib")
-        NATIVE_FLEET_SCANS.inc("score", "python")
+        _fleet_fallback("score", "no_lib")
         return [py_score(chips, topo) for chips, topo in nodes]
     try:
         import numpy as np
     except ImportError:
-        NATIVE_FALLBACKS.inc("no_numpy")
-        NATIVE_FLEET_SCANS.inc("score", "python")
+        _fleet_fallback("score", "no_numpy")
         return [py_score(chips, topo) for chips, topo in nodes]
     marshalled = _marshal_fleet(np, nodes, req)
     if marshalled is None:
-        NATIVE_FALLBACKS.inc("not_expressible")
-        NATIVE_FLEET_SCANS.inc("score", "python")
+        _fleet_fallback("score", "not_expressible")
         return [py_score(chips, topo) for chips, topo in nodes]
     dense_idx, free, total, dims, chip_offsets, mesh_offsets = marshalled
 

@@ -80,10 +80,10 @@ def main(argv: list[str] | None = None) -> int:
                          "the apiserver on every Allocate (debug only)")
     args = ap.parse_args(argv)
 
-    logging.basicConfig(
-        level=getattr(logging,
-                      os.environ.get("LOG_LEVEL", "info").upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # structured JSON logging, trace id stamped per line (obs/logging.py
+    # — Allocate joins the extender's cycle trace, and so do its logs)
+    from tpushare.obs.logging import setup as setup_logging
+    setup_logging(os.environ.get("LOG_LEVEL", "info"))
     log = logging.getLogger("tpushare.dp.main")
 
     if not args.node_name:

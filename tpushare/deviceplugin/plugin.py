@@ -44,8 +44,19 @@ from tpushare.contract.constants import (
 from tpushare.k8s.client import ApiError
 from tpushare.k8s.informer import LISTER_REQUESTS
 from tpushare.k8s.singleflight import Singleflight
+from tpushare.metrics import LATENCY_BUCKETS, Histogram
+from tpushare.obs.trace import TRACER
 
 log = logging.getLogger("tpushare.deviceplugin")
+
+# process-wide (the CLAIM_CAS_RETRIES pattern): the runtime end of the
+# scheduling cycle — how long the kubelet-driven rendezvous takes. The
+# trace exemplars point at the cycle whose Allocate they time.
+ALLOCATE_SECONDS = Histogram(
+    "tpushare_allocate_seconds",
+    "Device-plugin Allocate rendezvous latency (match a kubelet "
+    "container-start request to a placed pod + assigned-flag CAS)",
+    LATENCY_BUCKETS)
 
 
 class AllocateError(Exception):
@@ -410,7 +421,34 @@ class DevicePlugin:
         :meth:`placement_unit_ranges`), the devices themselves name the
         pod and the amount heuristic is skipped entirely — this is what
         makes same-size rendezvous deterministic at the device level.
+
+        Observability: latency lands in ``tpushare_allocate_seconds``,
+        and on success the span JOINS the scheduling-cycle trace named
+        by the pod's ``trace-context`` annotation (stamped at bind) —
+        the cross-process half of the Filter->...->Allocate timeline.
         """
+        t0 = time.perf_counter()
+        try:
+            result = self._allocate(hbm_mib, pod_uid, device_ids)
+        except AllocateError:
+            ALLOCATE_SECONDS.observe(time.perf_counter() - t0)
+            raise
+        self._observe_allocate(t0, result)
+        return result
+
+    def _observe_allocate(self, t0: float,
+                          result: dict[str, Any] | None) -> None:
+        dur_s = time.perf_counter() - t0
+        ctx = (result or {}).get("trace_context")
+        ALLOCATE_SECONDS.observe(dur_s, exemplar=ctx)
+        if result is not None:
+            TRACER.record_remote_span(
+                ctx, "allocate", dur_s * 1e3, node=self.node_name,
+                pod=f'{result["pod"]["namespace"]}/{result["pod"]["name"]}',
+                chip_ids=result["chip_ids"])
+
+    def _allocate(self, hbm_mib: int | None, pod_uid: str | None,
+                  device_ids: list[str] | None) -> dict[str, Any]:
         try:
             return self._allocate_from(self._list_node_pods(),
                                        hbm_mib, pod_uid, device_ids)
@@ -481,15 +519,23 @@ class DevicePlugin:
         4. otherwise raise, so a genuinely unmatched exclusive container
            fails container start instead of silently running without TPUs.
         """
+        t0 = time.perf_counter()
         try:
-            return self._allocate_exclusive_from(self._list_node_pods(),
-                                                 count)
+            result = self._allocate_exclusive_from(self._list_node_pods(),
+                                                   count)
         except AllocateError:
             if self._pod_lister is None:
+                ALLOCATE_SECONDS.observe(time.perf_counter() - t0)
                 raise
             LISTER_REQUESTS.inc("pods", "miss")  # watch lag; see allocate
-            return self._allocate_exclusive_from(
-                self._list_node_pods(force_apiserver=True), count)
+            try:
+                result = self._allocate_exclusive_from(
+                    self._list_node_pods(force_apiserver=True), count)
+            except AllocateError:
+                ALLOCATE_SECONDS.observe(time.perf_counter() - t0)
+                raise
+        self._observe_allocate(t0, result)
+        return result
 
     def _allocate_exclusive_from(self, snapshot: list[dict[str, Any]],
                                  count: int) -> dict[str, Any] | None:
@@ -579,6 +625,10 @@ class DevicePlugin:
             "chip_ids": list(ids),
             "devices": devices,
             "env": env,
+            # the scheduling-cycle trace this placement belongs to
+            # (obs/trace.py; None for pods bound by a pre-trace extender)
+            "trace_context": podlib.annotations(chosen).get(
+                contract.ANN_TRACE_CONTEXT),
         }
 
     def _gang_peers(self, ns: str, gid: str) -> list[dict[str, Any]]:

@@ -74,10 +74,10 @@ def main(argv: list[str] | None = None) -> int:
                          "serves Bind (multi-replica deployments)")
     args = ap.parse_args(argv)
 
-    logging.basicConfig(
-        level=getattr(logging,
-                      os.environ.get("LOG_LEVEL", "info").upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # structured JSON logging with the active trace id in every line
+    # (obs/logging.py; TPUSHARE_LOG_FORMAT=plain for the dev format)
+    from tpushare.obs.logging import setup as setup_logging
+    setup_logging(os.environ.get("LOG_LEVEL", "info"))
     log = logging.getLogger("tpushare.main")
 
     if args.fake_nodes:

@@ -33,6 +33,7 @@ from tpushare.k8s.retry import DeadlineExceeded
 from tpushare.k8s.singleflight import Singleflight
 from tpushare.k8s.stats import api_origin
 from tpushare.metrics import Counter, LabeledCounter
+from tpushare.obs.trace import TRACER
 
 log = logging.getLogger("tpushare.extender")
 
@@ -60,7 +61,8 @@ class FilterHandler:
     (reference Predicate.Handler, predicate.go:15-39)."""
 
     def __init__(self, cache: SchedulerCache, registry: Registry,
-                 gang=None, breaker=None, staleness_fn=None) -> None:
+                 gang=None, breaker=None, staleness_fn=None,
+                 tracer=None, explain=None) -> None:
         self._cache = cache
         self._gang = gang  # GangCoordinator | None
         # degraded mode: when the apiserver circuit is open this verb
@@ -69,6 +71,12 @@ class FilterHandler:
         # counted so operators can see how much traffic ran degraded
         self._breaker = breaker
         self._staleness_fn = staleness_fn
+        # observability (obs/): Filter STARTS the pod's scheduling-cycle
+        # trace, and every candidate verdict is recorded for
+        # /inspect/explain. Defaults to the process tracer so directly
+        # constructed handlers (bench, tests) trace too.
+        self._tracer = tracer or TRACER
+        self._explain = explain  # ExplainStore | None
         self._filter_total = registry.counter(
             "tpushare_filter_requests_total", "Filter webhook calls")
         self._filter_latency = registry.histogram(
@@ -81,19 +89,39 @@ class FilterHandler:
     def _handle(self, args: dict[str, Any]) -> dict[str, Any]:
         t0 = time.perf_counter()
         self._filter_total.inc()
+        pod = args.get("Pod") or {}
+        pod_key = podlib.pod_cache_key(pod)
+        trace = self._tracer.begin_cycle(pod_key, pod)
+        with self._tracer.root_span(trace, "filter") as sp:
+            result = self._filter(args, pod, pod_key, trace, sp)
+            sp.set_tags(ok=len(result["NodeNames"]),
+                        failed=len(result["FailedNodes"]))
+        self._filter_latency.observe(
+            time.perf_counter() - t0,
+            exemplar=trace.trace_id if trace else None)
+        return result
+
+    def _filter(self, args: dict[str, Any], pod: dict[str, Any],
+                pod_key: str, trace, sp) -> dict[str, Any]:
         if self._breaker is not None and \
                 self._breaker.state == BREAKER_IS_OPEN:
             DEGRADED_SERVES.inc("filter")
+            sp.set_tag("degraded", True)
             stale = self._staleness_fn() if self._staleness_fn else None
             log.debug("filter: serving degraded from cache (apiserver "
                       "circuit open; staleness bound %s s)",
                       f"{stale:.1f}" if stale is not None else "unknown")
-        pod = args.get("Pod") or {}
         node_names = args.get("NodeNames")
         if node_names is None:
             items = (args.get("Nodes") or {}).get("items") or []
             node_names = [n.get("metadata", {}).get("name", "")
                           for n in items]
+        trace_id = trace.trace_id if trace else None
+
+        def audit(nodes: dict[str, dict[str, Any]]) -> None:
+            if self._explain is not None:
+                self._explain.record_filter(pod_key, pod, trace_id, nodes)
+
         # gang members route through the coordinator: exactly one host
         # (the planned one for this member's rank) comes back, so the
         # default scheduler cannot diverge from the gang geometry
@@ -102,22 +130,26 @@ class FilterHandler:
             try:
                 membership = podlib.gang_membership(pod)
             except ValueError as e:
-                self._filter_latency.observe(time.perf_counter() - t0)
                 return {"NodeNames": [], "FailedNodes": {},
                         "Error": str(e)}
             if membership is not None:
+                sp.set_tag("gang", membership[0])
                 hosts, reason = self._gang.filter_hosts(pod)
                 hosts = [h for h in hosts if h in set(node_names)]
                 failed = {} if hosts else {
                     n: reason or "not the planned gang host"
                     for n in node_names if n}
-                self._filter_latency.observe(time.perf_counter() - t0)
+                audit({n: {"verdict": "ok", "reason": "planned gang host"}
+                       for n in hosts}
+                      | {n: {"verdict": "rejected", "reason": r}
+                         for n, r in failed.items()})
                 log.debug("filter gang %s: -> %s",
                           podlib.pod_key(pod), hosts)
                 return {"NodeNames": hosts, "FailedNodes": failed,
                         "Error": ""}
         ok_nodes: list[str] = []
         failed: dict[str, str] = {}
+        verdicts: dict[str, dict[str, Any]] = {}
         req = request_from_pod(pod)
         node_names = [n for n in node_names if n]
         if req is None:
@@ -128,21 +160,36 @@ class FilterHandler:
                     self._cache.get_node_info(name)
                 except ApiError as e:
                     failed[name] = f"node unavailable: {e}"
+                    verdicts[name] = {"verdict": "rejected",
+                                      "reason": failed[name]}
                     continue
                 ok_nodes.append(name)
+                verdicts[name] = {"verdict": "ok",
+                                  "reason": "no TPU request to check"}
         else:
             # one memoized native call evaluates the whole fleet (hot
             # loops #1+#2 of SURVEY §3.2 fused; flat wrt node count) —
             # Prioritize and Bind reuse this exact pass via the memo
-            scores, errors = self._cache.score_nodes(pod, req, node_names)
+            prov: dict[str, str] = {}
+            scores, errors = self._cache.score_nodes(pod, req, node_names,
+                                                     provenance=prov)
             for name in node_names:
                 if name in errors:
                     failed[name] = errors[name]
+                    verdicts[name] = {"verdict": "rejected",
+                                      "reason": errors[name],
+                                      "source": prov.get(name)}
                 elif scores.get(name) is not None:
                     ok_nodes.append(name)
+                    verdicts[name] = {"verdict": "ok",
+                                      "score": scores[name],
+                                      "source": prov.get(name)}
                 else:
                     failed[name] = no_fit_reason(req, name)
-        self._filter_latency.observe(time.perf_counter() - t0)
+                    verdicts[name] = {"verdict": "rejected",
+                                      "reason": failed[name],
+                                      "source": prov.get(name)}
+        audit(verdicts)
         log.debug("filter %s: %d ok / %d failed",
                   podlib.pod_key(pod), len(ok_nodes), len(failed))
         return {"NodeNames": ok_nodes, "FailedNodes": failed, "Error": ""}
@@ -169,9 +216,11 @@ class PrioritizeHandler:
     MAX_PRIORITY = 10  # k8s MaxExtenderPriority
 
     def __init__(self, cache: SchedulerCache, registry: Registry,
-                 breaker=None) -> None:
+                 breaker=None, tracer=None, explain=None) -> None:
         self._cache = cache
         self._breaker = breaker  # degraded-mode accounting, like Filter
+        self._tracer = tracer or TRACER  # joins the cycle Filter opened
+        self._explain = explain  # ExplainStore | None
         self._prioritize_total = registry.counter(
             "tpushare_prioritize_requests_total", "Prioritize webhook calls")
         self._prioritize_latency = registry.histogram(
@@ -185,10 +234,22 @@ class PrioritizeHandler:
     def _handle(self, args: dict[str, Any]) -> list[dict[str, Any]]:
         t0 = time.perf_counter()
         self._prioritize_total.inc()
+        pod = args.get("Pod") or {}
+        pod_key = podlib.pod_cache_key(pod)
+        trace = self._tracer.join_or_begin(pod_key, pod)
+        with self._tracer.root_span(trace, "prioritize") as sp:
+            out = self._prioritize(args, pod, pod_key, trace, sp)
+        self._prioritize_latency.observe(
+            time.perf_counter() - t0,
+            exemplar=trace.trace_id if trace else None)
+        return out
+
+    def _prioritize(self, args: dict[str, Any], pod: dict[str, Any],
+                    pod_key: str, trace, sp) -> list[dict[str, Any]]:
         if self._breaker is not None and \
                 self._breaker.state == BREAKER_IS_OPEN:
             DEGRADED_SERVES.inc("prioritize")
-        pod = args.get("Pod") or {}
+            sp.set_tag("degraded", True)
         node_names = args.get("NodeNames")
         if node_names is None:
             items = (args.get("Nodes") or {}).get("items") or []
@@ -229,7 +290,11 @@ class PrioritizeHandler:
             # scheduler's weighted choice almost always lands there, and
             # Bind then seeds allocate from this instead of re-searching
             self._cache.memo_best_placement(pod, req, best_name)
-        self._prioritize_latency.observe(time.perf_counter() - t0)
+        sp.set_tags(candidates=len(node_names), best=best_name)
+        if self._explain is not None:
+            self._explain.record_prioritize(
+                pod_key, pod, trace.trace_id if trace else None,
+                {h["Host"]: h["Score"] for h in out}, best_name)
         return out
 
 
@@ -350,7 +415,13 @@ class PreemptHandler:
 
     def handle(self, args: dict[str, Any]) -> dict[str, Any]:
         with api_origin("preempt"):
-            return self._handle(args)
+            pod = args.get("Pod") or {}
+            trace = TRACER.join_or_begin(podlib.pod_cache_key(pod), pod)
+            with TRACER.root_span(trace, "preempt") as sp:
+                out = self._handle(args)
+                sp.set_tag("nodes_kept",
+                           len(out.get("NodeNameToMetaVictims") or {}))
+            return out
 
     def _handle(self, args: dict[str, Any]) -> dict[str, Any]:
         t0 = time.perf_counter()
@@ -402,11 +473,18 @@ class BindHandler:
 
     def __init__(self, cache: SchedulerCache, cluster,
                  registry: Registry, ha_claims: bool = False,
-                 gang=None, pod_lister=None, breaker=None) -> None:
+                 gang=None, pod_lister=None, breaker=None,
+                 tracer=None, explain=None) -> None:
         self._cache = cache
         self._cluster = cluster
         self._ha_claims = ha_claims
         self._gang = gang  # GangCoordinator | None
+        # observability: Bind joins (or opens) the pod's cycle trace,
+        # CLOSES it on exit, and stamps the trace context into the
+        # placement annotations so the device plugin's Allocate joins
+        # the same trace across the process boundary
+        self._tracer = tracer or TRACER
+        self._explain = explain  # ExplainStore | None
         # degraded mode: an open apiserver circuit makes every bind
         # write doomed — refuse up front (distinct error, ~0 ms) instead
         # of reserving chips, failing the writes, and rolling back while
@@ -437,12 +515,40 @@ class BindHandler:
             return self._handle(args)
 
     def _handle(self, args: dict[str, Any]) -> dict[str, Any]:
-        t0 = time.perf_counter()
-        self.bind_total.inc()
         ns = args.get("PodNamespace", "default")
         name = args.get("PodName", "")
         uid = args.get("PodUID", "")
         node = args.get("Node", "")
+        pod_key = uid or f"{ns}/{name}"
+        trace = self._tracer.join_or_begin(pod_key)
+        audit: dict[str, Any] = {}
+        with self._tracer.root_span(trace, "bind") as sp:
+            sp.set_tag("node", node)
+            if self._breaker is not None:
+                sp.set_tag("breaker", self._breaker.state)
+            result = self._bind(args, ns, name, uid, node, trace, sp,
+                                audit)
+            err = result.get("Error") or ""
+            sp.set_tag("error", err)
+            if audit.get("chip_ids") is not None:
+                sp.set_tag("chip_ids", audit["chip_ids"])
+        outcome = "bound" if not err else "bind_failed"
+        if self._explain is not None:
+            self._explain.record_bind(
+                pod_key, {"metadata": {"namespace": ns, "name": name,
+                                       "uid": uid}},
+                trace.trace_id if trace else None, node, outcome,
+                error=audit.get("reason") or err or None,
+                chip_ids=audit.get("chip_ids"))
+        self._tracer.finish(pod_key, outcome)
+        return result
+
+    def _bind(self, args: dict[str, Any], ns: str, name: str, uid: str,
+              node: str, trace, sp,
+              audit: dict[str, Any]) -> dict[str, Any]:
+        t0 = time.perf_counter()
+        self.bind_total.inc()
+        trace_id = trace.trace_id if trace else None
         if self._breaker is not None and \
                 self._breaker.state == BREAKER_IS_OPEN:
             # fail fast with a DISTINCT error: the scheduler re-binds
@@ -451,7 +557,10 @@ class BindHandler:
             # POST would fail-fast too) and no chip reservation churn.
             BIND_FASTFAIL.inc()
             self.bind_failures.inc()
-            self.bind_latency.observe(time.perf_counter() - t0)
+            self.bind_latency.observe(time.perf_counter() - t0,
+                                      exemplar=trace_id)
+            audit["reason"] = ("breaker fast-fail: apiserver circuit "
+                              "open (degraded mode)")
             log.warning("bind %s/%s -> %s refused fast: apiserver "
                         "circuit open", ns, name, node)
             return {"Error":
@@ -468,17 +577,26 @@ class BindHandler:
                               if self._gang is not None else None)
             except ValueError as e:
                 raise AllocationError(str(e)) from None
+            # the annotation half of the trace: Allocate (device plugin,
+            # usually another process) reads this back and joins the
+            # SAME trace id — the placement handoff channel doubles as
+            # the trace-context carrier
+            trace_ann = ({contract.ANN_TRACE_CONTEXT: trace_id}
+                         if trace_id else None)
             if membership is not None:
                 # gang member: all-or-nothing slice placement through
                 # the coordinator (reserve-everywhere on first member,
                 # planned-replay for the rest)
                 placement = self._gang.bind_member(
-                    pod, node, self._cluster, ha_claims=self._ha_claims)
+                    pod, node, self._cluster, ha_claims=self._ha_claims,
+                    extra_annotations=trace_ann)
             else:
                 info = self._cache.get_node_info(node)
                 placement = info.allocate(
                     pod, self._cluster, ha_claims=self._ha_claims,
-                    hint=self._cache.placement_hint(pod, node))
+                    hint=self._cache.placement_hint(pod, node),
+                    extra_annotations=trace_ann)
+            audit["chip_ids"] = list(placement.chip_ids)
             self._cache.forget_memo(pod)
         except AlreadyBoundError as e:
             err = e
@@ -513,7 +631,8 @@ class BindHandler:
             # exceptions and the early returns above) and BEFORE event
             # emission: the event POST is its own apiserver round-trip and
             # must not skew the BASELINE p50/p99
-            self.bind_latency.observe(time.perf_counter() - t0)
+            self.bind_latency.observe(time.perf_counter() - t0,
+                                      exemplar=trace_id)
         if isinstance(err, AlreadyBoundError):
             if bound_node == node:
                 # duplicate delivery (webhook retry / HA replica race lost
@@ -673,3 +792,39 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
         "the Python fallback (check g++/numpy; see "
         "tpushare_native_fallback_total for the reason)",
         lambda: [("", 1.0 if _native.available() else 0.0)])
+    # observability set (obs/): cycle-trace accounting, the metric-
+    # registry cardinality guard, and the device plugin's Allocate
+    # phase histogram (meaningful when plugin and extender share a
+    # process — dev mode, tests, bench; the production DaemonSet scrapes
+    # its own copy)
+    from tpushare.deviceplugin.plugin import ALLOCATE_SECONDS
+    from tpushare.metrics import METRIC_SERIES_CLAMPED
+    from tpushare.obs.trace import TRACES_TOTAL
+
+    registry.register(TRACES_TOTAL)
+    registry.register(METRIC_SERIES_CLAMPED)
+    registry.register(ALLOCATE_SECONDS)
+    register_build_info(registry)
+
+
+def register_build_info(registry: Registry) -> None:
+    """``tpushare_build_info``: the which-build-is-this gauge (value
+    always 1; the information is the labels — the standard Prometheus
+    build-info idiom, joinable against any other series)."""
+    import platform
+
+    import tpushare
+    from tpushare.core.native import engine as _native
+
+    def info() -> list[tuple[str, float]]:
+        abi = _native.abi_version()
+        labels = (f'{{version="{tpushare.__version__}",'
+                  f'python="{platform.python_version()}",'
+                  f'native_abi="{abi if abi is not None else "none"}"}}')
+        return [(labels, 1.0)]
+
+    registry.gauge_func(
+        "tpushare_build_info",
+        "Build/runtime identity (value is always 1; read the labels: "
+        "tpushare version, python version, native engine ABI)",
+        info)

@@ -58,6 +58,13 @@ class ExtenderServer:
                 self.DEFAULT_REQUEST_DEADLINE_S))
         self.request_deadline_s = request_deadline_s
         staleness_fn = informer.staleness_s if informer is not None else None
+        # observability (obs/, docs/observability.md): the process-wide
+        # cycle tracer + its flight recorder behind /debug/traces, and
+        # the per-decision audit store behind /inspect/explain/<pod>
+        from tpushare.obs import ExplainStore
+        from tpushare.obs.trace import TRACER
+        self.tracer = TRACER
+        self.explain = ExplainStore()
         # multi-host gang placement (docs/designs/multihost-gang.md):
         # engages only for pods carrying the gang annotations, on nodes
         # labeled into slices — zero cost otherwise
@@ -65,9 +72,13 @@ class ExtenderServer:
         self.gang = GangCoordinator(cache)
         self.filter_handler = FilterHandler(cache, self.registry,
                                             gang=self.gang, breaker=breaker,
-                                            staleness_fn=staleness_fn)
+                                            staleness_fn=staleness_fn,
+                                            tracer=self.tracer,
+                                            explain=self.explain)
         self.prioritize_handler = PrioritizeHandler(cache, self.registry,
-                                                    breaker=breaker)
+                                                    breaker=breaker,
+                                                    tracer=self.tracer,
+                                                    explain=self.explain)
         self.preempt_handler = PreemptHandler(cache, self.registry)
         # HA (an elector is wired): binds also CAS a per-node claim so two
         # replicas in a stale-leader window cannot co-place onto one chip;
@@ -79,11 +90,21 @@ class ExtenderServer:
             cache, cluster, self.registry,
             ha_claims=elector is not None, gang=self.gang,
             pod_lister=informer.pods if informer is not None else None,
-            breaker=breaker)
+            breaker=breaker, tracer=self.tracer, explain=self.explain)
         self.inspect_handler = InspectHandler(cache)
         if breaker is not None:
             from tpushare.k8s.breaker import register_breaker_gauge
             register_breaker_gauge(self.registry, breaker)
+        if informer is not None:
+            # staleness as a first-class scrape (was /readyz-only): the
+            # bound on how stale a degraded-mode Filter verdict can be
+            self.registry.gauge_func(
+                "tpushare_informer_staleness_seconds",
+                "Seconds since the informer last applied a watch event "
+                "or relist (the staleness bound on degraded-mode "
+                "verdicts; alert when it grows past the relist period)",
+                lambda: [("", round(informer.staleness_s(), 3))]
+                if informer.staleness_s() is not None else [])
         self.host, self.port = host, port
         self._httpd: ThreadingHTTPServer | None = None
         # development-mode only (--fake-nodes): lets an operator seed pods
@@ -191,6 +212,19 @@ class ExtenderServer:
                     elif self.path == "/metrics":
                         self._reply(200, server_self.registry.expose(),
                                     content_type="text/plain; version=0.0.4")
+                    elif self.path.startswith("/debug/traces") or \
+                            self.path.startswith(f"{PREFIX}/debug/traces"):
+                        limit = None
+                        if "n=" in self.path:
+                            try:
+                                limit = int(self.path.split("n=")[1])
+                            except ValueError:
+                                pass
+                        self._reply(200, server_self.tracer.recorder
+                                    .dump(limit=limit))
+                    elif self.path.startswith("/inspect/explain") or \
+                            self.path.startswith(f"{PREFIX}/inspect/explain"):
+                        self._serve_explain()
                     elif self.path == f"{PREFIX}/inspect" or \
                             self.path == f"{PREFIX}/inspect/":
                         self._reply(200, server_self.inspect_handler.handle())
@@ -226,6 +260,31 @@ class ExtenderServer:
                 except Exception as e:  # noqa: BLE001
                     log.error("GET %s crashed: %s", self.path, e)
                     self._reply(500, {"error": str(e)})
+
+            def _serve_explain(self):
+                """/inspect/explain            -> list of audited pods
+                   /inspect/explain/<pod>      -> that pod's decision
+                                                  history (<pod> = uid,
+                                                  namespace/name or name)
+                """
+                path = self.path
+                if path.startswith(PREFIX):
+                    path = path[len(PREFIX):]
+                selector = path[len("/inspect/explain"):].strip("/")
+                if not selector:
+                    self._reply(200,
+                                {"pods": server_self.explain.pods()})
+                    return
+                out = server_self.explain.get(selector)
+                if out is None:
+                    self._reply(404, {
+                        "error": f"no decision record for {selector!r} "
+                                 "(kept for the last "
+                                 f"{server_self.explain.max_pods} pods x "
+                                 f"{server_self.explain.cycles_per_pod} "
+                                 "cycles)"})
+                    return
+                self._reply(200, out)
 
         return Handler
 

@@ -44,6 +44,7 @@ from typing import Any, Callable
 
 from tpushare.k8s.client import ApiError
 from tpushare.metrics import Counter, LabeledCounter
+from tpushare.obs.trace import annotate_current
 
 BREAKER_TRANSITIONS = LabeledCounter(
     "tpushare_breaker_transitions_total",
@@ -213,6 +214,8 @@ class BreakerCluster:
         def guarded(*args: Any, **kwargs: Any) -> Any:
             if not self.breaker.allow():
                 BREAKER_FASTFAIL.inc()
+                annotate_current("breaker_fastfail", verb=name,
+                                 state=self.breaker.state)
                 raise BreakerOpenError(
                     f"{name}: apiserver circuit open (failing fast; "
                     f"reset probe in <= {self.breaker.reset_timeout_s}s)")

@@ -50,6 +50,7 @@ from typing import Any, Callable
 
 from tpushare.k8s.client import ApiError
 from tpushare.metrics import Counter, LabeledCounter
+from tpushare.obs.trace import annotate_current
 
 # process-wide (the CLAIM_CAS_RETRIES pattern): attached to the extender
 # registry by register_cache_gauges so /metrics exposes them.
@@ -199,11 +200,16 @@ class RetryPolicy:
                     # the caller will have given up before the retry
                     # could land: stop burning its timeout and say so
                     DEADLINE_EXCEEDED_TOTAL.inc()
+                    annotate_current("retry_deadline", verb=verb,
+                                     remaining_s=round(remaining, 3))
                     raise DeadlineExceeded(
                         f"{verb}: deadline leaves {remaining:.3f}s, next "
                         f"retry needs {delay:.3f}s (last error: {e})"
                     ) from e
                 RETRY_ATTEMPTS.inc(verb, _status_class(e))
+                annotate_current("retry", verb=verb,
+                                 status=_status_class(e), attempt=attempt,
+                                 backoff_s=round(delay, 4))
                 if delay > 0:
                     self.sleep(delay)
 

@@ -18,9 +18,11 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Any, Iterator
 
 from tpushare.metrics import LabeledCounter
+from tpushare.obs.trace import TRACER
 
 APISERVER_REQUESTS = LabeledCounter(
     "tpushare_apiserver_requests_total",
@@ -89,7 +91,7 @@ class CountingCluster:
                 elif kwargs.get("namespace") or len(args) > 1:
                     verb = "list_pods_ns"
                 self._stats.inc(verb, current_origin())
-                return attr(*args, **kwargs)
+                return _traced_call(attr, verb, args, kwargs)
             return counted_list
         if name.startswith("watch_"):
             def counted_watch(*args: Any, **kwargs: Any) -> Any:
@@ -99,8 +101,28 @@ class CountingCluster:
 
         def counted(*args: Any, **kwargs: Any) -> Any:
             self._stats.inc(name, current_origin())
-            return attr(*args, **kwargs)
+            return _traced_call(attr, name, args, kwargs)
         return counted
+
+
+def _traced_call(attr: Any, verb: str, args: tuple, kwargs: dict) -> Any:
+    """Run one apiserver round-trip; when the calling thread is inside a
+    trace span, record the call as an event (verb, origin, ms, error) on
+    it. Outside a span this is one attribute read of overhead."""
+    span = TRACER.current_span()
+    if span is None:
+        return attr(*args, **kwargs)
+    t0 = time.perf_counter()
+    try:
+        result = attr(*args, **kwargs)
+    except Exception as e:  # noqa: BLE001 — annotate and re-raise as-is
+        span.annotate("api", verb=verb, origin=current_origin(),
+                      ms=round((time.perf_counter() - t0) * 1e3, 3),
+                      error=f"{type(e).__name__}: {e}"[:160])
+        raise
+    span.annotate("api", verb=verb, origin=current_origin(),
+                  ms=round((time.perf_counter() - t0) * 1e3, 3))
+    return result
 
 
 def hit_rate(before: dict[tuple[str, ...], float],

@@ -12,6 +12,40 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+# -- label hardening ----------------------------------------------------------
+# Label VALUES must stay low-cardinality: node names and closed enums
+# only — never pod names, UIDs or messages (each distinct value is a
+# forever-growing series in every scrape). The registry enforces it
+# mechanically: values are truncated to _MAX_LABEL_LEN, and a labeled
+# metric refuses to grow past its max_series cap — overflow traffic is
+# folded into a single "_overflow" series and counted here, so a
+# cardinality bomb degrades into one visible counter instead of an OOM.
+_MAX_LABEL_LEN = 120
+DEFAULT_MAX_SERIES = 1024
+
+# declared before LabeledCounter exists; bound at module end (Python
+# resolves the global at call time, and inc() can only run post-import)
+METRIC_SERIES_CLAMPED: "LabeledCounter"
+
+
+def _clean_label_value(v) -> str:
+    v = str(v)
+    if len(v) > _MAX_LABEL_LEN:
+        v = v[:_MAX_LABEL_LEN]
+    return v
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline) — an unescaped quote in a value corrupts every line after
+    it for strict parsers."""
+    return (v.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
 
 class Counter:
     def __init__(self, name: str, help_: str) -> None:
@@ -29,7 +63,7 @@ class Counter:
             return self._v
 
     def expose(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
+        return (f"# HELP {self.name} {_escape_help(self.help)}\n"
                 f"# TYPE {self.name} counter\n"
                 f"{self.name} {self.value}\n")
 
@@ -40,9 +74,11 @@ class LabeledCounter:
     on first increment, so an idle verb/origin pair costs nothing."""
 
     def __init__(self, name: str, help_: str,
-                 labelnames: tuple[str, ...]) -> None:
+                 labelnames: tuple[str, ...],
+                 max_series: int = DEFAULT_MAX_SERIES) -> None:
         self.name, self.help = name, help_
         self.labelnames = tuple(labelnames)
+        self.max_series = max_series
         self._series: dict[tuple[str, ...], float] = {}
         self._lock = threading.Lock()
 
@@ -51,9 +87,18 @@ class LabeledCounter:
             raise ValueError(
                 f"{self.name}: expected {len(self.labelnames)} label "
                 f"values {self.labelnames}, got {labelvalues!r}")
-        key = tuple(str(v) for v in labelvalues)
+        key = tuple(_clean_label_value(v) for v in labelvalues)
+        clamped = False
         with self._lock:
+            if key not in self._series and \
+                    len(self._series) >= self.max_series:
+                # cardinality bomb containment: fold the overflow into
+                # one sentinel series instead of growing without bound
+                key = ("_overflow",) * len(self.labelnames)
+                clamped = True
             self._series[key] = self._series.get(key, 0.0) + n
+        if clamped and self is not METRIC_SERIES_CLAMPED:
+            METRIC_SERIES_CLAMPED.inc(self.name)
 
     def get(self, *labelvalues: str) -> float:
         key = tuple(str(v) for v in labelvalues)
@@ -74,19 +119,24 @@ class LabeledCounter:
                        if all(key[i] == want for i, want in idx.items()))
 
     def expose(self) -> str:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} counter"]
         with self._lock:
             series = sorted(self._series.items())
         for key, v in series:
-            labels = ",".join(f'{n}="{val}"'
+            labels = ",".join(f'{n}="{_escape_label_value(val)}"'
                               for n, val in zip(self.labelnames, key))
             out.append(f"{self.name}{{{labels}}} {v}")
         return "\n".join(out) + "\n"
 
 
 class Histogram:
-    """Cumulative-bucket histogram (Prometheus semantics)."""
+    """Cumulative-bucket histogram (Prometheus semantics), with optional
+    trace exemplars: ``observe(v, exemplar=<trace id>)`` remembers, per
+    bucket, the latest trace id that landed there — so a p99 spike on a
+    phase histogram points straight at a /debug/traces timeline instead
+    of a needle hunt. Exemplars ride the JSON side (/debug/traces,
+    :meth:`exemplars`), keeping /metrics strict text-format 0.0.4."""
 
     def __init__(self, name: str, help_: str,
                  buckets: tuple[float, ...]) -> None:
@@ -94,24 +144,62 @@ class Histogram:
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
         self._sum = 0.0
+        self._exemplars: list[tuple[str, float] | None] = \
+            [None] * (len(self.buckets) + 1)
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def _bucket_index(self, v: float) -> int:
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                return i
+        return len(self.buckets)
+
+    def observe(self, v: float, exemplar: str | None = None) -> None:
+        i = self._bucket_index(v)
         with self._lock:
             self._sum += v
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    self._counts[i] += 1
-                    break
-            else:
-                self._counts[-1] += 1
+            self._counts[i] += 1
+            if exemplar:
+                self._exemplars[i] = (exemplar, v)
+
+    def exemplars(self) -> dict[str, dict[str, float | str]]:
+        """Per-bucket exemplar map: {le: {"trace_id", "value"}}."""
+        with self._lock:
+            pairs = list(zip(list(self.buckets) + ["+Inf"],
+                             self._exemplars))
+        return {str(le): {"trace_id": ex[0], "value": ex[1]}
+                for le, ex in pairs if ex is not None}
+
+    def quantile(self, q: float) -> float | None:
+        """Estimated q-quantile by linear interpolation inside the
+        hosting bucket (the standard histogram_quantile estimate); the
+        +Inf bucket answers with the largest finite bound. None when
+        the histogram is empty."""
+        with self._lock:
+            counts = list(self._counts)
+        total = sum(counts)
+        if total == 0:
+            return None
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i >= len(self.buckets):  # +Inf bucket
+                    return self.buckets[-1] if self.buckets else None
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                return lo + (hi - lo) * (rank - cum) / c
+            cum += c
+        return self.buckets[-1] if self.buckets else None
 
     def expose(self) -> str:
         with self._lock:
             counts = list(self._counts)
             s = self._sum
         total = sum(counts)
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} histogram"]
         cum = 0
         for i, b in enumerate(self.buckets):
@@ -134,10 +222,20 @@ class Registry:
         return c
 
     def labeled_counter(self, name: str, help_: str,
-                        labelnames: tuple[str, ...]) -> LabeledCounter:
-        c = LabeledCounter(name, help_, labelnames)
+                        labelnames: tuple[str, ...],
+                        max_series: int = DEFAULT_MAX_SERIES
+                        ) -> LabeledCounter:
+        c = LabeledCounter(name, help_, labelnames, max_series=max_series)
         self._metrics.append(c)
         return c
+
+    def get(self, name: str):
+        """The registered metric object with this name, or None (bench
+        reads phase histograms back out for quantile math)."""
+        for m in self._metrics:
+            if getattr(m, "name", None) == name:
+                return m
+        return None
 
     def register(self, metric) -> None:
         """Attach an externally owned metric (e.g. a module-level Counter
@@ -160,7 +258,8 @@ class Registry:
     def expose(self) -> str:
         parts = [m.expose() for m in self._metrics]
         for name, help_, fn in self._gauges:
-            lines = [f"# HELP {name} {help_}", f"# TYPE {name} gauge"]
+            lines = [f"# HELP {name} {_escape_help(help_)}",
+                     f"# TYPE {name} gauge"]
             try:
                 for labels, value in fn():
                     lines.append(f"{name}{labels} {value}")
@@ -173,3 +272,13 @@ class Registry:
 # latency buckets tuned around the 50 ms p50 target (BASELINE.md)
 LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                    0.1, 0.25, 0.5, 1.0, 2.5)
+
+# process-wide: one series per metric that ever clamped, so a
+# cardinality bomb is a visible, alertable event (registered on the
+# extender registry by register_cache_gauges)
+METRIC_SERIES_CLAMPED = LabeledCounter(
+    "tpushare_metric_series_clamped_total",
+    "Label tuples folded into a metric's _overflow series because the "
+    "metric hit its max_series cap (alert: some label value is "
+    "unbounded — pod names must never be label values)",
+    ("metric",))

@@ -1,0 +1,33 @@
+"""tpushare.obs — tracing, flight recording, decision audit, logging.
+
+The observability subsystem (docs/observability.md): dependency-free,
+threaded through every layer:
+
+- :mod:`tpushare.obs.trace` — scheduling-cycle span tracer (trace id =
+  pod key + cycle counter; Allocate joins via the pod-annotation trace
+  context);
+- :mod:`tpushare.obs.recorder` — flight recorder ring behind
+  ``/debug/traces``, with slow-trace pinning;
+- :mod:`tpushare.obs.explain` — per-decision audit records behind
+  ``/inspect/explain/<pod>``;
+- :mod:`tpushare.obs.logging` — structured JSON logger with the trace
+  id stamped into every line.
+"""
+
+from tpushare.obs.explain import ExplainStore  # noqa: F401
+from tpushare.obs.recorder import FlightRecorder  # noqa: F401
+from tpushare.obs.trace import (  # noqa: F401
+    NOOP_SPAN,
+    TRACER,
+    Span,
+    Trace,
+    Tracer,
+    annotate_current,
+    current_trace_id,
+    span,
+)
+
+__all__ = [
+    "ExplainStore", "FlightRecorder", "Span", "Trace", "Tracer",
+    "TRACER", "NOOP_SPAN", "annotate_current", "current_trace_id", "span",
+]

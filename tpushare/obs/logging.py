@@ -1,0 +1,85 @@
+"""Structured JSON logging with trace correlation.
+
+Every log line emitted inside a span scope carries the active trace id,
+so ``grep '"trace_id": "<id>"'`` over the service logs reconstructs one
+scheduling cycle's narrative — the textual twin of the /debug/traces
+timeline. Format is one JSON object per line (the shape log pipelines
+ingest without a parser config); ``TPUSHARE_LOG_FORMAT=plain`` keeps the
+classic human format for development, still with the trace id appended
+when one is active.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, TextIO
+
+
+class TraceContextFilter(logging.Filter):
+    """Stamps ``record.trace_id`` from the calling thread's span scope
+    (empty when logging outside any trace)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        from tpushare.obs.trace import current_trace_id
+        record.trace_id = current_trace_id() or ""
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: ts (unix + iso), level, logger, msg,
+    trace_id, and exception text when present."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: dict[str, Any] = {
+            "ts": round(record.created, 3),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                  time.gmtime(record.created))
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        trace_id = getattr(record, "trace_id", "")
+        if trace_id:
+            out["trace_id"] = trace_id
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+class PlainTraceFormatter(logging.Formatter):
+    """The classic dev format, trace id appended when active."""
+
+    def __init__(self) -> None:
+        super().__init__("%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    def format(self, record: logging.LogRecord) -> str:
+        line = super().format(record)
+        trace_id = getattr(record, "trace_id", "")
+        return f"{line} [trace {trace_id}]" if trace_id else line
+
+
+def setup(level: str | int = "INFO", json_format: bool | None = None,
+          stream: TextIO | None = None) -> logging.Handler:
+    """Install the structured handler on the root logger (replacing any
+    basicConfig handler the entry point installed before). Returns the
+    handler so tests can capture and detach it."""
+    if isinstance(level, str):
+        level = getattr(logging, level.upper(), logging.INFO)
+    if json_format is None:
+        json_format = os.environ.get("TPUSHARE_LOG_FORMAT",
+                                     "json") != "plain"
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(JsonFormatter() if json_format
+                         else PlainTraceFormatter())
+    handler.addFilter(TraceContextFilter())
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.addHandler(handler)
+    root.setLevel(level)
+    return handler

@@ -1,0 +1,102 @@
+"""Flight recorder: a fixed-size ring of completed traces.
+
+The operational shape is the black-box recorder, not the log pipeline:
+always on, bounded memory, readable the moment something looks wrong
+(``GET /debug/traces``). Two retention classes:
+
+- the **ring** holds the most recent ``capacity`` traces regardless of
+  how interesting they were (context for "what was the scheduler doing
+  around 14:32");
+- **pinned** traces — cycles slower than ``slow_ms``
+  (``TPUSHARE_TRACE_SLOW_MS``, default 50 ms = the BASELINE p50 target)
+  — survive ring eviction in their own bounded list, so the trace that
+  explains a latency-alert spike is still there after ten thousand fast
+  cycles have rolled the ring over.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 256, pinned_capacity: int = 64,
+                 slow_ms: float | None = None) -> None:
+        if slow_ms is None:
+            slow_ms = float(os.environ.get("TPUSHARE_TRACE_SLOW_MS", "50"))
+        self.capacity = capacity
+        self.pinned_capacity = pinned_capacity
+        self.slow_ms = slow_ms
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._pinned: deque = deque(maxlen=pinned_capacity)
+        self._recorded_total = 0
+
+    def record(self, trace) -> bool:
+        """Add a completed trace; returns True when it was ALSO pinned
+        as slow."""
+        slow = (trace.duration_ms or 0.0) >= self.slow_ms
+        with self._lock:
+            self._recorded_total += 1
+            self._ring.append(trace)
+            if slow:
+                self._pinned.append(trace)
+        return slow
+
+    def find(self, trace_id: str):
+        """The recorded trace with this id, or None (newest match wins —
+        a resubmitted cycle reuses ids only across tracer resets)."""
+        with self._lock:
+            for t in reversed(self._ring):
+                if t.trace_id == trace_id:
+                    return t
+            for t in reversed(self._pinned):
+                if t.trace_id == trace_id:
+                    return t
+        return None
+
+    def traces(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def pinned(self) -> list:
+        with self._lock:
+            return list(self._pinned)
+
+    def slowest(self, n: int = 3) -> list:
+        """The n slowest traces currently retained (ring + pinned,
+        deduplicated) — bench.py's slow-trace summary."""
+        with self._lock:
+            seen: dict[str, Any] = {}
+            for t in list(self._ring) + list(self._pinned):
+                seen[t.trace_id] = t
+        return sorted(seen.values(),
+                      key=lambda t: t.duration_ms or 0.0,
+                      reverse=True)[:n]
+
+    def dump(self, limit: int | None = None) -> dict[str, Any]:
+        """The /debug/traces JSON body."""
+        with self._lock:
+            ring = list(self._ring)
+            pinned = list(self._pinned)
+            total = self._recorded_total
+        if limit is not None and limit >= 0:
+            ring = ring[-limit:]
+        return {
+            "capacity": self.capacity,
+            "slow_ms": self.slow_ms,
+            "recorded_total": total,
+            "evicted_total": max(0, total - len(ring)),
+            "traces": [t.to_dict() for t in ring],
+            "pinned": [t.to_dict() for t in pinned
+                       if t not in ring],
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._pinned.clear()
+            self._recorded_total = 0

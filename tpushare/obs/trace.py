@@ -1,0 +1,423 @@
+"""In-process scheduling-cycle tracer (the Dapper shape, minus RPCs).
+
+One *trace* is one scheduling cycle of one pod: Filter -> Prioritize ->
+Bind on the extender, joined by the device plugin's Allocate across the
+process boundary. The trace id is ``<pod accounting key>-<cycle
+counter>`` — the pod annotation channel (``ANN_TRACE_CONTEXT``, stamped
+into the placement patch at bind) carries it to the device plugin the
+same way the placement itself travels, so the runtime half of a
+placement decision lands in the SAME trace as the scheduling half.
+
+Design constraints, in order:
+
+1. **Cheap enough for the bind-storm hot path.** A span is two
+   ``perf_counter`` reads, one small object and a list append; when the
+   tracer is disabled (``TPUSHARE_TRACE=0``) every entry point returns a
+   shared no-op after one attribute check. bench.py's bind-storm
+   self-check enforces <10% throughput cost with tracing ON.
+2. **No locks anywhere on the cycle path.** The thread-local span stack
+   means a webhook thread only ever touches its own spans, and the
+   open-trace map relies on GIL-atomic dict mutation (see Tracer) —
+   begin/join/finish never take a lock.
+3. **Bounded memory.** Open traces are capped with oldest-first
+   eviction (a pod that filters but never binds cannot leak); events
+   per span are capped; completed traces live in the FlightRecorder's
+   bounded ring (obs/recorder.py).
+
+Lower layers (k8s/stats.py round-trips, k8s/retry.py retries,
+core/native/engine.py fleet scans) call :func:`annotate_current` /
+:func:`span` — both no-ops unless a handler opened a root span above
+them, so library code stays wiring-free.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+from tpushare.metrics import LabeledCounter
+
+TRACES_TOTAL = LabeledCounter(
+    "tpushare_traces_total",
+    "Scheduling-cycle traces by outcome: recorded = finished and pushed "
+    "to the flight recorder, pinned = recorded AND held past ring "
+    "eviction (slow trace), superseded = a new cycle started before the "
+    "old one finished, evicted = open-trace LRU overflow (pods that "
+    "filter but never bind), joined_remote = an Allocate span arrived "
+    "for a trace this process never opened (cross-process join)",
+    ("outcome",))
+
+# spans record at most this many events (api round-trips, retries, scan
+# shards); beyond it the span grows a single "events_dropped" tag instead
+# of unbounded memory under a retry storm
+MAX_EVENTS_PER_SPAN = 64
+# open-trace LRU: pods mid-cycle (filtered, not yet bound)
+MAX_OPEN_TRACES = 1024
+
+
+class Span:
+    """One timed phase, and its own context manager (no separate scope
+    object — the bind-storm overhead budget is counted in Python calls).
+    Creation is one ``perf_counter`` read and NO dict/list allocations:
+    tags and events materialize lazily on first use (most storm-path
+    spans carry two tags and zero events), and wall-clock start offsets
+    are derived at dump time from the owning trace's clock pair, so a
+    span never calls ``time.time()`` itself."""
+
+    __slots__ = ("name", "tags", "events", "_t0", "_wall0",
+                 "duration_ms", "events_dropped", "trace", "_stack")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.tags: dict[str, Any] | None = None
+        self.events: list[dict[str, Any]] | None = None
+        self._t0 = time.perf_counter()
+        self._wall0: float | None = None  # remote spans pin it directly
+        self.duration_ms: float | None = None
+        self.events_dropped = 0
+        self.trace = None  # owning Trace (set by the tracer)
+        self._stack: list | None = None  # thread-local span stack
+
+    def __enter__(self) -> "Span":
+        if self._stack is not None:
+            self._stack.append(self)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if self.duration_ms is None:
+            self.duration_ms = (time.perf_counter() - self._t0) * 1e3
+        if self._stack is not None:
+            self._stack.pop()
+
+    def set_tag(self, key: str, value: Any) -> None:
+        if self.tags is None:
+            self.tags = {}
+        self.tags[key] = value
+
+    def set_tags(self, **tags: Any) -> None:
+        if self.tags is None:
+            self.tags = tags
+        else:
+            self.tags.update(tags)
+
+    def annotate(self, kind: str, **fields: Any) -> None:
+        """Append a timestamped event (an api round-trip, a retry, a
+        native scan) — the sub-span-without-the-overhead record."""
+        if self.events is None:
+            self.events = []
+        elif len(self.events) >= MAX_EVENTS_PER_SPAN:
+            self.events_dropped += 1
+            return
+        fields["event"] = kind
+        fields["t_ms"] = round((time.perf_counter() - self._t0) * 1e3, 3)
+        self.events.append(fields)
+
+    def finish(self) -> None:
+        if self.duration_ms is None:
+            self.duration_ms = (time.perf_counter() - self._t0) * 1e3
+
+    def wall0(self, trace: "Trace") -> float:
+        if self._wall0 is not None:
+            return self._wall0
+        return trace.wall0 + (self._t0 - trace._t0)
+
+    def to_dict(self, trace: "Trace") -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "name": self.name,
+            "start_ms": round((self.wall0(trace) - trace.wall0) * 1e3, 3),
+            "duration_ms": round(self.duration_ms, 3)
+            if self.duration_ms is not None else None,
+        }
+        if self.tags:
+            out["tags"] = self.tags
+        if self.events:
+            out["events"] = self.events
+        if self.events_dropped:
+            out["events_dropped"] = self.events_dropped
+        return out
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled-tracer (and no-active-trace)
+    fast path hands this out so call sites never branch."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        pass
+
+    def set_tag(self, key: str, value: Any) -> None:
+        pass
+
+    def set_tags(self, **tags: Any) -> None:
+        pass
+
+    def annotate(self, kind: str, **fields: Any) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Trace:
+    __slots__ = ("trace_id", "pod_key", "pod", "cycle", "spans", "wall0",
+                 "_t0", "duration_ms", "outcome")
+
+    def __init__(self, trace_id: str, pod_key: str, cycle: int,
+                 pod: dict[str, Any] | None = None) -> None:
+        self.trace_id = trace_id
+        self.pod_key = pod_key
+        self.pod = {  # identity only; never the whole object
+            "namespace": ((pod or {}).get("metadata") or {}).get("namespace"),
+            "name": ((pod or {}).get("metadata") or {}).get("name"),
+        } if pod is not None else {}
+        self.cycle = cycle
+        self.spans: list[Span] = []
+        self.wall0 = time.time()
+        self._t0 = time.perf_counter()
+        self.duration_ms: float | None = None
+        self.outcome: str | None = None
+
+    def span_names(self) -> list[str]:
+        return [s.name for s in self.spans]
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "trace_id": self.trace_id,
+            "pod": self.pod,
+            "cycle": self.cycle,
+            "start_unix": round(self.wall0, 3),
+            "duration_ms": round(self.duration_ms, 3)
+            if self.duration_ms is not None else None,
+            "outcome": self.outcome,
+            "spans": [s.to_dict(self) for s in self.spans],
+        }
+
+
+class Tracer:
+    """Process-wide tracer; handlers open root spans against a trace,
+    lower layers attach child spans/events via the thread-local stack."""
+
+    def __init__(self, recorder=None, enabled: bool | None = None) -> None:
+        from tpushare.obs.recorder import FlightRecorder
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        if enabled is None:
+            enabled = os.environ.get("TPUSHARE_TRACE", "1") != "0"
+        self.enabled = enabled
+        # LOCK-FREE maps (every op below is a single GIL-atomic dict
+        # mutation): the begin/join/finish path runs 3x per scheduling
+        # cycle on every webhook thread, and a contended lock acquire is
+        # a futex wait — measured ~2-3% of bind-storm throughput. The
+        # benign race: two concurrent webhooks for the SAME pod can each
+        # open a cycle and one supersedes the other — exactly what the
+        # locked version did, just without a serialized counter bump.
+        self._open: dict[str, Trace] = {}
+        self._cycles: dict[str, int] = {}
+        self._local = threading.local()
+
+    # -- thread-local span stack ----------------------------------------------
+
+    def _stack(self) -> list[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current_span(self) -> Span | None:
+        st = getattr(self._local, "stack", None)
+        return st[-1] if st else None
+
+    def current_trace(self) -> Trace | None:
+        st = getattr(self._local, "stack", None)
+        return st[-1].trace if st else None
+
+    def current_trace_id(self) -> str | None:
+        t = self.current_trace()
+        return t.trace_id if t is not None else None
+
+    # -- trace lifecycle ------------------------------------------------------
+
+    def begin_cycle(self, pod_key: str,
+                    pod: dict[str, Any] | None = None) -> Trace | None:
+        """Start a NEW scheduling cycle for ``pod_key`` (Filter's entry).
+        An unfinished previous cycle for the same pod is recorded as
+        superseded — the scheduler moved on, so should the trace."""
+        if not self.enabled or not pod_key:
+            return None
+        prev = self._open.pop(pod_key, None)
+        cycle = self._cycles.get(pod_key, 0) + 1
+        self._cycles[pod_key] = cycle
+        trace = Trace(f"{pod_key}-{cycle}", pod_key, cycle, pod)
+        self._open[pod_key] = trace
+        evicted = None
+        if len(self._open) > MAX_OPEN_TRACES:
+            try:  # oldest-inserted key; best-effort under concurrency
+                evicted = self._open.pop(next(iter(self._open)), None)
+            except (StopIteration, RuntimeError):
+                evicted = None
+        if len(self._cycles) > 4 * MAX_OPEN_TRACES:
+            # cycle counters for long-gone pods: keep only pods with an
+            # open trace (a reused key restarts at cycle 1, which still
+            # yields a fresh id because the uid differs)
+            self._cycles = {k: self._cycles[k]
+                            for k in list(self._open)
+                            if k in self._cycles}
+        if prev is not None:
+            self._record(prev, "superseded")
+        if evicted is not None:
+            self._record(evicted, "evicted")
+        return trace
+
+    def join_or_begin(self, pod_key: str,
+                      pod: dict[str, Any] | None = None) -> Trace | None:
+        """The open trace for ``pod_key`` (Prioritize/Bind joining the
+        cycle Filter started), or a new cycle when none is open (a bind
+        delivered without a preceding filter — webhook redelivery)."""
+        if not self.enabled or not pod_key:
+            return None
+        # lock-free hit path (dict get is GIL-atomic): under a bind
+        # storm every webhook thread joins here 2x per cycle, and a
+        # contended lock acquire is a futex wait — the LRU freshness a
+        # move_to_end would buy is not worth that
+        trace = self._open.get(pod_key)
+        if trace is not None:
+            return trace
+        return self.begin_cycle(pod_key, pod)
+
+    def finish(self, pod_key: str, outcome: str) -> Trace | None:
+        """Close the pod's open trace and push it to the flight recorder
+        (Bind's exit, success or failure)."""
+        if not self.enabled or not pod_key:
+            return None
+        trace = self._open.pop(pod_key, None)
+        if trace is None:
+            return None
+        self._record(trace, outcome)
+        return trace
+
+    def _record(self, trace: Trace, outcome: str) -> None:
+        trace.outcome = outcome
+        if trace.duration_ms is None:
+            trace.duration_ms = (time.perf_counter() - trace._t0) * 1e3
+        # NOTE: span.trace/_stack are deliberately NOT nulled here — a
+        # superseded trace's span may still be open on another webhook
+        # thread, and clearing its stack reference would corrupt that
+        # thread's span stack (the cycle is left to gc instead)
+        TRACES_TOTAL.inc(outcome if outcome in ("superseded", "evicted")
+                         else "recorded")
+        pinned = self.recorder.record(trace)
+        if pinned:
+            TRACES_TOTAL.inc("pinned")
+
+    # -- spans ----------------------------------------------------------------
+
+    def root_span(self, trace: Trace | None, name: str,
+                  **tags: Any) -> Span | _NoopSpan:
+        """Open a span directly on ``trace`` (the webhook handlers'
+        phase spans); entering it makes it the thread's current span."""
+        if trace is None:
+            return NOOP_SPAN
+        span = Span(name)
+        if tags:
+            span.tags = tags
+        span.trace = trace
+        span._stack = self._stack()
+        trace.spans.append(span)
+        return span
+
+    def span(self, name: str, **tags: Any) -> Span | _NoopSpan:
+        """Open a CHILD span under the thread's current trace (cache
+        scans, engine calls); a no-op when no root span is active."""
+        st = getattr(self._local, "stack", None)
+        if not st:
+            return NOOP_SPAN
+        trace = st[-1].trace
+        span = Span(name)
+        if tags:
+            span.tags = tags
+        span.trace = trace
+        span._stack = st
+        trace.spans.append(span)
+        return span
+
+    # -- cross-process join ---------------------------------------------------
+
+    def record_remote_span(self, trace_context: str | None, name: str,
+                           duration_ms: float,
+                           **tags: Any) -> None:
+        """Attach a span produced in ANOTHER component to the trace the
+        pod-annotation context names (the device plugin's Allocate).
+
+        Same process (tests, bench, --fake-cluster dev mode): the trace
+        is found in the open map or the flight recorder and the span
+        joins it directly. Separate process (production DaemonSet): the
+        id names a trace this process never opened, so a single-span
+        trace with the SAME id is recorded here — the operator joins the
+        two /debug/traces dumps on trace_id.
+        """
+        if not self.enabled or not trace_context:
+            return
+        span = Span(name)
+        if tags:
+            span.tags = tags
+        span._wall0 = time.time() - duration_ms / 1e3
+        span.duration_ms = duration_ms
+        target = next((t for t in list(self._open.values())
+                       if t.trace_id == trace_context), None)
+        if target is None:
+            target = self.recorder.find(trace_context)
+        if target is not None:
+            target.spans.append(span)
+            return
+        TRACES_TOTAL.inc("joined_remote")
+        pod_key, _, cycle = trace_context.rpartition("-")
+        trace = Trace(trace_context, pod_key or trace_context,
+                      int(cycle) if cycle.isdigit() else 0)
+        trace.wall0 = span._wall0
+        trace.spans.append(span)
+        trace.duration_ms = duration_ms
+        trace.outcome = "remote"
+        self.recorder.record(trace)
+
+    # -- test/bench hygiene ---------------------------------------------------
+
+    def reset(self) -> None:
+        """Drop all open traces, cycle counters and recorded traces
+        (test isolation; never called on the serving path)."""
+        self._open.clear()
+        self._cycles.clear()
+        self.recorder.reset()
+        self._local = threading.local()
+
+
+# the process-wide tracer every layer shares (extender handlers, cache,
+# k8s proxies, native engine, device plugin) — one trace per cycle only
+# works if everyone appends to the same place
+TRACER = Tracer()
+
+
+def annotate_current(kind: str, **fields: Any) -> None:
+    """Attach an event to the calling thread's current span, if any —
+    the zero-wiring hook the k8s/native layers use."""
+    span = TRACER.current_span()
+    if span is not None:
+        span.annotate(kind, **fields)
+
+
+def current_trace_id() -> str | None:
+    """Trace id of the calling thread's active span scope (the JSON
+    logger stamps this into every line)."""
+    return TRACER.current_trace_id()
+
+
+def span(name: str, **tags: Any) -> Span | _NoopSpan:
+    """Child span on the global tracer (see :meth:`Tracer.span`)."""
+    return TRACER.span(name, **tags)
