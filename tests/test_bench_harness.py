@@ -140,3 +140,35 @@ def test_probe_real_jax_subprocess_healthy_path():
         "print(jax.default_backend())"])
     assert probe["ok"] is True, probe
     assert probe["summary"] == "cpu"
+
+
+def test_onchip_failed_probe_is_skipped_env(monkeypatch):
+    """A wedged/unreachable tunnel is an ENVIRONMENT verdict: the
+    on-chip section reports skipped_env instead of error, so a wedged
+    rig cannot redden hermetic+wire results it says nothing about
+    (BENCH_r05's bench_check_failures: 1 was exactly this)."""
+    monkeypatch.setattr(
+        bench, "_probe_backend_resilient",
+        lambda: {"ok": False,
+                 "summary": "jax backend init failed/hung (1 attempt)",
+                 "attempts": ["attempt 1: rc=None probe1: hung"]})
+    out = bench.onchip_tests()
+    assert out["status"] == "skipped_env"
+    assert "environment" in out["summary"]
+    assert "init failed/hung" in out["summary"]
+
+
+def test_onchip_midsuite_wedge_is_skipped_env(monkeypatch):
+    """A suite that times out after a HEALTHY probe is the documented
+    mid-suite tunnel wedge (docs/perf.md runbook): also environment,
+    with the abandon note preserved for diagnosis."""
+    monkeypatch.setattr(bench, "_probe_backend_resilient",
+                        lambda: {"ok": True, "summary": "tpu",
+                                 "attempts": ["attempt 1: ok"]})
+    monkeypatch.setattr(
+        bench, "_run_tpu_subprocess",
+        lambda *a, **kw: (None, "", "", "tests_tpu: hung >10s, SIGINT "
+                          "unprocessed — left running; NOT killed"))
+    out = bench.onchip_tests(timeout_s=10)
+    assert out["status"] == "skipped_env"
+    assert "NOT killed" in out["summary"]

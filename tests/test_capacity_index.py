@@ -1,0 +1,451 @@
+"""Sublinear-filtering tests: capacity index, request equivalence
+classes, and the resident native fleet arena.
+
+The tentpole claims, made falsifiable:
+
+- the index NEVER wrongly prunes: indexed score_nodes output is
+  byte-identical to the full-scan path across request shapes, the
+  incremental summaries always equal a from-scratch rebuild under
+  randomized churn, and TPUSHARE_INDEX_VERIFY counts zero stale prunes;
+- pods with the same request signature share one fleet scan per
+  generation window (a 50-identical-pod storm performs ~1-2 fleet
+  scans' worth of per-node computes, the rest join), with zero stale
+  placements against the fake-apiserver TRUTH after binding the storm
+  (the chaos-soak oversubscription audit);
+- the arena is a pure marshalling cache: identical scores to
+  score_fleet, with delta slot updates (not re-packs) for mutated
+  nodes, and correct subset scans / structural rebuilds / non-dense
+  fallbacks.
+"""
+
+import random
+import threading
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import (
+    EQCLASS_SHARES, INDEX_PRUNED, INDEX_STALE_SERVES,
+    MEMO_NODE_SCORES, MEMO_STALE_SERVES, AllocationError, SchedulerCache)
+from tpushare.cache.index import max_box_size, summarize
+from tpushare.cache.nodeinfo import request_from_pod
+from tpushare.core.chips import ChipView
+from tpushare.core.placement import PlacementRequest
+from tpushare.core.topology import MeshTopology
+from tpushare.extender.handlers import (
+    BindHandler, FilterHandler, PrioritizeHandler)
+from tpushare.extender.metrics import Registry
+from tpushare.obs.explain import ExplainStore
+from tpushare.k8s import FakeCluster
+
+HBM = 16000
+GIB = 1024
+
+
+def fleet(n_nodes=4, chips=4, mesh="2x2"):
+    fc = FakeCluster()
+    names = [f"n{i}" for i in range(n_nodes)]
+    for n in names:
+        fc.add_tpu_node(n, chips=chips, hbm_per_chip_mib=HBM, mesh=mesh)
+    return fc, names
+
+
+def seed_filler(fc, node, name, chip_ids, hbm):
+    """A bound pod with placement annotations, seeded on the fake
+    apiserver so build_cache replays it into every cache identically."""
+    pod = make_pod(hbm=hbm, name=name, node=node,
+                   ann=contract.placement_annotations(
+                       chip_ids, hbm, HBM))
+    return fc.create_pod(pod)
+
+
+# -- max_box_size: the geometric core -----------------------------------------
+
+def brute_max_box(topo, elig):
+    for size in range(topo.num_chips, 0, -1):
+        for box in topo.box_shapes(size):
+            for origin in topo.box_positions(box):
+                if all(i in elig for i in topo.box_chips(origin, box)):
+                    return size
+    return 0
+
+
+@pytest.mark.parametrize("shape", [(7,), (4, 4), (2, 4), (3, 5),
+                                   (2, 2, 3)])
+def test_max_box_size_matches_enumeration(shape):
+    """Closed-form (run-length / max-rectangle) == brute-force box
+    enumeration over random eligibility masks, every rank."""
+    topo = MeshTopology(shape)
+    rng = random.Random(hash(shape) & 0xffff)
+    for trial in range(60):
+        k = rng.randrange(topo.num_chips + 1)
+        elig = frozenset(rng.sample(range(topo.num_chips), k))
+        assert max_box_size(topo, elig) == brute_max_box(topo, elig), \
+            f"shape {shape} eligible {sorted(elig)}"
+
+
+# -- the property test: incremental index == from-scratch rebuild -------------
+
+def test_index_agrees_with_rebuild_under_churn():
+    """Randomized allocate/release/sync/health churn; after EVERY
+    mutation batch the flushed index must agree with a from-scratch
+    rebuild of each node's summary AND its bucket memberships
+    (CapacityIndex.audit compares both)."""
+    fc, names = fleet(n_nodes=3, chips=4, mesh="2x2")
+    fc.add_tpu_node("n8", chips=8, hbm_per_chip_mib=HBM, mesh="2x4")
+    names = names + ["n8"]
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    rng = random.Random(7)
+    live: list[tuple[str, str]] = []  # (node, pod name)
+    for i in range(160):
+        node = rng.choice(names)
+        info = cache.get_node_info(node)
+        op = rng.randrange(5)
+        if op <= 1:  # allocate through the real bind path
+            pod = fc.create_pod(make_pod(
+                hbm=rng.choice([1000, 4000, 9000, 15000]),
+                name=f"churn-{i}"))
+            try:
+                info.allocate(pod, fc)
+                live.append((node, f"churn-{i}"))
+            except AllocationError:
+                fc.delete_pod("default", f"churn-{i}")
+        elif op == 2 and live:  # terminate
+            node, pname = live.pop(rng.randrange(len(live)))
+            bound = fc.get_pod("default", pname)
+            cache.get_node_info(node).remove_pod(bound)
+            fc.delete_pod("default", pname)
+        elif op == 3 and live:  # controller sync (remove+re-add)
+            node, pname = rng.choice(live)
+            bound = fc.get_pod("default", pname)
+            cache.get_node_info(node).sync_pod(bound)
+        else:  # health flips
+            bad = set(rng.sample(range(info.chip_count),
+                                 rng.randrange(info.chip_count + 1)))
+            info.set_unhealthy(bad)
+        if i % 7 == 0:
+            cache._index.flush()
+            problems = cache._index.audit()
+            assert not problems, f"after op {i}: {problems[:3]}"
+    cache._index.flush()
+    assert not cache._index.audit()
+
+
+def test_bucket_union_matches_per_name_verdicts():
+    """candidates() (the bucket-union query) and prune_verdict (the
+    per-name check) are the same predicate."""
+    fc, names = fleet(n_nodes=12)
+    for i, n in enumerate(names):
+        if i % 3 == 0:
+            seed_filler(fc, n, f"f{i}", [0, 1, 2, 3], 15000)
+        elif i % 3 == 1:
+            seed_filler(fc, n, f"f{i}", [0, 1], 8000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    cache._index.flush()
+    for req in (PlacementRequest(hbm_mib=2 * GIB),
+                PlacementRequest(hbm_mib=12000),
+                PlacementRequest(hbm_mib=4000, chip_count=4),
+                PlacementRequest(hbm_mib=0, chip_count=2),
+                PlacementRequest(hbm_mib=9000, chip_count=2,
+                                 allow_scatter=True)):
+        by_name = {n for n in names
+                   if cache._index.prune_verdict(n, req) is None}
+        assert cache._index.candidates(req) == by_name, req
+
+
+# -- pruning correctness: byte-identical to the full scan ---------------------
+
+REQS = [
+    PlacementRequest(hbm_mib=1 * GIB),
+    PlacementRequest(hbm_mib=12000),             # sparse: most pruned
+    PlacementRequest(hbm_mib=2000, chip_count=4),
+    PlacementRequest(hbm_mib=2000, chip_count=4, topology=(2, 2)),
+    PlacementRequest(hbm_mib=0, chip_count=1),   # exclusive
+    PlacementRequest(hbm_mib=6000, chip_count=2, allow_scatter=True),
+]
+
+
+def _mixed_fleet():
+    fc, names = fleet(n_nodes=24)
+    for i, n in enumerate(names):
+        if i % 4 == 0:
+            seed_filler(fc, n, f"full-{i}", [0, 1, 2, 3], 15500)
+        elif i % 4 == 1:
+            seed_filler(fc, n, f"half-{i}", [0, 2], 10000)
+        elif i % 4 == 2:
+            seed_filler(fc, n, f"dust-{i}", [0, 1, 2, 3], 2000)
+    return fc, names
+
+
+def test_indexed_verdicts_byte_identical_to_full_scan():
+    fc, names = _mixed_fleet()
+    indexed = SchedulerCache(fc, index=True, eqclass=False)
+    full = SchedulerCache(fc, index=False, eqclass=False)
+    indexed.build_cache()
+    full.build_cache()
+    # a few unhealthy chips, mirrored into both caches
+    for c in (indexed, full):
+        c.get_node_info(names[5]).set_unhealthy({0, 1})
+        c.get_node_info(names[7]).set_unhealthy({0, 1, 2, 3})
+    pruned0 = INDEX_PRUNED.value
+    for j, req in enumerate(REQS):
+        pod_i = fc.create_pod(make_pod(hbm=1, name=f"pi{j}"))
+        pod_f = fc.create_pod(make_pod(hbm=1, name=f"pf{j}"))
+        got = indexed.score_nodes(pod_i, req, names)
+        want = full.score_nodes(pod_f, req, names)
+        assert got == want, f"req {req} diverged"
+    assert INDEX_PRUNED.value > pruned0, \
+        "the sparse requests never engaged the index"
+
+
+def test_index_verify_mode_counts_zero_stale_prunes():
+    fc, names = _mixed_fleet()
+    cache = SchedulerCache(fc, verify_index=True, eqclass=False)
+    cache.build_cache()
+    stale0 = INDEX_STALE_SERVES.value
+    pruned0 = INDEX_PRUNED.value
+    for round_ in range(3):
+        for j, req in enumerate(REQS):
+            pod = fc.create_pod(make_pod(hbm=1, name=f"v{round_}-{j}"))
+            cache.score_nodes(pod, req, names)
+        # churn between rounds so summaries must re-derive
+        churn = fc.create_pod(make_pod(hbm=3000, name=f"vc{round_}"))
+        try:
+            cache.get_node_info(names[round_]).allocate(churn, fc)
+        except AllocationError:
+            pass
+    assert INDEX_PRUNED.value > pruned0
+    assert INDEX_STALE_SERVES.value == stale0, \
+        "the index pruned a node the full scan could place"
+
+
+# -- equivalence classes ------------------------------------------------------
+
+def test_eqclass_replica_storm_shares_one_scan():
+    """50 identical pods filtering concurrently: at most ~2 fleet
+    scans' worth of per-node computes (racing first scans), everything
+    else joined from the signature class."""
+    fc, names = fleet(n_nodes=16)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    registry = Registry()
+    flt = FilterHandler(cache, registry)
+    computed0 = MEMO_NODE_SCORES.get("computed")
+    joined0 = EQCLASS_SHARES.get("joined")
+    pods = [fc.create_pod(make_pod(hbm=2 * GIB, name=f"r{i}"))
+            for i in range(50)]
+    errs: list[str] = []
+
+    def run(chunk):
+        try:
+            for pod in chunk:
+                out = flt.handle({"Pod": pod, "NodeNames": names})
+                assert len(out["NodeNames"]) == 16
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=run, args=(pods[:25],)),
+               threading.Thread(target=run, args=(pods[25:],))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errs, errs
+    computed = MEMO_NODE_SCORES.get("computed") - computed0
+    joined = EQCLASS_SHARES.get("joined") - joined0
+    # 50 pods x 16 nodes = 800 verdicts; two racing threads may both
+    # pay the first fleet scan, everything after joins
+    assert computed <= 2 * len(names), \
+        f"storm paid {computed} per-node computes (> 2 fleet scans)"
+    assert computed + joined == 50 * len(names)
+
+
+def test_eqclass_storm_binds_with_zero_stale_placements(monkeypatch):
+    """The 50-identical-pod storm bound end to end under BOTH verify
+    oracles, then audited against the fake-apiserver truth: no chip
+    oversubscribed, zero stale memo serves, zero stale prunes (the
+    chaos-soak audit, eqclass + index engaged)."""
+    monkeypatch.setenv("TPUSHARE_MEMO_VERIFY", "1")
+    monkeypatch.setenv("TPUSHARE_INDEX_VERIFY", "1")
+    fc, names = fleet(n_nodes=8)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    registry = Registry()
+    flt = FilterHandler(cache, registry)
+    prio = PrioritizeHandler(cache, registry)
+    bind = BindHandler(cache, fc, registry)
+    stale0 = MEMO_STALE_SERVES.value
+    istale0 = INDEX_STALE_SERVES.value
+    bound = 0
+    for i in range(50):
+        pod = fc.create_pod(make_pod(hbm=1500, name=f"s{i}"))
+        ok = flt.handle({"Pod": pod, "NodeNames": names})["NodeNames"]
+        assert ok, f"pod {i} found no node"
+        ranked = prio.handle({"Pod": pod, "NodeNames": ok})
+        best = max(r["Score"] for r in ranked)
+        node = next(r["Host"] for r in ranked if r["Score"] == best)
+        out = bind.handle({"PodName": f"s{i}", "PodNamespace": "default",
+                           "PodUID": pod["metadata"]["uid"],
+                           "Node": node})
+        assert not out.get("Error"), out
+        bound += 1
+    # apiserver-truth audit (the chaos-soak invariant): per-(node,
+    # chip) allocation summed from live pods' annotations
+    per: dict[tuple[str, int], int] = {}
+    for pod in fc.list_pods():
+        if contract.is_complete_pod(pod):
+            continue
+        node = pod["spec"].get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        if not node or ids is None:
+            continue
+        h = contract.hbm_from_annotations(pod)
+        for c in ids:
+            per[(node, c)] = per.get((node, c), 0) + h
+    over = {k: v for k, v in per.items() if v > HBM}
+    assert bound == 50 and not over, f"oversubscribed: {over}"
+    assert MEMO_STALE_SERVES.value == stale0
+    assert INDEX_STALE_SERVES.value == istale0
+
+
+# -- the resident fleet arena -------------------------------------------------
+
+def _entry(key, stamp, used, topo, total=HBM, healthy=None, idxs=None):
+    n = topo.num_chips if idxs is None else len(idxs)
+    idxs = list(range(n)) if idxs is None else idxs
+    chips = [ChipView(idx=idxs[j], coords=topo.coords(idxs[j])
+                      if idxs[j] < topo.num_chips else (0,) * len(topo.shape),
+                      total_hbm_mib=total, used_hbm_mib=used[j],
+                      healthy=True if healthy is None else healthy[j])
+             for j in range(n)]
+    return (key, stamp, chips, topo)
+
+
+def test_arena_parity_delta_and_subsets(native_engine):
+    assert native_engine.available()
+    topo = MeshTopology((2, 2))
+    req = PlacementRequest(hbm_mib=2048, chip_count=2)
+    entries = [_entry(f"a{i}", (1, 0), [(i * 997 + j * 311) % HBM
+                                        for j in range(4)], topo)
+               for i in range(20)]
+    arena = native_engine.FleetArena()
+    raw = [(c, t) for _k, _s, c, t in entries]
+    assert arena.score(entries, req) == native_engine.score_fleet(raw, req)
+    d = arena.describe()
+    assert d["appends"] == 20 and d["slot_updates"] == 0
+    # quiescent rescore: nothing repacks
+    assert arena.score(entries, req) == native_engine.score_fleet(raw, req)
+    assert arena.describe()["slot_updates"] == 0
+    # one dirty slot -> exactly one in-place update, scores track it
+    entries[3] = _entry("a3", (1, 1), [15000] * 4, topo)
+    raw[3] = (entries[3][2], topo)
+    assert arena.score(entries, req) == native_engine.score_fleet(raw, req)
+    assert arena.describe()["slot_updates"] == 1
+    # scattered subset scan (runs of non-consecutive slots)
+    sub = [entries[i] for i in (1, 5, 6, 11, 19)]
+    assert arena.score(sub, req) == native_engine.score_fleet(
+        [(c, t) for _k, _s, c, t in sub], req)
+    assert arena.describe()["slot_updates"] == 1  # subset cost no packs
+    # structural change (chip count / mesh) retires + re-appends
+    big = MeshTopology((2, 4))
+    entries[5] = _entry("a5", (2, 0), [0] * 8, big)
+    raw[5] = (entries[5][2], big)
+    assert arena.score(entries, req) == native_engine.score_fleet(raw, req)
+    d = arena.describe()
+    assert d["appends"] == 21 and d["garbage_chips"] >= 4
+
+
+def test_arena_nondense_and_exclusive_fallbacks(native_engine):
+    topo = MeshTopology((2, 2))
+    arena = native_engine.FleetArena()
+    gappy = _entry("g", (1, 0), [0, 0, 0], topo, idxs=[0, 1, 3])
+    dense = _entry("d", (1, 0), [0, 5000, 0, 0], topo)
+    sick = _entry("s", (1, 0), [0, 0, 0, 0], topo,
+                  healthy=[False, True, True, True])
+    for req in (PlacementRequest(hbm_mib=4096),
+                PlacementRequest(hbm_mib=0, chip_count=1),  # exclusive
+                PlacementRequest(hbm_mib=1000, chip_count=4,
+                                 topology=(2, 2))):
+        got = arena.score([gappy, dense, sick], req)
+        want = native_engine.score_fleet(
+            [(e[2], e[3]) for e in (gappy, dense, sick)], req)
+        assert got == want, req
+
+
+def test_arena_compacts_after_mass_retirement(native_engine):
+    big = MeshTopology((2, 4))
+    req = PlacementRequest(hbm_mib=1024)
+    arena = native_engine.FleetArena()
+    entries = [_entry(f"c{i}", (1, 0), [0] * 8, big)
+               for i in range(16)]
+    arena.score(entries, req)
+    # structurally shrink most of the fleet (device-plugin restarts with
+    # fewer chips): retired rows exceed the garbage threshold -> compact
+    small = MeshTopology((2, 2))
+    entries = [_entry(f"c{i}", (2, 0), [0] * 4, small) if i < 12
+               else entries[i] for i in range(16)]
+    got = arena.score(entries, req)
+    want = native_engine.score_fleet([(c, t) for _k, _s, c, t in entries],
+                                     req)
+    assert got == want
+    d = arena.describe()
+    assert d["repacks"] >= 1
+    assert d["garbage_chips"] == 0
+
+
+# -- the audit stays truthful -------------------------------------------------
+
+def test_explain_records_index_pruned_nodes():
+    from tpushare.cache.nodeinfo import no_fit_reason
+
+    fc, names = fleet(n_nodes=4)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    explain = ExplainStore()
+    flt = FilterHandler(cache, Registry(), explain=explain)
+    pod = fc.create_pod(make_pod(hbm=20000, name="huge"))
+    req = request_from_pod(pod)
+    out = flt.handle({"Pod": pod, "NodeNames": names})
+    # the WIRE reply is byte-identical to a full scan's
+    assert out["NodeNames"] == []
+    assert out["FailedNodes"] == {n: no_fit_reason(req, n) for n in names}
+    # the AUDIT says what actually happened: never visited, and why
+    rec = explain.get(pod["metadata"]["uid"])
+    nodes = rec["cycles"][-1]["filter"]["nodes"]
+    for n in names:
+        assert nodes[n]["verdict"] == "skipped"
+        assert nodes[n]["reason"] == "index-pruned"
+        assert "eligible_chips" in nodes[n]["bucket"]
+        assert nodes[n]["source"] == "index"
+
+
+def test_no_index_knob_disables_pruning():
+    fc, names = fleet(n_nodes=4)
+    cache = SchedulerCache(fc, index=False)
+    cache.build_cache()
+    pruned0 = INDEX_PRUNED.value
+    pod = fc.create_pod(make_pod(hbm=20000, name="huge2"))
+    scores, errors = cache.score_nodes(pod, request_from_pod(pod), names)
+    assert scores == {n: None for n in names} and not errors
+    assert INDEX_PRUNED.value == pruned0
+
+
+def test_summarize_nontpu_node_is_never_bucketed():
+    """Zero-chip nodes keep their structural-error verdict: the index
+    must not fold them into the no-fit bucket (the wire reason would
+    silently change from 'not a TPU-share node' to 'no fit')."""
+    topo = MeshTopology((1,))
+    s = summarize((1, 0), [], topo, 0)
+    assert s.non_tpu
+    fc, names = fleet(n_nodes=1)
+    fc.add_tpu_node("plain", chips=0, hbm_per_chip_mib=0)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    pod = fc.create_pod(make_pod(hbm=2000, name="q"))
+    scores, errors = cache.score_nodes(pod, request_from_pod(pod),
+                                       names + ["plain"])
+    assert errors.get("plain") == "not a TPU-share node"
+    assert scores.get(names[0]) is not None

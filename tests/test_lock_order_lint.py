@@ -1,0 +1,138 @@
+"""Lock-order lint (CI satellite of the sublinear-filtering PR).
+
+The cache's concurrency story depends on one documented rule — lock
+order **gang -> stripe -> node -> memo -> index**, with `_pods_lock` a
+terminal leaf — enforced by review only until now. This is a simple AST
+pass over ``tpushare/cache/`` and ``tpushare/core/native/`` that finds
+every syntactically NESTED lock acquisition (``with <lock>:`` inside
+``with <lock>:`` in the same function) and asserts the ranks strictly
+increase, so a new lock (like the capacity index's) cannot silently
+introduce an inversion.
+
+Deliberately simple: cross-function acquisition chains (method A holds
+a lock and calls method B which takes another) are invisible to this
+pass — those are covered by the storm/soak deadlock watchdogs. What
+this red-lines is the cheap-to-catch case: a directly nested ``with``
+in the wrong order, and any NEW lock-like attribute that nobody added
+to the rank table (unknown locks fail the lint until classified).
+"""
+
+import ast
+import os
+import re
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+SCOPES = (
+    os.path.join(ROOT, "tpushare", "cache"),
+    os.path.join(ROOT, "tpushare", "core", "native"),
+)
+
+# (file basename, with-expression prefix) -> rank. Nested acquisitions
+# must strictly increase in rank. Leaf locks get high ranks so nothing
+# may be acquired inside them. Locks in unrelated domains (the native
+# engine's loader/pool/arena locks) never legally nest with the cache
+# chain OR each other, which distinct ranks + "no nesting exists"
+# encode for free.
+RANKS = {
+    ("gang.py", "self._lock"): 5,           # gang coordinator (leftmost)
+    ("cache.py", "self._stripes.for_key"): 10,   # node-map stripes
+    ("index.py", "self._flush_lock"): 15,   # whole-flush serialization
+    ("nodeinfo.py", "self._lock"): 20,      # per-node chip state
+    ("cache.py", "self._memo_lock"): 30,    # placement + eqclass memos
+    ("index.py", "self._lock"): 40,         # capacity index (rightmost)
+    ("cache.py", "self._pods_lock"): 90,    # known-pods leaf
+    ("engine.py", "_lock"): 60,             # native loader
+    ("engine.py", "_pool_lock"): 61,        # scan pool
+    ("engine.py", "self._lock"): 62,        # FleetArena
+}
+
+_LOCKISH = re.compile(r"(?:^|[._])(?:[a-z_]*lock[a-z_]*)(?:$|\()|for_key\(")
+
+
+def _with_expr_key(node: ast.expr) -> str:
+    """Normalized prefix of a with-item expression: attribute/name
+    chain, with call arguments stripped ('self._stripes.for_key')."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _walk(path, fname, body, stack, problems):
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def's body runs later, NOT under the outer lock
+            _walk(path, fname, node.body, [], problems)
+            continue
+        if isinstance(node, ast.With):
+            inner = list(stack)
+            for item in node.items:
+                keystr = _with_expr_key(item.context_expr)
+                src = ast.unparse(item.context_expr)
+                if not _LOCKISH.search(src):
+                    continue  # TRACER.span(...) etc: not a lock
+                rank = RANKS.get((fname, keystr))
+                assert rank is not None, (
+                    f"{path}:{node.lineno}: unclassified lock "
+                    f"acquisition 'with {src}:' — add ({fname!r}, "
+                    f"{keystr!r}) to RANKS in the documented order "
+                    f"(gang -> stripe -> node -> memo -> index)")
+                if inner and rank <= inner[-1][0]:
+                    problems.append(
+                        f"{path}:{node.lineno}: 'with {src}:' "
+                        f"(rank {rank}) acquired while holding "
+                        f"{inner[-1][1]} (rank {inner[-1][0]}) — "
+                        f"violates gang -> stripe -> node -> memo -> "
+                        f"index")
+                inner = inner + [(rank, keystr)]
+            _walk(path, fname, node.body, inner, problems)
+            continue
+        for child_body in (getattr(node, "body", None),
+                           getattr(node, "orelse", None),
+                           getattr(node, "finalbody", None)):
+            if isinstance(child_body, list):
+                _walk(path, fname, child_body, stack, problems)
+        for handler in getattr(node, "handlers", []) or []:
+            _walk(path, fname, handler.body, stack, problems)
+
+
+def _lint_tree() -> tuple[list[str], int]:
+    problems: list[str] = []
+    seen_locks = 0
+    for scope in SCOPES:
+        for fn in sorted(os.listdir(scope)):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(scope, fn)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            _walk(path, fn, tree.body, [], problems)
+            src = open(path).read()
+            seen_locks += len(re.findall(r"with (?:self\.)?_\w*lock", src))
+    return problems, seen_locks
+
+
+def test_lock_acquisitions_follow_documented_order():
+    problems, seen = _lint_tree()
+    assert seen >= 10, "the lint saw almost no lock acquisitions — " \
+        "the scan or the regex rotted"
+    assert not problems, "lock-order violations:\n" + "\n".join(problems)
+
+
+def test_lint_actually_detects_an_inversion():
+    """The lint must be falsifiable: a synthetic memo-inside-node →
+    node nesting in cache.py terms must red-line."""
+    bad = (
+        "def f(self):\n"
+        "    with self._memo_lock:\n"
+        "        with self._stripes.for_key('x'):\n"
+        "            pass\n")
+    problems: list[str] = []
+    _walk("synthetic.py", "cache.py", ast.parse(bad).body, [], problems)
+    assert problems and "violates" in problems[0]

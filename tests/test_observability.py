@@ -152,8 +152,12 @@ def test_explain_reports_every_candidate(rig):
     assert nodes["n1"]["verdict"] == "ok"
     assert isinstance(nodes["n1"]["score"], int)
     assert nodes["n1"]["source"] in ("memo", "computed")
-    assert nodes["n2"]["verdict"] == "rejected"
-    assert "no fit" in nodes["n2"]["reason"]
+    # n2 (8000 MiB chips) can provably never host 10000 MiB: the
+    # capacity index rejects it WITHOUT a visit, and the audit says so
+    # truthfully — verdict skipped, with the excluding bucket recorded
+    assert nodes["n2"]["verdict"] == "skipped"
+    assert nodes["n2"]["reason"] == "index-pruned"
+    assert "eligible_chips" in nodes["n2"]["bucket"]
     assert cycle["prioritize"]["best"] == "n1"
     assert cycle["bind"]["node"] == "n1"
     assert cycle["bind"]["outcome"] == "bound"
@@ -175,9 +179,11 @@ def test_explain_reports_every_candidate(rig):
 
 
 def test_explain_memo_provenance_on_second_cycle(rig):
-    """Prioritize reuses Filter's scan via the memo; a second pod's
-    filter right after a bind shows the delta-invalidation story in the
-    explain source fields (touched node recomputed, others reused)."""
+    """Prioritize reuses Filter's scan via the memo; a second identical
+    pod right after a bind shows the delta-invalidation AND
+    equivalence-class story in the explain source fields: the bound
+    node's stamp moved (recomputed), every untouched node is joined
+    from the first pod's scan of the same request signature."""
     fc, cache, server, base = rig
     run_cycle(fc, base, name="p1", hbm=1000, node="n1")
     pod2 = fc.create_pod(make_pod(hbm=1000, name="p2"))
@@ -185,9 +191,12 @@ def test_explain_memo_provenance_on_second_cycle(rig):
          {"Pod": pod2, "NodeNames": ["n1", "n2"]})
     status, out = get(f"{base}/inspect/explain/default/p2")
     nodes = out["cycles"][-1]["filter"]["nodes"]
-    # a fresh pod key means a fresh memo entry: everything computed
-    assert all(v["source"] == "computed" for v in nodes.values())
-    # same pod filtered again with nothing mutated: all served from memo
+    # p1's bind mutated n1, so its class verdict is stale: recomputed.
+    # n2 is untouched: p2 joins p1's scan instead of re-scanning.
+    assert nodes["n1"]["source"] == "computed"
+    assert nodes["n2"]["source"] == "eqclass"
+    # same pod filtered again with nothing mutated: all served from
+    # the pod's OWN memo (eqclass only fills pod-memo misses)
     post(f"{base}/tpushare-scheduler/filter",
          {"Pod": pod2, "NodeNames": ["n1", "n2"]})
     status, out = get(f"{base}/inspect/explain/default/p2")

@@ -15,12 +15,18 @@ from tpushare.cache.nodeinfo import (
     AllocationError, AlreadyBoundError, BindInFlightError,
     ClaimConflictError, NodeInfo)
 from tpushare.cache.cache import (
-    MEMO_DELTA_INVALIDATIONS, MEMO_NODE_SCORES, MEMO_REQUESTS,
-    MEMO_STALE_SERVES, SchedulerCache, memo_hit_rate,
+    EQCLASS_SHARES, MEMO_DELTA_INVALIDATIONS, MEMO_NODE_SCORES,
+    MEMO_REQUESTS, MEMO_STALE_SERVES, SchedulerCache, memo_hit_rate,
     memo_node_reuse_rate)
+from tpushare.cache.index import (
+    CapacityIndex, INDEX_CANDIDATE_RATIO, INDEX_PRUNED,
+    INDEX_STALE_SERVES)
 
 __all__ = ["ChipUsage", "NodeInfo", "AllocationError", "AlreadyBoundError",
            "BindInFlightError", "ClaimConflictError",
-           "SchedulerCache", "MEMO_REQUESTS", "MEMO_NODE_SCORES",
+           "SchedulerCache", "CapacityIndex",
+           "MEMO_REQUESTS", "MEMO_NODE_SCORES",
            "MEMO_DELTA_INVALIDATIONS", "MEMO_STALE_SERVES",
+           "EQCLASS_SHARES", "INDEX_PRUNED", "INDEX_CANDIDATE_RATIO",
+           "INDEX_STALE_SERVES",
            "memo_hit_rate", "memo_node_reuse_rate"]

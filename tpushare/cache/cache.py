@@ -7,7 +7,9 @@ exact chip assignments from the apiserver (cache.go:49-74 — the
 annotations are the durable write-ahead state, SURVEY §5.3b/§5.4).
 
 Concurrency model (the fleet-scale redesign — lock ORDER is stripe ->
-node -> memo, and nothing ever acquires leftward while holding rightward):
+node -> memo -> index, and nothing ever acquires leftward while holding
+rightward; the lock-order lint in tests/test_lock_order_lint.py holds
+this mechanically):
 
 - **Striped node map.** The node map is guarded by a small array of
   stripe locks (hash(node name) -> stripe) taken only to insert/remove a
@@ -27,6 +29,23 @@ node -> memo, and nothing ever acquires leftward while holding rightward):
   stamps can never match again — ghosts invalidate themselves.
 - **Known-pods map** has its own leaf lock (never held across calls
   into stripe/node/memo locks).
+- **Free-capacity index** (cache/index.py): every NodeInfo mutation
+  marks its node dirty in a bucket index of per-tier capability
+  summaries; Filter's scan consults it and SKIPS nodes that certainly
+  cannot fit the request (``tpushare_index_pruned_nodes_total``), so
+  the expensive part of a sparse-fit fleet scan touches candidates
+  only. ``TPUSHARE_INDEX_VERIFY=1`` full-scans every pruned node in
+  parallel and counts divergences (``tpushare_index_stale_serves_total``,
+  must stay 0); ``TPUSHARE_NO_INDEX=1`` disables pruning.
+- **Request equivalence classes**: scan results are ALSO published to a
+  per-request-signature memo, so identical pods (replica sets, gang
+  members) share one fleet scan per generation window — a 100-replica
+  storm costs ~1 scan + 99 joins
+  (``tpushare_eqclass_scan_shares_total{outcome}``);
+  ``TPUSHARE_NO_EQCLASS=1`` disables sharing.
+- **Resident fleet arena** (core/native/engine.py FleetArena): the scan
+  input is a persistent packed buffer delta-updated per node stamp, not
+  a per-call marshalling pass.
 
 Two read-path properties carried over from the informer work:
 
@@ -47,6 +66,9 @@ from collections import OrderedDict
 from typing import Any
 
 from tpushare import contract
+from tpushare.cache.index import (
+    CapacityIndex, INDEX_CANDIDATE_RATIO, INDEX_PRUNED,
+    INDEX_STALE_SERVES)
 from tpushare.cache.nodeinfo import NodeInfo, request_from_pod
 from tpushare.contract import node as nodelib
 from tpushare.contract import pod as podlib
@@ -88,6 +110,16 @@ MEMO_STALE_SERVES = Counter(
     "served under a matching stamp disagreed with a fresh recompute of "
     "the same node state. MUST stay 0 — nonzero means the stamp "
     "protocol has a hole")
+# request-signature equivalence classes: joined = a node verdict served
+# from another pod's scan of the same request shape, computed = a node
+# verdict scanned (or index-pruned) here and published to the class
+EQCLASS_SHARES = LabeledCounter(
+    "tpushare_eqclass_scan_shares_total",
+    "Per-node fleet-scan sharing across pods with the same request "
+    "signature: joined = served from the signature class's memo, "
+    "computed = produced here and published to the class (a replica "
+    "storm should be ~1 computed fleet + N-1 joined fleets)",
+    ("outcome",))
 
 
 def memo_hit_rate() -> float | None:
@@ -147,12 +179,19 @@ class SchedulerCache:
     # memo entries are per PENDING pod; the cap only matters if
     # thousands of pods filter without ever binding (LRU beyond it)
     MEMO_CAP = 4096
+    # signature-class entries are per DISTINCT request shape; each holds
+    # up to fleet-size stamped verdicts, so the cap bounds memory at
+    # SIG_MEMO_CAP x nodes entries (a replica storm uses exactly one)
+    SIG_MEMO_CAP = 128
     LOCK_STRIPES = 16
 
-    def __init__(self, cluster, node_lister=None) -> None:
+    def __init__(self, cluster, node_lister=None, *,
+                 index: bool | None = None,
+                 eqclass: bool | None = None,
+                 verify_index: bool | None = None) -> None:
         self._cluster = cluster
-        # lock order: stripe -> node (NodeInfo._lock) -> memo. The
-        # stripes guard node-map structure only; _pods_lock is a leaf.
+        # lock order: stripe -> node (NodeInfo._lock) -> memo -> index.
+        # The stripes guard node-map structure only; _pods_lock is a leaf.
         self._stripes = _LockStripes(self.LOCK_STRIPES)
         self._nodes: dict[str, NodeInfo] = {}
         self._pods_lock = threading.Lock()
@@ -164,15 +203,41 @@ class SchedulerCache:
         # placement memo: LRU of per-pod entries, scores stamped with
         # per-node generations (see module docstring)
         self._memo: OrderedDict[str, _MemoEntry] = OrderedDict()
+        # request-signature equivalence classes: LRU of per-signature
+        # entries sharing one fleet scan across identical pods
+        self._sig_memo: OrderedDict[tuple, _MemoEntry] = OrderedDict()
         self._memo_lock = threading.Lock()
-        # paranoia mode for the bench/property tests: every memo-served
+        # free-capacity index (cache/index.py): push-maintained via the
+        # NodeInfo mutation hook wired in _adopt_node_info
+        self._index = CapacityIndex(self._nodes.get)
+        self._index_enabled = (not os.environ.get("TPUSHARE_NO_INDEX")) \
+            if index is None else bool(index)
+        self._eqclass = (not os.environ.get("TPUSHARE_NO_EQCLASS")) \
+            if eqclass is None else bool(eqclass)
+        # resident packed fleet for the native scan, built lazily on the
+        # first compute (engine import is deferred off the ctor path)
+        self._arena = None
+        # paranoia modes for the bench/property tests: every memo-served
         # score is recomputed from the node's current stamped snapshot
-        # and a mismatch under a matching stamp counts as a stale serve
+        # (a mismatch under a matching stamp = stale serve), and every
+        # index-pruned node is full-scanned (a placement = stale prune)
         self._verify_serves = bool(os.environ.get("TPUSHARE_MEMO_VERIFY"))
+        self._verify_index = bool(os.environ.get("TPUSHARE_INDEX_VERIFY")) \
+            if verify_index is None else bool(verify_index)
         # flipped by build_cache: /readyz refuses traffic until the
         # startup replay has reconstructed chip assignments (a bind
         # against an un-replayed cache could oversubscribe)
         self.built = False
+
+    def _adopt_node_info(self, info: NodeInfo) -> None:
+        """Wire a newly tracked NodeInfo into the capacity index: its
+        mutation hook marks the node dirty (a leaf set-add, legal under
+        the node lock), and the initial dirty mark gets the summary
+        built at the next flush."""
+        name = info.name
+        index = self._index
+        info._on_mutate = lambda: index.mark_dirty(name)
+        index.mark_dirty(name)
 
     # -- node access ----------------------------------------------------------
 
@@ -194,6 +259,7 @@ class SchedulerCache:
             info = self._nodes.get(node_name)
             if info is None:
                 info = NodeInfo(node)
+                self._adopt_node_info(info)
                 self._nodes[node_name] = info
                 log.debug("cache: created NodeInfo %s (%d chips x %d MiB)",
                           node_name, info.chip_count, info.hbm_per_chip)
@@ -229,7 +295,12 @@ class SchedulerCache:
         with self._stripes.for_key(node_name):
             self._nodes.pop(node_name, None)
         # no fleet-wide invalidation: a removed node has no live
-        # NodeInfo, so its memoized stamps can never validate again
+        # NodeInfo, so its memoized stamps can never validate again.
+        # The index summary and the arena slot ARE dropped eagerly —
+        # both are keyed by name and a re-faulted node must re-enter.
+        self._index.forget(node_name)
+        if self._arena is not None:
+            self._arena.forget(node_name)
 
     def node_names(self) -> list[str]:
         return list(self._nodes)  # GIL-atomic copy of the keys
@@ -249,11 +320,14 @@ class SchedulerCache:
         """Fleet scores for ``pod`` over ``node_names``, memoized per
         (pod, request signature) with per-node generation stamps.
 
-        ``provenance`` (optional out-param) is filled with
-        ``node -> "memo" | "computed"`` — which verdicts were served
-        under a still-valid stamp vs recomputed this call. The explain
-        audit (obs/explain.py) records it per decision, and the
-        cache.score_nodes trace span carries the aggregate counts.
+        ``provenance`` (optional out-param) is filled with ``node ->
+        "memo" | "eqclass" | "pruned:<bucket>" | "computed"`` — served
+        under a still-valid per-pod stamp, joined from another pod's
+        scan of the same request signature, rejected by the capacity
+        index (``<bucket>`` names the capability shortfall), or
+        actually scanned this call. The explain audit (obs/explain.py)
+        records it per decision, and the cache.score_nodes trace span
+        carries the aggregate counts.
 
         Returns ``(scores, errors)``: ``scores[name]`` is the native
         engine's best binpack score (lower = tighter; None = no
@@ -271,18 +345,34 @@ class SchedulerCache:
         would strand the pod. Structural errors ("not a TPU-share
         node") are stamped against the live NodeInfo like scores.
 
+        Sublinear path (the sparse-fit tentpole), applied to the nodes
+        the per-pod memo could not serve, in order:
+
+        1. **equivalence-class join** — another pod with the same
+           request signature already scanned the node at its current
+           stamp: copy the verdict (``source: eqclass``), no snapshot;
+        2. **capacity-index prune** — the bucket index proves the node
+           cannot fit the request: record ``None`` under the summary's
+           stamp (``source: pruned``), no snapshot, no scan;
+        3. **scan** — whatever survives is snapshotted and scored
+           through the resident fleet arena (delta-packed native scan).
+
         Tracing: a full memo hit is a dict read — it lands as one event
         on the caller's phase span. Only a scan that actually computes
-        (memo miss / stale nodes) opens a ``cache.score_nodes`` child
-        span, so the timeline shows real work, and the hit path stays
-        span-free (the bind-storm overhead budget is counted in spans).
+        (memo miss / stale nodes surviving join+prune) opens a
+        ``cache.score_nodes`` child span, so the timeline shows real
+        work, and the hit path stays span-free (the bind-storm overhead
+        budget is counted in spans).
         """
         from tpushare.core.native import engine as native_engine
 
         key = podlib.pod_cache_key(pod)
         sig = _req_sig(req)
         reused = 0
-        verify: list[tuple[str, int, int | None]] = []
+        verify: list[tuple[str, tuple[int, int], int | None]] = []
+        joined_scores: dict[str, int | None] = {}
+        joined_errors: dict[str, str] = {}
+        joined_stamps: dict[str, tuple[int, int]] = {}
         with self._memo_lock:
             entry = self._memo.get(key)
             if entry is not None and entry.req_sig != sig:
@@ -317,22 +407,84 @@ class SchedulerCache:
                         if n in entry.scores},
                        {n: entry.errors[n] for n in node_names
                         if n in entry.errors})
+            elif self._eqclass:
+                # equivalence-class join: a pod with the same request
+                # signature may have scanned these nodes already — a
+                # verdict under a still-valid stamp is THIS pod's
+                # verdict too (the score is a pure function of
+                # (node state, request signature))
+                sig_entry = self._sig_memo.get(sig)
+                if sig_entry is not None:
+                    self._sig_memo.move_to_end(sig)
+                    still: list[str] = []
+                    for n in missing:
+                        st = sig_entry.stamps.get(n)
+                        if st is not None \
+                                and st == self._node_version(n) \
+                                and (n in sig_entry.scores
+                                     or n in sig_entry.errors):
+                            if n in sig_entry.errors:
+                                joined_errors[n] = sig_entry.errors[n]
+                            else:
+                                joined_scores[n] = sig_entry.scores[n]
+                                if self._verify_serves:
+                                    verify.append(
+                                        (n, st, sig_entry.scores[n]))
+                            joined_stamps[n] = st
+                            if provenance is not None:
+                                provenance[n] = "eqclass"
+                        else:
+                            still.append(n)
+                    missing = still
         if full_hit:
             annotate_current("score_nodes", memo="hit",
                              nodes_reused=reused)
             # verification takes node locks; never do that while holding
-            # the memo lock (lock order is stripe -> node -> memo)
+            # the memo lock (lock order is stripe -> node -> memo -> index)
             self._verify_served(verify, req)
             return out
-        if provenance is not None:
-            for n in missing:
-                provenance[n] = "computed"
+        joined = len(joined_scores) + len(joined_errors)
+        if joined:
+            EQCLASS_SHARES.inc("joined", n=joined)
         MEMO_REQUESTS.inc("score", "miss")
-        with TRACER.span("cache.score_nodes", memo="miss",
-                         nodes_reused=reused,
-                         nodes_computed=len(missing)):
-            scores, fetch_errors, node_errors, stamps = \
-                self._compute_missing(missing, req, native_engine)
+        # capacity-index pruning: reject certain no-fits without a
+        # snapshot or scan (flush first so dirty nodes re-summarize;
+        # node locks are taken inside flush, never under the memo lock)
+        pruned: dict[str, tuple[tuple[int, int], str]] = {}
+        to_scan = missing
+        if missing and self._index_enabled:
+            self._index.flush()
+            to_scan, pruned = self._index.partition(missing, req)
+            if pruned:
+                INDEX_PRUNED.inc(len(pruned))
+                if provenance is not None:
+                    for n, (_st, bucket) in pruned.items():
+                        provenance[n] = "pruned:" + bucket
+            INDEX_CANDIDATE_RATIO.observe(len(to_scan) / len(missing))
+        if provenance is not None:
+            for n in to_scan:
+                provenance[n] = "computed"
+        if to_scan:
+            with TRACER.span("cache.score_nodes", memo="miss",
+                             nodes_reused=reused,
+                             nodes_joined=joined,
+                             nodes_pruned=len(pruned),
+                             nodes_computed=len(to_scan)):
+                scores, fetch_errors, node_errors, stamps = \
+                    self._compute_missing(to_scan, req, native_engine)
+        else:
+            # join+prune covered everything: no snapshot was taken and
+            # no engine ran — one event on the phase span, like a hit
+            annotate_current("score_nodes", memo="shared",
+                             nodes_reused=reused, nodes_joined=joined,
+                             nodes_pruned=len(pruned))
+            scores, fetch_errors, node_errors, stamps = {}, {}, {}, {}
+        # pruned verdicts are NOT folded into the memos: re-deriving
+        # them is one O(1) summary read per node, while memoizing tens
+        # of thousands of None entries per pod costs more dict plumbing
+        # than it saves — the memo carries real scores, the index
+        # carries the no-fits. They still join the returned verdicts
+        # below, byte-identical to what a full scan would have said.
         with self._memo_lock:
             entry = self._memo.get(key)
             if entry is None or entry.req_sig != sig:
@@ -343,19 +495,43 @@ class SchedulerCache:
             else:
                 self._memo.move_to_end(key)
             entry.scores.update(scores)
+            entry.scores.update(joined_scores)
             entry.errors.update(node_errors)
+            entry.errors.update(joined_errors)
             entry.stamps.update(stamps)
+            entry.stamps.update(joined_stamps)
             if reused:
                 MEMO_NODE_SCORES.inc("reused", n=reused)
-            if missing:
-                MEMO_NODE_SCORES.inc("computed", n=len(missing))
+            if to_scan:
+                MEMO_NODE_SCORES.inc("computed", n=len(to_scan))
+            if self._eqclass and (scores or node_errors):
+                # publish this pod's freshly SCANNED verdicts to the
+                # signature class so the next identical pod joins
+                # instead of re-scanning (pruned no-fits stay in the
+                # index: replicas re-derive those in O(1) per node)
+                sig_entry = self._sig_memo.get(sig)
+                if sig_entry is None:
+                    while len(self._sig_memo) >= self.SIG_MEMO_CAP:
+                        self._sig_memo.popitem(last=False)
+                    sig_entry = _MemoEntry(sig)
+                    self._sig_memo[sig] = sig_entry
+                else:
+                    self._sig_memo.move_to_end(sig)
+                sig_entry.scores.update(scores)
+                sig_entry.errors.update(node_errors)
+                sig_entry.stamps.update(stamps)
+                EQCLASS_SHARES.inc(
+                    "computed", n=len(scores) + len(node_errors))
             out = ({n: entry.scores[n] for n in node_names
                     if n in entry.scores},
                    {n: entry.errors[n] for n in node_names
                     if n in entry.errors})
             for n, msg in fetch_errors.items():
                 out[1][n] = msg
+        if pruned:
+            out[0].update(dict.fromkeys(pruned, None))
         self._verify_served(verify, req)
+        self._verify_pruned(pruned, req)
         return out
 
     def _compute_missing(self, missing: list[str], req: PlacementRequest,
@@ -363,14 +539,16 @@ class SchedulerCache:
                              dict[str, int | None], dict[str, str],
                              dict[str, str], dict[str, tuple[int, int]]]:
         """The recompute half of :meth:`score_nodes`: snapshot every
-        stale/uncovered node and run the native fleet scan. Returns
-        (scores, fetch_errors, node_errors, stamps)."""
+        stale/uncovered node and score it through the resident fleet
+        arena (delta-packed: only stamp-moved slots re-marshal; see
+        engine.FleetArena). Returns (scores, fetch_errors, node_errors,
+        stamps)."""
         scores: dict[str, int | None] = {}
         fetch_errors: dict[str, str] = {}
         node_errors: dict[str, str] = {}
         stamps: dict[str, tuple[int, int]] = {}
         known: list[str] = []
-        snapshots = []
+        entries = []
         for name in missing:
             try:
                 info = self.get_node_info(name)
@@ -385,11 +563,41 @@ class SchedulerCache:
                 node_errors[name] = "not a TPU-share node"
                 continue
             known.append(name)
-            snapshots.append((snap, info.topology))
-        for name, score in zip(known,
-                               native_engine.score_fleet(snapshots, req)):
-            scores[name] = score
+            entries.append((name, stamp, snap, info.topology))
+        if entries:
+            if self._arena is None:
+                self._arena = native_engine.FleetArena()
+            for name, score in zip(known,
+                                   self._arena.score(entries, req)):
+                scores[name] = score
         return scores, fetch_errors, node_errors, stamps
+
+    def _verify_pruned(self, pruned: dict[str, tuple[tuple[int, int], str]],
+                       req: PlacementRequest) -> None:
+        """TPUSHARE_INDEX_VERIFY: full-scan every index-pruned node; if
+        the node has not moved past the summary's stamp, the scan must
+        agree there is no placement — one that places is a stale prune
+        (a wrongly rejected schedulable node) and increments
+        INDEX_STALE_SERVES."""
+        if not pruned or not self._verify_index:
+            return
+        from tpushare.core.native import engine as native_engine
+
+        for name, (stamp, bucket) in pruned.items():
+            info = self._nodes.get(name)
+            if info is None:
+                continue
+            now_stamp, snap = info.stamped_snapshot()
+            if now_stamp != stamp:
+                continue  # node moved after the verdict; a fresh scan
+                # would legitimately differ — not a staleness verdict
+            fresh = native_engine.score_fleet([(snap, info.topology)],
+                                              req)[0]
+            if fresh is not None:
+                INDEX_STALE_SERVES.inc()
+                log.error("capacity index pruned %s (%s) but the full "
+                          "scan placed it (score %s) at stamp %s",
+                          name, bucket, fresh, stamp)
 
     def _verify_served(self, served: list[tuple[str, int, int | None]],
                        req: PlacementRequest) -> None:
@@ -534,7 +742,9 @@ class SchedulerCache:
                 name = nodelib.node_name(node)
                 with self._stripes.for_key(name):
                     if name not in self._nodes:
-                        self._nodes[name] = NodeInfo(node)
+                        info = NodeInfo(node)
+                        self._adopt_node_info(info)
+                        self._nodes[name] = info
         replayed = 0
         for pod in (self._cluster.list_pods() if pods is None else pods):
             if not contract.is_tpushare_pod(pod):
@@ -549,6 +759,11 @@ class SchedulerCache:
             replayed += 1
         log.info("cache: replayed %d assigned pods onto %d nodes",
                  replayed, len(self._nodes))
+        # warm the capacity index off the hot path: the first Filter
+        # should classify against resident summaries, not pay the whole
+        # fleet's initial summary build
+        if self._index_enabled:
+            self._index.flush()
         self.built = True
         return replayed
 

@@ -1,0 +1,517 @@
+"""Free-capacity index: prune the fleet BEFORE the scan touches it.
+
+PR 3 made the per-node cost of a Filter pass small (memo + native scan);
+this index makes the *number of nodes paying that cost* small. Every
+node is summarized into per-tier capability counts — for each free-HBM
+tier ``t`` (plus a pseudo-tier for exclusive/whole-chip requests), how
+many healthy chips offer ``free >= t`` and how large the largest
+contiguous axis-aligned sub-box of such chips is — and bucketed by
+those capabilities. A request maps to the largest tier ``<= hbm_mib``;
+any node whose capability at that tier cannot host ``chip_count`` chips
+can be rejected WITHOUT a snapshot, marshalling, or a native scan.
+
+Conservative by construction: eligibility at tier ``t <= hbm`` is a
+superset of eligibility at ``hbm``, so a node the superset cannot host
+is a certain no-fit (a pruned node's verdict is exactly the full scan's
+``None``), while a kept node may still fail the real scan (a false
+positive only costs scan work, never correctness). Pinned topologies
+are handled the same way: ``contig_ge`` is the max box size over ANY
+shape, so "no box of this size at all" safely rejects every shape.
+
+Maintenance is push-based so a query never walks the fleet: NodeInfo's
+mutation counter bump (``_dirty``) invokes a callback that marks the
+node dirty here (a set add under this module's leaf lock), and the next
+query flushes only the dirty names. A quiescent 20k-node fleet flushes
+nothing and answers from the resident buckets.
+
+Lock order (extends the documented cache rule): stripe -> node -> memo
+-> index. ``mark_dirty`` is called while a node lock is held, so the
+index lock is acquired only to its right; ``flush`` takes node locks
+(stamped_snapshot) strictly OUTSIDE the index lock. Nothing here ever
+calls back into stripe/node/memo while holding the index lock.
+
+``TPUSHARE_INDEX_VERIFY=1`` (read by SchedulerCache) runs the full scan
+for every pruned node in parallel and counts verdict divergences in
+``tpushare_index_stale_serves_total`` — which must stay 0.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from collections import OrderedDict
+from typing import Any, Callable, Iterable
+
+from tpushare.core.chips import ChipView
+from tpushare.core.placement import PlacementRequest
+from tpushare.core.topology import MeshTopology
+from tpushare.metrics import Counter, Histogram
+
+# Free-HBM tiers in MiB. A request at ``hbm`` is checked against the
+# largest tier <= hbm (conservative: more chips are eligible at the
+# lower tier). The spacing is the workload ladder bench.py exercises
+# (0.5-32 GiB); requests above the top tier reuse it, still soundly.
+TIERS: tuple[int, ...] = (1, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+# pseudo-tier for exclusive (hbm == 0) requests: eligibility is
+# "completely free" (used == 0), not a free-HBM threshold
+EXCL_TIER = len(TIERS)
+
+# capability values are clipped into buckets; both sides of a query clip
+# the same way, so clipping only ever widens the candidate set
+MAX_CAP = 64
+
+INDEX_PRUNED = Counter(
+    "tpushare_index_pruned_nodes_total",
+    "Candidate nodes rejected by the free-capacity index without a "
+    "snapshot or native scan (the sublinear-Filter win; compare with "
+    "tpushare_memo_node_scores_total{outcome=computed})")
+INDEX_STALE_SERVES = Counter(
+    "tpushare_index_stale_serves_total",
+    "Self-check failures under TPUSHARE_INDEX_VERIFY: a node the index "
+    "pruned was found schedulable by the full scan at the same stamp. "
+    "MUST stay 0 — nonzero means the index summaries are not "
+    "conservative")
+INDEX_CANDIDATE_RATIO = Histogram(
+    "tpushare_index_candidate_ratio",
+    "Fraction of memo-missing nodes that survived index pruning and "
+    "were actually scanned (low = the index is doing its job on a "
+    "sparse-fit fleet)",
+    (0.01, 0.02, 0.05, 0.1, 0.2, 0.4, 0.6, 0.8, 0.95, 1.0))
+
+
+def tier_for(req: PlacementRequest) -> int:
+    """Tier index this request is classified at."""
+    if req.hbm_mib <= 0:
+        return EXCL_TIER
+    return bisect_right(TIERS, req.hbm_mib) - 1
+
+
+def tier_label(tier: int) -> str:
+    return "exclusive" if tier == EXCL_TIER else f">={TIERS[tier]}MiB"
+
+
+class _Summary:
+    """Per-node capability record at one generation stamp."""
+
+    __slots__ = ("stamp", "non_tpu", "n_ge", "contig_ge")
+
+    def __init__(self, stamp: tuple[int, int], non_tpu: bool,
+                 n_ge: tuple[int, ...], contig_ge: tuple[int, ...]) -> None:
+        self.stamp = stamp
+        self.non_tpu = non_tpu
+        self.n_ge = n_ge          # eligible chip count per tier
+        self.contig_ge = contig_ge  # max contiguous box size per tier
+
+
+def _max_rect_in_histogram(heights: list[int]) -> int:
+    """Largest rectangle area under a histogram (stack method)."""
+    best = 0
+    stack: list[int] = []  # indices with increasing heights
+    for i in range(len(heights) + 1):
+        h = heights[i] if i < len(heights) else 0
+        while stack and heights[stack[-1]] >= h:
+            top = stack.pop()
+            width = i - (stack[-1] + 1 if stack else 0)
+            area = heights[top] * width
+            if area > best:
+                best = area
+        stack.append(i)
+    return best
+
+
+def max_box_size(topo: MeshTopology, eligible: frozenset[int] | set[int]
+                 ) -> int:
+    """Size of the largest contiguous axis-aligned sub-box whose chips
+    are all in ``eligible``. Closed-form for rank 1/2 (run-length /
+    max-rectangle-in-histogram), shape enumeration for higher ranks."""
+    if not eligible:
+        return 0
+    shape = topo.shape
+    rank = len(shape)
+    if rank == 1:
+        best = run = 0
+        for i in range(shape[0]):
+            run = run + 1 if i in eligible else 0
+            if run > best:
+                best = run
+        return best
+    if rank == 2:
+        rows, cols = shape
+        heights = [0] * cols
+        best = 0
+        for r in range(rows):
+            base = r * cols  # row-major: index = r * cols + c
+            for c in range(cols):
+                heights[c] = heights[c] + 1 if base + c in eligible else 0
+            area = _max_rect_in_histogram(heights)
+            if area > best:
+                best = area
+        return best
+    # rank >= 3: enumerate box shapes, largest size first, early exit
+    best = 0
+    sizes = sorted({s for s in range(1, topo.num_chips + 1)},
+                   reverse=True)
+    for size in sizes:
+        if size <= best:
+            break
+        for box in topo.box_shapes(size):
+            found = False
+            for origin in topo.box_positions(box):
+                if all(i in eligible for i in topo.box_chips(origin, box)):
+                    found = True
+                    break
+            if found:
+                best = size
+                break
+    return best
+
+
+def summarize(stamp: tuple[int, int], snap: Iterable[ChipView],
+              topo: MeshTopology, chip_count: int) -> _Summary:
+    """Pure summary of one stamped snapshot (the from-scratch rebuild
+    the property test compares incremental maintenance against)."""
+    chips = list(snap)
+    if chip_count <= 0 or not chips:
+        empty = (0,) * (len(TIERS) + 1)
+        return _Summary(stamp, True, empty, empty)
+    if len(chips) != topo.num_chips:
+        # same partial-host repair the fit/select path applies
+        topo = MeshTopology((len(chips),))
+    n_ge = [0] * (len(TIERS) + 1)
+    contig_ge = [0] * (len(TIERS) + 1)
+    prev_set: frozenset[int] | None = None
+    prev_val = (0, 0)
+    for ti in range(len(TIERS) + 1):
+        if ti == EXCL_TIER:
+            elig = frozenset(c.idx for c in chips
+                             if c.healthy and c.used_hbm_mib == 0)
+        else:
+            t = TIERS[ti]
+            elig = frozenset(c.idx for c in chips
+                             if c.healthy and c.free_hbm_mib >= t)
+        if elig == prev_set:
+            n_ge[ti], contig_ge[ti] = prev_val  # tiers sharing an
+            # eligibility set share the (expensive) box computation
+        else:
+            prev_set = elig
+            prev_val = (len(elig), max_box_size(topo, elig))
+            n_ge[ti], contig_ge[ti] = prev_val
+    return _Summary(stamp, False, tuple(n_ge), tuple(contig_ge))
+
+
+class _PruneMap(dict):
+    """Per-request-shape map of certain no-fits: node name ->
+    (stamp, bucket). Kept incrementally current while resident in
+    ``CapacityIndex._prune_maps`` (every summary install/drop updates
+    it under the index lock); ``gen`` equals the index generation as of
+    its last update, so a map that was EVICTED (and therefore stopped
+    receiving updates) is detected by its stale gen and rebuilt rather
+    than served — a detached map would otherwise serve verdicts of
+    arbitrary age."""
+
+    __slots__ = ("key", "gen", "reasons")
+
+    def __init__(self, key: tuple[int, int, bool]) -> None:
+        super().__init__()
+        self.key = key
+        self.gen = -1
+        # (kind, have) -> interned bucket string (a 20k-node fleet
+        # shares a handful of shortfalls)
+        self.reasons: dict[tuple[str, int], str] = {}
+
+
+class CapacityIndex:
+    """Incrementally maintained bucket index over node capability
+    summaries. See the module docstring for semantics and lock order."""
+
+    # distinct request shapes whose prune maps stay resident (LRU-ish
+    # FIFO beyond it; an evicted shape just pays one rebuild pass)
+    PRUNE_MAP_CAP = 16
+
+    def __init__(self, resolver: Callable[[str], Any]) -> None:
+        # resolver: node name -> NodeInfo | None (the cache's lock-free
+        # dict read); called from flush() with NO index lock held
+        self._resolver = resolver
+        self._lock = threading.Lock()  # leaf: dirty set + summaries + buckets
+        # serializes whole-flush application: a caller returning from
+        # flush() is guaranteed every node dirty at entry has its
+        # summary INSTALLED (not merely claimed by a concurrent flusher
+        # that is still mid-application) — so a verdict served right
+        # after flush reflects every mutation that preceded the call.
+        # Order: flush_lock -> node (stamped_snapshot) -> index lock.
+        self._flush_lock = threading.Lock()
+        self._dirty: set[str] = set()
+        self._summaries: dict[str, _Summary] = {}
+        # bucket key: (kind, tier, clipped capability) -> node names.
+        # kind "contig" buckets by contig_ge (contiguous multi-chip
+        # requests), "count" by n_ge (single-chip and scatter requests).
+        self._buckets: dict[tuple[str, int, int], set[str]] = {}
+        # per-request-shape prune maps (see _PruneMap): partition()
+        # answers a 20k-name storm with one dict.get per name instead
+        # of re-deriving every node's verdict per call
+        self._prune_maps: OrderedDict[tuple, _PruneMap] = OrderedDict()
+        self._gen = 0  # bumped on every summary install/drop
+
+    # -- maintenance ----------------------------------------------------------
+
+    def mark_dirty(self, name: str) -> None:
+        """Called from NodeInfo._dirty under the NODE lock — the index
+        lock is to its right in the lock order, and this does nothing
+        but a set add."""
+        with self._lock:
+            self._dirty.add(name)
+
+    def forget(self, name: str) -> None:
+        with self._lock:
+            self._dirty.discard(name)
+            self._drop_locked(name)
+
+    def flush(self) -> int:
+        """Re-summarize every dirty node. Node locks (stamped_snapshot)
+        are taken strictly outside the index lock; the flush lock
+        serializes whole flushes (see __init__). Returns the number of
+        nodes recomputed."""
+        # no lock-free empty-dirty fast path on purpose: returning
+        # while ANOTHER thread's flush is still applying would serve
+        # verdicts that miss mutations which happened-before this call
+        with self._flush_lock:
+            with self._lock:
+                if not self._dirty:
+                    return 0
+                dirty = list(self._dirty)
+                self._dirty.clear()
+            for name in dirty:
+                info = self._resolver(name)
+                if info is None:
+                    with self._lock:
+                        self._drop_locked(name)
+                    continue
+                stamp, snap = info.stamped_snapshot()
+                s = summarize(stamp, snap, info.topology,
+                              info.chip_count)
+                with self._lock:
+                    self._drop_locked(name)
+                    self._install_locked(name, s)
+            return len(dirty)
+
+    @staticmethod
+    def _map_verdict(m: _PruneMap, s: _Summary
+                     ) -> tuple[tuple[int, int], str] | None:
+        """(stamp, bucket) when ``s`` certainly cannot fit ``m``'s
+        request shape, else None. The single source of truth every
+        prune decision (map build, incremental update, audit) derives
+        from."""
+        ti, need, contig_needed = m.key
+        have = s.n_ge[ti]
+        if have >= need:
+            if not contig_needed or s.contig_ge[ti] >= need:
+                return None
+            kind, have = "max_contig_box", s.contig_ge[ti]
+        else:
+            kind = "eligible_chips"
+        r = m.reasons.get((kind, have))
+        if r is None:
+            r = m.reasons[(kind, have)] = \
+                f"tier={tier_label(ti)} {kind}={have}<{need}"
+        return (s.stamp, r)
+
+    def _install_locked(self, name: str, s: _Summary) -> None:
+        self._summaries[name] = s
+        self._gen += 1
+        if s.non_tpu:
+            # never bucketed OR prune-mapped: their verdict is a
+            # structural error message, not a no-fit
+            for m in self._prune_maps.values():
+                m.pop(name, None)
+                m.gen = self._gen
+            return
+        for ti in range(len(TIERS) + 1):
+            self._buckets.setdefault(
+                ("contig", ti, min(s.contig_ge[ti], MAX_CAP)),
+                set()).add(name)
+            self._buckets.setdefault(
+                ("count", ti, min(s.n_ge[ti], MAX_CAP)), set()).add(name)
+        for m in self._prune_maps.values():
+            v = self._map_verdict(m, s)
+            if v is None:
+                m.pop(name, None)
+            else:
+                m[name] = v
+            m.gen = self._gen
+
+    def _drop_locked(self, name: str) -> None:
+        s = self._summaries.pop(name, None)
+        self._gen += 1
+        for m in self._prune_maps.values():
+            m.pop(name, None)
+            m.gen = self._gen
+        if s is None or s.non_tpu:
+            return
+        for ti in range(len(TIERS) + 1):
+            for kind, cap in (("contig", s.contig_ge[ti]),
+                              ("count", s.n_ge[ti])):
+                bucket = self._buckets.get((kind, ti, min(cap, MAX_CAP)))
+                if bucket is not None:
+                    bucket.discard(name)
+
+    def _prune_map(self, req: PlacementRequest) -> _PruneMap:
+        """The current prune map for this request shape, built (one
+        pass over the summaries, under the lock so no install can slip
+        past it) when absent or detected stale by generation."""
+        key = (tier_for(req), req.chip_count,
+               req.chip_count > 1 and not req.allow_scatter)
+        m = self._prune_maps.get(key)
+        if m is not None and m.gen == self._gen:
+            return m
+        with self._lock:
+            m = self._prune_maps.get(key)
+            if m is not None and m.gen == self._gen:
+                return m
+            m = _PruneMap(key)
+            for name, s in self._summaries.items():
+                if s.non_tpu:
+                    continue
+                v = self._map_verdict(m, s)
+                if v is not None:
+                    m[name] = v
+            m.gen = self._gen
+            self._prune_maps.pop(key, None)
+            while len(self._prune_maps) >= self.PRUNE_MAP_CAP:
+                self._prune_maps.popitem(last=False)
+            self._prune_maps[key] = m
+            return m
+
+    # -- queries --------------------------------------------------------------
+
+    def partition(self, names: Iterable[str], req: PlacementRequest
+                  ) -> tuple[list[str],
+                             dict[str, tuple[tuple[int, int], str]]]:
+        """Split ``names`` into (to_scan, pruned) for ``req`` in one
+        pass. ``pruned[name] = (stamp, bucket)``: the node certainly
+        cannot fit the request at ``stamp`` (the generation of the
+        state the verdict describes), and ``bucket`` names the
+        capability shortfall that excluded it. Uncovered, non-TPU, and
+        possibly-fitting nodes land in ``to_scan``.
+
+        The per-name loop is one dict.get against the request shape's
+        resident prune map (see _PruneMap — incrementally maintained
+        under the index lock, rebuilt in one pass when absent or
+        generation-stale). Reads are LOCK-FREE on purpose (this sits on
+        the Filter hot path, once per candidate): map values are
+        immutable tuples mutated per-key by GIL-atomic ops — the same
+        discipline as the cache's node map — so a racing install costs
+        at most one conservative "scan" decision or a verdict at the
+        instant the call overlapped, never a wrong prune of settled
+        state."""
+        mget = self._prune_map(req).get
+        to_scan: list[str] = []
+        pruned: dict[str, tuple[tuple[int, int], str]] = {}
+        for n in names:
+            v = mget(n)
+            if v is None:
+                to_scan.append(n)
+            else:
+                pruned[n] = v
+        return to_scan, pruned
+
+    def prune_verdict(self, name: str, req: PlacementRequest
+                      ) -> tuple[tuple[int, int], str] | None:
+        """Single-name form of :meth:`partition` (tests, tooling)."""
+        return self.partition((name,), req)[1].get(name)
+
+    def candidates(self, req: PlacementRequest) -> set[str]:
+        """Union of every bucket that could host the request — the
+        enumeration form of :meth:`prune_verdict` (a node is in this set
+        iff prune_verdict keeps it, minus uncovered/non-TPU nodes which
+        are never bucketed and must always be scanned)."""
+        ti = tier_for(req)
+        need = min(req.chip_count, MAX_CAP)
+        kind = "count" if (req.chip_count == 1 or req.allow_scatter) \
+            else "contig"
+        out: set[str] = set()
+        with self._lock:
+            for cap in range(need, MAX_CAP + 1):
+                bucket = self._buckets.get((kind, ti, cap))
+                if bucket:
+                    out.update(bucket)
+        return out
+
+    def covered(self, name: str) -> bool:
+        with self._lock:
+            return name in self._summaries
+
+    def describe(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "nodes": len(self._summaries),
+                "dirty": len(self._dirty),
+                "buckets": sum(1 for v in self._buckets.values() if v),
+            }
+
+    # -- self-audit (property tests + debugging) ------------------------------
+
+    def audit(self) -> list[str]:
+        """Compare every resident summary and bucket membership against
+        a from-scratch rebuild of the node's CURRENT state. Call after
+        flush() in a quiesced test — any string returned is a bug."""
+        problems: list[str] = []
+        with self._lock:
+            names = list(self._summaries)
+        for name in names:
+            info = self._resolver(name)
+            with self._lock:
+                s = self._summaries.get(name)
+            if info is None:
+                problems.append(f"{name}: summary for an untracked node")
+                continue
+            if s is None:
+                continue  # dropped concurrently
+            stamp, snap = info.stamped_snapshot()
+            fresh = summarize(stamp, snap, info.topology, info.chip_count)
+            if s.stamp != fresh.stamp:
+                problems.append(f"{name}: stale stamp {s.stamp} != "
+                                f"{fresh.stamp} (unflushed mutation?)")
+                continue
+            if (s.non_tpu, s.n_ge, s.contig_ge) != \
+                    (fresh.non_tpu, fresh.n_ge, fresh.contig_ge):
+                problems.append(
+                    f"{name}: summary diverged from rebuild: "
+                    f"{(s.n_ge, s.contig_ge)} != "
+                    f"{(fresh.n_ge, fresh.contig_ge)}")
+        # bucket membership must match the summaries exactly
+        with self._lock:
+            for (kind, ti, cap), bucket in self._buckets.items():
+                for name in bucket:
+                    s = self._summaries.get(name)
+                    if s is None or s.non_tpu:
+                        problems.append(
+                            f"{name}: stale bucket member {kind}/{ti}")
+                        continue
+                    val = s.contig_ge[ti] if kind == "contig" \
+                        else s.n_ge[ti]
+                    if min(val, MAX_CAP) != cap:
+                        problems.append(
+                            f"{name}: in bucket {(kind, ti, cap)} but "
+                            f"summary says {val}")
+            for name, s in self._summaries.items():
+                if s.non_tpu:
+                    continue
+                for ti in range(len(TIERS) + 1):
+                    key = ("contig", ti, min(s.contig_ge[ti], MAX_CAP))
+                    if name not in self._buckets.get(key, ()):
+                        problems.append(
+                            f"{name}: missing from bucket {key}")
+            # resident prune maps must equal a from-scratch derivation
+            for mkey, m in self._prune_maps.items():
+                for name in m:
+                    if name not in self._summaries:
+                        problems.append(
+                            f"{name}: in prune map {mkey} without a "
+                            f"summary")
+                for name, s in self._summaries.items():
+                    want = None if s.non_tpu else self._map_verdict(m, s)
+                    if m.get(name) != want:
+                        problems.append(
+                            f"{name}: prune map {mkey} has "
+                            f"{m.get(name)}, rebuild says {want}")
+        return problems

@@ -149,11 +149,19 @@ class NodeInfo:
         self._version = 0
         self._snap_version = -1
         self._snap: list[ChipView] = []
+        # mutation hook (set by SchedulerCache): marks this node dirty
+        # in the free-capacity index so its capability summary is
+        # re-derived before the next Filter consults it. Invoked UNDER
+        # the node lock, so the hook must only do leaf work (the index's
+        # dirty-set add) — lock order is stripe -> node -> memo -> index.
+        self._on_mutate = None
         self._init_chips(node)
 
     def _dirty(self) -> None:
         """Caller holds self._lock."""
         self._version += 1
+        if self._on_mutate is not None:
+            self._on_mutate()
 
     @property
     def version(self) -> tuple[int, int]:

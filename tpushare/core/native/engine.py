@@ -20,6 +20,15 @@ thread dispatch overhead beats the win below ~2 shards of _MIN_SHARD
 nodes. ``TPUSHARE_SCAN_WORKERS`` caps (or forces) the shard count;
 default min(cpu_count, 8).
 
+Fleet marshalling has two shapes: the per-call ``_marshal_fleet`` path
+(pack cache + one-entry fleet cache — any node change rebuilds the
+whole concatenation) used by ``fits_fleet``/``score_fleet`` direct
+callers, and the RESIDENT :class:`FleetArena` used by the scheduler
+cache's hot path, whose slots are delta-updated in place only for
+nodes whose generation stamp moved and which scans arbitrary node
+subsets against the resident buffers (``TPUSHARE_NO_ARENA=1`` opts
+back into the per-call path).
+
 Every degradation to the Python path is observable:
 ``tpushare_native_fallback_total{reason}`` counts them,
 ``tpushare_native_fleet_scans_total{call,engine}`` attributes each fleet
@@ -535,6 +544,377 @@ def score_fleet(nodes, req: "PlacementRequest",
             chips, topo = nodes[i]
             results[i] = py_score(chips, topo)
     return results
+
+
+# -- resident fleet arena -----------------------------------------------------
+
+
+class _Gap:
+    """Placeholder in the arena's slot order for a retired region (the
+    rows stay in the arrays until compaction; the gap remembers their
+    extent so offset rebuilds stay correct)."""
+
+    __slots__ = ("n_chips", "rank")
+
+    def __init__(self, n_chips: int, rank: int) -> None:
+        self.n_chips = n_chips
+        self.rank = rank
+
+
+class _ArenaSlot:
+    """Bookkeeping for one node's region of the arena arrays."""
+
+    __slots__ = ("pos", "chip_off", "n_chips", "mesh_off", "shape", "stamp")
+
+    def __init__(self, pos: int, chip_off: int, n_chips: int,
+                 mesh_off: int, shape: tuple, stamp) -> None:
+        self.pos = pos
+        self.chip_off = chip_off
+        self.n_chips = n_chips
+        self.mesh_off = mesh_off
+        self.shape = shape
+        self.stamp = stamp
+
+
+def _dense_order(chips, topo):
+    """Chips in idx order when the node is ABI-dense (chip id == array
+    position, mesh size matches), else None (Python fallback)."""
+    n = len(chips)
+    if n != topo.num_chips:
+        return None
+    if all(c.idx == j for j, c in enumerate(chips)):
+        return chips
+    by_idx = sorted(chips, key=lambda c: c.idx)
+    if any(c.idx != j for j, c in enumerate(by_idx)):
+        return None
+    return by_idx
+
+
+class FleetArena:
+    """Persistent packed fleet for the native scan: one resident copy of
+    the concatenated per-chip arrays, DELTA-updated in place only for
+    nodes whose generation stamp moved (dirty-slot tracking) — so a
+    quiescent 20k-node fleet re-packs nothing between scans, and a bind
+    storm re-packs exactly the bound nodes. Contrast `_marshal_fleet`,
+    whose one-entry cache rebuilds the whole concatenation when any
+    single pack changes.
+
+    Callers (SchedulerCache._compute_missing) pass ``entries`` of
+    ``(key, stamp, chips, topo)`` where ``stamp`` is the node's
+    generation at snapshot time (NodeInfo.stamped_snapshot). Scans run
+    over arbitrary subsets: consecutive-slot runs are scanned as
+    zero-copy views of the resident buffers (offsets are absolute into
+    the chip arrays — the placement.cpp sharding contract is exactly
+    what makes this legal), scattered subsets are gathered into a
+    scratch concatenation.
+
+    Concurrency: slot mutation happens under the arena lock; the C scan
+    runs WITHOUT the lock (it releases the GIL and may take tens of ms
+    at fleet scale). A concurrent slot update can therefore tear a
+    scan's read — which is caught, not prevented: after the scan, every
+    scanned slot's stamp is revalidated under the lock, and any node
+    whose slot moved is re-scored from its own (immutable) snapshot.
+    Same optimistic pattern as the per-node memo stamps.
+
+    ``TPUSHARE_NO_ARENA=1`` routes callers to the per-call
+    `score_fleet` marshalling path (A/B + escape hatch).
+    """
+
+    # compact when more than half the chip rows are retired slots
+    _GARBAGE_FRACTION = 0.5
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._slots: dict = {}          # key -> _ArenaSlot
+        self._nondense: set = set()     # keys the dense ABI can't carry
+        self._order: list = []          # keys in slot-pos order
+        self._used = self._total = self._healthy = None
+        self._dims = None
+        self._chip_off = self._mesh_off = None  # prefix offsets (n+1)
+        self._live_chips = 0
+        self._garbage_chips = 0
+        # observability (bench/tests): how much delta work the arena did
+        self.slot_updates = 0
+        self.appends = 0
+        self.repacks = 0
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"nodes": len(self._slots),
+                    "chips": self._live_chips,
+                    "garbage_chips": self._garbage_chips,
+                    "slot_updates": self.slot_updates,
+                    "appends": self.appends,
+                    "repacks": self.repacks}
+
+    # -- maintenance (arena lock held) ---------------------------------------
+
+    def _write_slot(self, slot, ordered) -> None:
+        a, b = slot.chip_off, slot.chip_off + slot.n_chips
+        self._used[a:b] = [c.used_hbm_mib for c in ordered]
+        self._total[a:b] = [c.total_hbm_mib for c in ordered]
+        self._healthy[a:b] = [c.healthy for c in ordered]
+
+    def _retire(self, key, slot) -> None:
+        del self._slots[key]
+        # the order entry becomes a gap (NOT removed: later slots'
+        # positions and offsets remain valid until compaction)
+        self._order[slot.pos] = _Gap(slot.n_chips, len(slot.shape))
+        self._garbage_chips += slot.n_chips
+        self._live_chips -= slot.n_chips
+
+    def _append(self, np, new) -> None:
+        """Append slots for ``new`` [(key, stamp, ordered, topo)] by
+        building NEW arrays (concatenate) — existing arrays are never
+        reallocated in place, so in-flight scans keep reading their
+        captured (consistent) buffers."""
+        parts_u, parts_t, parts_h, parts_d = [], [], [], []
+        if self._used is not None:
+            parts_u.append(self._used)
+            parts_t.append(self._total)
+            parts_h.append(self._healthy)
+            parts_d.append(self._dims)
+        chip_off = int(self._chip_off[-1]) if self._chip_off is not None \
+            else 0
+        mesh_off = int(self._mesh_off[-1]) if self._mesh_off is not None \
+            else 0
+        for key, stamp, ordered, topo in new:
+            n = len(ordered)
+            parts_u.append(np.fromiter(
+                (c.used_hbm_mib for c in ordered), np.int64, n))
+            parts_t.append(np.fromiter(
+                (c.total_hbm_mib for c in ordered), np.int64, n))
+            parts_h.append(np.fromiter(
+                (c.healthy for c in ordered), np.bool_, n))
+            parts_d.append(np.asarray(topo.shape, np.int64))
+            self._slots[key] = _ArenaSlot(
+                len(self._order), chip_off, n, mesh_off,
+                tuple(topo.shape), stamp)
+            self._order.append(key)
+            chip_off += n
+            mesh_off += len(topo.shape)
+            self._live_chips += n
+            self.appends += 1
+        self._used = np.concatenate(parts_u)
+        self._total = np.concatenate(parts_t)
+        self._healthy = np.concatenate(parts_h)
+        self._dims = np.concatenate(parts_d)
+        self._rebuild_offsets(np)
+
+    def _rebuild_offsets(self, np) -> None:
+        n = len(self._order)
+        chip_off = np.zeros(n + 1, np.int64)
+        mesh_off = np.zeros(n + 1, np.int64)
+        for i, key in enumerate(self._order):
+            if isinstance(key, _Gap):
+                nc, rk = key.n_chips, key.rank
+            else:
+                slot = self._slots[key]
+                nc, rk = slot.n_chips, len(slot.shape)
+            chip_off[i + 1] = chip_off[i] + nc
+            mesh_off[i + 1] = mesh_off[i] + rk
+        self._chip_off = chip_off
+        self._mesh_off = mesh_off
+
+    def _compact(self, np) -> None:
+        """Drop retired-slot rows: rebuild the arrays from live slots
+        (new arrays; see _append for why in-place is forbidden)."""
+        live = [(key, self._slots[key]) for key in self._order
+                if not isinstance(key, _Gap)]
+        parts_u, parts_t, parts_h, parts_d = [], [], [], []
+        self._order = []
+        chip_off = mesh_off = 0
+        for key, slot in live:
+            a, b = slot.chip_off, slot.chip_off + slot.n_chips
+            ma, mb = slot.mesh_off, slot.mesh_off + len(slot.shape)
+            parts_u.append(self._used[a:b])
+            parts_t.append(self._total[a:b])
+            parts_h.append(self._healthy[a:b])
+            parts_d.append(self._dims[ma:mb])
+            slot.pos = len(self._order)
+            slot.chip_off = chip_off
+            slot.mesh_off = mesh_off
+            self._order.append(key)
+            chip_off += slot.n_chips
+            mesh_off += len(slot.shape)
+        one = np.zeros(0, np.int64)
+        self._used = np.concatenate(parts_u) if parts_u else one
+        self._total = np.concatenate(parts_t) if parts_t else one
+        self._healthy = np.concatenate(parts_h) if parts_h \
+            else np.zeros(0, np.bool_)
+        self._dims = np.concatenate(parts_d) if parts_d else one
+        self._rebuild_offsets(np)
+        self._garbage_chips = 0
+        self.repacks += 1
+
+    def _sync(self, np, entries) -> None:
+        """Bring every entry's slot up to its stamp: no-op for
+        stamp-matched slots, in-place value write for moved stamps,
+        append for new nodes, retire+append for structural changes
+        (chip count / mesh shape)."""
+        new = []
+        for key, stamp, chips, topo in entries:
+            slot = self._slots.get(key)
+            if slot is not None:
+                if slot.n_chips == len(chips) and \
+                        slot.shape == tuple(topo.shape):
+                    if slot.stamp != stamp:
+                        ordered = _dense_order(chips, topo)
+                        if ordered is None:  # turned gappy: retire
+                            self._retire(key, slot)
+                            self._nondense.add(key)
+                            continue
+                        slot.stamp = stamp
+                        self._write_slot(slot, ordered)
+                        self.slot_updates += 1
+                    continue
+                self._retire(key, slot)  # structural change
+            self._nondense.discard(key)
+            ordered = _dense_order(chips, topo)
+            if ordered is None:
+                self._nondense.add(key)
+                continue
+            new.append((key, stamp, ordered, topo))
+        if new:
+            self._append(np, new)
+        if self._garbage_chips > max(
+                64, self._GARBAGE_FRACTION
+                * (self._live_chips + self._garbage_chips)):
+            self._compact(np)
+
+    def forget(self, key) -> None:
+        with self._lock:
+            slot = self._slots.get(key)
+            if slot is not None:
+                self._retire(key, slot)
+            self._nondense.discard(key)
+
+    # -- scanning -------------------------------------------------------------
+
+    def score(self, entries, req: "PlacementRequest",
+              workers: int | None = None) -> "list[int | None]":
+        """Best binpack score per entry (None = no placement): the
+        arena-backed equivalent of :func:`score_fleet` over
+        ``(key, stamp, chips, topo)`` entries."""
+        if not entries:
+            return []
+        nodes = [(chips, topo) for _k, _s, chips, topo in entries]
+        if _load() is None or os.environ.get("TPUSHARE_NO_ARENA"):
+            return score_fleet(nodes, req, workers)
+        try:
+            import numpy as np
+        except ImportError:
+            return score_fleet(nodes, req, workers)  # counts no_numpy
+
+        with self._lock:
+            self._sync(np, entries)
+            resident = []   # (entry idx, slot pos, slot object)
+            fallback = []   # entry idx scored via score_fleet below
+            for i, (key, _stamp, _chips, _topo) in enumerate(entries):
+                slot = self._slots.get(key)
+                if slot is None:
+                    fallback.append(i)
+                else:
+                    resident.append((i, slot.pos, slot))
+            used, total, healthy = self._used, self._total, self._healthy
+            dims, chip_off, mesh_off = \
+                self._dims, self._chip_off, self._mesh_off
+
+        results: "list[int | None]" = [None] * len(entries)
+        stale: list = []
+        if resident:
+            resident.sort(key=lambda t: t[1])
+            runs: list[tuple[int, int]] = []  # [pos_a, pos_b) slot runs
+            for _i, pos, _slot in resident:
+                if runs and runs[-1][1] == pos:
+                    runs[-1] = (runs[-1][0], pos + 1)
+                else:
+                    runs.append((pos, pos + 1))
+            # gather the subset: consecutive runs are zero-copy views of
+            # the resident buffers; the offsets are rebased so they stay
+            # absolute WITHIN the gathered arrays (the placement.cpp
+            # sharding contract)
+            parts_u, parts_t, parts_h, parts_d = [], [], [], []
+            parts_o, parts_m = [np.zeros(1, np.int64)], \
+                [np.zeros(1, np.int64)]
+            chip_base = mesh_base = 0
+            for p0, p1 in runs:
+                a, b = int(chip_off[p0]), int(chip_off[p1])
+                ma, mb = int(mesh_off[p0]), int(mesh_off[p1])
+                parts_u.append(used[a:b])
+                parts_t.append(total[a:b])
+                parts_h.append(healthy[a:b])
+                parts_d.append(dims[ma:mb])
+                parts_o.append(chip_off[p0 + 1:p1 + 1] - (a - chip_base))
+                parts_m.append(mesh_off[p0 + 1:p1 + 1] - (ma - mesh_base))
+                chip_base += b - a
+                mesh_base += mb - ma
+            if len(runs) == 1:
+                used_s, total_s, healthy_s = \
+                    parts_u[0], parts_t[0], parts_h[0]
+                dims_s = parts_d[0]
+            else:
+                used_s = np.concatenate(parts_u)
+                total_s = np.concatenate(parts_t)
+                healthy_s = np.concatenate(parts_h)
+                dims_s = np.concatenate(parts_d)
+            off_s = np.concatenate(parts_o)
+            moff_s = np.concatenate(parts_m)
+            # request-dependent eligibility, folded per scan (the arena
+            # stores raw used/total; -1 marks can-never-host)
+            ineligible = ~healthy_s
+            if req.hbm_mib == 0:
+                ineligible = ineligible | (used_s > 0)
+            free_s = np.ascontiguousarray(
+                np.where(ineligible, np.int64(-1), total_s - used_s),
+                np.int64)
+            total_s = np.ascontiguousarray(total_s, np.int64)
+            dims_s = np.ascontiguousarray(dims_s, np.int64)
+
+            n = len(resident)
+            t_rank = len(req.topology) if req.topology else 0
+            t_dims = (ctypes.c_int64 * max(t_rank, 1))(
+                *(req.topology or (0,)))
+            out = np.zeros(n, np.int64)
+            lib = _load()
+
+            def call_range(a: int, b: int) -> int:
+                return lib.tpushare_score_fleet(
+                    b - a, _i64p(off_s[a:]), _i64p(free_s),
+                    _i64p(total_s), _i64p(moff_s[a:]), _i64p(dims_s),
+                    req.hbm_mib, req.chip_count, t_rank, t_dims,
+                    1 if req.allow_scatter else 0, _i64p(out[a:]))
+
+            rc = _fleet_call(call_range, n, "score", workers)
+            if rc != 0:
+                NATIVE_FALLBACKS.inc("engine_error")
+                fallback.extend(i for i, _p, _s in resident)
+            else:
+                # optimistic-concurrency validation: any slot whose
+                # stamp moved during the unlocked scan may have torn
+                # our read — re-score those from their own snapshots
+                with self._lock:
+                    current = self._slots
+                    for k, (i, _pos, slot) in enumerate(resident):
+                        key, stamp = entries[i][0], entries[i][1]
+                        if current.get(key) is slot \
+                                and slot.stamp == stamp:
+                            s = int(out[k])
+                            if s >= 0:
+                                results[i] = s
+                            elif s == -1:
+                                results[i] = None
+                            else:  # -2: not expressible after all
+                                fallback.append(i)
+                        else:
+                            stale.append(i)
+        if stale or fallback:
+            redo = stale + fallback
+            redo_scores = score_fleet(
+                [nodes[i] for i in redo], req, workers)
+            for i, s in zip(redo, redo_scores):
+                results[i] = s
+        return results
 
 
 def select_chips(chips: "Sequence[ChipView]", topo: "MeshTopology",

@@ -152,6 +152,16 @@ extern "C" int64_t tpushare_abi_version() { return 3; }
 // reads shared immutable input and writes only its own out window.
 // Both fleet entry points keep this property; do not introduce shared
 // mutable state here.
+//
+// RESIDENT-ARENA NOTE (engine.py FleetArena): the same two properties —
+// absolute offsets and per-node independence — are what let a caller
+// keep ONE long-lived packed fleet and scan arbitrary subsets of it:
+// a run of consecutive slots is passed as views into the resident
+// arrays with rebased offsets, with no per-call marshalling. The ABI
+// itself is unchanged (abi_version stays 3); any future change that
+// makes node evaluation order- or neighbor-dependent, or makes offsets
+// relative, breaks BOTH the thread-sharding and the arena subset-scan
+// callers and must bump the version.
 extern "C" int tpushare_fits_fleet(
     int n_nodes,
     const int64_t* node_chip_offsets,

@@ -167,28 +167,40 @@ class FilterHandler:
                 verdicts[name] = {"verdict": "ok",
                                   "reason": "no TPU request to check"}
         else:
-            # one memoized native call evaluates the whole fleet (hot
-            # loops #1+#2 of SURVEY §3.2 fused; flat wrt node count) —
-            # Prioritize and Bind reuse this exact pass via the memo
+            # one memoized native call evaluates the candidates that
+            # survive the memo + eqclass join + capacity-index prune
+            # (hot loops #1+#2 of SURVEY §3.2 fused, then made sublinear
+            # in fleet size) — Prioritize and Bind reuse this exact pass
             prov: dict[str, str] = {}
             scores, errors = self._cache.score_nodes(pod, req, node_names,
                                                      provenance=prov)
             for name in node_names:
+                src = prov.get(name)
                 if name in errors:
                     failed[name] = errors[name]
                     verdicts[name] = {"verdict": "rejected",
                                       "reason": errors[name],
-                                      "source": prov.get(name)}
+                                      "source": src}
                 elif scores.get(name) is not None:
                     ok_nodes.append(name)
                     verdicts[name] = {"verdict": "ok",
                                       "score": scores[name],
-                                      "source": prov.get(name)}
+                                      "source": src}
                 else:
+                    # the WIRE verdict is identical either way (the
+                    # index only prunes certain no-fits), but the audit
+                    # stays truthful: a pruned node was never visited,
+                    # and the bucket that excluded it is recorded
                     failed[name] = no_fit_reason(req, name)
-                    verdicts[name] = {"verdict": "rejected",
-                                      "reason": failed[name],
-                                      "source": prov.get(name)}
+                    if src and src.startswith("pruned:"):
+                        verdicts[name] = {"verdict": "skipped",
+                                          "reason": "index-pruned",
+                                          "bucket": src.split(":", 1)[1],
+                                          "source": "index"}
+                    else:
+                        verdicts[name] = {"verdict": "rejected",
+                                          "reason": failed[name],
+                                          "source": src}
         audit(verdicts)
         log.debug("filter %s: %d ok / %d failed",
                   podlib.pod_key(pod), len(ok_nodes), len(failed))
@@ -749,8 +761,10 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
         per_node)
 
     from tpushare.cache.cache import (
-        MEMO_DELTA_INVALIDATIONS, MEMO_NODE_SCORES, MEMO_REQUESTS,
-        MEMO_STALE_SERVES)
+        EQCLASS_SHARES, MEMO_DELTA_INVALIDATIONS, MEMO_NODE_SCORES,
+        MEMO_REQUESTS, MEMO_STALE_SERVES)
+    from tpushare.cache.index import (
+        INDEX_CANDIDATE_RATIO, INDEX_PRUNED, INDEX_STALE_SERVES)
     from tpushare.cache.nodeinfo import CLAIM_CAS_RETRIES
     from tpushare.core.native import engine as _native
     from tpushare.k8s.informer import (
@@ -784,6 +798,13 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
     registry.register(MEMO_NODE_SCORES)
     registry.register(MEMO_DELTA_INVALIDATIONS)
     registry.register(MEMO_STALE_SERVES)
+    # sublinear-filtering set: index pruning volume + candidate ratio,
+    # the index-verify tripwire, and eqclass scan sharing — the
+    # counters that prove Filter stopped paying O(fleet)
+    registry.register(INDEX_PRUNED)
+    registry.register(INDEX_CANDIDATE_RATIO)
+    registry.register(INDEX_STALE_SERVES)
+    registry.register(EQCLASS_SHARES)
     registry.register(_native.NATIVE_FLEET_SCANS)
     registry.register(_native.NATIVE_FALLBACKS)
     registry.gauge_func(

@@ -7,10 +7,15 @@ Pending pod gets counters, not reasons. The ExplainStore keeps, per pod,
 the last few scheduling cycles' complete decision record:
 
 - **filter**: for EVERY candidate node, the verdict — ``ok`` with the
-  binpack score, or ``rejected`` with the concrete reason (insufficient
-  chip HBM, not a TPU node, gang constraint, node fetch failure) — plus
-  whether the score was served from the placement memo or recomputed
-  (``source: memo|computed``, the stale-memo-recompute breadcrumb);
+  binpack score, ``rejected`` with the concrete reason (insufficient
+  chip HBM, not a TPU node, gang constraint, node fetch failure), or
+  ``skipped`` with ``reason: index-pruned`` for nodes the free-capacity
+  index excluded WITHOUT a visit (the ``bucket`` field names the
+  capability shortfall, e.g. ``tier=>=8192MiB eligible_chips=0<1``) —
+  plus where the verdict came from (``source:
+  memo|eqclass|computed|index``, the stale-memo-recompute breadcrumb).
+  Sublinear filtering means Filter no longer walks every node; the
+  audit records that honestly instead of inventing a visit;
 - **prioritize**: the normalized 0-10 ranking and the winning node;
 - **bind**: the chosen node, outcome, chips granted or the error
   (including breaker fast-fail refusals, which never reach a node).
