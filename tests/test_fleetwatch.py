@@ -1,0 +1,420 @@
+"""Fleet-health observability (ISSUE 6): the continuous drift auditor
+flags injected cache/apiserver divergence and index corruption with the
+right ``kind`` labels, the stranded-HBM gap matches brute-force
+enumeration on random fleets, the scorecard reduces the decision
+stream correctly, sampled verify (TPUSHARE_VERIFY_SAMPLE) actually
+runs the oracles, and /inspect/fleet serves it all.
+"""
+
+import json
+import random
+import threading
+import urllib.request
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.cache.index import EXCL_TIER, TIERS, summarize
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+from tpushare.obs.fleetwatch import (
+    AUDIT_SWEEPS, CACHE_DRIFT, FleetWatch, Scorecard, stranded_gap_mib)
+
+HBM = 16384
+
+
+def _fleet(n_nodes=2, chips=4, mesh="2x2"):
+    fc = FakeCluster()
+    for i in range(n_nodes):
+        fc.add_tpu_node(f"n{i}", chips=chips, hbm_per_chip_mib=HBM,
+                        mesh=mesh)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    return fc, cache
+
+
+def _bind(fc, cache, node, name, hbm):
+    info = cache.get_node_info(node)
+    pod = fc.create_pod(make_pod(hbm=hbm, name=name))
+    info.allocate(pod, fc)
+    cache.add_or_update_pod(fc.get_pod("default", name))
+
+
+def _drift_delta(fn):
+    before = CACHE_DRIFT.snapshot()
+    result = fn()
+    after = CACHE_DRIFT.snapshot()
+    delta = {k[0]: after[k] - before.get(k, 0.0)
+             for k in after if after[k] != before.get(k, 0.0)}
+    return result, delta
+
+
+# -- drift auditor ------------------------------------------------------------
+
+def test_clean_fleet_audits_zero_drift():
+    fc, cache = _fleet()
+    _bind(fc, cache, "n0", "w0", 2048)
+    fw = FleetWatch(cache, cluster=fc, recheck_s=0.0)
+    sweeps0 = AUDIT_SWEEPS.value
+    _, delta = _drift_delta(lambda: fw.audit_sweep(sample=10))
+    assert delta == {}
+    assert AUDIT_SWEEPS.value == sweeps0 + 1
+
+
+def test_auditor_flags_ghost_pod_within_one_sweep():
+    fc, cache = _fleet()
+    info = cache.get_node_info("n0")
+    ghost = {"metadata": {"name": "ghost", "namespace": "default",
+                          "uid": "ghost-uid",
+                          "annotations": contract.placement_annotations(
+                              [0], 2048, HBM)},
+             "spec": {"nodeName": "n0"}}
+    info.add_or_update_pod(ghost)
+    fw = FleetWatch(cache, cluster=fc, recheck_s=0.0)
+    r, delta = _drift_delta(lambda: fw.audit_sweep(sample=10))
+    assert delta == {"ghost_pod": 1.0}
+    assert [d["kind"] for d in r["drift"]] == ["ghost_pod"]
+    # healed: the divergence disappears from the next sweep
+    info.remove_pod(ghost)
+    _, delta = _drift_delta(lambda: fw.audit_sweep(sample=10))
+    assert delta == {}
+
+
+def test_auditor_flags_missing_pod_and_chip_usage():
+    fc, cache = _fleet()
+    # missing: a bound, chip-annotated pod the cache never accounted
+    p = make_pod(hbm=2048, name="lost")
+    p["metadata"]["annotations"] = dict(
+        p["metadata"].get("annotations") or {},
+        **contract.placement_annotations([1], 2048, HBM))
+    fc.create_pod(p)
+    fc.bind_pod("default", "lost", "n0")
+    fw = FleetWatch(cache, cluster=fc, recheck_s=0.0)
+    _, delta = _drift_delta(lambda: fw.audit_sweep(sample=10))
+    assert delta == {"missing_pod": 1.0}
+    # usage mismatch: cache accounts the pod at the wrong size
+    bound = fc.get_pod("default", "lost")
+    skewed = json.loads(json.dumps(bound))  # deep copy
+    skewed["metadata"]["annotations"][contract.ANN_HBM_POD] = "4096"
+    cache.get_node_info("n0").add_or_update_pod(skewed)
+    _, delta = _drift_delta(lambda: fw.audit_sweep(sample=10))
+    assert delta == {"chip_usage": 1.0}
+
+
+def test_auditor_flags_index_summary_corruption():
+    fc, cache = _fleet()
+    _bind(fc, cache, "n0", "w0", 2048)
+    cache.index.flush()
+    info = cache.get_node_info("n0")
+    stamp, snap = info.stamped_snapshot()
+    bogus = summarize(stamp, snap, info.topology, info.chip_count)
+    bogus.n_ge = (0,) * (len(TIERS) + 1)
+    bogus.contig_ge = (0,) * (len(TIERS) + 1)
+    with cache.index._lock:
+        cache.index._drop_locked("n0")
+        cache.index._install_locked("n0", bogus)
+    fw = FleetWatch(cache, cluster=fc, recheck_s=0.0)
+    r, delta = _drift_delta(lambda: fw.audit_sweep(sample=10))
+    assert delta.get("index_summary", 0.0) >= 1.0
+    assert any(d["kind"] == "index_summary" for d in r["drift"])
+    # heal: re-deriving the summary clears the drift
+    cache.index.mark_dirty("n0")
+    cache.index.flush()
+    _, delta = _drift_delta(lambda: fw.audit_sweep(sample=10))
+    assert delta == {}
+
+
+def test_auditor_ignores_inflight_reservations():
+    """A bind between phase 1 (reserve) and phase 3 (confirm) has no
+    apiserver annotation yet — the auditor must not read that window
+    as drift (reserved entries are excluded from audit_snapshot)."""
+    fc, cache = _fleet()
+    info = cache.get_node_info("n0")
+    with info._lock:
+        info.chips[0].reserve("inflight-uid", 4096)
+        info._dirty()
+    fw = FleetWatch(cache, cluster=fc, recheck_s=0.0)
+    _, delta = _drift_delta(lambda: fw.audit_sweep(sample=10))
+    assert delta == {}
+
+
+def test_auditor_double_check_clears_transient_divergence():
+    """Watch-lag shape: the truth catches up between the first pass and
+    the recheck — nothing may be counted."""
+    fc, cache = _fleet()
+    info = cache.get_node_info("n0")
+    ghost = {"metadata": {"name": "late", "namespace": "default",
+                          "uid": "late-uid",
+                          "annotations": contract.placement_annotations(
+                              [0], 2048, HBM)},
+             "spec": {"nodeName": "n0"}}
+    info.add_or_update_pod(ghost)  # cache leads the apiserver briefly
+    fw = FleetWatch(cache, cluster=fc, recheck_s=0.1)
+
+    def heal():
+        p = make_pod(hbm=2048, name="late", uid="late-uid")
+        p["metadata"]["annotations"] = dict(
+            p["metadata"].get("annotations") or {},
+            **contract.placement_annotations([0], 2048, HBM))
+        fc.create_pod(p)
+        fc.bind_pod("default", "late", "n0")
+
+    t = threading.Timer(0.02, heal)
+    t.start()
+    try:
+        _, delta = _drift_delta(lambda: fw.audit_sweep(sample=10))
+    finally:
+        t.join()
+    assert delta == {}
+
+
+def test_audit_sweep_round_robin_covers_the_fleet():
+    fc, cache = _fleet(n_nodes=5)
+    fw = FleetWatch(cache, cluster=fc, recheck_s=0.0, audit_sample=2)
+    seen: set[str] = set()
+    for _ in range(3):
+        seen.update(fw.audit_sweep()["nodes"])
+    assert seen == {f"n{i}" for i in range(5)}
+
+
+# -- stranded-HBM gap ---------------------------------------------------------
+
+def _brute_gap(views, topo, hbm_per_chip):
+    """Brute-force per-tier stranded gap: eligibility by direct scan,
+    largest contiguous sub-box by full shape x position enumeration."""
+    out = []
+    for ti in range(len(TIERS) + 1):
+        if ti == EXCL_TIER:
+            elig = {v.idx for v in views
+                    if v.healthy and v.used_hbm_mib == 0}
+        else:
+            elig = {v.idx for v in views
+                    if v.healthy and v.free_hbm_mib >= TIERS[ti]}
+        best = 0
+        for size in range(len(views), 0, -1):
+            if size <= best:
+                break
+            found = False
+            for box in topo.box_shapes(size):
+                for origin in topo.box_positions(box):
+                    if all(i in elig
+                           for i in topo.box_chips(origin, box)):
+                        found = True
+                        break
+                if found:
+                    break
+            if found:
+                best = size
+        mib = hbm_per_chip if ti == EXCL_TIER else TIERS[ti]
+        out.append((len(elig) - best) * mib)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_stranded_gap_matches_bruteforce_on_random_fleets(seed):
+    rng = random.Random(seed)
+    mesh = rng.choice(["2x2", "4x2", "2x4", "4x4", None])
+    chips = (int(mesh.split("x")[0]) * int(mesh.split("x")[1])
+             if mesh else rng.choice([2, 4, 8]))
+    fc = FakeCluster()
+    names = [f"r{i}" for i in range(rng.randint(2, 5))]
+    for n in names:
+        fc.add_tpu_node(n, chips=chips, hbm_per_chip_mib=HBM, mesh=mesh)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    # random occupancy + health churn
+    for n in names:
+        info = cache.get_node_info(n)
+        for cid in range(chips):
+            if rng.random() < 0.6:
+                used = rng.choice([512, 2048, 4096, 8192, HBM])
+                info.add_or_update_pod({
+                    "metadata": {"name": f"{n}-p{cid}", "namespace": "d",
+                                 "uid": f"{n}-p{cid}",
+                                 "annotations":
+                                     contract.placement_annotations(
+                                         [cid], used, HBM)},
+                    "spec": {"nodeName": n}})
+        if rng.random() < 0.3:
+            info.set_unhealthy({rng.randrange(chips)})
+    cache.index.flush()
+    summaries = cache.index.summaries_snapshot()
+    assert set(summaries) == set(names)
+    for n in names:
+        info = cache.get_node_info(n)
+        _stamp, _non_tpu, n_ge, contig_ge = summaries[n]
+        got = stranded_gap_mib(n_ge, contig_ge, info.hbm_per_chip)
+        want = _brute_gap(info.snapshot(), info.topology,
+                          info.hbm_per_chip)
+        assert got == want, (n, got, want)
+
+
+def test_sampler_reports_known_fragmented_layout():
+    """docs/pd.md §1.3 literally: free chips with no free contiguous
+    pair — the gap gauge must price exactly the stranded chip."""
+    fc, cache = _fleet(n_nodes=1)
+    # fill chips 0 and 3 (2x2 corners): free {1, 2} is a diagonal —
+    # 2 schedulable chips, largest contiguous box 1
+    for cid in (0, 3):
+        cache.get_node_info("n0").add_or_update_pod({
+            "metadata": {"name": f"fill{cid}", "namespace": "d",
+                         "uid": f"fill{cid}",
+                         "annotations": contract.placement_annotations(
+                             [cid], HBM, HBM)},
+            "spec": {"nodeName": "n0"}})
+    fw = FleetWatch(cache, cluster=fc, recheck_s=0.0)
+    sample = fw.sample_fleet()
+    top = sample["tiers"][f">={HBM}MiB"]
+    assert top["schedulable_chips"] == 2
+    assert top["contiguous_chips"] == 1
+    assert top["stranded_hbm_mib"] == HBM
+    assert sample["tiers"]["exclusive"]["stranded_hbm_mib"] == HBM
+    assert sample["top_fragmented"][0]["node"] == "n0"
+    assert sample["top_fragmented"][0]["stranded_hbm_mib"] == HBM
+
+
+# -- scorecard ----------------------------------------------------------------
+
+def test_scorecard_reduces_the_decision_stream():
+    clock = [0.0]
+    sc = Scorecard(time_fn=lambda: clock[0])
+    # pod a: filtered at t=0, bound at t=2
+    sc.filter_recorded("a", ok=3, candidates=4)
+    clock[0] = 2.0
+    sc.bind_recorded("a", "bound")
+    # pod b: rejected twice, then bound at t=10 (age 8 from first sight)
+    sc.filter_recorded("b", ok=0, candidates=4)
+    clock[0] = 6.0
+    sc.filter_recorded("b", ok=0, candidates=4)
+    clock[0] = 10.0
+    sc.filter_recorded("b", ok=1, candidates=4)
+    sc.bind_recorded("b", "bound")
+    # pod c: still pending; one failed bind on d
+    sc.filter_recorded("c", ok=0, candidates=4)
+    sc.bind_recorded("d", "bind_failed")
+    # utilization: 50% for 4s then 100% for 4s -> 75% time-weighted
+    clock[0] = 0.0
+    sc.util_sample(50, 100)
+    clock[0] = 4.0
+    sc.util_sample(50, 100)
+    sc.util_sample(100, 100)
+    clock[0] = 8.0
+    sc.util_sample(100, 100)
+    snap = sc.snapshot()
+    assert snap["cycles"] == 5
+    assert snap["rejected_cycles"] == 3
+    assert snap["rejection_rate"] == pytest.approx(0.6)
+    assert snap["binds"] == 2
+    assert snap["bind_failures"] == 1
+    assert snap["pending"] == 1
+    assert snap["p99_pending_age_s"] == pytest.approx(8.0)
+    # trapezoid: 50% over [0,4] + step to 100% at 4 + 100% over [4,8]
+    assert snap["time_weighted_util_pct"] == pytest.approx(75.0)
+
+
+# -- sampled verify -----------------------------------------------------------
+
+def _poison_index(cache, name):
+    """Install a wrong (all-zero) summary at the node's CURRENT stamp,
+    through the real install path so buckets/prune maps/generation stay
+    internally consistent — the index now wrongly prunes the node."""
+    cache.index.flush()
+    info = cache.get_node_info(name)
+    stamp, snap = info.stamped_snapshot()
+    bogus = summarize(stamp, snap, info.topology, info.chip_count)
+    bogus.n_ge = (0,) * (len(TIERS) + 1)
+    bogus.contig_ge = (0,) * (len(TIERS) + 1)
+    with cache.index._lock:
+        cache.index._drop_locked(name)
+        cache.index._install_locked(name, bogus)
+
+
+def _score_once(fc, cache):
+    from tpushare.cache.nodeinfo import request_from_pod
+    pod = fc.create_pod(make_pod(hbm=2048,
+                                 name=f"probe{random.random()}"))
+    req = request_from_pod(pod)
+    return cache.score_nodes(pod, req, cache.node_names())
+
+
+def test_sampled_verify_runs_the_index_oracle():
+    from tpushare.cache.index import INDEX_STALE_SERVES
+    fc = FakeCluster()
+    fc.add_tpu_node("n0", chips=4, hbm_per_chip_mib=HBM, mesh="2x2")
+    cache = SchedulerCache(fc, eqclass=False, verify_sample=1)
+    cache.build_cache()
+    _poison_index(cache, "n0")
+    before = INDEX_STALE_SERVES.value
+    scores, errors = _score_once(fc, cache)
+    # the poisoned index pruned a schedulable node; the sampled oracle
+    # full-scanned it and counted the stale prune
+    assert scores.get("n0") is None and not errors
+    assert INDEX_STALE_SERVES.value == before + 1
+
+
+def test_unsampled_decisions_skip_the_oracle():
+    from tpushare.cache.index import INDEX_STALE_SERVES
+    fc = FakeCluster()
+    fc.add_tpu_node("n0", chips=4, hbm_per_chip_mib=HBM, mesh="2x2")
+    cache = SchedulerCache(fc, eqclass=False, verify_sample=0)
+    cache.build_cache()
+    _poison_index(cache, "n0")
+    before = INDEX_STALE_SERVES.value
+    _score_once(fc, cache)
+    assert INDEX_STALE_SERVES.value == before
+
+
+def test_verify_sample_cadence_is_one_in_n():
+    from tpushare.cache.index import INDEX_STALE_SERVES
+    fc = FakeCluster()
+    fc.add_tpu_node("n0", chips=4, hbm_per_chip_mib=HBM, mesh="2x2")
+    cache = SchedulerCache(fc, eqclass=False, verify_sample=3)
+    cache.build_cache()
+    _poison_index(cache, "n0")
+    before = INDEX_STALE_SERVES.value
+    for _ in range(6):  # calls 0..5: calls 0 and 3 draw the straw
+        _score_once(fc, cache)
+    assert INDEX_STALE_SERVES.value == before + 2
+
+
+# -- /inspect/fleet -----------------------------------------------------------
+
+def test_inspect_fleet_endpoint_and_gauges():
+    fc, cache = _fleet()
+    _bind(fc, cache, "n0", "w0", 4096)
+    server = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    port = server.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        # drive one real cycle so the scorecard has a decision stream
+        pod = fc.create_pod(make_pod(hbm=2048, name="cyc"))
+        body = json.dumps({"Pod": pod,
+                           "NodeNames": ["n0", "n1"]}).encode()
+        req = urllib.request.Request(
+            f"{base}/tpushare-scheduler/filter", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["NodeNames"]
+        with urllib.request.urlopen(f"{base}/inspect/fleet",
+                                    timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["nodes_covered"] == 2
+        assert snap["tiers"][">=1MiB"]["schedulable_chips"] > 0
+        assert snap["scorecard"]["cycles"] >= 1
+        assert "drift_total" in snap["audit"]
+        # prefixed route too (kube-ecosystem tooling hits the prefix)
+        with urllib.request.urlopen(
+                f"{base}/tpushare-scheduler/inspect/fleet",
+                timeout=10) as r:
+            assert json.loads(r.read())["nodes_covered"] == 2
+        server.fleetwatch.sample_fleet()
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'tpushare_fleet_schedulable_chips{tier=">=1MiB"}' in text
+        assert 'tpushare_fleet_stranded_hbm_mib' in text
+        assert "tpushare_cache_drift_total" in text
+        assert "tpushare_audit_sweeps_total" in text
+    finally:
+        server.stop()

@@ -10,7 +10,7 @@ from tpushare.k8s import FakeCluster
 
 
 @pytest.fixture
-def live(capsys):
+def live_env(capsys):
     fc = FakeCluster()
     fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=15000)
     fc.add_tpu_node("n2", chips=1, hbm_per_chip_mib=15000)
@@ -23,8 +23,18 @@ def live(capsys):
     cache.add_or_update_pod(fc.get_pod("default", "worker"))
     server = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
     port = server.start()
-    yield f"http://127.0.0.1:{port}"
+    yield f"http://127.0.0.1:{port}", fc
     server.stop()
+
+
+@pytest.fixture
+def live(live_env):
+    return live_env[0]
+
+
+@pytest.fixture
+def live_cluster(live_env):
+    return live_env[1]
 
 
 def test_cli_summary_table(live, capsys):
@@ -56,3 +66,56 @@ def test_cli_unreachable_endpoint(capsys):
 def test_render_empty_cluster():
     out = render_table({"nodes": [], "used_hbm_mib": 0, "total_hbm_mib": 0})
     assert "Allocated/Total TPU HBM in Cluster: 0/0 MiB (-)" in out
+
+
+def test_cli_fleet_subcommand(live, capsys):
+    assert main(["--endpoint", live, "fleet"]) == 0
+    out = capsys.readouterr().out
+    assert "TIER" in out and "STRANDED" in out
+    assert "drift auditor" in out and "scorecard" in out
+    # --json emits the raw snapshot
+    assert main(["--endpoint", live, "--json", "fleet"]) == 0
+    import json as jsonlib
+    snap = jsonlib.loads(capsys.readouterr().out)
+    assert "tiers" in snap and "audit" in snap
+
+
+def test_cli_explain_and_traces_subcommands(live, capsys, live_cluster):
+    import json as jsonlib
+    import urllib.request
+
+    fc = live_cluster
+    pod = fc.create_pod(make_pod(hbm=1024, name="cli-pod"))
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"{live}/tpushare-scheduler{path}",
+            data=jsonlib.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return jsonlib.loads(r.read())
+
+    ok = post("/filter", {"Pod": pod, "NodeNames": ["n1", "n2"]})
+    assert ok["NodeNames"]
+    bind = post("/bind", {"PodName": "cli-pod", "PodNamespace": "default",
+                          "PodUID": pod["metadata"]["uid"],
+                          "Node": ok["NodeNames"][0]})
+    assert not bind.get("Error")
+
+    assert main(["--endpoint", live, "explain"]) == 0
+    listing = jsonlib.loads(capsys.readouterr().out)
+    assert any(p["pod"].get("name") == "cli-pod"
+               for p in listing["pods"])
+    assert main(["--endpoint", live, "explain", "default/cli-pod"]) == 0
+    record = jsonlib.loads(capsys.readouterr().out)
+    assert record["cycles"] and "filter" in record["cycles"][0]
+    # unknown pod: clean error, not a traceback
+    assert main(["--endpoint", live, "explain", "no/such"]) == 1
+    assert "no decision record" in capsys.readouterr().err
+
+    assert main(["--endpoint", live, "traces"]) == 0
+    out = capsys.readouterr().out
+    assert "recent traces" in out and "[bound]" in out
+    assert main(["--endpoint", live, "--json", "-n", "1", "traces"]) == 0
+    dump = jsonlib.loads(capsys.readouterr().out)
+    assert len(dump["traces"]) <= 1

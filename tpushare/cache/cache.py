@@ -188,7 +188,8 @@ class SchedulerCache:
     def __init__(self, cluster, node_lister=None, *,
                  index: bool | None = None,
                  eqclass: bool | None = None,
-                 verify_index: bool | None = None) -> None:
+                 verify_index: bool | None = None,
+                 verify_sample: int | None = None) -> None:
         self._cluster = cluster
         # lock order: stripe -> node (NodeInfo._lock) -> memo -> index.
         # The stripes guard node-map structure only; _pods_lock is a leaf.
@@ -224,6 +225,23 @@ class SchedulerCache:
         self._verify_serves = bool(os.environ.get("TPUSHARE_MEMO_VERIFY"))
         self._verify_index = bool(os.environ.get("TPUSHARE_INDEX_VERIFY")) \
             if verify_index is None else bool(verify_index)
+        # sampled verify (TPUSHARE_VERIFY_SAMPLE=N): run BOTH verify
+        # oracles on 1-in-N score_nodes calls, so the stale-serve
+        # tripwires (tpushare_memo_stale_serves_total,
+        # tpushare_index_stale_serves_total) stay cheap always-on
+        # production signals instead of all-or-nothing debug knobs.
+        # The full-verify flags above still force every call.
+        if verify_sample is None:
+            try:
+                verify_sample = int(os.environ.get(
+                    "TPUSHARE_VERIFY_SAMPLE", "0") or 0)
+            except ValueError:
+                verify_sample = 0
+        self._verify_sample = max(int(verify_sample), 0)
+        # GIL-atomic sampling cursor (itertools.count is C-level; no
+        # lock needed for a statistical 1-in-N)
+        import itertools
+        self._verify_ctr = itertools.count()
         # flipped by build_cache: /readyz refuses traffic until the
         # startup replay has reconstructed chip assignments (a bind
         # against an un-replayed cache could oversubscribe)
@@ -305,6 +323,20 @@ class SchedulerCache:
     def node_names(self) -> list[str]:
         return list(self._nodes)  # GIL-atomic copy of the keys
 
+    def peek_node(self, node_name: str) -> NodeInfo | None:
+        """Lock-free read of an already-tracked NodeInfo, or None.
+        Never faults the node in — observers (the drift auditor, the
+        fleet sampler) must not create state as a side effect of
+        looking at it."""
+        return self._nodes.get(node_name)
+
+    @property
+    def index(self) -> CapacityIndex:
+        """The free-capacity index (read-mostly observer surface: the
+        fleet-health sampler reads summaries_snapshot(), the drift
+        auditor runs audit(names=...) sweeps against it)."""
+        return self._index
+
     def _node_version(self, node_name: str) -> tuple[int, int] | None:
         """Current generation stamp, or None when untracked (removed /
         never seen) — None never matches a stored stamp."""
@@ -369,6 +401,12 @@ class SchedulerCache:
         key = podlib.pod_cache_key(pod)
         sig = _req_sig(req)
         reused = 0
+        # per-call oracle switches: the full-verify env knobs, or this
+        # call drew the 1-in-N sampled-verify straw
+        sampled = self._verify_sample > 0 and \
+            next(self._verify_ctr) % self._verify_sample == 0
+        verify_serves = self._verify_serves or sampled
+        verify_index = self._verify_index or sampled
         verify: list[tuple[str, tuple[int, int], int | None]] = []
         joined_scores: dict[str, int | None] = {}
         joined_errors: dict[str, str] = {}
@@ -389,7 +427,7 @@ class SchedulerCache:
                         reused += 1
                         if provenance is not None:
                             provenance[n] = "memo"
-                        if self._verify_serves and n in entry.scores:
+                        if verify_serves and n in entry.scores:
                             verify.append((n, stamp, entry.scores[n]))
                     else:
                         if n in entry.scores or n in entry.errors:
@@ -427,7 +465,7 @@ class SchedulerCache:
                                 joined_errors[n] = sig_entry.errors[n]
                             else:
                                 joined_scores[n] = sig_entry.scores[n]
-                                if self._verify_serves:
+                                if verify_serves:
                                     verify.append(
                                         (n, st, sig_entry.scores[n]))
                             joined_stamps[n] = st
@@ -531,7 +569,7 @@ class SchedulerCache:
         if pruned:
             out[0].update(dict.fromkeys(pruned, None))
         self._verify_served(verify, req)
-        self._verify_pruned(pruned, req)
+        self._verify_pruned(pruned, req, enabled=verify_index)
         return out
 
     def _compute_missing(self, missing: list[str], req: PlacementRequest,
@@ -573,16 +611,24 @@ class SchedulerCache:
         return scores, fetch_errors, node_errors, stamps
 
     def _verify_pruned(self, pruned: dict[str, tuple[tuple[int, int], str]],
-                       req: PlacementRequest) -> None:
-        """TPUSHARE_INDEX_VERIFY: full-scan every index-pruned node; if
-        the node has not moved past the summary's stamp, the scan must
-        agree there is no placement — one that places is a stale prune
-        (a wrongly rejected schedulable node) and increments
-        INDEX_STALE_SERVES."""
-        if not pruned or not self._verify_index:
+                       req: PlacementRequest,
+                       enabled: bool | None = None) -> None:
+        """TPUSHARE_INDEX_VERIFY (or this call's sampled-verify draw):
+        full-scan every index-pruned node; if the node has not moved
+        past the summary's stamp, the scan must agree there is no
+        placement — one that places is a stale prune (a wrongly
+        rejected schedulable node) and increments INDEX_STALE_SERVES."""
+        if enabled is None:
+            enabled = self._verify_index
+        if not pruned or not enabled:
             return
         from tpushare.core.native import engine as native_engine
 
+        # batched: ONE engine call for every still-valid pruned node —
+        # per-node score_fleet calls each paid full marshalling, which
+        # made the oracle too expensive to sample in production
+        entries: list[tuple[str, tuple[int, int], str]] = []
+        fleet = []
         for name, (stamp, bucket) in pruned.items():
             info = self._nodes.get(name)
             if info is None:
@@ -591,8 +637,12 @@ class SchedulerCache:
             if now_stamp != stamp:
                 continue  # node moved after the verdict; a fresh scan
                 # would legitimately differ — not a staleness verdict
-            fresh = native_engine.score_fleet([(snap, info.topology)],
-                                              req)[0]
+            entries.append((name, stamp, bucket))
+            fleet.append((snap, info.topology))
+        if not entries:
+            return
+        for (name, stamp, bucket), fresh in zip(
+                entries, native_engine.score_fleet(fleet, req)):
             if fresh is not None:
                 INDEX_STALE_SERVES.inc()
                 log.error("capacity index pruned %s (%s) but the full "
@@ -609,6 +659,9 @@ class SchedulerCache:
             return
         from tpushare.core.native import engine as native_engine
 
+        # batched like _verify_pruned: one engine call, not one per node
+        entries: list[tuple[str, tuple[int, int], int | None]] = []
+        fleet = []
         for name, stamp, score in served:
             info = self._nodes.get(name)
             if info is None:
@@ -617,12 +670,16 @@ class SchedulerCache:
             if now_stamp != stamp:
                 continue  # node moved after the serve; recompute would
                 # legitimately differ — not a staleness verdict
-            fresh = native_engine.score_fleet([(snap, info.topology)],
-                                              req)[0]
+            entries.append((name, stamp, score))
+            fleet.append((snap, info.topology))
+        if not entries:
+            return
+        for (name, stamp, score), fresh in zip(
+                entries, native_engine.score_fleet(fleet, req)):
             if fresh != score:
                 MEMO_STALE_SERVES.inc()
                 log.error("memo served stale score for %s: served %s, "
-                          "fresh %s at stamp %d", name, score, fresh,
+                          "fresh %s at stamp %s", name, score, fresh,
                           stamp)
 
     def memo_best_placement(self, pod: dict[str, Any],

@@ -440,6 +440,17 @@ class CapacityIndex:
         with self._lock:
             return name in self._summaries
 
+    def summaries_snapshot(self) -> dict[str, tuple[
+            tuple[int, int], bool, tuple[int, ...], tuple[int, ...]]]:
+        """``name -> (stamp, non_tpu, n_ge, contig_ge)`` for every
+        resident summary — the fleet-health sampler's raw material
+        (obs/fleetwatch.py derives the per-tier schedulable-chip and
+        stranded-HBM gauges from this). One dict copy under the lock;
+        the value tuples are immutable and safe to share."""
+        with self._lock:
+            return {name: (s.stamp, s.non_tpu, s.n_ge, s.contig_ge)
+                    for name, s in self._summaries.items()}
+
     def describe(self) -> dict[str, Any]:
         with self._lock:
             return {
@@ -450,10 +461,21 @@ class CapacityIndex:
 
     # -- self-audit (property tests + debugging) ------------------------------
 
-    def audit(self) -> list[str]:
-        """Compare every resident summary and bucket membership against
-        a from-scratch rebuild of the node's CURRENT state. Call after
-        flush() in a quiesced test — any string returned is a bug."""
+    def audit(self, names: Iterable[str] | None = None) -> list[str]:
+        """Compare resident summaries and bucket membership against a
+        from-scratch rebuild of each node's CURRENT state. With
+        ``names=None`` (quiesced tests) every node plus the full bucket
+        and prune-map tables are checked — any string returned is a bug.
+        With ``names`` given (the continuous drift auditor's
+        budget-bounded sweep) only those nodes are checked, per-name in
+        O(tiers + resident prune maps), and a stamp mismatch is benign
+        while the node is still dirty or its summary was concurrently
+        replaced (the push-maintenance protocol at work, not drift) —
+        only a moved node with NO dirty mark and the SAME resident
+        summary is reported, because that means a mutation escaped the
+        ``_on_mutate`` hook."""
+        if names is not None:
+            return self._audit_subset(list(names))
         problems: list[str] = []
         with self._lock:
             names = list(self._summaries)
@@ -510,6 +532,69 @@ class CapacityIndex:
                             f"summary")
                 for name, s in self._summaries.items():
                     want = None if s.non_tpu else self._map_verdict(m, s)
+                    if m.get(name) != want:
+                        problems.append(
+                            f"{name}: prune map {mkey} has "
+                            f"{m.get(name)}, rebuild says {want}")
+        return problems
+
+    def _audit_subset(self, names: list[str]) -> list[str]:
+        """Per-name audit (see :meth:`audit`): summary vs rebuild,
+        bucket membership, and resident prune-map verdicts for exactly
+        ``names`` — safe to run continuously against live traffic."""
+        problems: list[str] = []
+        for name in names:
+            info = self._resolver(name)
+            with self._lock:
+                s = self._summaries.get(name)
+                dirty = name in self._dirty
+            if s is None:
+                continue  # uncovered (non-TPU or not yet flushed)
+            if info is None:
+                if not dirty:
+                    problems.append(
+                        f"{name}: summary for an untracked node")
+                continue
+            stamp, snap = info.stamped_snapshot()
+            if s.stamp != stamp:
+                # moved since summarize(). The mutation hook runs under
+                # the node lock BEFORE the new stamp is observable, so
+                # by now the node must be dirty (or a concurrent flush
+                # already installed a fresh summary) — anything else
+                # means a mutation bypassed _on_mutate.
+                with self._lock:
+                    benign = name in self._dirty \
+                        or self._summaries.get(name) is not s
+                if not benign:
+                    problems.append(
+                        f"{name}: summary stale at {s.stamp} vs node "
+                        f"{stamp} with no dirty mark (mutation escaped "
+                        f"the index hook)")
+                continue
+            fresh = summarize(stamp, snap, info.topology, info.chip_count)
+            if (s.non_tpu, s.n_ge, s.contig_ge) != \
+                    (fresh.non_tpu, fresh.n_ge, fresh.contig_ge):
+                problems.append(
+                    f"{name}: summary diverged from rebuild: "
+                    f"{(s.n_ge, s.contig_ge)} != "
+                    f"{(fresh.n_ge, fresh.contig_ge)}")
+                continue
+            if s.non_tpu:
+                continue
+            with self._lock:
+                if self._summaries.get(name) is not s:
+                    continue  # replaced mid-check; next sweep sees it
+                for ti in range(len(TIERS) + 1):
+                    for kind, cap in (("contig", s.contig_ge[ti]),
+                                      ("count", s.n_ge[ti])):
+                        key = (kind, ti, min(cap, MAX_CAP))
+                        if name not in self._buckets.get(key, ()):
+                            problems.append(
+                                f"{name}: missing from bucket {key}")
+                for mkey, m in self._prune_maps.items():
+                    if m.gen != self._gen:
+                        continue  # detached map; rebuilt before serving
+                    want = self._map_verdict(m, s)
                     if m.get(name) != want:
                         problems.append(
                             f"{name}: prune map {mkey} has "

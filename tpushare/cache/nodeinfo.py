@@ -870,6 +870,28 @@ class NodeInfo:
 
     # -- metrics / inspect -----------------------------------------------------
 
+    def hbm_usage(self) -> tuple[int, int]:
+        """(used, total) HBM MiB in one lock acquisition — the fleet
+        sampler's utilization read (describe() builds per-pod trees,
+        far too heavy to call per node per sample at fleet scale)."""
+        with self._lock:
+            return (sum(c.used_hbm_mib for c in self.chips),
+                    self.hbm_per_chip * self.chip_count)
+
+    def audit_snapshot(self) -> tuple[tuple[int, int],
+                                      list[dict[int, int]]]:
+        """(stamp, per-chip {pod key -> CONFIRMED hbm}) for the drift
+        auditor. Reserved (bind-in-flight) entries are EXCLUDED on
+        purpose: a reservation has no apiserver annotation yet, so
+        counting it would flag every concurrent bind as cache drift.
+        The stamp lets the auditor discard comparisons that raced a
+        mutation instead of reporting transient state."""
+        with self._lock:
+            return (self._epoch, self._version), [
+                {uid: hbm for uid, hbm, reserved in c.entries()
+                 if not reserved}
+                for c in self.chips]
+
     def describe(self, pod_index: dict[str, dict[str, Any]] | None = None
                  ) -> dict[str, Any]:
         """Inspect-API tree for this node (reference buildNode,

@@ -65,6 +65,16 @@ class ExtenderServer:
         from tpushare.obs.trace import TRACER
         self.tracer = TRACER
         self.explain = ExplainStore()
+        # fleet-health layer (obs/fleetwatch.py): fragmentation/
+        # utilization gauges + the continuous drift auditor behind
+        # GET /inspect/fleet; its scorecard consumes the decision-audit
+        # stream via the ExplainStore observer hook. The background
+        # thread starts with the server (TPUSHARE_FLEETWATCH=0 opts out).
+        from tpushare.obs.fleetwatch import FleetWatch
+        self.fleetwatch = FleetWatch(cache, cluster=cluster,
+                                     informer=informer)
+        self.explain.observer = self.fleetwatch.scorecard
+        self.fleetwatch.attach(self.registry)
         # multi-host gang placement (docs/designs/multihost-gang.md):
         # engages only for pods carrying the gang annotations, on nodes
         # labeled into slices — zero cost otherwise
@@ -225,6 +235,10 @@ class ExtenderServer:
                     elif self.path.startswith("/inspect/explain") or \
                             self.path.startswith(f"{PREFIX}/inspect/explain"):
                         self._serve_explain()
+                    elif self.path == "/inspect/fleet" or \
+                            self.path == f"{PREFIX}/inspect/fleet":
+                        self._reply(200,
+                                    server_self.fleetwatch.snapshot())
                     elif self.path == f"{PREFIX}/inspect" or \
                             self.path == f"{PREFIX}/inspect/":
                         self._reply(200, server_self.inspect_handler.handle())
@@ -321,6 +335,11 @@ class ExtenderServer:
 
     # -- lifecycle ------------------------------------------------------------
 
+    def _start_fleetwatch(self) -> None:
+        import os
+        if os.environ.get("TPUSHARE_FLEETWATCH", "1") != "0":
+            self.fleetwatch.start()
+
     def start(self) -> int:
         """Bind and serve on a background thread; returns the bound port."""
         from tpushare.core import native as native_engine
@@ -331,6 +350,7 @@ class ExtenderServer:
         t = threading.Thread(target=self._httpd.serve_forever,
                              name="tpushare-http", daemon=True)
         t.start()
+        self._start_fleetwatch()
         log.info("extender listening on %s:%d", self.host, self.port)
         return self.port
 
@@ -339,10 +359,12 @@ class ExtenderServer:
         native_engine.warmup()
         self._httpd = ThreadingHTTPServer(
             (self.host, self.port), self._make_handler())
+        self._start_fleetwatch()
         log.info("extender listening on %s:%d", self.host, self.port)
         self._httpd.serve_forever()
 
     def stop(self) -> None:
+        self.fleetwatch.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

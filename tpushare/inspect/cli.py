@@ -1,4 +1,16 @@
-"""Render the cluster TPU allocation tree as a terminal table."""
+"""Render the cluster TPU allocation tree as a terminal table, plus
+the operator subcommands over the extender's diagnostic endpoints:
+
+    tpushare-inspect                   # allocation table (default)
+    tpushare-inspect <node>            # one node, per-chip detail
+    tpushare-inspect fleet             # /inspect/fleet health snapshot
+    tpushare-inspect explain [<pod>]   # /inspect/explain decision audit
+    tpushare-inspect traces [-n N]     # /debug/traces flight recorder
+
+No hand-rolled curl: every JSON surface the extender serves has a CLI
+verb (the fleet/explain/traces trio is rendered for terminals; raw
+JSON is one `--json` away).
+"""
 
 from __future__ import annotations
 
@@ -9,12 +21,17 @@ import urllib.request
 from typing import Any
 
 
-def fetch(endpoint: str, node: str | None = None) -> dict[str, Any]:
-    url = endpoint.rstrip("/") + "/tpushare-scheduler/inspect"
-    if node:
-        url += f"/{node}"
-    with urllib.request.urlopen(url, timeout=10) as r:
+def fetch_path(endpoint: str, path: str) -> Any:
+    with urllib.request.urlopen(endpoint.rstrip("/") + path,
+                                timeout=10) as r:
         return json.loads(r.read())
+
+
+def fetch(endpoint: str, node: str | None = None) -> dict[str, Any]:
+    path = "/tpushare-scheduler/inspect"
+    if node:
+        path += f"/{node}"
+    return fetch_path(endpoint, path)
 
 
 def _fmt_row(cols: list[str], widths: list[int]) -> str:
@@ -70,30 +87,135 @@ def render_table(tree: dict[str, Any], details: bool = False) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(snap: dict[str, Any]) -> str:
+    """Terminal rendering of the /inspect/fleet health snapshot."""
+    lines: list[str] = []
+    util = snap.get("utilization_pct")
+    lines.append(
+        f"fleet: {snap.get('nodes_covered', 0)}/"
+        f"{snap.get('nodes_total', 0)} nodes indexed, "
+        f"{snap.get('used_hbm_mib', 0)}/{snap.get('total_hbm_mib', 0)} "
+        f"MiB used"
+        + (f" ({util}%)" if util is not None else ""))
+    rows = [["TIER", "SCHEDULABLE", "CONTIGUOUS", "STRANDED MiB"]]
+    for label, row in (snap.get("tiers") or {}).items():
+        rows.append([label, str(row["schedulable_chips"]),
+                     str(row["contiguous_chips"]),
+                     str(row["stranded_hbm_mib"])])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines.extend(_fmt_row(r, widths) for r in rows)
+    top = snap.get("top_fragmented") or []
+    lines.append("")
+    if top:
+        lines.append(f"most fragmented nodes "
+                     f"({snap.get('fragmented_nodes', len(top))} with a "
+                     f"stranded gap):")
+        for t in top:
+            lines.append(
+                f"  {t['node']}: {t['stranded_hbm_mib']} MiB stranded at "
+                f"{t['tier']} ({t['eligible_chips']} eligible chips, "
+                f"largest contiguous {t['largest_contiguous']})")
+    else:
+        lines.append("no stranded contiguous capacity")
+    sc = snap.get("scorecard") or {}
+    lines.append("")
+    lines.append(
+        f"scorecard: util {sc.get('time_weighted_util_pct')}% "
+        f"(time-weighted), rejection rate {sc.get('rejection_rate')}, "
+        f"p99 pending age {sc.get('p99_pending_age_s')} s "
+        f"({sc.get('cycles', 0)} cycles, {sc.get('binds', 0)} binds, "
+        f"{sc.get('pending', 0)} pending)")
+    audit = snap.get("audit") or {}
+    drift = audit.get("drift_total") or {}
+    total_drift = sum(drift.values())
+    lines.append(
+        f"drift auditor: {int(audit.get('sweeps_total', 0))} sweeps over "
+        f"{int(audit.get('nodes_total', 0))} nodes, "
+        + (f"DRIFT DETECTED: {drift}" if total_drift
+           else "0 divergences"))
+    return "\n".join(lines)
+
+
+def render_traces(dump: dict[str, Any], limit: int | None = None) -> str:
+    """Terminal rendering of the /debug/traces flight recorder."""
+    lines: list[str] = []
+    traces = dump.get("traces") or []
+    pinned = dump.get("pinned") or []
+    if limit is not None:
+        traces = traces[:limit]
+    lines.append(f"{len(traces)} recent traces, {len(pinned)} pinned "
+                 f"slow, {dump.get('recorded_total', 0)} recorded total")
+    for t in traces:
+        spans = " ".join(
+            f"{s.get('name')}={s.get('duration_ms', 0):.1f}ms"
+            for s in t.get("spans") or [])
+        lines.append(f"  {t.get('trace_id')} [{t.get('outcome')}] "
+                     f"{t.get('duration_ms', 0):.1f}ms  {spans}")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpushare-inspect",
-        description="Show per-node/per-chip TPU HBM allocation")
+        description="Show per-node/per-chip TPU HBM allocation and the "
+                    "extender's diagnostic surfaces (fleet / explain / "
+                    "traces subcommands)")
     ap.add_argument("-d", "--details", action="store_true",
                     help="per-chip and per-pod breakdown")
     ap.add_argument("--endpoint", default="http://127.0.0.1:39999",
                     help="extender base URL")
-    ap.add_argument("node", nargs="?", default=None,
-                    help="restrict to one node")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw JSON instead of a table")
+    ap.add_argument("-n", "--limit", type=int, default=None,
+                    help="traces: show at most N traces")
+    ap.add_argument("target", nargs="*", default=[],
+                    help="node name, or a subcommand: 'fleet', "
+                         "'explain [pod]', 'traces'")
     args = ap.parse_args(argv)
+    cmd = args.target[0] if args.target else None
     try:
-        if args.node:
-            tree = {"nodes": [fetch(args.endpoint, args.node)]}
-            node = tree["nodes"][0]
-            tree["used_hbm_mib"] = node.get("used_hbm_mib", 0)
-            tree["total_hbm_mib"] = node.get("total_hbm_mib", 0)
+        if cmd == "fleet":
+            snap = fetch_path(args.endpoint, "/inspect/fleet")
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_fleet(snap))
+            return 0
+        if cmd == "explain":
+            path = "/inspect/explain"
+            if len(args.target) > 1:
+                path += "/" + args.target[1]
+            try:
+                out = fetch_path(args.endpoint, path)
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    print(f"no decision record for "
+                          f"{args.target[1]!r}", file=sys.stderr)
+                    return 1
+                raise
+            # decision records are nested per-cycle trees; JSON is the
+            # honest rendering (the table would lie by omission)
+            print(json.dumps(out, indent=2))
+            return 0
+        if cmd == "traces":
+            path = "/debug/traces"
+            if args.limit is not None:
+                path += f"?n={args.limit}"
+            dump = fetch_path(args.endpoint, path)
+            print(json.dumps(dump, indent=2) if args.json
+                  else render_traces(dump, args.limit))
+            return 0
+        node = cmd  # plain node name (or None = whole cluster)
+        if node:
+            tree = {"nodes": [fetch(args.endpoint, node)]}
+            n = tree["nodes"][0]
+            tree["used_hbm_mib"] = n.get("used_hbm_mib", 0)
+            tree["total_hbm_mib"] = n.get("total_hbm_mib", 0)
         else:
             tree = fetch(args.endpoint)
     except Exception as e:  # noqa: BLE001 — CLI surface
         print(f"error: cannot reach extender at {args.endpoint}: {e}",
               file=sys.stderr)
         return 1
-    print(render_table(tree, details=args.details or bool(args.node)))
+    print(render_table(tree, details=args.details or bool(node)))
     return 0
 
 

@@ -44,6 +44,21 @@ class ExplainStore:
         self._lock = threading.Lock()
         # pod accounting key -> {"pod": identity, "cycles": deque of records}
         self._pods: OrderedDict[str, dict[str, Any]] = OrderedDict()
+        # decision-stream observer (obs/fleetwatch.Scorecard): gets
+        # filter_recorded(pod_key, ok, candidates) and
+        # bind_recorded(pod_key, outcome) AFTER each record lands —
+        # called outside the lock, and a broken observer must never
+        # take a webhook down with it
+        self.observer: Any = None
+
+    def _notify(self, method: str, *args) -> None:
+        obs = self.observer
+        if obs is None:
+            return
+        try:
+            getattr(obs, method)(*args)
+        except Exception:  # noqa: BLE001 — observability must not bite
+            pass
 
     # -- recording ------------------------------------------------------------
 
@@ -78,14 +93,15 @@ class ExplainStore:
         """``nodes`` maps every candidate node to its verdict dict:
         ``{"verdict": "ok"|"rejected", "score": int|None,
         "reason": str|None, "source": "memo"|"computed"|None}``."""
+        ok = sum(1 for v in nodes.values() if v.get("verdict") == "ok")
         with self._lock:
             rec = self._entry(pod_key, pod, trace_id)
             rec["filter"] = {
                 "candidates": len(nodes),
-                "ok": sum(1 for v in nodes.values()
-                          if v.get("verdict") == "ok"),
+                "ok": ok,
                 "nodes": nodes,
             }
+        self._notify("filter_recorded", pod_key, ok, len(nodes))
 
     def record_prioritize(self, pod_key: str, pod: dict[str, Any] | None,
                           trace_id: str | None,
@@ -107,6 +123,7 @@ class ExplainStore:
                 "error": error or None,
                 "chip_ids": chip_ids,
             }
+        self._notify("bind_recorded", pod_key, outcome)
 
     # -- queries --------------------------------------------------------------
 

@@ -1,0 +1,574 @@
+"""Fleet-health observability: fragmentation telemetry, continuous
+drift auditing, and the placement-quality scorecard.
+
+The paper's core argument makes device sharing a *vector* accounting
+problem whose truth lives in the extender's cache, not the apiserver —
+which creates exactly two failure modes node-level counters cannot see:
+
+1. **silent cache drift** — the cache's per-chip accounting (or the
+   capacity index derived from it) quietly diverges from apiserver
+   truth, and every verdict after that is built on sand;
+2. **stranded contiguous capacity** — aggregate free HBM looks healthy
+   while no contiguous sub-box exists ("4 free chips with no free 2x2",
+   docs/pd.md §1.3), so multi-chip pods starve on a fleet that reports
+   plenty of room.
+
+:class:`FleetWatch` watches both, continuously, from one background
+thread, and answers the fleet-level questions PR 4's per-cycle tracing
+cannot: *is the cache still the truth?* and *how much capacity is
+stranded?* Three cooperating parts:
+
+- **Fragmentation/utilization sampler** — reads the capacity index's
+  per-tier summaries (:meth:`CapacityIndex.summaries_snapshot`, one
+  dict copy, no fleet walk) and aggregates per free-HBM tier: total
+  schedulable chips, total largest-contiguous chips, and the
+  **stranded-HBM gap** = (aggregate-fit − largest-contiguous-fit)
+  chips × the tier's MiB — per node, fleet-aggregated per tier, and as
+  a top-k most-fragmented-nodes view. Published as cardinality-capped
+  gauges on ``/metrics`` (tier labels are a closed 9-value enum) and in
+  full on ``GET /inspect/fleet``.
+- **Continuous drift auditor** — a budget-bounded reconciler: each
+  sweep samples N nodes round-robin, compares the cache's CONFIRMED
+  per-chip accounting (:meth:`NodeInfo.audit_snapshot`; in-flight
+  reservations excluded) against informer/apiserver truth, and runs the
+  capacity index's from-scratch-rebuild audit on the same nodes
+  (:meth:`CapacityIndex.audit` with ``names=``). Divergences are
+  double-checked after a short delay (watch lag and mid-bind windows
+  are transient; drift persists) and stamp-guarded (a node that mutated
+  during the comparison is skipped, not reported), then counted in
+  ``tpushare_cache_drift_total{kind}`` — which MUST stay 0 on a healthy
+  system and is bench-enforced to stay 0 on the clean run.
+- **Placement-quality scorecard** — time-weighted utilization,
+  rejection rate, and p99 pending age, computed from the decision-audit
+  stream (the :class:`~tpushare.obs.explain.ExplainStore` observer
+  hook) plus the sampler's utilization integral. The same schema is
+  emitted by ``tpushare/sim`` reports and published (with self-checks)
+  by ``bench.py``'s ``fleet_health`` section — the shared currency the
+  defrag rebalancer and trace-replay wind tunnel (ROADMAP items 3/5)
+  will be judged in.
+
+Knobs: ``TPUSHARE_FLEETWATCH=0`` disables the background thread
+entirely; ``TPUSHARE_FLEETWATCH_PERIOD_S`` (default 5) paces the
+sampler; ``TPUSHARE_AUDIT_PERIOD_S`` (default 30) and
+``TPUSHARE_AUDIT_SAMPLE`` (default 8 nodes/sweep) bound the auditor;
+``TPUSHARE_AUDIT_RECHECK_S`` (default 0.25) is the transient-vs-drift
+settle delay. The related ``TPUSHARE_VERIFY_SAMPLE=N`` (read by
+SchedulerCache) runs the index/memo verify oracles on 1-in-N decisions.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable
+
+from tpushare import contract
+from tpushare.cache.index import EXCL_TIER, TIERS, tier_label
+from tpushare.contract import pod as podlib
+from tpushare.metrics import Counter, LabeledCounter
+
+# drift kinds are a CLOSED enum (label cardinality):
+#   ghost_pod    — the cache accounts a pod the apiserver doesn't have
+#   missing_pod  — the apiserver holds a bound, annotated pod the cache
+#                  doesn't account
+#   chip_usage   — both sides know the pod but disagree on per-chip HBM
+#   index_summary — a capacity-index summary/bucket/prune-map diverged
+#                  from a from-scratch rebuild of the node's state
+DRIFT_KINDS = ("ghost_pod", "missing_pod", "chip_usage", "index_summary")
+
+CACHE_DRIFT = LabeledCounter(
+    "tpushare_cache_drift_total",
+    "Persistent cache-vs-truth divergences found by the continuous "
+    "drift auditor, by kind (ghost_pod / missing_pod / chip_usage = "
+    "NodeInfo accounting vs apiserver truth; index_summary = capacity "
+    "index vs from-scratch rebuild). MUST stay 0 — nonzero means "
+    "scheduling verdicts are being derived from wrong state",
+    ("kind",))
+AUDIT_SWEEPS = Counter(
+    "tpushare_audit_sweeps_total",
+    "Drift-auditor sweeps completed (each samples a bounded number of "
+    "nodes; alert if this stalls while the extender serves traffic — "
+    "a dead auditor means drift would go unnoticed)")
+AUDIT_NODES = Counter(
+    "tpushare_audit_nodes_total",
+    "Nodes examined by drift-auditor sweeps (sweeps x sample size; "
+    "divide by fleet size for the full-fleet coverage period)")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def tier_mib(tier: int, hbm_per_chip: int) -> int:
+    """MiB value a stranded chip represents at ``tier`` (the exclusive
+    pseudo-tier strands the whole chip)."""
+    return hbm_per_chip if tier == EXCL_TIER else TIERS[tier]
+
+
+def stranded_gap_mib(n_ge: tuple[int, ...], contig_ge: tuple[int, ...],
+                     hbm_per_chip: int) -> list[int]:
+    """Per-tier stranded-HBM gap for one node: chips that pass the
+    aggregate (count) fit at the tier but sit outside the largest
+    contiguous sub-box, valued at the tier's MiB. This is the
+    conservative lower bound on capacity a contiguous request at that
+    tier cannot reach even though counters say it exists — the number
+    the defrag rebalancer (ROADMAP item 3) exists to drive down."""
+    return [(n_ge[t] - contig_ge[t]) * tier_mib(t, hbm_per_chip)
+            for t in range(len(TIERS) + 1)]
+
+
+class Scorecard:
+    """Placement-quality scorecard over the decision-audit stream.
+
+    Consumes the :class:`ExplainStore` observer callbacks (every Filter
+    verdict and Bind outcome the extender records) plus the sampler's
+    utilization readings, and reduces them to three numbers:
+
+    - ``time_weighted_util_pct`` — integral of used/total HBM over the
+      observation window (the honest capacity number, same definition
+      as ``tpushare/sim``'s ``util_pct``);
+    - ``rejection_rate`` — fraction of Filter cycles that admitted NO
+      node (the pod stayed pending that cycle);
+    - ``p99_pending_age_s`` — p99 of first-Filter-to-successful-Bind
+      age over completed placements.
+    """
+
+    MAX_PENDING = 4096   # first-seen entries kept (LRU beyond)
+    MAX_AGES = 4096      # completed pending ages kept for quantiles
+
+    def __init__(self, time_fn: Callable[[], float] = time.monotonic
+                 ) -> None:
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._first_seen: OrderedDict[str, float] = OrderedDict()
+        self._ages: list[float] = []
+        self.cycles = 0
+        self.rejected_cycles = 0
+        self.binds = 0
+        self.bind_failures = 0
+        self._util_integral = 0.0   # MiB * s
+        self._util_span = 0.0       # s (over nonzero-capacity samples)
+        self._last_util: tuple[float, float] | None = None  # (t, frac)
+
+    # -- ExplainStore observer protocol ---------------------------------------
+
+    def filter_recorded(self, pod_key: str, ok: int,
+                        candidates: int) -> None:
+        now = self._time()
+        with self._lock:
+            self.cycles += 1
+            if ok == 0:
+                self.rejected_cycles += 1
+            if pod_key not in self._first_seen:
+                self._first_seen[pod_key] = now
+                while len(self._first_seen) > self.MAX_PENDING:
+                    self._first_seen.popitem(last=False)
+
+    def bind_recorded(self, pod_key: str, outcome: str) -> None:
+        now = self._time()
+        with self._lock:
+            if outcome != "bound":
+                self.bind_failures += 1
+                return
+            self.binds += 1
+            born = self._first_seen.pop(pod_key, None)
+            if born is not None:
+                self._ages.append(now - born)
+                if len(self._ages) > self.MAX_AGES:
+                    del self._ages[:len(self._ages) - self.MAX_AGES]
+
+    # -- utilization integral (fed by the sampler) ----------------------------
+
+    def util_sample(self, used_mib: float, total_mib: float) -> None:
+        now = self._time()
+        frac = used_mib / total_mib if total_mib else 0.0
+        with self._lock:
+            if self._last_util is not None:
+                t0, f0 = self._last_util
+                dt = max(now - t0, 0.0)
+                # trapezoid over the sample interval
+                self._util_integral += (f0 + frac) / 2.0 * dt
+                self._util_span += dt
+            self._last_util = (now, frac)
+
+    # -- report ---------------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            ages = sorted(self._ages)
+            # same sorted-percentile idiom as bench.py's latency report
+            p99 = ages[min(len(ages) - 1, int(len(ages) * 0.99))] \
+                if ages else None
+            util = (self._util_integral / self._util_span * 100.0
+                    if self._util_span > 0 else None)
+            return {
+                "time_weighted_util_pct":
+                    round(util, 4) if util is not None else None,
+                "rejection_rate": round(
+                    self.rejected_cycles / self.cycles, 4)
+                if self.cycles else None,
+                "p99_pending_age_s":
+                    round(p99, 4) if p99 is not None else None,
+                "cycles": self.cycles,
+                "rejected_cycles": self.rejected_cycles,
+                "binds": self.binds,
+                "bind_failures": self.bind_failures,
+                "pending": len(self._first_seen),
+            }
+
+
+class FleetWatch:
+    """The fleet-health layer: sampler + drift auditor + scorecard.
+
+    Wired by the extender server (one per process registry); usable
+    standalone in tests and bench — every sweep/sample is a plain
+    synchronous method, and the background thread is just a pacing
+    loop over them.
+    """
+
+    TOP_K = 5  # most-fragmented nodes kept in the /inspect/fleet view
+
+    def __init__(self, cache, cluster=None, informer=None,
+                 pods_for_node: Callable[[str], list] | None = None,
+                 period_s: float | None = None,
+                 audit_period_s: float | None = None,
+                 audit_sample: int | None = None,
+                 recheck_s: float | None = None,
+                 time_fn: Callable[[], float] = time.monotonic) -> None:
+        self._cache = cache
+        self._cluster = cluster
+        self._time = time_fn
+        if pods_for_node is not None:
+            self._pods_for_node = pods_for_node
+        elif informer is not None:
+            self._pods_for_node = informer.pods.on_node
+        elif cluster is not None:
+            self._pods_for_node = \
+                lambda n: cluster.list_pods(node_name=n)
+        else:
+            self._pods_for_node = None
+        self.period_s = _env_float("TPUSHARE_FLEETWATCH_PERIOD_S", 5.0) \
+            if period_s is None else period_s
+        self.audit_period_s = _env_float("TPUSHARE_AUDIT_PERIOD_S", 30.0) \
+            if audit_period_s is None else audit_period_s
+        if audit_sample is None:
+            audit_sample = int(_env_float("TPUSHARE_AUDIT_SAMPLE", 8))
+        self.audit_sample = max(audit_sample, 1)
+        self.recheck_s = _env_float("TPUSHARE_AUDIT_RECHECK_S", 0.25) \
+            if recheck_s is None else recheck_s
+        self.scorecard = Scorecard(time_fn=time_fn)
+        self._lock = threading.Lock()
+        self._sample: dict[str, Any] | None = None
+        self._sample_at: float | None = None
+        self._last_audit: dict[str, Any] | None = None
+        self._audit_cursor = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- fragmentation / utilization sampler ----------------------------------
+
+    def sample_fleet(self) -> dict[str, Any]:
+        """One sampler pass: flush the index so summaries are current,
+        aggregate per-tier capability + the stranded-HBM gap, rank the
+        top-k most-fragmented nodes, and feed the scorecard's
+        utilization integral. O(covered nodes), no apiserver I/O."""
+        index = self._cache.index
+        index.flush()
+        summaries = index.summaries_snapshot()
+        n_tiers = len(TIERS) + 1
+        sched = [0] * n_tiers
+        contig = [0] * n_tiers
+        stranded = [0] * n_tiers
+        per_node: list[dict[str, Any]] = []
+        used_mib = 0
+        total_mib = 0
+        covered = 0
+        for name, (_stamp, non_tpu, n_ge, contig_ge) in summaries.items():
+            info = self._cache.peek_node(name)
+            if info is None or non_tpu:
+                continue
+            covered += 1
+            u, t = info.hbm_usage()
+            used_mib += u
+            total_mib += t
+            gaps = stranded_gap_mib(n_ge, contig_ge, info.hbm_per_chip)
+            worst_t = max(range(n_tiers), key=lambda ti: gaps[ti])
+            for ti in range(n_tiers):
+                sched[ti] += n_ge[ti]
+                contig[ti] += contig_ge[ti]
+                stranded[ti] += gaps[ti]
+            if gaps[worst_t] > 0:
+                per_node.append({
+                    "node": name,
+                    "stranded_hbm_mib": gaps[worst_t],
+                    "tier": tier_label(worst_t),
+                    "eligible_chips": n_ge[worst_t],
+                    "largest_contiguous": contig_ge[worst_t],
+                })
+        per_node.sort(key=lambda r: -r["stranded_hbm_mib"])
+        sample = {
+            "nodes_covered": covered,
+            "nodes_total": len(self._cache.node_names()),
+            "used_hbm_mib": used_mib,
+            "total_hbm_mib": total_mib,
+            "utilization_pct": round(100.0 * used_mib / total_mib, 4)
+            if total_mib else None,
+            "tiers": {
+                tier_label(ti): {
+                    "schedulable_chips": sched[ti],
+                    "contiguous_chips": contig[ti],
+                    "stranded_hbm_mib": stranded[ti],
+                } for ti in range(n_tiers)},
+            "fragmented_nodes": len(per_node),
+            "top_fragmented": per_node[:self.TOP_K],
+        }
+        self.scorecard.util_sample(used_mib, total_mib)
+        with self._lock:
+            self._sample = sample
+            self._sample_at = self._time()
+        return sample
+
+    # -- continuous drift auditor ---------------------------------------------
+
+    def _expected_chips(self, name: str, info) -> list[dict[str, int]] | None:
+        """Per-chip {pod key -> hbm} derived from informer/apiserver
+        truth for ``name``: live, bound, chip-annotated pods only.
+        None = the truth source failed (degraded apiserver — skip the
+        node rather than invent drift)."""
+        if self._pods_for_node is None:
+            return None
+        try:
+            pods = self._pods_for_node(name) or []
+        except Exception:  # noqa: BLE001 — auditing must never crash
+            return None
+        expected: list[dict[str, int]] = [
+            {} for _ in range(info.chip_count)]
+        for pod in pods:
+            if contract.is_complete_pod(pod):
+                continue
+            if podlib.pod_node_name(pod) != name:
+                continue
+            ids = contract.chip_ids_from_annotations(pod)
+            if ids is None:
+                continue
+            hbm = contract.hbm_from_annotations(pod)
+            key = podlib.pod_cache_key(pod)
+            for cid in ids:
+                if 0 <= cid < len(expected):
+                    expected[cid][key] = hbm
+        return expected
+
+    def _compare_node(self, name: str) -> list[tuple[str, str]] | None:
+        """(kind, detail) divergences for one node at one instant, or
+        None when the comparison raced a mutation / truth read failed
+        (transient — the caller just moves on)."""
+        info = self._cache.peek_node(name)
+        if info is None:
+            return []
+        stamp, chips = info.audit_snapshot()
+        expected = self._expected_chips(name, info)
+        if expected is None:
+            return None
+        if info.version != stamp:
+            return None  # node mutated mid-comparison: not a verdict
+        problems: list[tuple[str, str]] = []
+        for idx, (have, want) in enumerate(zip(chips, expected)):
+            for key in have.keys() - want.keys():
+                problems.append((
+                    "ghost_pod",
+                    f"{name}#{idx}: cache holds {key} ({have[key]} MiB) "
+                    f"with no live apiserver placement"))
+            for key in want.keys() - have.keys():
+                problems.append((
+                    "missing_pod",
+                    f"{name}#{idx}: apiserver places {key} "
+                    f"({want[key]} MiB) but the cache does not account "
+                    f"it"))
+            for key in have.keys() & want.keys():
+                if have[key] != want[key]:
+                    problems.append((
+                        "chip_usage",
+                        f"{name}#{idx}: {key} accounted {have[key]} MiB "
+                        f"vs apiserver {want[key]} MiB"))
+        return problems
+
+    def _collect(self, names: list[str]) -> list[tuple[str, str]]:
+        """One pass of both comparisons over ``names``."""
+        problems: list[tuple[str, str]] = []
+        for name in names:
+            p = self._compare_node(name)
+            if p:
+                problems.extend(p)
+        try:
+            index = self._cache.index
+            index.flush()
+            problems.extend(("index_summary", detail)
+                            for detail in index.audit(names=names))
+        except Exception:  # noqa: BLE001 — auditing must never crash
+            pass
+        return problems
+
+    def audit_sweep(self, sample: int | None = None) -> dict[str, Any]:
+        """One budget-bounded sweep: pick the next ``sample`` nodes
+        round-robin, compare cache vs truth and index vs rebuild, and
+        DOUBLE-CHECK any divergence after ``recheck_s`` — watch lag and
+        bind/remove windows clear; real drift persists and is counted
+        per kind in ``tpushare_cache_drift_total``."""
+        names = sorted(self._cache.node_names())
+        k = min(sample or self.audit_sample, len(names))
+        if k <= 0:
+            AUDIT_SWEEPS.inc()
+            return {"nodes_checked": 0, "drift": []}
+        with self._lock:
+            start = self._audit_cursor % len(names)
+            self._audit_cursor = start + k
+        chosen = [names[(start + i) % len(names)] for i in range(k)]
+        first = self._collect(chosen)
+        confirmed: list[tuple[str, str]] = []
+        if first:
+            if self.recheck_s > 0:
+                self._stop.wait(self.recheck_s)
+            second = self._collect(chosen)
+            # identical (kind, detail) on both passes = persistent
+            confirmed = [p for p in second if p in first]
+        for kind, _detail in confirmed:
+            CACHE_DRIFT.inc(kind)
+        AUDIT_SWEEPS.inc()
+        AUDIT_NODES.inc(k)
+        result = {
+            "nodes_checked": k,
+            "nodes": chosen,
+            "drift": [{"kind": kind, "detail": detail}
+                      for kind, detail in confirmed],
+        }
+        with self._lock:
+            self._last_audit = dict(result, at=self._time())
+        return result
+
+    # -- /inspect/fleet -------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """The ``GET /inspect/fleet`` JSON: the latest fragmentation
+        sample (refreshed when stale or absent), the scorecard, and the
+        auditor's counters + last sweep."""
+        with self._lock:
+            sample = self._sample
+            sampled_at = self._sample_at
+            last_audit = self._last_audit
+        now = self._time()
+        if sample is None or sampled_at is None \
+                or now - sampled_at > max(self.period_s, 1.0):
+            sample = self.sample_fleet()
+            with self._lock:
+                sampled_at = self._sample_at
+        drift_totals = {kind: v for (kind,), v
+                        in CACHE_DRIFT.snapshot().items()}
+        return {
+            "sample_age_s": round(now - sampled_at, 3)
+            if sampled_at is not None else None,
+            **sample,
+            "scorecard": self.scorecard.snapshot(),
+            "audit": {
+                "sweeps_total": AUDIT_SWEEPS.value,
+                "nodes_total": AUDIT_NODES.value,
+                "drift_total": drift_totals,
+                "last_sweep": last_audit,
+            },
+        }
+
+    # -- metrics --------------------------------------------------------------
+
+    def attach(self, registry) -> None:
+        """Register the fleet gauges + auditor counters on ``registry``.
+        Gauges serve the sampler's CACHED aggregate — a scrape never
+        walks the fleet (the sampler already did, on its own clock)."""
+        registry.register(CACHE_DRIFT)
+        registry.register(AUDIT_SWEEPS)
+        registry.register(AUDIT_NODES)
+
+        def _tier_rows(field: str):
+            def rows() -> list[tuple[str, float]]:
+                with self._lock:
+                    sample = self._sample
+                if sample is None:
+                    return []
+                return [(f'{{tier="{label}"}}', float(row[field]))
+                        for label, row in sample["tiers"].items()]
+            return rows
+
+        registry.gauge_func(
+            "tpushare_fleet_schedulable_chips",
+            "Fleet-wide chips whose free HBM admits the tier (sum of "
+            "per-node capacity-index eligibility counts; the aggregate-"
+            "fit half of the stranded-capacity story)",
+            _tier_rows("schedulable_chips"))
+        registry.gauge_func(
+            "tpushare_fleet_contiguous_chips",
+            "Fleet-wide chips reachable as each node's largest "
+            "contiguous sub-box at the tier (the contiguous-fit half; "
+            "compare with tpushare_fleet_schedulable_chips)",
+            _tier_rows("contiguous_chips"))
+        registry.gauge_func(
+            "tpushare_fleet_stranded_hbm_mib",
+            "Fleet-aggregated stranded-HBM gap per tier: (aggregate-fit "
+            "minus largest-contiguous-fit) chips x tier MiB — capacity "
+            "counters report free but no contiguous request can reach "
+            "(docs/pd.md §1.3; sustained growth = run the defrag "
+            "rebalancer)",
+            _tier_rows("stranded_hbm_mib"))
+
+        def _nodes() -> list[tuple[str, float]]:
+            with self._lock:
+                sample = self._sample
+            if sample is None:
+                return []
+            return [('{state="covered"}', float(sample["nodes_covered"])),
+                    ('{state="fragmented"}',
+                     float(sample["fragmented_nodes"]))]
+
+        registry.gauge_func(
+            "tpushare_fleet_nodes",
+            "Nodes in the latest fleet-health sample: covered = "
+            "summarized by the capacity index, fragmented = carrying a "
+            "nonzero stranded-HBM gap",
+            _nodes)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "FleetWatch":
+        if self._thread is not None or self.period_s <= 0:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpushare-fleetwatch", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        next_audit = self._time() + self.audit_period_s
+        # first sample eagerly: /inspect/fleet and the gauges answer
+        # from the start instead of waiting out the first period
+        while not self._stop.is_set():
+            try:
+                self.sample_fleet()
+            except Exception:  # noqa: BLE001 — the watch must survive
+                pass
+            if self._time() >= next_audit:
+                try:
+                    self.audit_sweep()
+                except Exception:  # noqa: BLE001
+                    pass
+                next_audit = self._time() + self.audit_period_s
+            self._stop.wait(self.period_s)

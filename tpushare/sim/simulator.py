@@ -243,9 +243,25 @@ class SimReport:
     hp_p99_wait: float = 0.0
     waits: list[float] = field(default_factory=list, repr=False)
 
+    def scorecard(self) -> dict:
+        """The placement-quality scorecard in the SAME schema the live
+        fleet publishes on /inspect/fleet (obs/fleetwatch.Scorecard)
+        and bench.py's fleet_health section self-checks — so simulated
+        policy sweeps and production fleets are compared in one
+        currency (time-weighted utilization, rejection rate, p99
+        pending age)."""
+        return {
+            "time_weighted_util_pct": round(self.util_pct, 4),
+            "rejection_rate": round(self.never_placed / self.pods, 4)
+            if self.pods else None,
+            "p99_pending_age_s": round(self.p99_wait, 4),
+        }
+
     def to_json(self) -> dict:
-        return {k: (round(v, 4) if isinstance(v, float) else v)
-                for k, v in self.__dict__.items() if k != "waits"}
+        out = {k: (round(v, 4) if isinstance(v, float) else v)
+               for k, v in self.__dict__.items() if k != "waits"}
+        out["scorecard"] = self.scorecard()
+        return out
 
 
 def run_sim(fleet: Fleet, trace: list[SimPod],
