@@ -1,0 +1,385 @@
+"""Batched decision cycles (ABI v4): property + protocol tests.
+
+The multi-pod solve is only shippable if three things are falsifiable:
+
+- **disjointness**: the k placements of one batch solve never share a
+  chip on any node, across randomized fleets, meshes, occupancy and
+  request shapes — and the native solve agrees with the Python
+  fallback spec bit-for-bit;
+- **stamp revalidation**: a node mutation between the solve and the
+  bind demotes EXACTLY the affected member to the single-pod path
+  (counted as ``revalidation_demoted``), while the untouched members'
+  speculative placements survive;
+- **apiserver truth**: a concurrent storm with batching enabled ends
+  with zero oversubscription on the fake apiserver's annotations (the
+  same audit the chaos soak applies), because speculative placements
+  are only ever trusted after in-lock revalidation.
+
+Plus the observability contract: a pod served from a batch solve is
+visible as such in /inspect/explain (leader trace id, batch size,
+``source: batched``) and is never presented as individually computed.
+"""
+
+import random
+import threading
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.cache.batch import (
+    BATCH_SOLVES, BATCH_WINDOW_PODS, BatchPlanner)
+from tpushare.cache.nodeinfo import request_from_pod
+from tpushare.core.chips import ChipView
+from tpushare.core.native import engine as native_engine
+from tpushare.core.placement import PlacementRequest
+from tpushare.core.topology import MeshTopology
+from tpushare.extender.handlers import (
+    BindHandler, FilterHandler, PrioritizeHandler)
+from tpushare.extender.metrics import Registry
+from tpushare.k8s import FakeCluster
+from tpushare.obs import ExplainStore
+
+HBM = 16384
+
+
+def _random_fleet(rng, n_nodes):
+    meshes = [(4,), (8,), (2, 2), (2, 4), (4, 4), (2, 2, 2)]
+    nodes = []
+    for _ in range(n_nodes):
+        shape = rng.choice(meshes)
+        topo = MeshTopology(shape)
+        n = topo.num_chips
+        nodes.append((
+            [ChipView(idx=j, coords=topo.coords(j), total_hbm_mib=HBM,
+                      used_hbm_mib=rng.choice(
+                          [0, 0, 2048, 4096, 8192, HBM]),
+                      healthy=rng.random() > 0.05)
+             for j in range(n)], topo))
+    return nodes
+
+
+def _assert_disjoint(placed):
+    seen: set[tuple[int, int]] = set()
+    for node_pos, p in placed:
+        for cid in p.chip_ids:
+            assert (node_pos, cid) not in seen, (
+                f"members share chip {cid} on node {node_pos}")
+            seen.add((node_pos, cid))
+
+
+def test_batch_solve_pairwise_disjoint_randomized(native_engine):
+    """k placements from one solve are pairwise chip-disjoint on every
+    node, for random fleets/meshes/occupancy and several request
+    shapes — and the native solve equals the Python spec."""
+    rng = random.Random(20260804)
+    shapes = [
+        PlacementRequest(hbm_mib=2048),
+        PlacementRequest(hbm_mib=4096, chip_count=2),
+        PlacementRequest(hbm_mib=1024, chip_count=4),
+        PlacementRequest(hbm_mib=0, chip_count=1),  # exclusive
+        PlacementRequest(hbm_mib=2048, chip_count=3,
+                         allow_scatter=True),
+    ]
+    for trial in range(8):
+        nodes = _random_fleet(rng, rng.randrange(3, 12))
+        req = shapes[trial % len(shapes)]
+        k = rng.randrange(2, 9)
+        placed = native_engine.solve_batch(nodes, req, k)
+        assert len(placed) <= k
+        _assert_disjoint(placed)
+        spec = native_engine._py_solve_batch(nodes, req, k)
+        assert [(n, p.chip_ids, p.box, p.origin, p.score)
+                for n, p in placed] == \
+            [(n, p.chip_ids, p.box, p.origin, p.score)
+             for n, p in spec], f"native/python divergence (trial {trial})"
+        # every placement must be real: chips eligible on that node
+        for node_pos, p in placed:
+            chips, _topo = nodes[node_pos]
+            by_idx = {c.idx: c for c in chips}
+            for cid in p.chip_ids:
+                c = by_idx[cid]
+                assert c.healthy
+                if req.hbm_mib == 0:
+                    assert c.used_hbm_mib == 0
+                else:
+                    assert c.free_hbm_mib >= req.hbm_mib
+
+
+def test_cache_solve_batch_disjoint_and_stamped():
+    fc = FakeCluster()
+    names = [f"b{i}" for i in range(6)]
+    for n in names:
+        fc.add_tpu_node(n, chips=4, hbm_per_chip_mib=HBM, mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    req = PlacementRequest(hbm_mib=2048)
+    placed = cache.solve_batch(req, names, 8)
+    assert len(placed) == 8
+    seen = set()
+    for node, p, stamp in placed:
+        assert stamp == cache.get_node_info(node).version, \
+            "stamp must be the generation the solve read"
+        for cid in p.chip_ids:
+            assert (node, cid) not in seen
+            seen.add((node, cid))
+    # untouched-node preference: 8 members over 6 nodes touches every
+    # node before any node hosts a second (disjoint) member; the two
+    # overflow members tie-break to the lowest node index
+    per_node = {}
+    for node, _p, _s in placed:
+        per_node[node] = per_node.get(node, 0) + 1
+    assert len(per_node) == 6
+    assert sum(per_node.values()) == 8
+
+
+def test_stamp_mutation_demotes_exactly_the_affected_member():
+    """The revalidation protocol: after a batch solve, mutating node A
+    demotes A's member at its seed lookup (counted revalidation_demoted)
+    while B's member still rides its speculative placement."""
+    fc = FakeCluster()
+    for n in ("da", "db"):
+        fc.add_tpu_node(n, chips=4, hbm_per_chip_mib=HBM, mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    pod_a, pod_b = make_pod(hbm=2048, name="ma"), \
+        make_pod(hbm=2048, name="mb")
+    req = request_from_pod(pod_a)
+    placed = cache.solve_batch(req, ["da", "db"], 2)
+    assert [n for n, _p, _s in placed] == ["da", "db"]
+    for pod, (node, placement, stamp) in zip((pod_a, pod_b), placed):
+        cache.stash_speculative(pod, req, node, placement, stamp)
+
+    # concurrent mutation on da between the solve and member A's bind
+    intruder = make_pod(hbm=1024, name="intruder")
+    fc.create_pod(intruder)
+    cache.get_node_info("da").allocate(intruder, fc)
+
+    demoted0 = BATCH_SOLVES.get("revalidation_demoted")
+    hint_a, stamp_a, spec_a = cache.placement_hint_stamped(pod_a, "da")
+    assert hint_a is None, "mutated member must demote"
+    assert BATCH_SOLVES.get("revalidation_demoted") == demoted0 + 1
+    hint_b, stamp_b, spec_b = cache.placement_hint_stamped(pod_b, "db")
+    assert hint_b is not None and spec_b is True, \
+        "untouched member keeps its speculative placement"
+    assert BATCH_SOLVES.get("revalidation_demoted") == demoted0 + 1, \
+        "only the affected member may be demoted"
+
+
+def test_allocate_in_lock_stamp_recheck_demotes():
+    """The race window between placement_hint_stamped and the node lock
+    is closed INSIDE allocate: a stale stamp passed in makes allocate
+    re-search instead of trusting the speculative chips, and the bind
+    still succeeds."""
+    fc = FakeCluster()
+    fc.add_tpu_node("ra", chips=4, hbm_per_chip_mib=HBM, mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    pod = make_pod(hbm=2048, name="racy")
+    req = request_from_pod(pod)
+    (node, placement, stamp), = cache.solve_batch(req, ["ra"], 1)
+    # mutate after the solve: the captured stamp is now stale
+    intruder = make_pod(hbm=4096, name="squatter")
+    fc.create_pod(intruder)
+    cache.get_node_info("ra").allocate(intruder, fc)
+    created = fc.create_pod(pod)
+    demoted0 = BATCH_SOLVES.get("revalidation_demoted")
+    out = cache.get_node_info("ra").allocate(
+        created, fc, hint=placement, hint_stamp=stamp,
+        hint_speculative=True)
+    assert out is not None
+    assert BATCH_SOLVES.get("revalidation_demoted") == demoted0 + 1
+
+
+def _storm_rig(n_nodes, window_s, max_batch):
+    fc = FakeCluster()
+    names = [f"s{i}" for i in range(n_nodes)]
+    for n in names:
+        fc.add_tpu_node(n, chips=4, hbm_per_chip_mib=HBM, mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    registry = Registry()
+    explain = ExplainStore()
+    batcher = BatchPlanner(cache, window_s=window_s, max_batch=max_batch)
+    flt = FilterHandler(cache, registry, explain=explain,
+                        batcher=batcher)
+    prio = PrioritizeHandler(cache, registry, explain=explain)
+    bind = BindHandler(cache, fc, registry, explain=explain)
+    return fc, names, cache, flt, prio, bind, explain
+
+
+def _apiserver_truth_usage(fc):
+    usage: dict[tuple[str, int], int] = {}
+    for pod in fc.list_pods():
+        node = (pod.get("spec") or {}).get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        if not node or ids is None:
+            continue
+        hbm = contract.hbm_from_annotations(pod)
+        for cid in ids:
+            usage[(node, cid)] = usage.get((node, cid), 0) + hbm
+    return usage
+
+
+def test_batched_storm_zero_oversubscription_on_apiserver_truth():
+    """The chaos-soak audit with batching enabled: concurrent identical
+    pods through the full webhook cycle, bound pods LEFT IN PLACE, and
+    the fake apiserver's chip accounting must never exceed capacity.
+    Speculation is only safe if revalidation holds under real races."""
+    fc, names, cache, flt, prio, bind, _explain = _storm_rig(
+        n_nodes=6, window_s=0.004, max_batch=8)
+    errors: list[str] = []
+    bound = []
+    lock = threading.Lock()
+
+    def worker(w):
+        for i in range(6):
+            pod = fc.create_pod(make_pod(
+                hbm=2048, name=f"st-{w}-{i}", uid=f"uid-st-{w}-{i}"))
+            ok = flt.handle({"Pod": pod, "NodeNames": names})
+            if not ok["NodeNames"]:
+                continue
+            ranked = prio.handle({"Pod": pod,
+                                  "NodeNames": ok["NodeNames"]})
+            top = max(r["Score"] for r in ranked)
+            node = next(r["Host"] for r in ranked if r["Score"] == top)
+            out = bind.handle({
+                "PodName": pod["metadata"]["name"],
+                "PodNamespace": pod["metadata"]["namespace"],
+                "PodUID": pod["metadata"]["uid"], "Node": node})
+            if not out.get("Error"):
+                with lock:
+                    bound.append(pod["metadata"]["name"])
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "storm deadlocked"
+    assert bound, "storm bound nothing"
+    over = {k: v for k, v in _apiserver_truth_usage(fc).items()
+            if v > HBM}
+    assert not over, f"oversubscribed chips on apiserver truth: {over}"
+    assert not errors
+
+
+def test_explain_never_shows_batched_pod_as_computed():
+    fc, names, cache, flt, prio, bind, explain = _storm_rig(
+        n_nodes=4, window_s=0.01, max_batch=4)
+    pods = [fc.create_pod(make_pod(hbm=2048, name=f"e{i}",
+                                   uid=f"uid-e{i}"))
+            for i in range(4)]
+    results = [None] * 4
+
+    def run(i):
+        results[i] = flt.handle({"Pod": pods[i], "NodeNames": names})
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    batched = [i for i in range(4)
+               if results[i] and len(results[i]["NodeNames"]) == 1]
+    assert batched, "window did not coalesce (timing?)"
+    leader_ids = set()
+    for i in batched:
+        rec = explain.get(f"default/e{i}")
+        assert rec is not None
+        cycle = rec["cycles"][-1]
+        assert cycle.get("batch"), "batch membership missing"
+        assert cycle["batch"]["size"] >= 2
+        leader_ids.add(cycle["batch"]["leader_trace_id"])
+        for verdict in cycle["filter"]["nodes"].values():
+            assert verdict.get("source") == "batched"
+            assert verdict.get("source") != "computed"
+    assert len(leader_ids) == 1, \
+        "members of one solve must share the leader trace id"
+
+
+def test_speculative_scores_exempt_from_stale_serve_oracle(monkeypatch):
+    """A same-node sibling's speculative score embeds the batch's
+    disjointness (earlier members' chips left the pool), so a fresh
+    recompute legitimately differs — the memo-verify oracle must not
+    count that as a stale serve (its safety comes from stamp
+    revalidation at bind, not score purity)."""
+    from tpushare.cache import MEMO_STALE_SERVES
+
+    monkeypatch.setenv("TPUSHARE_MEMO_VERIFY", "1")
+    fc = FakeCluster()
+    fc.add_tpu_node("vx", chips=4, hbm_per_chip_mib=HBM, mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    # asymmetric chips: a fresh single-pod select always picks the
+    # tightest chip 0, so a sibling's different-chip score genuinely
+    # disagrees with a recompute (not a vacuous all-equal case)
+    squat = make_pod(hbm=4096, name="vsquat", uid="uid-vsquat",
+                     node="vx",
+                     ann=dict(contract.placement_annotations(
+                         [0], 4096, HBM)))
+    fc.create_pod(squat)
+    cache.add_or_update_pod(squat)
+    pods = [make_pod(hbm=2048, name=f"v{i}", uid=f"uid-v{i}")
+            for i in range(3)]
+    req = request_from_pod(pods[0])
+    placed = cache.solve_batch(req, ["vx"], 3)
+    assert len(placed) == 3  # all on one node, disjoint chips
+    scores_seen = {p.score for _n, p, _s in placed}
+    assert len(scores_seen) > 1, \
+        "setup must produce genuinely divergent sibling scores"
+    for pod, (node, placement, stamp) in zip(pods, placed):
+        cache.stash_speculative(pod, req, node, placement, stamp)
+    # members 2 and 3 carry scores a fresh single-pod select would not
+    # produce; serving them under the verify oracle must not trip it
+    stale0 = MEMO_STALE_SERVES.value
+    for pod in pods:
+        scores, errors = cache.score_nodes(pod, req, ["vx"])
+        assert scores["vx"] is not None and not errors
+    assert MEMO_STALE_SERVES.value == stale0
+
+
+def test_lone_window_runs_solo_and_disabled_planner_is_free():
+    fc = FakeCluster()
+    fc.add_tpu_node("solo", chips=4, hbm_per_chip_mib=HBM, mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    pod = make_pod(hbm=2048, name="alone")
+    req = request_from_pod(pod)
+    solo0 = BATCH_SOLVES.get("solo")
+    planner = BatchPlanner(cache, window_s=0.002, max_batch=8)
+    assert planner.submit(pod, req, ["solo"]) is None
+    assert BATCH_SOLVES.get("solo") == solo0 + 1
+    disabled = BatchPlanner(cache, window_s=0)
+    assert not disabled.enabled
+    assert disabled.submit(pod, req, ["solo"]) is None
+    assert BATCH_SOLVES.get("solo") == solo0 + 1, \
+        "a disabled planner must not touch the counters"
+
+
+def test_window_histogram_observes_batch_size():
+    fc = FakeCluster()
+    for i in range(4):
+        fc.add_tpu_node(f"h{i}", chips=4, hbm_per_chip_mib=HBM,
+                        mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    names = [f"h{i}" for i in range(4)]
+    planner = BatchPlanner(cache, window_s=0.01, max_batch=3)
+    count0 = BATCH_WINDOW_PODS.count
+    pods = [make_pod(hbm=2048, name=f"w{i}", uid=f"uid-w{i}")
+            for i in range(3)]
+    req = request_from_pod(pods[0])
+    out = [None] * 3
+
+    def run(i):
+        out[i] = planner.submit(pods[i], req, names)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert BATCH_WINDOW_PODS.count == count0 + 1
+    assert all(o is not None for o in out), "full window covers everyone"
+    assert {o.batch_size for o in out} == {3}

@@ -88,6 +88,65 @@ def test_native_path_scored_a_fleet(native_engine):
         "fleet scan did not run on the native engine"
 
 
+def test_native_cycle_scored_a_fleet(native_engine):
+    """Tier-1 tripwire (ABI v4 sibling of test_native_path_scored_a_fleet):
+    the loaded engine must carry the v4 end-to-end cycle entry point AND
+    a SchedulerCache scoring pass must actually run it — cycles silently
+    falling back to the v3 score-then-reselect path (stale .so, broken
+    symbol binding) is a perf regression this test turns into a red
+    build. TPUSHARE_NO_CYCLE remains the deliberate opt-out; this test
+    asserts the DEFAULT path."""
+    assert native_engine.available()
+    abi = native_engine.abi_version()
+    assert abi is not None and abi >= 4, \
+        f"loaded .so is ABI {abi} (< 4): tpushare_cycle_fleet is " \
+        f"missing and every cycle runs the v3 score-then-reselect path"
+    assert native_engine.cycle_supported(), \
+        "cycle_fleet symbol not bound — cycles silently run v3; see " \
+        "tpushare_cycle_calls_total{engine}"
+
+    fc, names = fleet(n_nodes=4)
+    cache, flt, prio, _bind = rig(fc)
+    pod = fc.create_pod(make_pod(hbm=2048))
+    before = native_engine.CYCLE_CALLS.get("native")
+    ok = flt.handle({"Pod": pod, "NodeNames": names})["NodeNames"]
+    assert ok == names
+    assert native_engine.CYCLE_CALLS.get("native") == before + 1, \
+        "score_nodes did not run a native end-to-end cycle"
+    # the cycle's placements seed Prioritize's best-placement memo with
+    # ZERO extra engine calls — and the seed must match a from-scratch
+    # selection of the same state
+    prio.handle({"Pod": pod, "NodeNames": ok})
+    hint, stamp, spec = cache.placement_hint_stamped(pod, ok[0])
+    assert hint is not None and stamp is not None and spec is False
+    from tpushare.core.placement import select_chips_py
+
+    info = cache.get_node_info(ok[0])
+    want = select_chips_py(info.snapshot(), info.topology,
+                           request_from_pod(pod))
+    assert (hint.chip_ids, hint.box, hint.origin, hint.score) == \
+        (want.chip_ids, want.box, want.origin, want.score)
+
+
+def test_no_cycle_escape_hatch_matches_default(native_engine, monkeypatch):
+    """TPUSHARE_NO_CYCLE forces the v3 score-then-reselect path; the
+    verdicts must be byte-identical to the default cycle path and the
+    compatibility engine must be attributed in the cycle counter."""
+    fc, names = fleet(n_nodes=6)
+    pod = make_pod(hbm=4096)
+    req = request_from_pod(pod)
+    cache_a, flt_a, _p, _b = rig(fc)
+    scores_a, errors_a = cache_a.score_nodes(pod, req, names)
+
+    monkeypatch.setenv("TPUSHARE_NO_CYCLE", "1")
+    v3_before = native_engine.CYCLE_CALLS.get("v3")
+    cache_b = SchedulerCache(fc)
+    cache_b.build_cache()
+    scores_b, errors_b = cache_b.score_nodes(pod, req, names)
+    assert native_engine.CYCLE_CALLS.get("v3") == v3_before + 1
+    assert (scores_a, errors_a) == (scores_b, errors_b)
+
+
 def test_parallel_scan_matches_serial(native_engine):
     """The sharded scan is a pure partition of the serial one: same
     fleet, same request -> identical scores and fit verdicts, with the

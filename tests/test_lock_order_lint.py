@@ -35,7 +35,10 @@ SCOPES = (
 # chain OR each other, which distinct ranks + "no nesting exists"
 # encode for free.
 RANKS = {
-    ("gang.py", "self._lock"): 5,           # gang coordinator (leftmost)
+    ("batch.py", "self._lock"): 2,          # batch-window table (leftmost:
+    # guards only the pending-window dict and is NEVER held across the
+    # solve or any cache/node call — the leader pops its window first)
+    ("gang.py", "self._lock"): 5,           # gang coordinator
     ("cache.py", "self._stripes.for_key"): 10,   # node-map stripes
     ("index.py", "self._flush_lock"): 15,   # whole-flush serialization
     ("nodeinfo.py", "self._lock"): 20,      # per-node chip state

@@ -1,0 +1,239 @@
+"""Batched decision cycles: coalesce a replica storm into one solve.
+
+The remaining per-pod cost at high bind rates is Python orchestration —
+every pod of a 100-replica storm runs its own Filter fleet pass,
+Prioritize ranking, and Bind chip search even though the pods are
+IDENTICAL (same ``_req_sig`` equivalence class, PR 5). The
+:class:`BatchPlanner` closes that gap: concurrently-arriving pods with
+the same request signature and candidate list are held for a short
+window (``TPUSHARE_BATCH_WINDOW_MS``), then solved TOGETHER by one
+GIL-released native call (ABI v4 ``tpushare_solve_batch`` via
+``SchedulerCache.solve_batch``) that returns k pairwise chip-disjoint
+speculative placements — so the storm costs ~1 placement cycle, not k.
+
+Protocol (the stamp-revalidation story, docs/perf.md "Batched cycles"):
+
+1. the first pod of a signature becomes the window LEADER and waits up
+   to the window for joiners (an early wake fires when the window
+   fills to ``TPUSHARE_BATCH_MAX``);
+2. the leader runs the multi-pod solve and stashes each member's
+   placement into the scheduler cache's memo as a SPECULATIVE entry
+   stamped with the node generation the solve read
+   (``SchedulerCache.stash_speculative``);
+3. each member's Filter answers with exactly its assigned node (the
+   gang-coordinator shape: the extender may return any subset), its
+   Prioritize is a memo dict read, and its Bind seeds allocate from the
+   speculative chips;
+4. **revalidation**: the placement is only trusted while its node
+   stamp still matches — checked at the Bind seed lookup
+   (``placement_hint_stamped``) and again under the node lock inside
+   ``NodeInfo.allocate``. Any concurrent mutation (a sibling's bind, a
+   release, a health flip) demotes exactly that member to the ordinary
+   single-pod path (``outcome=revalidation_demoted``) instead of
+   risking oversubscription. Disjointness plus per-member demotion is
+   what keeps apiserver truth clean with speculation enabled — the
+   chaos-soak audit enforces it.
+
+Members the solve could NOT place (fleet out of capacity) and windows
+that close with one member fall through to the single-pod path
+(``outcome=solo``) — batching is a fast path, never a gate.
+
+Locking: ``self._lock`` guards only the pending-window table and is
+NEVER held across the solve or any cache/node call (the leader pops its
+window first, then solves unlocked) — it nests with nothing, and the
+lock-order lint classifies it leftmost for that reason.
+
+``TPUSHARE_BATCH_WINDOW_MS=0`` (the default) disables batching
+entirely; ``TPUSHARE_BATCH_MAX`` caps members per window.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any
+
+from tpushare.metrics import Histogram, LabeledCounter
+
+# one observation per closed window: how many pods the window coalesced
+# (a storm shows mass at the cap; quiet traffic shows mass at 1)
+BATCH_WINDOW_PODS = Histogram(
+    "tpushare_batch_window_pods",
+    "Pods coalesced per batching window (1 = the window closed with "
+    "only its leader and the pod ran the single-pod path)",
+    (1, 2, 4, 8, 16, 32, 64))
+# per-POD outcome of the batching layer: batched = served a speculative
+# placement from a multi-pod solve, solo = ran the ordinary single-pod
+# path (lone window, solve overflow, planner timeout),
+# revalidation_demoted = a speculative placement was dropped because
+# its node's stamp moved between the solve and the bind
+BATCH_SOLVES = LabeledCounter(
+    "tpushare_batch_solves_total",
+    "Pods through the batching layer by outcome: batched = rode a "
+    "multi-pod solve's speculative placement, solo = single-pod path, "
+    "revalidation_demoted = speculative placement invalidated by a "
+    "concurrent node mutation (demoted to solo at bind time — safe, "
+    "but sustained growth means windows race their own binds)",
+    ("outcome",))
+
+
+@dataclass(frozen=True)
+class SpeculativePlacement:
+    """One member's share of a multi-pod solve, handed back to Filter."""
+
+    node: str
+    score: int
+    batch_size: int
+    leader_trace_id: str | None
+    leader: bool
+
+
+class _Window:
+    """One pending batch: the leader + joiners of a request signature."""
+
+    __slots__ = ("sig", "names", "pods", "trace_ids", "results",
+                 "full", "done", "closed", "leader_trace_id")
+
+    def __init__(self, sig: tuple, names: tuple) -> None:
+        self.sig = sig
+        self.names = names          # candidate tuple (must match to join)
+        self.pods: list[dict[str, Any]] = []
+        self.trace_ids: list[str | None] = []
+        self.results: list[SpeculativePlacement | None] = []
+        self.full = threading.Event()   # wakes the leader early at cap
+        self.done = threading.Event()   # releases joiners after the solve
+        self.closed = False
+        self.leader_trace_id: str | None = None
+
+
+def _sig(req) -> tuple:
+    # keep in lockstep with cache.cache._req_sig (not imported to keep
+    # this module a leaf below cache.py in the import graph)
+    return (req.hbm_mib, req.chip_count, req.topology, req.allow_scatter)
+
+
+class BatchPlanner:
+    """The extender-side batching window over ``SchedulerCache``.
+
+    ``solver`` must provide ``solve_batch(req, node_names, k)`` and
+    ``stash_speculative(pod, req, node, placement, stamp)`` — the
+    scheduler cache does. The planner itself never touches node or memo
+    state directly.
+    """
+
+    def __init__(self, solver, window_s: float | None = None,
+                 max_batch: int | None = None) -> None:
+        if window_s is None:
+            window_s = float(os.environ.get(
+                "TPUSHARE_BATCH_WINDOW_MS", "0") or 0) / 1e3
+        if max_batch is None:
+            try:
+                max_batch = int(os.environ.get("TPUSHARE_BATCH_MAX",
+                                               "32") or 32)
+            except ValueError:
+                max_batch = 32
+        self._solver = solver
+        self.window_s = max(0.0, window_s)
+        self.max_batch = max(1, max_batch)
+        self._lock = threading.Lock()  # pending-window table ONLY
+        self._pending: dict[tuple, _Window] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.window_s > 0
+
+    # -- the one entry point --------------------------------------------------
+
+    def submit(self, pod: dict[str, Any], req, node_names: list[str],
+               trace_id: str | None = None
+               ) -> SpeculativePlacement | None:
+        """Offer ``pod`` to the batching layer; BLOCKS up to ~one window.
+
+        Returns the pod's speculative placement when a multi-pod solve
+        covered it, or ``None`` — run the ordinary single-pod path.
+        """
+        if not self.enabled:
+            return None
+        sig = _sig(req)
+        names = tuple(node_names)
+        joined = leader_w = None
+        slot = 0
+        with self._lock:
+            w = self._pending.get(sig)
+            if w is not None and not w.closed and w.names == names \
+                    and len(w.pods) < self.max_batch:
+                slot = len(w.pods)
+                w.pods.append(pod)
+                w.trace_ids.append(trace_id)
+                if len(w.pods) >= self.max_batch:
+                    w.full.set()
+                joined = w
+            elif w is None or w.closed:
+                leader_w = _Window(sig, names)
+                leader_w.pods.append(pod)
+                leader_w.trace_ids.append(trace_id)
+                leader_w.leader_trace_id = trace_id
+                self._pending[sig] = leader_w
+            # else: an OPEN window this pod cannot join (different
+            # candidate list, or already at the cap) — run solo rather
+            # than stall behind a window that excludes it
+        if joined is not None:
+            # joiner: the leader solves for us; a generous timeout
+            # bounds the stall if the leader dies mid-solve
+            joined.done.wait(timeout=self.window_s * 10 + 1.0)
+            res = joined.results[slot] if slot < len(joined.results) \
+                else None
+            if res is None:
+                BATCH_SOLVES.inc("solo")
+            return res
+        if leader_w is None:
+            BATCH_SOLVES.inc("solo")
+            return None
+        return self._lead(leader_w, req)
+
+    # -- leader ---------------------------------------------------------------
+
+    def _lead(self, w: _Window, req) -> SpeculativePlacement | None:
+        # window close rule: cap reached (the full event), the window
+        # elapsed, OR no new joiner for one quiescence gap — a storm's
+        # stragglers arrive back-to-back, so waiting the whole window
+        # after arrivals stop would just add latency for nothing
+        deadline = time.monotonic() + self.window_s
+        gap = max(self.window_s / 8, 0.0002)
+        size = 1
+        while not w.full.wait(gap):
+            with self._lock:
+                now = len(w.pods)
+            if now == size or time.monotonic() >= deadline:
+                break
+            size = now
+        with self._lock:
+            w.closed = True
+            if self._pending.get(w.sig) is w:
+                del self._pending[w.sig]
+            pods = list(w.pods)
+        k = len(pods)
+        w.results = [None] * k
+        try:
+            BATCH_WINDOW_PODS.observe(k)
+            if k > 1:
+                placed = self._solver.solve_batch(req, list(w.names), k)
+                for m, (node, placement, stamp) in enumerate(placed):
+                    self._solver.stash_speculative(
+                        pods[m], req, node, placement, stamp)
+                    w.results[m] = SpeculativePlacement(
+                        node=node, score=placement.score, batch_size=k,
+                        leader_trace_id=w.leader_trace_id,
+                        leader=(m == 0))
+                BATCH_SOLVES.inc("batched", n=len(placed))
+                if k > len(placed):
+                    BATCH_SOLVES.inc("solo", n=k - len(placed))
+            else:
+                BATCH_SOLVES.inc("solo")
+        finally:
+            # joiners MUST be released even if the solve raised — they
+            # fall back to the single-pod path on a None result
+            w.done.set()
+        return w.results[0]

@@ -66,6 +66,7 @@ from collections import OrderedDict
 from typing import Any
 
 from tpushare import contract
+from tpushare.cache.batch import BATCH_SOLVES
 from tpushare.cache.index import (
     CapacityIndex, INDEX_CANDIDATE_RATIO, INDEX_PRUNED,
     INDEX_STALE_SERVES)
@@ -141,8 +142,9 @@ def memo_node_reuse_rate() -> float | None:
 
 
 class _MemoEntry:
-    __slots__ = ("req_sig", "scores", "errors", "stamps",
-                 "placement_node", "placement", "placement_stamp")
+    __slots__ = ("req_sig", "scores", "errors", "stamps", "placements",
+                 "placement_node", "placement", "placement_stamp",
+                 "speculative")
 
     def __init__(self, req_sig: tuple) -> None:
         self.req_sig = req_sig
@@ -151,9 +153,18 @@ class _MemoEntry:
         # node name -> NodeInfo.version stamp ((epoch, counter) tuple)
         # the score/error was computed at
         self.stamps: dict[str, tuple[int, int]] = {}
+        # node name -> winning Placement from the SAME native cycle that
+        # produced the score (ABI v4): Bind's seed lookup serves from
+        # here instead of re-running the chip search. Valid under the
+        # same per-node stamp as the score; absent on the v3 path.
+        self.placements: dict[str, Placement] = {}
         self.placement_node: str | None = None
         self.placement: Placement | None = None
         self.placement_stamp: tuple[int, int] | None = None
+        # True when `placement` came from a multi-pod batch solve
+        # (speculative: stamp-revalidated at bind; a mismatch counts
+        # tpushare_batch_solves_total{outcome=revalidation_demoted})
+        self.speculative = False
 
 
 def _req_sig(req: PlacementRequest) -> tuple:
@@ -411,6 +422,7 @@ class SchedulerCache:
         joined_scores: dict[str, int | None] = {}
         joined_errors: dict[str, str] = {}
         joined_stamps: dict[str, tuple[int, int]] = {}
+        joined_placements: dict[str, Placement] = {}
         with self._memo_lock:
             entry = self._memo.get(key)
             if entry is not None and entry.req_sig != sig:
@@ -427,13 +439,23 @@ class SchedulerCache:
                         reused += 1
                         if provenance is not None:
                             provenance[n] = "memo"
-                        if verify_serves and n in entry.scores:
+                        # speculative (batch-solved) entries are exempt
+                        # from the stale-serve oracle BY DESIGN: a
+                        # same-node sibling's score embeds the batch's
+                        # disjointness (earlier members' chips removed
+                        # from the pool), so a fresh single-pod
+                        # recompute legitimately differs — that is the
+                        # speculation, not a staleness bug. Safety for
+                        # these comes from stamp revalidation at bind.
+                        if verify_serves and n in entry.scores \
+                                and not entry.speculative:
                             verify.append((n, stamp, entry.scores[n]))
                     else:
                         if n in entry.scores or n in entry.errors:
                             entry.scores.pop(n, None)
                             entry.errors.pop(n, None)
                             entry.stamps.pop(n, None)
+                            entry.placements.pop(n, None)
                             MEMO_DELTA_INVALIDATIONS.inc()
                         missing.append(n)
             full_hit = not missing
@@ -465,6 +487,9 @@ class SchedulerCache:
                                 joined_errors[n] = sig_entry.errors[n]
                             else:
                                 joined_scores[n] = sig_entry.scores[n]
+                                jp = sig_entry.placements.get(n)
+                                if jp is not None:
+                                    joined_placements[n] = jp
                                 if verify_serves:
                                     verify.append(
                                         (n, st, sig_entry.scores[n]))
@@ -508,7 +533,7 @@ class SchedulerCache:
                              nodes_joined=joined,
                              nodes_pruned=len(pruned),
                              nodes_computed=len(to_scan)):
-                scores, fetch_errors, node_errors, stamps = \
+                scores, fetch_errors, node_errors, stamps, placements = \
                     self._compute_missing(to_scan, req, native_engine)
         else:
             # join+prune covered everything: no snapshot was taken and
@@ -516,7 +541,8 @@ class SchedulerCache:
             annotate_current("score_nodes", memo="shared",
                              nodes_reused=reused, nodes_joined=joined,
                              nodes_pruned=len(pruned))
-            scores, fetch_errors, node_errors, stamps = {}, {}, {}, {}
+            scores, fetch_errors, node_errors, stamps, placements = \
+                {}, {}, {}, {}, {}
         # pruned verdicts are NOT folded into the memos: re-deriving
         # them is one O(1) summary read per node, while memoizing tens
         # of thousands of None entries per pod costs more dict plumbing
@@ -538,6 +564,8 @@ class SchedulerCache:
             entry.errors.update(joined_errors)
             entry.stamps.update(stamps)
             entry.stamps.update(joined_stamps)
+            entry.placements.update(placements)
+            entry.placements.update(joined_placements)
             if reused:
                 MEMO_NODE_SCORES.inc("reused", n=reused)
             if to_scan:
@@ -558,6 +586,10 @@ class SchedulerCache:
                 sig_entry.scores.update(scores)
                 sig_entry.errors.update(node_errors)
                 sig_entry.stamps.update(stamps)
+                # placements are a pure function of (node state,
+                # signature) exactly like scores: replicas joining the
+                # class get the chip selection for free too
+                sig_entry.placements.update(placements)
                 EQCLASS_SHARES.inc(
                     "computed", n=len(scores) + len(node_errors))
             out = ({n: entry.scores[n] for n in node_names
@@ -575,16 +607,22 @@ class SchedulerCache:
     def _compute_missing(self, missing: list[str], req: PlacementRequest,
                          native_engine) -> tuple[
                              dict[str, int | None], dict[str, str],
-                             dict[str, str], dict[str, tuple[int, int]]]:
+                             dict[str, str], dict[str, tuple[int, int]],
+                             dict[str, Placement]]:
         """The recompute half of :meth:`score_nodes`: snapshot every
-        stale/uncovered node and score it through the resident fleet
-        arena (delta-packed: only stamp-moved slots re-marshal; see
-        engine.FleetArena). Returns (scores, fetch_errors, node_errors,
-        stamps)."""
+        stale/uncovered node and run the END-TO-END cycle through the
+        resident fleet arena (delta-packed; see engine.FleetArena) — one
+        ABI v4 native call yields both the binpack score AND the winning
+        chip set per node, so Bind's seed lookup stops costing a second
+        selection round trip. Returns (scores, fetch_errors,
+        node_errors, stamps, placements); ``placements`` is empty on the
+        v3/TPUSHARE_NO_CYCLE path (callers then re-derive lazily, the
+        old behavior)."""
         scores: dict[str, int | None] = {}
         fetch_errors: dict[str, str] = {}
         node_errors: dict[str, str] = {}
         stamps: dict[str, tuple[int, int]] = {}
+        placements: dict[str, Placement] = {}
         known: list[str] = []
         entries = []
         for name in missing:
@@ -605,10 +643,12 @@ class SchedulerCache:
         if entries:
             if self._arena is None:
                 self._arena = native_engine.FleetArena()
-            for name, score in zip(known,
-                                   self._arena.score(entries, req)):
+            for name, (score, placement) in zip(
+                    known, self._arena.cycle(entries, req)):
                 scores[name] = score
-        return scores, fetch_errors, node_errors, stamps
+                if placement is not None:
+                    placements[name] = placement
+        return scores, fetch_errors, node_errors, stamps, placements
 
     def _verify_pruned(self, pruned: dict[str, tuple[tuple[int, int], str]],
                        req: PlacementRequest,
@@ -684,14 +724,36 @@ class SchedulerCache:
 
     def memo_best_placement(self, pod: dict[str, Any],
                             req: PlacementRequest, node_name: str) -> None:
-        """Pre-compute the chip selection Bind will need on ``node_name``
-        (Prioritize calls this for its top-ranked node, which is almost
-        always the scheduler's eventual choice). Stored under the node's
-        generation stamp — NodeInfo.allocate re-validates the chips
+        """Make the chip selection Bind will need on ``node_name``
+        available as the seed hint (Prioritize calls this for its
+        top-ranked node, which is almost always the scheduler's eventual
+        choice).
+
+        Fast path (ABI v4): the end-to-end cycle that scored the node
+        already produced its winning placement — promoting it is a dict
+        read under the memo lock, zero engine calls. Fallback (v3 path,
+        or the node's stamp moved since the cycle): snapshot + select,
+        exactly the old behavior. Either way the hint is stored under
+        the node's generation stamp — NodeInfo.allocate re-validates
         under its own lock before trusting the seed, so a stamp race
         costs a recompute, never a bad placement."""
         from tpushare.core.placement import select_chips
 
+        key = podlib.pod_cache_key(pod)
+        sig = _req_sig(req)
+        with self._memo_lock:
+            entry = self._memo.get(key)
+            if entry is not None and entry.req_sig == sig:
+                p = entry.placements.get(node_name)
+                st = entry.stamps.get(node_name)
+                if p is not None and st is not None \
+                        and st == self._node_version(node_name):
+                    entry.placement_node = node_name
+                    entry.placement = p
+                    entry.placement_stamp = st
+                    # provenance unchanged: a speculative (batch) entry
+                    # stays speculative, a cycle-scanned one is not
+                    return
         try:
             info = self.get_node_info(node_name)
         except ApiError:
@@ -700,8 +762,6 @@ class SchedulerCache:
         placement = select_chips(snap, info.topology, req)
         if placement is None:
             return
-        key = podlib.pod_cache_key(pod)
-        sig = _req_sig(req)
         with self._memo_lock:
             entry = self._memo.get(key)
             if entry is None or entry.req_sig != sig:
@@ -709,28 +769,113 @@ class SchedulerCache:
             entry.placement_node = node_name
             entry.placement = placement
             entry.placement_stamp = stamp
+            entry.speculative = False  # freshly derived from live state
 
     def placement_hint(self, pod: dict[str, Any],
                        node_name: str) -> Placement | None:
         """The memoized best placement for Bind to seed allocate with,
         or None when the memo is cold / for a different node / the node
         mutated since the hint's stamp."""
+        return self.placement_hint_stamped(pod, node_name)[0]
+
+    def placement_hint_stamped(self, pod: dict[str, Any], node_name: str
+                               ) -> tuple[Placement | None,
+                                          tuple[int, int] | None, bool]:
+        """:meth:`placement_hint` plus the hint's generation stamp and
+        speculative provenance — Bind threads both into
+        ``NodeInfo.allocate`` so the stamp is re-checked UNDER the node
+        lock (closing the lookup→lock race window) and a demoted batch
+        member is attributed to ``revalidation_demoted``.
+
+        A speculative (batch-solved) placement whose node stamp moved
+        between the solve and this lookup is the stamp-revalidation
+        protocol firing: exactly that member demotes to the single-pod
+        path, counted in ``tpushare_batch_solves_total``."""
         req = request_from_pod(pod)
         if req is None:
-            return None
+            return None, None, False
         key = podlib.pod_cache_key(pod)
         with self._memo_lock:
             entry = self._memo.get(key)
             if entry is None or entry.req_sig != _req_sig(req) \
                     or entry.placement_node != node_name \
-                    or entry.placement is None \
-                    or entry.placement_stamp \
-                    != self._node_version(node_name):
+                    or entry.placement is None:
                 MEMO_REQUESTS.inc("seed", "miss")
-                return None
+                return None, None, False
+            if entry.placement_stamp != self._node_version(node_name):
+                if entry.speculative:
+                    BATCH_SOLVES.inc("revalidation_demoted")
+                MEMO_REQUESTS.inc("seed", "miss")
+                return None, None, False
             self._memo.move_to_end(key)
             MEMO_REQUESTS.inc("seed", "hit")
-            return entry.placement
+            return (entry.placement, entry.placement_stamp,
+                    entry.speculative)
+
+    # -- batched same-eqclass solves (cache/batch.py BatchPlanner) -----------
+
+    def solve_batch(self, req: PlacementRequest, node_names: list[str],
+                    k: int) -> list[tuple[str, Placement, tuple[int, int]]]:
+        """One multi-pod native solve for ``k`` identical requests:
+        up to ``k`` pairwise chip-disjoint ``(node, placement, stamp)``
+        speculative placements over the index-pruned candidate set.
+        ``stamp`` is the node generation the solve read — consumers MUST
+        revalidate against it before acting (stash_speculative +
+        placement_hint_stamped + NodeInfo.allocate do). Fewer than ``k``
+        results means the fleet ran out of disjoint capacity; the
+        planner routes the overflow to the single-pod path."""
+        from tpushare.core.native import engine as native_engine
+
+        if self._index_enabled:
+            self._index.flush()
+            to_scan, _pruned = self._index.partition(node_names, req)
+        else:
+            to_scan = list(node_names)
+        known: list[str] = []
+        stamps: dict[str, tuple[int, int]] = {}
+        fleet = []
+        for name in to_scan:
+            info = self._nodes.get(name)
+            if info is None or info.chip_count <= 0:
+                continue  # lazy faults / structural errors: solo path
+            stamp, snap = info.stamped_snapshot()
+            known.append(name)
+            stamps[name] = stamp
+            fleet.append((snap, info.topology))
+        if not fleet:
+            return []
+        out: list[tuple[str, Placement, tuple[int, int]]] = []
+        for pos, placement in native_engine.solve_batch(fleet, req, k):
+            name = known[pos]
+            out.append((name, placement, stamps[name]))
+        return out
+
+    def stash_speculative(self, pod: dict[str, Any], req: PlacementRequest,
+                          node_name: str, placement: Placement,
+                          stamp: tuple[int, int]) -> None:
+        """Record one batch-solve member's speculative placement as the
+        pod's memo entry: its Prioritize becomes a pure memo read and
+        its Bind seeds allocate from these chips — all guarded by
+        ``stamp`` (any node mutation in between demotes the member to
+        the single-pod path; see placement_hint_stamped)."""
+        key = podlib.pod_cache_key(pod)
+        sig = _req_sig(req)
+        with self._memo_lock:
+            entry = self._memo.get(key)
+            if entry is None or entry.req_sig != sig:
+                while len(self._memo) >= self.MEMO_CAP:
+                    self._memo.popitem(last=False)
+                entry = _MemoEntry(sig)
+                self._memo[key] = entry
+            else:
+                self._memo.move_to_end(key)
+            entry.scores[node_name] = placement.score
+            entry.stamps[node_name] = stamp
+            entry.placements[node_name] = placement
+            entry.placement_node = node_name
+            entry.placement = placement
+            entry.placement_stamp = stamp
+            entry.speculative = True
 
     def forget_memo(self, pod: dict[str, Any]) -> None:
         """Drop a bound/terminated pod's memo entry (its node's stamp

@@ -330,6 +330,8 @@ class NodeInfo:
         now_ns: Callable[[], int] = time.time_ns,
         ha_claims: bool = False,
         hint: Placement | None = None,
+        hint_stamp: tuple[int, int] | None = None,
+        hint_speculative: bool = False,
         extra_annotations: dict | None = None,
     ) -> Placement:
         """Bind-path: select chips, reserve, patch annotations, bind, confirm.
@@ -340,8 +342,13 @@ class NodeInfo:
         so single-replica deployments skip its two apiserver round-trips.
 
         ``hint`` is the memoized best placement from the Prioritize pass
-        (SchedulerCache.placement_hint): validated under the lock and used
-        verbatim when still admissible, skipping the chip search.
+        or a batch solve (SchedulerCache.placement_hint_stamped):
+        validated under the lock and used verbatim when still
+        admissible, skipping the chip search. ``hint_stamp`` is the node
+        generation the hint was computed at — re-checked UNDER the lock,
+        so a mutation that slipped between the memo lookup and this
+        call demotes the hint to a fresh search (``hint_speculative``
+        attributes that demotion to the batch-revalidation counter).
 
         Raises AllocationError when no placement exists or the apiserver
         writes fail (after rolling back the reservation).
@@ -369,6 +376,15 @@ class NodeInfo:
                 raise BindInFlightError(
                     f"bind already in flight for {podlib.pod_key(pod)} "
                     f"on {self.name}")
+            if hint is not None and hint_stamp is not None \
+                    and (self._epoch, self._version) != hint_stamp:
+                # stamp revalidation under the node lock: the state the
+                # hint was solved against is gone — re-search instead of
+                # trusting a speculative decision about a different node
+                if hint_speculative:
+                    from tpushare.cache.batch import BATCH_SOLVES
+                    BATCH_SOLVES.inc("revalidation_demoted")
+                hint = None
             if hint is not None and self._hint_valid(
                     hint, req, req.chip_demand_mib(self.hbm_per_chip)):
                 placement = hint

@@ -38,6 +38,10 @@ class ChipView:
         return ChipView(self.idx, self.coords, self.total_hbm_mib,
                         used_hbm_mib, self.healthy)
 
+    def with_healthy(self, healthy: bool) -> "ChipView":
+        return ChipView(self.idx, self.coords, self.total_hbm_mib,
+                        self.used_hbm_mib, healthy)
+
 
 class ChipSnapshot(list):
     """A list of :class:`ChipView` that supports weak references and

@@ -78,8 +78,30 @@ NATIVE_FALLBACKS = LabeledCounter(
 # sharded multi-thread scan, python the O(nodes) interpreter fallback
 NATIVE_FLEET_SCANS = LabeledCounter(
     "tpushare_native_fleet_scans_total",
-    "Fleet-wide scans by call (fits/score) and executing engine",
+    "Fleet-wide scans by call (fits/score/cycle) and executing engine",
     ("call", "engine"))
+# end-to-end decision cycles (ABI v4): engine=native means one
+# tpushare_cycle_fleet call produced scores AND winning chip sets;
+# engine=v3 means the cycle ran the score-then-reselect path (stale .so
+# without the symbol, or TPUSHARE_NO_CYCLE); engine=python is the
+# interpreter fallback. Sustained v3/python with a current build means
+# cycles silently lost the one-call win — the regression the
+# test_native_cycle_scored_a_fleet tier-1 guard exists to catch.
+CYCLE_CALLS = LabeledCounter(
+    "tpushare_cycle_calls_total",
+    "End-to-end Filter/Prioritize/selection cycle calls by executing "
+    "engine (native = one ABI v4 cycle_fleet call; v3 = "
+    "score-then-reselect compatibility path; python = interpreter "
+    "fallback)",
+    ("engine",))
+# batched same-eqclass solves (ABI v4 tpushare_solve_batch): one native
+# call per batch window, by executing engine
+BATCH_NATIVE_SOLVES = LabeledCounter(
+    "tpushare_batch_native_solves_total",
+    "Multi-pod batch placement solves by executing engine (native = "
+    "one ABI v4 solve_batch call per batch; python = per-member "
+    "interpreter fallback)",
+    ("engine",))
 
 
 def _build() -> bool:
@@ -140,6 +162,52 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_int,                    # allow_scatter
                 ctypes.POINTER(ctypes.c_int64),  # out scores (n)
             ]
+            # ABI v4 entry points: absent on a stale prebuilt .so —
+            # cycle callers detect that via _cycle_fn() and run the v3
+            # score-then-reselect path instead of crashing startup
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            try:
+                lib.tpushare_cycle_fleet.restype = ctypes.c_int
+                lib.tpushare_cycle_fleet.argtypes = [
+                    ctypes.c_int,    # n_nodes
+                    i64p,            # node chip offsets (n+1)
+                    i64p,            # free per chip (concat)
+                    i64p,            # total per chip (concat)
+                    i64p,            # mesh rank offsets (n+1)
+                    i64p,            # mesh dims (concat)
+                    ctypes.c_int64,  # req hbm
+                    ctypes.c_int,    # req count
+                    ctypes.c_int,    # topo rank
+                    i64p,            # topo dims
+                    ctypes.c_int,    # allow_scatter
+                    i64p,            # out scores (n)
+                    i64p,            # out chip ids (concat, chip offsets)
+                    i64p,            # out box (concat, mesh offsets)
+                    i64p,            # out origin (concat, mesh offsets)
+                ]
+                lib.tpushare_solve_batch.restype = ctypes.c_int
+                lib.tpushare_solve_batch.argtypes = [
+                    ctypes.c_int,    # n_nodes
+                    i64p,            # node chip offsets (n+1)
+                    i64p,            # free per chip (concat, MUTATED)
+                    i64p,            # total per chip (concat)
+                    i64p,            # mesh rank offsets (n+1)
+                    i64p,            # mesh dims (concat)
+                    ctypes.c_int64,  # req hbm
+                    ctypes.c_int,    # req count
+                    ctypes.c_int,    # topo rank
+                    i64p,            # topo dims
+                    ctypes.c_int,    # allow_scatter
+                    ctypes.c_int,    # k members
+                    ctypes.c_int,    # geo stride
+                    i64p,            # out node index (k)
+                    i64p,            # out scores (k)
+                    i64p,            # out chip ids (k * req_count)
+                    i64p,            # out box (k * geo_stride)
+                    i64p,            # out origin (k * geo_stride)
+                ]
+            except AttributeError:
+                pass  # v3 .so: cycle/batch run the compatibility path
             lib.tpushare_select_chips.argtypes = [
                 ctypes.c_int,                    # n_chips
                 ctypes.POINTER(ctypes.c_int64),  # free_hbm per chip (-1 = unhealthy)
@@ -184,15 +252,47 @@ def abi_version() -> int | None:
     return int(fn())
 
 
+def _cycle_fn():
+    """The ABI v4 tpushare_cycle_fleet symbol, or None when the cycle
+    must run the v3 score-then-reselect path (no lib, stale pre-v4 .so,
+    or the TPUSHARE_NO_CYCLE escape hatch)."""
+    if os.environ.get("TPUSHARE_NO_CYCLE"):
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    return getattr(lib, "tpushare_cycle_fleet", None)
+
+
+def _batch_fn():
+    """The ABI v4 tpushare_solve_batch symbol, or None (same gating as
+    :func:`_cycle_fn` — the batch solve is only profitable on top of
+    native cycles, so one knob disables both)."""
+    if os.environ.get("TPUSHARE_NO_CYCLE"):
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    return getattr(lib, "tpushare_solve_batch", None)
+
+
+def cycle_supported() -> bool:
+    """True when end-to-end cycles run the one-call ABI v4 path."""
+    return _cycle_fn() is not None
+
+
 def describe() -> "dict":
     """Observability snapshot for /inspect and bench: availability, ABI,
     scan worker config, and the fallback/scan counters."""
     return {
         "available": available(),
         "abi_version": abi_version(),
+        "cycle_supported": cycle_supported(),
         "scan_workers": _scan_workers(),
         "fleet_scans": {f"{call}/{engine}": v for (call, engine), v
                         in NATIVE_FLEET_SCANS.snapshot().items()},
+        "cycle_calls": {engine: v for (engine,), v
+                        in CYCLE_CALLS.snapshot().items()},
         "fallbacks": {reason: v for (reason,), v
                       in NATIVE_FALLBACKS.snapshot().items()},
     }
@@ -546,6 +646,228 @@ def score_fleet(nodes, req: "PlacementRequest",
     return results
 
 
+def _np_best(np, scores) -> int:
+    """Index of the lowest valid (>= 0) score, ties to the lowest
+    index (np.argmin's tie rule == Prioritize's first-best-wins), or
+    -1 when nothing placed. Vectorized: a Python loop here measured as
+    real per-cycle cost at fleet size."""
+    valid = scores >= 0
+    if not valid.any():
+        return -1
+    masked = np.where(valid, scores, np.iinfo(np.int64).max)
+    return int(np.argmin(masked))
+
+
+def _py_cycle(nodes, req):
+    """Per-node interpreter fallback for a cycle: the full selection,
+    so callers still get placements (just O(nodes) slower)."""
+    from tpushare.core.placement import select_chips_py
+
+    out = []
+    for chips, topo in nodes:
+        p = select_chips_py(chips, topo, req)
+        out.append((None, None) if p is None else (p.score, p))
+    return out
+
+
+def _placement_from(np_ids, box_arr, origin_arr, rank, req, score):
+    """Build a Placement from a cycle/batch out window (node-local chip
+    ids; box[0] == -1 marks scatter)."""
+    from tpushare.core.placement import Placement
+
+    ids = tuple(int(np_ids[j]) for j in range(req.chip_count))
+    if rank > 0 and int(box_arr[0]) == -1:
+        return Placement(ids, box=None, score=int(score))
+    return Placement(
+        ids, box=tuple(int(box_arr[i]) for i in range(rank)),
+        origin=tuple(int(origin_arr[i]) for i in range(rank)),
+        score=int(score))
+
+
+def cycle_fleet(nodes, req: "PlacementRequest", workers: int | None = None,
+                _count: bool = True
+                ) -> "list[tuple[int | None, Placement | None]]":
+    """End-to-end decision cycle per node in one (sharded) ABI v4 scan:
+    ``(best score, winning Placement)`` — ``(None, None)`` = no
+    placement. This is :func:`score_fleet` plus the chip selection Bind's
+    seed lookup used to re-derive with a second native call; on a pre-v4
+    .so or under ``TPUSHARE_NO_CYCLE`` the scores come from the v3 path
+    and placements are ``None`` (callers recompute lazily, exactly the
+    old behavior). ``_count`` suppresses the per-call cycle accounting
+    when this runs as the redo half of an arena scan."""
+    fn = _cycle_fn()
+    if fn is None:
+        if _count:
+            CYCLE_CALLS.inc("v3" if _load() is not None else "python")
+        return [(s, None) for s in score_fleet(nodes, req, workers)]
+    try:
+        import numpy as np
+    except ImportError:
+        if _count:
+            CYCLE_CALLS.inc("python")
+        return [(s, None) for s in score_fleet(nodes, req, workers)]
+    marshalled = _marshal_fleet(np, nodes, req)
+    if marshalled is None:
+        if _count:
+            CYCLE_CALLS.inc("python")
+        return _py_cycle(nodes, req)
+    dense_idx, free, total, dims, chip_offsets, mesh_offsets = marshalled
+
+    n = len(dense_idx)
+    t_rank = len(req.topology) if req.topology else 0
+    t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
+    out_scores = np.zeros(n, np.int64)
+    # out ids/geometry are indexed by the SAME absolute offsets as the
+    # inputs (the v4 layout note in placement.cpp), so shards pass the
+    # full arrays and write disjoint windows — no gather/merge
+    out_ids = np.zeros(len(free), np.int64)
+    out_box = np.zeros(len(dims), np.int64)
+    out_origin = np.zeros(len(dims), np.int64)
+
+    def call_range(a: int, b: int) -> int:
+        return fn(
+            b - a, _i64p(chip_offsets[a:]), _i64p(free), _i64p(total),
+            _i64p(mesh_offsets[a:]), _i64p(dims),
+            req.hbm_mib, req.chip_count, t_rank, t_dims,
+            1 if req.allow_scatter else 0,
+            _i64p(out_scores[a:]), _i64p(out_ids), _i64p(out_box),
+            _i64p(out_origin))
+
+    rc = _fleet_call(call_range, n, "cycle", workers)
+    if rc != 0:
+        NATIVE_FALLBACKS.inc("engine_error")
+        if _count:
+            CYCLE_CALLS.inc("python")
+        return _py_cycle(nodes, req)
+    if _count:
+        CYCLE_CALLS.inc("native")
+    results: "list[tuple[int | None, Placement | None] | None]" = \
+        [None] * len(nodes)
+    # materialize a Placement object for the BEST-scoring node only:
+    # Bind's seed lookup consumes exactly the winner (Prioritize's
+    # first-best-wins rule, which this argmin tie-break matches), and
+    # building fleet-size Python objects per cycle costs more than the
+    # second native call the cycle exists to remove. A non-winner node
+    # that does get bound re-derives its placement lazily — the old
+    # cost, paid only on the rare scheduler-disagrees path.
+    best = _np_best(np, out_scores)
+    for pos, i in enumerate(dense_idx):
+        s = int(out_scores[pos])
+        if s >= 0:
+            if pos == best:
+                c0 = int(chip_offsets[pos])
+                m0 = int(mesh_offsets[pos])
+                rank = int(mesh_offsets[pos + 1]) - m0
+                results[i] = (s, _placement_from(
+                    out_ids[c0:], out_box[m0:], out_origin[m0:], rank,
+                    req, s))
+            else:
+                results[i] = (s, None)
+        elif s == -1:
+            results[i] = (None, None)
+        # -2: not expressible after all — per-node Python below
+    for i, r in enumerate(results):
+        if r is None:
+            results[i] = _py_cycle([nodes[i]], req)[0]
+    return results  # type: ignore[return-value]
+
+
+def solve_batch(nodes, req: "PlacementRequest", k: int
+                ) -> "list[tuple[int, Placement]]":
+    """Place ``k`` identical requests onto ``nodes`` in ONE native call,
+    returning up to ``k`` ``(node index, Placement)`` pairs that are
+    pairwise chip-disjoint on every node (ABI v4 tpushare_solve_batch —
+    each member's demand is applied before the next member solves).
+    Fewer than ``k`` pairs means the fleet ran out of capacity; the
+    caller routes the overflow members to the single-pod path. Node
+    order is significant: score ties resolve to the lowest index (the
+    Prioritize first-best-wins rule)."""
+    if k <= 0 or not nodes:
+        return []
+    fn = _batch_fn()
+    np = None
+    if fn is not None:
+        try:
+            import numpy as np  # noqa: F811
+        except ImportError:
+            np = None
+    marshalled = _marshal_fleet(np, nodes, req) if np is not None else None
+    if fn is None or marshalled is None:
+        BATCH_NATIVE_SOLVES.inc("python")
+        return _py_solve_batch(nodes, req, k)
+    dense_idx, free, total, dims, chip_offsets, mesh_offsets = marshalled
+    # free is freshly derived per _marshal_fleet call (np.where output),
+    # never a cached or resident buffer — safe for the C side to mutate
+    n = len(dense_idx)
+    t_rank = len(req.topology) if req.topology else 0
+    t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
+    geo = max(1, int(np.max(np.diff(mesh_offsets))))
+    out_nodes = np.zeros(k, np.int64)
+    out_scores = np.zeros(k, np.int64)
+    out_ids = np.zeros(k * req.chip_count, np.int64)
+    out_box = np.zeros(k * geo, np.int64)
+    out_origin = np.zeros(k * geo, np.int64)
+    rc = fn(n, _i64p(chip_offsets), _i64p(free), _i64p(total),
+            _i64p(mesh_offsets), _i64p(dims),
+            req.hbm_mib, req.chip_count, t_rank, t_dims,
+            1 if req.allow_scatter else 0, k, geo,
+            _i64p(out_nodes), _i64p(out_scores), _i64p(out_ids),
+            _i64p(out_box), _i64p(out_origin))
+    if rc != 0:
+        NATIVE_FALLBACKS.inc("engine_error")
+        BATCH_NATIVE_SOLVES.inc("python")
+        return _py_solve_batch(nodes, req, k)
+    BATCH_NATIVE_SOLVES.inc("native")
+    out: "list[tuple[int, Placement]]" = []
+    for m in range(k):
+        pos = int(out_nodes[m])
+        if pos < 0:
+            break
+        m0 = int(mesh_offsets[pos])
+        rank = int(mesh_offsets[pos + 1]) - m0
+        out.append((dense_idx[pos], _placement_from(
+            out_ids[m * req.chip_count:], out_box[m * geo:],
+            out_origin[m * geo:], rank, req, int(out_scores[m]))))
+    return out
+
+
+def _py_solve_batch(nodes, req, k):
+    """Interpreter fallback for :func:`solve_batch` — the same greedy
+    loop (untouched-node preference, taken chips leave the pool), via
+    per-node selection on adjusted views."""
+    from tpushare.core.placement import select_chips_py
+
+    taken: "list[set[int]]" = [set() for _ in nodes]
+
+    def adjusted(i):
+        # a taken chip is modeled as unhealthy: ineligible for every
+        # request shape, exactly the C side's free = -1
+        chips, topo = nodes[i]
+        if not taken[i]:
+            return chips, topo
+        return [c.with_healthy(False) if c.idx in taken[i] else c
+                for c in chips], topo
+
+    best_p: "list" = [select_chips_py(*adjusted(i), req)
+                      for i in range(len(nodes))]
+    out: "list[tuple[int, Placement]]" = []
+    for _m in range(k):
+        best = None
+        for i, p in enumerate(best_p):
+            if p is not None and (
+                    best is None
+                    or (bool(taken[i]), p.score)
+                    < (bool(taken[best]), best_p[best].score)):
+                best = i
+        if best is None:
+            break
+        p = best_p[best]
+        out.append((best, p))
+        taken[best].update(p.chip_ids)
+        best_p[best] = select_chips_py(*adjusted(best), req)
+    return out
+
+
 # -- resident fleet arena -----------------------------------------------------
 
 
@@ -796,15 +1118,48 @@ class FleetArena:
         """Best binpack score per entry (None = no placement): the
         arena-backed equivalent of :func:`score_fleet` over
         ``(key, stamp, chips, topo)`` entries."""
+        return [s for s, _p in self._scan(entries, req, workers,
+                                          cycle=False)]
+
+    def cycle(self, entries, req: "PlacementRequest",
+              workers: int | None = None
+              ) -> "list[tuple[int | None, Placement | None]]":
+        """End-to-end cycle per entry over the resident arena:
+        ``(score, winning Placement)`` in ONE ABI v4 native call —
+        :meth:`score` plus the chip selection, so the cache's Bind seed
+        lookup stops paying a second select round trip. On a pre-v4 .so
+        or under ``TPUSHARE_NO_CYCLE`` the scores still flow (v3 path)
+        with placements ``None``."""
+        return self._scan(entries, req, workers, cycle=True)
+
+    def _scan(self, entries, req: "PlacementRequest",
+              workers: int | None, cycle: bool
+              ) -> "list[tuple[int | None, Placement | None]]":
         if not entries:
             return []
         nodes = [(chips, topo) for _k, _s, chips, topo in entries]
+
+        def off_arena():
+            # not arena-backed: the per-call marshalling path (which
+            # owns the fallback accounting); cycle mode keeps its
+            # placement outputs when the v4 symbol exists
+            if cycle:
+                return cycle_fleet(nodes, req, workers)
+            return [(s, None) for s in score_fleet(nodes, req, workers)]
+
         if _load() is None or os.environ.get("TPUSHARE_NO_ARENA"):
-            return score_fleet(nodes, req, workers)
+            return off_arena()
         try:
             import numpy as np
         except ImportError:
-            return score_fleet(nodes, req, workers)  # counts no_numpy
+            return off_arena()  # counts no_numpy
+        cycle_fn = _cycle_fn() if cycle else None
+        if cycle and cycle_fn is None:
+            # v3 .so or TPUSHARE_NO_CYCLE: the arena still delta-packs
+            # and scores in one call, but placements must be re-derived
+            # by the caller — count the compatibility path once here
+            CYCLE_CALLS.inc("v3")
+            return self._scan(entries, req, workers, False)
 
         with self._lock:
             self._sync(np, entries)
@@ -820,7 +1175,8 @@ class FleetArena:
             dims, chip_off, mesh_off = \
                 self._dims, self._chip_off, self._mesh_off
 
-        results: "list[int | None]" = [None] * len(entries)
+        results: "list[tuple[int | None, Placement | None]]" = \
+            [(None, None)] * len(entries)
         stale: list = []
         if resident:
             resident.sort(key=lambda t: t[1])
@@ -877,19 +1233,46 @@ class FleetArena:
                 *(req.topology or (0,)))
             out = np.zeros(n, np.int64)
             lib = _load()
+            if cycle_fn is not None:
+                # v4 one-call cycle: ids/geometry land at the gathered
+                # subset's (absolute, rebased) offsets — the same layout
+                # contract the score scan already relies on
+                out_ids = np.zeros(len(free_s), np.int64)
+                out_box = np.zeros(len(dims_s), np.int64)
+                out_origin = np.zeros(len(dims_s), np.int64)
 
-            def call_range(a: int, b: int) -> int:
-                return lib.tpushare_score_fleet(
-                    b - a, _i64p(off_s[a:]), _i64p(free_s),
-                    _i64p(total_s), _i64p(moff_s[a:]), _i64p(dims_s),
-                    req.hbm_mib, req.chip_count, t_rank, t_dims,
-                    1 if req.allow_scatter else 0, _i64p(out[a:]))
+                def call_range(a: int, b: int) -> int:
+                    return cycle_fn(
+                        b - a, _i64p(off_s[a:]), _i64p(free_s),
+                        _i64p(total_s), _i64p(moff_s[a:]),
+                        _i64p(dims_s),
+                        req.hbm_mib, req.chip_count, t_rank, t_dims,
+                        1 if req.allow_scatter else 0,
+                        _i64p(out[a:]), _i64p(out_ids),
+                        _i64p(out_box), _i64p(out_origin))
+            else:
+                def call_range(a: int, b: int) -> int:
+                    return lib.tpushare_score_fleet(
+                        b - a, _i64p(off_s[a:]), _i64p(free_s),
+                        _i64p(total_s), _i64p(moff_s[a:]),
+                        _i64p(dims_s),
+                        req.hbm_mib, req.chip_count, t_rank, t_dims,
+                        1 if req.allow_scatter else 0, _i64p(out[a:]))
 
-            rc = _fleet_call(call_range, n, "score", workers)
+            rc = _fleet_call(call_range, n,
+                             "cycle" if cycle_fn is not None else "score",
+                             workers)
             if rc != 0:
                 NATIVE_FALLBACKS.inc("engine_error")
                 fallback.extend(i for i, _p, _s in resident)
             else:
+                if cycle_fn is not None:
+                    CYCLE_CALLS.inc("native")
+                # materialize a Placement for the BEST-scoring slot
+                # only (see cycle_fleet: the seed lookup consumes the
+                # winner; fleet-size object construction would cost
+                # more than the native call the cycle removes)
+                best = _np_best(np, out) if cycle_fn is not None else -1
                 # optimistic-concurrency validation: any slot whose
                 # stamp moved during the unlocked scan may have torn
                 # our read — re-score those from their own snapshots
@@ -901,19 +1284,31 @@ class FleetArena:
                                 and slot.stamp == stamp:
                             s = int(out[k])
                             if s >= 0:
-                                results[i] = s
+                                if cycle_fn is not None and k == best:
+                                    c0 = int(off_s[k])
+                                    m0 = int(moff_s[k])
+                                    rank = int(moff_s[k + 1]) - m0
+                                    results[i] = (s, _placement_from(
+                                        out_ids[c0:], out_box[m0:],
+                                        out_origin[m0:], rank, req, s))
+                                else:
+                                    results[i] = (s, None)
                             elif s == -1:
-                                results[i] = None
+                                results[i] = (None, None)
                             else:  # -2: not expressible after all
                                 fallback.append(i)
                         else:
                             stale.append(i)
         if stale or fallback:
             redo = stale + fallback
-            redo_scores = score_fleet(
-                [nodes[i] for i in redo], req, workers)
-            for i, s in zip(redo, redo_scores):
-                results[i] = s
+            if cycle:
+                redo_out = cycle_fleet([nodes[i] for i in redo], req,
+                                       workers, _count=False)
+            else:
+                redo_out = [(s, None) for s in score_fleet(
+                    [nodes[i] for i in redo], req, workers)]
+            for i, r in zip(redo, redo_out):
+                results[i] = r
         return results
 
 

@@ -136,7 +136,19 @@ bool fits_one(int n_chips, const int64_t* free_hbm, const int64_t* total_hbm,
 // stale prebuilt library (missing newer symbols, pre-sharding layout) is
 // identifiable in production. Bump on any exported-signature or
 // fleet-contract change.
-extern "C" int64_t tpushare_abi_version() { return 3; }
+//
+// ABI v4 COMPATIBILITY NOTE: v4 adds tpushare_cycle_fleet (end-to-end
+// Filter+Prioritize+chip-selection in one pass) and tpushare_solve_batch
+// (multi-pod disjoint placement solve). Every v3 entry point keeps its
+// exact signature and semantics — a v3 caller against a v4 .so is fully
+// compatible; a v4 caller against a v3 .so detects the missing symbols
+// (AttributeError at bind time) and runs the v3 score-then-reselect
+// path. v4 out-array layout: cycle_fleet writes winning chip ids into a
+// concatenated array indexed by the SAME absolute node_chip_offsets as
+// the inputs (node n's chips at [offsets[n], offsets[n]+req_count)),
+// and box/origin at the mesh_rank_offsets — so the sharding and
+// resident-arena contracts below carry over to the outputs verbatim.
+extern "C" int64_t tpushare_abi_version() { return 4; }
 
 // Fleet-wide Filter: one call evaluates every candidate node, avoiding
 // per-node FFI marshalling (the reference's hot loop #1 x #2,
@@ -157,11 +169,13 @@ extern "C" int64_t tpushare_abi_version() { return 3; }
 // absolute offsets and per-node independence — are what let a caller
 // keep ONE long-lived packed fleet and scan arbitrary subsets of it:
 // a run of consecutive slots is passed as views into the resident
-// arrays with rebased offsets, with no per-call marshalling. The ABI
-// itself is unchanged (abi_version stays 3); any future change that
-// makes node evaluation order- or neighbor-dependent, or makes offsets
-// relative, breaks BOTH the thread-sharding and the arena subset-scan
-// callers and must bump the version.
+// arrays with rebased offsets, with no per-call marshalling. The v4
+// additions preserve both properties (cycle_fleet's out arrays use the
+// same absolute offsets; solve_batch mutates only caller-owned scratch);
+// any future change that makes node evaluation order- or
+// neighbor-dependent, or makes offsets relative, breaks BOTH the
+// thread-sharding and the arena subset-scan callers and must bump the
+// version.
 extern "C" int tpushare_fits_fleet(
     int n_nodes,
     const int64_t* node_chip_offsets,
@@ -470,6 +484,165 @@ extern "C" int tpushare_select_gang(
       *out_hosts = best_hosts;
       return 1;
     }
+  }
+  return 0;
+}
+
+// -- ABI v4: end-to-end cycles + batched solves ------------------------------
+
+// Fleet-wide Filter+Prioritize+selection in ONE pass: like
+// tpushare_score_fleet, but the winning chip set (the thing Bind's
+// seed-placement lookup used to re-derive with a second call) is written
+// out per node instead of discarded. out_scores[n] follows score_fleet
+// (-1 no placement, -2 not expressible); when out_scores[n] >= 0 the
+// chosen chip ids sit at out_ids[node_chip_offsets[n] ..
+// node_chip_offsets[n] + req_count) (node-local ids, exactly what
+// tpushare_select_chips emits) and the box/origin at
+// out_box/out_origin[mesh_rank_offsets[n] .. +rank); out_box[m0] == -1
+// marks a scattered placement. Offsets stay ABSOLUTE and every node's
+// evaluation (and out window) is independent, so both the
+// thread-sharding and resident-arena subset-scan contracts hold for the
+// out arrays too.
+extern "C" int tpushare_cycle_fleet(
+    int n_nodes,
+    const int64_t* node_chip_offsets,
+    const int64_t* free_hbm,
+    const int64_t* total_hbm,
+    const int64_t* mesh_rank_offsets,
+    const int64_t* mesh_dims,
+    int64_t req_hbm,
+    int req_count,
+    int topo_rank,
+    const int64_t* topo_dims,
+    int allow_scatter,
+    int64_t* out_scores,
+    int64_t* out_ids,
+    int64_t* out_box,
+    int64_t* out_origin) {
+  if (n_nodes < 0) return -1;
+  for (int n = 0; n < n_nodes; ++n) {
+    int64_t c0 = node_chip_offsets[n], c1 = node_chip_offsets[n + 1];
+    int64_t m0 = mesh_rank_offsets[n], m1 = mesh_rank_offsets[n + 1];
+    int64_t score = 0;
+    int rc = tpushare_select_chips(
+        (int)(c1 - c0), free_hbm + c0, total_hbm + c0,
+        (int)(m1 - m0), mesh_dims + m0,
+        req_hbm, req_count, topo_rank, topo_dims, allow_scatter,
+        out_ids + c0, out_box + m0, out_origin + m0, &score);
+    out_scores[n] = rc == 1 ? score : (rc == 0 ? -1 : -2);
+  }
+  return 0;
+}
+
+// Multi-pod solve: place k IDENTICAL requests (one _req_sig equivalence
+// class) onto the fleet in one call, returning k pairwise chip-DISJOINT
+// speculative placements. k repetitions of the single-pod decision
+// (argmin node score), with two batch-specific rules:
+//
+// 1. every chip a member takes is marked INELIGIBLE (free = -1) before
+//    the next member solves — disjointness by construction. Sharing a
+//    chip across members would be HBM-legal, but a speculative sibling
+//    placement is worthless the moment the first member's bind moves
+//    the node's stamp, and disjointness keeps apiserver truth
+//    oversubscription-free even if every member's PATCH lands;
+// 2. nodes no member has touched are preferred (argmin key is
+//    (touched, score, node index)) — a placement on a sibling's node
+//    is guaranteed to be stamp-demoted to the solo path once that
+//    sibling binds, so spreading maximizes the placements that survive
+//    revalidation; same-node disjoint placements are still produced
+//    when untouched capacity runs out.
+//
+// free_hbm is MUTATED — callers pass a scratch copy, never
+// resident-arena buffers.
+//
+// Outputs per member m: out_nodes[m] = node index into this call's
+// fleet (-1 = no placement for this and all later members — capacity
+// only shrinks), out_scores[m], node-local chip ids at
+// out_ids[m * req_count ..), box/origin at out_box/out_origin
+// [m * geo_stride ..) with geo_stride >= every node's rank
+// (out_box[m * geo_stride] == -1 marks scatter). NOT shardable: members
+// are sequentially dependent by design; one call per batch.
+extern "C" int tpushare_solve_batch(
+    int n_nodes,
+    const int64_t* node_chip_offsets,
+    int64_t* free_hbm,
+    const int64_t* total_hbm,
+    const int64_t* mesh_rank_offsets,
+    const int64_t* mesh_dims,
+    int64_t req_hbm,
+    int req_count,
+    int topo_rank,
+    const int64_t* topo_dims,
+    int allow_scatter,
+    int k,
+    int geo_stride,
+    int64_t* out_nodes,
+    int64_t* out_scores,
+    int64_t* out_ids,
+    int64_t* out_box,
+    int64_t* out_origin) {
+  if (n_nodes < 0 || k < 0 || req_count <= 0 || geo_stride <= 0)
+    return -1;
+  int64_t max_chips = 1, max_rank = 1;
+  for (int n = 0; n < n_nodes; ++n) {
+    max_chips = std::max(max_chips,
+                         node_chip_offsets[n + 1] - node_chip_offsets[n]);
+    max_rank = std::max(max_rank,
+                        mesh_rank_offsets[n + 1] - mesh_rank_offsets[n]);
+  }
+  if (max_rank > geo_stride) return -1;
+  std::vector<int64_t> ids(max_chips), box(max_rank), origin(max_rank);
+  std::vector<int64_t> scores(n_nodes);
+  std::vector<char> fit(n_nodes), touched(n_nodes);
+
+  auto rescore = [&](int n) {
+    int64_t c0 = node_chip_offsets[n], c1 = node_chip_offsets[n + 1];
+    int64_t m0 = mesh_rank_offsets[n], m1 = mesh_rank_offsets[n + 1];
+    int64_t s = 0;
+    int rc = tpushare_select_chips(
+        (int)(c1 - c0), free_hbm + c0, total_hbm + c0,
+        (int)(m1 - m0), mesh_dims + m0,
+        req_hbm, req_count, topo_rank, topo_dims, allow_scatter,
+        ids.data(), box.data(), origin.data(), &s);
+    fit[n] = rc == 1;
+    scores[n] = s;
+  };
+  for (int n = 0; n < n_nodes; ++n) rescore(n);
+
+  for (int m = 0; m < k; ++m) {
+    int best = -1;
+    for (int n = 0; n < n_nodes; ++n)
+      if (fit[n] && (best < 0 ||
+                     (touched[n] != touched[best]
+                          ? touched[n] < touched[best]
+                          : scores[n] < scores[best])))
+        best = n;
+    if (best < 0) {
+      for (int r = m; r < k; ++r) out_nodes[r] = -1;
+      return 0;
+    }
+    // re-run the selector on the winner to materialize the chip set
+    // (the scan above kept only scores); the scratch holds node-local
+    // ids and geometry for exactly this node
+    rescore(best);
+    if (!fit[best]) { --m; continue; }  // defensive; cannot recur
+    int64_t c0 = node_chip_offsets[best];
+    int64_t m0 = mesh_rank_offsets[best], m1 = mesh_rank_offsets[best + 1];
+    int rank = (int)(m1 - m0);
+    out_nodes[m] = best;
+    out_scores[m] = scores[best];
+    for (int j = 0; j < req_count; ++j)
+      out_ids[(int64_t)m * req_count + j] = ids[j];
+    for (int i = 0; i < geo_stride; ++i) {
+      out_box[(int64_t)m * geo_stride + i] = i < rank ? box[i] : 0;
+      out_origin[(int64_t)m * geo_stride + i] = i < rank ? origin[i] : 0;
+    }
+    // rule 1: the taken chips leave the pool entirely (disjointness);
+    // rule 2: the node is now a demotion risk for siblings
+    for (int j = 0; j < req_count; ++j)
+      free_hbm[c0 + ids[j]] = -1;
+    touched[best] = 1;
+    rescore(best);
   }
   return 0;
 }

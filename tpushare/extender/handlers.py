@@ -62,9 +62,15 @@ class FilterHandler:
 
     def __init__(self, cache: SchedulerCache, registry: Registry,
                  gang=None, breaker=None, staleness_fn=None,
-                 tracer=None, explain=None) -> None:
+                 tracer=None, explain=None, batcher=None) -> None:
         self._cache = cache
         self._gang = gang  # GangCoordinator | None
+        # batched decision cycles (cache/batch.py BatchPlanner):
+        # concurrently-arriving same-signature pods coalesce into one
+        # multi-pod native solve; a member's Filter answers with its
+        # assigned node only. None (or a disabled planner) = every pod
+        # runs the single-pod path.
+        self._batcher = batcher
         # degraded mode: when the apiserver circuit is open this verb
         # keeps answering from the informer-warmed cache — correct up to
         # the staleness bound staleness_fn reports — and the serve is
@@ -152,6 +158,32 @@ class FilterHandler:
         verdicts: dict[str, dict[str, Any]] = {}
         req = request_from_pod(pod)
         node_names = [n for n in node_names if n]
+        if req is not None and self._batcher is not None \
+                and self._batcher.enabled:
+            # batched decision cycles: same-signature pods arriving
+            # within the window share ONE multi-pod solve; a covered
+            # member answers with exactly its assigned node (the gang
+            # shape — the extender may return any subset) and its
+            # speculative placement is already stashed for Prioritize/
+            # Bind. A None result = run the ordinary path below.
+            spec = self._batcher.submit(pod, req, node_names, trace_id)
+            if spec is not None:
+                sp.set_tags(batch_size=spec.batch_size,
+                            batch="leader" if spec.leader else "member",
+                            batch_leader_trace=spec.leader_trace_id)
+                # the audit must never show a batched pod as computed:
+                # record_batch writes the membership record AND the
+                # single source=batched filter verdict in one notify
+                if self._explain is not None:
+                    self._explain.record_batch(
+                        pod_key, pod, trace_id,
+                        leader_trace_id=spec.leader_trace_id,
+                        size=spec.batch_size, node=spec.node)
+                log.debug("filter %s: batched -> %s (k=%d)",
+                          podlib.pod_key(pod), spec.node,
+                          spec.batch_size)
+                return {"NodeNames": [spec.node], "FailedNodes": {},
+                        "Error": ""}
         if req is None:
             # not a tpushare pod: nothing to check (handler shouldn't even
             # be consulted thanks to managedResources, but be permissive)
@@ -604,9 +636,16 @@ class BindHandler:
                     extra_annotations=trace_ann)
             else:
                 info = self._cache.get_node_info(node)
+                # the stamped form threads the hint's node generation
+                # into allocate, which re-checks it UNDER the node lock:
+                # a speculative (batch-solved) placement invalidated by
+                # a concurrent mutation demotes to a fresh search there
+                hint, hint_stamp, hint_spec = \
+                    self._cache.placement_hint_stamped(pod, node)
                 placement = info.allocate(
                     pod, self._cluster, ha_claims=self._ha_claims,
-                    hint=self._cache.placement_hint(pod, node),
+                    hint=hint, hint_stamp=hint_stamp,
+                    hint_speculative=hint_spec,
                     extra_annotations=trace_ann)
             audit["chip_ids"] = list(placement.chip_ids)
             self._cache.forget_memo(pod)
@@ -807,6 +846,16 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
     registry.register(EQCLASS_SHARES)
     registry.register(_native.NATIVE_FLEET_SCANS)
     registry.register(_native.NATIVE_FALLBACKS)
+    # batched-cycles set (ABI v4): end-to-end cycle calls by engine (a
+    # sustained v3/python share on a current build = the silent-fallback
+    # regression the cycle tier-1 guard reds on), window coalescing
+    # volume, and per-pod batch outcomes incl. revalidation demotions
+    from tpushare.cache.batch import BATCH_SOLVES, BATCH_WINDOW_PODS
+
+    registry.register(_native.CYCLE_CALLS)
+    registry.register(_native.BATCH_NATIVE_SOLVES)
+    registry.register(BATCH_SOLVES)
+    registry.register(BATCH_WINDOW_PODS)
     registry.gauge_func(
         "tpushare_native_engine_available",
         "1 when the C++ placement engine is loaded, 0 when scans run "

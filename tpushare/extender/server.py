@@ -80,11 +80,18 @@ class ExtenderServer:
         # labeled into slices — zero cost otherwise
         from tpushare.cache.gang import GangCoordinator
         self.gang = GangCoordinator(cache)
+        # batched decision cycles (cache/batch.py): same-signature pods
+        # arriving within TPUSHARE_BATCH_WINDOW_MS coalesce into one
+        # multi-pod native solve. Window 0 (the default) disables the
+        # layer entirely — quiet deployments pay nothing.
+        from tpushare.cache.batch import BatchPlanner
+        self.batcher = BatchPlanner(cache)
         self.filter_handler = FilterHandler(cache, self.registry,
                                             gang=self.gang, breaker=breaker,
                                             staleness_fn=staleness_fn,
                                             tracer=self.tracer,
-                                            explain=self.explain)
+                                            explain=self.explain,
+                                            batcher=self.batcher)
         self.prioritize_handler = PrioritizeHandler(cache, self.registry,
                                                     breaker=breaker,
                                                     tracer=self.tracer,

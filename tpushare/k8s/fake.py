@@ -53,9 +53,12 @@ class FakeCluster:
         obj.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
 
     def _notify(self, kind: str, etype: str, obj: dict[str, Any]) -> None:
-        ev = WatchEvent(etype, copy.deepcopy(obj))
+        # one isolated copy per WATCHER (not one shared copy, and none
+        # at all with no watchers): consumers never see the live object
+        # or each other's, and an unwatched cluster pays nothing — the
+        # unconditional deepcopy was ~40% of a hermetic bind cycle
         for q in list(self._watchers[kind]):
-            q.put(ev)
+            q.put(WatchEvent(etype, copy.deepcopy(obj)))
 
     @staticmethod
     def _key(namespace: str, name: str) -> str:
@@ -101,12 +104,19 @@ class FakeCluster:
         return copy.deepcopy(node)
 
     def create_pod(self, pod: dict[str, Any]) -> dict[str, Any]:
+        # defaulting, uid generation (a urandom syscall) and the
+        # isolating input copy all happen OUTSIDE the store lock: the
+        # single fake-apiserver lock is the hermetic bench's convoy
+        # point, and only the dict insert + notify need it. The copy
+        # also stops the store from aliasing the CALLER's dict (a
+        # caller mutating its pod after create must not edit ours).
+        pod = copy.deepcopy(pod)
+        meta = pod.setdefault("metadata", {})
+        meta.setdefault("namespace", "default")
+        meta.setdefault("uid", str(uuid.uuid4()))
+        pod.setdefault("status", {}).setdefault("phase", "Pending")
+        key = self._key(meta["namespace"], meta["name"])
         with self._lock:
-            meta = pod.setdefault("metadata", {})
-            meta.setdefault("namespace", "default")
-            meta.setdefault("uid", str(uuid.uuid4()))
-            pod.setdefault("status", {}).setdefault("phase", "Pending")
-            key = self._key(meta["namespace"], meta["name"])
             if key in self._pods:
                 raise ApiError(409, f"pod {key} already exists")
             self._bump(pod)
@@ -169,6 +179,15 @@ class FakeCluster:
             if pod is None:
                 raise ApiError(404, f"pod {namespace}/{name}")
             return copy.deepcopy(pod)
+
+    def peek_pod(self, namespace: str, name: str) -> dict[str, Any] | None:
+        """Watch-warmed-lister analogue for hermetic rigs: the STORED
+        pod object by reference, no copy and no simulated round-trip —
+        the same read a production informer lister serves (its handlers
+        also receive the store's object). Read-only by contract; None on
+        a miss (the caller falls back to the GET path, like a lister)."""
+        with self._lock:
+            return self._pods.get(self._key(namespace, name))
 
     def list_nodes(self) -> list[dict[str, Any]]:
         with self._lock:

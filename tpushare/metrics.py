@@ -162,6 +162,12 @@ class Histogram:
             if exemplar:
                 self._exemplars[i] = (exemplar, v)
 
+    @property
+    def count(self) -> int:
+        """Total observations (the _count series)."""
+        with self._lock:
+            return sum(self._counts)
+
     def exemplars(self) -> dict[str, dict[str, float | str]]:
         """Per-bucket exemplar map: {le: {"trace_id", "value"}}."""
         with self._lock:

@@ -103,6 +103,31 @@ class ExplainStore:
             }
         self._notify("filter_recorded", pod_key, ok, len(nodes))
 
+    def record_batch(self, pod_key: str, pod: dict[str, Any] | None,
+                     trace_id: str | None, leader_trace_id: str | None,
+                     size: int, node: str) -> None:
+        """The pod was served from a MULTI-POD batch solve: record its
+        membership (which leader's solve, how many pods the window
+        coalesced, which node it was assigned) and a filter record whose
+        single verdict carries ``source: batched`` — the audit must
+        never present a batched pod as individually computed."""
+        with self._lock:
+            rec = self._entry(pod_key, pod, trace_id)
+            rec["batch"] = {
+                "leader_trace_id": leader_trace_id,
+                "size": size,
+                "node": node,
+                "source": "batched",
+            }
+            rec["filter"] = {
+                "candidates": 1,
+                "ok": 1,
+                "nodes": {node: {"verdict": "ok", "source": "batched",
+                                 "leader_trace_id": leader_trace_id,
+                                 "batch_size": size}},
+            }
+        self._notify("filter_recorded", pod_key, 1, 1)
+
     def record_prioritize(self, pod_key: str, pod: dict[str, Any] | None,
                           trace_id: str | None,
                           scores: dict[str, int],
