@@ -27,6 +27,7 @@ def assert_close(a, b, atol=2e-2):
         atol=atol, rtol=2e-2)
 
 
+@pytest.mark.tpu_kernel
 def test_flash_matches_reference_causal():
     q, k, v = rand_qkv(jax.random.key(0))
     out = flash_attention(q, k, v, causal=True, interpret=True)
@@ -35,6 +36,7 @@ def test_flash_matches_reference_causal():
     assert_close(out, ref)
 
 
+@pytest.mark.tpu_kernel
 def test_flash_matches_reference_multiblock():
     # 3 query blocks -> exercises the online-softmax recurrence across
     # blocks, not just the single-block degenerate case
@@ -43,6 +45,7 @@ def test_flash_matches_reference_multiblock():
     assert_close(out, attention_reference(q, k, v, causal=True))
 
 
+@pytest.mark.tpu_kernel
 def test_flash_handles_unaligned_seq():
     # S=100 pads to 128: padded keys must be masked, padded queries dropped
     q, k, v = rand_qkv(jax.random.key(2), S=100)
@@ -51,6 +54,7 @@ def test_flash_handles_unaligned_seq():
     assert_close(out, attention_reference(q, k, v, causal=True))
 
 
+@pytest.mark.tpu_kernel
 def test_flash_non_causal():
     q, k, v = rand_qkv(jax.random.key(3), S=160)
     out = flash_attention(q, k, v, causal=False, interpret=True)
@@ -68,6 +72,7 @@ def test_flash_rejects_bad_shapes():
         flash_attention(q, k[..., :32], v[..., :32])  # head_dim mismatch
 
 
+@pytest.mark.tpu_kernel
 def test_flash_grads_match_reference():
     # custom VJP (blockwise backward from the LSE residual) vs autodiff
     # through the einsum reference, fp32 so tolerances are tight
@@ -83,6 +88,7 @@ def test_flash_grads_match_reference():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.tpu_kernel
 def test_flash_grads_non_causal_unaligned():
     q, k, v = rand_qkv(jax.random.key(8), S=100, dtype=jnp.float32)
     f = lambda q, k, v: jnp.sum(
@@ -116,14 +122,17 @@ def _pallas_bwd_vs_autodiff(S, causal, dtype=jnp.float32, bq=None, bk=None,
             atol=tol, rtol=tol, err_msg=f"{name} S={S} causal={causal}")
 
 
+@pytest.mark.tpu_kernel
 def test_pallas_backward_causal():
     _pallas_bwd_vs_autodiff(S=256, causal=True)
 
 
+@pytest.mark.tpu_kernel
 def test_pallas_backward_non_causal():
     _pallas_bwd_vs_autodiff(S=256, causal=False)
 
 
+@pytest.mark.tpu_kernel
 def test_pallas_backward_ragged_padding():
     # S=300 pads to 384: padded-query lanes must self-zero in dk/dv (the
     # +1e30 lse clamp) and padded-key rows are sliced — both kernels'
@@ -132,6 +141,7 @@ def test_pallas_backward_ragged_padding():
     _pallas_bwd_vs_autodiff(S=300, causal=False)
 
 
+@pytest.mark.tpu_kernel
 def test_pallas_backward_unequal_tiles():
     # block_q != block_kv exercises i_start/last diagonal arithmetic in
     # both grid orders
@@ -139,11 +149,13 @@ def test_pallas_backward_unequal_tiles():
     _pallas_bwd_vs_autodiff(S=512, causal=True, bq=256, bk=128)
 
 
+@pytest.mark.tpu_kernel
 def test_pallas_backward_bf16():
     _pallas_bwd_vs_autodiff(S=384, causal=True, dtype=jnp.bfloat16,
                             tol=6e-2)
 
 
+@pytest.mark.tpu_kernel
 def test_train_step_with_flash_config():
     from tpushare.workloads.model import make_train_step
     cfg = dataclasses.replace(PRESETS["llama-tiny"], attn="flash")
@@ -154,6 +166,7 @@ def test_train_step_with_flash_config():
     assert jnp.isfinite(loss)
 
 
+@pytest.mark.tpu_kernel
 def test_model_forward_flash_matches_einsum():
     cfg = PRESETS["llama-tiny"]
     params = init_params(cfg, jax.random.key(5))
@@ -167,6 +180,7 @@ def test_model_forward_flash_matches_einsum():
     assert float(agree) >= 0.95
 
 
+@pytest.mark.tpu_kernel
 def test_flash_gqa_matches_expanded_reference():
     """GQA-native call (small kv heads) == reference on expanded heads."""
     B, H, Hkv, S, D = 2, 8, 2, 192, 32
@@ -182,6 +196,7 @@ def test_flash_gqa_matches_expanded_reference():
     assert_close(out, ref)
 
 
+@pytest.mark.tpu_kernel
 def test_flash_gqa_backward_matches_expanded_autodiff():
     B, H, Hkv, S, D = 1, 4, 2, 128, 16
     kq, kk, kv = jax.random.split(jax.random.key(8), 3)
@@ -215,6 +230,7 @@ def test_flash_rejects_nondividing_kv_heads():
         flash_attention(q, k[:, :4], v[:, :4], interpret=True)
 
 
+@pytest.mark.tpu_kernel
 def test_window_attention_matches_reference():
     # sliding window: multi-block S with a window smaller than, equal to,
     # and non-aligned with the block size
@@ -228,6 +244,7 @@ def test_window_attention_matches_reference():
                                    err_msg=f"S={S} W={W}")
 
 
+@pytest.mark.tpu_kernel
 def test_window_floor_skip_and_relocated_init():
     # geometry chosen so j_start > 0: bq=256, bk=128, S=640, W=300 ->
     # q block i=2 (rows 512..639) has floor 512-299=213 -> j_start=1.
@@ -241,6 +258,7 @@ def test_window_floor_skip_and_relocated_init():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.tpu_kernel
 def test_window_attention_ragged_and_unequal_tiles():
     q, k, v = rand_qkv(jax.random.key(41), S=300, dtype=jnp.float32)
     out = flash_attention(q, k, v, causal=True, window=77, interpret=True,
@@ -250,6 +268,7 @@ def test_window_attention_ragged_and_unequal_tiles():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.tpu_kernel
 def test_window_attention_grads():
     q, k, v = rand_qkv(jax.random.key(42), S=300, dtype=jnp.float32)
     f = lambda q, k, v: jnp.sum(jnp.sin(flash_attention(
@@ -271,6 +290,7 @@ def test_window_requires_causal_and_positive():
         flash_attention(q, k, v, causal=True, window=0, interpret=True)
 
 
+@pytest.mark.tpu_kernel
 def test_pallas_backward_gqa_grouped_grid():
     """The dkdv kernel's grouped (B, H_kv, j, i, g) grid vs autodiff on
     expanded heads — GQA gradients sum per group IN the grid, no K/V
@@ -301,6 +321,7 @@ def test_pallas_backward_gqa_grouped_grid():
                 err_msg=f"{name} H{H}/{Hkv} S{S} causal={causal}")
 
 
+@pytest.mark.tpu_kernel
 def test_pallas_backward_windowed():
     """Window support in BOTH backward grid orders: the dq kernel's
     relocated init/floor skip (j_start > 0 at bq=256/bk=128/W=300) and
@@ -351,32 +372,39 @@ def _pipe_vs_step(S, causal=True, window=None, dtype=jnp.float32,
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.tpu_kernel
 def test_pipelined_bit_identical_causal():
     _pipe_vs_step(S=256)
 
 
+@pytest.mark.tpu_kernel
 def test_pipelined_bit_identical_non_causal():
     _pipe_vs_step(S=256, causal=False)
 
 
+@pytest.mark.tpu_kernel
 def test_pipelined_bit_identical_ragged_bf16():
     _pipe_vs_step(S=300, dtype=jnp.bfloat16)
 
 
+@pytest.mark.tpu_kernel
 def test_pipelined_bit_identical_windowed():
     # window floor > 0 exercises the shifted j_start/init interplay
     _pipe_vs_step(S=384, window=96)
 
 
+@pytest.mark.tpu_kernel
 def test_pipelined_bit_identical_unequal_tiles():
     _pipe_vs_step(S=384, bq=256, bk=128)
     _pipe_vs_step(S=384, bq=128, bk=256)
 
 
+@pytest.mark.tpu_kernel
 def test_pipelined_gqa_single_kv_head():
     _pipe_vs_step(S=256, Hkv=1)
 
 
+@pytest.mark.tpu_kernel
 def test_pipelined_grads_route_through_same_vjp():
     # the forward variant only changes the primal kernel; the custom
     # VJP (lse residual) must serve both identically
@@ -392,6 +420,7 @@ def test_pipelined_grads_route_through_same_vjp():
     np.testing.assert_array_equal(np.asarray(ga), np.asarray(gb))
 
 
+@pytest.mark.tpu_kernel
 def test_fwd_impl_env_and_validation(monkeypatch):
     from tpushare.workloads.attention import _resolve_flash_fwd
     q, k, v = rand_qkv(jax.random.key(12), 1, 2, 128, 64, jnp.float32)

@@ -96,6 +96,7 @@ def test_eos_frees_slot_early():
     assert out[rid] == [first] and eng.free_slots == 1
 
 
+@pytest.mark.tpu_kernel
 def test_per_request_eos_override():
     # stop tokens vary per request: one co-tenant stops at ITS second
     # prediction, the other (same prompt, engine-default eos) runs its

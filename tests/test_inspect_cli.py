@@ -80,6 +80,40 @@ def test_cli_fleet_subcommand(live, capsys):
     assert "tiers" in snap and "audit" in snap
 
 
+def test_cli_defrag_subcommand(live, capsys, live_cluster):
+    # before any pass: the endpoint serves, the renderer says so
+    assert main(["--endpoint", live, "defrag"]) == 0
+    out = capsys.readouterr().out
+    assert "defrag:" in out and "no plan yet" in out
+    assert "no moves executed yet" in out
+    # --json emits the raw snapshot with the budget/counters schema
+    assert main(["--endpoint", live, "--json", "defrag"]) == 0
+    import json as jsonlib
+    snap = jsonlib.loads(capsys.readouterr().out)
+    assert snap["budget"]["budget"] >= 0
+    assert "counters" in snap and "recent_moves" in snap
+
+
+def test_cli_defrag_renders_a_real_pass(capsys):
+    """A fragmented fleet through the REAL controller pass, rendered."""
+    from tests.test_defrag import _frag_fleet
+    fc, cache = _frag_fleet()
+    server = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    port = server.start()
+    try:
+        server.defrag.run_once()
+        live = f"http://127.0.0.1:{port}"
+        assert main(["--endpoint", live, "defrag"]) == 0
+        out = capsys.readouterr().out
+        assert "1 passes" in out
+        assert "1 fragmented nodes" in out
+        assert "n0" in out and "-> n1" in out
+        assert "completed" in out
+        assert "freed chips" in out
+    finally:
+        server.stop()
+
+
 def test_cli_explain_and_traces_subcommands(live, capsys, live_cluster):
     import json as jsonlib
     import urllib.request

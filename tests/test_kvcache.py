@@ -5,6 +5,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import pytest
+
 from tpushare.workloads.model import (
     PRESETS, forward, forward_cached, greedy_decode, greedy_decode_kv,
     init_kv_cache, init_params, quantize_int8)
@@ -236,6 +238,7 @@ def _cfg_pair(**extra):
             dataclasses.replace(base, attn="flash"))
 
 
+@pytest.mark.tpu_kernel
 def test_flash_prefill_matches_einsum_prefill():
     # prefill-from-zero is plain causal self-attention over the chunk,
     # so the fused kernel must reproduce the buffer einsum exactly (up
@@ -260,6 +263,7 @@ def test_flash_prefill_matches_einsum_prefill():
                                        atol=1e-4, rtol=1e-3)
 
 
+@pytest.mark.tpu_kernel
 def test_flash_prefill_decode_tokens_match():
     cfg_e, cfg_f = _cfg_pair(attn_window=16)
     p = init_params(cfg_e, jax.random.key(2))
@@ -269,6 +273,7 @@ def test_flash_prefill_decode_tokens_match():
     np.testing.assert_array_equal(np.asarray(oe), np.asarray(of))
 
 
+@pytest.mark.tpu_kernel
 def test_flash_prefill_int8_cache_documented_semantics():
     # int8 cache: the flash prefill attends PRE-quantization k/v while
     # the einsum path reads the quantized buffer, so logits (and the

@@ -3,7 +3,8 @@
 The cache's concurrency story depends on one documented rule — lock
 order **gang -> stripe -> node -> memo -> index**, with `_pods_lock` a
 terminal leaf — enforced by review only until now. This is a simple AST
-pass over ``tpushare/cache/`` and ``tpushare/core/native/`` that finds
+pass over ``tpushare/cache/``, ``tpushare/core/native/``,
+``tpushare/controller/`` and ``tpushare/defrag/`` that finds
 every syntactically NESTED lock acquisition (``with <lock>:`` inside
 ``with <lock>:`` in the same function) and asserts the ranks strictly
 increase, so a new lock (like the capacity index's) cannot silently
@@ -26,6 +27,8 @@ ROOT = os.path.dirname(HERE)
 SCOPES = (
     os.path.join(ROOT, "tpushare", "cache"),
     os.path.join(ROOT, "tpushare", "core", "native"),
+    os.path.join(ROOT, "tpushare", "controller"),
+    os.path.join(ROOT, "tpushare", "defrag"),
 )
 
 # (file basename, with-expression prefix) -> rank. Nested acquisitions
@@ -48,6 +51,19 @@ RANKS = {
     ("engine.py", "_lock"): 60,             # native loader
     ("engine.py", "_pool_lock"): 61,        # scan pool
     ("engine.py", "self._lock"): 62,        # FleetArena
+    # defrag (ISSUE 9): both are LEFTMOST like the batch window lock —
+    # pure bookkeeping (budget/backoff/in-flight; inspect state), never
+    # held across a solve, an eviction, or any cache/node call. The
+    # planner holds nothing at all.
+    ("executor.py", "self._lock"): 3,       # defrag budget governor
+    ("rebalancer.py", "self._lock"): 4,     # defrag inspect state
+    # controller: the informer's seen-set and the workqueue condition
+    # never nest with the cache chain (handlers are called lock-free)
+    # or with each other today; seen-set < queue so a future requeue-
+    # under-seen-set would pass and the reverse would red-line
+    ("controller.py", "self._seen_lock"): 6,
+    ("controller.py", "self._queue._lock"): 7,
+    ("workqueue.py", "self._lock"): 7,      # the same Condition object
 }
 
 _LOCKISH = re.compile(r"(?:^|[._])(?:[a-z_]*lock[a-z_]*)(?:$|\()|for_key\(")

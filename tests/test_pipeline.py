@@ -28,6 +28,7 @@ def _tokens(cfg, batch, seq=12, seed=1):
                               0, cfg.vocab)
 
 
+@pytest.mark.tpu_kernel
 def test_dense_parity_two_stages():
     cfg = PRESETS["llama-tiny"]
     params = init_params(cfg, jax.random.key(0))
@@ -40,6 +41,7 @@ def test_dense_parity_two_stages():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.tpu_kernel
 def test_dense_parity_four_stages_more_microbatches():
     cfg = dataclasses.replace(PRESETS["llama-tiny"], n_layers=4)
     params = init_params(cfg, jax.random.key(2))
@@ -52,6 +54,7 @@ def test_dense_parity_four_stages_more_microbatches():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.tpu_kernel
 def test_moe_parity_dropless():
     cfg = PRESETS["llama-moe-tiny"]
     # dropless per microbatch (capacity_factor >= E/top_k), so routing is
@@ -68,6 +71,7 @@ def test_moe_parity_dropless():
     assert np.isfinite(float(aux)) and float(aux) > 0
 
 
+@pytest.mark.tpu_kernel
 def test_gradients_match_sequential():
     cfg = PRESETS["llama-tiny"]
     params = init_params(cfg, jax.random.key(0))
@@ -91,6 +95,7 @@ def test_gradients_match_sequential():
         np.testing.assert_allclose(gp, gs, rtol=5e-2, atol=5e-3)
 
 
+@pytest.mark.tpu_kernel
 def test_pipelined_train_step_learns():
     cfg = PRESETS["llama-tiny"]
     params = init_params(cfg, jax.random.key(0))

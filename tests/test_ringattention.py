@@ -37,6 +37,7 @@ def assert_close(a, b, atol=2e-2):
                                atol=atol, rtol=2e-2)
 
 
+@pytest.mark.tpu_kernel
 def test_ring_matches_reference_causal():
     mesh = sp_mesh()
     q, k, v = rand_qkv(jax.random.key(0))
@@ -45,6 +46,7 @@ def test_ring_matches_reference_causal():
     assert_close(out, attention_reference(q, k, v, causal=True))
 
 
+@pytest.mark.tpu_kernel
 def test_ring_matches_reference_non_causal():
     mesh = sp_mesh()
     q, k, v = rand_qkv(jax.random.key(1), S=128)
@@ -52,6 +54,7 @@ def test_ring_matches_reference_non_causal():
     assert_close(out, attention_reference(q, k, v, causal=False))
 
 
+@pytest.mark.tpu_kernel
 def test_ring_fp32_tight_tolerance():
     mesh = sp_mesh()
     q, k, v = rand_qkv(jax.random.key(2), S=64, dtype=jnp.float32)
@@ -61,6 +64,7 @@ def test_ring_fp32_tight_tolerance():
         atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.tpu_kernel
 def test_ring_output_stays_sequence_sharded():
     # the result must come back sharded over sp — no hidden all-gather
     mesh = sp_mesh()
@@ -71,6 +75,7 @@ def test_ring_output_stays_sequence_sharded():
     assert out.sharding.is_equivalent_to(spec, out.ndim)
 
 
+@pytest.mark.tpu_kernel
 def test_ring_smaller_ring_sizes():
     devs = jax.devices()
     if len(devs) < 4:
@@ -106,6 +111,7 @@ def test_zigzag_order_roundtrip():
     assert list(fwd[:8]) == [0, 1, 2, 3, 28, 29, 30, 31]
 
 
+@pytest.mark.tpu_kernel
 def test_zigzag_matches_reference_causal():
     from tpushare.workloads.ringattention import (
         ring_attention, zigzag_inverse, zigzag_order)
@@ -122,6 +128,7 @@ def test_zigzag_matches_reference_causal():
     assert_close(out, ref)
 
 
+@pytest.mark.tpu_kernel
 def test_zigzag_matches_reference_noncausal():
     # NOTE: with causal=False the position bookkeeping is inert, so this
     # only checks permutation equivariance of the non-causal ring — the
@@ -140,6 +147,7 @@ def test_zigzag_matches_reference_noncausal():
                  attention_reference(q, k, v, causal=False))
 
 
+@pytest.mark.tpu_kernel
 def test_zigzag_matches_reference_causal_small_ring():
     # second causal shape on a SMALLER ring (n=2): different half-chunk
     # arithmetic ((2n-1-r) offsets) than the n=8 case
@@ -166,6 +174,7 @@ def test_zigzag_rejects_odd_chunk():
         ring_attention(q, k, v, mesh, causal=True, zigzag=True)
 
 
+@pytest.mark.tpu_kernel
 def test_ring_gqa_native_matches_expanded_reference():
     """GQA-native ring: k/v carry the SMALL head count through the ring
     (1/G of the ppermute bytes per hop) and must match the reference on

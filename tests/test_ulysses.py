@@ -23,6 +23,7 @@ def _qkv(B=2, H=8, S=64, D=16, seed=0):
             jax.random.normal(kv, (B, H, S, D), jnp.float32))
 
 
+@pytest.mark.tpu_kernel
 @pytest.mark.parametrize("n", [2, 4, 8])
 @pytest.mark.parametrize("causal", [True, False])
 def test_matches_reference(n, causal):
@@ -35,6 +36,7 @@ def test_matches_reference(n, causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.tpu_kernel
 def test_agrees_with_ring_attention():
     q, k, v = _qkv(seed=3)
     mesh = _mesh(8)
@@ -44,6 +46,7 @@ def test_agrees_with_ring_attention():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.tpu_kernel
 def test_sharded_inputs_stay_sharded():
     q, k, v = _qkv(seed=5)
     mesh = _mesh(4)
@@ -63,6 +66,7 @@ def test_rejects_indivisible_shapes():
         ulysses_attention(q, k, v, mesh)
 
 
+@pytest.mark.tpu_kernel
 def test_differentiable():
     q, k, v = _qkv(B=1, H=4, S=32, D=8, seed=7)
     mesh = _mesh(4)
@@ -80,6 +84,7 @@ def test_differentiable():
                                    rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.tpu_kernel
 def test_ulysses_flash_matches_einsum_path():
     """attn='flash' (the fused-kernel TPU serving path; interpret mode
     here) must match the einsum spec path on the same sharded inputs."""
@@ -94,6 +99,7 @@ def test_ulysses_flash_matches_einsum_path():
         ulysses_attention(q, k, v, mesh, attn="nope")
 
 
+@pytest.mark.tpu_kernel
 def test_ulysses_window_matches_reference():
     """Sequence-parallel + sliding window: the all_to_all re-shard hands
     each device the FULL sequence, so the window applies unchanged; both
@@ -115,6 +121,7 @@ def test_ulysses_window_matches_reference():
                                    err_msg=f"attn={attn}")
 
 
+@pytest.mark.tpu_kernel
 def test_ulysses_gqa_native_matches_expanded_reference():
     """GQA-native Ulysses: the kv all_to_all moves the SMALL heads (1/G
     of the expanded bytes) and the per-device head blocks align exactly;

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import pytest
+
 from tpushare.workloads.vit import (
     PRESETS_VIT, ViTConfig, init_vit_params, make_vit_train_step,
     patchify, vit_forward, vit_param_specs)
@@ -46,6 +48,7 @@ def test_patch_embed_is_exactly_the_strided_conv():
                                atol=2e-2, rtol=2e-2)
 
 
+@pytest.mark.tpu_kernel
 def test_flash_attention_drop_in():
     import dataclasses
     cfg_f = dataclasses.replace(CFG, attn="flash").validate()

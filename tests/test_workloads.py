@@ -158,6 +158,7 @@ def test_gating_pins_process_bounds_for_visible_chips():
     assert "TPU_PROCESS_BOUNDS" not in apply_hbm_gating(env2)
 
 
+@pytest.mark.tpu_kernel
 def test_attn_window_config_flash_matches_einsum():
     """cfg.attn_window must produce the same model outputs through both
     attention backends (the einsum mask and the flash kernel's window
@@ -180,6 +181,7 @@ def test_attn_window_config_flash_matches_einsum():
     assert float(jnp.max(jnp.abs(full - ref))) > 1e-3
 
 
+@pytest.mark.tpu_kernel
 def test_player_modes_run():
     # the player is what sample pods actually execute; all three modes
     # must drive end to end on the hermetic mesh (train = gang member,

@@ -1,0 +1,27 @@
+"""Live defragmentation: the repack rebalancer (ROADMAP item 3).
+
+PR 6 made stranded contiguous capacity *visible*
+(``tpushare_fleet_stranded_hbm_mib``); PR 5/7 made what-if placement
+*cheap* (capacity index, native batch solves). This package *acts*:
+
+- :mod:`.planner`  — stamped repack plans from the stranded-gap picture
+  (pure core shared with :mod:`tpushare.sim.defrag`);
+- :mod:`.executor` — budget-governed, stamp-revalidated move execution
+  over the restore/drain eviction paths;
+- :mod:`.rebalancer` — the background controller the extender server
+  starts/stops (``TPUSHARE_DEFRAG=0`` opts out), serving
+  ``GET /inspect/defrag``.
+"""
+
+from .executor import (DEFRAG_DEMOTIONS, DEFRAG_FREED, DEFRAG_MOVES,
+                       DefragExecutor)
+from .planner import (ANN_MOVABLE, DEFRAG_PLANS, DefragPlanner, Move,
+                      NodeState, RepackPlan, Victim, plan_moves)
+from .rebalancer import DefragController
+
+__all__ = [
+    "ANN_MOVABLE",
+    "DEFRAG_DEMOTIONS", "DEFRAG_FREED", "DEFRAG_MOVES", "DEFRAG_PLANS",
+    "DefragController", "DefragExecutor", "DefragPlanner",
+    "Move", "NodeState", "RepackPlan", "Victim", "plan_moves",
+]

@@ -1,0 +1,325 @@
+"""Move execution under a migration-cost budget, with stamp
+revalidation before every eviction.
+
+A repack plan is speculative twice over: the planner read stamped
+snapshots that may be stale by execution time, and the eviction itself
+races live binds. The executor closes both windows:
+
+1. **Pre-eviction stamp revalidation** — before ANY write, both nodes'
+   current ``(epoch, counter)`` stamps are compared against the plan's
+   pins. A mismatch means a bind/remove landed since planning: the move
+   is DEMOTED (``tpushare_defrag_demotions_total``), never executed —
+   the next planning pass re-derives it from fresh state. Eviction is
+   irreversible in a way a stale solve is not, so the demotion check is
+   on the far side of the line.
+2. **In-lock target revalidation** — the replacement pod is placed via
+   ``NodeInfo.allocate(hint=..., hint_stamp=..., hint_speculative=True)``:
+   the same under-the-node-lock stamp check that guards batch-solve
+   members. A bind that slips between our revalidation and the
+   allocate demotes the hint to a fresh search; worst case the
+   replacement lands on different chips — never on top of someone.
+
+The **budget governor** bounds disruption: ``TPUSHARE_DEFRAG_BUDGET``
+moves per ``TPUSHARE_DEFRAG_WINDOW_S`` rolling window, one in-flight
+move per node, and a per-node backoff (``TPUSHARE_DEFRAG_BACKOFF_S``)
+after a failed move so a persistently un-movable workload cannot eat
+the whole budget every window.
+
+Two eviction paths, selected by the victim's movability annotation
+(see planner.ANN_MOVABLE):
+
+- **restore** (``"true"``/``"checkpoint"``): delete the source pod,
+  recreate it unbound (placement annotations stripped) and allocate it
+  on the target — the annotation-level contract of a checkpoint/restore
+  migration. A ``checkpoint_hook(pod, move)`` seam lets deployments
+  wire the actual state transfer (``workloads/checkpoint.py`` cross-mesh
+  restore + the serve engine); the scheduler layer stays import-clean
+  of jax.
+- **drain** (``"drain"``): delete the pod and stop — its workload
+  controller recreates it and the normal scheduling path (which now
+  sees the defragmented node) places the successor. This is the
+  preempt-verb path without the priority fight.
+
+A failed restore rolls back: the original pod (original placement
+annotations, original node) is re-created and re-accounted, so the
+fleet is never left with a workload evicted-but-not-restored.
+
+The executor's single lock guards only budget/backoff/in-flight
+bookkeeping and is NEVER held across a solve, an eviction, or any
+cache/node call — leftmost in the lock order, like the batch window
+lock (tests/test_lock_order_lint.py).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from tpushare.contract import pod as podlib
+from tpushare.metrics import Counter, LabeledCounter
+from tpushare.obs.trace import TRACER
+
+from .planner import Move, RepackPlan
+
+log = logging.getLogger("tpushare.defrag")
+
+# move outcomes are a CLOSED enum (label cardinality):
+#   completed       — victim relocated (or drained) and accounted
+#   failed          — eviction/restore raised; original state restored
+#   demoted         — a stamp moved since planning; nothing was touched
+#   skipped_budget  — the window's move budget is spent
+#   skipped_backoff — a touched node is in post-failure backoff
+#   skipped_inflight— a touched node already has a move in flight
+DEFRAG_MOVES = LabeledCounter(
+    "tpushare_defrag_moves_total",
+    "Repack move executions by outcome (completed / failed / demoted / "
+    "skipped_budget / skipped_backoff / skipped_inflight). Sustained "
+    "'failed' or 'demoted' means the fleet is too hot to repack — stop "
+    "the controller and inspect the plan (docs/ops.md)",
+    ("outcome",))
+DEFRAG_DEMOTIONS = Counter(
+    "tpushare_defrag_demotions_total",
+    "Moves demoted by stamp revalidation: a concurrent bind/remove "
+    "changed a pinned node between planning and eviction, so the move "
+    "was dropped un-executed. The oversubscription guard FIRING, not "
+    "failing — but a high sustained rate means the defrag period is "
+    "too slow for the fleet's churn")
+DEFRAG_FREED = Counter(
+    "tpushare_defrag_freed_chips_total",
+    "Estimated contiguous chips recovered by completed repack moves "
+    "(the planner's per-move gain at the source node's worst tier; "
+    "compare with the tpushare_fleet_stranded_hbm_mib gauge trending "
+    "down)")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _strip_placement(pod: dict[str, Any]) -> dict[str, Any]:
+    """A deep copy of ``pod`` with binding + placement state removed —
+    the unbound replacement the restore path re-schedules. Identity
+    (uid, namespace, name) and the workload's own annotations survive."""
+    from tpushare import contract
+    rep = copy.deepcopy(pod)
+    rep.get("spec", {}).pop("nodeName", None)
+    ann = (rep.get("metadata") or {}).get("annotations") or {}
+    for key in (contract.ANN_CHIP_IDS, contract.ANN_HBM_POD,
+                contract.ANN_HBM_CHIP, contract.ANN_ASSIGNED,
+                contract.ANN_ASSUME_TIME):
+        ann.pop(key, None)
+    rep.get("metadata", {}).pop("resourceVersion", None)
+    rep["status"] = {}
+    return rep
+
+
+class DefragExecutor:
+    """Budget-governed, stamp-revalidated move execution."""
+
+    def __init__(self, cache, cluster,
+                 budget: int | None = None,
+                 window_s: float | None = None,
+                 backoff_s: float | None = None,
+                 explain=None,
+                 checkpoint_hook: Callable[[dict, Move], None] | None = None,
+                 time_fn: Callable[[], float] = time.monotonic) -> None:
+        self._cache = cache
+        self._cluster = cluster
+        self._explain = explain
+        self._checkpoint_hook = checkpoint_hook
+        self._time = time_fn
+        self.budget = int(_env_float("TPUSHARE_DEFRAG_BUDGET", 4)) \
+            if budget is None else budget
+        self.window_s = _env_float("TPUSHARE_DEFRAG_WINDOW_S", 60.0) \
+            if window_s is None else window_s
+        self.backoff_s = _env_float("TPUSHARE_DEFRAG_BACKOFF_S", 120.0) \
+            if backoff_s is None else backoff_s
+        # guards ONLY the bookkeeping below; never held across a solve,
+        # an eviction or any cache/node call (lock-order: leftmost)
+        self._lock = threading.Lock()
+        self._window_started: float | None = None
+        self._window_used = 0
+        self._backoff: dict[str, float] = {}   # node -> retry-after time
+        self._inflight: set[str] = set()       # nodes with a move running
+
+    # -- budget governor ------------------------------------------------------
+
+    def budget_state(self) -> dict[str, Any]:
+        now = self._time()
+        with self._lock:
+            remaining = None
+            if self._window_started is not None:
+                remaining = max(
+                    self.window_s - (now - self._window_started), 0.0)
+            return {
+                "budget": self.budget,
+                "window_s": self.window_s,
+                "used_in_window": self._window_used,
+                "window_remaining_s": round(remaining, 3)
+                if remaining is not None else None,
+                "backoff_nodes": sorted(
+                    n for n, t in self._backoff.items() if t > now),
+                "inflight_nodes": sorted(self._inflight),
+            }
+
+    def _admit(self, move: Move) -> str | None:
+        """Budget/backoff/in-flight gate; returns the skip outcome or
+        None (admitted — the window slot is consumed and both nodes are
+        marked in flight)."""
+        now = self._time()
+        with self._lock:
+            if self._window_started is None \
+                    or now - self._window_started >= self.window_s:
+                self._window_started = now
+                self._window_used = 0
+            if self._window_used >= self.budget:
+                return "skipped_budget"
+            for node in (move.source, move.target):
+                if self._backoff.get(node, 0.0) > now:
+                    return "skipped_backoff"
+            if self._inflight & {move.source, move.target}:
+                return "skipped_inflight"
+            self._window_used += 1
+            self._inflight.update((move.source, move.target))
+            return None
+
+    def _settle(self, move: Move, failed: bool) -> None:
+        now = self._time()
+        with self._lock:
+            self._inflight.difference_update((move.source, move.target))
+            if failed:
+                self._backoff[move.source] = now + self.backoff_s
+                self._backoff[move.target] = now + self.backoff_s
+            # drop expired entries so the map cannot grow unboundedly
+            self._backoff = {n: t for n, t in self._backoff.items()
+                             if t > now}
+
+    # -- stamp revalidation ---------------------------------------------------
+
+    def _revalidate(self, move: Move) -> dict[str, Any] | None:
+        """The pinned stamps against live node state, plus the victim's
+        identity; returns the pod or None (= demoted)."""
+        src = self._cache.peek_node(move.source)
+        tgt = self._cache.peek_node(move.target)
+        if src is None or src.version != move.source_stamp:
+            return None
+        if tgt is None or tgt.version != move.target_stamp:
+            return None
+        pod = self._cache.pod_by_key(move.pod_key)
+        if pod is None or podlib.pod_node_name(pod) != move.source:
+            return None
+        return pod
+
+    # -- the move itself ------------------------------------------------------
+
+    def _evict(self, pod: dict[str, Any]) -> None:
+        ns, name = podlib.pod_namespace(pod), podlib.pod_name(pod)
+        self._cluster.delete_pod(ns, name)
+        self._cache.remove_pod(pod)
+
+    def _restore_source(self, original: dict[str, Any]) -> None:
+        """Failed move rollback: the victim returns to its source node
+        with its original placement annotations, apiserver and cache."""
+        ns, name = (podlib.pod_namespace(original),
+                    podlib.pod_name(original))
+        try:
+            self._cluster.delete_pod(ns, name)  # half-created replacement
+        except Exception:  # noqa: BLE001 — may simply not exist
+            pass
+        back = copy.deepcopy(original)
+        back.get("metadata", {}).pop("resourceVersion", None)
+        self._cluster.create_pod(back)
+        self._cache.add_or_update_pod(back)
+
+    def _place_replacement(self, pod: dict[str, Any], move: Move) -> None:
+        """Create the unbound replacement and allocate it on the target
+        with the plan's placement as a STAMPED hint — the in-lock
+        revalidation demotes the hint (fresh search, same node) if the
+        target mutated after our pre-eviction check."""
+        rep = _strip_placement(pod)
+        self._cluster.create_pod(rep)
+        info = self._cache.get_node_info(move.target)
+        info.allocate(rep, self._cluster,
+                      hint=move.placement,
+                      hint_stamp=move.target_stamp,
+                      hint_speculative=True)
+        ns, name = podlib.pod_namespace(rep), podlib.pod_name(rep)
+        # re-account from apiserver truth (bound + placement-annotated)
+        # so the cache's known-pods map tracks the pod's new incarnation
+        # even when no controller/informer is wired (tests, bench)
+        self._cache.add_or_update_pod(self._cluster.get_pod(ns, name))
+
+    def execute_move(self, move: Move) -> dict[str, Any]:
+        """Run one move end to end; returns its outcome record."""
+        outcome = self._admit(move)
+        if outcome is not None:
+            DEFRAG_MOVES.inc(outcome)
+            return {"move": move.to_dict(), "outcome": outcome}
+        error: str | None = None
+        pod = self._revalidate(move)
+        if pod is None:
+            self._settle(move, failed=False)
+            DEFRAG_DEMOTIONS.inc()
+            DEFRAG_MOVES.inc("demoted")
+            return {"move": move.to_dict(), "outcome": "demoted"}
+        identity = {"namespace": podlib.pod_namespace(pod),
+                    "name": podlib.pod_name(pod),
+                    "uid": podlib.pod_uid(pod)}
+        original = copy.deepcopy(pod)
+        trace = TRACER.join_or_begin(move.pod_key, pod)
+        outcome = "completed"
+        try:
+            with TRACER.root_span(trace, "defrag.move",
+                                  source=move.source, target=move.target,
+                                  mode=move.mode,
+                                  gain_chips=move.gain_chips) as sp:
+                if self._checkpoint_hook is not None \
+                        and move.mode == "restore":
+                    self._checkpoint_hook(pod, move)
+                self._evict(pod)
+                sp.annotate("evicted", node=move.source,
+                            chips=list(move.victim_chip_ids))
+                if move.mode == "restore":
+                    try:
+                        self._place_replacement(pod, move)
+                    except Exception as e:
+                        self._restore_source(original)
+                        sp.annotate("restored_to_source",
+                                    error=str(e))
+                        raise
+                    sp.annotate("placed", node=move.target,
+                                chips=list(move.placement.chip_ids))
+        except Exception as e:  # noqa: BLE001 — a move must never crash
+            outcome = "failed"
+            error = str(e)
+            log.warning("defrag: move of %s %s -> %s failed: %s",
+                        move.pod_key, move.source, move.target, e)
+        finally:
+            self._settle(move, failed=outcome == "failed")
+        DEFRAG_MOVES.inc(outcome)
+        if outcome == "completed":
+            DEFRAG_FREED.inc(move.gain_chips)
+        trace_id = trace.trace_id if trace is not None else None
+        if self._explain is not None:
+            self._explain.record_bind(
+                move.pod_key, identity, trace_id,
+                node=move.target if move.mode == "restore" else move.source,
+                outcome=f"defrag_{outcome}", error=error,
+                chip_ids=list(move.placement.chip_ids)
+                if outcome == "completed" and move.mode == "restore"
+                else None)
+        TRACER.finish(move.pod_key, f"defrag_{outcome}")
+        return {"move": move.to_dict(), "outcome": outcome,
+                **({"error": error} if error else {})}
+
+    def execute(self, plan: RepackPlan) -> list[dict[str, Any]]:
+        """Execute a plan's moves serially (one eviction at a time —
+        bounded disruption is the point) and return their outcomes."""
+        return [self.execute_move(m) for m in plan.moves]

@@ -1,0 +1,389 @@
+"""Repack planning: turn stranded-gap telemetry into a stamped move list.
+
+The capacity index already *measures* the vector failure mode — chips
+that pass a tier's aggregate count fit but sit outside the largest
+contiguous sub-box (docs/pd.md §1.3's "4 free chips with no free 2x2",
+surfaced fleet-wide as ``tpushare_fleet_stranded_hbm_mib``). This module
+decides what to DO about it: for each fragmented node, which resident
+placement to move where so the node's largest contiguous box grows.
+
+Two layers, deliberately split:
+
+- :func:`plan_moves` is the PURE core — it sees only
+  :class:`NodeState` records (stamped chip views + movable victims) and
+  a ``solve`` callback, holds no locks and touches no cache, so the
+  simulator (:mod:`tpushare.sim.defrag`) drives the exact same
+  planning logic the live controller runs, and property tests can feed
+  it synthetic fleets.
+- :class:`DefragPlanner` binds the core to a live
+  :class:`~tpushare.cache.cache.SchedulerCache`: node states come from
+  ``CapacityIndex.summaries_snapshot()`` + ``NodeInfo.audit_snapshot``/
+  ``stamped_snapshot`` (stamp-checked against each other — a node that
+  mutated mid-read is skipped, not planned on stale state), and the
+  solve callback is ``SchedulerCache.solve_batch`` — the SAME
+  index-pruned native what-if machinery the batch scheduler uses, so a
+  repack target is found exactly as a real bind would find it.
+
+Every move is stamp-pinned to the (epoch, counter) generation of BOTH
+nodes it touches. The plan is speculative by construction: the executor
+revalidates the stamps before any eviction, and
+``NodeInfo.allocate(hint_stamp=...)`` re-checks the target under the
+node lock — a concurrent bind demotes the move, never oversubscribes.
+
+Movability is opt-in per pod: ``tpushare.aliyun.com/movable`` must be
+``"true"``/``"checkpoint"`` (checkpoint/restore replacement; see
+executor) or ``"drain"`` (delete-and-let-the-controller-recreate).
+Unannotated pods are never touched — a rebalancer that surprises
+stateful workloads is worse than fragmentation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+from tpushare.cache.index import EXCL_TIER, TIERS, max_box_size, tier_label
+from tpushare.cache.nodeinfo import request_from_pod
+from tpushare.contract import pod as podlib
+from tpushare.core.chips import ChipView
+from tpushare.core.placement import Placement, PlacementRequest
+from tpushare.core.topology import MeshTopology
+from tpushare.metrics import LabeledCounter
+
+# pod-level opt-in: how (whether) the defrag executor may relocate it
+ANN_MOVABLE = "tpushare.aliyun.com/movable"
+MOVABLE_RESTORE = ("true", "checkpoint")
+MOVABLE_DRAIN = ("drain",)
+
+# plan outcomes are a CLOSED enum (label cardinality):
+#   planned — at least one admissible move was produced
+#   empty   — no fragmented node had a movable victim with positive gain
+DEFRAG_PLANS = LabeledCounter(
+    "tpushare_defrag_plans_total",
+    "Repack planning passes by outcome (planned = the pass produced at "
+    "least one stamped move; empty = no fragmented node offered a "
+    "movable victim whose relocation grows a contiguous box). A healthy "
+    "unfragmented fleet shows only 'empty'",
+    ("outcome",))
+
+
+@dataclass(frozen=True)
+class Victim:
+    """One resident placement on a fragmented node, as the planner sees
+    it: which chips it confirms, how much per-chip HBM it holds, the
+    request a replacement pod would re-issue, and how it may move."""
+
+    pod_key: str
+    chip_ids: tuple[int, ...]
+    per_chip_mib: int
+    request: PlacementRequest
+    mode: str = "restore"        # "restore" | "drain"
+    movable: bool = True
+
+
+@dataclass
+class NodeState:
+    """A fragmented node at ONE generation stamp: chip views and victim
+    list read under the same stamp, so every derived quantity (tier
+    eligibility, contiguous box, per-victim gain) describes a single
+    consistent instant."""
+
+    name: str
+    stamp: tuple[int, int]
+    topo: MeshTopology
+    hbm_per_chip: int
+    views: list[ChipView]
+    victims: list[Victim] = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class Move:
+    """One planned relocation, stamp-pinned to the reads that justify
+    it. ``gain_chips`` is the estimated growth of the source node's
+    largest contiguous box at ``tier`` once the victim leaves."""
+
+    pod_key: str
+    source: str
+    source_stamp: tuple[int, int]
+    target: str
+    target_stamp: tuple[int, int]
+    placement: Placement
+    victim_chip_ids: tuple[int, ...]
+    per_chip_mib: int
+    gain_chips: int
+    tier: int
+    mode: str = "restore"
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "pod_key": self.pod_key,
+            "source": self.source,
+            "source_stamp": list(self.source_stamp),
+            "target": self.target,
+            "target_stamp": list(self.target_stamp),
+            "target_chip_ids": list(self.placement.chip_ids),
+            "victim_chip_ids": list(self.victim_chip_ids),
+            "per_chip_mib": self.per_chip_mib,
+            "gain_chips": self.gain_chips,
+            "tier": tier_label(self.tier),
+            "mode": self.mode,
+        }
+
+
+@dataclass
+class RepackPlan:
+    """A planning pass's output: ordered moves plus the fragmentation
+    picture that motivated them (for /inspect/defrag and the bench's
+    recovery accounting)."""
+
+    moves: list[Move] = field(default_factory=list)
+    fragmented_nodes: int = 0
+    stranded_chips_before: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "moves": [m.to_dict() for m in self.moves],
+            "fragmented_nodes": self.fragmented_nodes,
+            "stranded_chips_before": self.stranded_chips_before,
+        }
+
+
+# -- tier geometry over plain chip views --------------------------------------
+
+def eligible_at_tier(views: list[ChipView], tier: int) -> set[int]:
+    """Chip ids whose free HBM admits ``tier`` (same eligibility rule
+    the capacity index summarizes: the exclusive pseudo-tier wants
+    completely untouched chips)."""
+    if tier == EXCL_TIER:
+        return {v.idx for v in views if v.healthy and v.used_hbm_mib == 0}
+    return {v.idx for v in views
+            if v.healthy and v.free_hbm_mib >= TIERS[tier]}
+
+
+def _views_without(views: list[ChipView], victim: Victim) -> list[ChipView]:
+    """The node's chip views with the victim's usage lifted — the
+    what-if state the gain estimate is computed against."""
+    lift = set(victim.chip_ids)
+    return [v.with_used(max(v.used_hbm_mib - victim.per_chip_mib, 0))
+            if v.idx in lift else v for v in views]
+
+
+def worst_tier(state: NodeState) -> tuple[int, int, int]:
+    """(tier, stranded gap in chips, current contiguous box size) at the
+    node's WORST tier — gap valued in the tier's MiB, mirroring the
+    fleetwatch sampler's ranking so the planner chases exactly the
+    capacity the ``tpushare_fleet_stranded_hbm_mib`` gauge reports."""
+    best = (0, 0, 0)
+    best_mib = 0
+    for t in range(len(TIERS) + 1):
+        elig = eligible_at_tier(state.views, t)
+        contig = max_box_size(state.topo, elig)
+        gap = len(elig) - contig
+        mib = gap * (state.hbm_per_chip if t == EXCL_TIER else TIERS[t])
+        if mib > best_mib:
+            best_mib = mib
+            best = (t, gap, contig)
+    return best
+
+
+def victim_gain(state: NodeState, victim: Victim, tier: int,
+                contig_now: int) -> int:
+    """Contiguous chips the node's largest box at ``tier`` gains once
+    the victim's usage leaves (0 or negative = the move is pointless)."""
+    return _gain(state.views, state.topo, victim, tier, contig_now)
+
+
+def _gain(views: list[ChipView], topo: MeshTopology, victim: Victim,
+          tier: int, contig_now: int) -> int:
+    after = _views_without(views, victim)
+    return max_box_size(topo, eligible_at_tier(after, tier)) - contig_now
+
+
+# -- the pure planning core ---------------------------------------------------
+
+# solve(req, exclude_nodes, claimed_chips) -> (node, placement, stamp) | None
+SolveFn = Callable[
+    [PlacementRequest, set[str], Mapping[str, set[int]]],
+    "tuple[str, Placement, tuple[int, int]] | None"]
+
+
+def plan_moves(states: list[NodeState], solve: SolveFn,
+               max_moves: int, per_node: int = 1) -> RepackPlan:
+    """Compute a repack plan over stamped node states.
+
+    Worst-fragmented nodes first (stranded MiB at the node's worst
+    tier); per node, the movable victim with the LARGEST contiguous
+    gain and, among equals, the smallest footprint (cheapest eviction);
+    targets come from ``solve`` with the source excluded and chips
+    already claimed by earlier moves in THIS plan refused — a plan's
+    moves are pairwise disjoint by construction, like a batch solve's
+    members. Nodes an earlier move targeted are skipped as sources
+    (their stamp will change when that move lands; planning them now
+    would only manufacture demotions).
+
+    ``per_node`` allows several victims from one source in a single
+    plan — later victims' gains are computed with the earlier ones
+    already lifted (clearing a diagonal fh-frag node takes both
+    corners). The LIVE planner keeps the default 1: every executed
+    move bumps the source's stamp, so a sibling move pinned to the
+    same stamp would only demote; the simulator (which applies a
+    plan atomically) raises it to repack whole nodes per pass.
+    """
+    plan = RepackPlan()
+    ranked: list[tuple[int, NodeState, int, int, int]] = []
+    for st in states:
+        tier, gap, contig = worst_tier(st)
+        if gap <= 0:
+            continue
+        mib = gap * (st.hbm_per_chip if tier == EXCL_TIER else TIERS[tier])
+        ranked.append((mib, st, tier, gap, contig))
+    ranked.sort(key=lambda r: (-r[0], r[1].name))
+    plan.fragmented_nodes = len(ranked)
+    plan.stranded_chips_before = sum(r[3] for r in ranked)
+    claimed: dict[str, set[int]] = {}
+    for _mib, st, tier, _gap, contig in ranked:
+        if len(plan.moves) >= max_moves:
+            break
+        if st.name in claimed:
+            continue  # an earlier move already lands here: stamp will move
+        views = st.views
+        contig_cur = contig
+        moved: set[str] = set()
+        for _slot in range(max(per_node, 1)):
+            if len(plan.moves) >= max_moves:
+                break
+            best: tuple[int, int, Victim] | None = None
+            for v in st.victims:
+                if not v.movable or v.pod_key in moved:
+                    continue
+                gain = _gain(views, st.topo, v, tier, contig_cur)
+                if gain <= 0:
+                    continue
+                cost = len(v.chip_ids) * v.per_chip_mib
+                if best is None or (-gain, cost) < (-best[0], best[1]):
+                    best = (gain, cost, v)
+            if best is None:
+                break
+            gain, _cost, victim = best
+            resolved = solve(victim.request, {st.name}, claimed)
+            if resolved is None:
+                break
+            tname, placement, tstamp = resolved
+            claimed.setdefault(tname, set()).update(placement.chip_ids)
+            plan.moves.append(Move(
+                pod_key=victim.pod_key,
+                source=st.name, source_stamp=st.stamp,
+                target=tname, target_stamp=tstamp,
+                placement=placement,
+                victim_chip_ids=victim.chip_ids,
+                per_chip_mib=victim.per_chip_mib,
+                gain_chips=gain, tier=tier, mode=victim.mode))
+            moved.add(victim.pod_key)
+            views = _views_without(views, victim)
+            contig_cur = max_box_size(
+                st.topo, eligible_at_tier(views, tier))
+    return plan
+
+
+# -- the live planner ---------------------------------------------------------
+
+class DefragPlanner:
+    """Bind the pure core to a live SchedulerCache.
+
+    Lock-free by design: state collection reads stamped snapshots, and
+    the solve callback delegates to ``cache.solve_batch`` (which takes
+    its own locks per node, never ours) — the lock-order lint's
+    "leftmost, never held across solves" rule for this subsystem is
+    satisfied by simply holding nothing.
+    """
+
+    SOLVE_RETRIES = 3  # re-solve attempts when a target overlaps a claim
+
+    def __init__(self, cache,
+                 movable_fn: Callable[[dict], str | None] | None = None
+                 ) -> None:
+        self._cache = cache
+        self._movable_fn = movable_fn or self._movable_from_annotations
+
+    @staticmethod
+    def _movable_from_annotations(pod: dict[str, Any]) -> str | None:
+        """Default movability policy: the pod's own opt-in annotation,
+        or None (immovable)."""
+        raw = (podlib.annotations(pod).get(ANN_MOVABLE) or "").lower()
+        if raw in MOVABLE_RESTORE:
+            return "restore"
+        if raw in MOVABLE_DRAIN:
+            return "drain"
+        return None
+
+    def collect_states(self) -> list[NodeState]:
+        """Stamped NodeStates for every fragmented TPU node. A node
+        whose audit and view snapshots carry different stamps mutated
+        mid-read and is skipped — the next pass will see it settled."""
+        cache = self._cache
+        index = cache.index
+        index.flush()
+        states: list[NodeState] = []
+        for name, (_stamp, non_tpu, n_ge, contig_ge) \
+                in index.summaries_snapshot().items():
+            if non_tpu:
+                continue
+            if all(n <= c for n, c in zip(n_ge, contig_ge)):
+                continue  # no stranded gap at any tier
+            info = cache.peek_node(name)
+            if info is None:
+                continue
+            astamp, chips = info.audit_snapshot()
+            vstamp, views = info.stamped_snapshot()
+            if astamp != vstamp:
+                continue  # mutated between the two reads: not plannable
+            by_pod: dict[str, list[int]] = {}
+            per_chip: dict[str, int] = {}
+            for idx, entries in enumerate(chips):
+                for key, hbm in entries.items():
+                    by_pod.setdefault(key, []).append(idx)
+                    per_chip[key] = max(per_chip.get(key, 0), hbm)
+            victims: list[Victim] = []
+            for key, ids in by_pod.items():
+                pod = cache.pod_by_key(key)
+                if pod is None:
+                    continue  # identity unknown: cannot be re-placed
+                mode = self._movable_fn(pod)
+                req = request_from_pod(pod)
+                if mode is None or req is None:
+                    continue
+                victims.append(Victim(
+                    pod_key=key, chip_ids=tuple(sorted(ids)),
+                    per_chip_mib=per_chip[key], request=req, mode=mode))
+            states.append(NodeState(
+                name=name, stamp=vstamp, topo=info.topology,
+                hbm_per_chip=info.hbm_per_chip,
+                views=list(views), victims=victims))
+        return states
+
+    def _solve(self, req: PlacementRequest, exclude: set[str],
+               claimed: Mapping[str, set[int]]
+               ) -> tuple[str, Placement, tuple[int, int]] | None:
+        """One what-if target via the batch-solve machinery, refusing
+        nodes whose best placement overlaps chips an earlier move in
+        this plan already claimed."""
+        names = [n for n in self._cache.node_names() if n not in exclude]
+        for _ in range(self.SOLVE_RETRIES):
+            if not names:
+                return None
+            got = self._cache.solve_batch(req, names, 1)
+            if not got:
+                return None
+            name, placement, stamp = got[0]
+            if set(placement.chip_ids) & claimed.get(name, set()):
+                names = [n for n in names if n != name]
+                continue
+            return name, placement, stamp
+        return None
+
+    def plan(self, max_moves: int) -> RepackPlan:
+        """One planning pass: collect fragmented node states, run the
+        pure core against the live what-if solver."""
+        plan = plan_moves(self.collect_states(), self._solve, max_moves)
+        DEFRAG_PLANS.inc("planned" if plan.moves else "empty")
+        return plan

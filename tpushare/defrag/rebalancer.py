@@ -1,0 +1,141 @@
+"""The defrag controller: one background thread pacing plan -> execute.
+
+Lifecycle mirrors :class:`~tpushare.obs.fleetwatch.FleetWatch` — the
+extender server constructs one per process, starts it with the HTTP
+listener (``TPUSHARE_DEFRAG=0`` opts out) and stops it on shutdown.
+Every ``TPUSHARE_DEFRAG_PERIOD_S`` (default 30 s) it runs one pass:
+the planner derives a stamped repack plan from the capacity index's
+stranded-gap picture, the executor carries it out under the migration
+budget, and the controller keeps the last plan + last-N move outcomes
+for ``GET /inspect/defrag``.
+
+Every pass is also available synchronously (:meth:`run_once`) so tests
+and bench drive the identical code path without threads or sleeps.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from .executor import (DEFRAG_DEMOTIONS, DEFRAG_FREED, DEFRAG_MOVES,
+                       DefragExecutor, _env_float)
+from .planner import DEFRAG_PLANS, DefragPlanner
+
+
+class DefragController:
+    """Planner + executor + pacing thread + /inspect/defrag state."""
+
+    LAST_MOVES = 32  # move outcomes retained for the inspect endpoint
+
+    def __init__(self, cache, cluster=None,
+                 period_s: float | None = None,
+                 planner: DefragPlanner | None = None,
+                 executor: DefragExecutor | None = None,
+                 explain=None,
+                 time_fn: Callable[[], float] = time.monotonic) -> None:
+        self.period_s = _env_float("TPUSHARE_DEFRAG_PERIOD_S", 30.0) \
+            if period_s is None else period_s
+        self.planner = planner or DefragPlanner(cache)
+        self.executor = executor or DefragExecutor(
+            cache, cluster, explain=explain, time_fn=time_fn)
+        self._time = time_fn
+        # guards only the inspect-state below; never held across a
+        # planning pass or a move (lock-order: leftmost, like the
+        # executor's — the two never nest)
+        self._lock = threading.Lock()
+        self._last_plan: dict[str, Any] | None = None
+        self._last_plan_at: float | None = None
+        self._moves: deque[dict[str, Any]] = deque(maxlen=self.LAST_MOVES)
+        self._passes = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- one pass -------------------------------------------------------------
+
+    def run_once(self) -> dict[str, Any]:
+        """Plan and execute one pass synchronously; returns the pass
+        summary (also retained for /inspect/defrag)."""
+        plan = self.planner.plan(max_moves=self.executor.budget)
+        outcomes = self.executor.execute(plan) if plan.moves else []
+        summary = {"plan": plan.to_dict(),
+                   "executed": len(outcomes),
+                   "outcomes": [o["outcome"] for o in outcomes]}
+        with self._lock:
+            self._passes += 1
+            self._last_plan = summary["plan"]
+            self._last_plan_at = self._time()
+            self._moves.extend(outcomes)
+        return summary
+
+    # -- GET /inspect/defrag --------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        now = self._time()
+        with self._lock:
+            last_plan = self._last_plan
+            age = (round(now - self._last_plan_at, 3)
+                   if self._last_plan_at is not None else None)
+            moves = list(self._moves)
+            passes = self._passes
+        plans = {k[0]: v for k, v in DEFRAG_PLANS.snapshot().items()}
+        move_totals = {k[0]: v for k, v in DEFRAG_MOVES.snapshot().items()}
+        return {
+            "running": self._thread is not None,
+            "period_s": self.period_s,
+            "passes": passes,
+            "plan_age_s": age,
+            "plan": last_plan,
+            "budget": self.executor.budget_state(),
+            "recent_moves": moves,
+            "counters": {
+                "plans_total": plans,
+                "moves_total": move_totals,
+                "demotions_total": DEFRAG_DEMOTIONS.value,
+                "freed_chips_total": DEFRAG_FREED.value,
+            },
+        }
+
+    # -- metrics --------------------------------------------------------------
+
+    def attach(self, registry) -> None:
+        registry.register(DEFRAG_PLANS)
+        registry.register(DEFRAG_MOVES)
+        registry.register(DEFRAG_DEMOTIONS)
+        registry.register(DEFRAG_FREED)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @staticmethod
+    def enabled() -> bool:
+        """The server-side opt-out knob (docs/ops.md)."""
+        return os.environ.get("TPUSHARE_DEFRAG", "1") != "0"
+
+    def start(self) -> "DefragController":
+        if self._thread is not None or self.period_s <= 0:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpushare-defrag", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        # wait one period BEFORE the first pass: at process start the
+        # cache is still replaying / the informer syncing, and a repack
+        # decided against a half-built picture is all demotions
+        while not self._stop.wait(self.period_s):
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — the rebalancer must survive
+                pass

@@ -75,6 +75,16 @@ class ExtenderServer:
                                      informer=informer)
         self.explain.observer = self.fleetwatch.scorecard
         self.fleetwatch.attach(self.registry)
+        # live defragmentation (defrag/): the repack rebalancer consumes
+        # the same capacity-index stranded-gap picture the fleetwatch
+        # gauges publish and acts on it under a migration budget, behind
+        # GET /inspect/defrag. Background thread starts with the server
+        # (TPUSHARE_DEFRAG=0 opts out); decisions land in the explain
+        # audit and the cycle tracer like any scheduling verdict.
+        from tpushare.defrag import DefragController
+        self.defrag = DefragController(cache, cluster=cluster,
+                                       explain=self.explain)
+        self.defrag.attach(self.registry)
         # multi-host gang placement (docs/designs/multihost-gang.md):
         # engages only for pods carrying the gang annotations, on nodes
         # labeled into slices — zero cost otherwise
@@ -246,6 +256,9 @@ class ExtenderServer:
                             self.path == f"{PREFIX}/inspect/fleet":
                         self._reply(200,
                                     server_self.fleetwatch.snapshot())
+                    elif self.path == "/inspect/defrag" or \
+                            self.path == f"{PREFIX}/inspect/defrag":
+                        self._reply(200, server_self.defrag.snapshot())
                     elif self.path == f"{PREFIX}/inspect" or \
                             self.path == f"{PREFIX}/inspect/":
                         self._reply(200, server_self.inspect_handler.handle())
@@ -346,6 +359,8 @@ class ExtenderServer:
         import os
         if os.environ.get("TPUSHARE_FLEETWATCH", "1") != "0":
             self.fleetwatch.start()
+        if self.defrag.enabled():
+            self.defrag.start()
 
     def start(self) -> int:
         """Bind and serve on a background thread; returns the bound port."""
@@ -371,6 +386,7 @@ class ExtenderServer:
         self._httpd.serve_forever()
 
     def stop(self) -> None:
+        self.defrag.stop()
         self.fleetwatch.stop()
         if self._httpd:
             self._httpd.shutdown()

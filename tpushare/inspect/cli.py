@@ -4,6 +4,7 @@ the operator subcommands over the extender's diagnostic endpoints:
     tpushare-inspect                   # allocation table (default)
     tpushare-inspect <node>            # one node, per-chip detail
     tpushare-inspect fleet             # /inspect/fleet health snapshot
+    tpushare-inspect defrag            # /inspect/defrag rebalancer state
     tpushare-inspect explain [<pod>]   # /inspect/explain decision audit
     tpushare-inspect traces [-n N]     # /debug/traces flight recorder
 
@@ -136,6 +137,62 @@ def render_fleet(snap: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_defrag(snap: dict[str, Any]) -> str:
+    """Terminal rendering of the /inspect/defrag rebalancer state."""
+    lines: list[str] = []
+    budget = snap.get("budget") or {}
+    lines.append(
+        f"defrag: {'running' if snap.get('running') else 'stopped'}, "
+        f"{snap.get('passes', 0)} passes (period "
+        f"{snap.get('period_s')} s), budget "
+        f"{budget.get('used_in_window', 0)}/{budget.get('budget', 0)} "
+        f"moves this {budget.get('window_s')} s window")
+    for key, label in (("backoff_nodes", "backoff"),
+                       ("inflight_nodes", "in flight")):
+        nodes = budget.get(key) or []
+        if nodes:
+            lines.append(f"  {label}: {', '.join(nodes)}")
+    plan = snap.get("plan")
+    age = snap.get("plan_age_s")
+    if plan is None:
+        lines.append("no plan yet")
+    else:
+        lines.append(
+            f"last plan ({age} s ago): {plan.get('fragmented_nodes', 0)} "
+            f"fragmented nodes, {plan.get('stranded_chips_before', 0)} "
+            f"stranded chips, {len(plan.get('moves') or [])} moves")
+        for m in plan.get("moves") or []:
+            lines.append(
+                f"  {m.get('pod_key')}: {m.get('source')}"
+                f"{list(m.get('victim_chip_ids') or [])} -> "
+                f"{m.get('target')}{list(m.get('target_chip_ids') or [])} "
+                f"[{m.get('mode')}, +{m.get('gain_chips')} chips at "
+                f"{m.get('tier')}]")
+    moves = snap.get("recent_moves") or []
+    lines.append("")
+    if moves:
+        lines.append(f"last {len(moves)} move outcomes:")
+        for rec in moves:
+            m = rec.get("move") or {}
+            err = rec.get("error")
+            lines.append(
+                f"  {m.get('pod_key')} {m.get('source')} -> "
+                f"{m.get('target')}: {rec.get('outcome')}"
+                + (f" ({err})" if err else ""))
+    else:
+        lines.append("no moves executed yet")
+    c = snap.get("counters") or {}
+    totals = ", ".join(f"{k}={int(v)}" for k, v in sorted(
+        (c.get("moves_total") or {}).items()))
+    lines.append("")
+    lines.append(
+        f"counters: plans {c.get('plans_total') or {}}, "
+        f"moves [{totals or 'none'}], "
+        f"demotions {int(c.get('demotions_total', 0))}, "
+        f"freed chips {int(c.get('freed_chips_total', 0))}")
+    return "\n".join(lines)
+
+
 def render_traces(dump: dict[str, Any], limit: int | None = None) -> str:
     """Terminal rendering of the /debug/traces flight recorder."""
     lines: list[str] = []
@@ -169,7 +226,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("-n", "--limit", type=int, default=None,
                     help="traces: show at most N traces")
     ap.add_argument("target", nargs="*", default=[],
-                    help="node name, or a subcommand: 'fleet', "
+                    help="node name, or a subcommand: 'fleet', 'defrag', "
                          "'explain [pod]', 'traces'")
     args = ap.parse_args(argv)
     cmd = args.target[0] if args.target else None
@@ -178,6 +235,11 @@ def main(argv: list[str] | None = None) -> int:
             snap = fetch_path(args.endpoint, "/inspect/fleet")
             print(json.dumps(snap, indent=2) if args.json
                   else render_fleet(snap))
+            return 0
+        if cmd == "defrag":
+            snap = fetch_path(args.endpoint, "/inspect/defrag")
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_defrag(snap))
             return 0
         if cmd == "explain":
             path = "/inspect/explain"

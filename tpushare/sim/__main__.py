@@ -38,6 +38,13 @@ def main(argv: list[str] | None = None) -> int:
                          "no-extender failure mode), refined = per-chip "
                          "victim refinement (the preempt verb)")
     ap.add_argument("--high-priority-fraction", type=float, default=0.0)
+    ap.add_argument("--defrag", action="store_true",
+                    help="repack-rebalancer mode: replay a churn trace "
+                         "through the defrag planner core, sweeping the "
+                         "per-pass migration budget; one JSON report per "
+                         "budget (tpushare/sim/defrag.py)")
+    ap.add_argument("--budgets", default="0,1,2,4",
+                    help="--defrag: comma-separated move budgets to sweep")
     ap.add_argument("--slice", action="store_true",
                     help="multi-host slice (gang) mode: one v5e-16 "
                          "(2x2 hosts of 2x2 chips), mixed single-chip "
@@ -46,6 +53,17 @@ def main(argv: list[str] | None = None) -> int:
                          "and 'spread' singles policies "
                          "(docs/designs/multihost-gang.md)")
     args = ap.parse_args(argv)
+
+    if args.defrag:
+        from tpushare.sim.defrag import sweep_budgets
+        mesh = tuple(int(d) for d in args.mesh.split("x")) \
+            if args.mesh else ((2, 2) if args.chips == 4 else None)
+        budgets = tuple(int(b) for b in args.budgets.split(","))
+        for report in sweep_budgets(budgets, n_nodes=args.nodes,
+                                    chips=args.chips, hbm=args.hbm,
+                                    mesh=mesh):
+            print(json.dumps(report))
+        return 0
 
     if args.slice:
         # slice mode simulates a fixed v5e-16 (2x2 hosts of 2x2 chips)
