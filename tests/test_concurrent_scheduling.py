@@ -204,6 +204,33 @@ def test_delta_invalidation_spares_untouched_nodes():
     assert scores["n1"] != scores["n0"]
 
 
+def test_bind_watch_echo_is_not_a_mutation():
+    """The informer echo of a bind this cache already applied (same
+    chips, same HBM, confirmed) must be a no-op: a stamp bump here
+    would invalidate the node's memo on EVERY bind and keep shard
+    handover revalidation re-arming forever on any node that keeps
+    receiving traffic. A pod whose annotations actually changed still
+    syncs and bumps."""
+    import copy
+
+    fc, names = fleet(n_nodes=2)
+    cache, flt, _p, _b = rig(fc)
+    pod = fc.create_pod(make_pod(hbm=2048))
+    flt.handle({"Pod": pod, "NodeNames": names})
+    cache.get_node_info("n0").allocate(pod, fc)
+    bound = fc.get_pod(pod["metadata"]["namespace"],
+                       pod["metadata"]["name"])
+    v0 = cache.peek_node("n0").version
+    cache.add_or_update_pod(bound)  # watch echo of our own bind
+    cache.add_or_update_pod(bound)  # controller resync, same state
+    assert cache.peek_node("n0").version == v0
+    # a REAL annotation change (repair/defrag rewrite) is a mutation
+    changed = copy.deepcopy(bound)
+    changed["metadata"]["annotations"][contract.ANN_HBM_POD] = "1024"
+    cache.add_or_update_pod(changed)
+    assert cache.peek_node("n0").version != v0
+
+
 def test_removed_node_memoized_score_never_served():
     """A removed node's stamps can never validate again: the lookup
     recomputes (and here re-faults the node from the apiserver)."""

@@ -153,3 +153,53 @@ def test_cli_explain_and_traces_subcommands(live, capsys, live_cluster):
     assert main(["--endpoint", live, "--json", "-n", "1", "traces"]) == 0
     dump = jsonlib.loads(capsys.readouterr().out)
     assert len(dump["traces"]) <= 1
+
+
+def test_cli_ring_disabled_mode(live, capsys):
+    # no sharding wired: the endpoint still serves, the renderer says
+    # which HA mode is actually running
+    assert main(["--endpoint", live, "ring"]) == 0
+    out = capsys.readouterr().out
+    assert "sharding disabled" in out and "single-replica" in out
+    assert main(["--endpoint", live, "--json", "ring"]) == 0
+    import json as jsonlib
+    snap = jsonlib.loads(capsys.readouterr().out)
+    assert snap == {"enabled": False, "mode": "single-replica"}
+
+
+@pytest.fixture
+def live_sharded(capsys):
+    from tpushare.ha import ShardMembership
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=15000)
+    fc.add_tpu_node("n2", chips=1, hbm_per_chip_mib=15000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    sm = ShardMembership(fc, "ra", cache=cache)
+    # membership applied directly (no renewal thread): deterministic
+    # two-member ring for the golden rendering
+    sm._apply_membership(["ra", "rb"])
+    server = ExtenderServer(cache, fc, host="127.0.0.1", port=0,
+                            sharding=sm)
+    port = server.start()
+    yield f"http://127.0.0.1:{port}"
+    server.stop()
+
+
+def test_cli_ring_subcommand(live_sharded, capsys):
+    assert main(["--endpoint", live_sharded, "ring"]) == 0
+    out = capsys.readouterr().out
+    assert "ring: 2 member(s)" in out
+    assert "this replica: ra (live, ring leader)" in out
+    assert "MEMBER" in out and "SHARD NODES" in out
+    assert "leader,self" in out and "rb" in out
+    assert "bind outcomes:" in out and "lock-free" in out
+    # --json round-trips the raw snapshot schema
+    assert main(["--endpoint", live_sharded, "--json", "ring"]) == 0
+    import json as jsonlib
+    snap = jsonlib.loads(capsys.readouterr().out)
+    assert snap["members"] == ["ra", "rb"]
+    assert snap["identity"] == "ra" and snap["live"] is True
+    assert snap["ring_leader"] == "ra"
+    assert sum(snap["shard_sizes"].values()) == 2
+    assert set(snap["conflicts"]) == {"owned", "spillover", "cas_lost"}

@@ -4,7 +4,8 @@ The cache's concurrency story depends on one documented rule — lock
 order **gang -> stripe -> node -> memo -> index**, with `_pods_lock` a
 terminal leaf — enforced by review only until now. This is a simple AST
 pass over ``tpushare/cache/``, ``tpushare/core/native/``,
-``tpushare/controller/`` and ``tpushare/defrag/`` that finds
+``tpushare/controller/``, ``tpushare/defrag/`` and ``tpushare/ha/``
+that finds
 every syntactically NESTED lock acquisition (``with <lock>:`` inside
 ``with <lock>:`` in the same function) and asserts the ranks strictly
 increase, so a new lock (like the capacity index's) cannot silently
@@ -29,6 +30,7 @@ SCOPES = (
     os.path.join(ROOT, "tpushare", "core", "native"),
     os.path.join(ROOT, "tpushare", "controller"),
     os.path.join(ROOT, "tpushare", "defrag"),
+    os.path.join(ROOT, "tpushare", "ha"),
 )
 
 # (file basename, with-expression prefix) -> rank. Nested acquisitions
@@ -38,6 +40,10 @@ SCOPES = (
 # chain OR each other, which distinct ranks + "no nesting exists"
 # encode for free.
 RANKS = {
+    ("sharding.py", "self._lock"): 1,       # ring membership (leftmost of
+    # all: guards only the members/ring/pending bookkeeping and is NEVER
+    # held across a solve, a bind, or a lease renewal — the renew loop
+    # does its apiserver I/O lock-free and swaps the ring by reference)
     ("batch.py", "self._lock"): 2,          # batch-window table (leftmost:
     # guards only the pending-window dict and is NEVER held across the
     # solve or any cache/node call — the leader pops its window first)

@@ -1,0 +1,487 @@
+"""Active-active shard membership tests (the scale-out tentpole).
+
+Three layers:
+
+1. unit — the membership/ring/pending bookkeeping driven directly
+   (``_apply_membership``), pinning the handover-revalidation protocol:
+   a newly owned node binds lock-free only after its generation stamp is
+   observed unchanged (the node quiesced), a moving stamp keeps it on
+   the claim-CAS path;
+2. lease machinery over FakeCluster — N replicas each renewing their own
+   ``tpushare-schd-shard-*`` lease converge on one membership, partition
+   their fleet disjointly, and a replica that cannot renew steps itself
+   down within one lease duration;
+3. chaos handoff (the ISSUE satellite) — three COMPLETE extender stacks
+   over the stub apiserver storm concurrent binds while one replica is
+   killed (thread death, no abdication — the crash model): its shard is
+   re-owned within one lease TTL, no bind lands on the dead server, and
+   the apiserver-truth audit shows zero oversubscription across the
+   handoff.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tests.test_ha_storm import (
+    CHIPS, GIB, HBM, NODES, assert_apiserver_invariants, post, seed_pod,
+    wait_until)
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller
+from tpushare.extender.server import ExtenderServer
+from tpushare.ha.sharding import (
+    SHARD_CONFLICTS, SHARD_LEASE_PREFIX, ShardMembership)
+from tpushare.k8s import FakeCluster
+from tpushare.k8s.client import ApiError
+from tpushare.k8s.incluster import InClusterClient
+from tpushare.k8s.stubapi import StubApiServer
+
+FAST = dict(lease_duration=0.8, renew_period=0.1, retry_period=0.05)
+
+
+# -- unit: ring/pending bookkeeping, no threads -------------------------------
+
+class _Info:
+    def __init__(self, version):
+        self.version = version
+
+
+class _FakeCache:
+    """node_names/peek_node/set_ownership — just enough cache for the
+    revalidation protocol."""
+
+    def __init__(self, names):
+        self._v = {n: (1, 0) for n in names}
+        self.ownership = []  # set_ownership calls, in order
+
+    def node_names(self):
+        return list(self._v)
+
+    def peek_node(self, name):
+        v = self._v.get(name)
+        return None if v is None else _Info(v)
+
+    def bump(self, name):
+        epoch, count = self._v[name]
+        self._v[name] = (epoch, count + 1)
+
+    def set_ownership(self, owned):
+        self.ownership.append(owned)
+
+
+def _member(identity, cache=None, cluster=None):
+    return ShardMembership(cluster or FakeCluster(), identity,
+                           cache=cache, **FAST)
+
+
+def test_no_cache_single_member_owns_everything_lock_free():
+    sm = _member("ra")
+    sm._apply_membership(["ra"])
+    assert sm.is_live() and sm.is_ring_leader()
+    for n in ("a", "b", "zz"):
+        assert sm.is_owned(n) and sm.owns_for_bind(n)
+
+
+def test_first_membership_arms_pending_then_promotes_on_quiesce():
+    cache = _FakeCache(["n1", "n2"])
+    sm = _member("ra", cache=cache)
+    sm._apply_membership(["ra"])
+    # every owned node starts pending (this replica did not schedule its
+    # recent history), stamped at rebalance time
+    assert sm.snapshot()["pending_revalidation"] == 2
+    # stamp unchanged since the rebalance -> quiesced -> promoted
+    assert sm.owns_for_bind("n1")
+    assert sm.snapshot()["pending_revalidation"] == 1
+    # a node still being written by the old owner keeps the CAS...
+    cache.bump("n2")
+    assert not sm.owns_for_bind("n2")  # re-armed with the new stamp
+    cache.bump("n2")
+    assert not sm.owns_for_bind("n2")  # still moving
+    # ...until it finally quiesces between two observations
+    assert sm.owns_for_bind("n2")
+    assert sm.snapshot()["pending_revalidation"] == 0
+
+
+def test_note_bound_promotes_node_under_sustained_bind_traffic():
+    # Every bind moves the node's stamp, so without note_bound a busy
+    # pending node re-arms on every check and NEVER leaves the CAS path
+    # (each check-to-check window contains our own previous bind).
+    # BindHandler reports its own successful bind via note_bound; the
+    # next check then sees a quiet window and promotes.
+    cache = _FakeCache(["n1"])
+    sm = _member("ra", cache=cache)
+    sm._apply_membership(["ra"])
+    cache.bump("n1")  # old-owner straggler: the rebalance stamp is stale
+    # bind 1: the check re-arms on the moved stamp -> CAS path
+    assert not sm.owns_for_bind("n1")
+    cache.bump("n1")     # our bind's own mutation...
+    sm.note_bound("n1")  # ...reported by BindHandler
+    # bind 2: only OUR write happened since -> promoted, lock-free
+    assert sm.owns_for_bind("n1")
+    assert sm.snapshot()["pending_revalidation"] == 0
+
+
+def test_note_bound_does_not_mask_foreign_writes():
+    cache = _FakeCache(["n1"])
+    sm = _member("ra", cache=cache)
+    sm._apply_membership(["ra"])
+    cache.bump("n1")
+    assert not sm.owns_for_bind("n1")  # armed
+    cache.bump("n1")
+    sm.note_bound("n1")
+    cache.bump("n1")  # a straggler lands AFTER our bind was noted
+    assert not sm.owns_for_bind("n1")  # re-armed: CAS kept
+    assert sm.owns_for_bind("n1")      # quiesces -> promotes
+    # note_bound on an already-promoted node is a no-op
+    sm.note_bound("n1")
+    assert sm.owns_for_bind("n1")
+
+
+def test_rebalance_arms_only_handed_over_nodes():
+    names = [f"n{i}" for i in range(40)]
+    cache = _FakeCache(names)
+    sm = _member("ra", cache=cache)
+    sm._apply_membership(["ra"])
+    for n in names:
+        assert sm.owns_for_bind(n)  # revalidate everything once
+    # rb leaves: ra is handed rb's nodes, but its continuously-owned
+    # ones must NOT re-enter pending
+    sm._apply_membership(["ra", "rb"])
+    owned_through = [n for n in names if sm.is_owned(n)]
+    for n in owned_through:
+        assert sm.owns_for_bind(n)
+    sm._apply_membership(["ra"])
+    handed = [n for n in names if n not in owned_through]
+    assert sm.snapshot()["pending_revalidation"] == len(handed)
+    # ownership refresh reached the cache on every rebalance
+    assert len(cache.ownership) == 3
+    assert cache.ownership[-1] == sm.is_owned
+
+
+def test_not_in_membership_means_not_live_and_nothing_owned():
+    sm = _member("ra", cache=_FakeCache(["n1"]))
+    sm._apply_membership(["rb", "rc"])
+    assert not sm.is_live() and not sm.is_owned("n1")
+    assert not sm.owns_for_bind("n1")
+    # dropped out of the ring entirely -> ownership predicate cleared
+    assert sm._cache.ownership[-1] is None
+
+
+def test_unknown_node_never_promotes_to_lock_free():
+    # peek_node -> None means the cache cannot vouch for quiescence;
+    # such a node stays on the claim-CAS path forever (it cannot pass
+    # Filter anyway, so the only cost is safety)
+    cache = _FakeCache(["n1"])
+    sm = _member("ra", cache=cache)
+    sm._apply_membership(["ra"])
+    sm._pending["ghost"] = None
+    assert not sm.owns_for_bind("ghost")
+    assert not sm.owns_for_bind("ghost")
+
+
+# -- lease machinery over FakeCluster -----------------------------------------
+
+@pytest.fixture
+def pair():
+    fc = FakeCluster()
+    for i in range(8):
+        fc.add_tpu_node(f"n{i}", chips=4, hbm_per_chip_mib=16 * GIB)
+    a = ShardMembership(fc, "ra", **FAST)
+    b = ShardMembership(fc, "rb", **FAST)
+    a.start()
+    b.start()
+    try:
+        yield fc, a, b
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_two_replicas_converge_and_partition_disjointly(pair):
+    fc, a, b = pair
+    assert wait_until(lambda: a.members() == ("ra", "rb")
+                      and b.members() == ("ra", "rb"))
+    names = [f"n{i}" for i in range(8)]
+    for n in names:
+        # both replicas compute the same owner, exactly one owns it
+        assert a.owner_of(n) == b.owner_of(n)
+        assert a.is_owned(n) != b.is_owned(n)
+    # exactly one ring leader (the defrag seat)
+    assert a.is_ring_leader() != b.is_ring_leader()
+    # each wrote its own lease
+    leases = fc.list_leases(a.namespace)
+    held = sorted((lease["metadata"]["name"] for lease in leases
+                   if (lease.get("spec") or {}).get("holderIdentity")))
+    assert held == [SHARD_LEASE_PREFIX + "ra", SHARD_LEASE_PREFIX + "rb"]
+
+
+def test_clean_stop_releases_lease_and_peer_reowns(pair):
+    fc, a, b = pair
+    assert wait_until(lambda: a.members() == ("ra", "rb")
+                      and b.members() == ("ra", "rb"))
+    a.stop()  # abdication clears the holder: no TTL wait needed
+    assert wait_until(lambda: b.members() == ("rb",))
+    assert all(b.is_owned(f"n{i}") for i in range(8))
+    assert b.is_ring_leader()
+
+
+class _Partitioned:
+    """Cluster proxy whose lease verbs fail while .down is set (the
+    replica-side partition model: the stub keeps running, this replica
+    just cannot reach it)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.down = False
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if name in ("get_lease", "create_lease", "update_lease",
+                    "list_leases") and callable(fn):
+            def wrapped(*a, **k):
+                if self.down:
+                    raise ApiError(500, "partitioned")
+                return fn(*a, **k)
+            return wrapped
+        return fn
+
+
+def test_partitioned_replica_steps_itself_down_within_ttl():
+    fc = FakeCluster()
+    for i in range(4):
+        fc.add_tpu_node(f"n{i}", chips=4, hbm_per_chip_mib=16 * GIB)
+    link = _Partitioned(fc)
+    a = ShardMembership(link, "ra", **FAST)
+    b = ShardMembership(fc, "rb", **FAST)
+    a.start()
+    b.start()
+    try:
+        assert wait_until(lambda: a.members() == ("ra", "rb")
+                          and b.members() == ("ra", "rb"))
+        link.down = True
+        # within one lease duration the partitioned replica must stop
+        # claiming ownership (peers have expired it and re-own its
+        # shard; a stale lock-free owner would be split-brain)
+        assert wait_until(lambda: not a.is_live(),
+                          timeout=4 * FAST["lease_duration"])
+        assert not any(a.is_owned(f"n{i}") for i in range(4))
+        assert wait_until(lambda: b.members() == ("rb",),
+                          timeout=4 * FAST["lease_duration"])
+        assert all(b.is_owned(f"n{i}") for i in range(4))
+        # healing the partition re-admits it, with revalidation pending
+        link.down = False
+        assert wait_until(lambda: a.is_live()
+                          and a.members() == ("ra", "rb"))
+    finally:
+        a.stop()
+        b.stop()
+
+
+# -- chaos handoff: kill a replica mid-storm (the ISSUE satellite) ------------
+
+class ShardReplica:
+    """A complete extender stack whose HA mode is active-active."""
+
+    def __init__(self, stub, ident: str):
+        self.ident = ident
+        self.client = InClusterClient(base_url=stub.base_url, timeout=10.0)
+        self.cache = SchedulerCache(self.client)
+        self.controller = Controller(self.client, self.cache)
+        self.controller.build_cache()
+        self.controller.start()
+        self.sharding = ShardMembership(
+            self.client, ident, cache=self.cache,
+            on_rebalance=self.controller.resync_once, **FAST)
+        self.sharding.start()
+        self.server = ExtenderServer(self.cache, self.client,
+                                     host="127.0.0.1", port=0,
+                                     sharding=self.sharding)
+        self.base = (f"http://127.0.0.1:{self.server.start()}"
+                     "/tpushare-scheduler")
+
+    def crash(self):
+        """Process-death model: the membership thread dies WITHOUT
+        abdicating (peers must expire the lease by TTL) and the HTTP
+        server stops answering."""
+        self.sharding._stop.set()
+        if self.sharding._thread is not None:
+            self.sharding._thread.join(timeout=5)
+        self.server.stop()
+        self.controller.stop()
+
+    def stop(self):
+        self.server.stop()
+        self.sharding.stop()
+        self.controller.stop()
+
+
+def try_schedule_sharded(replicas, pod, node_names, attempts=80):
+    """kube-scheduler across an active-active service: EVERY live
+    replica serves filter+bind (no leader gate) — on error try the
+    next endpoint."""
+    name = pod["metadata"]["name"]
+    ns = pod["metadata"]["namespace"]
+    for i in range(attempts):
+        rep = replicas[i % len(replicas)]
+        try:
+            _, flt = post(rep.base, "/filter",
+                          {"Pod": pod, "NodeNames": node_names}, timeout=5)
+        except OSError:
+            continue
+        ok = flt.get("NodeNames") or []
+        if not ok:
+            return None
+        try:
+            status, result = post(rep.base, "/bind", {
+                "PodName": name, "PodNamespace": ns,
+                "PodUID": pod["metadata"].get("uid", ""), "Node": ok[0]},
+                timeout=5)
+        except OSError:
+            continue
+        if status == 200 and not result.get("Error"):
+            return ok[0]
+        time.sleep(0.05)
+    return None
+
+
+@pytest.mark.slow
+def test_chaos_shard_handoff_mid_storm():
+    stub = StubApiServer().start()
+    n_nodes = 8  # wide enough that all three shards are non-empty
+    for i in range(n_nodes):
+        stub.seed("nodes", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"s{i}",
+                         "labels": {"tpushare": "true",
+                                    "tpushare.aliyun.com/mesh": "2x2"}},
+            "status": {"capacity": {
+                "aliyun.com/tpu-hbm": str(CHIPS * HBM),
+                "aliyun.com/tpu-count": str(CHIPS)}}})
+    replicas = [ShardReplica(stub, f"r{c}") for c in "abc"]
+    killed = []
+    try:
+        idents = tuple(sorted(r.ident for r in replicas))
+        assert wait_until(lambda: all(r.sharding.members() == idents
+                                      for r in replicas), timeout=10), \
+            [r.sharding.members() for r in replicas]
+
+        names = [f"s{i}" for i in range(n_nodes)]
+        pods = [seed_pod(stub, f"chaos-{i}", 2 * GIB) for i in range(30)]
+        bound: dict[str, str] = {}
+        lock = threading.Lock()
+        done = {"n": 0}
+        live = list(replicas)
+
+        def worker(chunk):
+            for pod in chunk:
+                node = try_schedule_sharded(list(live), pod, names)
+                with lock:
+                    done["n"] += 1
+                    if node:
+                        bound[pod["metadata"]["name"]] = node
+
+        threads = [threading.Thread(target=worker, args=(pods[i::3],))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        # kill one replica while binds are in flight (crash, not stop:
+        # its lease holder stays set until the TTL expires it). Pick a
+        # victim that actually owns part of the fleet so the handoff
+        # moves real ownership.
+        assert wait_until(lambda: done["n"] >= 8, timeout=30)
+        victim = next(r for r in replicas
+                      if any(r.sharding.is_owned(n) for n in names))
+        victim_nodes = [n for n in names if victim.sharding.is_owned(n)]
+        victim.crash()
+        killed.append(victim)
+        with lock:
+            live[:] = [r for r in replicas if r is not victim]
+
+        # the dead replica's shard is re-owned within ~one lease TTL
+        # (expiry) + one renew period (the next membership poll)
+        t0 = time.monotonic()
+        survivors = [r for r in replicas if r is not victim]
+        surviving = tuple(sorted(r.ident for r in survivors))
+        assert wait_until(
+            lambda: all(r.sharding.members() == surviving
+                        for r in survivors),
+            timeout=3 * FAST["lease_duration"]), \
+            [r.sharding.members() for r in survivors]
+        reowned_in = time.monotonic() - t0
+        for n in names:
+            owners = [r.ident for r in survivors if r.sharding.is_owned(n)]
+            assert len(owners) == 1, (n, owners)
+        assert reowned_in <= 3 * FAST["lease_duration"], reowned_in
+        assert victim_nodes, "victim owned nothing — kill proved nothing"
+
+        for t in threads:
+            t.join(timeout=60)
+        # kube-scheduler retries pending pods; drain the remainder
+        # through the survivors before judging
+        for pod in pods:
+            name = pod["metadata"]["name"]
+            if name not in bound:
+                node = try_schedule_sharded(survivors, pod, names,
+                                            attempts=40)
+                if node:
+                    bound[name] = node
+
+        # capacity: 8 nodes x 4 chips x 16 GiB / 2 GiB = 256 slots >>
+        # 30 pods — after the retry pass a strong majority must land
+        assert len(bound) >= 26, f"storm bound only {len(bound)}/30"
+        # the apiserver-truth audit: zero oversubscription across the
+        # handoff, every placement consistent with its binding
+        per_chip = assert_apiserver_invariants(stub, survivors[0].client)
+        assert sum(per_chip.values()) == len(bound) * 2 * GIB
+        for pod in survivors[0].client.list_pods():
+            name = pod["metadata"]["name"]
+            if name in bound:
+                assert pod["spec"]["nodeName"] == bound[name]
+        # the bind paths actually split owned/spillover (active-active
+        # proof: more than one replica bound lock-free is not required,
+        # but SOME owned-path binds must have happened)
+        snap = survivors[0].sharding.snapshot()
+        assert snap["conflicts"]["owned"] + snap["conflicts"]["spillover"] \
+            > 0
+    finally:
+        for r in replicas:
+            if r not in killed:
+                r.stop()
+        stub.stop()
+
+
+def test_single_replica_stack_binds_lock_free():
+    """The satellite closing the BENCH_r05 gap: a ring of size 1 owns
+    everything, so the claim CAS is skipped even though HA is on."""
+    stub = StubApiServer().start()
+    stub.seed("nodes", {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "s0",
+                     "labels": {"tpushare": "true",
+                                "tpushare.aliyun.com/mesh": "2x2"}},
+        "status": {"capacity": {
+            "aliyun.com/tpu-hbm": str(CHIPS * HBM),
+            "aliyun.com/tpu-count": str(CHIPS)}}})
+    rep = ShardReplica(stub, "solo")
+    try:
+        assert wait_until(lambda: rep.sharding.members() == ("solo",),
+                          timeout=10)
+        # the first membership arms revalidation even on a solo ring
+        # (this replica cannot know it scheduled the node's history);
+        # drive it to promotion — the node quiesces between two checks
+        assert wait_until(lambda: rep.sharding.owns_for_bind("s0"),
+                          timeout=10)
+        owned_before = SHARD_CONFLICTS.get("owned")
+        pod = seed_pod(stub, "solo-pod", 2 * GIB)
+        assert try_schedule_sharded([rep], pod, ["s0"]) == "s0"
+        assert SHARD_CONFLICTS.get("owned") == owned_before + 1
+        # lock-free bind leaves NO claim annotation to GC later
+        node = rep.client.get_node("s0")
+        claims = (node["metadata"].get("annotations") or {}).get(
+            "tpushare.aliyun.com/claims")
+        assert not claims, claims
+        assert_apiserver_invariants(stub, rep.client)
+    finally:
+        rep.stop()
+        stub.stop()

@@ -156,3 +156,44 @@ def test_wasted_eviction_victims_do_not_starve():
     assert r.wasted_evictions == 2
     assert r.never_placed == 1          # only the impossible pod
     assert fleet.used_hbm == 0          # victims re-placed AND finished
+
+
+def test_sharded_run_preserves_the_scorecard():
+    """Active-active sharding changes who HANDLES a bind, never its
+    verdict: replaying the standard trace against 1/2/4 simulated shard
+    owners must produce byte-identical scorecards, with only the
+    owned/spillover split varying (~(N-1)/N spillover for round-robin
+    handling)."""
+    from tpushare.sim.simulator import run_sim_sharded
+    trace = synth_trace(TraceSpec(n_pods=200, seed=3))
+    results = []
+    for shards in (1, 2, 4):
+        fleet = Fleet.homogeneous(8, 4, 16384, (2, 2))
+        report, stats = run_sim_sharded(fleet, trace, "binpack",
+                                        shards=shards)
+        results.append((report.to_json(), stats))
+    base = results[0][0]
+    for rep, stats in results:
+        assert rep["scorecard"] == base["scorecard"]
+        assert rep["placed"] == base["placed"]
+        assert rep["frag_time_weighted"] == base["frag_time_weighted"]
+        n = stats["shards"]
+        assert stats["owned_binds"] + stats["spillover_binds"] \
+            == rep["placed"]
+        assert sum(stats["shard_sizes"].values()) == 8
+        if n == 1:
+            assert stats["spillover_binds"] == 0
+        else:
+            # round-robin handling: ~1/N of binds land on their owner
+            assert 0 < stats["spillover_rate"] < 1
+
+
+def test_cli_shards_leg_emits_identical_scorecards(capsys):
+    from tpushare.sim.__main__ import main
+    assert main(["--nodes", "4", "--pods", "60", "--shards", "2"]) == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 3  # shard counts 1, 2, 4
+    reps = [json.loads(l) for l in lines]
+    assert [r["sharding"]["shards"] for r in reps] == [1, 2, 4]
+    for r in reps:
+        assert r["scorecard"] == reps[0]["scorecard"]

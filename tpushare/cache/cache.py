@@ -63,7 +63,7 @@ import logging
 import os
 import threading
 from collections import OrderedDict
-from typing import Any
+from typing import Any, Callable
 
 from tpushare import contract
 from tpushare.cache.batch import BATCH_SOLVES
@@ -229,6 +229,11 @@ class SchedulerCache:
         # resident packed fleet for the native scan, built lazily on the
         # first compute (engine import is deferred off the ctor path)
         self._arena = None
+        # active-active sharding (ha/sharding.py): when set, index
+        # summaries, eqclass publication, and arena residency cover only
+        # the nodes this predicate accepts (~1/N of the fleet per
+        # replica); foreign nodes stay scoreable via a per-call scan
+        self._owned: Callable[[str], bool] | None = None
         # paranoia modes for the bench/property tests: every memo-served
         # score is recomputed from the node's current stamped snapshot
         # (a mismatch under a matching stamp = stale serve), and every
@@ -333,6 +338,30 @@ class SchedulerCache:
 
     def node_names(self) -> list[str]:
         return list(self._nodes)  # GIL-atomic copy of the keys
+
+    def set_ownership(self, owned: Callable[[str], bool] | None) -> None:
+        """Install (or clear, with None) the shard-ownership predicate
+        and converge the owned-subset views: every node is re-marked
+        dirty so the next index flush drops foreign summaries and
+        (re)builds owned ones, and foreign arena slots are evicted
+        eagerly. Called by ShardMembership on every ring rebalance,
+        outside any cache lock.
+
+        Correctness note: verdicts never change — a foreign node is
+        merely *uncovered* (partition routes it to the scan path and
+        _compute_missing scores it without arena residency), so
+        spillover pods still find their only fit. Only the resident
+        footprint and flush work shrink to ~1/N."""
+        self._owned = owned
+        self._index.set_owned(owned)
+        names = self.node_names()
+        for n in names:
+            self._index.mark_dirty(n)
+        arena = self._arena
+        if arena is not None and owned is not None:
+            for n in names:
+                if not owned(n):
+                    arena.forget(n)
 
     def peek_node(self, node_name: str) -> NodeInfo | None:
         """Lock-free read of an already-tracked NodeInfo, or None.
@@ -570,7 +599,18 @@ class SchedulerCache:
                 MEMO_NODE_SCORES.inc("reused", n=reused)
             if to_scan:
                 MEMO_NODE_SCORES.inc("computed", n=len(to_scan))
-            if self._eqclass and (scores or node_errors):
+            # shard mode: only owned verdicts enter the signature class
+            # (foreign scans are transient by design — publishing them
+            # would grow the memo back to fleet size)
+            owned_fn = self._owned
+            if owned_fn is None:
+                pub_scores, pub_errors = scores, node_errors
+            else:
+                pub_scores = {n: s for n, s in scores.items()
+                              if owned_fn(n)}
+                pub_errors = {n: e for n, e in node_errors.items()
+                              if owned_fn(n)}
+            if self._eqclass and (pub_scores or pub_errors):
                 # publish this pod's freshly SCANNED verdicts to the
                 # signature class so the next identical pod joins
                 # instead of re-scanning (pruned no-fits stay in the
@@ -583,15 +623,21 @@ class SchedulerCache:
                     self._sig_memo[sig] = sig_entry
                 else:
                     self._sig_memo.move_to_end(sig)
-                sig_entry.scores.update(scores)
-                sig_entry.errors.update(node_errors)
-                sig_entry.stamps.update(stamps)
+                sig_entry.scores.update(pub_scores)
+                sig_entry.errors.update(pub_errors)
+                sig_entry.stamps.update(
+                    {n: st for n, st in stamps.items()
+                     if n in pub_scores or n in pub_errors}
+                    if owned_fn is not None else stamps)
                 # placements are a pure function of (node state,
                 # signature) exactly like scores: replicas joining the
                 # class get the chip selection for free too
-                sig_entry.placements.update(placements)
+                sig_entry.placements.update(
+                    {n: p for n, p in placements.items()
+                     if n in pub_scores}
+                    if owned_fn is not None else placements)
                 EQCLASS_SHARES.inc(
-                    "computed", n=len(scores) + len(node_errors))
+                    "computed", n=len(pub_scores) + len(pub_errors))
             out = ({n: entry.scores[n] for n in node_names
                     if n in entry.scores},
                    {n: entry.errors[n] for n in node_names
@@ -623,7 +669,6 @@ class SchedulerCache:
         node_errors: dict[str, str] = {}
         stamps: dict[str, tuple[int, int]] = {}
         placements: dict[str, Placement] = {}
-        known: list[str] = []
         entries = []
         for name in missing:
             try:
@@ -638,16 +683,32 @@ class SchedulerCache:
             if info.chip_count <= 0:
                 node_errors[name] = "not a TPU-share node"
                 continue
-            known.append(name)
             entries.append((name, stamp, snap, info.topology))
         if entries:
-            if self._arena is None:
-                self._arena = native_engine.FleetArena()
-            for name, (score, placement) in zip(
-                    known, self._arena.cycle(entries, req)):
-                scores[name] = score
-                if placement is not None:
-                    placements[name] = placement
+            owned = self._owned
+            if owned is None:
+                resident, transient = entries, []
+            else:
+                resident = [e for e in entries if owned(e[0])]
+                transient = [e for e in entries if not owned(e[0])]
+            if resident:
+                if self._arena is None:
+                    self._arena = native_engine.FleetArena()
+                for (name, _st, _sn, _tp), (score, placement) in zip(
+                        resident, self._arena.cycle(resident, req)):
+                    scores[name] = score
+                    if placement is not None:
+                        placements[name] = placement
+            if transient:
+                # foreign-shard nodes: a spillover pod must still find
+                # its only fit, but a foreign node never becomes arena-
+                # resident — per-call marshalled cycle, same verdicts
+                nodes = [(snap, topo) for _n, _s, snap, topo in transient]
+                for (name, _st, _sn, _tp), (score, placement) in zip(
+                        transient, native_engine.cycle_fleet(nodes, req)):
+                    scores[name] = score
+                    if placement is not None:
+                        placements[name] = placement
         return scores, fetch_errors, node_errors, stamps, placements
 
     def _verify_pruned(self, pruned: dict[str, tuple[tuple[int, int], str]],

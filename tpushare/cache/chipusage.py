@@ -51,6 +51,16 @@ class ChipUsage:
         e = self._pods.get(uid)
         return e.hbm_mib if e else 0
 
+    def has_pod(self, uid: str) -> bool:
+        return uid in self._pods
+
+    def holds(self, uid: str, hbm_mib: int) -> bool:
+        """True iff a CONFIRMED entry with exactly this HBM exists —
+        the sync-echo no-op test (reserved entries must take the real
+        sync path so the re-add clears the reservation)."""
+        e = self._pods.get(uid)
+        return e is not None and not e.reserved and e.hbm_mib == hbm_mib
+
     def entries(self) -> list[tuple[str, int, bool]]:
         """(uid, hbm_mib, reserved) triples — for state carry-over
         across a chip rebuild (NodeInfo.update_node), which must

@@ -232,6 +232,11 @@ class CapacityIndex:
         # resolver: node name -> NodeInfo | None (the cache's lock-free
         # dict read); called from flush() with NO index lock held
         self._resolver = resolver
+        # optional shard-ownership predicate (active-active mode): a
+        # node it rejects is summarized as if untracked, so the index
+        # holds ~1/N of the fleet and partition() conservatively routes
+        # foreign candidates to the scan path (uncovered != unfit)
+        self._owned: Callable[[str], bool] | None = None
         self._lock = threading.Lock()  # leaf: dirty set + summaries + buckets
         # serializes whole-flush application: a caller returning from
         # flush() is guaranteed every node dirty at entry has its
@@ -253,6 +258,12 @@ class CapacityIndex:
         self._gen = 0  # bumped on every summary install/drop
 
     # -- maintenance ----------------------------------------------------------
+
+    def set_owned(self, owned: Callable[[str], bool] | None) -> None:
+        """Install (or clear) the shard-ownership predicate. The caller
+        re-marks the fleet dirty afterwards so the next flush converges
+        the summary set to the owned subset."""
+        self._owned = owned
 
     def mark_dirty(self, name: str) -> None:
         """Called from NodeInfo._dirty under the NODE lock — the index
@@ -280,9 +291,11 @@ class CapacityIndex:
                     return 0
                 dirty = list(self._dirty)
                 self._dirty.clear()
+            owned = self._owned
             for name in dirty:
                 info = self._resolver(name)
-                if info is None:
+                if info is None or \
+                        (owned is not None and not owned(name)):
                     with self._lock:
                         self._drop_locked(name)
                     continue

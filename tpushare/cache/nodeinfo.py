@@ -832,6 +832,19 @@ class NodeInfo:
         hbm = contract.hbm_from_annotations(pod)
         key = podlib.pod_cache_key(pod)
         with self._lock:
+            if ids is not None:
+                wanted = {cid for cid in ids if 0 <= cid < len(self.chips)}
+                if len(wanted) == len(ids) and all(
+                        self.chips[cid].holds(key, hbm) for cid in wanted) \
+                        and not any(c.has_pod(key) for c in self.chips
+                                    if c.idx not in wanted):
+                    # watch echo of occupancy we already hold — usually
+                    # our OWN bind coming back through the informer. Not
+                    # a mutation: bumping the stamp here would invalidate
+                    # the node's placement memo on every bind and
+                    # endlessly re-arm shard handover revalidation on
+                    # any node that keeps receiving traffic.
+                    return True
             for c in self.chips:
                 c.remove_pod(key)
             if ids is not None:

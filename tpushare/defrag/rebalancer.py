@@ -51,8 +51,14 @@ class DefragController:
         self._last_plan_at: float | None = None
         self._moves: deque[dict[str, Any]] = deque(maxlen=self.LAST_MOVES)
         self._passes = 0
+        self._skipped_gate = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # active-active sharding: ring-leader gate. The paced loop skips
+        # its pass while this returns False, so exactly ONE replica
+        # plans repacks fleet-wide (ShardMembership.is_ring_leader is
+        # wired here by the extender server). None = always plan.
+        self.gate: Callable[[], bool] | None = None
 
     # -- one pass -------------------------------------------------------------
 
@@ -81,12 +87,16 @@ class DefragController:
                    if self._last_plan_at is not None else None)
             moves = list(self._moves)
             passes = self._passes
+            skipped_gate = self._skipped_gate
         plans = {k[0]: v for k, v in DEFRAG_PLANS.snapshot().items()}
         move_totals = {k[0]: v for k, v in DEFRAG_MOVES.snapshot().items()}
+        gate = self.gate
         return {
             "running": self._thread is not None,
             "period_s": self.period_s,
             "passes": passes,
+            "ring_leader": None if gate is None else bool(gate()),
+            "skipped_not_leader": skipped_gate,
             "plan_age_s": age,
             "plan": last_plan,
             "budget": self.executor.budget_state(),
@@ -136,6 +146,10 @@ class DefragController:
         # decided against a half-built picture is all demotions
         while not self._stop.wait(self.period_s):
             try:
+                if self.gate is not None and not self.gate():
+                    with self._lock:
+                        self._skipped_gate += 1
+                    continue  # not the ring leader this period
                 self.run_once()
             except Exception:  # noqa: BLE001 — the rebalancer must survive
                 pass

@@ -134,7 +134,27 @@ def main(argv: list[str] | None = None) -> int:
     gc.freeze()
 
     elector = None
-    if args.ha:
+    sharding = None
+    shard_replicas = int(os.environ.get("TPUSHARE_SHARD_REPLICAS", "0")
+                         or 0)
+    if shard_replicas > 0:
+        # active-active: every replica renews its own membership lease
+        # and owns a consistent-hash shard of the fleet — supersedes the
+        # single-leader gate (docs/ops.md: TPUSHARE_SHARD_REPLICAS /
+        # TPUSHARE_SHARD_VNODES)
+        import socket as socketlib
+
+        from tpushare.ha import ShardMembership
+        identity = f"{socketlib.gethostname()}-{os.getpid()}"
+        # a rebalance hands this replica foreign-scheduled nodes: resync
+        # so their claims/placements are re-read before lock-free binds
+        sharding = ShardMembership(
+            cluster, identity, cache=cache,
+            on_rebalance=controller.resync_once)
+        sharding.start()
+        log.info("ha: active-active sharding enabled (identity %s, "
+                 "%d vnodes)", identity, sharding.vnodes)
+    elif args.ha:
         import socket as socketlib
 
         from tpushare.ha import LeaderElector
@@ -151,7 +171,7 @@ def main(argv: list[str] | None = None) -> int:
                             host=args.host, port=args.port,
                             allow_debug_seed=bool(args.fake_nodes),
                             elector=elector, informer=informer,
-                            breaker=breaker)
+                            breaker=breaker, sharding=sharding)
     register_cache_gauges(registry, cache)
     # abandoned-gang expiry rides the controller's 30 s anti-entropy
     # heartbeat (docs/designs/multihost-gang.md protocol step 5)
@@ -172,6 +192,8 @@ def main(argv: list[str] | None = None) -> int:
     port = server.start()
     print(f"tpushare extender ready on {args.host}:{port}", flush=True)
     stop.wait()
+    if sharding is not None:
+        sharding.stop()
     if elector is not None:
         elector.stop()
     controller.stop()

@@ -26,6 +26,7 @@ from tpushare.cache.nodeinfo import no_fit_reason, request_from_pod
 from tpushare.contract import pod as podlib
 from tpushare.core.placement import fragmentation, utilization_pct
 from tpushare.extender.metrics import LATENCY_BUCKETS, Registry
+from tpushare.ha.sharding import SHARD_CONFLICTS
 from tpushare.k8s.breaker import OPEN as BREAKER_IS_OPEN
 from tpushare.k8s.client import ApiError
 from tpushare.k8s.informer import LISTER_REQUESTS
@@ -518,10 +519,14 @@ class BindHandler:
     def __init__(self, cache: SchedulerCache, cluster,
                  registry: Registry, ha_claims: bool = False,
                  gang=None, pod_lister=None, breaker=None,
-                 tracer=None, explain=None) -> None:
+                 tracer=None, explain=None, sharding=None) -> None:
         self._cache = cache
         self._cluster = cluster
         self._ha_claims = ha_claims
+        # active-active mode (ha/sharding.py): per-bind claim decision —
+        # a shard-owned (and revalidated) node binds lock-free, foreign
+        # spillover keeps the claim CAS. Overrides ha_claims per node.
+        self._sharding = sharding
         self._gang = gang  # GangCoordinator | None
         # observability: Bind joins (or opens) the pod's cycle trace,
         # CLOSES it on exit, and stamps the trace context into the
@@ -553,6 +558,27 @@ class BindHandler:
             "Binds refused by a concurrent replica's node claim (HA "
             "backpressure; sustained growth = replicas fighting over "
             "the same nodes)")
+
+    def _claims_for(self, node: str, gang: bool) -> bool:
+        """Whether THIS bind needs the per-node claim CAS. Without
+        sharding: the static ha_claims flag (active-passive). With
+        sharding: an owned+revalidated node skips the CAS (outcome
+        ``owned`` — the restored plain path, including the whole fleet
+        on a single-replica ring), anything else keeps it (``spillover``).
+        A gang bind reserves across MULTIPLE nodes, so it only goes
+        lock-free when one replica owns the entire fleet (ring of 1)."""
+        if self._sharding is None:
+            return self._ha_claims
+        if gang:
+            solo = self._sharding.is_live() and \
+                len(self._sharding.members()) == 1
+            SHARD_CONFLICTS.inc("owned" if solo else "spillover")
+            return not solo
+        if self._sharding.owns_for_bind(node):
+            SHARD_CONFLICTS.inc("owned")
+            return False
+        SHARD_CONFLICTS.inc("spillover")
+        return True
 
     def handle(self, args: dict[str, Any]) -> dict[str, Any]:
         with api_origin("bind"):
@@ -632,7 +658,8 @@ class BindHandler:
                 # the coordinator (reserve-everywhere on first member,
                 # planned-replay for the rest)
                 placement = self._gang.bind_member(
-                    pod, node, self._cluster, ha_claims=self._ha_claims,
+                    pod, node, self._cluster,
+                    ha_claims=self._claims_for(node, gang=True),
                     extra_annotations=trace_ann)
             else:
                 info = self._cache.get_node_info(node)
@@ -643,12 +670,18 @@ class BindHandler:
                 hint, hint_stamp, hint_spec = \
                     self._cache.placement_hint_stamped(pod, node)
                 placement = info.allocate(
-                    pod, self._cluster, ha_claims=self._ha_claims,
+                    pod, self._cluster,
+                    ha_claims=self._claims_for(node, gang=False),
                     hint=hint, hint_stamp=hint_stamp,
                     hint_speculative=hint_spec,
                     extra_annotations=trace_ann)
             audit["chip_ids"] = list(placement.chip_ids)
             self._cache.forget_memo(pod)
+            if self._sharding is not None and membership is None:
+                # our own bind moved the node's stamp; tell the
+                # revalidation check so it isn't mistaken for a
+                # straggler write from the previous shard owner
+                self._sharding.note_bound(node)
         except AlreadyBoundError as e:
             err = e
             bound_node = podlib.pod_node_name(pod)
@@ -664,6 +697,8 @@ class BindHandler:
             # benign HA backpressure: the scheduler retries; no
             # FailedScheduling-style event, but counted for operators
             self.claim_conflicts.inc()
+            if self._sharding is not None:
+                SHARD_CONFLICTS.inc("cas_lost")
             self.bind_failures.inc()
             log.info("bind %s/%s -> %s refused: %s", ns, name, node, e)
             return {"Error": str(e)}

@@ -43,7 +43,8 @@ class ExtenderServer:
                  host: str = "0.0.0.0", port: int = 39999,
                  allow_debug_seed: bool = False,
                  elector=None, informer=None, breaker=None,
-                 request_deadline_s: float | None = None) -> None:
+                 request_deadline_s: float | None = None,
+                 sharding=None) -> None:
         self.registry = registry or Registry()
         self._cache = cache
         self._informer = informer
@@ -115,9 +116,11 @@ class ExtenderServer:
         # a per-bind apiserver GET.
         self.bind_handler = BindHandler(
             cache, cluster, self.registry,
-            ha_claims=elector is not None, gang=self.gang,
+            ha_claims=elector is not None or sharding is not None,
+            gang=self.gang,
             pod_lister=informer.pods if informer is not None else None,
-            breaker=breaker, tracer=self.tracer, explain=self.explain)
+            breaker=breaker, tracer=self.tracer, explain=self.explain,
+            sharding=sharding)
         self.inspect_handler = InspectHandler(cache)
         if breaker is not None:
             from tpushare.k8s.breaker import register_breaker_gauge
@@ -142,6 +145,15 @@ class ExtenderServer:
         # (Filter/Inspect stay readable on every replica — their caches are
         # watch-warmed). None = single-replica mode, always leader.
         self._elector = elector
+        # active-active sharding (ha/sharding.py) SUPERSEDES the leader
+        # gate: every replica binds (lock-free on its own shard, claim
+        # CAS on spillover), owned-subset cache views track the ring,
+        # and the defrag controller runs only on the ring leader so
+        # exactly one planner acts fleet-wide.
+        self._sharding = sharding
+        if sharding is not None:
+            sharding.attach(self.registry)
+            self.defrag.gate = sharding.is_ring_leader
 
     # -- request routing ------------------------------------------------------
 
@@ -203,7 +215,12 @@ class ExtenderServer:
                     self._reply(
                         200, server_self.preempt_handler.handle(args))
                 elif self.path == f"{PREFIX}/bind":
-                    if server_self._elector is not None and \
+                    # active-active (sharding wired): EVERY replica
+                    # binds — lock-free on its shard, claim-CAS on
+                    # spillover — so the leader gate applies only to
+                    # the legacy active-passive elector mode
+                    if server_self._sharding is None and \
+                            server_self._elector is not None and \
                             not server_self._elector.is_leader():
                         # retryable: the default scheduler re-binds
                         # after its timeout and reaches the leader
@@ -259,6 +276,19 @@ class ExtenderServer:
                     elif self.path == "/inspect/defrag" or \
                             self.path == f"{PREFIX}/inspect/defrag":
                         self._reply(200, server_self.defrag.snapshot())
+                    elif self.path == "/inspect/ring" or \
+                            self.path == f"{PREFIX}/inspect/ring":
+                        if server_self._sharding is not None:
+                            self._reply(200,
+                                        server_self._sharding.snapshot())
+                        else:
+                            self._reply(200, {
+                                "enabled": False,
+                                "mode": ("leader-elect"
+                                         if server_self._elector
+                                         is not None
+                                         else "single-replica"),
+                            })
                     elif self.path == f"{PREFIX}/inspect" or \
                             self.path == f"{PREFIX}/inspect/":
                         self._reply(200, server_self.inspect_handler.handle())

@@ -1,17 +1,24 @@
-"""High availability: Lease-based leader election for the extender.
+"""High availability: leader election and active-active sharding.
 
 The reference lists scheduler-extender HA as an unimplemented roadmap item
 (/root/reference/README.md:80) and deploys a single replica with
 ``ignorable: false`` — extender downtime blocks all gpu-mem scheduling
-(SURVEY §5.3d). tpushare closes that gap: multiple extender replicas run
-behind the Service; all of them serve Filter/Inspect from their own
-watch-warmed caches, while the Bind verb — the only writer — is gated on
-holding a ``coordination.k8s.io/v1`` Lease, the same mechanism
-kube-scheduler itself uses for leader election. A non-leader replica
-answers binds with a retryable error; the default scheduler retries and
-the Service (or the scheduler's own retry) reaches the leader.
+(SURVEY §5.3d). tpushare closes that gap in two modes:
+
+- **Active-passive** (`leaderelection.py`): multiple replicas behind the
+  Service; all serve Filter/Inspect from their own watch-warmed caches,
+  while Bind — the only writer — is gated on holding one
+  ``coordination.k8s.io/v1`` Lease. Every bind pays a per-node claim CAS.
+- **Active-active** (`sharding.py` + `ring.py`): every replica renews its
+  own membership lease; a consistent-hash ring deterministically shards
+  the fleet over the live members, and each replica binds **lock-free**
+  (no claim CAS) within its shard, falling back to the claim-CAS path
+  only for cross-shard spillover. This is the ROADMAP item-1 structural
+  unlock — aggregate bind throughput scales with replicas.
 """
 
 from tpushare.ha.leaderelection import LeaderElector
+from tpushare.ha.ring import HashRing
+from tpushare.ha.sharding import ShardMembership
 
-__all__ = ["LeaderElector"]
+__all__ = ["LeaderElector", "HashRing", "ShardMembership"]

@@ -1,0 +1,397 @@
+"""Active-active shard membership: N replicas, one lease each.
+
+The leader-election machinery (`leaderelection.py`) generalized from one
+contested lease to N uncontested ones: every extender replica renews its
+OWN Lease (``tpushare-schd-shard-<identity>``) and lists the others, so
+the live membership is simply "every shard lease whose holder is set and
+whose renewTime has not expired". Membership feeds an immutable
+:class:`~tpushare.ha.ring.HashRing`; each replica deterministically owns
+the shard of node names the ring hashes to it and schedules those
+**lock-free** — no per-node claim CAS — while cross-shard spillover
+falls back to the claim-CAS path the active-passive design already
+proved safe.
+
+Safety protocol (the part that makes lock-free correct):
+
+- **Self step-down.** A replica that cannot renew its own lease within
+  ``lease_duration`` stops claiming ownership entirely (``live`` drops,
+  ``is_owned`` answers False for everything): by then the others have
+  expired it from membership and re-own its shard, and a partitioned
+  stale owner binding lock-free alongside the new owner is exactly the
+  split-brain the lease TTL exists to prevent. Its binds degrade to the
+  claim-CAS spillover path, which is mutual-exclusion-safe against any
+  other writer.
+- **Handover revalidation.** A rebalance hands this replica nodes whose
+  recent history it did not schedule (the previous owner may still have
+  a bind in flight). Each newly owned node enters a pending set with its
+  current generation stamp; ``owns_for_bind`` promotes it to lock-free
+  only once a later check sees the stamp UNCHANGED — i.e. the node
+  provably quiesced across the observation gap. Until then binds keep
+  the claim CAS (counted ``spillover``), so a straggler write from the
+  old owner can race nothing.
+
+Lock discipline: ``self._lock`` is LEFTMOST in the documented order (see
+tests/test_lock_order_lint.py) — it guards only the membership/ring/
+pending bookkeeping and is never held across lease I/O, a solve, or a
+bind. The ring itself is immutable and swapped by reference, so the
+bind-path reads (`is_owned`, `owner_of`) are plain attribute loads.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable
+
+from tpushare.ha.leaderelection import (
+    LEASE_NAMESPACE, _fmt, _now, _parse)
+from tpushare.ha.ring import DEFAULT_VNODES, HashRing
+from tpushare.k8s.client import ApiError
+from tpushare.metrics import Counter, LabeledCounter
+
+log = logging.getLogger("tpushare.ha")
+
+SHARD_LEASE_PREFIX = "tpushare-schd-shard-"
+
+# Per-bind ownership outcomes: `owned` binds skipped the claim CAS
+# entirely (the restored plain path), `spillover` kept it (foreign or
+# not-yet-revalidated node), `cas_lost` is the subset of spillover binds
+# that actually lost the CAS to another writer. Sustained cas_lost
+# growth = replicas fighting over the same nodes (ring churn, or a
+# workload whose only fits are off-shard) — see docs/ops.md.
+SHARD_CONFLICTS = LabeledCounter(
+    "tpushare_shard_conflicts_total",
+    "Bind-path shard ownership outcomes "
+    "(owned = lock-free, spillover = claim-CAS fallback, "
+    "cas_lost = spillover bind that lost the CAS)",
+    ("outcome",))
+
+RING_REBALANCES = Counter(
+    "tpushare_ring_rebalances_total",
+    "Consistent-hash ring rebuilds on membership change (join, leave, "
+    "lease expiry). Each rebalance re-routes ~1/N of the fleet and "
+    "re-arms stamp revalidation for the handed-over nodes")
+
+
+class ShardMembership:
+    """One replica's view of the active-active membership.
+
+    ``cluster`` needs get/create/update/list_leases; ``cache`` (optional
+    but wired in production) provides node names + stamps for handover
+    revalidation and receives ownership refreshes for its owned-subset
+    views (index / eqclass / arena).
+    """
+
+    def __init__(
+        self,
+        cluster,
+        identity: str,
+        cache=None,
+        namespace: str = LEASE_NAMESPACE,
+        lease_duration: float = 15.0,
+        renew_period: float = 5.0,
+        retry_period: float = 2.0,
+        vnodes: int | None = None,
+        on_rebalance: Callable[[], None] | None = None,
+    ) -> None:
+        self._cluster = cluster
+        self.identity = identity
+        self.lease_name = SHARD_LEASE_PREFIX + identity
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self._cache = cache
+        self._on_rebalance = on_rebalance
+        if vnodes is None:
+            vnodes = int(os.environ.get("TPUSHARE_SHARD_VNODES",
+                                        DEFAULT_VNODES))
+        self.vnodes = max(1, vnodes)
+        # _ring/_live are swapped whole (reference assignment) so the
+        # bind path reads them without the membership lock
+        self._ring: HashRing | None = None
+        self._live = False
+        self._lock = threading.Lock()  # LEFTMOST: bookkeeping only
+        self._members: tuple[str, ...] = ()
+        self._pending: dict[str, tuple[int, int] | None] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_renew = 0.0
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"tpushare-shard-{self.identity}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._live = False
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._release()
+
+    def _release(self) -> None:
+        """Best-effort holder clear so peers expire us immediately
+        instead of after a full TTL."""
+        try:
+            lease = self._cluster.get_lease(self.namespace, self.lease_name)
+            spec = dict(lease.get("spec") or {})
+            if spec.get("holderIdentity") != self.identity:
+                return
+            spec["holderIdentity"] = ""
+            self._cluster.update_lease(
+                self.namespace, self.lease_name, spec,
+                resource_version=(lease.get("metadata") or {})
+                .get("resourceVersion"))
+        except ApiError:
+            pass
+
+    # -- bind-path reads (lock-free) ------------------------------------------
+
+    def members(self) -> tuple[str, ...]:
+        return self._members
+
+    def is_live(self) -> bool:
+        return self._live
+
+    def is_owned(self, node_name: str) -> bool:
+        """Ring says this replica owns the node (ignores the pending
+        handover state — use :meth:`owns_for_bind` on the bind path)."""
+        ring = self._ring
+        return self._live and ring is not None \
+            and ring.owner(node_name) == self.identity
+
+    def owner_of(self, node_name: str) -> str | None:
+        ring = self._ring
+        return None if ring is None else ring.owner(node_name)
+
+    def is_ring_leader(self) -> bool:
+        """Deterministic fleet-wide singleton seat (lowest live member):
+        gates the defrag controller so exactly one planner runs."""
+        ring = self._ring
+        return self._live and ring is not None \
+            and ring.leader() == self.identity
+
+    def owns_for_bind(self, node_name: str) -> bool:
+        """True iff a bind on ``node_name`` may skip the claim CAS:
+        owned by the ring AND past handover revalidation.
+
+        A pending node is promoted when its generation stamp is
+        UNCHANGED since the last observation — the node quiesced across
+        the gap, so no straggler write from the previous owner is in
+        flight. A moved stamp re-arms the check with the new stamp and
+        keeps this bind on the CAS path (safe, merely slower).
+        """
+        if not self.is_owned(node_name):
+            return False
+        with self._lock:
+            if node_name not in self._pending:
+                return True
+            recorded = self._pending[node_name]
+        current = self._stamp(node_name)
+        with self._lock:
+            if node_name not in self._pending:
+                return True  # a concurrent check already promoted it
+            if recorded is not None and current == recorded:
+                del self._pending[node_name]
+                return True
+            self._pending[node_name] = current
+        return False
+
+    def note_bound(self, node_name: str) -> None:
+        """A bind by THIS replica just mutated ``node_name``. Our own
+        write is not a straggler from the previous owner, yet it moves
+        the generation stamp exactly like one — without this hook a
+        node under sustained bind traffic re-arms on every check and
+        never leaves the CAS path. Re-recording the post-bind stamp
+        keeps the quiesce window honest (any foreign write landing
+        after it still moves the stamp and re-arms) while letting the
+        next check promote."""
+        with self._lock:
+            if node_name not in self._pending:
+                return
+        current = self._stamp(node_name)
+        with self._lock:
+            if node_name in self._pending:
+                self._pending[node_name] = current
+
+    def _stamp(self, node_name: str) -> tuple[int, int] | None:
+        if self._cache is None:
+            return None
+        info = self._cache.peek_node(node_name)
+        return None if info is None else info.version
+
+    # -- membership loop ------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            ok = self._renew_own_lease()
+            if ok:
+                self._last_renew = time.monotonic()
+            elif self._live and (time.monotonic() - self._last_renew
+                                 > self.lease_duration):
+                # self step-down: peers have expired us by now and
+                # re-own our shard; claiming ownership any longer would
+                # be the lock-free split-brain the TTL exists to prevent
+                log.warning("shard: %s renew deadline exceeded; dropping "
+                            "ownership", self.identity)
+                self._live = False
+            try:
+                members = self._list_members()
+            except ApiError:
+                members = None  # keep the last view; expiry is peer-side
+            if members is not None:
+                self._apply_membership(members)
+            if self._stop.wait(self.renew_period if ok
+                               else self.retry_period):
+                break
+
+    def _renew_own_lease(self) -> bool:
+        now = _fmt(_now())
+        spec = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration) or 1,
+            "acquireTime": now,
+            "renewTime": now,
+        }
+        try:
+            lease = self._cluster.get_lease(self.namespace, self.lease_name)
+        except ApiError as e:
+            if not e.is_not_found:
+                return False
+            try:
+                self._cluster.create_lease(
+                    self.namespace, self.lease_name, spec)
+                return True
+            except ApiError:
+                return False  # creation raced (stale previous self)
+        old = lease.get("spec") or {}
+        if old.get("acquireTime") and \
+                old.get("holderIdentity") == self.identity:
+            spec["acquireTime"] = old["acquireTime"]
+        try:
+            self._cluster.update_lease(
+                self.namespace, self.lease_name, spec,
+                resource_version=(lease.get("metadata") or {})
+                .get("resourceVersion"))
+            return True
+        except ApiError:
+            return False
+
+    def _list_members(self) -> list[str]:
+        """Live shard members: every ``tpushare-schd-shard-*`` lease
+        with a holder and an unexpired renewTime."""
+        members = []
+        for lease in self._cluster.list_leases(self.namespace):
+            name = (lease.get("metadata") or {}).get("name") or ""
+            if not name.startswith(SHARD_LEASE_PREFIX):
+                continue
+            spec = lease.get("spec") or {}
+            holder = spec.get("holderIdentity")
+            if not holder:
+                continue  # released / abdicated
+            renew = _parse(spec.get("renewTime"))
+            duration = float(spec.get("leaseDurationSeconds")
+                             or self.lease_duration)
+            if renew is None or \
+                    (_now() - renew).total_seconds() > duration:
+                continue  # expired: the replica died or partitioned
+            members.append(holder)
+        return sorted(set(members))
+
+    def _apply_membership(self, members: list[str]) -> None:
+        in_ring = self.identity in members
+        prev_ring = self._ring
+        with self._lock:
+            changed = tuple(members) != self._members
+        if not changed:
+            self._live = in_ring
+            return
+        new_ring = HashRing(members, vnodes=self.vnodes)
+        # arm handover revalidation BEFORE publishing the new ring:
+        # a bind must never see a newly-owned node as plain-owned
+        # without passing through the pending set
+        pending: dict[str, tuple[int, int] | None] = {}
+        if self._cache is not None and in_ring:
+            for name in self._cache.node_names():
+                if new_ring.owner(name) != self.identity:
+                    continue
+                if prev_ring is not None and self._live and \
+                        prev_ring.owner(name) == self.identity:
+                    continue  # continuously owned: no handover happened
+                pending[name] = self._stamp(name)
+        with self._lock:
+            self._members = tuple(members)
+            # carry over still-unrevalidated nodes we still own
+            for name, st in self._pending.items():
+                if new_ring.owner(name) == self.identity \
+                        and name not in pending:
+                    pending[name] = st
+            self._pending = pending
+        self._ring = new_ring
+        self._live = in_ring
+        RING_REBALANCES.inc()
+        log.info("shard: %s ring rebalanced to %d member(s) %s "
+                 "(%d node(s) pending revalidation)", self.identity,
+                 len(members), members, len(pending))
+        if self._cache is not None and \
+                hasattr(self._cache, "set_ownership"):
+            # refresh the owned-subset views (index summaries, arena
+            # residency); runs outside self._lock — it takes cache locks
+            self._cache.set_ownership(self.is_owned if in_ring else None)
+        if self._on_rebalance is not None:
+            try:
+                self._on_rebalance()
+            except Exception as e:  # noqa: BLE001
+                log.error("shard: on_rebalance callback failed: %s", e)
+
+    # -- observability --------------------------------------------------------
+
+    def owned_count(self) -> int:
+        if self._cache is None or not self._live:
+            return 0
+        ring = self._ring
+        if ring is None:
+            return 0
+        return sum(1 for n in self._cache.node_names()
+                   if ring.owner(n) == self.identity)
+
+    def snapshot(self) -> dict:
+        """The /inspect/ring payload."""
+        ring = self._ring
+        with self._lock:
+            members = list(self._members)
+            pending = len(self._pending)
+        names = self._cache.node_names() if self._cache is not None else []
+        sizes = ring.shard_sizes(names) if ring is not None else {}
+        return {
+            "identity": self.identity,
+            "live": self._live,
+            "ring_leader": ring.leader() if ring is not None else None,
+            "members": members,
+            "vnodes": self.vnodes,
+            "lease_duration_s": self.lease_duration,
+            "shard_sizes": sizes,
+            "owned_nodes": sizes.get(self.identity, 0),
+            "pending_revalidation": pending,
+            "rebalances_total": RING_REBALANCES.value,
+            "conflicts": {
+                "owned": SHARD_CONFLICTS.get("owned"),
+                "spillover": SHARD_CONFLICTS.get("spillover"),
+                "cas_lost": SHARD_CONFLICTS.get("cas_lost"),
+            },
+        }
+
+    def attach(self, registry) -> None:
+        registry.register(SHARD_CONFLICTS)
+        registry.register(RING_REBALANCES)
+        registry.gauge_func(
+            "tpushare_shard_owned_nodes",
+            "Nodes this replica's ring shard currently owns (0 while "
+            "not live in the membership)",
+            lambda: [("", float(self.owned_count()))])

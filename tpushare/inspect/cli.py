@@ -5,6 +5,7 @@ the operator subcommands over the extender's diagnostic endpoints:
     tpushare-inspect <node>            # one node, per-chip detail
     tpushare-inspect fleet             # /inspect/fleet health snapshot
     tpushare-inspect defrag            # /inspect/defrag rebalancer state
+    tpushare-inspect ring              # /inspect/ring shard membership
     tpushare-inspect explain [<pod>]   # /inspect/explain decision audit
     tpushare-inspect traces [-n N]     # /debug/traces flight recorder
 
@@ -193,6 +194,44 @@ def render_defrag(snap: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_ring(snap: dict[str, Any]) -> str:
+    """Terminal rendering of the /inspect/ring membership snapshot."""
+    if snap.get("enabled") is False:
+        return (f"sharding disabled "
+                f"(mode: {snap.get('mode', 'single-replica')})")
+    lines: list[str] = []
+    members = snap.get("members") or []
+    lines.append(
+        f"ring: {len(members)} member(s), {snap.get('vnodes')} vnodes, "
+        f"lease TTL {snap.get('lease_duration_s')} s, "
+        f"{int(snap.get('rebalances_total', 0))} rebalance(s)")
+    lines.append(
+        f"this replica: {snap.get('identity')} "
+        f"({'live' if snap.get('live') else 'NOT LIVE'}"
+        + (", ring leader" if snap.get("ring_leader")
+           == snap.get("identity") else "")
+        + f"), {snap.get('owned_nodes', 0)} owned node(s), "
+        f"{snap.get('pending_revalidation', 0)} pending revalidation")
+    sizes = snap.get("shard_sizes") or {}
+    rows = [["MEMBER", "SHARD NODES", ""]]
+    for m in members:
+        tags = []
+        if m == snap.get("ring_leader"):
+            tags.append("leader")
+        if m == snap.get("identity"):
+            tags.append("self")
+        rows.append([m, str(sizes.get(m, 0)), ",".join(tags)])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines.extend(_fmt_row(r, widths) for r in rows)
+    c = snap.get("conflicts") or {}
+    lines.append("")
+    lines.append(
+        f"bind outcomes: owned {int(c.get('owned', 0))} (lock-free), "
+        f"spillover {int(c.get('spillover', 0))} (claim CAS), "
+        f"cas_lost {int(c.get('cas_lost', 0))}")
+    return "\n".join(lines)
+
+
 def render_traces(dump: dict[str, Any], limit: int | None = None) -> str:
     """Terminal rendering of the /debug/traces flight recorder."""
     lines: list[str] = []
@@ -227,7 +266,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="traces: show at most N traces")
     ap.add_argument("target", nargs="*", default=[],
                     help="node name, or a subcommand: 'fleet', 'defrag', "
-                         "'explain [pod]', 'traces'")
+                         "'ring', 'explain [pod]', 'traces'")
     args = ap.parse_args(argv)
     cmd = args.target[0] if args.target else None
     try:
@@ -240,6 +279,11 @@ def main(argv: list[str] | None = None) -> int:
             snap = fetch_path(args.endpoint, "/inspect/defrag")
             print(json.dumps(snap, indent=2) if args.json
                   else render_defrag(snap))
+            return 0
+        if cmd == "ring":
+            snap = fetch_path(args.endpoint, "/inspect/ring")
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_ring(snap))
             return 0
         if cmd == "explain":
             path = "/inspect/explain"

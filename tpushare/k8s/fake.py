@@ -289,6 +289,13 @@ class FakeCluster:
             self._leases[key] = lease
             return copy.deepcopy(lease)
 
+    def list_leases(self, namespace: str) -> list[dict[str, Any]]:
+        with self._lock:
+            prefix = namespace + "/"
+            return [copy.deepcopy(lease)
+                    for key, lease in sorted(self._leases.items())
+                    if key.startswith(prefix)]
+
     def update_lease(self, namespace: str, name: str, spec: dict[str, Any],
                      resource_version: str | None = None) -> dict[str, Any]:
         with self._lock:

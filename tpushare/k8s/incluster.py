@@ -351,6 +351,10 @@ class InClusterClient:
                 "spec": spec}
         return self._json("POST", self._lease_path(namespace), body)
 
+    def list_leases(self, namespace: str) -> list[dict[str, Any]]:
+        return self._json("GET", self._lease_path(namespace)) \
+            .get("items", [])
+
     def update_lease(self, namespace: str, name: str, spec: dict[str, Any],
                      resource_version: str | None = None) -> dict[str, Any]:
         body = {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",

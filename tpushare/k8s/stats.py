@@ -35,7 +35,7 @@ APISERVER_REQUESTS = LabeledCounter(
 # from the read budget — they are the mechanism that REMOVES reads)
 READ_VERBS = frozenset({
     "list_pods", "list_pods_node", "list_pods_ns", "list_nodes",
-    "get_pod", "get_node", "get_configmap", "get_lease"})
+    "get_pod", "get_node", "get_configmap", "get_lease", "list_leases"})
 WRITE_VERBS = frozenset({
     "patch_pod", "replace_pod", "bind_pod", "patch_node",
     "put_configmap", "create_lease", "update_lease"})

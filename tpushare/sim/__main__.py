@@ -45,6 +45,13 @@ def main(argv: list[str] | None = None) -> int:
                          "budget (tpushare/sim/defrag.py)")
     ap.add_argument("--budgets", default="0,1,2,4",
                     help="--defrag: comma-separated move budgets to sweep")
+    ap.add_argument("--shards", type=int, default=0, metavar="N",
+                    help="active-active sharding mode: replay the "
+                         "standard arrival trace against 1, 2 and 4 "
+                         "simulated shard owners (or 1 and N when N is "
+                         "given and not in {1,2,4}); one JSON report "
+                         "per shard count, proving the scorecard is "
+                         "unchanged by shard ownership")
     ap.add_argument("--slice", action="store_true",
                     help="multi-host slice (gang) mode: one v5e-16 "
                          "(2x2 hosts of 2x2 chips), mixed single-chip "
@@ -103,6 +110,25 @@ def main(argv: list[str] | None = None) -> int:
                      high_priority_fraction=args.high_priority_fraction,
                      seed=args.seed)
     trace = synth_trace(spec)
+    if args.shards:
+        # sharding changes who HANDLES a bind, never its verdict: every
+        # shard count must emit an identical scorecard. One JSON per
+        # count, with the owned/spillover split attached.
+        from tpushare.sim.simulator import run_sim_sharded
+        if args.preempt != "off":
+            ap.error("--preempt does not apply to --shards mode")
+        policy = "binpack" if args.policy == "all" else args.policy
+        counts = [1, 2, 4] if args.shards in (1, 2, 4) else [1, args.shards]
+        for shards in counts:
+            fleet = Fleet.homogeneous(args.nodes, args.chips, args.hbm,
+                                      mesh)
+            report, stats = run_sim_sharded(fleet, trace, policy,
+                                            shards=shards)
+            out = report.to_json()
+            out["sharding"] = stats
+            print(json.dumps(out))
+        return 0
+
     policies = list(POLICIES) if args.policy == "all" else [args.policy]
     for policy in policies:
         fleet = Fleet.homogeneous(args.nodes, args.chips, args.hbm, mesh)

@@ -25,6 +25,7 @@ counts come along for sizing headroom.
 from __future__ import annotations
 
 import heapq
+import itertools
 import random
 from dataclasses import dataclass, field
 from typing import Callable
@@ -288,7 +289,11 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
     shows up in the victims' wait tail).
     """
     assert preempt in ("off", "scalar", "refined"), preempt
-    place = POLICIES[policy]
+    # a callable policy is accepted for wrappers (run_sim_sharded
+    # decorates a named policy with ownership attribution)
+    place = policy if callable(policy) else POLICIES[policy]
+    policy = policy if isinstance(policy, str) \
+        else getattr(policy, "policy_name", "custom")
     # event heap: (time, kind, seq, payload); kind 0=departure, 1=arrival
     # (departures first at equal times: free capacity before retrying)
     heap: list[tuple] = []
@@ -497,6 +502,62 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
         hp_p99_wait=_p99(hp_waits),
         waits=waits,
     )
+
+
+# -- sharded scheduling (active-active scale-out, ISSUE 10) ------------------
+
+def run_sim_sharded(fleet: Fleet, trace: list[SimPod],
+                    policy: str = "binpack", shards: int = 2,
+                    vnodes: int | None = None
+                    ) -> tuple[SimReport, dict]:
+    """Replay ``trace`` with ``shards`` simulated shard owners and prove
+    placement quality is UNCHANGED by sharding.
+
+    The model mirrors the live design exactly: sharding never alters a
+    scheduling verdict — every replica scores the whole fleet (owned
+    nodes from its resident views, foreign nodes via a transient scan),
+    so the chosen (node, chips) is identical to the unsharded run. What
+    sharding changes is the BIND mechanics: a verdict landing on the
+    handling replica's own shard binds lock-free, a foreign verdict
+    pays the claim-CAS spillover path. This wrapper attributes each
+    placement to a round-robin handling replica and a consistent-hash
+    ring over the node names (the real ring code), returning the
+    unchanged :class:`SimReport` plus the owned/spillover split — the
+    expected spillover share is (N-1)/N, which the live
+    ``tpushare_shard_conflicts_total`` metric should track.
+    """
+    from tpushare.ha.ring import DEFAULT_VNODES, HashRing
+
+    members = [f"replica-{i}" for i in range(max(1, shards))]
+    ring = HashRing(members, vnodes=vnodes or DEFAULT_VNODES)
+    base = POLICIES[policy]
+    counts = {"owned": 0, "spillover": 0}
+    cursor = itertools.count()
+
+    def sharded(fleet_: Fleet, req: PlacementRequest):
+        decision = base(fleet_, req)
+        if decision is not None:
+            replica = members[next(cursor) % len(members)]
+            node_name = fleet_.nodes[decision[0]].name
+            if ring.owner(node_name) == replica:
+                counts["owned"] += 1
+            else:
+                counts["spillover"] += 1
+        return decision
+
+    sharded.policy_name = policy
+    report = run_sim(fleet, trace, policy=sharded)
+    total = counts["owned"] + counts["spillover"]
+    stats = {
+        "shards": len(members),
+        "vnodes": ring.vnodes,
+        "shard_sizes": ring.shard_sizes(n.name for n in fleet.nodes),
+        "owned_binds": counts["owned"],
+        "spillover_binds": counts["spillover"],
+        "spillover_rate": round(counts["spillover"] / total, 4)
+        if total else None,
+    }
+    return report, stats
 
 
 # -- multi-host slice (gang) simulation -------------------------------------
