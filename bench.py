@@ -673,7 +673,14 @@ def _probe_backend_resilient(probe_cmd: list | None = None) -> dict:
     learn nothing new; the abandoned client is left running and exits
     on its own. Interactive diagnosis (the far end's real error after
     the ~25-min self-exit): TPUSHARE_WEDGE_WAIT=1800.
-    Knobs: TPUSHARE_PROBE_TIMEOUT (150 s), TPUSHARE_WEDGE_WAIT
+    Stage 0 is a short hard-deadlined PREFLIGHT (TPUSHARE_PREFLIGHT_TIMEOUT,
+    90 s): a healthy backend answers it in seconds; a preflight HANG is
+    the wedge signature itself and maps to skipped_env immediately --
+    in bounded wall time, instead of wedging the whole bench behind
+    one blocked init (BENCH_r03) -- while a clean nonzero exit falls
+    through to the patient attempts below.
+    Knobs: TPUSHARE_PREFLIGHT_TIMEOUT (90 s), TPUSHARE_PROBE_TIMEOUT
+    (150 s), TPUSHARE_WEDGE_WAIT
     (600 s default, see _wedge_wait_s; 0 = don't wait for self-exit;
     attempt 1 only), TPUSHARE_WEDGE_PAUSE (120 s).
     """
@@ -687,6 +694,36 @@ def _probe_backend_resilient(probe_cmd: list | None = None) -> dict:
     # point for the bench, and why hermetic tests must inject cmd.
     cmd = probe_cmd or [sys.executable, "-c",
                         "import jax; print(jax.default_backend())"]
+    # Stage 0: a SHORT preflight before the patient machinery (fixes
+    # the BENCH_r03-class wedge where the bench spent its whole capture
+    # window inside one blocked init). Three outcomes: a healthy
+    # backend answers in seconds -> done, no patient attempt needed; a
+    # HANG here (rc None: SIGINT unprocessed, blocked in the PJRT C
+    # call) is already the wedge signature, and the client is still
+    # alive holding the single-client relay slot -- a patient attempt
+    # behind it cannot answer, so map straight to skipped_env in
+    # bounded time (the abandoned client is left to self-exit, never
+    # killed); a clean nonzero exit is a fast *answer*, not a wedge --
+    # fall through to the patient attempts, which own retry semantics.
+    preflight_s = float(os.environ.get("TPUSHARE_PREFLIGHT_TIMEOUT", "90"))
+    try:
+        rc, out, err, note = _run_tpu_subprocess(
+            cmd, preflight_s, label="preflight",
+            self_exit_wait_s=0.0, sigint_grace_s=5.0)
+    except OSError as e:
+        return {"ok": False, "summary": f"backend probe: {e}",
+                "attempts": []}
+    if rc == 0:
+        return {"ok": True,
+                "summary": (out or "").strip().splitlines()[-1]
+                if (out or "").strip() else "ok",
+                "attempts": ["preflight: ok"]}
+    if rc is None:
+        return {"ok": False,
+                "summary": (f"jax backend init hung at preflight "
+                            f"(>{preflight_s:.0f}s; TPU tunnel wedged? "
+                            f"see docs/perf.md runbook): {note}"),
+                "attempts": [f"preflight: rc=None {note}"]}
     attempts = []
     for attempt in (1, 2):
         try:
@@ -2454,6 +2491,221 @@ def defrag_bench() -> dict:
     }
 
 
+def shard_scaleout_procs(n_procs: int = 4, n_pods: int = 96) -> dict:
+    """Wall-clock scale-out with REAL processes (ISSUE 11).
+
+    ``shard_scaleout()`` above proves the fleet-division win with
+    sequential in-process storms (honest on this 1-core box, where a
+    multi-core win is unmeasurable by construction). This arm measures
+    the thing that design exists to deliver: ``python bench.py
+    shard_scaleout --procs N`` launches N GENUINE extender processes
+    (own interpreter, own GIL, own cache) against one stub apiserver,
+    storms them round-robin over HTTP, and reports aggregate wall-clock
+    binds/sec for 1 process vs N. Off-shard arrivals hop to their owner
+    through the forward layer, so the N-proc arm also publishes the
+    summed forward/conflict counters — the spillover CAS staying near
+    zero is the forwarding layer doing its job. The >= 3x @ N=4
+    acceptance is asserted only when the box has the cores to show it
+    (os.cpu_count() >= N); on fewer cores the numbers are published
+    informationally. Either way both arms must finish with ZERO
+    oversubscribed chips on apiserver truth.
+    """
+    import signal as _signal
+    import subprocess
+    import threading
+
+    from tpushare import contract as _contract
+    from tpushare.k8s.incluster import InClusterClient
+    from tpushare.k8s.stubapi import StubApiServer
+
+    N_NODES = 16
+
+    def get_json(base: str, path: str) -> dict:
+        with urllib.request.urlopen(f"{base}{path}", timeout=5) as r:
+            return json.loads(r.read())
+
+    def arm(procs: int) -> dict:
+        stub = StubApiServer().start()
+        for i in range(N_NODES):
+            stub.seed("nodes", {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": f"sn{i}",
+                             "labels": {"tpushare": "true",
+                                        "tpushare.aliyun.com/mesh": "2x2"}},
+                "status": {"capacity": {
+                    "aliyun.com/tpu-hbm": str(4 * V5E_HBM),
+                    "aliyun.com/tpu-count": "4"}}})
+        env = dict(os.environ,
+                   TPUSHARE_SHARD_REPLICAS=str(procs),
+                   TPUSHARE_SHARD_LEASE_S="1.5",
+                   TPUSHARE_SHARD_RENEW_S="0.2",
+                   TPUSHARE_FLEETWATCH="0",
+                   TPUSHARE_DEFRAG="0",
+                   JAX_PLATFORMS="cpu")
+        children: list = []
+        bases: list[str] = []
+        try:
+            for _ in range(procs):
+                children.append(subprocess.Popen(
+                    [sys.executable, "-m", "tpushare.extender",
+                     "--apiserver", stub.base_url,
+                     "--host", "127.0.0.1", "--port", "0"],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, text=True))
+            for p in children:
+                deadline = time.monotonic() + 60
+                line = ""
+                while time.monotonic() < deadline:
+                    line = p.stdout.readline()
+                    if not line and p.poll() is not None:
+                        raise RuntimeError(
+                            f"extender died at startup rc={p.returncode}")
+                    if "ready on" in line:
+                        break
+                if "ready on" not in line:
+                    raise RuntimeError("extender never became ready")
+                bases.append("http://" + line.rsplit("on ", 1)[1].strip())
+            # every replica must see the full ring (and, past one
+            # member, every peer's advertised address) before the clock
+            # starts — otherwise the first storms measure lease renewal
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                rings = [get_json(b, "/inspect/ring") for b in bases]
+                if all(len(r.get("members", [])) == procs
+                       for r in rings) and \
+                        (procs == 1 or
+                         all(len(r.get("peers", {})) == procs
+                             for r in rings)):
+                    break
+                time.sleep(0.1)
+
+            pods = [stub.seed("pods", {
+                "metadata": {"name": f"sp-{i}", "namespace": "bench",
+                             "annotations": {}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "limits": {"aliyun.com/tpu-hbm": str(2 * GIB)}}}]}})
+                for i in range(n_pods)]
+            names = [f"sn{i}" for i in range(N_NODES)]
+            bound = [0]
+            lock = threading.Lock()
+
+            def post_json(base: str, path: str, body: dict) -> tuple:
+                req = urllib.request.Request(
+                    f"{base}{path}", data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status, json.loads(r.read())
+
+            def drive(chunk: list, k: int) -> None:
+                for j, pod in enumerate(chunk):
+                    meta = pod["metadata"]
+                    for a in range(20):
+                        base = bases[(k + j + a) % len(bases)]
+                        try:
+                            _, flt = post_json(
+                                base, "/tpushare-scheduler/filter",
+                                {"Pod": pod, "NodeNames": names})
+                            ok = flt.get("NodeNames") or []
+                            if not ok:
+                                break
+                            status, res = post_json(
+                                base, "/tpushare-scheduler/bind",
+                                {"PodName": meta["name"],
+                                 "PodNamespace": meta["namespace"],
+                                 "PodUID": meta.get("uid", ""),
+                                 "Node": ok[0]})
+                            if status == 200 and not res.get("Error"):
+                                with lock:
+                                    bound[0] += 1
+                                break
+                        except OSError:
+                            pass
+                        time.sleep(0.02)
+
+            n_drivers = min(8, max(2, 2 * procs))
+            chunks = [pods[i::n_drivers] for i in range(n_drivers)]
+            threads = [threading.Thread(target=drive, args=(c, k))
+                       for k, c in enumerate(chunks)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+
+            forwards: dict[str, int] = {}
+            conflicts: dict[str, int] = {}
+            for b in bases:
+                ring = get_json(b, "/inspect/ring")
+                for k, v in (ring.get("forwards") or {}).items():
+                    forwards[k] = forwards.get(k, 0) + int(v)
+                for k, v in (ring.get("conflicts") or {}).items():
+                    conflicts[k] = conflicts.get(k, 0) + int(v)
+            # apiserver truth: per-chip grant totals vs capacity
+            client = InClusterClient(base_url=stub.base_url, timeout=10.0)
+            per_chip: dict[tuple, int] = {}
+            for pod in client.list_pods():
+                ids = _contract.chip_ids_from_annotations(pod)
+                node = pod.get("spec", {}).get("nodeName")
+                if ids is None or not node:
+                    continue
+                grant = _contract.hbm_from_annotations(pod)
+                for c in ids:
+                    per_chip[(node, c)] = per_chip.get((node, c), 0) \
+                        + grant
+            oversub = sum(1 for used in per_chip.values()
+                          if used > V5E_HBM)
+            return {"procs": procs, "bound": bound[0],
+                    "wall_s": round(wall, 3),
+                    "binds_per_sec": round(bound[0] / wall, 1)
+                    if wall else None,
+                    "forwards": forwards, "conflicts": conflicts,
+                    "oversubscribed_chips": oversub}
+        finally:
+            for p in children:
+                if p.poll() is None:
+                    p.send_signal(_signal.SIGTERM)
+            for p in children:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            stub.stop()
+
+    single = arm(1)
+    multi = arm(n_procs)
+    speedup = (multi["binds_per_sec"] / single["binds_per_sec"]
+               if single["binds_per_sec"] and multi["binds_per_sec"]
+               else None)
+    checks: list[str] = []
+    cores = os.cpu_count() or 1
+    if cores >= n_procs:
+        ok = speedup is not None and speedup >= 3.0 and n_procs >= 4
+        checks.append(("PASS " if ok or n_procs < 4 else "FAIL ")
+                      + f"aggregate >= 3x single-process binds/sec "
+                        f"at N={n_procs} (got {speedup}x)")
+    else:
+        checks.append(f"INFO {cores}-core box < N={n_procs} procs: "
+                      f"speedup {speedup}x published informationally, "
+                      "not asserted")
+    checks.append(("PASS " if single["bound"] == n_pods
+                   and multi["bound"] == n_pods else "FAIL ")
+                  + f"every pod bound (single {single['bound']}/"
+                    f"{n_pods}, multi {multi['bound']}/{n_pods})")
+    checks.append(("PASS " if single["oversubscribed_chips"] == 0
+                   and multi["oversubscribed_chips"] == 0 else "FAIL ")
+                  + "zero oversubscribed chips on apiserver truth")
+    spill = multi["conflicts"].get("spillover", 0)
+    checks.append(("PASS " if spill <= n_pods * 0.1 else "FAIL ")
+                  + f"forwarding keeps the spillover CAS near zero "
+                    f"({spill} spillovers / {n_pods} binds)")
+    return {"single": single, "multi": multi,
+            "speedup": round(speedup, 2) if speedup else None,
+            "cores": cores, "checks": checks,
+            "failed": sum(1 for c in checks if c.startswith("FAIL"))}
+
+
 SLICE_HOSTS = [f"v5e16-h{i}" for i in range(4)]
 
 
@@ -3065,4 +3317,10 @@ if __name__ == "__main__":
         result = _kernel_bench_inline()
         print(json.dumps(result or {}))
         sys.exit(0)
+    if "shard_scaleout" in sys.argv:
+        procs = int(sys.argv[sys.argv.index("--procs") + 1]) \
+            if "--procs" in sys.argv else 4
+        result = shard_scaleout_procs(procs)
+        print(json.dumps(result, indent=2))
+        sys.exit(1 if result["failed"] else 0)
     sys.exit(main())

@@ -172,3 +172,72 @@ def test_onchip_midsuite_wedge_is_skipped_env(monkeypatch):
     out = bench.onchip_tests(timeout_s=10)
     assert out["status"] == "skipped_env"
     assert "NOT killed" in out["summary"]
+
+
+def test_preflight_hang_maps_to_skipped_env_in_bounded_time(monkeypatch):
+    # the BENCH_r03 wedge: init hangs at the very first touch, blocked
+    # where SIGINT cannot be processed. The preflight must convert that
+    # into a skipped_env verdict in bounded WALL-CLOCK time — measured
+    # here against a real SIGINT-immune subprocess (the wedge
+    # signature), not a monkeypatched stub. (A client that DOES die on
+    # SIGINT after the deadline self-resolved — that shape falls
+    # through to the patient machinery instead, by design.)
+    monkeypatch.setenv("TPUSHARE_PREFLIGHT_TIMEOUT", "0.5")
+    t0 = time.monotonic()
+    probe = bench._probe_backend_resilient(probe_cmd=[
+        sys.executable, "-c",
+        "import signal, time\n"
+        "signal.signal(signal.SIGINT, signal.SIG_IGN)\n"
+        "time.sleep(45)"])
+    elapsed = time.monotonic() - t0
+    assert elapsed < 20, f"preflight not bounded: {elapsed:.1f}s"
+    assert probe["ok"] is False
+    assert "preflight" in probe["summary"]
+    assert probe["attempts"] and "preflight" in probe["attempts"][0]
+
+
+def test_preflight_never_sigkills_a_blocked_client(monkeypatch):
+    # a client blocked in the PJRT C call processes no signals at all;
+    # the preflight must ABANDON it (rc None path), not SIGKILL it —
+    # proven with a subprocess that ignores SIGINT/SIGTERM and writes a
+    # liveness file after the probe has given up on it.
+    import signal
+    import subprocess
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        alive = os.path.join(td, "alive")
+        code = (
+            "import os, signal, sys, time\n"
+            "signal.signal(signal.SIGINT, signal.SIG_IGN)\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "time.sleep(8)\n"  # outlives the 5 s SIGINT grace: rc None
+            f"open({alive!r}, 'w').write('still here')\n")
+        monkeypatch.setenv("TPUSHARE_PREFLIGHT_TIMEOUT", "0.3")
+        probe = bench._probe_backend_resilient(
+            probe_cmd=[sys.executable, "-c", code])
+        assert probe["ok"] is False
+        assert "NOT killed" in probe["summary"]
+        # the abandoned client survived the probe and self-exited on
+        # its own schedule — a SIGKILL would have left no liveness file
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not os.path.exists(alive):
+            time.sleep(0.1)
+        assert os.path.exists(alive), "blocked client was killed"
+
+
+def test_preflight_healthy_backend_skips_patient_machinery(monkeypatch):
+    # a healthy backend answers the preflight; the patient attempts
+    # (and their wedge-waits) must never run
+    calls = []
+
+    def fake_run(cmd, timeout_s, env=None, label="", self_exit_wait_s=0.0,
+                 sigint_grace_s=20.0):
+        calls.append((label, timeout_s, self_exit_wait_s))
+        return 0, "tpu\n", "", ""
+
+    monkeypatch.setattr(bench, "_run_tpu_subprocess", fake_run)
+    probe = bench._probe_backend_resilient()
+    assert probe["ok"] is True and probe["summary"] == "tpu"
+    assert [c[0] for c in calls] == ["preflight"]
+    # and the preflight itself never waits for a self-exit: bounded
+    assert calls[0][2] == 0.0

@@ -141,12 +141,15 @@ def _render_helm(template_path: str, values: dict) -> str:
     # {{- /* comments */ -}}
     text = re.sub(r"\{\{-?\s*/\*.*?\*/\s*-?\}\}\n?", "", text, flags=re.S)
 
-    # {{- if .Values.x }} ... {{- end }} (no nesting in this chart)
+    # {{- if .Values.x }} ... {{- end }} (no nesting in this chart).
+    # Like real Helm, `{{-` chomps the preceding whitespace — without
+    # that an INDENTED if/end (inside an env: list, say) would leave
+    # its indentation behind, gluing the next line mid-document.
     def if_repl(m):
         return m.group(2) if lookup(m.group(1)) else ""
 
     text = re.sub(
-        r"\{\{-? if (\.Values[.\w]+) \}\}\n(.*?)\{\{-? end \}\}\n?",
+        r"[ \t]*\{\{-? if (\.Values[.\w]+) \}\}\n(.*?)[ \t]*\{\{-? end \}\}\n?",
         if_repl, text, flags=re.S)
 
     # {{ toYaml .Values.x | indent N }}
@@ -219,3 +222,40 @@ def test_evict_and_recover_scripts(fake_host):
             "stock-tpu-device-plugin.yaml").exists()
     r = run_script("dp-recover-on-host.sh", env)
     assert r.returncode == 0 and stock.exists()
+
+
+def test_chart_sharding_mode_wires_scaleout_env_and_rbac():
+    """extender.sharding=true must render the active-active env block
+    (shard count, forward knob, a podIP-derived advertise URL) and the
+    ClusterRole must grant lease "list" — membership and peer forward
+    addresses are DISCOVERED by listing the shard leases, so a chart
+    without "list" deploys replicas that can never see each other."""
+    chart = os.path.join(REPO, "deployer/chart/tpushare-installer")
+    with open(os.path.join(chart, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    values["extender"]["sharding"] = True
+    values["extender"]["replicas"] = 3
+    text = _render_helm(
+        os.path.join(chart, "templates", "extender.yaml"), values)
+    docs = [d for d in yaml.safe_load_all(text) if d]
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    lease_rule = next(r for r in role["rules"]
+                      if "leases" in r["resources"])
+    assert "list" in lease_rule["verbs"]
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    env = {e["name"]: e for e in
+           dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUSHARE_SHARD_REPLICAS"]["value"] == "3"
+    assert env["TPUSHARE_FORWARD"]["value"] == "1"
+    assert env["POD_IP"]["valueFrom"]["fieldRef"]["fieldPath"] == \
+        "status.podIP"
+    # hostNetwork: podIP == host IP, container port == peer port, so
+    # the advertised URL is replica-reachable as rendered
+    assert dep["spec"]["template"]["spec"]["hostNetwork"] is True
+    assert env["TPUSHARE_ADVERTISE_URL"]["value"] == \
+        "http://$(POD_IP):12345"
+    # and the block actually gates: default values render WITHOUT it
+    values["extender"]["sharding"] = False
+    text = _render_helm(
+        os.path.join(chart, "templates", "extender.yaml"), values)
+    assert "TPUSHARE_SHARD_REPLICAS" not in text

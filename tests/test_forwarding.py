@@ -1,0 +1,370 @@
+"""Owner-forwarding tests (ha/forward.py + the server routing hook).
+
+Three layers:
+
+1. two complete in-process replica stacks over one FakeCluster, with the
+   peer address book cross-wired the way the lease listing would build
+   it — the happy path (a bind landing off-owner hops once and the owner
+   binds lock-free), the mid-rebalance ownership disagreement (the loop
+   guard stops a second hop and the bind degrades to the claim CAS), and
+   the dead-peer path (transport failure -> per-peer breaker -> local
+   CAS, never a lost bind);
+2. router-level decision checks that need no HTTP at all;
+3. (slow) a 2-process end-to-end storm over the stub apiserver with a
+   replica kill mid-storm and the apiserver-truth zero-oversubscription
+   audit — real processes, the topology bench.py shard_scaleout --procs
+   measures.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller
+from tpushare.extender.server import ExtenderServer
+from tpushare.ha.forward import FORWARD_HEADER, ForwardRouter
+from tpushare.ha.sharding import (
+    SHARD_CONFLICTS, SHARD_FORWARDS, ShardMembership)
+from tpushare.k8s import FakeCluster
+
+
+def post(url, body, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def forwards():
+    return {o: SHARD_FORWARDS.get(o)
+            for o in ("forwarded", "served", "loop_fallback",
+                      "peer_failed")}
+
+
+def conflicts():
+    return {o: SHARD_CONFLICTS.get(o)
+            for o in ("owned", "spillover", "cas_lost")}
+
+
+def delta(before, after):
+    return {k: after[k] - before[k] for k in after}
+
+
+@pytest.fixture
+def duo():
+    """Two full replica stacks ('ra', 'rb') over one FakeCluster, ring
+    applied directly (deterministic, no renewal threads) and peer URLs
+    cross-wired."""
+    fc = FakeCluster()
+    for i in range(8):
+        fc.add_tpu_node(f"n{i}", chips=4, hbm_per_chip_mib=16000,
+                        mesh="2x2")
+    reps = {}
+    for ident in ("ra", "rb"):
+        cache = SchedulerCache(fc)
+        ctl = Controller(fc, cache)
+        ctl.build_cache()
+        ctl.start()
+        sm = ShardMembership(fc, ident, cache=cache)
+        sm._apply_membership(["ra", "rb"])
+        server = ExtenderServer(cache, fc, host="127.0.0.1", port=0,
+                                sharding=sm)
+        port = server.start()
+        reps[ident] = SimpleNamespace(
+            cache=cache, ctl=ctl, sm=sm, server=server,
+            base=f"http://127.0.0.1:{port}")
+    reps["ra"].sm._peers = {"rb": reps["rb"].base}
+    reps["rb"].sm._peers = {"ra": reps["ra"].base}
+    yield fc, reps
+    for r in reps.values():
+        r.server.stop()
+        r.ctl.stop()
+
+
+def _node_owned_by(reps, owner):
+    sm = reps["ra"].sm
+    return next(n for n in (f"n{i}" for i in range(8))
+                if sm.owner_of(n) == owner)
+
+
+def test_offshard_bind_forwards_to_owner_and_binds_lock_free(duo):
+    fc, reps = duo
+    node = _node_owned_by(reps, "rb")
+    pod = fc.create_pod(make_pod(hbm=2000, name="fw-happy"))
+    f0, c0 = forwards(), conflicts()
+    status, result = post(f"{reps['ra'].base}/tpushare-scheduler/bind", {
+        "PodName": "fw-happy", "PodNamespace": "default",
+        "PodUID": pod["metadata"]["uid"], "Node": node})
+    assert status == 200 and not result.get("Error"), result
+    assert fc.get_pod("default", "fw-happy")["spec"]["nodeName"] == node
+    df, dc = delta(f0, forwards()), delta(c0, conflicts())
+    # exactly one hop: ra forwarded, rb served...
+    assert df["forwarded"] == 1 and df["served"] == 1, df
+    assert df["loop_fallback"] == 0 and df["peer_failed"] == 0, df
+    # ...and the owner bound LOCK-FREE — the spillover CAS the forward
+    # exists to eliminate never ran
+    assert dc["owned"] == 1 and dc["spillover"] == 0, dc
+
+
+def test_midrebalance_disagreement_degrades_to_cas_no_pingpong(duo):
+    fc, reps = duo
+    # rb's view is one rebalance ahead: a third member joined, so for
+    # some nodes ra still routes to rb while rb already routes elsewhere
+    reps["rb"].sm._apply_membership(["ra", "rb", "rc"])
+    # a live (but bogus) rc peer URL proves the LOOP GUARD — not a
+    # missing address — is what stops the second hop
+    reps["rb"].sm._peers = {"ra": reps["ra"].base,
+                            "rc": "http://127.0.0.1:1"}
+    ra_sm, rb_sm = reps["ra"].sm, reps["rb"].sm
+    node = next(n for n in (f"n{i}" for i in range(8))
+                if ra_sm.owner_of(n) == "rb"
+                and rb_sm.owner_of(n) != "rb")
+    pod = fc.create_pod(make_pod(hbm=2000, name="fw-loop"))
+    f0, c0 = forwards(), conflicts()
+    status, result = post(f"{reps['ra'].base}/tpushare-scheduler/bind", {
+        "PodName": "fw-loop", "PodNamespace": "default",
+        "PodUID": pod["metadata"]["uid"], "Node": node})
+    assert status == 200 and not result.get("Error"), result
+    assert fc.get_pod("default", "fw-loop")["spec"]["nodeName"] == node
+    df, dc = delta(f0, forwards()), delta(c0, conflicts())
+    # one hop, then the guard: rb did NOT forward on to rc (no
+    # ping-pong), it served locally through the claim-CAS spillover path
+    assert df["forwarded"] == 1 and df["loop_fallback"] == 1, df
+    assert df["served"] == 0 and df["peer_failed"] == 0, df
+    assert dc["spillover"] == 1 and dc["cas_lost"] == 0, dc
+
+
+def test_dead_peer_fails_fast_into_local_cas(duo):
+    fc, reps = duo
+    # rb's advertised address is dead (nothing listens on port 9);
+    # after 3 transport failures the per-peer breaker opens and later
+    # forwards are refused with zero connection attempts
+    reps["ra"].sm._peers = {"rb": "http://127.0.0.1:9"}
+    node = _node_owned_by(reps, "rb")
+    f0, c0 = forwards(), conflicts()
+    for i in range(4):
+        pod = fc.create_pod(make_pod(hbm=1000, name=f"fw-dead-{i}"))
+        status, result = post(
+            f"{reps['ra'].base}/tpushare-scheduler/bind", {
+                "PodName": f"fw-dead-{i}", "PodNamespace": "default",
+                "PodUID": pod["metadata"]["uid"], "Node": node})
+        # availability invariant: a forward must never lose the bind
+        assert status == 200 and not result.get("Error"), (i, result)
+        assert fc.get_pod("default", f"fw-dead-{i}") \
+            ["spec"]["nodeName"] == node
+    df, dc = delta(f0, forwards()), delta(c0, conflicts())
+    assert df["peer_failed"] == 4 and df["forwarded"] == 0, df
+    # every bind fell back to the claim CAS and won it
+    assert dc["spillover"] == 4 and dc["cas_lost"] == 0, dc
+
+
+def test_filter_stays_local_unless_cycle_forwarding_opted_in(duo):
+    fc, reps = duo
+    ra = reps["ra"]
+    # find a pod name whose cycle key routes to rb
+    name = next(f"cyc-{i}" for i in range(64)
+                if ra.sm.owner_of(f"default/cyc-{i}") == "rb")
+    pod = make_pod(hbm=2000, name=name)
+    f0 = forwards()
+    status, result = post(f"{ra.base}/tpushare-scheduler/filter", {
+        "Pod": pod, "NodeNames": [f"n{i}" for i in range(8)]})
+    assert status == 200 and result["NodeNames"]
+    assert delta(f0, forwards())["forwarded"] == 0  # default: reads stay local
+    # opt in: the pod's whole cycle now runs on its owner
+    ra.server.forwarder = ForwardRouter(ra.sm, enabled=True, cycle=True)
+    f0 = forwards()
+    status, fwd_result = post(f"{ra.base}/tpushare-scheduler/filter", {
+        "Pod": pod, "NodeNames": [f"n{i}" for i in range(8)]})
+    assert status == 200
+    assert delta(f0, forwards())["forwarded"] == 1
+    assert fwd_result["NodeNames"] == result["NodeNames"]
+
+
+# -- router-level decisions (no HTTP) -----------------------------------------
+
+class _SM:
+    def __init__(self, identity, owner, live=True, peers=None):
+        self.identity = identity
+        self._owner = owner
+        self._live = live
+        self._peers = peers or {}
+
+    def is_live(self):
+        return self._live
+
+    def owner_of(self, key):
+        return self._owner
+
+    def peer_url(self, ident):
+        return self._peers.get(ident)
+
+
+def test_router_serves_when_not_live_or_unadvertised():
+    bind = {"Node": "n1"}
+    # not live: claim-CAS safety net, no routing
+    r = ForwardRouter(_SM("ra", "rb", live=False), enabled=True)
+    assert r.maybe_forward("bind", "/p", b"{}", bind, None) is None
+    # owner never advertised a URL (mixed-version fleet): serve locally
+    r = ForwardRouter(_SM("ra", "rb"), enabled=True)
+    assert r.maybe_forward("bind", "/p", b"{}", bind, None) is None
+    # own shard: serve locally
+    r = ForwardRouter(_SM("ra", "ra"), enabled=True)
+    assert r.maybe_forward("bind", "/p", b"{}", bind, None) is None
+
+
+def test_router_guard_header_is_terminal():
+    f0 = forwards()
+    # guarded + ring agrees we own it -> served
+    r = ForwardRouter(_SM("rb", "rb", peers={"ra": "http://x"}),
+                      enabled=True)
+    assert r.maybe_forward("bind", "/p", b"{}", {"Node": "n1"},
+                          "ra") is None
+    # guarded + ring disagrees -> loop_fallback, STILL no second hop
+    r = ForwardRouter(_SM("rb", "rc", peers={"rc": "http://x"}),
+                      enabled=True)
+    assert r.maybe_forward("bind", "/p", b"{}", {"Node": "n1"},
+                          "ra") is None
+    df = delta(f0, forwards())
+    assert df["served"] == 1 and df["loop_fallback"] == 1
+    assert df["forwarded"] == 0
+
+
+def test_router_disabled_by_knob():
+    r = ForwardRouter(_SM("ra", "rb", peers={"rb": "http://x"}),
+                      enabled=False)
+    assert r.maybe_forward("bind", "/p", b"{}", {"Node": "n1"},
+                          None) is None
+
+
+# -- (slow) 2-process end-to-end storm over the stub apiserver ----------------
+
+@pytest.mark.slow
+def test_two_process_storm_with_kill_zero_oversubscription(tmp_path):
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from tests.test_ha_storm import (
+        assert_apiserver_invariants, seed_pod, wait_until)
+    from tpushare.k8s.incluster import InClusterClient
+    from tpushare.k8s.stubapi import StubApiServer
+
+    GIB = 1024
+    stub = StubApiServer().start()
+    for i in range(6):
+        stub.seed("nodes", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"e{i}",
+                         "labels": {"tpushare": "true",
+                                    "tpushare.aliyun.com/mesh": "2x2"}},
+            "status": {"capacity": {
+                "aliyun.com/tpu-hbm": str(4 * 16 * GIB),
+                "aliyun.com/tpu-count": "4"}}})
+    env = dict(os.environ,
+               TPUSHARE_SHARD_REPLICAS="2",
+               TPUSHARE_SHARD_LEASE_S="1.5",
+               TPUSHARE_SHARD_RENEW_S="0.2",
+               TPUSHARE_FLEETWATCH="0", TPUSHARE_DEFRAG="0",
+               JAX_PLATFORMS="cpu")
+    procs, bases = [], []
+    try:
+        for i in range(2):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "tpushare.extender",
+                 "--apiserver", stub.base_url,
+                 "--host", "127.0.0.1", "--port", "0"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True)
+            procs.append(p)
+        for p in procs:
+            line = ""
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                line = p.stdout.readline()
+                if "ready on" in line:
+                    break
+            assert "ready on" in line, "extender never came up"
+            bases.append("http://" + line.rsplit("on ", 1)[1].strip())
+
+        def ring(base):
+            with urllib.request.urlopen(f"{base}/inspect/ring",
+                                        timeout=5) as r:
+                return json.loads(r.read())
+
+        # both replicas converge on a 2-member ring with peer addresses
+        assert wait_until(
+            lambda: all(len(ring(b).get("members", [])) == 2
+                        and len(ring(b).get("peers", {})) == 2
+                        for b in bases), timeout=30)
+
+        client = InClusterClient(base_url=stub.base_url, timeout=10.0)
+        names = [f"e{i}" for i in range(6)]
+        pods = [seed_pod(stub, f"e2e-{i}", 2 * GIB) for i in range(20)]
+        bound = {}
+
+        def drive(pod, endpoints, attempts=40):
+            meta = pod["metadata"]
+            for a in range(attempts):
+                base = endpoints[a % len(endpoints)]
+                try:
+                    _, flt = post(f"{base}/tpushare-scheduler/filter",
+                                  {"Pod": pod, "NodeNames": names},
+                                  timeout=5)
+                    ok = flt.get("NodeNames") or []
+                    if not ok:
+                        return None
+                    status, res = post(
+                        f"{base}/tpushare-scheduler/bind", {
+                            "PodName": meta["name"],
+                            "PodNamespace": meta["namespace"],
+                            "PodUID": meta.get("uid", ""),
+                            "Node": ok[0]}, timeout=5)
+                    if status == 200 and not res.get("Error"):
+                        return ok[0]
+                except OSError:
+                    pass
+                time.sleep(0.05)
+            return None
+
+        # first half of the storm across both replicas
+        for pod in pods[:10]:
+            node = drive(pod, bases)
+            if node:
+                bound[pod["metadata"]["name"]] = node
+        # kill replica 0 mid-storm (SIGKILL: no lease abdication — the
+        # survivor must expire it by TTL) and drain through the survivor
+        procs[0].kill()
+        for pod in pods[10:]:
+            node = drive(pod, [bases[1]])
+            if node:
+                bound[pod["metadata"]["name"]] = node
+        assert wait_until(
+            lambda: len(ring(bases[1]).get("members", [])) == 1,
+            timeout=15)
+        assert len(bound) >= 18, f"storm bound only {len(bound)}/20"
+        # the acceptance audit: apiserver truth shows zero chip
+        # oversubscription across the replica-kill handoff
+        per_chip = assert_apiserver_invariants(stub, client)
+        assert sum(per_chip.values()) > 0
+        for pod in client.list_pods():
+            name = pod["metadata"]["name"]
+            if name in bound:
+                assert pod["spec"]["nodeName"] == bound[name]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        stub.stop()

@@ -177,8 +177,10 @@ def live_sharded(capsys):
     cache.build_cache()
     sm = ShardMembership(fc, "ra", cache=cache)
     # membership applied directly (no renewal thread): deterministic
-    # two-member ring for the golden rendering
+    # two-member ring for the golden rendering; rb's advertised peer
+    # address as the lease listing would have discovered it
     sm._apply_membership(["ra", "rb"])
+    sm._peers = {"rb": "http://127.0.0.1:40001"}
     server = ExtenderServer(cache, fc, host="127.0.0.1", port=0,
                             sharding=sm)
     port = server.start()
@@ -194,6 +196,10 @@ def test_cli_ring_subcommand(live_sharded, capsys):
     assert "MEMBER" in out and "SHARD NODES" in out
     assert "leader,self" in out and "rb" in out
     assert "bind outcomes:" in out and "lock-free" in out
+    # owner-forwarding surfaces: the peer address book column and the
+    # per-outcome forward counters
+    assert "PEER URL" in out and "http://127.0.0.1:40001" in out
+    assert "forwards:" in out and "loop_fallback" in out
     # --json round-trips the raw snapshot schema
     assert main(["--endpoint", live_sharded, "--json", "ring"]) == 0
     import json as jsonlib
@@ -203,3 +209,6 @@ def test_cli_ring_subcommand(live_sharded, capsys):
     assert snap["ring_leader"] == "ra"
     assert sum(snap["shard_sizes"].values()) == 2
     assert set(snap["conflicts"]) == {"owned", "spillover", "cas_lost"}
+    assert snap["peers"] == {"rb": "http://127.0.0.1:40001"}
+    assert set(snap["forwards"]) == {
+        "forwarded", "served", "loop_fallback", "peer_failed"}

@@ -31,6 +31,7 @@ SCOPES = (
     os.path.join(ROOT, "tpushare", "controller"),
     os.path.join(ROOT, "tpushare", "defrag"),
     os.path.join(ROOT, "tpushare", "ha"),
+    os.path.join(ROOT, "tpushare", "extender"),
 )
 
 # (file basename, with-expression prefix) -> rank. Nested acquisitions
@@ -67,6 +68,11 @@ RANKS = {
     # never nest with the cache chain (handlers are called lock-free)
     # or with each other today; seen-set < queue so a future requeue-
     # under-seen-set would pass and the reverse would red-line
+    # extender front end (ISSUE 11): the selector server's ONLY lock —
+    # guards the worker->loop done-list handoff and the inflight
+    # counter, and is never held across a handler, a socket op, or a
+    # forward. A leaf like _pods_lock: nothing may be acquired inside it.
+    ("httpserver.py", "self._done_lock"): 91,
     ("controller.py", "self._seen_lock"): 6,
     ("controller.py", "self._queue._lock"): 7,
     ("workqueue.py", "self._lock"): 7,      # the same Condition object

@@ -197,3 +197,37 @@ def test_cli_shards_leg_emits_identical_scorecards(capsys):
     assert [r["sharding"]["shards"] for r in reps] == [1, 2, 4]
     for r in reps:
         assert r["scorecard"] == reps[0]["scorecard"]
+
+
+def test_procs_replay_is_deterministic_across_interpreters():
+    """--procs (ISSUE 11): the same replay in SPAWNED interpreters must
+    produce byte-identical canonical output — the cross-process
+    determinism a sharded production fleet silently depends on."""
+    from tpushare.sim.procs import replay_once, run_procs
+    payload = {"nodes": 2, "chips": 4, "hbm": 16384, "mesh": [2, 2],
+               "policy": "binpack", "preempt": "off",
+               "spec": {"n_pods": 40, "arrival_rate": 3.0,
+                        "mean_duration": 40.0,
+                        "multi_chip_fraction": 0.3,
+                        "high_priority_fraction": 0.0, "seed": 42}}
+    # in-process reference twice: the canonical rendering is stable
+    assert replay_once(payload) == replay_once(payload)
+    out = run_procs(payload, 2)
+    assert out["scorecards_identical"] is True
+    assert out["procs"] == 2 and out["pods_per_proc"] == 40
+    assert out["aggregate_placements_per_sec"] > 0
+    # the gate is honest about what this box can assert
+    import os
+    assert out["speedup_asserted"] == ((os.cpu_count() or 1) >= 2)
+
+
+def test_cli_procs_leg_emits_report_and_gates_on_divergence(capsys):
+    from tpushare.sim.__main__ import main
+    assert main(["--nodes", "2", "--chips", "4", "--mesh", "2x2",
+                 "--pods", "30", "--procs", "2"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["mode"] == "procs"
+    assert out["scorecards_identical"] is True
+    assert set(out["scorecard"]) == {"time_weighted_util_pct",
+                                     "rejection_rate",
+                                     "p99_pending_age_s"}

@@ -150,8 +150,14 @@ def main(argv: list[str] | None = None) -> int:
         # so their claims/placements are re-read before lock-free binds
         sharding = ShardMembership(
             cluster, identity, cache=cache,
+            lease_duration=float(os.environ.get(
+                "TPUSHARE_SHARD_LEASE_S", "15.0")),
+            renew_period=float(os.environ.get(
+                "TPUSHARE_SHARD_RENEW_S", "5.0")),
             on_rebalance=controller.resync_once)
-        sharding.start()
+        # started AFTER the server binds: the peer URL advertised in the
+        # shard lease (owner forwarding, ha/forward.py) needs the real
+        # bound port, which --port 0 only yields at server.start()
         log.info("ha: active-active sharding enabled (identity %s, "
                  "%d vnodes)", identity, sharding.vnodes)
     elif args.ha:
@@ -190,6 +196,16 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGTERM, on_signal)
 
     port = server.start()
+    if sharding is not None:
+        adv = os.environ.get("TPUSHARE_ADVERTISE_URL", "")
+        if not adv:
+            import socket as socketlib
+            adv_host = args.host
+            if adv_host in ("0.0.0.0", "::"):
+                adv_host = socketlib.gethostname()
+            adv = f"http://{adv_host}:{port}"
+        sharding.advertise_url = adv
+        sharding.start()
     print(f"tpushare extender ready on {args.host}:{port}", flush=True)
     stop.wait()
     if sharding is not None:

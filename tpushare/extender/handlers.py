@@ -580,11 +580,13 @@ class BindHandler:
         SHARD_CONFLICTS.inc("spillover")
         return True
 
-    def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+    def handle(self, args: dict[str, Any],
+               forwarded_from: str | None = None) -> dict[str, Any]:
         with api_origin("bind"):
-            return self._handle(args)
+            return self._handle(args, forwarded_from)
 
-    def _handle(self, args: dict[str, Any]) -> dict[str, Any]:
+    def _handle(self, args: dict[str, Any],
+                forwarded_from: str | None = None) -> dict[str, Any]:
         ns = args.get("PodNamespace", "default")
         name = args.get("PodName", "")
         uid = args.get("PodUID", "")
@@ -594,6 +596,10 @@ class BindHandler:
         audit: dict[str, Any] = {}
         with self._tracer.root_span(trace, "bind") as sp:
             sp.set_tag("node", node)
+            if forwarded_from:
+                # owner forwarding (ha/forward.py): which replica the
+                # kube-scheduler originally hit before the peer hop
+                sp.set_tag("forwarded_from", forwarded_from)
             if self._breaker is not None:
                 sp.set_tag("breaker", self._breaker.state)
             result = self._bind(args, ns, name, uid, node, trace, sp,

@@ -1,0 +1,349 @@
+"""Selector/event-loop HTTP front end for the extender.
+
+ThreadingHTTPServer spends a thread per *connection*: the kube-scheduler
+keeps long-lived keep-alive connections to its extenders, so every idle
+connection pins a thread, and under a bind storm the accept path, socket
+reads and GIL-released native solves all fight for the same pool of
+oversubscribed threads. This front end splits the two concerns:
+
+- **one event-loop thread** owns every socket — accept, read, parse and
+  write are all non-blocking and multiplexed through a selector, so ten
+  thousand idle keep-alive connections cost one thread and zero wakeups;
+- **a bounded worker pool** runs the request handlers (which may block on
+  apiserver writes, native solves, or a peer forward hop) and hands the
+  finished response bytes back to the loop over a queue + self-pipe
+  wakeup. Workers never touch a socket.
+
+The HTTP surface is deliberately minimal — request line, headers,
+Content-Length bodies, HTTP/1.1 keep-alive with ``Connection: close``
+honored — which is exactly what the kube-scheduler webhook, the peer
+forward transport and the ops tooling speak. No chunked request bodies
+(the webhook never sends them; a Transfer-Encoding request gets 501).
+
+Lock discipline (tests/test_lock_order_lint.py): ``self._done_lock`` is
+the only lock — it guards the finished-response queue and the in-flight
+counter for a few instructions at a time and is NEVER held across a
+handler call, a socket operation, or a forward hop.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import selectors
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from http.client import responses as _REASONS
+from typing import Callable
+
+log = logging.getLogger("tpushare.extender.http")
+
+DEFAULT_HTTP_WORKERS = 8
+
+
+def http_workers() -> int:
+    try:
+        return max(1, int(os.environ.get("TPUSHARE_HTTP_WORKERS",
+                                         DEFAULT_HTTP_WORKERS)))
+    except ValueError:
+        return DEFAULT_HTTP_WORKERS
+
+
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 64 * 1024 * 1024  # a 50k-node Nodes list is ~20 MiB
+
+
+class _Conn:
+    __slots__ = ("sock", "inbuf", "outbuf", "busy", "close_after",
+                 "closed")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.inbuf = bytearray()
+        self.outbuf = bytearray()
+        self.busy = False         # a request is in flight in the pool
+        self.close_after = False  # close once outbuf drains
+        self.closed = False
+
+
+class SelectorHTTPServer:
+    """Event-loop acceptor + bounded worker pool.
+
+    ``handle_get(path)`` / ``handle_post(path, body, headers)`` return
+    ``(status, payload_bytes, content_type)`` and run on pool threads.
+    """
+
+    def __init__(self, host: str, port: int,
+                 handle_get: Callable, handle_post: Callable,
+                 max_workers: int | None = None) -> None:
+        self.host, self.port = host, port
+        self._handle_get = handle_get
+        self._handle_post = handle_post
+        self.max_workers = max_workers or http_workers()
+        self._sel = selectors.DefaultSelector()
+        self._listener: socket.socket | None = None
+        self._pool: ThreadPoolExecutor | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._stopped = threading.Event()
+        # worker -> loop handoff: finished (conn, response) pairs plus
+        # the in-flight count, guarded for a few instructions at a time
+        self._done_lock = threading.Lock()
+        self._done: list[tuple[_Conn, bytes]] = []
+        self._inflight = 0
+        self._conns: set[_Conn] = set()  # loop-thread only
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+
+    # -- observability (the front-end gauges) ---------------------------------
+
+    def open_connections(self) -> int:
+        return len(self._conns)
+
+    def busy_workers(self) -> int:
+        with self._done_lock:
+            return self._inflight
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind, start the loop thread + pool; returns the bound port."""
+        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind((self.host, self.port))
+        lst.listen(256)
+        lst.setblocking(False)
+        self.port = lst.getsockname()[1]
+        self._listener = lst
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.max_workers,
+            thread_name_prefix="tpushare-http-worker")
+        self._sel.register(lst, selectors.EVENT_READ, "accept")
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._thread = threading.Thread(
+            target=self._loop, name="tpushare-http-loop", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def serve_forever(self) -> None:
+        self.start()
+        self._stopped.wait()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wakeup()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        self._stopped.set()
+
+    def server_close(self) -> None:
+        pass  # sockets are closed by the loop on shutdown
+
+    def _wakeup(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    # -- event loop (the only thread that touches sockets) --------------------
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                for key, _ in self._sel.select(timeout=0.5):
+                    if key.data == "accept":
+                        self._accept()
+                    elif key.data == "wake":
+                        try:
+                            while self._wake_r.recv(4096):
+                                pass
+                        except BlockingIOError:
+                            pass
+                        self._drain_done()
+                    else:
+                        self._service(key.data)
+        finally:
+            for conn in list(self._conns):
+                self._close(conn)
+            if self._listener is not None:
+                try:
+                    self._sel.unregister(self._listener)
+                except (KeyError, ValueError):
+                    pass
+                self._listener.close()
+            self._sel.close()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            # same rationale as the threaded front end: Nagle + delayed
+            # ACK stalls keep-alive webhook round-trips ~40ms
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(sock)
+            self._conns.add(conn)
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _interest(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        ev = selectors.EVENT_READ
+        if conn.outbuf:
+            ev |= selectors.EVENT_WRITE
+        try:
+            self._sel.modify(conn.sock, ev, conn)
+        except (KeyError, ValueError, OSError):
+            self._close(conn)
+
+    def _service(self, conn: _Conn) -> None:
+        # read whatever is there (also how we learn about a hangup)
+        try:
+            while True:
+                chunk = conn.sock.recv(65536)
+                if not chunk:
+                    if not conn.busy and not conn.outbuf:
+                        self._close(conn)
+                    else:
+                        conn.close_after = True
+                    break
+                conn.inbuf += chunk
+        except BlockingIOError:
+            pass
+        except OSError:
+            self._close(conn)
+            return
+        if conn.closed:
+            return
+        if not conn.busy:
+            self._try_dispatch(conn)
+        if conn.outbuf:
+            self._flush(conn)
+
+    def _flush(self, conn: _Conn) -> None:
+        try:
+            n = conn.sock.send(bytes(conn.outbuf))
+            del conn.outbuf[:n]
+        except BlockingIOError:
+            pass
+        except OSError:
+            self._close(conn)
+            return
+        if not conn.outbuf and conn.close_after:
+            self._close(conn)
+            return
+        self._interest(conn)
+        if not conn.outbuf and not conn.busy:
+            self._try_dispatch(conn)  # a pipelined request may be buffered
+
+    def _close(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        self._conns.discard(conn)
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    # -- request parsing + dispatch -------------------------------------------
+
+    def _try_dispatch(self, conn: _Conn) -> None:
+        head_end = conn.inbuf.find(b"\r\n\r\n")
+        if head_end < 0:
+            if len(conn.inbuf) > _MAX_HEADER_BYTES:
+                self._reject(conn, 431, "headers too large")
+            return
+        head = bytes(conn.inbuf[:head_end]).decode("latin-1")
+        lines = head.split("\r\n")
+        parts = lines[0].split()
+        if len(parts) != 3:
+            self._reject(conn, 400, "malformed request line")
+            return
+        method, path, version = parts
+        headers: dict[str, str] = {}
+        for line in lines[1:]:
+            name, sep, value = line.partition(":")
+            if sep:
+                headers[name.strip().title()] = value.strip()
+        if headers.get("Transfer-Encoding"):
+            self._reject(conn, 501, "chunked bodies unsupported")
+            return
+        try:
+            length = int(headers.get("Content-Length", 0))
+        except ValueError:
+            self._reject(conn, 400, "bad Content-Length")
+            return
+        if length > _MAX_BODY_BYTES:
+            self._reject(conn, 413, "body too large")
+            return
+        total = head_end + 4 + length
+        if len(conn.inbuf) < total:
+            return  # body still arriving
+        body = bytes(conn.inbuf[head_end + 4:total])
+        del conn.inbuf[:total]
+        wants_close = headers.get("Connection", "").lower() == "close" \
+            or version == "HTTP/1.0"
+        conn.close_after = conn.close_after or wants_close
+        conn.busy = True
+        with self._done_lock:
+            self._inflight += 1
+        self._pool.submit(self._work, conn, method, path, body, headers)
+
+    def _reject(self, conn: _Conn, status: int, reason: str) -> None:
+        conn.close_after = True
+        conn.outbuf += _response(status, reason.encode(), "text/plain",
+                                 close=True)
+        self._flush(conn)
+
+    # -- worker side (never touches sockets) ----------------------------------
+
+    def _work(self, conn: _Conn, method: str, path: str, body: bytes,
+              headers: dict[str, str]) -> None:
+        try:
+            if method == "GET":
+                status, data, ctype = self._handle_get(path)
+            elif method == "POST":
+                status, data, ctype = self._handle_post(path, body, headers)
+            else:
+                status, data, ctype = 405, b"method not allowed", \
+                    "text/plain"
+        except Exception as e:  # noqa: BLE001 — the socket must answer
+            log.error("%s %s crashed in worker: %s", method, path, e)
+            status, data, ctype = 500, b'{"error": "internal error"}', \
+                "application/json"
+        resp = _response(status, data, ctype, close=conn.close_after)
+        with self._done_lock:
+            self._done.append((conn, resp))
+            self._inflight -= 1
+        self._wakeup()
+
+    def _drain_done(self) -> None:
+        with self._done_lock:
+            done, self._done = self._done, []
+        for conn, resp in done:
+            if conn.closed:
+                continue
+            conn.busy = False
+            conn.outbuf += resp
+            self._flush(conn)
+
+
+def _response(status: int, data: bytes, content_type: str,
+              close: bool = False) -> bytes:
+    reason = _REASONS.get(status, "OK")
+    head = (f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(data)}\r\n")
+    if close:
+        head += "Connection: close\r\n"
+    return head.encode("latin-1") + b"\r\n" + data

@@ -1,16 +1,28 @@
 """HTTP server wiring for the extender (reference pkg/routes/routes.go).
 
-stdlib ThreadingHTTPServer: every scheduler webhook call is handled on its
-own thread over the lock-scoped cache, replacing the reference's
-httprouter + net/http stack. Bind failures return HTTP 500 with the
-ExtenderBindingResult body (routes.go:139-143 does the same), which makes
-the default scheduler retry after its timeout.
+Routing is front-end-agnostic: :meth:`ExtenderServer.handle_get` /
+:meth:`ExtenderServer.handle_post` map a path + raw body to
+``(status, payload bytes, content type)``, and two interchangeable front
+ends drive them — the selector/event-loop server (extender/httpserver.py,
+the default: one loop thread owns every socket, a bounded worker pool
+runs the handlers) and the legacy stdlib ThreadingHTTPServer
+(``TPUSHARE_SERVER=threaded``, thread per connection). Bind failures
+return HTTP 500 with the ExtenderBindingResult body (routes.go:139-143
+does the same), which makes the default scheduler retry after its
+timeout.
+
+Owner forwarding (ha/forward.py): when active-active sharding is wired,
+a Filter/Prioritize/Bind landing on a non-owning replica hops once to
+the shard owner and the owner's verdict is relayed verbatim; the
+loop-guard header degrades mid-rebalance disagreement to the claim-CAS
+fallback instead of ping-ponging.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
 import threading
 import time
@@ -27,10 +39,25 @@ from tpushare.extender.handlers import (
     PrioritizeHandler,
 )
 from tpushare.extender.metrics import Registry
+from tpushare.ha.forward import FORWARD_HEADER, ForwardRouter
 
 log = logging.getLogger("tpushare.extender.http")
 
 PREFIX = "/tpushare-scheduler"
+
+_POST_ROUTES = {
+    f"{PREFIX}/filter": "filter",
+    f"{PREFIX}/prioritize": "prioritize",
+    f"{PREFIX}/preempt": "preempt",
+    f"{PREFIX}/bind": "bind",
+}
+
+
+def _enc(status: int, body: Any,
+         content_type: str = "application/json") -> tuple[int, bytes, str]:
+    data = (json.dumps(body).encode()
+            if content_type == "application/json" else body.encode())
+    return status, data, content_type
 
 
 class ExtenderServer:
@@ -154,8 +181,172 @@ class ExtenderServer:
         if sharding is not None:
             sharding.attach(self.registry)
             self.defrag.gate = sharding.is_ring_leader
+        # owner forwarding (ha/forward.py): active-active only — it
+        # routes on the same ring. No peers advertised = no-op.
+        self.forwarder = ForwardRouter(sharding) \
+            if sharding is not None else None
+        self._serve_done: threading.Event | None = None
 
-    # -- request routing ------------------------------------------------------
+    # -- request routing (shared by both front ends) --------------------------
+
+    def handle_post(self, path: str, raw: bytes,
+                    headers=None) -> tuple[int, bytes, str]:
+        """Route one POST: ``(status, payload bytes, content type)``.
+
+        Front-end-agnostic — the threaded handler, the selector worker
+        pool, and a peer's forwarded request all land here. ``headers``
+        only needs a case-insensitive-enough ``get`` (the loop-guard
+        header is looked up by its canonical name).
+        """
+        try:
+            args = json.loads(raw) if raw else {}
+        except json.JSONDecodeError as e:
+            return _enc(400, {"error": f"bad JSON: {e}"})
+        try:
+            # stamp the per-request deadline: every retry loop
+            # underneath (k8s/retry.py) consults it — the forward hop
+            # included — and stops before the scheduler's httpTimeout
+            from tpushare.k8s.retry import request_deadline
+            with request_deadline(self.request_deadline_s):
+                return self._post_routed(path, raw, args, headers)
+        except Exception as e:  # noqa: BLE001 — webhook must answer
+            log.error("POST %s crashed: %s\n%s", path, e,
+                      traceback.format_exc())
+            return _enc(500, {"Error": f"internal error: {e}"})
+
+    def _post_routed(self, path: str, raw: bytes, args: Any,
+                     headers) -> tuple[int, bytes, str]:
+        route = _POST_ROUTES.get(path)
+        if route in ("filter", "prioritize", "bind") and \
+                self.forwarder is not None:
+            fwd = self.forwarder.maybe_forward(
+                route, path, raw, args,
+                headers.get(FORWARD_HEADER) if headers is not None
+                else None)
+            if fwd is not None:
+                # the owner's verdict, relayed verbatim
+                return fwd[0], fwd[1], "application/json"
+        if route == "filter":
+            return _enc(200, self.filter_handler.handle(args))
+        if route == "prioritize":
+            return _enc(200, self.prioritize_handler.handle(args))
+        if route == "preempt":
+            return _enc(200, self.preempt_handler.handle(args))
+        if route == "bind":
+            # active-active (sharding wired): EVERY replica binds —
+            # lock-free on its shard, claim-CAS on spillover — so the
+            # leader gate applies only to the legacy active-passive
+            # elector mode
+            if self._sharding is None and self._elector is not None \
+                    and not self._elector.is_leader():
+                # retryable: the default scheduler re-binds after its
+                # timeout and reaches the leader
+                return _enc(503, {"Error": "not the leader; retry"})
+            result = self.bind_handler.handle(
+                args, forwarded_from=(headers.get(FORWARD_HEADER)
+                                      if headers is not None else None))
+            # reference returns 500 on bind failure (routes.go:139)
+            return _enc(500 if result.get("Error") else 200, result)
+        if path == "/debug/pods" and self._seed_cluster:
+            return _enc(201, self._seed_cluster.create_pod(args))
+        return _enc(404, {"error": f"no route {path}"})
+
+    def handle_get(self, path: str) -> tuple[int, bytes, str]:
+        try:
+            return self._get_routed(path)
+        except Exception as e:  # noqa: BLE001
+            log.error("GET %s crashed: %s", path, e)
+            return _enc(500, {"error": str(e)})
+
+    def _get_routed(self, path: str) -> tuple[int, bytes, str]:
+        if path == "/version":
+            info = {"version": tpushare.__version__}
+            if self._elector is not None:
+                info["leader"] = self._elector.is_leader()
+                info["identity"] = self._elector.identity
+            return _enc(200, info)
+        if path == "/healthz":
+            # liveness only: the process is up and serving. Everything
+            # state-dependent belongs to /readyz — restarting a pod
+            # because the APISERVER browned out would make the outage
+            # strictly worse.
+            return _enc(200, "ok", content_type="text/plain")
+        if path == "/readyz":
+            ready, body = self.readiness()
+            return _enc(200 if ready else 503, body)
+        if path == "/metrics":
+            return _enc(200, self.registry.expose(),
+                        content_type="text/plain; version=0.0.4")
+        if path.startswith("/debug/traces") or \
+                path.startswith(f"{PREFIX}/debug/traces"):
+            limit = None
+            if "n=" in path:
+                try:
+                    limit = int(path.split("n=")[1])
+                except ValueError:
+                    pass
+            return _enc(200, self.tracer.recorder.dump(limit=limit))
+        if path.startswith("/inspect/explain") or \
+                path.startswith(f"{PREFIX}/inspect/explain"):
+            return self._serve_explain(path)
+        if path in ("/inspect/fleet", f"{PREFIX}/inspect/fleet"):
+            return _enc(200, self.fleetwatch.snapshot())
+        if path in ("/inspect/defrag", f"{PREFIX}/inspect/defrag"):
+            return _enc(200, self.defrag.snapshot())
+        if path in ("/inspect/ring", f"{PREFIX}/inspect/ring"):
+            if self._sharding is not None:
+                return _enc(200, self._sharding.snapshot())
+            return _enc(200, {
+                "enabled": False,
+                "mode": ("leader-elect" if self._elector is not None
+                         else "single-replica"),
+            })
+        if path in (f"{PREFIX}/inspect", f"{PREFIX}/inspect/"):
+            return _enc(200, self.inspect_handler.handle())
+        if path.startswith(f"{PREFIX}/inspect/"):
+            node = path[len(f"{PREFIX}/inspect/"):]
+            out = self.inspect_handler.handle(node)
+            return _enc(404 if "error" in out else 200, out)
+        if path == "/debug/threads":
+            return _enc(200, _thread_dump(), content_type="text/plain")
+        if path.startswith("/debug/profile"):
+            seconds = 1.0
+            if "seconds=" in path:
+                try:
+                    seconds = min(float(path.split("seconds=")[1]), 30.0)
+                except ValueError:
+                    pass
+            return _enc(200, _profile(seconds), content_type="text/plain")
+        if path.startswith("/debug/heap"):
+            top = 25
+            if "top=" in path:
+                try:
+                    top = min(int(path.split("top=")[1]), 200)
+                except ValueError:
+                    pass
+            return _enc(200, _heap_profile(top), content_type="text/plain")
+        return _enc(404, {"error": f"no route {path}"})
+
+    def _serve_explain(self, path: str) -> tuple[int, bytes, str]:
+        """/inspect/explain       -> list of audited pods
+           /inspect/explain/<pod> -> that pod's decision history
+                                     (<pod> = uid, namespace/name or name)
+        """
+        if path.startswith(PREFIX):
+            path = path[len(PREFIX):]
+        selector = path[len("/inspect/explain"):].strip("/")
+        if not selector:
+            return _enc(200, {"pods": self.explain.pods()})
+        out = self.explain.get(selector)
+        if out is None:
+            return _enc(404, {
+                "error": f"no decision record for {selector!r} "
+                         "(kept for the last "
+                         f"{self.explain.max_pods} pods x "
+                         f"{self.explain.cycles_per_pod} cycles)"})
+        return _enc(200, out)
+
+    # -- legacy thread-per-connection front end -------------------------------
 
     def _make_handler(server_self):  # noqa: N805 — closure over the server
         class Handler(BaseHTTPRequestHandler):
@@ -168,187 +359,26 @@ class ExtenderServer:
             def log_message(self, fmt, *args):  # route into logging, not stderr
                 log.debug("%s %s", self.address_string(), fmt % args)
 
-            def _reply(self, code: int, body: Any,
-                       content_type: str = "application/json") -> None:
-                data = (json.dumps(body).encode()
-                        if content_type == "application/json"
-                        else body.encode())
-                self.send_response(code)
+            def _send(self, out: tuple[int, bytes, str]) -> None:
+                status, data, content_type = out
+                self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _read_json(self) -> Any:
+            def do_POST(self):
+                # ALWAYS drain the body first: these are HTTP/1.1
+                # keep-alive connections, and replying with unread
+                # Content-Length bytes in the socket would make the
+                # leftover body parse as the next request line
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b""
-                return json.loads(raw) if raw else {}
-
-            def do_POST(self):
-                try:
-                    # ALWAYS drain the body first: these are HTTP/1.1
-                    # keep-alive connections, and replying with unread
-                    # Content-Length bytes in the socket would make the
-                    # leftover body parse as the next request line
-                    args = self._read_json()
-                    # stamp the per-request deadline: every retry loop
-                    # underneath this handler (k8s/retry.py) consults it
-                    # and stops before the scheduler's httpTimeout fires
-                    from tpushare.k8s.retry import request_deadline
-                    with request_deadline(server_self.request_deadline_s):
-                        self._do_post_routed(args)
-                except json.JSONDecodeError as e:
-                    self._reply(400, {"error": f"bad JSON: {e}"})
-                except Exception as e:  # noqa: BLE001 — webhook must answer
-                    log.error("POST %s crashed: %s\n%s", self.path, e,
-                              traceback.format_exc())
-                    self._reply(500, {"Error": f"internal error: {e}"})
-
-            def _do_post_routed(self, args):
-                if self.path == f"{PREFIX}/filter":
-                    self._reply(200, server_self.filter_handler.handle(args))
-                elif self.path == f"{PREFIX}/prioritize":
-                    self._reply(
-                        200,
-                        server_self.prioritize_handler.handle(args))
-                elif self.path == f"{PREFIX}/preempt":
-                    self._reply(
-                        200, server_self.preempt_handler.handle(args))
-                elif self.path == f"{PREFIX}/bind":
-                    # active-active (sharding wired): EVERY replica
-                    # binds — lock-free on its shard, claim-CAS on
-                    # spillover — so the leader gate applies only to
-                    # the legacy active-passive elector mode
-                    if server_self._sharding is None and \
-                            server_self._elector is not None and \
-                            not server_self._elector.is_leader():
-                        # retryable: the default scheduler re-binds
-                        # after its timeout and reaches the leader
-                        self._reply(503, {
-                            "Error": "not the leader; retry"})
-                        return
-                    result = server_self.bind_handler.handle(args)
-                    # reference returns 500 on bind failure (routes.go:139)
-                    self._reply(500 if result.get("Error") else 200, result)
-                elif self.path == "/debug/pods" and server_self._seed_cluster:
-                    pod = server_self._seed_cluster.create_pod(args)
-                    self._reply(201, pod)
-                else:
-                    self._reply(404, {"error": f"no route {self.path}"})
+                self._send(server_self.handle_post(
+                    self.path, raw, self.headers))
 
             def do_GET(self):
-                try:
-                    if self.path == "/version":
-                        info = {"version": tpushare.__version__}
-                        if server_self._elector is not None:
-                            info["leader"] = server_self._elector.is_leader()
-                            info["identity"] = server_self._elector.identity
-                        self._reply(200, info)
-                    elif self.path == "/healthz":
-                        # liveness only: the process is up and serving.
-                        # Everything state-dependent belongs to /readyz —
-                        # restarting a pod because the APISERVER browned
-                        # out would make the outage strictly worse.
-                        self._reply(200, "ok", content_type="text/plain")
-                    elif self.path == "/readyz":
-                        ready, body = server_self.readiness()
-                        self._reply(200 if ready else 503, body)
-                    elif self.path == "/metrics":
-                        self._reply(200, server_self.registry.expose(),
-                                    content_type="text/plain; version=0.0.4")
-                    elif self.path.startswith("/debug/traces") or \
-                            self.path.startswith(f"{PREFIX}/debug/traces"):
-                        limit = None
-                        if "n=" in self.path:
-                            try:
-                                limit = int(self.path.split("n=")[1])
-                            except ValueError:
-                                pass
-                        self._reply(200, server_self.tracer.recorder
-                                    .dump(limit=limit))
-                    elif self.path.startswith("/inspect/explain") or \
-                            self.path.startswith(f"{PREFIX}/inspect/explain"):
-                        self._serve_explain()
-                    elif self.path == "/inspect/fleet" or \
-                            self.path == f"{PREFIX}/inspect/fleet":
-                        self._reply(200,
-                                    server_self.fleetwatch.snapshot())
-                    elif self.path == "/inspect/defrag" or \
-                            self.path == f"{PREFIX}/inspect/defrag":
-                        self._reply(200, server_self.defrag.snapshot())
-                    elif self.path == "/inspect/ring" or \
-                            self.path == f"{PREFIX}/inspect/ring":
-                        if server_self._sharding is not None:
-                            self._reply(200,
-                                        server_self._sharding.snapshot())
-                        else:
-                            self._reply(200, {
-                                "enabled": False,
-                                "mode": ("leader-elect"
-                                         if server_self._elector
-                                         is not None
-                                         else "single-replica"),
-                            })
-                    elif self.path == f"{PREFIX}/inspect" or \
-                            self.path == f"{PREFIX}/inspect/":
-                        self._reply(200, server_self.inspect_handler.handle())
-                    elif self.path.startswith(f"{PREFIX}/inspect/"):
-                        node = self.path[len(f"{PREFIX}/inspect/"):]
-                        out = server_self.inspect_handler.handle(node)
-                        self._reply(404 if "error" in out else 200, out)
-                    elif self.path == "/debug/threads":
-                        self._reply(200, _thread_dump(),
-                                    content_type="text/plain")
-                    elif self.path.startswith("/debug/profile"):
-                        seconds = 1.0
-                        if "seconds=" in self.path:
-                            try:
-                                seconds = min(float(
-                                    self.path.split("seconds=")[1]), 30.0)
-                            except ValueError:
-                                pass
-                        self._reply(200, _profile(seconds),
-                                    content_type="text/plain")
-                    elif self.path.startswith("/debug/heap"):
-                        top = 25
-                        if "top=" in self.path:
-                            try:
-                                top = min(int(
-                                    self.path.split("top=")[1]), 200)
-                            except ValueError:
-                                pass
-                        self._reply(200, _heap_profile(top),
-                                    content_type="text/plain")
-                    else:
-                        self._reply(404, {"error": f"no route {self.path}"})
-                except Exception as e:  # noqa: BLE001
-                    log.error("GET %s crashed: %s", self.path, e)
-                    self._reply(500, {"error": str(e)})
-
-            def _serve_explain(self):
-                """/inspect/explain            -> list of audited pods
-                   /inspect/explain/<pod>      -> that pod's decision
-                                                  history (<pod> = uid,
-                                                  namespace/name or name)
-                """
-                path = self.path
-                if path.startswith(PREFIX):
-                    path = path[len(PREFIX):]
-                selector = path[len("/inspect/explain"):].strip("/")
-                if not selector:
-                    self._reply(200,
-                                {"pods": server_self.explain.pods()})
-                    return
-                out = server_self.explain.get(selector)
-                if out is None:
-                    self._reply(404, {
-                        "error": f"no decision record for {selector!r} "
-                                 "(kept for the last "
-                                 f"{server_self.explain.max_pods} pods x "
-                                 f"{server_self.explain.cycles_per_pod} "
-                                 "cycles)"})
-                    return
-                self._reply(200, out)
+                self._send(server_self.handle_get(self.path))
 
         return Handler
 
@@ -386,34 +416,54 @@ class ExtenderServer:
     # -- lifecycle ------------------------------------------------------------
 
     def _start_fleetwatch(self) -> None:
-        import os
         if os.environ.get("TPUSHARE_FLEETWATCH", "1") != "0":
             self.fleetwatch.start()
         if self.defrag.enabled():
             self.defrag.start()
 
-    def start(self) -> int:
-        """Bind and serve on a background thread; returns the bound port."""
+    def start(self, http_workers: int | None = None) -> int:
+        """Bind and serve on background threads; returns the bound port.
+
+        The selector/event-loop front end (extender/httpserver.py) is
+        the default; ``TPUSHARE_SERVER=threaded`` keeps the legacy
+        stdlib thread-per-connection server.
+        """
         from tpushare.core import native as native_engine
         native_engine.warmup()  # first Filter must not pay engine cold-start
-        self._httpd = ThreadingHTTPServer(
-            (self.host, self.port), self._make_handler())
-        self.port = self._httpd.server_address[1]
-        t = threading.Thread(target=self._httpd.serve_forever,
-                             name="tpushare-http", daemon=True)
-        t.start()
+        if os.environ.get("TPUSHARE_SERVER", "selector") == "threaded":
+            self._httpd = ThreadingHTTPServer(
+                (self.host, self.port), self._make_handler())
+            self.port = self._httpd.server_address[1]
+            t = threading.Thread(target=self._httpd.serve_forever,
+                                 name="tpushare-http", daemon=True)
+            t.start()
+        else:
+            from tpushare.extender.httpserver import SelectorHTTPServer
+            self._httpd = SelectorHTTPServer(
+                self.host, self.port,
+                handle_get=self.handle_get, handle_post=self.handle_post,
+                max_workers=http_workers)
+            self.port = self._httpd.start()
+            httpd = self._httpd
+            self.registry.gauge_func(
+                "tpushare_http_open_connections",
+                "Open keep-alive connections held by the event-loop "
+                "front end (each costs a buffer, not a thread)",
+                lambda: [("", float(httpd.open_connections()))])
+            self.registry.gauge_func(
+                "tpushare_http_busy_workers",
+                "Front-end worker-pool threads currently inside a "
+                "handler (sustained == pool size means requests are "
+                "queueing; raise TPUSHARE_HTTP_WORKERS)",
+                lambda: [("", float(httpd.busy_workers()))])
         self._start_fleetwatch()
         log.info("extender listening on %s:%d", self.host, self.port)
         return self.port
 
     def serve_forever(self) -> None:
-        from tpushare.core import native as native_engine
-        native_engine.warmup()
-        self._httpd = ThreadingHTTPServer(
-            (self.host, self.port), self._make_handler())
-        self._start_fleetwatch()
-        log.info("extender listening on %s:%d", self.host, self.port)
-        self._httpd.serve_forever()
+        self.start()
+        self._serve_done = threading.Event()
+        self._serve_done.wait()
 
     def stop(self) -> None:
         self.defrag.stop()
@@ -421,6 +471,8 @@ class ExtenderServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self._serve_done is not None:
+            self._serve_done.set()
 
 
 def _thread_dump() -> str:

@@ -1,0 +1,125 @@
+"""Owner forwarding: route a request to the replica that owns it.
+
+Active-active sharding (ha/sharding.py) made binds lock-free *on the
+owning replica*, but the kube-scheduler webhook sprays requests across
+replicas blindly — in an N-replica fleet (N-1)/N of binds land off-shard
+and pay the claim-CAS spillover path (+2 apiserver round-trips) as a
+steady-state cost. This module turns that steady state into a rare-race
+fallback: a request landing on a non-owner hops ONCE, replica-to-replica,
+to the shard owner (peer addresses discovered from the shard leases) and
+the owner's verdict is relayed verbatim.
+
+Loop guard: the hop carries ``X-Tpushare-Forwarded: <origin identity>``.
+A request that already hopped is NEVER forwarded again — during a
+rebalance two replicas may briefly disagree about ownership, and without
+the guard they would ping-pong the request until the webhook timeout.
+Instead the receiver serves locally: if its ring agrees it owns the
+target that is the normal ``served`` outcome; if it disagrees
+(``loop_fallback``) the bind simply degrades to the claim-CAS spillover
+path, which is mutual-exclusion-safe against any concurrent writer — the
+exact fallback PR 10 proved. Forwarding is therefore an optimization
+layered ON TOP of the safety protocol, never a replacement for it.
+
+Transport failures (dead peer, open per-peer breaker) are counted
+``peer_failed`` and also degrade to the local CAS — a forward must never
+make a bind less available than not forwarding.
+
+What forwards: Bind, keyed on the ring owner of the target node, on by
+default when sharding is live and the owner advertised an address
+(``TPUSHARE_FORWARD=0`` disables). Filter/Prioritize forwarding — keyed
+on the pod, so a pod's whole cycle runs on one replica and its Filter
+verdict warms the owner's caches — is opt-in via
+``TPUSHARE_FORWARD_CYCLE=1``: a Filter verdict is a cache read every
+replica can serve, so the extra hop only pays off when cycle affinity
+matters more than a round-trip.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from tpushare.ha.sharding import SHARD_FORWARDS
+from tpushare.k8s.client import ApiError
+from tpushare.k8s.peer import PeerPool
+
+log = logging.getLogger("tpushare.ha")
+
+FORWARD_HEADER = "X-Tpushare-Forwarded"
+
+
+class ForwardRouter:
+    """Per-replica forwarding decision + transport.
+
+    ``maybe_forward`` returns the peer's ``(status, body_bytes)`` when
+    the request was handed to the shard owner, or ``None`` when it must
+    be served locally (we own it, forwarding is off, no peer address,
+    the loop guard is set, or the peer hop failed).
+    """
+
+    def __init__(self, sharding, pool: PeerPool | None = None,
+                 enabled: bool | None = None,
+                 cycle: bool | None = None) -> None:
+        self._sharding = sharding
+        self._pool = pool or PeerPool()
+        if enabled is None:
+            enabled = os.environ.get("TPUSHARE_FORWARD", "1") != "0"
+        if cycle is None:
+            cycle = os.environ.get("TPUSHARE_FORWARD_CYCLE", "0") == "1"
+        self.enabled = enabled
+        self.cycle = cycle
+
+    # -- routing keys ---------------------------------------------------------
+
+    @staticmethod
+    def _route_key(route: str, args: dict) -> str | None:
+        """The string whose ring owner should serve this request."""
+        if route == "bind":
+            return args.get("Node") or None
+        # filter/prioritize: key the pod so its whole cycle has one home
+        meta = (args.get("Pod") or {}).get("metadata") or {}
+        name = meta.get("name")
+        if not name:
+            return None
+        return f"{meta.get('namespace', 'default')}/{name}"
+
+    # -- the decision ---------------------------------------------------------
+
+    def maybe_forward(self, route: str, path: str, body: bytes,
+                      args: dict, forwarded_from: str | None
+                      ) -> tuple[int, bytes] | None:
+        sm = self._sharding
+        if sm is None or not sm.is_live():
+            return None
+        if route == "bind":
+            if not self.enabled:
+                return None
+        elif not (self.enabled and self.cycle):
+            return None
+        key = self._route_key(route, args)
+        if key is None:
+            return None
+        owner = sm.owner_of(key)
+        if forwarded_from is not None:
+            # already hopped once: serve locally no matter what. Ring
+            # agreement is the normal case (served); disagreement is the
+            # mid-rebalance window (loop_fallback) and the claim CAS
+            # underneath keeps it safe.
+            SHARD_FORWARDS.inc("served" if owner == sm.identity
+                               else "loop_fallback")
+            return None
+        if owner is None or owner == sm.identity:
+            return None
+        url = sm.peer_url(owner)
+        if url is None:
+            return None  # owner never advertised (mixed-version fleet)
+        try:
+            status, data = self._pool.forward(
+                url, path, body, {FORWARD_HEADER: sm.identity})
+        except ApiError as e:
+            SHARD_FORWARDS.inc("peer_failed")
+            log.warning("forward %s %s -> %s failed (%s); serving "
+                        "locally via claim CAS", route, key, owner, e)
+            return None
+        SHARD_FORWARDS.inc("forwarded")
+        return status, data

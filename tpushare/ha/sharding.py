@@ -74,6 +74,22 @@ RING_REBALANCES = Counter(
     "lease expiry). Each rebalance re-routes ~1/N of the fleet and "
     "re-arms stamp revalidation for the handed-over nodes")
 
+# Owner-forwarding attribution (ha/forward.py): `forwarded` = this
+# replica handed the request to the shard owner over the peer hop,
+# `served` = this replica answered a request a peer forwarded to it,
+# `loop_fallback` = a forwarded request arrived at a replica that does
+# NOT think it owns the target (mid-rebalance ring disagreement) — the
+# loop guard stops a second hop and the bind degrades to the claim-CAS
+# spillover path, `peer_failed` = the forward transport failed (dead
+# peer, open peer breaker) and the bind fell back to the local CAS.
+SHARD_FORWARDS = LabeledCounter(
+    "tpushare_shard_forwards_total",
+    "Owner-forwarded requests by outcome (forwarded = sent to the "
+    "shard owner, served = answered a peer's forward, loop_fallback = "
+    "forward arrived off-owner and degraded to the claim CAS, "
+    "peer_failed = transport failed and the bind ran locally)",
+    ("outcome",))
+
 
 class ShardMembership:
     """One replica's view of the active-active membership.
@@ -95,9 +111,17 @@ class ShardMembership:
         retry_period: float = 2.0,
         vnodes: int | None = None,
         on_rebalance: Callable[[], None] | None = None,
+        advertise_url: str | None = None,
     ) -> None:
         self._cluster = cluster
         self.identity = identity
+        # Peer address book: when set, the advertise URL rides INSIDE
+        # holderIdentity ("<identity> <url>") so discovery needs nothing
+        # beyond the lease listing every replica already does. Settable
+        # after construction (the server's bound port is only known once
+        # it starts) but before start().
+        self.advertise_url = advertise_url
+        self._peers: dict[str, str] = {}  # swapped whole, read lock-free
         self.lease_name = SHARD_LEASE_PREFIX + identity
         self.namespace = namespace
         self.lease_duration = lease_duration
@@ -141,7 +165,8 @@ class ShardMembership:
         try:
             lease = self._cluster.get_lease(self.namespace, self.lease_name)
             spec = dict(lease.get("spec") or {})
-            if spec.get("holderIdentity") != self.identity:
+            holder = (spec.get("holderIdentity") or "").split()
+            if not holder or holder[0] != self.identity:
                 return
             spec["holderIdentity"] = ""
             self._cluster.update_lease(
@@ -169,6 +194,14 @@ class ShardMembership:
     def owner_of(self, node_name: str) -> str | None:
         ring = self._ring
         return None if ring is None else ring.owner(node_name)
+
+    def peer_url(self, identity: str) -> str | None:
+        """Advertised base URL of a live member, or None when the
+        member never advertised one (or has expired)."""
+        return self._peers.get(identity)
+
+    def peers(self) -> dict[str, str]:
+        return dict(self._peers)
 
     def is_ring_leader(self) -> bool:
         """Deterministic fleet-wide singleton seat (lowest live member):
@@ -253,8 +286,11 @@ class ShardMembership:
 
     def _renew_own_lease(self) -> bool:
         now = _fmt(_now())
+        holder = self.identity
+        if self.advertise_url:
+            holder = f"{self.identity} {self.advertise_url}"
         spec = {
-            "holderIdentity": self.identity,
+            "holderIdentity": holder,
             "leaseDurationSeconds": int(self.lease_duration) or 1,
             "acquireTime": now,
             "renewTime": now,
@@ -271,8 +307,9 @@ class ShardMembership:
             except ApiError:
                 return False  # creation raced (stale previous self)
         old = lease.get("spec") or {}
-        if old.get("acquireTime") and \
-                old.get("holderIdentity") == self.identity:
+        old_holder = (old.get("holderIdentity") or "").split()
+        if old.get("acquireTime") and old_holder \
+                and old_holder[0] == self.identity:
             spec["acquireTime"] = old["acquireTime"]
         try:
             self._cluster.update_lease(
@@ -285,15 +322,20 @@ class ShardMembership:
 
     def _list_members(self) -> list[str]:
         """Live shard members: every ``tpushare-schd-shard-*`` lease
-        with a holder and an unexpired renewTime."""
+        with a holder and an unexpired renewTime. A holder of the form
+        ``"<identity> <url>"`` also advertises the replica's peer
+        address; the URLs land in the peer address book
+        (:meth:`peer_url`), the returned membership stays plain
+        identities."""
         members = []
+        peers: dict[str, str] = {}
         for lease in self._cluster.list_leases(self.namespace):
             name = (lease.get("metadata") or {}).get("name") or ""
             if not name.startswith(SHARD_LEASE_PREFIX):
                 continue
             spec = lease.get("spec") or {}
-            holder = spec.get("holderIdentity")
-            if not holder:
+            tokens = (spec.get("holderIdentity") or "").split()
+            if not tokens:
                 continue  # released / abdicated
             renew = _parse(spec.get("renewTime"))
             duration = float(spec.get("leaseDurationSeconds")
@@ -301,7 +343,10 @@ class ShardMembership:
             if renew is None or \
                     (_now() - renew).total_seconds() > duration:
                 continue  # expired: the replica died or partitioned
-            members.append(holder)
+            members.append(tokens[0])
+            if len(tokens) > 1:
+                peers[tokens[0]] = tokens[1]
+        self._peers = peers
         return sorted(set(members))
 
     def _apply_membership(self, members: list[str]) -> None:
@@ -385,11 +430,20 @@ class ShardMembership:
                 "spillover": SHARD_CONFLICTS.get("spillover"),
                 "cas_lost": SHARD_CONFLICTS.get("cas_lost"),
             },
+            "advertise_url": self.advertise_url,
+            "peers": dict(self._peers),
+            "forwards": {
+                "forwarded": SHARD_FORWARDS.get("forwarded"),
+                "served": SHARD_FORWARDS.get("served"),
+                "loop_fallback": SHARD_FORWARDS.get("loop_fallback"),
+                "peer_failed": SHARD_FORWARDS.get("peer_failed"),
+            },
         }
 
     def attach(self, registry) -> None:
         registry.register(SHARD_CONFLICTS)
         registry.register(RING_REBALANCES)
+        registry.register(SHARD_FORWARDS)
         registry.gauge_func(
             "tpushare_shard_owned_nodes",
             "Nodes this replica's ring shard currently owns (0 while "

@@ -213,14 +213,16 @@ def render_ring(snap: dict[str, Any]) -> str:
         + f"), {snap.get('owned_nodes', 0)} owned node(s), "
         f"{snap.get('pending_revalidation', 0)} pending revalidation")
     sizes = snap.get("shard_sizes") or {}
-    rows = [["MEMBER", "SHARD NODES", ""]]
+    peers = snap.get("peers") or {}
+    rows = [["MEMBER", "SHARD NODES", "PEER URL", ""]]
     for m in members:
         tags = []
         if m == snap.get("ring_leader"):
             tags.append("leader")
         if m == snap.get("identity"):
             tags.append("self")
-        rows.append([m, str(sizes.get(m, 0)), ",".join(tags)])
+        rows.append([m, str(sizes.get(m, 0)), peers.get(m, "-"),
+                     ",".join(tags)])
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     lines.extend(_fmt_row(r, widths) for r in rows)
     c = snap.get("conflicts") or {}
@@ -229,6 +231,12 @@ def render_ring(snap: dict[str, Any]) -> str:
         f"bind outcomes: owned {int(c.get('owned', 0))} (lock-free), "
         f"spillover {int(c.get('spillover', 0))} (claim CAS), "
         f"cas_lost {int(c.get('cas_lost', 0))}")
+    f = snap.get("forwards") or {}
+    lines.append(
+        f"forwards: forwarded {int(f.get('forwarded', 0))}, "
+        f"served {int(f.get('served', 0))}, "
+        f"loop_fallback {int(f.get('loop_fallback', 0))}, "
+        f"peer_failed {int(f.get('peer_failed', 0))}")
     return "\n".join(lines)
 
 

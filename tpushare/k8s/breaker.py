@@ -194,7 +194,7 @@ _GUARDED_VERBS = frozenset({
     "list_pods", "get_pod", "list_nodes", "get_node", "get_configmap",
     "patch_pod", "replace_pod", "bind_pod", "create_event", "patch_node",
     "put_configmap", "get_lease", "create_lease", "update_lease",
-    "list_leases",
+    "list_leases", "forward_post",
 })
 
 

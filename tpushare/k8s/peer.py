@@ -1,0 +1,116 @@
+"""Replica-to-replica forward transport for owner forwarding.
+
+When a Filter/Prioritize/Bind lands on a replica that does not own the
+target shard (ha/forward.py), the request hops once to the owner over
+plain HTTP. The transport is deliberately thin — one verb,
+``forward_post`` — and rides the same fault-containment stack as the
+apiserver client (:func:`tpushare.k8s.breaker.harden`), but with its own
+per-peer breaker and a much tighter budget: a forward is an
+*optimization* over the claim-CAS spillover path, so a sick peer must
+fail fast into the local fallback rather than burn the webhook timeout.
+
+Error contract: ``forward_post`` returns ``(status, body)`` for ANY
+HTTP response the peer produced — a 500 from the owner is an application
+verdict to relay verbatim, not a transport failure — and raises
+``ApiError(0, ...)`` only when no response arrived (connect/read
+failure). That keeps the breaker accounting honest (`answered` =
+healthy peer) and makes retry classification fall out of the existing
+``is_retryable`` rules.
+
+Replay safety: the keep-alive pool never auto-resends a POST
+(incluster.py ``_REPLAY_SAFE``); a reused-socket failure surfaces as
+ApiError(0) and the retry policy replays it. That is safe for forwards
+because the forwarded operations tolerate duplicates by construction —
+a duplicate bind is the idempotent already-bound-here path, and
+Filter/Prioritize are reads.
+
+Lock discipline: the pool lock only guards the transport map; no lock
+is ever held across a forward round-trip (the hop runs on a checked-out
+transport object).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import urllib.parse
+
+from tpushare.k8s.breaker import CircuitBreaker, harden
+from tpushare.k8s.client import ApiError
+from tpushare.k8s.incluster import _ConnPool
+from tpushare.k8s.retry import RetryPolicy
+
+DEFAULT_FORWARD_TIMEOUT_S = 2.0
+
+
+def forward_timeout_s() -> float:
+    try:
+        return float(os.environ.get("TPUSHARE_FORWARD_TIMEOUT_S",
+                                    DEFAULT_FORWARD_TIMEOUT_S))
+    except ValueError:
+        return DEFAULT_FORWARD_TIMEOUT_S
+
+
+class PeerTransport:
+    """One peer's keep-alive HTTP channel; the ``forward_post`` verb is
+    what the retry/breaker proxies gate on."""
+
+    def __init__(self, base_url: str,
+                 timeout: float | None = None) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = forward_timeout_s() if timeout is None else timeout
+        parsed = urllib.parse.urlsplit(self.base_url)
+        self._pool = _ConnPool(
+            parsed.hostname or "localhost",
+            parsed.port or (443 if parsed.scheme == "https" else 80),
+            parsed.scheme == "https", None, max_idle=4)
+
+    def forward_post(self, path: str, body: bytes,
+                     headers: dict[str, str]) -> tuple[int, bytes]:
+        hdrs = {"Content-Type": "application/json",
+                "Content-Length": str(len(body))}
+        hdrs.update(headers)
+        try:
+            status, data, _ = self._pool.request(
+                "POST", path, body, hdrs, self.timeout)
+        except OSError as e:
+            raise ApiError(0, f"peer {self.base_url}: {e}") from None
+        except Exception as e:  # http.client.HTTPException et al
+            raise ApiError(0, f"peer {self.base_url}: {e}") from None
+        return status, data
+
+
+class PeerPool:
+    """Hardened transports keyed by peer URL, built lazily.
+
+    Each peer gets its own breaker (one sick replica must not poison
+    forwards to the healthy ones) with a short reset so a restarted
+    replica is probed again within a couple of seconds, and a 2-attempt
+    retry budget — one replay for a stale keep-alive socket, nothing
+    more; the local CAS fallback is always available and cheaper than a
+    third round-trip.
+    """
+
+    def __init__(self, timeout: float | None = None) -> None:
+        self._timeout = timeout
+        self._lock = threading.Lock()  # guards the map only, never I/O
+        self._transports: dict[str, object] = {}
+
+    def _get(self, base_url: str):
+        with self._lock:
+            t = self._transports.get(base_url)
+            if t is None:
+                t = harden(
+                    PeerTransport(base_url, timeout=self._timeout),
+                    breaker=CircuitBreaker(failure_threshold=3,
+                                           reset_timeout_s=2.0),
+                    policy=RetryPolicy(max_attempts=2))
+                self._transports[base_url] = t
+            return t
+
+    def forward(self, base_url: str, path: str, body: bytes,
+                headers: dict[str, str]) -> tuple[int, bytes]:
+        """POST ``body`` to ``base_url + path``. Returns the peer's
+        ``(status, body)``; raises ApiError (incl. BreakerOpenError) when
+        the peer could not be reached."""
+        return self._get(base_url).forward_post(path, body, headers)

@@ -52,6 +52,14 @@ def main(argv: list[str] | None = None) -> int:
                          "given and not in {1,2,4}); one JSON report "
                          "per shard count, proving the scorecard is "
                          "unchanged by shard ownership")
+    ap.add_argument("--procs", type=int, default=0, metavar="N",
+                    help="wall-clock scale-out mode: run the full "
+                         "standard replay in N spawned OS processes "
+                         "and in one, report aggregate placements/sec "
+                         "for both, and prove every process emitted a "
+                         "byte-identical scorecard (cross-process "
+                         "determinism; tpushare/sim/procs.py). Exits "
+                         "nonzero on scorecard divergence")
     ap.add_argument("--slice", action="store_true",
                     help="multi-host slice (gang) mode: one v5e-16 "
                          "(2x2 hosts of 2x2 chips), mixed single-chip "
@@ -109,6 +117,29 @@ def main(argv: list[str] | None = None) -> int:
                      multi_chip_fraction=args.multi_chip_fraction,
                      high_priority_fraction=args.high_priority_fraction,
                      seed=args.seed)
+    if args.procs:
+        # real OS processes, one replay each: the multi-core number and
+        # the cross-process determinism proof (tpushare/sim/procs.py)
+        from tpushare.sim.procs import run_procs
+        if args.shards:
+            ap.error("--shards does not apply to --procs mode")
+        policy = "binpack" if args.policy == "all" else args.policy
+        out = run_procs({
+            "nodes": args.nodes, "chips": args.chips, "hbm": args.hbm,
+            "mesh": list(mesh) if mesh else None,
+            "policy": policy, "preempt": args.preempt,
+            "spec": {"n_pods": args.pods,
+                     "arrival_rate": args.arrival_rate,
+                     "mean_duration": args.mean_duration,
+                     "multi_chip_fraction": args.multi_chip_fraction,
+                     "high_priority_fraction":
+                         args.high_priority_fraction,
+                     "seed": args.seed}}, args.procs)
+        print(json.dumps(out))
+        # a scorecard that differs across fresh interpreters is a
+        # nondeterminism bug, not a tuning question: fail loudly
+        return 0 if out["scorecards_identical"] else 1
+
     trace = synth_trace(spec)
     if args.shards:
         # sharding changes who HANDLES a bind, never its verdict: every
