@@ -2706,6 +2706,87 @@ def shard_scaleout_procs(n_procs: int = 4, n_pods: int = 96) -> dict:
             "failed": sum(1 for c in checks if c.startswith("FAIL"))}
 
 
+def wind_tunnel() -> dict:
+    """Million-pod wind tunnel A/B (ISSUE 12): the python spec loop vs
+    the native engine loop (tpushare/sim/engine_loop.py), hermetic.
+
+    Arm 1 replays the STANDARD trace on a mid-size fleet through both
+    engines: the reports must be byte-identical (the native loop is the
+    same binpack decisions, resident in the arena) and both arms
+    publish ``sim_pods_per_sec``. Arm 2 is the scale leg: a seeded
+    diurnal trace over a 50k-node fleet — the native loop replays it
+    whole, the python spec path is timed on a pod PREFIX (a full python
+    replay at 50k nodes runs ~1 s/pod: hours, not a bench section) and
+    extrapolated. The >= 10x check and the <5 min/1M-pod projection
+    ride on arm 2.
+    """
+    from tpushare.sim.engine_loop import run_sim_native
+    from tpushare.sim.simulator import (
+        Fleet, TraceSpec, run_sim, synth_trace)
+    from tpushare.sim.traces import DiurnalSpec, synth_diurnal, synth_fleet
+
+    # arm 1: standard trace, both engines end to end
+    spec = TraceSpec(n_pods=2000, arrival_rate=6.0, mean_duration=40.0,
+                     multi_chip_fraction=0.3, seed=13)
+    trace = synth_trace(spec)
+    t0 = time.perf_counter()
+    spec_report = run_sim(Fleet.homogeneous(64, 4, 16384, (2, 2)),
+                          trace, "binpack")
+    py_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    native_report, _ = run_sim_native(
+        Fleet.homogeneous(64, 4, 16384, (2, 2)), trace)
+    nat_wall = time.perf_counter() - t0
+    identical = (json.dumps(spec_report.to_json(), sort_keys=True)
+                 == json.dumps(native_report.to_json(), sort_keys=True))
+    standard = {
+        "nodes": 64, "pods": spec.n_pods,
+        "python_wall_s": round(py_wall, 3),
+        "native_wall_s": round(nat_wall, 3),
+        "python_sim_pods_per_sec": round(spec.n_pods / py_wall, 1),
+        "native_sim_pods_per_sec": round(spec.n_pods / nat_wall, 1),
+        "speedup": round(py_wall / nat_wall, 2) if nat_wall else None,
+        "scorecards_identical": identical,
+    }
+
+    # arm 2: the 50k-node diurnal leg. ~100k pods keeps the native arm
+    # around half a minute; the projection scales the measured rate to
+    # the full 1M-pod day.
+    dspec = DiurnalSpec(hours=0.5, period=0.5, base_rate=100_000.0,
+                        peak_rate=300_000.0, seed=21)
+    dtrace = synth_diurnal(dspec)
+    n_nodes = 50_000
+    t0 = time.perf_counter()
+    report, stats = run_sim_native(synth_fleet(n_nodes), dtrace)
+    nat_wall = time.perf_counter() - t0
+    nat_rate = len(dtrace) / nat_wall if nat_wall else 0.0
+    # python prefix: enough pods to average the per-pod full-fleet scan,
+    # few enough to stay a bench section
+    prefix = dtrace[:24]
+    t0 = time.perf_counter()
+    run_sim(synth_fleet(n_nodes), prefix, "binpack")
+    py_wall = time.perf_counter() - t0
+    py_rate = len(prefix) / py_wall if py_wall else 0.0
+    diurnal = {
+        "nodes": n_nodes, "pods": len(dtrace),
+        "placed": report.placed, "never_placed": report.never_placed,
+        "native_wall_s": round(nat_wall, 3),
+        "native_sim_pods_per_sec": round(nat_rate, 1),
+        "python_prefix_pods": len(prefix),
+        "python_prefix_wall_s": round(py_wall, 3),
+        "python_sim_pods_per_sec": round(py_rate, 2),
+        "speedup": round(nat_rate / py_rate, 1) if py_rate else None,
+        "projected_1m_pod_minutes":
+            round(1_000_000 / nat_rate / 60.0, 2) if nat_rate else None,
+        "arena": {k: stats["arena"][k]
+                  for k in ("nodes", "slot_updates", "appends")},
+        "delta_refreshes": stats["delta_refreshes"],
+        "full_builds": stats["full_builds"],
+    }
+    return {"hermetic": True, "standard": standard,
+            "diurnal_50k": diurnal}
+
+
 SLICE_HOSTS = [f"v5e16-h{i}" for i in range(4)]
 
 
@@ -3060,6 +3141,35 @@ def main() -> int:
            f"zero chip oversubscription on apiserver truth across the "
            f"handoff (got {ho['oversubscribed_chips'] or 'none'})")
 
+    # million-pod wind tunnel (ISSUE 12): native engine loop vs python
+    # spec path — byte-identical standard-trace scorecards, >= 10x at
+    # 50k nodes, and the <5 min/1M-pod projection
+    wt = wind_tunnel()
+    expect(wt["standard"]["scorecards_identical"],
+           f"wind tunnel: native engine loop replays the standard "
+           f"trace byte-identically to the python spec "
+           f"({wt['standard']['pods']} pods, "
+           f"{wt['standard']['native_sim_pods_per_sec']}/s native vs "
+           f"{wt['standard']['python_sim_pods_per_sec']}/s python)")
+    expect((wt["diurnal_50k"]["speedup"] or 0) >= 10.0,
+           f"wind tunnel: native loop >= 10x the python spec path on "
+           f"the 50k-node diurnal leg "
+           f"(x{wt['diurnal_50k']['speedup']}: "
+           f"{wt['diurnal_50k']['native_sim_pods_per_sec']}/s vs "
+           f"{wt['diurnal_50k']['python_sim_pods_per_sec']}/s)")
+    expect((wt["diurnal_50k"]["projected_1m_pod_minutes"] or 99) < 5.0,
+           f"wind tunnel: 1M-pod diurnal day over 50k nodes projects "
+           f"under 5 minutes "
+           f"({wt['diurnal_50k']['projected_1m_pod_minutes']} min from "
+           f"{wt['diurnal_50k']['pods']} pods in "
+           f"{wt['diurnal_50k']['native_wall_s']} s)")
+    expect(wt["diurnal_50k"]["arena"]["appends"]
+           <= wt["diurnal_50k"]["arena"]["nodes"],
+           f"wind tunnel: events delta-update resident arena slots "
+           f"(appends {wt['diurnal_50k']['arena']['appends']} <= "
+           f"{wt['diurnal_50k']['arena']['nodes']} nodes, "
+           f"{wt['diurnal_50k']['arena']['slot_updates']} slot updates)")
+
     # bind latency with real apiserver round-trips (stub apiserver wire)
     wire = wire_latency()
     expect(wire["p50"] < 50.0,
@@ -3244,6 +3354,10 @@ def main() -> int:
             # per-shard index residency, and the replica-kill handoff
             # drift/oversubscription proof
             "shard_scaleout": scaleout,
+            # million-pod wind tunnel (ISSUE 12): python-spec vs
+            # native-loop A/B on the standard trace (byte-identical)
+            # and the 50k-node diurnal leg with the 1M-pod projection
+            "wind_tunnel": wt,
         },
         "wire": {
             "note": "stub apiserver loopback: real HTTP wire format incl. "
@@ -3323,4 +3437,7 @@ if __name__ == "__main__":
         result = shard_scaleout_procs(procs)
         print(json.dumps(result, indent=2))
         sys.exit(1 if result["failed"] else 0)
+    if "wind_tunnel" in sys.argv:
+        print(json.dumps(wind_tunnel(), indent=2))
+        sys.exit(0)
     sys.exit(main())

@@ -32,6 +32,7 @@ SCOPES = (
     os.path.join(ROOT, "tpushare", "defrag"),
     os.path.join(ROOT, "tpushare", "ha"),
     os.path.join(ROOT, "tpushare", "extender"),
+    os.path.join(ROOT, "tpushare", "sim"),
 )
 
 # (file basename, with-expression prefix) -> rank. Nested acquisitions
@@ -58,6 +59,12 @@ RANKS = {
     ("engine.py", "_lock"): 60,             # native loader
     ("engine.py", "_pool_lock"): 61,        # scan pool
     ("engine.py", "self._lock"): 62,        # FleetArena
+    # sim engine loop (ISSUE 12): arena bookkeeping lock — guards only
+    # the signature-table install/evict and the snapshot counters, and
+    # is NEVER held across an arena call (cycle/score/_sync take the
+    # FleetArena's own 62-ranked lock), so it must sit BELOW 62 to keep
+    # a loop-holds-lock -> arena-call nesting legal if one ever appears
+    ("engine_loop.py", "self._lock"): 55,
     # defrag (ISSUE 9): both are LEFTMOST like the batch window lock —
     # pure bookkeeping (budget/backoff/in-flight; inspect state), never
     # held across a solve, an eviction, or any cache/node call. The

@@ -1,0 +1,138 @@
+"""Diurnal wind-tunnel trace generator: determinism, rate integrals,
+tier mix, spike placement (tpushare/sim/traces.py)."""
+
+import math
+
+import pytest
+
+from tpushare.sim.traces import (
+    DEFAULT_TIERS, DiurnalSpec, PodTier, SpikeWindow, expected_arrivals,
+    rate_at, synth_diurnal, synth_fleet)
+
+
+def _spec(**kw):
+    base = dict(hours=6.0, period=6.0, base_rate=200.0, peak_rate=600.0,
+                seed=11)
+    base.update(kw)
+    return DiurnalSpec(**base)
+
+
+def test_seeded_determinism():
+    a, b = synth_diurnal(_spec()), synth_diurnal(_spec())
+    assert len(a) == len(b) > 0
+    assert [(p.arrival, p.duration, p.request.hbm_mib, p.request.chip_count,
+             p.request.topology, p.priority) for p in a] == \
+           [(p.arrival, p.duration, p.request.hbm_mib, p.request.chip_count,
+             p.request.topology, p.priority) for p in b]
+    assert all(p.arrival <= q.arrival for p, q in zip(a, a[1:]))
+    # a different seed must actually change the realization
+    c = synth_diurnal(_spec(seed=12))
+    assert [(p.arrival, p.duration) for p in c] != \
+           [(p.arrival, p.duration) for p in a]
+
+
+def test_arrival_count_matches_rate_integral():
+    """The thinning sampler's realized count must track the analytic
+    integral of rate_at over the horizon (law of large numbers: a few
+    thousand arrivals → well within 10%)."""
+    spec = _spec()
+    trace = synth_diurnal(spec)
+    want = expected_arrivals(spec)
+    assert want > 1000  # the bound below is vacuous on tiny traces
+    assert abs(len(trace) - want) / want < 0.10
+
+
+def test_rate_at_trough_and_peak():
+    spec = _spec()
+    assert rate_at(spec, 0.0) == pytest.approx(spec.base_rate)
+    assert rate_at(spec, spec.period / 2) == pytest.approx(spec.peak_rate)
+    mid = rate_at(spec, spec.period / 4)
+    assert spec.base_rate < mid < spec.peak_rate
+
+
+def test_tier_mix_proportions():
+    """Realized tier shares must match the configured weights — the
+    sweep's pressure profile depends on the mix being honest."""
+    trace = synth_diurnal(_spec(hours=12.0, period=12.0))
+    assert len(trace) > 3000
+    by_shape = {}
+    for p in trace:
+        key = (p.request.hbm_mib, p.request.chip_count,
+               p.request.topology)
+        by_shape[key] = by_shape.get(key, 0) + 1
+    total = len(trace)
+    for tier in DEFAULT_TIERS:
+        key = (tier.hbm_mib, tier.chip_count, tier.topology)
+        got = by_shape.get(key, 0) / total
+        assert got == pytest.approx(tier.weight, abs=0.04), tier.name
+
+
+def test_tier_durations_track_mean():
+    trace = synth_diurnal(_spec(hours=12.0, period=12.0))
+    by_shape = {}
+    for p in trace:
+        key = (p.request.hbm_mib, p.request.chip_count,
+               p.request.topology)
+        by_shape.setdefault(key, []).append(p.duration)
+    for tier in DEFAULT_TIERS:
+        durs = by_shape[(tier.hbm_mib, tier.chip_count, tier.topology)]
+        mean = sum(durs) / len(durs)
+        assert abs(mean - tier.mean_duration) / tier.mean_duration < 0.25
+
+
+def test_spike_windows_land_where_configured():
+    """Arrivals inside a configured spike window must be denser than
+    the same-width windows either side of it."""
+    spike = SpikeWindow(start=2.0, duration=0.5, multiplier=3.0)
+    spec = _spec(spikes=(spike,))
+    trace = synth_diurnal(spec)
+
+    def count(lo, hi):
+        return sum(1 for p in trace if lo <= p.arrival < hi)
+
+    inside = count(2.0, 2.5)
+    before = count(1.5, 2.0)
+    after = count(2.5, 3.0)
+    # multiplier 3x against a smooth sinusoid: the window must clearly
+    # dominate both neighbors, not just edge them out
+    assert inside > 2.0 * before
+    assert inside > 2.0 * after
+    # and the analytic integral agrees the spike adds mass
+    flat = expected_arrivals(_spec())
+    assert expected_arrivals(spec) > flat * 1.05
+
+
+def test_expected_arrivals_is_an_integral():
+    """Doubling the horizon of a periodic spec doubles the expected
+    count; scaling both rates scales it linearly."""
+    one = expected_arrivals(_spec(hours=6.0))
+    two = expected_arrivals(_spec(hours=12.0))
+    assert two == pytest.approx(2 * one, rel=1e-6)
+    hot = expected_arrivals(_spec(base_rate=400.0, peak_rate=1200.0))
+    assert hot == pytest.approx(2 * one, rel=1e-6)
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        DiurnalSpec(hours=0.0)
+    with pytest.raises(ValueError):
+        DiurnalSpec(base_rate=-1.0)
+    with pytest.raises(ValueError):
+        DiurnalSpec(peak_rate=10.0, base_rate=20.0)
+    with pytest.raises(ValueError):
+        DiurnalSpec(tiers=())
+    with pytest.raises(ValueError):
+        DiurnalSpec(tiers=(PodTier("bad", -1.0, 1024, 1, None, 1.0),))
+
+
+def test_default_tier_weights_are_a_distribution():
+    assert math.isclose(sum(t.weight for t in DEFAULT_TIERS), 1.0)
+    assert all(t.weight > 0 for t in DEFAULT_TIERS)
+
+
+def test_synth_fleet_geometry():
+    fleet = synth_fleet(32)
+    assert len(fleet.nodes) == 32
+    node = fleet.nodes[0]
+    assert len(node.used) == 4
+    assert node.hbm == 16384

@@ -1,0 +1,75 @@
+"""The pinned wind-tunnel scorecard gate (tier-1).
+
+tests/data/wind_tunnel_golden.json pins the autotune winner's scorecard
+on the standard gate trace with per-metric tolerance bands. This test
+replays the gate every tier-1 run: a change that degrades placement
+QUALITY — not just throughput — reds here. Re-baselining is deliberate:
+``python -m tpushare.sim --autotune --pin`` (docs/ops.md)."""
+
+import pytest
+
+from tpushare.sim.autotune import (
+    DEFAULT_BANDS, GATE_FLEET, GATE_TRACE, LoopKnobs, check_scorecard,
+    gate_scorecard, knob_grid, load_golden)
+from tpushare.sim.simulator import Fleet, run_sim, synth_trace
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return load_golden()
+
+
+def test_golden_schema(golden):
+    assert set(golden) == {"gate_trace", "gate_fleet", "winner_knobs",
+                           "scorecard", "bands"}
+    assert set(golden["bands"]) <= set(golden["scorecard"])
+    assert all(b > 0 for b in golden["bands"].values())
+    # the golden must describe THIS code's gate workload, or the replay
+    # below compares apples to oranges after a silent workload edit
+    assert golden["gate_trace"]["n_pods"] == GATE_TRACE.n_pods
+    assert golden["gate_trace"]["seed"] == GATE_TRACE.seed
+    assert golden["gate_fleet"]["nodes"] == GATE_FLEET["nodes"]
+
+
+def test_gate_scorecard_within_bands(golden):
+    """THE regression gate: replay the pinned winner's knobs on the
+    gate trace; every banded metric must sit inside its band."""
+    got = gate_scorecard(LoopKnobs(**golden["winner_knobs"]))
+    violations = check_scorecard(got, golden)
+    assert violations == [], "\n".join(violations)
+
+
+def test_gate_is_falsifiable_by_policy_regression(golden):
+    """A deliberate scoring regression must red the gate: worstfit on
+    the same gate workload lands outside the bands (if it did not, the
+    bands would be too loose to protect anything)."""
+    fleet = Fleet.homogeneous(GATE_FLEET["nodes"], GATE_FLEET["chips"],
+                              GATE_FLEET["hbm"], GATE_FLEET["mesh"])
+    bad = run_sim(fleet, synth_trace(GATE_TRACE), "worstfit").scorecard()
+    assert check_scorecard(bad, golden) != []
+
+
+def test_bands_match_defaults(golden):
+    assert golden["bands"] == DEFAULT_BANDS
+
+
+def test_check_scorecard_mechanics(golden):
+    pinned = dict(golden["scorecard"])
+    assert check_scorecard(pinned, golden) == []
+    for metric, band in golden["bands"].items():
+        nudged = dict(pinned, **{metric: pinned[metric] + band * 2})
+        bad = check_scorecard(nudged, golden)
+        assert len(bad) == 1 and metric in bad[0]
+    # a missing metric is a violation, not a silent pass
+    dropped = dict(pinned)
+    dropped.pop("p99_pending_age_s")
+    dropped["p99_pending_age_s"] = None
+    assert check_scorecard(dropped, golden) != []
+
+
+def test_knob_grid_shape():
+    """The sweep ranks at least 16 configurations (acceptance floor)
+    and every config is a valid, distinct knob point."""
+    grid = knob_grid()
+    assert len(grid) >= 16
+    assert len(set(grid)) == len(grid)

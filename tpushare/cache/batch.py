@@ -108,10 +108,18 @@ class _Window:
         self.leader_trace_id: str | None = None
 
 
-def _sig(req) -> tuple:
-    # keep in lockstep with cache.cache._req_sig (not imported to keep
-    # this module a leaf below cache.py in the import graph)
+def request_signature(req) -> tuple:
+    """The batching window's request equivalence class: two requests
+    with the same signature are interchangeable to a multi-pod solve.
+    Kept in lockstep with cache.cache._req_sig (not imported to keep
+    this module a leaf below cache.py in the import graph). Public
+    because the sim's native engine loop coalesces same-signature
+    pending pods through the SAME class (tpushare/sim/engine_loop.py) —
+    one definition of "same pod" for server and wind tunnel."""
     return (req.hbm_mib, req.chip_count, req.topology, req.allow_scatter)
+
+
+_sig = request_signature  # internal alias, pre-existing call sites
 
 
 class BatchPlanner:

@@ -11,8 +11,14 @@ time-weighted utilization, fragmentation, and rejection rates per policy.
 CLI: ``python -m tpushare.sim --help``.
 """
 
+from tpushare.sim.engine_loop import LoopKnobs, run_sim_native
 from tpushare.sim.simulator import (
     POLICIES, Fleet, SimReport, TraceSpec, run_sim, synth_trace)
+from tpushare.sim.traces import (
+    DEFAULT_TIERS, DiurnalSpec, PodTier, SpikeWindow, synth_diurnal,
+    synth_fleet)
 
-__all__ = ["POLICIES", "Fleet", "SimReport", "TraceSpec", "run_sim",
-           "synth_trace"]
+__all__ = ["DEFAULT_TIERS", "DiurnalSpec", "Fleet", "LoopKnobs",
+           "POLICIES", "PodTier", "SimReport", "SpikeWindow",
+           "TraceSpec", "run_sim", "run_sim_native", "synth_diurnal",
+           "synth_fleet", "synth_trace"]

@@ -3,7 +3,9 @@
     python -m tpushare.sim --nodes 8 --chips 4 --hbm 16384 --mesh 2x2 \
         --pods 400 --policy all
 
-Prints one JSON object per policy run.
+Prints one JSON object per policy run. Flags are grouped: *trace*
+(what workload), *engine* (what replays it), *sweep modes* (which
+harness), *output* (where results land) — ``--help`` shows the groups.
 """
 
 from __future__ import annotations
@@ -16,58 +18,208 @@ from tpushare.sim.simulator import (
     POLICIES, Fleet, TraceSpec, run_sim, synth_trace)
 
 
-def main(argv: list[str] | None = None) -> int:
-    ap = argparse.ArgumentParser(prog="tpushare-sim")
-    ap.add_argument("--nodes", type=int, default=8)
-    ap.add_argument("--chips", type=int, default=4)
-    ap.add_argument("--hbm", type=int, default=16384,
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tpushare-sim",
+        description="Discrete-event fleet simulator over the real "
+                    "placement kernel: policy duels, preemption and "
+                    "defrag studies, scale-out proofs, and the "
+                    "million-pod wind tunnel (--engine native).")
+
+    tg = ap.add_argument_group(
+        "trace", "the synthetic workload: flat Poisson by default, "
+                 "diurnal wind-tunnel day with --diurnal")
+    tg.add_argument("--pods", type=int, default=400)
+    tg.add_argument("--arrival-rate", type=float, default=2.0)
+    tg.add_argument("--mean-duration", type=float, default=40.0)
+    tg.add_argument("--multi-chip-fraction", type=float, default=0.15)
+    tg.add_argument("--high-priority-fraction", type=float, default=0.0)
+    tg.add_argument("--seed", type=int, default=0)
+    tg.add_argument("--diurnal", action="store_true",
+                    help="replace the flat trace with the seeded "
+                         "diurnal generator (tpushare/sim/traces.py): "
+                         "sinusoidal arrival rate, tiered pod shapes, "
+                         "per-tier churn")
+    tg.add_argument("--hours", type=float, default=24.0,
+                    help="--diurnal: trace length in hours")
+    tg.add_argument("--base-rate", type=float, default=40.0,
+                    help="--diurnal: trough arrivals/hour")
+    tg.add_argument("--peak-rate", type=float, default=160.0,
+                    help="--diurnal: peak arrivals/hour")
+
+    eg = ap.add_argument_group(
+        "engine", "the fleet geometry and the loop that replays the "
+                  "trace over it")
+    eg.add_argument("--nodes", type=int, default=8)
+    eg.add_argument("--chips", type=int, default=4)
+    eg.add_argument("--hbm", type=int, default=16384,
                     help="HBM MiB per chip")
-    ap.add_argument("--mesh", default=None,
+    eg.add_argument("--mesh", default=None,
                     help='host ICI mesh, e.g. "2x2" (default: 1-D)')
-    ap.add_argument("--pods", type=int, default=400)
-    ap.add_argument("--arrival-rate", type=float, default=2.0)
-    ap.add_argument("--mean-duration", type=float, default=40.0)
-    ap.add_argument("--multi-chip-fraction", type=float, default=0.15)
-    ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--policy", default="all",
+    eg.add_argument("--engine", default="python",
+                    choices=["python", "native"],
+                    help="python = the behavioral-spec loop (one "
+                         "select_chips_py per pod per node — the "
+                         "parity oracle); native = the resident-arena "
+                         "engine loop (tpushare/sim/engine_loop.py), "
+                         "byte-identical scorecards at default knobs")
+    eg.add_argument("--policy", default="all",
                     choices=["all", *POLICIES])
-    ap.add_argument("--preempt", default="off",
+    eg.add_argument("--preempt", default="off",
                     choices=["off", "scalar", "refined"],
                     help="priority preemption for unplaceable arrivals: "
                          "scalar = node-level victim arithmetic (the "
                          "no-extender failure mode), refined = per-chip "
                          "victim refinement (the preempt verb)")
-    ap.add_argument("--high-priority-fraction", type=float, default=0.0)
-    ap.add_argument("--defrag", action="store_true",
+    eg.add_argument("--batch-window", type=float, default=0.0,
+                    help="--engine native: coalesce arrivals for this "
+                         "many sim-time units and solve same-signature "
+                         "groups disjointly (the BatchPlanner replayed "
+                         "offline); 0 = spec-parity waves")
+    eg.add_argument("--index-scheme", default="off",
+                    choices=["off", "pow2", "exact"],
+                    help="--engine native: max-free no-fit prune over "
+                         "delta re-scores (throughput only — decisions "
+                         "never change)")
+    eg.add_argument("--eqclass-lru", type=int, default=32,
+                    help="--engine native: resident signature score "
+                         "vectors kept before LRU eviction")
+    eg.add_argument("--defrag-budget", type=int, default=0,
+                    help="--engine native: live-migration moves per "
+                         "defrag pass (0 = no defrag)")
+    eg.add_argument("--defrag-period", type=float, default=4.0,
+                    help="--engine native: sim-time between defrag "
+                         "passes")
+    eg.add_argument("--scatter-util-pct", type=float, default=0.0,
+                    help="--engine native: below this fleet "
+                         "utilization, scatter-tolerant requests are "
+                         "forced contiguous (0 = honor the request)")
+
+    sg = ap.add_argument_group(
+        "sweep modes", "alternative harnesses around the replay "
+                       "(mutually exclusive with each other)")
+    sg.add_argument("--autotune", action="store_true",
+                    help="ranked knob sweep: replay the wind-tunnel "
+                         "sweep workload under 18 knob configurations "
+                         "and print the winners table ranked by "
+                         "scorecard (tpushare/sim/autotune.py); "
+                         "throughput is published but never ranks")
+    sg.add_argument("--pin", action="store_true",
+                    help="--autotune: re-baseline the tier-1 scorecard "
+                         "gate — write the winner's standard-trace "
+                         "scorecard + tolerance bands to "
+                         "tests/data/wind_tunnel_golden.json "
+                         "(deliberate act; see docs/ops.md)")
+    sg.add_argument("--defrag", action="store_true",
                     help="repack-rebalancer mode: replay a churn trace "
                          "through the defrag planner core, sweeping the "
                          "per-pass migration budget; one JSON report per "
                          "budget (tpushare/sim/defrag.py)")
-    ap.add_argument("--budgets", default="0,1,2,4",
+    sg.add_argument("--budgets", default="0,1,2,4",
                     help="--defrag: comma-separated move budgets to sweep")
-    ap.add_argument("--shards", type=int, default=0, metavar="N",
+    sg.add_argument("--shards", type=int, default=0, metavar="N",
                     help="active-active sharding mode: replay the "
                          "standard arrival trace against 1, 2 and 4 "
                          "simulated shard owners (or 1 and N when N is "
                          "given and not in {1,2,4}); one JSON report "
                          "per shard count, proving the scorecard is "
                          "unchanged by shard ownership")
-    ap.add_argument("--procs", type=int, default=0, metavar="N",
+    sg.add_argument("--procs", type=int, default=0, metavar="N",
                     help="wall-clock scale-out mode: run the full "
                          "standard replay in N spawned OS processes "
                          "and in one, report aggregate placements/sec "
                          "for both, and prove every process emitted a "
                          "byte-identical scorecard (cross-process "
-                         "determinism; tpushare/sim/procs.py). Exits "
-                         "nonzero on scorecard divergence")
-    ap.add_argument("--slice", action="store_true",
+                         "determinism; tpushare/sim/procs.py). Honors "
+                         "--engine. Exits nonzero on scorecard "
+                         "divergence")
+    sg.add_argument("--slice", action="store_true",
                     help="multi-host slice (gang) mode: one v5e-16 "
                          "(2x2 hosts of 2x2 chips), mixed single-chip "
                          "tenants + 2x2/2x4 exclusive gangs through "
                          "core/slice.select_gang; compares the 'pack' "
                          "and 'spread' singles policies "
                          "(docs/designs/multihost-gang.md)")
+
+    og = ap.add_argument_group("output")
+    og.add_argument("--out", default=None, metavar="FILE",
+                    help="write the JSON lines to FILE instead of "
+                         "stdout")
+    og.add_argument("--stats", action="store_true",
+                    help="--engine native: attach the engine loop's "
+                         "internals (refresh/prune/batch counters, "
+                         "arena delta accounting) to each report")
+    return ap
+
+
+def _knobs_from(args):
+    from tpushare.sim.engine_loop import LoopKnobs
+    return LoopKnobs(batch_window=args.batch_window,
+                     index_scheme=args.index_scheme,
+                     eqclass_lru=args.eqclass_lru,
+                     defrag_budget=args.defrag_budget,
+                     defrag_period=args.defrag_period,
+                     scatter_util_pct=args.scatter_util_pct)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = _build_parser()
     args = ap.parse_args(argv)
+    sink = open(args.out, "w") if args.out else sys.stdout
+
+    def emit(obj) -> None:
+        print(json.dumps(obj), file=sink)
+
+    try:
+        return _run(ap, args, emit)
+    finally:
+        if args.out:
+            sink.close()
+
+
+def _run(ap, args, emit) -> int:
+    knob_flags_set = (args.batch_window != 0.0
+                      or args.index_scheme != "off"
+                      or args.eqclass_lru != 32
+                      or args.defrag_budget != 0
+                      or args.defrag_period != 4.0
+                      or args.scatter_util_pct != 0.0)
+    if args.engine == "python" and knob_flags_set and not args.autotune:
+        ap.error("engine knobs (--batch-window/--index-scheme/"
+                 "--eqclass-lru/--defrag-budget/--defrag-period/"
+                 "--scatter-util-pct) require --engine native")
+    if args.pin and not args.autotune:
+        ap.error("--pin re-baselines the autotune gate: it requires "
+                 "--autotune")
+
+    if args.autotune:
+        # the sweep owns its workload and fleet so the winners table —
+        # and the golden --pin writes — mean one fixed, comparable
+        # thing; flags that would silently not apply are rejected
+        for flag, default in (("pods", 400), ("arrival_rate", 2.0),
+                              ("mean_duration", 40.0),
+                              ("multi_chip_fraction", 0.15),
+                              ("high_priority_fraction", 0.0),
+                              ("nodes", 8), ("chips", 4),
+                              ("hbm", 16384), ("mesh", None),
+                              ("policy", "all"), ("preempt", "off"),
+                              ("shards", 0), ("procs", 0), ("seed", 0)):
+            if getattr(args, flag) != default:
+                ap.error(f"--{flag.replace('_', '-')} does not apply "
+                         "to --autotune (fixed sweep workload: "
+                         "tpushare/sim/autotune.py SWEEP_SPEC)")
+        if args.slice or args.defrag:
+            ap.error("--slice/--defrag do not apply to --autotune")
+        from tpushare.sim import autotune
+        from tpushare.sim.engine_loop import LoopKnobs
+        out = autotune.run_sweep()
+        if args.pin:
+            winner = out["winner"]
+            golden = autotune.pin_golden(LoopKnobs(**winner["knobs"]))
+            out["golden"] = golden
+            out["golden_path"] = autotune.GOLDEN_PATH
+        emit(out)
+        return 0
 
     if args.defrag:
         from tpushare.sim.defrag import sweep_budgets
@@ -77,7 +229,7 @@ def main(argv: list[str] | None = None) -> int:
         for report in sweep_budgets(budgets, n_nodes=args.nodes,
                                     chips=args.chips, hbm=args.hbm,
                                     mesh=mesh):
-            print(json.dumps(report))
+            emit(report)
         return 0
 
     if args.slice:
@@ -86,7 +238,7 @@ def main(argv: list[str] | None = None) -> int:
         # apply are rejected rather than ignored
         for flag, default in (("nodes", 8), ("chips", 4), ("hbm", 16384),
                               ("mesh", None), ("policy", "all"),
-                              ("preempt", "off"),
+                              ("preempt", "off"), ("engine", "python"),
                               ("high_priority_fraction", 0.0)):
             if getattr(args, flag) != default:
                 ap.error(f"--{flag.replace('_', '-')} does not apply to "
@@ -99,8 +251,20 @@ def main(argv: list[str] | None = None) -> int:
             arrival_rate=args.arrival_rate,
             mean_duration=args.mean_duration)
         for policy in ("spread", "pack"):
-            print(json.dumps(run_slice_sim(strace, policy)))
+            emit(run_slice_sim(strace, policy))
         return 0
+
+    if args.engine == "native":
+        if args.preempt != "off":
+            ap.error("--preempt applies to the python spec loop only "
+                     "(the native engine loop has no preemption model)")
+        if args.policy not in ("all", "binpack"):
+            ap.error("--engine native replays the binpack policy (the "
+                     "production engine); use --engine python for "
+                     "policy duels")
+        if args.shards:
+            ap.error("--shards does not apply to --engine native "
+                     "(sharding attribution wraps the python policies)")
 
     mesh = tuple(int(d) for d in args.mesh.split("x")) if args.mesh else None
     if mesh is not None:
@@ -112,6 +276,25 @@ def main(argv: list[str] | None = None) -> int:
             # geometry (the placement kernel falls back to a 1-D mesh)
             ap.error(f"--mesh {args.mesh} has {n} chips but --chips is "
                      f"{args.chips}")
+
+    diurnal_spec = None
+    if args.diurnal:
+        # the diurnal generator has its own tiered shape mix; flat-trace
+        # shape flags would silently not apply
+        for flag, default in (("pods", 400), ("arrival_rate", 2.0),
+                              ("mean_duration", 40.0),
+                              ("multi_chip_fraction", 0.15),
+                              ("high_priority_fraction", 0.0)):
+            if getattr(args, flag) != default:
+                ap.error(f"--{flag.replace('_', '-')} does not apply "
+                         "with --diurnal (tiered mix: "
+                         "tpushare/sim/traces.py DEFAULT_TIERS)")
+        from tpushare.sim.traces import DiurnalSpec
+        diurnal_spec = DiurnalSpec(hours=args.hours,
+                                   base_rate=args.base_rate,
+                                   peak_rate=args.peak_rate,
+                                   seed=args.seed)
+
     spec = TraceSpec(n_pods=args.pods, arrival_rate=args.arrival_rate,
                      mean_duration=args.mean_duration,
                      multi_chip_fraction=args.multi_chip_fraction,
@@ -123,11 +306,15 @@ def main(argv: list[str] | None = None) -> int:
         from tpushare.sim.procs import run_procs
         if args.shards:
             ap.error("--shards does not apply to --procs mode")
+        if args.diurnal:
+            ap.error("--diurnal does not apply to --procs mode "
+                     "(standard replay only)")
         policy = "binpack" if args.policy == "all" else args.policy
         out = run_procs({
             "nodes": args.nodes, "chips": args.chips, "hbm": args.hbm,
             "mesh": list(mesh) if mesh else None,
             "policy": policy, "preempt": args.preempt,
+            "engine": args.engine,
             "spec": {"n_pods": args.pods,
                      "arrival_rate": args.arrival_rate,
                      "mean_duration": args.mean_duration,
@@ -135,12 +322,28 @@ def main(argv: list[str] | None = None) -> int:
                      "high_priority_fraction":
                          args.high_priority_fraction,
                      "seed": args.seed}}, args.procs)
-        print(json.dumps(out))
+        emit(out)
         # a scorecard that differs across fresh interpreters is a
         # nondeterminism bug, not a tuning question: fail loudly
         return 0 if out["scorecards_identical"] else 1
 
-    trace = synth_trace(spec)
+    if diurnal_spec is not None:
+        from tpushare.sim.traces import synth_diurnal
+        trace = synth_diurnal(diurnal_spec)
+    else:
+        trace = synth_trace(spec)
+
+    if args.engine == "native":
+        from tpushare.sim.engine_loop import run_sim_native
+        fleet = Fleet.homogeneous(args.nodes, args.chips, args.hbm, mesh)
+        report, stats = run_sim_native(fleet, trace, _knobs_from(args))
+        out = report.to_json()
+        out["engine"] = "native"
+        if args.stats:
+            out["engine_stats"] = stats
+        emit(out)
+        return 0
+
     if args.shards:
         # sharding changes who HANDLES a bind, never its verdict: every
         # shard count must emit an identical scorecard. One JSON per
@@ -157,14 +360,14 @@ def main(argv: list[str] | None = None) -> int:
                                             shards=shards)
             out = report.to_json()
             out["sharding"] = stats
-            print(json.dumps(out))
+            emit(out)
         return 0
 
     policies = list(POLICIES) if args.policy == "all" else [args.policy]
     for policy in policies:
         fleet = Fleet.homogeneous(args.nodes, args.chips, args.hbm, mesh)
         report = run_sim(fleet, trace, policy, preempt=args.preempt)
-        print(json.dumps(report.to_json()))
+        emit(report.to_json())
     return 0
 
 

@@ -1,0 +1,253 @@
+"""Policy autotuning sweep: ranked search over the wind tunnel's knobs.
+
+``python -m tpushare.sim --autotune`` replays one seeded wind-tunnel
+trace under every configuration in :func:`knob_grid` (18 points over
+batch window x scatter threshold x defrag budget, with the throughput
+knobs — index scheme, eqclass LRU — cycled through so their pods/sec
+effect is visible in the table) and ranks the results by SCORECARD:
+
+    (rejection_rate, p99_pending_age_s, -time_weighted_util_pct)
+
+Admission first, latency second, packing density third — the same
+priority order the ops runbook uses to read a live fleet's scorecard.
+Wall-clock throughput (``sim_pods_per_sec``) is published per row but
+NEVER ranks: every replay is a pure function of (trace, fleet, knobs),
+so the ranking is byte-reproducible run-to-run and machine-to-machine,
+which is what lets the winner be pinned as a CI gate.
+
+The sweep parallelizes across a thread pool — the native scans release
+the GIL, and each config's replay is deterministic and independent, so
+concurrency cannot perturb the ranking.
+
+**The pinned gate** (:func:`pin_golden` / :func:`check_scorecard`): the
+winner's scorecard on the STANDARD gate trace is written to
+``tests/data/wind_tunnel_golden.json`` with per-metric tolerance bands.
+tests/test_wind_tunnel_gate.py replays the gate every tier-1 run and
+reds when the scorecard leaves the bands — protecting placement
+QUALITY, not just throughput, from regressions (a deliberate policy
+downgrade, e.g. worstfit, lands far outside the bands — the test
+proves that too). Re-baselining is an explicit act:
+``python -m tpushare.sim --autotune --pin`` (see docs/ops.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass
+
+from tpushare.metrics import LabeledCounter
+from tpushare.sim.engine_loop import LoopKnobs, run_sim_native
+from tpushare.sim.simulator import Fleet, TraceSpec, synth_trace
+from tpushare.sim.traces import DiurnalSpec, SpikeWindow, synth_diurnal
+
+SIM_AUTOTUNE_RUNS = LabeledCounter(
+    "tpushare_sim_autotune_runs_total",
+    "Autotune sweep replays by outcome (ok = scorecard produced, "
+    "error = the config's replay raised and was excluded from the "
+    "ranking — any error makes the sweep non-exhaustive, so a nonzero "
+    "rate deserves a look before trusting a winner)",
+    ("outcome",))
+
+# The standard GATE workload: a saturating replay on a small fleet —
+# heavy enough that policy quality moves every scorecard axis (the
+# binpack-vs-worstfit duel in tests/test_sim.py uses this exact
+# pressure), small enough for tier-1. The golden pins the winner's
+# scorecard HERE, so the gate is stable even when the sweep trace grows.
+GATE_TRACE = TraceSpec(n_pods=300, arrival_rate=8.0, mean_duration=60.0,
+                       multi_chip_fraction=0.3, seed=42)
+GATE_FLEET = {"nodes": 12, "chips": 4, "hbm": 16384, "mesh": (2, 2)}
+
+# The default SWEEP workload: one full diurnal period compressed into
+# two hours over a 100-node fleet, saturating at the peak plus a spike
+# window — enough pressure that batching / scatter / defrag genuinely
+# separate in the ranking (pending backlogs form at the peak), small
+# enough that 18 replays finish in well under a minute.
+SWEEP_SPEC = DiurnalSpec(hours=2.0, period=2.0, base_rate=500.0,
+                         peak_rate=1500.0, seed=7,
+                         spikes=(SpikeWindow(0.6, 0.25, 1.6),))
+SWEEP_FLEET = {"nodes": 100, "chips": 4, "hbm": 16384, "mesh": (2, 2)}
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "data",
+    "wind_tunnel_golden.json")
+
+# tolerance bands around the pinned scorecard: replays are
+# deterministic, so the bands exist to absorb INTENDED small shifts
+# (a kernel tie-break reshuffle, a trace-generator tweak) while a
+# policy-quality regression — worstfit moves utilization by tens of
+# points on the gate trace — cannot hide inside them
+DEFAULT_BANDS = {
+    "time_weighted_util_pct": 1.0,
+    "rejection_rate": 0.03,
+    "p99_pending_age_s": 3.0,
+}
+
+
+@dataclass(frozen=True)
+class SweepRow:
+    """One ranked configuration of the winners table."""
+
+    rank: int
+    config_id: int
+    knobs: LoopKnobs
+    scorecard: dict
+    sim_pods_per_sec: float        # informational ONLY — never ranks
+    pods: int
+    placed: int
+
+    def to_json(self) -> dict:
+        return {
+            "rank": self.rank,
+            "config_id": self.config_id,
+            "knobs": asdict(self.knobs),
+            "scorecard": self.scorecard,
+            "sim_pods_per_sec": round(self.sim_pods_per_sec, 1),
+            "pods": self.pods,
+            "placed": self.placed,
+        }
+
+
+def knob_grid() -> list[LoopKnobs]:
+    """The 18-point sweep: full cross of the three QUALITY knobs
+    (batch window x scatter threshold x defrag budget), with the two
+    THROUGHPUT knobs cycled so their pods/sec effect shows in the
+    table without exploding the grid (they cannot change a scorecard —
+    the engine-loop tests pin that invariance)."""
+    schemes = ("off", "pow2", "exact")
+    lrus = (32, 8, 4)
+    grid = []
+    for bw in (0.0, 0.05, 0.2):
+        for scatter in (0.0, 70.0, 90.0):
+            for budget in (0, 2):
+                i = len(grid)
+                grid.append(LoopKnobs(
+                    batch_window=bw,
+                    scatter_util_pct=scatter,
+                    defrag_budget=budget,
+                    index_scheme=schemes[i % 3],
+                    eqclass_lru=lrus[i % 3]))
+    return grid
+
+
+def _rank_key(row: tuple) -> tuple:
+    """(rejection, p99 pending age, -util, config id): admission beats
+    latency beats density; the config id makes total order explicit."""
+    cid, _knobs, card, _pps, _pods, _placed = row
+    return (card["rejection_rate"] or 0.0, card["p99_pending_age_s"],
+            -card["time_weighted_util_pct"], cid)
+
+
+def run_sweep(trace=None, fleet_spec: dict | None = None,
+              grid: list[LoopKnobs] | None = None,
+              workers: int | None = None) -> dict:
+    """Replay every grid config over the trace, rank by scorecard.
+
+    ``trace`` defaults to the diurnal SWEEP_SPEC; pass a list of
+    SimPod to sweep a custom workload (the CLI's trace flags do).
+    Returns the winners table: ``{"rows": [...], "winner": {...},
+    "errors": [...]}``.
+    """
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    if trace is None:
+        trace = synth_diurnal(SWEEP_SPEC)
+    fleet_spec = fleet_spec or SWEEP_FLEET
+    grid = grid if grid is not None else knob_grid()
+
+    def one(cid_knobs):
+        cid, knobs = cid_knobs
+        fleet = Fleet.homogeneous(
+            fleet_spec["nodes"], fleet_spec["chips"], fleet_spec["hbm"],
+            tuple(fleet_spec["mesh"]) if fleet_spec.get("mesh") else None)
+        t0 = time.perf_counter()
+        report, _stats = run_sim_native(fleet, trace, knobs)
+        wall = time.perf_counter() - t0
+        SIM_AUTOTUNE_RUNS.inc("ok")
+        return (cid, knobs, report.scorecard(),
+                report.pods / wall if wall > 0 else 0.0,
+                report.pods, report.placed)
+
+    rows, errors = [], []
+    if workers is None:
+        workers = min(4, os.cpu_count() or 1)
+    with ThreadPoolExecutor(max_workers=max(1, workers)) as pool:
+        futures = [(cid, knobs, pool.submit(one, (cid, knobs)))
+                   for cid, knobs in enumerate(grid)]
+        for cid, knobs, fut in futures:
+            try:
+                rows.append(fut.result())
+            except Exception as e:  # a broken config must not sink the sweep
+                SIM_AUTOTUNE_RUNS.inc("error")
+                errors.append({"config_id": cid, "knobs": asdict(knobs),
+                               "error": f"{type(e).__name__}: {e}"})
+    rows.sort(key=_rank_key)
+    table = [SweepRow(rank=i + 1, config_id=cid, knobs=knobs,
+                      scorecard=card, sim_pods_per_sec=pps, pods=pods,
+                      placed=placed)
+             for i, (cid, knobs, card, pps, pods, placed)
+             in enumerate(rows)]
+    return {
+        "mode": "autotune",
+        "configs": len(grid),
+        "ranked": len(table),
+        "errors": errors,
+        "rank_key": "(rejection_rate, p99_pending_age_s, -util_pct)",
+        "rows": [r.to_json() for r in table],
+        "winner": table[0].to_json() if table else None,
+    }
+
+
+# -- the pinned regression gate ----------------------------------------------
+
+def gate_scorecard(knobs: LoopKnobs) -> dict:
+    """The winner's scorecard on the STANDARD gate workload — the
+    number the golden pins and tier-1 replays."""
+    fleet = Fleet.homogeneous(GATE_FLEET["nodes"], GATE_FLEET["chips"],
+                              GATE_FLEET["hbm"], GATE_FLEET["mesh"])
+    report, _ = run_sim_native(fleet, synth_trace(GATE_TRACE), knobs)
+    return report.scorecard()
+
+
+def pin_golden(winner_knobs: LoopKnobs, path: str | None = None,
+               bands: dict | None = None) -> dict:
+    """Write the gate golden: winner knobs + their gate-trace scorecard
+    + tolerance bands. Deliberate re-baselining ONLY (docs/ops.md)."""
+    golden = {
+        "gate_trace": asdict(GATE_TRACE),
+        "gate_fleet": {k: (list(v) if isinstance(v, tuple) else v)
+                       for k, v in GATE_FLEET.items()},
+        "winner_knobs": asdict(winner_knobs),
+        "scorecard": gate_scorecard(winner_knobs),
+        "bands": dict(bands or DEFAULT_BANDS),
+    }
+    path = path or GOLDEN_PATH
+    with open(path, "w") as f:
+        json.dump(golden, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return golden
+
+
+def load_golden(path: str | None = None) -> dict:
+    with open(path or GOLDEN_PATH) as f:
+        return json.load(f)
+
+
+def check_scorecard(scorecard: dict, golden: dict) -> list[str]:
+    """Band check: empty list = inside every band; otherwise one
+    human-readable violation per metric (what the gate test prints)."""
+    out = []
+    pinned = golden["scorecard"]
+    for metric, band in golden["bands"].items():
+        want = pinned[metric]
+        got = scorecard.get(metric)
+        if want is None or got is None:
+            if got != want:
+                out.append(f"{metric}: got {got!r}, pinned {want!r}")
+            continue
+        if abs(got - want) > band:
+            out.append(f"{metric}: {got} outside pinned {want} "
+                       f"+/- {band}")
+    return out

@@ -1,0 +1,792 @@
+"""Native-engine sim hot loop: the wind tunnel's placement engine.
+
+:func:`tpushare.sim.simulator.run_sim` is the behavioral spec — every
+arrival runs ``select_chips_py`` against every node, O(pods x nodes)
+Python. That caps the simulator at policy-duel scale. This module
+replays the SAME discrete-event protocol through the production
+engine's resident :class:`~tpushare.core.native.engine.FleetArena`:
+
+- **resident arena, delta accounting**: every node is an arena entry
+  keyed by its index and stamped with a per-node mutation counter.
+  Between events only the nodes an event actually touched move their
+  stamp, so the arena re-packs exactly the mutated slots — a departure
+  on one host re-syncs one slot, not 50k.
+- **per-signature score residency**: the loop keeps one int64 score
+  vector per request signature (the :func:`tpushare.cache.batch.
+  request_signature` equivalence class — the same definition of "same
+  pod" the server's BatchPlanner coalesces on). A signature's first use
+  pays one fleet-wide ``arena.score`` call; afterwards each use
+  re-scores only the nodes mutated since (the mutation log + a
+  per-signature cursor), then the wave resolves with an argmin plus ONE
+  single-entry ABI v4 ``arena.cycle`` call that materializes the
+  winner's chips. Ties break to the lowest node index — exactly
+  ``_policy_binpack``'s first-best-wins rule — so default-knob replays
+  are decision-for-decision identical to the Python spec path and the
+  standard-trace scorecards compare byte-for-byte (the parity gate in
+  tests/test_sim_engine_loop.py).
+- **no-fit fast path**: each signature tracks how many nodes currently
+  fit; a departure wave whose pending signatures all sit at zero is
+  skipped in O(distinct signatures), which is what keeps saturated
+  spike windows from going quadratic in the backlog.
+
+The remaining knobs deliberately DIVERGE from the spec path — they are
+the policy surface ``--autotune`` sweeps (tpushare/sim/autotune.py):
+
+- ``batch_window``  — coalesce arrivals inside a sim-time window and
+  solve same-signature groups with the disjoint multi-pod semantics of
+  ``tpushare_solve_batch`` (taken chips leave the pool, untouched nodes
+  preferred — the BatchPlanner's solve, replayed offline).
+- ``index_scheme``  — a conservative max-free prune (off/pow2/exact)
+  over full and delta re-scores: certain-no-fit nodes skip the native
+  scan. Pure throughput; pruning is superset-safe so decisions never
+  change (the production capacity-index story, miniaturized).
+- ``eqclass_lru``   — how many signature score vectors stay resident;
+  an evicted signature pays a fresh fleet-wide scan on next use.
+- ``defrag_budget`` / ``defrag_period`` — run the live repack planner
+  (:func:`tpushare.defrag.planner.plan_moves`) every period with that
+  move budget, applying moves as live migrations.
+- ``scatter_util_pct`` — binpack-vs-scatter threshold: below this fleet
+  utilization, scatter-tolerant multi-chip requests are forced
+  contiguous (keep big boxes while there is room); 0 honors the
+  request as written (spec behavior).
+
+Concurrency: the loop itself is single-threaded. ``self._lock`` is the
+arena-era bookkeeping lock — it guards ONLY the signature-table
+(install/evict) and the progress counters that :meth:`EngineLoop.
+snapshot` reads, so an autotune worker's progress can be observed from
+another thread mid-run. It is never held across an arena call, a
+native scan, or any placement work (the lock-order lint classifies it
+accordingly).
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import asdict, dataclass
+import threading
+
+from tpushare.cache.batch import request_signature
+from tpushare.core.native import engine as native
+from tpushare.core.placement import PlacementRequest
+from tpushare.metrics import Counter, LabeledCounter
+from tpushare.sim.simulator import (
+    Fleet, SimPod, SimReport, _is_contiguous_box, _p99)
+
+# wind-tunnel loop telemetry (docs/observability.md catalog): counters
+# are bulk-incremented once per run — the sim is offline, what matters
+# is the totals a bench/autotune harness can diff, not per-event cost
+SIM_EVENTS = LabeledCounter(
+    "tpushare_sim_events_total",
+    "Wind-tunnel engine-loop events replayed, by kind (arrival / "
+    "departure / flush = batch-window close / defrag_pass)",
+    ("kind",))
+SIM_SCORE_REFRESHES = LabeledCounter(
+    "tpushare_sim_score_refreshes_total",
+    "Signature score-vector refreshes in the engine loop: full = "
+    "fleet-wide build (first use or post-LRU-eviction), delta = only "
+    "the nodes the mutation log marked dirty. Full growth at steady "
+    "state means the eqclass LRU is thrashing",
+    ("path",))
+SIM_PRUNED_NODES = Counter(
+    "tpushare_sim_pruned_nodes_total",
+    "Dirty or candidate nodes the engine loop's max-free index scheme "
+    "skipped as certain no-fits without a native scan (index_scheme "
+    "knob; pruning is superset-safe so decisions never change)")
+SIM_BATCH_PODS = LabeledCounter(
+    "tpushare_sim_batch_pods_total",
+    "Pods leaving a closed batch window in the engine loop, by outcome "
+    "(placed via the disjoint multi-pod solve, or pending when the "
+    "group solve ran out of fleet)",
+    ("outcome",))
+
+# score-vector sentinel for "no placement on this node": large enough
+# that a plain argmin lands on a real fit whenever one exists (real
+# scores are bounded by total fleet HBM), so the hot path needs no mask
+_NOFIT = 1 << 62
+
+# dirty sets at or below this size refresh via per-node native selects
+# (lower fixed cost than an arena gather, and every placement feeds the
+# signature's memo); larger sets go through the arena in one call
+_SELECT_THRESHOLD = 16
+
+
+@dataclass(frozen=True)
+class LoopKnobs:
+    """The autotune policy surface. Defaults are the SPEC point: every
+    knob at its default makes the loop decision-identical to run_sim."""
+
+    batch_window: float = 0.0
+    index_scheme: str = "off"        # off | pow2 | exact
+    eqclass_lru: int = 32
+    defrag_budget: int = 0
+    defrag_period: float = 4.0
+    scatter_util_pct: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.index_scheme not in ("off", "pow2", "exact"):
+            raise ValueError(f"index_scheme {self.index_scheme!r} "
+                             "not in off|pow2|exact")
+        if self.batch_window < 0 or self.eqclass_lru < 1 \
+                or self.defrag_budget < 0 or self.defrag_period <= 0:
+            raise ValueError("bad knobs")
+
+
+def _pow2_floor(v: int) -> int:
+    return 1 << (v.bit_length() - 1) if v > 0 else 0
+
+
+class _Sig:
+    """One resident request-signature: its score vector (value =
+    binpack score, _NOFIT = no placement), the count of fitting nodes,
+    the mutation-log cursor of the last refresh, and a small
+    placement memo (node -> (version, Placement)) fed by the refresh
+    scans — in steady-state packing the argmin winner is usually a
+    node the refresh just re-scored, so its placement is already
+    materialized and the wave costs no extra native call."""
+
+    __slots__ = ("req", "scores", "n_fit", "cursor", "pcache")
+
+    def __init__(self, req, scores, n_fit, cursor) -> None:
+        self.req = req
+        self.scores = scores
+        self.n_fit = n_fit
+        self.cursor = cursor
+        self.pcache: dict[int, tuple] = {}
+
+
+class EngineLoop:
+    """One wind-tunnel replay: fleet + trace + knobs -> SimReport.
+
+    Use :func:`run_sim_native` unless you need mid-run :meth:`snapshot`
+    access (the autotune progress reader).
+    """
+
+    def __init__(self, fleet: Fleet, knobs: LoopKnobs | None = None
+                 ) -> None:
+        import numpy as np
+        self._np = np
+        self.fleet = fleet
+        self.knobs = knobs or LoopKnobs()
+        n = len(fleet.nodes)
+        self._n = n
+        self._arena = native.FleetArena()
+        # per-node delta accounting: mutation counter (the arena stamp)
+        # and a lazily rebuilt ChipView snapshot, invalidated on mutation
+        self._versions = [0] * n
+        self._view_cache: list = [None] * n
+        self._log: list[int] = []        # mutation log (node indices)
+        # max-free index (the index_scheme prune) + exclusive-chip counts
+        self._maxfree = np.fromiter(
+            (nd.hbm - min(nd.used) for nd in fleet.nodes), np.int64, n)
+        self._freechips = np.fromiter(
+            (sum(1 for u in nd.used if u == 0) for nd in fleet.nodes),
+            np.int64, n)
+        # fragmentation bookkeeping: free-value histogram + lazy max-heap
+        # (run_sim recomputes fragmentation() fleet-wide per event; this
+        # maintains the same max(free)/total_free pair incrementally)
+        self._free_cnt: dict[int, int] = {}
+        self._free_heap: list[int] = []
+        self._total_hbm = fleet.total_hbm
+        self._used_total = 0
+        for nd in fleet.nodes:
+            for u in nd.used:
+                f = nd.hbm - u
+                self._free_cnt[f] = self._free_cnt.get(f, 0) + 1
+                self._used_total += u
+        for f in self._free_cnt:
+            heapq.heappush(self._free_heap, -f)
+        # signature residency (the eqclass LRU)
+        from collections import OrderedDict
+        self._sigs: "OrderedDict[tuple, _Sig]" = OrderedDict()
+        self._key_reqs: dict[tuple, PlacementRequest] = {}
+        # arena-era bookkeeping lock: signature-table install/evict and
+        # the snapshot counters ONLY — never held across an arena call
+        # or native scan (lock-order lint: engine_loop.py/self._lock)
+        self._lock = threading.Lock()
+        # run state
+        self._active: dict[int, tuple] = {}
+        self._dep_heap: list[tuple] = []
+        self._pending: list[tuple] = []
+        self._pending_keys: dict[tuple, int] = {}
+        self._stable_sigs = self.knobs.scatter_util_pct <= 0
+        self._waits: list[float] = []
+        self._hp_waits: list[float] = []
+        self._placed = 0
+        self._violations = 0
+        self._seq2 = 0
+        self._now = 0.0
+        self._last_t = 0.0
+        self._util_integral = 0.0
+        self._frag_integral = 0.0
+        self._peak = 0.0
+        self._busy_start: float | None = None
+        # per-run stats (module metrics get the totals once, at the end)
+        self._arrivals = self._departures = 0
+        self._full_builds = self._delta_refreshes = 0
+        self._rescored = self._pruned = self._sig_evictions = 0
+        self._batch_groups = self._batch_pods = 0
+        self._batch_pods_pending = 0
+        self._defrag_passes = self._defrag_moves = 0
+
+    # -- observability --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Consistent multi-counter read for a concurrent observer
+        (the autotune progress thread)."""
+        with self._lock:
+            return {"placed": self._placed,
+                    "arrivals": self._arrivals,
+                    "departures": self._departures,
+                    "pending": len(self._pending),
+                    "resident_sigs": len(self._sigs),
+                    "sim_now": self._now}
+
+    # -- node bookkeeping -----------------------------------------------------
+
+    def _views_of(self, ni: int):
+        v = self._view_cache[ni]
+        if v is None:
+            v = self.fleet.nodes[ni].views()
+            self._view_cache[ni] = v
+        return v
+
+    def _entry(self, ni: int):
+        return (ni, (0, self._versions[ni]), self._views_of(ni),
+                self.fleet.nodes[ni].topo)
+
+    def _mutate(self, ni: int, chip_ids, delta: int) -> None:
+        node = self.fleet.nodes[ni]
+        used = node.used
+        hbm = node.hbm
+        cnt = self._free_cnt
+        for cid in chip_ids:
+            old = used[cid]
+            new = old + delta
+            assert 0 <= new <= hbm, "sim oversubscription"
+            used[cid] = new
+            of, nf = hbm - old, hbm - new
+            c = cnt[of] - 1
+            if c:
+                cnt[of] = c
+            else:
+                del cnt[of]
+            if nf in cnt:
+                cnt[nf] += 1
+            else:
+                cnt[nf] = 1
+                heapq.heappush(self._free_heap, -nf)
+        self._used_total += delta * len(chip_ids)
+        self._versions[ni] += 1
+        self._view_cache[ni] = None
+        self._log.append(ni)
+        self._maxfree[ni] = hbm - min(used)
+        self._freechips[ni] = sum(1 for u in used if u == 0)
+
+    def _max_free_chip(self) -> int:
+        heap, cnt = self._free_heap, self._free_cnt
+        while heap and -heap[0] not in cnt:
+            heapq.heappop(heap)
+        return -heap[0] if heap else 0
+
+    def _advance(self, to: float) -> None:
+        dt = to - self._last_t
+        if dt > 0:
+            used = self._used_total
+            self._util_integral += used * dt
+            total_free = self._total_hbm - used
+            frag = 0.0 if total_free == 0 \
+                else 1.0 - self._max_free_chip() / total_free
+            self._frag_integral += frag * dt
+            self._peak = max(self._peak,
+                             used / self._total_hbm * 100.0)
+        self._last_t = to
+
+    # -- the index_scheme prune (superset-safe no-fit certificates) -----------
+
+    def _prune_threshold(self, req) -> int:
+        if req.hbm_mib == 0:
+            return 0
+        if self.knobs.index_scheme == "exact":
+            return req.hbm_mib
+        return _pow2_floor(req.hbm_mib)      # coarser tier: prunes less
+
+    def _pruned_node(self, ni: int, req) -> bool:
+        if self.knobs.index_scheme == "off":
+            return False
+        if req.hbm_mib == 0:
+            return int(self._freechips[ni]) < req.chip_count
+        return int(self._maxfree[ni]) < self._prune_threshold(req)
+
+    def _candidates(self, req):
+        """Full-build candidate set after pruning (node index list)."""
+        np = self._np
+        if self.knobs.index_scheme == "off":
+            return range(self._n)
+        if req.hbm_mib == 0:
+            keep = self._freechips >= req.chip_count
+        else:
+            keep = self._maxfree >= self._prune_threshold(req)
+        idxs = np.nonzero(keep)[0]
+        self._pruned += self._n - len(idxs)
+        return [int(i) for i in idxs]
+
+    # -- signature score residency --------------------------------------------
+
+    def _get_sig(self, key: tuple, req) -> _Sig:
+        sig = self._sigs.get(key)
+        if sig is not None:
+            self._sigs.move_to_end(key)
+            return sig
+        np = self._np
+        scores = np.full(self._n, _NOFIT, np.int64)
+        cursor = len(self._log)
+        cand = self._candidates(req)
+        n_fit = 0
+        sig = _Sig(req, scores, n_fit, cursor)
+        if len(cand):
+            # the whole-fleet build is ONE resident-arena cycle_fleet
+            # call: scores for every candidate plus the best entry's
+            # materialized Placement — so the wave that faulted this
+            # signature in resolves from this same call (the memo)
+            entries = [self._entry(ni) for ni in cand]
+            out = self._arena.cycle(entries, req)
+            for ni, (s, p) in zip(cand, out):
+                if s is not None:
+                    scores[ni] = s
+                    n_fit += 1
+                    if p is not None:
+                        sig.pcache[ni] = (self._versions[ni], p)
+            sig.n_fit = n_fit
+        self._key_reqs.setdefault(key, req)
+        with self._lock:
+            self._sigs[key] = sig
+            self._full_builds += 1
+            lru = self.knobs.eqclass_lru
+            while len(self._sigs) > lru:
+                self._sigs.popitem(last=False)
+                self._sig_evictions += 1
+        return sig
+
+    def _refresh(self, sig: _Sig) -> None:
+        log = self._log
+        if sig.cursor >= len(log):
+            return
+        dirty = sorted(set(log[sig.cursor:]))
+        sig.cursor = len(log)
+        scores = sig.scores
+        scan = []
+        for ni in dirty:
+            if self._pruned_node(ni, sig.req):
+                if scores[ni] != _NOFIT:
+                    sig.n_fit -= 1
+                    scores[ni] = _NOFIT
+                self._pruned += 1
+            else:
+                scan.append(ni)
+        if scan:
+            if len(scan) <= _SELECT_THRESHOLD:
+                # a handful of dirty nodes: per-node native selects are
+                # cheaper than an arena gather AND hand back every
+                # node's placement for the memo (same kernel, same
+                # scores — the arena path is the same math at scale)
+                pcache = sig.pcache
+                if len(pcache) > 64:
+                    pcache.clear()
+                for ni in scan:
+                    p = native.select_chips(
+                        self._views_of(ni), self.fleet.nodes[ni].topo,
+                        sig.req)
+                    old_fit = int(scores[ni]) != _NOFIT
+                    if p is None:
+                        scores[ni] = _NOFIT
+                        sig.n_fit -= old_fit
+                    else:
+                        scores[ni] = p.score
+                        sig.n_fit += 1 - old_fit
+                        pcache[ni] = (self._versions[ni], p)
+            else:
+                entries = [self._entry(ni) for ni in scan]
+                out = self._arena.cycle(entries, sig.req)
+                for ni, (s, p) in zip(scan, out):
+                    old_fit = int(scores[ni]) != _NOFIT
+                    new = _NOFIT if s is None else s
+                    scores[ni] = new
+                    sig.n_fit += (new != _NOFIT) - old_fit
+                    if p is not None:
+                        sig.pcache[ni] = (self._versions[ni], p)
+            self._rescored += len(scan)
+        self._delta_refreshes += 1
+
+    def _winner_placement(self, ni: int, req, sig: _Sig | None = None):
+        if sig is not None:
+            hit = sig.pcache.get(ni)
+            if hit is not None and hit[0] == self._versions[ni]:
+                return hit[1]
+        p = native.select_chips(self._views_of(ni),
+                                self.fleet.nodes[ni].topo, req)
+        assert p is not None, "cached fit vanished without a mutation"
+        if sig is not None:
+            sig.pcache[ni] = (self._versions[ni], p)
+        return p
+
+    # -- placement ------------------------------------------------------------
+
+    def _effective(self, pod: SimPod):
+        """The request as policy sees it: the scatter_util_pct knob may
+        force contiguity while the fleet still has room."""
+        req = pod.request
+        if self.knobs.scatter_util_pct > 0 and req.allow_scatter \
+                and self._used_total < self._total_hbm \
+                * self.knobs.scatter_util_pct / 100.0:
+            req = PlacementRequest(req.hbm_mib, req.chip_count,
+                                   req.topology, allow_scatter=False)
+        return request_signature(req), req
+
+    def _place(self, pod: SimPod, ni: int, p, req) -> None:
+        node = self.fleet.nodes[ni]
+        if pod.topology is not None and not (
+                p.box == pod.topology or _is_contiguous_box(
+                    node.topo, p.chip_ids, pod.topology)):
+            self._violations += 1
+        demand = req.chip_demand_mib(node.hbm)
+        self._mutate(ni, p.chip_ids, demand)
+        vid = self._seq2
+        self._seq2 += 1
+        self._active[vid] = (ni, p.chip_ids, demand, pod)
+        heapq.heappush(self._dep_heap, (self._now + pod.duration, vid))
+        self._placed += 1
+        wait = self._now - pod.arrival
+        self._waits.append(wait)
+        if pod.priority > 0:
+            self._hp_waits.append(wait)
+
+    def _try_place_now(self, pod: SimPod, key: tuple, req) -> bool:
+        sig = self._get_sig(key, req)
+        self._refresh(sig)
+        if sig.n_fit == 0:
+            return False
+        ni = int(self._np.argmin(sig.scores))
+        self._place(pod, ni, self._winner_placement(ni, req, sig), req)
+        return True
+
+    def _pend(self, pod: SimPod, req, key: tuple) -> None:
+        self._pending.append((pod, req, key))
+        if self._stable_sigs:
+            self._pending_keys[key] = self._pending_keys.get(key, 0) + 1
+
+    def _retry_pending(self) -> None:
+        """One FIFO pass over pending, exactly run_sim's departure
+        semantics — with an O(distinct signatures) skip when nothing
+        can fit anywhere (the saturated-backlog fast path)."""
+        if not self._pending:
+            return
+        if self._stable_sigs:
+            any_fit = False
+            for key in self._pending_keys:
+                sig = self._get_sig(key, self._key_reqs[key])
+                self._refresh(sig)
+                if sig.n_fit:
+                    any_fit = True
+                    break
+            if not any_fit:
+                return
+        still = []
+        for pod, req, key in self._pending:
+            if not self._stable_sigs:
+                key, req = self._effective(pod)
+            if not self._try_place_now(pod, key, req):
+                still.append((pod, req, key))
+        self._pending = still
+        if self._stable_sigs:
+            keys: dict[tuple, int] = {}
+            for _pod, _req, key in still:
+                keys[key] = keys.get(key, 0) + 1
+            self._pending_keys = keys
+
+    # -- batched waves (the BatchPlanner's solve, replayed offline) -----------
+
+    def _solve_excluding(self, ni: int, req, taken: set):
+        views = [v.with_healthy(False) if v.idx in taken else v
+                 for v in self._views_of(ni)]
+        return native.select_chips(views, self.fleet.nodes[ni].topo, req)
+
+    def _solve_group(self, key: tuple, req, k: int) -> list:
+        """k chip-disjoint placements for one signature group — the
+        semantics of ``tpushare_solve_batch`` (taken chips leave the
+        pool entirely; untouched nodes preferred over ANY touched
+        node's score; ties to the lowest node index), computed against
+        the resident score vector instead of a fresh fleet marshal."""
+        np = self._np
+        sig = self._get_sig(key, req)
+        self._refresh(sig)
+        scores = sig.scores
+        out = []
+        taken: dict[int, set] = {}
+        touched: dict[int, object] = {}
+        saved: dict[int, int] = {}
+        for _ in range(k):
+            ni = int(np.argmin(scores))
+            if scores[ni] != _NOFIT:             # best untouched node
+                p = self._winner_placement(ni, req, sig)
+                saved[ni] = int(scores[ni])
+                scores[ni] = _NOFIT              # mask: now touched
+            else:                                # only touched nodes left
+                best = None
+                for ti, tp in touched.items():
+                    if tp is not None and (best is None
+                                           or (tp.score, ti) < best[:2]):
+                        best = (tp.score, ti, tp)
+                if best is None:
+                    break
+                ni, p = best[1], best[2]
+            out.append((ni, p))
+            taken.setdefault(ni, set()).update(p.chip_ids)
+            touched[ni] = self._solve_excluding(ni, req, taken[ni])
+        for ni, s in saved.items():
+            if scores[ni] == _NOFIT:             # restore masked reality
+                scores[ni] = s
+        return out
+
+    def _flush(self, buf: list) -> None:
+        groups: dict[tuple, list] = {}
+        order: list[tuple] = []
+        for pod in buf:
+            key, req = self._effective(pod)
+            g = groups.get(key)
+            if g is None:
+                groups[key] = g = [req]
+                order.append(key)
+            g.append(pod)
+        for key in order:
+            req, *members = groups[key]
+            if len(members) == 1:
+                if not self._try_place_now(members[0], key, req):
+                    self._pend(members[0], req, key)
+                continue
+            self._batch_groups += 1
+            placements = self._solve_group(key, req, len(members))
+            for i, pod in enumerate(members):
+                if i < len(placements):
+                    self._place(pod, placements[i][0],
+                                placements[i][1], req)
+                    self._batch_pods += 1
+                else:
+                    self._pend(pod, req, key)
+                    self._batch_pods_pending += 1
+
+    # -- defrag passes (the live repack planner, applied as migrations) -------
+
+    def _defrag_pass(self) -> None:
+        from tpushare.defrag.planner import NodeState, Victim, plan_moves
+        victims: dict[int, list] = {}
+        for vid, (ni, chips, demand, pod) in self._active.items():
+            victims.setdefault(ni, []).append(Victim(
+                pod_key=str(vid), chip_ids=chips, per_chip_mib=demand,
+                request=pod.request))
+        states = [NodeState(
+            name=nd.name, stamp=(0, self._versions[ni]), topo=nd.topo,
+            hbm_per_chip=nd.hbm, views=self._views_of(ni),
+            victims=victims.get(ni, []))
+            for ni, nd in enumerate(self.fleet.nodes)]
+        by_name = {nd.name: ni for ni, nd in enumerate(self.fleet.nodes)}
+        np = self._np
+
+        def solve(req, exclude, claimed):
+            key = request_signature(req)
+            sig = self._get_sig(key, req)
+            self._refresh(sig)
+            scores = sig.scores
+            masked = sorted({by_name[n] for n in exclude}
+                            | {by_name[n] for n in claimed})
+            saved = scores[masked].copy() if masked else None
+            if masked:
+                scores[masked] = _NOFIT
+            ni = int(np.argmin(scores))
+            s = int(scores[ni])
+            if masked:
+                scores[masked] = saved
+            best = (s, ni, None) if s != _NOFIT else None
+            for name, chips in claimed.items():
+                ci = by_name[name]
+                if name in exclude:
+                    continue
+                views = [v.with_used(v.total_hbm_mib)
+                         if v.idx in chips else v
+                         for v in self._views_of(ci)]
+                p = native.select_chips(views,
+                                        self.fleet.nodes[ci].topo, req)
+                if p is not None and (best is None
+                                      or (p.score, ci) < best[:2]):
+                    best = (p.score, ci, p)
+            if best is None:
+                return None
+            s, ni, p = best
+            if p is None:
+                p = self._winner_placement(ni, req, sig)
+            return (self.fleet.nodes[ni].name, p,
+                    (0, self._versions[ni]))
+
+        plan = plan_moves(states, solve, self.knobs.defrag_budget,
+                          per_node=self.knobs.defrag_budget)
+        self._defrag_passes += 1
+        for m in plan.moves:
+            vid = int(m.pod_key)
+            entry = self._active.get(vid)
+            if entry is None:
+                continue
+            ni, chips, demand, pod = entry
+            self._mutate(ni, chips, -demand)
+            tni = by_name[m.target]
+            self._mutate(tni, m.placement.chip_ids, demand)
+            # live migration: the departure event keys into _active, so
+            # the pod simply departs from its NEW chips at its old time
+            self._active[vid] = (tni, m.placement.chip_ids, demand, pod)
+            self._defrag_moves += 1
+
+    # -- the event loop -------------------------------------------------------
+
+    def run(self, trace) -> SimReport:
+        """Replay ``trace`` (list or arrival-ordered iterator of
+        SimPod). Event ordering is run_sim's exactly: departures before
+        arrivals at equal times, departures by placement order, trace
+        order among simultaneous arrivals — so default-knob replays
+        yield byte-identical scorecards."""
+        INF = float("inf")
+        if isinstance(trace, list):
+            trace = sorted(trace, key=lambda p: p.arrival)
+        arrivals = iter(trace)
+        nxt = next(arrivals, None)
+        dep = self._dep_heap
+        window = self.knobs.batch_window
+        buf: list[SimPod] = []
+        flush_at = INF
+        defrag_on = self.knobs.defrag_budget > 0
+        next_defrag = self.knobs.defrag_period if defrag_on else INF
+        pods = 0
+        flushes = 0
+        while nxt is not None or dep or buf:
+            ta = nxt.arrival if nxt is not None else INF
+            td = dep[0][0] if dep else INF
+            tf = flush_at if buf else INF
+            # defrag is a maintenance tick, not workload: it only fires
+            # while real events remain, so a drained sim terminates
+            tdf = next_defrag if defrag_on and (nxt is not None or dep) \
+                else INF
+            t = min(ta, td, tf, tdf)
+            if tf <= t:                    # close the batch window
+                self._advance(tf)
+                self._now = tf
+                if self._busy_start is None:
+                    self._busy_start = tf
+                batch, buf, flush_at = buf, [], INF
+                self._flush(batch)
+                flushes += 1
+                continue
+            if tdf <= t:                   # defrag tick
+                self._advance(tdf)
+                self._now = tdf
+                next_defrag += self.knobs.defrag_period
+                self._defrag_pass()
+                continue
+            if td <= t:                    # departure (wins arrival ties)
+                _, vid = heapq.heappop(dep)
+                self._advance(td)
+                self._now = td
+                if self._busy_start is None:
+                    self._busy_start = td
+                ni, chip_ids, demand, _pod = self._active.pop(vid)
+                self._mutate(ni, chip_ids, -demand)
+                self._departures += 1
+                self._retry_pending()
+                continue
+            # arrival
+            self._advance(ta)
+            self._now = ta
+            if self._busy_start is None:
+                self._busy_start = ta
+            pods += 1
+            self._arrivals += 1
+            if window > 0:
+                if not buf:
+                    flush_at = ta + window
+                buf.append(nxt)
+            else:
+                key, req = self._effective(nxt)
+                if not self._try_place_now(nxt, key, req):
+                    self._pend(nxt, req, key)
+            nxt = next(arrivals, None)
+
+        # telemetry lands once per run (the sim is offline: totals, not
+        # per-event increments, are what observers diff)
+        SIM_EVENTS.inc("arrival", n=self._arrivals)
+        SIM_EVENTS.inc("departure", n=self._departures)
+        if flushes:
+            SIM_EVENTS.inc("flush", n=flushes)
+        if self._defrag_passes:
+            SIM_EVENTS.inc("defrag_pass", n=self._defrag_passes)
+        if self._full_builds:
+            SIM_SCORE_REFRESHES.inc("full", n=self._full_builds)
+        if self._delta_refreshes:
+            SIM_SCORE_REFRESHES.inc("delta", n=self._delta_refreshes)
+        if self._pruned:
+            SIM_PRUNED_NODES.inc(self._pruned)
+        if self._batch_pods:
+            SIM_BATCH_PODS.inc("placed", n=self._batch_pods)
+        if self._batch_pods_pending:
+            SIM_BATCH_PODS.inc("pending", n=self._batch_pods_pending)
+
+        waits = self._waits
+        hp = self._hp_waits
+        span = max(self._last_t - (self._busy_start or 0.0), 1e-9)
+        return SimReport(
+            policy="binpack",
+            pods=pods,
+            placed=self._placed,
+            never_placed=len(self._pending),
+            mean_wait=sum(waits) / len(waits) if waits else 0.0,
+            p99_wait=_p99(waits),
+            util_pct=self._util_integral / (self._total_hbm * span)
+            * 100.0,
+            peak_util_pct=self._peak,
+            frag_time_weighted=self._frag_integral / span,
+            makespan=span,
+            contig_violations=self._violations,
+            hp_mean_wait=sum(hp) / len(hp) if hp else 0.0,
+            hp_p99_wait=_p99(hp),
+            waits=waits,
+        )
+
+    def stats(self) -> dict:
+        """Engine-loop internals for bench/autotune output (NOT part of
+        the scorecard — never feeds a ranking)."""
+        return {
+            "engine": "native" if native.available()
+            else "python-fallback",
+            "arrivals": self._arrivals,
+            "departures": self._departures,
+            "full_builds": self._full_builds,
+            "delta_refreshes": self._delta_refreshes,
+            "rescored_nodes": self._rescored,
+            "pruned_nodes": self._pruned,
+            "resident_sigs": len(self._sigs),
+            "sig_evictions": self._sig_evictions,
+            "batch_groups": self._batch_groups,
+            "batch_pods_placed": self._batch_pods,
+            "batch_pods_pending": self._batch_pods_pending,
+            "defrag_passes": self._defrag_passes,
+            "defrag_moves": self._defrag_moves,
+            "knobs": asdict(self.knobs),
+            "arena": self._arena.describe(),
+        }
+
+
+def run_sim_native(fleet: Fleet, trace,
+                   knobs: LoopKnobs | None = None
+                   ) -> tuple[SimReport, dict]:
+    """The wind tunnel's entry point: replay ``trace`` over ``fleet``
+    through the native engine loop. Returns (report, stats) — the
+    report is scorecard-compatible with :func:`run_sim` and, at default
+    knobs, byte-identical to it."""
+    loop = EngineLoop(fleet, knobs)
+    report = loop.run(trace)
+    return report, loop.stats()

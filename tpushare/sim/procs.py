@@ -30,7 +30,14 @@ import time
 
 def replay_once(payload: dict) -> str:
     """One full standard replay, rendered as canonical JSON (sorted
-    keys) so byte-comparison across processes is meaningful."""
+    keys) so byte-comparison across processes is meaningful.
+
+    ``payload["engine"]`` selects the placement loop: ``"python"`` (the
+    spec path, default — absent key keeps old payloads working) or
+    ``"native"`` (the engine loop, tpushare/sim/engine_loop.py). The
+    determinism claim is per-engine: N native workers must agree with
+    each other byte-for-byte, and — because default-knob native replays
+    are decision-identical to the spec — with the python arm too."""
     from tpushare.sim.simulator import (
         Fleet, TraceSpec, run_sim, synth_trace)
     spec = TraceSpec(**payload["spec"])
@@ -38,8 +45,12 @@ def replay_once(payload: dict) -> str:
     mesh = tuple(payload["mesh"]) if payload.get("mesh") else None
     fleet = Fleet.homogeneous(payload["nodes"], payload["chips"],
                               payload["hbm"], mesh)
-    report = run_sim(fleet, trace, payload["policy"],
-                     preempt=payload.get("preempt", "off"))
+    if payload.get("engine", "python") == "native":
+        from tpushare.sim.engine_loop import run_sim_native
+        report, _stats = run_sim_native(fleet, trace)
+    else:
+        report = run_sim(fleet, trace, payload["policy"],
+                         preempt=payload.get("preempt", "off"))
     return json.dumps(report.to_json(), sort_keys=True)
 
 
@@ -62,6 +73,7 @@ def run_procs(payload: dict, n_procs: int) -> dict:
     agg_rate = n_procs * pods / wall if wall else 0.0
     return {
         "mode": "procs",
+        "engine": payload.get("engine", "python"),
         "procs": n_procs,
         "pods_per_proc": pods,
         "cores": cores,

@@ -259,10 +259,14 @@ class SimReport:
         }
 
     def to_json(self) -> dict:
+        # key order is sorted, NOT dataclass-declaration order: the
+        # --procs determinism proof and the autotune ranking both
+        # compare serialized reports byte-for-byte, so the ordering is
+        # part of the contract (tests/test_sim.py pins it)
         out = {k: (round(v, 4) if isinstance(v, float) else v)
                for k, v in self.__dict__.items() if k != "waits"}
         out["scorecard"] = self.scorecard()
-        return out
+        return {k: out[k] for k in sorted(out)}
 
 
 def run_sim(fleet: Fleet, trace: list[SimPod],

@@ -1,0 +1,181 @@
+"""Diurnal wind-tunnel traces: multi-hour arrival mixes at fleet scale.
+
+The standard :func:`tpushare.sim.simulator.synth_trace` draws a flat
+Poisson arrival process — fine for policy duels on a dozen hosts,
+useless for the capacity questions ROADMAP item 4 asks ("what does MY
+workload mix do to a 50k-node fleet across a business day?"). This
+module synthesizes that day:
+
+- **diurnal arrival rate**: a seeded inhomogeneous Poisson process whose
+  rate follows a sinusoid between ``base_rate`` (trough, t=0) and
+  ``peak_rate`` (peak, half a period later), sampled by thinning — the
+  textbook exact method: propose at the ceiling rate, accept with
+  probability rate(t)/ceiling, so the empirical arrival count over any
+  window converges to the rate integral (tests/test_sim_traces.py
+  checks exactly that).
+- **spike windows**: multiplicative bursts (a failover, a launch, a
+  batch-job wave) on top of the sinusoid, landing exactly where
+  configured.
+- **tiered pod shapes**: a weighted mix of request tiers (single-chip
+  HBM slices through exclusive topology-pinned quads), each with its
+  own mean duration — churn differs per tier, as it does in real
+  fleets (inference replicas cycle fast, training jobs squat).
+
+Everything is a pure function of the spec (``random.Random(seed)``,
+no wall clock), so traces are byte-reproducible across processes —
+the property the autotune ranking and the ``--procs`` determinism
+proof both sit on. :func:`iter_diurnal` streams pods in arrival order
+so a million-pod trace never has to be resident (the engine loop
+consumes the iterator directly); :func:`synth_diurnal` materializes a
+list for the parity/oracle paths.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from tpushare.sim.simulator import Fleet, SimPod
+
+# default period of the diurnal sinusoid, in trace time units ("hours")
+DAY = 24.0
+
+
+@dataclass(frozen=True)
+class PodTier:
+    """One shape class in the workload mix. ``weight`` is relative;
+    ``mean_duration`` is this tier's churn knob (expovariate holding
+    time, same distribution the flat-trace generator uses)."""
+
+    name: str
+    weight: float
+    hbm_mib: int
+    chip_count: int = 1
+    topology: tuple[int, ...] | None = None
+    mean_duration: float = 1.0
+    priority: int = 0
+
+
+@dataclass(frozen=True)
+class SpikeWindow:
+    """Multiplicative arrival burst over [start, start + duration)."""
+
+    start: float
+    duration: float
+    multiplier: float
+
+    def active(self, t: float) -> bool:
+        return self.start <= t < self.start + self.duration
+
+
+# The default mix: mostly single-chip inference slices with fast churn,
+# a long tail of topology-pinned training quads that squat. Weights and
+# sizes are v5e-flavored (16 GiB chips); tests pin the proportions.
+DEFAULT_TIERS: tuple[PodTier, ...] = (
+    PodTier("s1-2g", 0.45, 2048, mean_duration=0.5),
+    PodTier("s1-4g", 0.25, 4096, mean_duration=1.0),
+    PodTier("s1-8g", 0.12, 8192, mean_duration=2.0),
+    PodTier("pair-4g", 0.08, 4096, chip_count=2, mean_duration=1.5),
+    PodTier("quad-2x2", 0.07, 4096, chip_count=4, topology=(2, 2),
+            mean_duration=3.0),
+    PodTier("excl-2x2", 0.03, 0, chip_count=4, topology=(2, 2),
+            mean_duration=4.0),
+)
+
+
+@dataclass(frozen=True)
+class DiurnalSpec:
+    """Knobs of one wind-tunnel day (or several). Rates are arrivals
+    per time unit; the sinusoid troughs at t=0 and peaks at DAY/2."""
+
+    hours: float = 24.0
+    base_rate: float = 40.0
+    peak_rate: float = 160.0
+    tiers: tuple[PodTier, ...] = DEFAULT_TIERS
+    spikes: tuple[SpikeWindow, ...] = ()
+    seed: int = 0
+    period: float = DAY
+
+    def __post_init__(self) -> None:
+        if self.hours <= 0 or self.base_rate < 0 \
+                or self.peak_rate < self.base_rate:
+            raise ValueError("bad diurnal spec (hours > 0, "
+                             "0 <= base_rate <= peak_rate)")
+        if not self.tiers or any(t.weight <= 0 for t in self.tiers):
+            raise ValueError("tiers must be non-empty with "
+                             "positive weights")
+
+
+def rate_at(spec: DiurnalSpec, t: float) -> float:
+    """Instantaneous arrival rate at trace time ``t`` — the spec the
+    thinning sampler realizes and the integral test integrates."""
+    lam = spec.base_rate + (spec.peak_rate - spec.base_rate) * 0.5 * (
+        1.0 - math.cos(2.0 * math.pi * t / spec.period))
+    for s in spec.spikes:
+        if s.active(t):
+            lam *= s.multiplier
+    return lam
+
+
+def expected_arrivals(spec: DiurnalSpec, t0: float = 0.0,
+                      t1: float | None = None, steps: int = 4096) -> float:
+    """Numeric integral of :func:`rate_at` over [t0, t1] (midpoint
+    rule): the expected arrival count the trace realizes in that
+    window, up to Poisson noise."""
+    if t1 is None:
+        t1 = spec.hours
+    dt = (t1 - t0) / steps
+    return sum(rate_at(spec, t0 + (i + 0.5) * dt)
+               for i in range(steps)) * dt
+
+
+def iter_diurnal(spec: DiurnalSpec) -> Iterator[SimPod]:
+    """Stream the trace in arrival order (thinning sampler). Pure
+    function of the spec; a million-pod day never lives in memory."""
+    rng = random.Random(spec.seed)
+    ceiling = spec.peak_rate * max(
+        [1.0] + [s.multiplier for s in spec.spikes if s.multiplier > 1.0])
+    if ceiling <= 0:
+        return
+    weights = [t.weight for t in spec.tiers]
+    cum = []
+    acc = 0.0
+    for w in weights:
+        acc += w
+        cum.append(acc)
+    total_w = acc
+    t = 0.0
+    while True:
+        t += rng.expovariate(ceiling)
+        if t >= spec.hours:
+            return
+        # thinning: accept proposals at the instantaneous/ceiling ratio
+        if rng.random() * ceiling >= rate_at(spec, t):
+            continue
+        r = rng.random() * total_w
+        tier = spec.tiers[-1]
+        for i, c in enumerate(cum):
+            if r < c:
+                tier = spec.tiers[i]
+                break
+        duration = rng.expovariate(1.0 / tier.mean_duration)
+        yield SimPod(arrival=t, duration=duration, hbm_mib=tier.hbm_mib,
+                     chip_count=tier.chip_count, topology=tier.topology,
+                     priority=tier.priority)
+
+
+def synth_diurnal(spec: DiurnalSpec) -> list[SimPod]:
+    """Materialized form of :func:`iter_diurnal` for the oracle paths
+    (run_sim wants a list; parity tests replay both engines over the
+    same object)."""
+    return list(iter_diurnal(spec))
+
+
+def synth_fleet(n_nodes: int, chips: int = 4, hbm: int = 16384,
+                mesh: tuple[int, ...] | None = (2, 2)) -> Fleet:
+    """Fleet synthesis to wind-tunnel scale. Thin veneer over
+    Fleet.homogeneous, named so call sites read as what they are —
+    bench.py builds 50k-node fleets through this."""
+    return Fleet.homogeneous(n_nodes, chips, hbm, mesh)
