@@ -3170,6 +3170,27 @@ def main() -> int:
            f"{wt['diurnal_50k']['arena']['nodes']} nodes, "
            f"{wt['diurnal_50k']['arena']['slot_updates']} slot updates)")
 
+    # fault-domain wind tunnel (ISSUE 13): the hermetic chaos drill —
+    # two full replica stacks over one FakeCluster, a conductor
+    # replaying the seeded fault schedule (replica SIGKILL + cold
+    # restart, apiserver brownout, node partitions, chip degradation)
+    # while a bind storm runs, a continuous apiserver-truth sampler,
+    # and the crash-restart reconciler healing every half-bound orphan
+    from tpushare.chaos import assert_drill_invariants, run_hermetic_drill
+    drill = run_hermetic_drill(seed=1234)
+    try:
+        assert_drill_invariants(drill)
+        drill_failure = ""
+    except AssertionError as e:
+        drill_failure = str(e)
+    expect(not drill_failure,
+           f"chaos drill: all {drill['placed']}/{drill['n_pods']} pods "
+           f"bound under the seeded storm with 0 oversubscription over "
+           f"{drill['samples']} truth samples, 0 drift after heal, and "
+           f"every orphan reconciled within "
+           f"{drill['window_bound_s']:.1f}s "
+           f"({drill_failure or 'all self-checks passed'})")
+
     # bind latency with real apiserver round-trips (stub apiserver wire)
     wire = wire_latency()
     expect(wire["p50"] < 50.0,
@@ -3358,6 +3379,26 @@ def main() -> int:
             # native-loop A/B on the standard trace (byte-identical)
             # and the 50k-node diurnal leg with the 1M-pod projection
             "wind_tunnel": wt,
+            # fault-domain wind tunnel (ISSUE 13): the hermetic chaos
+            # drill's verdict — fault mix applied, recovery
+            # adopt/GC attribution, orphan-recovery window vs bound,
+            # and the continuous oversubscription/drift audit
+            "chaos": {
+                "placed": drill["placed"],
+                "n_pods": drill["n_pods"],
+                "truth_samples": drill["samples"],
+                "faults_applied": drill["faults_applied"],
+                "recovery": drill["recovery"],
+                "recovery_window_s": round(drill["recovery_window_s"],
+                                           3),
+                "window_bound_s": drill["window_bound_s"],
+                "max_pending_age_s": round(drill["max_pending_age_s"],
+                                           3),
+                "oversubscription_instants":
+                    len(drill["oversubscription"]),
+                "drift_after_heal": len(drill["drift"]),
+                "half_bound_left": len(drill["half_bound_left"]),
+            },
         },
         "wire": {
             "note": "stub apiserver loopback: real HTTP wire format incl. "

@@ -1,0 +1,357 @@
+"""The chaos conductor (ISSUE 13 tentpole b): one seeded fault schedule,
+three consumers. tests/test_sim_faults.py proves the two sim engines
+replay it byte-identically; this file proves the REAL stack survives it
+— first hermetically (in-process fleet over a FakeCluster, tier-1),
+then end-to-end (slow: real extender processes killed and restarted
+against the wire-format stub apiserver), with the same invariants
+monitored continuously: zero chip oversubscription on apiserver truth
+at every sampled instant, zero residual drift after healing, bounded
+recovery of every half-bound orphan."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpushare.chaos import (
+    ChaosConductor,
+    assert_drill_invariants,
+    run_hermetic_drill,
+)
+from tpushare.sim import FaultEvent, FaultSpec, synth_faults
+
+
+# -- conductor dispatch + pacing (no fleet) ------------------------------------
+
+
+class _Recorder:
+    def __init__(self):
+        self.calls = []
+
+    def node_down(self, node, lose_pods):
+        self.calls.append(("node_down", node, lose_pods))
+
+    def node_up(self, node):
+        self.calls.append(("node_up", node))
+
+    def brownout_start(self):
+        self.calls.append(("brownout_start",))
+
+    def brownout_end(self):
+        self.calls.append(("brownout_end",))
+
+    def replica_crash(self, replica):
+        raise RuntimeError("the crash crashed")  # conductor must survive
+
+
+def test_conductor_dispatches_in_order_with_compressed_pacing():
+    clock = [0.0]
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(round(s, 6))
+        clock[0] += s
+
+    rec = _Recorder()
+    cond = ChaosConductor(rec, seconds_per_unit=0.1,
+                          clock=lambda: clock[0], sleep=fake_sleep)
+    applied = cond.run([
+        FaultEvent(time=1.0, kind="node_down", node=2, lose_pods=True),
+        FaultEvent(time=3.0, kind="brownout_start"),
+        FaultEvent(time=5.0, kind="brownout_end"),
+        FaultEvent(time=5.0, kind="node_up", node=2),
+        # no 'degrade' method on the target -> skipped, not an error
+        FaultEvent(time=6.0, kind="degrade", node=1, chips=(0,)),
+        # the action raises -> logged + skipped, the storm goes on
+        FaultEvent(time=7.0, kind="replica_crash", replica=0),
+    ])
+    assert rec.calls == [("node_down", 2, True), ("brownout_start",),
+                         ("brownout_end",), ("node_up", 2)]
+    # each event waits to its compressed offset (0.1 s per sim unit);
+    # skipped events are still paced (the schedule's clock is shared)
+    assert sleeps == [0.1, 0.2, 0.2, 0.1, 0.1]
+    assert applied == {"node_down": 1, "brownout_start": 1,
+                       "brownout_end": 1, "node_up": 1, "skipped": 2}
+
+
+def test_conductor_rejects_nonpositive_time_scale():
+    with pytest.raises(ValueError):
+        ChaosConductor(_Recorder(), seconds_per_unit=0.0)
+
+
+def test_synth_schedule_drives_the_conductor_end_to_end():
+    """The generator and the conductor speak the same language: every
+    kind synth_faults emits dispatches without a skip on a full target."""
+
+    class _Full(_Recorder):
+        def degrade(self, node, chips):
+            self.calls.append(("degrade", node, chips))
+
+        def replica_crash(self, replica):
+            self.calls.append(("replica_crash", replica))
+
+        def replica_restart(self, replica):
+            self.calls.append(("replica_restart", replica))
+
+    schedule = synth_faults(FaultSpec(
+        hours=10.0, n_nodes=4, chips_per_node=4, node_crashes=1,
+        notready_windows=1, degradations=1, brownouts=1,
+        replica_crashes=1, replicas=2, mean_outage=2.0, seed=9))
+    rec = _Full()
+    clock = [0.0]
+
+    def fake_sleep(s):
+        clock[0] += s
+
+    applied = ChaosConductor(rec, seconds_per_unit=0.01,
+                             clock=lambda: clock[0],
+                             sleep=fake_sleep).run(schedule)
+    assert applied.pop("skipped") == 0
+    assert sum(applied.values()) == len(schedule) == len(rec.calls)
+
+
+# -- the hermetic drill (tier-1) -----------------------------------------------
+
+
+def test_hermetic_drill_survives_the_seeded_storm():
+    """The whole in-process fleet — two replicas, claim CAS, informers,
+    recovery heartbeat — under the seeded schedule: crash, restart,
+    brownout, partition, degrade. Every invariant, every interleaving."""
+    assert_drill_invariants(run_hermetic_drill(seed=1234))
+
+
+@pytest.mark.slow
+def test_hermetic_drill_many_seeds():
+    for seed in (7, 42, 20260805):
+        assert_drill_invariants(run_hermetic_drill(seed=seed))
+
+
+# -- (slow) the real-fleet conductor run ---------------------------------------
+
+
+def _post(url, body, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.mark.slow
+def test_real_fleet_conductor_kill_restart_brownout(tmp_path):
+    """The acceptance run: >=2 real extender processes against the stub
+    apiserver; the conductor replays a seeded schedule that kills and
+    RESTARTS a replica, severs watches + browns out the apiserver, and
+    partitions a node — while a driver storms pods through whichever
+    replica answers. Ends with zero chip oversubscription at every
+    sampled instant, every placement bound, and the ring reconverged."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from tests.test_ha_storm import (
+        assert_apiserver_invariants, seed_pod, wait_until)
+    from tpushare.chaos.invariants import InvariantMonitor
+    from tpushare.k8s.incluster import InClusterClient
+    from tpushare.k8s.stubapi import StubApiServer
+
+    GIB = 1024
+    stub = StubApiServer().start()
+    node_names = [f"e{i}" for i in range(6)]
+    for n in node_names:
+        stub.seed("nodes", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": n,
+                         "labels": {"tpushare": "true",
+                                    "tpushare.aliyun.com/mesh": "2x2"}},
+            "status": {"capacity": {
+                "aliyun.com/tpu-hbm": str(4 * 16 * GIB),
+                "aliyun.com/tpu-count": "4"}}})
+    env = dict(os.environ,
+               TPUSHARE_SHARD_REPLICAS="2",
+               TPUSHARE_SHARD_LEASE_S="1.5",
+               TPUSHARE_SHARD_RENEW_S="0.2",
+               TPUSHARE_RESYNC_S="0.5",
+               TPUSHARE_RECOVERY_STALE_S="1.0",
+               TPUSHARE_FLEETWATCH="0", TPUSHARE_DEFRAG="0",
+               JAX_PLATFORMS="cpu")
+
+    procs: list = [None, None]
+    bases: list = [None, None]
+
+    def spawn(i):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tpushare.extender",
+             "--apiserver", stub.base_url,
+             "--host", "127.0.0.1", "--port", "0"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        deadline = time.monotonic() + 60
+        line = ""
+        while time.monotonic() < deadline:
+            line = p.stdout.readline()
+            if "ready on" in line:
+                break
+        assert "ready on" in line, f"replica {i} never came up"
+        procs[i] = p
+        bases[i] = "http://" + line.rsplit("on ", 1)[1].strip()
+
+    class ProcessFleet:
+        """Conductor target over real OS processes + the stub's chaos
+        primitives. 'degrade' is unimplemented at this fidelity —
+        the conductor counts it skipped."""
+
+        def node_down(self, idx, lose_pods):
+            stub.partition(node_names[idx % len(node_names)])
+
+        def node_up(self, idx):
+            stub.heal(node_names[idx % len(node_names)])
+
+        def brownout_start(self):
+            stub.break_watches()  # >=1 watch break, by construction
+            for n in node_names:
+                stub.partition(n)
+
+        def brownout_end(self):
+            stub.heal()
+
+        def replica_crash(self, idx):
+            i = idx % 2
+            if procs[i] is not None and procs[i].poll() is None and \
+                    (procs[1 - i] is not None
+                     and procs[1 - i].poll() is None):
+                procs[i].kill()  # SIGKILL: no abdication, no cleanup
+
+        def replica_restart(self, idx):
+            i = idx % 2
+            if procs[i] is not None and procs[i].poll() is not None:
+                spawn(i)  # cold start: build_cache + recovery pass
+
+    try:
+        for i in range(2):
+            spawn(i)
+
+        def ring(base):
+            with urllib.request.urlopen(f"{base}/inspect/ring",
+                                        timeout=5) as r:
+                return json.loads(r.read())
+
+        assert wait_until(
+            lambda: all(len(ring(b).get("members", [])) == 2
+                        for b in bases), timeout=30)
+
+        client = InClusterClient(base_url=stub.base_url, timeout=10.0)
+        monitor = InvariantMonitor(client.list_pods, 16 * GIB,
+                                   interval_s=0.05).start()
+
+        # a schedule with exactly the acceptance ingredients: one node
+        # NotReady window, one brownout (watch sever + node 503s), one
+        # replica SIGKILL + cold restart
+        schedule = synth_faults(FaultSpec(
+            hours=16.0, n_nodes=len(node_names), chips_per_node=4,
+            node_crashes=1, notready_windows=0, degradations=0,
+            brownouts=1, replica_crashes=1, replicas=2,
+            mean_outage=3.0, seed=5))
+        conductor = ChaosConductor(ProcessFleet(), seconds_per_unit=0.4)
+        applied: dict = {}
+        storm = threading.Thread(
+            target=lambda: applied.update(conductor.run(schedule)),
+            daemon=True)
+        storm.start()
+
+        pods = [seed_pod(stub, f"cx-{i}", 2 * GIB) for i in range(20)]
+        bound: dict = {}
+
+        def drive(pod, attempts=60):
+            meta = pod["metadata"]
+            for a in range(attempts):
+                live = [b for i, b in enumerate(bases)
+                        if procs[i] is not None
+                        and procs[i].poll() is None]
+                if not live:
+                    time.sleep(0.2)
+                    continue
+                base = live[a % len(live)]
+                try:
+                    _, flt = _post(f"{base}/tpushare-scheduler/filter",
+                                   {"Pod": pod, "NodeNames": node_names},
+                                   timeout=5)
+                    ok = flt.get("NodeNames") or []
+                    if ok:
+                        status, res = _post(
+                            f"{base}/tpushare-scheduler/bind", {
+                                "PodName": meta["name"],
+                                "PodNamespace": meta["namespace"],
+                                "PodUID": meta.get("uid", ""),
+                                "Node": ok[a % len(ok)]}, timeout=5)
+                        if status == 200 and not res.get("Error"):
+                            return ok[a % len(ok)]
+                except OSError:
+                    pass
+                time.sleep(0.1)
+            return None
+
+        for pod in pods:  # the storm rages while these bind
+            node = drive(pod)
+            if node:
+                bound[pod["metadata"]["name"]] = node
+        storm.join(timeout=60)
+        assert applied.get("replica_crash", 0) >= 1, applied
+        assert applied.get("replica_restart", 0) >= 1, applied
+        assert applied.get("brownout_start", 0) >= 1, applied
+
+        # healing: everything lifted, both replicas up, ring reconverges
+        # within the lease TTL
+        stub.heal()
+        for i in range(2):
+            if procs[i].poll() is not None:
+                spawn(i)
+        assert wait_until(
+            lambda: all(len(ring(b).get("members", [])) == 2
+                        for b in bases), timeout=30)
+        # stragglers bind against the healthy fleet; the recovery
+        # heartbeat (TPUSHARE_RECOVERY_STALE_S=1, TPUSHARE_RESYNC_S=0.5)
+        # adopts-or-GCs anything a dead incarnation half-bound
+        for pod in pods:
+            if pod["metadata"]["name"] not in bound:
+                node = drive(pod)
+                if node:
+                    bound[pod["metadata"]["name"]] = node
+        assert len(bound) == 20, f"only {len(bound)}/20 ever bound"
+
+        # half-bound orphans must evaporate within the bounded window
+        def half_bound():
+            from tpushare import contract
+            out = []
+            for pod in client.list_pods():
+                if contract.is_complete_pod(pod) or \
+                        (pod.get("spec") or {}).get("nodeName"):
+                    continue
+                if contract.chip_ids_from_annotations(pod) is not None:
+                    out.append(pod["metadata"]["name"])
+            return out
+
+        assert wait_until(lambda: not half_bound(), timeout=10), \
+            f"half-bound orphans survived: {half_bound()}"
+
+        verdict = monitor.stop()
+        assert verdict["samples"] > 10
+        assert not verdict["oversubscription"], \
+            verdict["oversubscription"][:3]
+        # the acceptance audit on final apiserver truth
+        per_chip = assert_apiserver_invariants(stub, client)
+        assert sum(per_chip.values()) > 0
+    finally:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p is not None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        stub.stop()

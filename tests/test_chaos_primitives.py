@@ -1,0 +1,172 @@
+"""Chaos primitives (ISSUE 13 satellite): ``break_watches()`` and
+node-scoped ``partition()`` on both hermetic backends (FakeCluster and
+the wire-format StubApiServer), plus the direct informer proof — a
+stream severed mid-storm heals by relist (tpushare_informer_relists_total
+rises) and the lister ends byte-equal to cluster truth: no drift."""
+
+import time
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare.k8s import FakeCluster
+from tpushare.k8s.client import ApiError
+from tpushare.k8s.incluster import InClusterClient
+from tpushare.k8s.informer import INFORMER_RELISTS, Informer
+from tpushare.k8s.stubapi import StubApiServer
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class _NoJitter:
+    """Deterministic zero-backoff rng for the informer under test."""
+
+    @staticmethod
+    def uniform(_a, _b):
+        return 0.0
+
+
+# -- FakeCluster primitives ----------------------------------------------------
+
+
+def test_break_watches_counts_and_severs_live_streams():
+    fc = FakeCluster()
+    assert fc.break_watches() == 0  # no streams, nothing severed
+    informer = Informer(fc, rng=_NoJitter())
+    informer.start()
+    try:
+        assert wait_until(lambda: sum(
+            len(qs) for qs in fc._watchers.values()) == 2)
+        assert fc.break_watches() == 2  # pods + nodes
+    finally:
+        informer.stop()
+
+
+def test_partition_gates_node_verbs_and_heals():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    fc.add_tpu_node("n2", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    fc.create_pod(make_pod(hbm=1000, name="p1"))
+    fc.partition("n1")
+    for op in (lambda: fc.get_node("n1"),
+               lambda: fc.patch_node("n1", {"metadata": {}}),
+               lambda: fc.bind_pod("default", "p1", "n1")):
+        with pytest.raises(ApiError) as ei:
+            op()
+        assert ei.value.status == 503
+    # the partition is node-scoped: the rest of the fleet is reachable
+    assert fc.get_node("n2")["metadata"]["name"] == "n2"
+    fc.bind_pod("default", "p1", "n2")
+    fc.heal("n1")
+    assert fc.get_node("n1")["metadata"]["name"] == "n1"
+
+
+def test_heal_all_clears_every_partition():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=1, hbm_per_chip_mib=100)
+    fc.add_tpu_node("n2", chips=1, hbm_per_chip_mib=100)
+    fc.partition("n1")
+    fc.partition("n2")
+    fc.heal()
+    assert {n["metadata"]["name"] for n in (fc.get_node("n1"),
+                                            fc.get_node("n2"))} == \
+        {"n1", "n2"}
+
+
+# -- the informer sever proof (the satellite's point) --------------------------
+
+
+def test_informer_sever_mid_storm_relists_and_converges():
+    """Sever the watch streams while pods are landing: events queued
+    behind the sever are LOST (the k8s watch API does not replay gaps),
+    so only the backoff->relist path can re-ground the store. The
+    relist counter must rise and the lister must end exactly equal to
+    cluster truth."""
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    informer = Informer(fc, rng=_NoJitter())
+    informer.start()
+    before = (INFORMER_RELISTS.get("pods"), INFORMER_RELISTS.get("nodes"))
+    try:
+        fc.create_pod(make_pod(hbm=1000, name="pre", node="n1"))
+        assert wait_until(lambda: informer.pods.get("default", "pre"))
+        assert fc.break_watches() == 2
+        # the storm keeps going while the streams are down: these events
+        # race the sever and may be lost — relist is the only guarantee
+        fc.add_tpu_node("n2", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+        for i in range(8):
+            fc.create_pod(make_pod(hbm=500, name=f"mid{i}", node="n1"))
+        fc.delete_pod("default", "pre")
+        assert wait_until(lambda: INFORMER_RELISTS.get("pods") > before[0]
+                          and INFORMER_RELISTS.get("nodes") > before[1])
+        # convergence: the lister matches apiserver truth exactly
+        truth = {(p["metadata"]["namespace"], p["metadata"]["name"])
+                 for p in fc.list_pods()}
+        assert wait_until(lambda: len(informer.pods) == len(truth) and all(
+            informer.pods.get(ns, n) is not None for ns, n in truth))
+        assert informer.pods.get("default", "pre") is None  # no drift
+        assert set(informer.nodes.names()) == {"n1", "n2"}
+        # the severed pods index healed too (on_node is the bind path)
+        assert len(informer.pods.on_node("n1")) == 8
+    finally:
+        informer.stop()
+
+
+# -- StubApiServer parity over the real wire -----------------------------------
+
+
+@pytest.fixture
+def stub():
+    s = StubApiServer().start()
+    yield s
+    s.stop()
+
+
+def test_stub_partition_gates_node_verbs_over_the_wire(stub):
+    from tests.test_contract import make_node
+    client = InClusterClient(base_url=stub.base_url, timeout=5.0)
+    stub.seed("nodes", make_node("n1", hbm=64000, count=4))
+    stub.seed("nodes", make_node("n2", hbm=64000, count=4))
+    stub.seed("pods", make_pod(hbm=1000, name="p1", uid="u1"))
+    stub.partition("n1")
+    for op in (lambda: client.get_node("n1"),
+               lambda: client.patch_node("n1", {"metadata": {
+                   "labels": {"x": "y"}}}),
+               lambda: client.bind_pod("default", "p1", "n1", uid="u1")):
+        with pytest.raises(ApiError) as ei:
+            op()
+        assert ei.value.status == 503
+    assert client.get_node("n2")["metadata"]["name"] == "n2"
+    stub.heal("n1")
+    assert client.get_node("n1")["metadata"]["name"] == "n1"
+    client.bind_pod("default", "p1", "n1", uid="u1")
+    assert stub.get("pods", "default/p1")["spec"]["nodeName"] == "n1"
+
+
+def test_stub_break_watches_severs_then_stream_heals(stub):
+    """break_watches() is the FakeCluster-parity verb: live streams are
+    reset, the client reconnects, and post-sever events still arrive."""
+    import threading
+
+    client = InClusterClient(base_url=stub.base_url, timeout=5.0)
+    events = []
+    stop = threading.Event()
+    t = threading.Thread(
+        target=lambda: events.extend(client.watch_pods(stop)), daemon=True)
+    t.start()
+    try:
+        assert wait_until(lambda: stub.watch_count() > 0)
+        assert stub.break_watches() == 1
+        stub.seed("pods", make_pod(name="after-sever"))
+        assert wait_until(lambda: any(
+            e.object["metadata"]["name"] == "after-sever" for e in events))
+    finally:
+        stop.set()
+        t.join(timeout=5)

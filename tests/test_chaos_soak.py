@@ -22,9 +22,12 @@ variant runs multiple rolling brownout waves for several seconds
 (``pytest -m slow``).
 """
 
+import json
+import os
 import random
 import threading
 import time
+import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
 import pytest
@@ -53,10 +56,24 @@ POD_WRITE_VERBS = ("patch_pod", "bind_pod", "replace_pod")
 LOGICAL_WRITES_PER_ATTEMPT = 3
 
 
+def _post_json(url: str, body: dict, timeout: float) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
 def run_soak(*, seed: int, storm_s: float, n_pods: int, n_nodes: int = 3,
              threads: int = 8, deadline_s: float = 1.0,
-             waves: int = 1) -> dict:
-    """One soak run; returns its telemetry for the variant's assertions."""
+             waves: int = 1, via_http: bool = False) -> dict:
+    """One soak run; returns its telemetry for the variant's assertions.
+
+    ``via_http=True`` (ISSUE 13 satellite) reruns the same storm through
+    the real HTTP front end: an :class:`ExtenderServer` over the same
+    hardened cluster, every filter/bind a real POST — so the selector
+    event-loop server (the ``TPUSHARE_SERVER`` default, PR 11) sits
+    inside the brownout blast radius instead of being bypassed."""
     fc = FakeCluster()
     names = [f"n{i}" for i in range(n_nodes)]
     for n in names:
@@ -74,8 +91,57 @@ def run_soak(*, seed: int, storm_s: float, n_pods: int, n_nodes: int = 3,
     ctl.build_cache()
     ctl.start()
     registry = Registry()
-    fil = FilterHandler(cache, registry, breaker=breaker)
-    binder = BindHandler(cache, cluster, registry, breaker=breaker)
+    server = None
+    if via_http:
+        from tpushare.extender.server import ExtenderServer
+
+        # pin TPUSHARE_SERVER to its default (the selector front end is
+        # what this variant exists to storm) and keep the background
+        # auditors out of the hermetic rig
+        saved = {k: os.environ.pop(k, None)
+                 for k in ("TPUSHARE_SERVER", "TPUSHARE_FLEETWATCH",
+                           "TPUSHARE_DEFRAG")}
+        os.environ["TPUSHARE_FLEETWATCH"] = "0"
+        os.environ["TPUSHARE_DEFRAG"] = "0"
+        try:
+            server = ExtenderServer(cache, cluster, registry,
+                                    host="127.0.0.1", port=0,
+                                    breaker=breaker,
+                                    request_deadline_s=deadline_s)
+            port = server.start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        base = f"http://127.0.0.1:{port}/tpushare-scheduler"
+        http_timeout = deadline_s + 10.0
+
+        class _HttpFilter:
+            """Same .handle() surface as FilterHandler, over the wire.
+            A transport failure is an empty verdict — the storm loop
+            retries, exactly as it does for a degraded direct serve."""
+
+            def handle(self, args):
+                try:
+                    body = _post_json(base + "/filter", args, http_timeout)
+                except OSError:
+                    return {"NodeNames": []}
+                return {"NodeNames": body.get("NodeNames") or []}
+
+        class _HttpBind:
+            def handle(self, args):
+                try:
+                    body = _post_json(base + "/bind", args, http_timeout)
+                except OSError as e:
+                    return {"Error": f"http transport: {e}"}
+                return {"Error": body.get("Error") or ""}
+
+        fil, binder = _HttpFilter(), _HttpBind()
+    else:
+        fil = FilterHandler(cache, registry, breaker=breaker)
+        binder = BindHandler(cache, cluster, registry, breaker=breaker)
 
     # -- the storm: rolling brownout + 429s + latency + watch drops ----------
     wave_s = storm_s / waves
@@ -218,6 +284,8 @@ def run_soak(*, seed: int, storm_s: float, n_pods: int, n_nodes: int = 3,
     finally:
         stop.set()
         sampler_t.join(timeout=2)
+        if server is not None:
+            server.stop()
 
     # -- post-storm healing: GC + resync, then audit -------------------------
     from tests.test_fault_containment import _plugin_for
@@ -276,6 +344,7 @@ def run_soak(*, seed: int, storm_s: float, n_pods: int, n_nodes: int = 3,
         "injected": dict(chaos.injected),
         "used_total": tree["used_hbm_mib"],
         "live_bound": live_bound,
+        "front_end": type(server._httpd).__name__ if server else None,
     }
 
 
@@ -303,6 +372,17 @@ def test_chaos_soak_fast_deterministic():
     """Tier-1 variant: one short brownout wave, fixed seed."""
     _assert_invariants(run_soak(seed=1234, storm_s=1.0, n_pods=16,
                                 threads=6))
+
+
+def test_chaos_soak_through_http_front_end():
+    """ISSUE 13 satellite: the same storm, but every filter/bind is a
+    real POST through the selector event-loop front end (the
+    ``TPUSHARE_SERVER`` default, PR 11) — the HTTP layer is inside the
+    brownout blast radius, and the invariants must hold unchanged."""
+    r = run_soak(seed=4321, storm_s=1.0, n_pods=12, threads=6,
+                 via_http=True)
+    _assert_invariants(r)
+    assert r["front_end"] == "SelectorHTTPServer", r["front_end"]
 
 
 @pytest.mark.slow

@@ -165,6 +165,67 @@ def test_dead_peer_fails_fast_into_local_cas(duo):
     assert dc["spillover"] == 4 and dc["cas_lost"] == 0, dc
 
 
+def test_peer_breaker_recovers_when_dead_peer_comes_back(duo):
+    """The other half of the dead-peer story (ISSUE 13 satellite): the
+    per-peer breaker must not stay latched once the peer heals. rb's
+    server dies, three binds trip ra's breaker into the local-CAS
+    fallback; rb restarts ON THE SAME PORT (same PeerPool key, same
+    breaker instance); after the reset timeout the half-open probe rides
+    the next bind, succeeds, closes the breaker, and forwarding resumes."""
+    from tpushare.ha.forward import ForwardRouter as _FR
+    from tpushare.k8s.peer import PeerPool
+
+    fc, reps = duo
+    ra, rb = reps["ra"], reps["rb"]
+    # a tight reset so the half-open probe happens inside the test; the
+    # knobs are the point — production keeps the 2 s default
+    ra.server.forwarder = _FR(
+        ra.sm, pool=PeerPool(failure_threshold=3, reset_timeout_s=0.3),
+        enabled=True)
+    node = _node_owned_by(reps, "rb")
+    rb_port = int(rb.base.rsplit(":", 1)[1])
+
+    rb.server.stop()  # the peer dies; its lease (ring entry) lingers
+    f0, c0 = forwards(), conflicts()
+    for i in range(3):
+        pod = fc.create_pod(make_pod(hbm=1000, name=f"fw-rec-{i}"))
+        status, result = post(
+            f"{ra.base}/tpushare-scheduler/bind", {
+                "PodName": f"fw-rec-{i}", "PodNamespace": "default",
+                "PodUID": pod["metadata"]["uid"], "Node": node})
+        assert status == 200 and not result.get("Error"), (i, result)
+    df, dc = delta(f0, forwards()), delta(c0, conflicts())
+    assert df["peer_failed"] == 3 and df["forwarded"] == 0, df
+    assert dc["spillover"] == 3, dc
+
+    # rb comes back on the SAME port — the address book never changed,
+    # so recovery is purely the breaker's half-open -> closed transition
+    rb.server = ExtenderServer(rb.cache, fc, host="127.0.0.1",
+                               port=rb_port, sharding=rb.sm)
+    assert rb.server.start() == rb_port
+    time.sleep(0.35)  # past reset_timeout_s: breaker arms a probe
+    f0, c0 = forwards(), conflicts()
+    for i in range(3):
+        pod = fc.create_pod(make_pod(hbm=1000, name=f"fw-back-{i}"))
+        status, result = post(
+            f"{ra.base}/tpushare-scheduler/bind", {
+                "PodName": f"fw-back-{i}", "PodNamespace": "default",
+                "PodUID": pod["metadata"]["uid"], "Node": node})
+        assert status == 200 and not result.get("Error"), (i, result)
+        assert fc.get_pod("default", f"fw-back-{i}") \
+            ["spec"]["nodeName"] == node
+    df, dc = delta(f0, forwards()), delta(c0, conflicts())
+    # all three forwarded (the first was the successful probe) and the
+    # owner served them — no residual fallback on ra's side
+    assert df["forwarded"] == 3 and df["served"] == 3, df
+    assert df["peer_failed"] == 0 and df["loop_fallback"] == 0, df
+    # rb's first bind stays on the claim CAS: ra's fallback binds during
+    # the outage moved the node's generation stamp, so handover
+    # revalidation re-arms once before promoting back to lock-free
+    assert dc["spillover"] == 1 and dc["owned"] == 2, dc
+    assert dc["cas_lost"] == 0, dc
+
+
 def test_filter_stays_local_unless_cycle_forwarding_opted_in(duo):
     fc, reps = duo
     ra = reps["ra"]

@@ -33,6 +33,7 @@ SCOPES = (
     os.path.join(ROOT, "tpushare", "ha"),
     os.path.join(ROOT, "tpushare", "extender"),
     os.path.join(ROOT, "tpushare", "sim"),
+    os.path.join(ROOT, "tpushare", "chaos"),
 )
 
 # (file basename, with-expression prefix) -> rank. Nested acquisitions
@@ -83,6 +84,11 @@ RANKS = {
     ("controller.py", "self._seen_lock"): 6,
     ("controller.py", "self._queue._lock"): 7,
     ("workqueue.py", "self._lock"): 7,      # the same Condition object
+    # chaos (ISSUE 13): the invariant monitor's sample-counter lock —
+    # pure bookkeeping (violation list, pending ages), NEVER held across
+    # a cluster list or any cache call; leftmost like the other
+    # bookkeeping locks so a future monitor-under-cache nesting red-lines
+    ("invariants.py", "self._lock"): 8,
 }
 
 _LOCKISH = re.compile(r"(?:^|[._])(?:[a-z_]*lock[a-z_]*)(?:$|\()|for_key\(")

@@ -1,0 +1,210 @@
+"""Crash-restart reconciliation (controller/recovery.py): a replica
+dying in the patch->bind gap leaves half-bound pods — placement
+annotations stamped by a dead incarnation, never bound. The reconciler
+must adopt what the dead incarnation DID bind and GC what it only
+half-bound, within a bounded window, with every action attributed via
+tpushare_recovery_{adopted,gc}_total{kind}."""
+
+import time
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.controller import Controller, reconcile_once
+from tpushare.controller.recovery import RECOVERY_ADOPTED, RECOVERY_GC
+from tpushare.k8s import FakeCluster
+from tpushare.k8s.client import ApiError
+
+S = 1_000_000_000  # ns per second
+
+
+@pytest.fixture
+def rig():
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=4, hbm_per_chip_mib=16000, mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    return fc, cache
+
+
+def half_bound(fc, name="orphan", stamp_ns=1_000 * S, chips=(0, 1),
+               hbm=4000, extra_ann=None):
+    """A pod as a crashed replica leaves it: placement annotations
+    patched (per-attempt assume-time stamp included), bind never ran."""
+    ann = contract.placement_annotations(list(chips), hbm, 16000,
+                                         now_ns=stamp_ns)
+    ann.update(extra_ann or {})
+    return fc.create_pod(make_pod(hbm=hbm, name=name, ann=ann))
+
+
+class _Hooked:
+    """Cluster wrapper that lets one verb misbehave mid-reconcile —
+    the races a real fleet produces between LIST and the CAS."""
+
+    def __init__(self, inner, **hooks):
+        self._inner = inner
+        self._hooks = hooks
+
+    def __getattr__(self, name):
+        if name in self._hooks:
+            return self._hooks[name]
+        return getattr(self._inner, name)
+
+
+# -- GC: the half-bound orphan ------------------------------------------------
+
+def test_half_bound_pod_is_gcd_after_window(rig):
+    fc, cache = rig
+    half_bound(fc, stamp_ns=1_000 * S)
+    before = RECOVERY_GC.get("half_bound")
+    out = reconcile_once(fc, cache, now_ns=1_100 * S, stale_after_s=15.0)
+    assert out == {"adopted": 0, "gc": 1}
+    assert RECOVERY_GC.get("half_bound") == before + 1
+    fresh = fc.get_pod("default", "orphan")
+    assert contract.chip_ids_from_annotations(fresh) is None
+    assert contract.assume_time_from_annotations(fresh) == 0
+    # nothing ever entered the cache: the chips are free for real
+    assert cache.get_node_info("n1").describe()["used_hbm_mib"] == 0
+
+
+def test_half_bound_pod_inside_window_untouched(rig):
+    """The bounded grace: a stamp younger than stale_after_s is a LIVE
+    allocate mid-flight — the reconciler must not race it."""
+    fc, cache = rig
+    half_bound(fc, stamp_ns=1_000 * S)
+    out = reconcile_once(fc, cache, now_ns=1_010 * S, stale_after_s=15.0)
+    assert out == {"adopted": 0, "gc": 0}
+    fresh = fc.get_pod("default", "orphan")
+    assert contract.chip_ids_from_annotations(fresh) == (0, 1)
+
+
+def test_unstamped_half_bound_is_gcd_as_malformed(rig):
+    fc, cache = rig
+    ann = contract.placement_annotations([2], 4000, 16000, now_ns=1)
+    del ann[contract.ANN_ASSUME_TIME]
+    fc.create_pod(make_pod(hbm=4000, name="unstamped", ann=ann))
+    before = RECOVERY_GC.get("unstamped")
+    out = reconcile_once(fc, cache, now_ns=1_000 * S)
+    assert out["gc"] == 1
+    assert RECOVERY_GC.get("unstamped") == before + 1
+
+
+def test_assigned_pod_is_never_reclaimed(rig):
+    """assigned=true means the device plugin granted real chips — a
+    missing nodeName then is NOT the reconciler's call to undo."""
+    fc, cache = rig
+    half_bound(fc, stamp_ns=1_000 * S,
+               extra_ann={contract.ANN_ASSIGNED: "true"})
+    out = reconcile_once(fc, cache, now_ns=2_000 * S)
+    assert out == {"adopted": 0, "gc": 0}
+    fresh = fc.get_pod("default", "orphan")
+    assert contract.chip_ids_from_annotations(fresh) == (0, 1)
+
+
+# -- adoption: what the dead incarnation DID finish ---------------------------
+
+def test_bound_pod_unknown_to_cache_is_adopted(rig):
+    """A pod bound by a dead replica AFTER our build_cache replay: the
+    watch gap means only reconciliation can account it."""
+    fc, cache = rig
+    ann = contract.placement_annotations([3], 4000, 16000, now_ns=1)
+    pod = fc.create_pod(make_pod(hbm=4000, name="ghost", phase="Running",
+                                 node="n1", ann=ann))
+    before = RECOVERY_ADOPTED.get("bound")
+    out = reconcile_once(fc, cache, now_ns=1_000 * S)
+    assert out == {"adopted": 1, "gc": 0}
+    assert RECOVERY_ADOPTED.get("bound") == before + 1
+    assert cache.known_pod(pod["metadata"]["uid"])
+    assert cache.get_node_info("n1").describe()["used_hbm_mib"] == 4000
+    # idempotent: the second pass finds nothing to do
+    assert reconcile_once(fc, cache, now_ns=1_000 * S) == \
+        {"adopted": 0, "gc": 0}
+
+
+def test_late_bind_mid_reconcile_is_adopted_not_gcd(rig):
+    """The bind lands between our LIST and the re-read: the fresh GET
+    shows a nodeName, so the pod is adopted — reclaim would have
+    orphaned a live placement."""
+    fc, cache = rig
+    pod = half_bound(fc, stamp_ns=1_000 * S)
+
+    def get_pod(ns, name):
+        cur = fc.get_pod(ns, name)
+        if not cur["spec"].get("nodeName"):
+            fc.bind_pod(ns, name, "n1")
+            cur = fc.get_pod(ns, name)
+        return cur
+
+    before = RECOVERY_ADOPTED.get("late_bind")
+    out = reconcile_once(_Hooked(fc, get_pod=get_pod), cache,
+                         now_ns=2_000 * S)
+    assert out == {"adopted": 1, "gc": 0}
+    assert RECOVERY_ADOPTED.get("late_bind") == before + 1
+    assert cache.known_pod(pod["metadata"]["uid"])
+    fresh = fc.get_pod("default", "orphan")
+    assert contract.chip_ids_from_annotations(fresh) == (0, 1)
+
+
+def test_restamped_pod_is_a_live_replacement(rig):
+    """A live replica re-placed the pod (new assume-time stamp) between
+    LIST and GET: the stale stamp we judged no longer exists, so the
+    pass must leave the new placement alone."""
+    fc, cache = rig
+    half_bound(fc, stamp_ns=1_000 * S)
+    fc.patch_pod("default", "orphan", contract.placement_patch(
+        contract.placement_annotations([2, 3], 4000, 16000,
+                                       now_ns=1_999 * S)))
+    snapshot = fc.get_pod("default", "orphan")
+
+    def get_pod(ns, name):
+        return snapshot  # the re-read sees the re-stamped pod
+
+    out = reconcile_once(_Hooked(fc, get_pod=get_pod), cache,
+                         now_ns=2_000 * S)
+    assert out["gc"] == 0
+
+
+def test_gc_cas_race_loses_safely(rig):
+    """replace_pod 409s (a concurrent mutation won): the placement
+    stands, nothing is counted, the pass does not die."""
+    fc, cache = rig
+    half_bound(fc, stamp_ns=1_000 * S)
+
+    def replace_pod(ns, name, body):
+        raise ApiError(409, "lost the race")
+
+    before = RECOVERY_GC.get("half_bound")
+    out = reconcile_once(_Hooked(fc, replace_pod=replace_pod), cache,
+                         now_ns=2_000 * S)
+    assert out == {"adopted": 0, "gc": 0}
+    assert RECOVERY_GC.get("half_bound") == before
+    fresh = fc.get_pod("default", "orphan")
+    assert contract.chip_ids_from_annotations(fresh) == (0, 1)
+
+
+def test_list_failure_skips_the_pass(rig):
+    fc, cache = rig
+
+    def list_pods():
+        raise ApiError(503, "brownout")
+
+    out = reconcile_once(_Hooked(fc, list_pods=list_pods), cache)
+    assert out == {"adopted": 0, "gc": 0}
+
+
+# -- the bounded window, end to end -------------------------------------------
+
+def test_recovery_window_is_bounded_by_the_resync_heartbeat(rig):
+    """Wired as a resync hook (extender/__main__.py does exactly this),
+    a half-bound orphan survives at most stale_after_s + one heartbeat:
+    drive one heartbeat and watch it heal."""
+    fc, cache = rig
+    ctl = Controller(fc, cache)
+    ctl.resync_hooks.append(lambda: reconcile_once(
+        fc, cache, stale_after_s=0.05))
+    half_bound(fc, stamp_ns=time.time_ns() - S)  # stamped 1 s ago
+    ctl.resync_once()
+    fresh = fc.get_pod("default", "orphan")
+    assert contract.chip_ids_from_annotations(fresh) is None

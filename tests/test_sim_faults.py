@@ -1,0 +1,210 @@
+"""Fault-domain wind tunnel (ISSUE 13 tentpole a): the seeded fault
+schedule is a first-class trace input, and BOTH sim engines — the
+python spec path (run_sim) and the native engine loop — must produce
+byte-identical reports under the identical schedule, extending the
+PR-12 determinism proof into the faulted regime."""
+
+import json
+
+import pytest
+
+from tpushare.sim import (
+    FaultEvent, FaultSpec, Fleet, LoopKnobs, TraceSpec, run_sim,
+    run_sim_native, synth_faults, synth_trace)
+
+
+def _fleet(nodes=8):
+    return Fleet.homogeneous(nodes, 4, 16384, (2, 2))
+
+
+def _trace(seed=0, **kw):
+    base = dict(n_pods=300, arrival_rate=4.0, mean_duration=30.0,
+                multi_chip_fraction=0.3, seed=seed)
+    base.update(kw)
+    return synth_trace(TraceSpec(**base))
+
+
+def _faults(seed=3, **kw):
+    base = dict(hours=70.0, n_nodes=8, chips_per_node=4,
+                node_crashes=2, notready_windows=1, degradations=1,
+                brownouts=1, replica_crashes=1, mean_outage=6.0,
+                seed=seed)
+    base.update(kw)
+    return synth_faults(FaultSpec(**base))
+
+
+def _canon(report):
+    return json.dumps(report.to_json(), sort_keys=True)
+
+
+# -- the schedule itself ------------------------------------------------------
+
+def test_synth_faults_is_deterministic_and_sorted():
+    a = _faults(11)
+    b = _faults(11)
+    assert a == b
+    assert a != _faults(12)
+    assert all(x.time <= y.time for x, y in zip(a, a[1:]))
+    kinds = {e.kind for e in a}
+    assert {"node_down", "node_up", "degrade", "brownout_start",
+            "brownout_end", "replica_crash", "replica_restart"} <= kinds
+
+
+def test_fault_windows_are_paired_and_clamped():
+    evs = _faults(7, node_crashes=3, notready_windows=2, brownouts=2,
+                  replica_crashes=2)
+    downs = sum(1 for e in evs if e.kind == "node_down")
+    ups = sum(1 for e in evs if e.kind == "node_up")
+    assert downs == ups == 5
+    assert sum(1 for e in evs if e.kind == "brownout_start") == \
+        sum(1 for e in evs if e.kind == "brownout_end") == 2
+    assert all(0.0 <= e.time <= 70.0 for e in evs)
+
+
+def test_fault_event_validation():
+    with pytest.raises(ValueError):
+        FaultEvent(time=1.0, kind="meteor_strike")
+    with pytest.raises(ValueError):
+        FaultEvent(time=-1.0, kind="node_down")
+    with pytest.raises(ValueError):
+        FaultSpec(hours=0.0)
+    with pytest.raises(ValueError):
+        FaultSpec(node_crashes=-1)
+
+
+# -- engine parity under faults (the tentpole claim) --------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 7])
+def test_faulted_scorecard_byte_identical_to_spec(seed):
+    """The whole point: the native loop replays the spec decisions
+    through crashes, NotReady windows, degradations and brownouts —
+    the full report is byte-identical, not merely close."""
+    trace = _trace(seed)
+    faults = _faults(seed + 100)
+    spec = run_sim(_fleet(), trace, "binpack", faults=faults)
+    native, _ = run_sim_native(_fleet(), trace, faults=faults)
+    assert spec.faults_applied == len(faults)
+    assert _canon(spec) == _canon(native)
+
+
+def test_faulted_parity_under_saturation():
+    """Small fleet + hot trace + pod-killing crashes: the pending queue
+    and the restart churn are both busy, and parity must still hold."""
+    trace = _trace(42, n_pods=300, arrival_rate=8.0, mean_duration=60.0)
+    faults = _faults(9, n_nodes=3, node_crashes=3, mean_outage=10.0)
+    spec = run_sim(_fleet(3), trace, "binpack", faults=faults)
+    native, _ = run_sim_native(_fleet(3), trace, faults=faults)
+    assert spec.fault_lost_pods > 0      # crashes actually killed pods
+    assert spec.mean_wait > 0            # the pressure is real
+    assert _canon(spec) == _canon(native)
+
+
+def test_throughput_knobs_stay_invariant_under_faults():
+    """index_scheme/eqclass_lru remain pure throughput knobs in the
+    faulted regime: the max-free prune stays a conservative
+    OVERestimate on downed/degraded nodes, so decisions never move."""
+    trace = _trace(3)
+    faults = _faults(5)
+    base, _ = run_sim_native(_fleet(), trace, faults=faults)
+    for knobs in (LoopKnobs(index_scheme="pow2"),
+                  LoopKnobs(index_scheme="exact"),
+                  LoopKnobs(eqclass_lru=1)):
+        tuned, _ = run_sim_native(_fleet(), trace, knobs, faults=faults)
+        assert _canon(base) == _canon(tuned)
+
+
+def test_no_fault_schedule_is_the_identity():
+    """faults=None and faults=[] replay exactly the pre-fault code
+    path — the pinned no-fault golden cannot move."""
+    trace = _trace(1)
+    plain = run_sim(_fleet(), trace, "binpack")
+    empty = run_sim(_fleet(), trace, "binpack", faults=[])
+    assert plain.faults_applied == 0 and plain.fault_lost_pods == 0
+    assert _canon(plain) == _canon(empty)
+    native, _ = run_sim_native(_fleet(), trace, faults=None)
+    assert _canon(plain) == _canon(native)
+
+
+# -- fault semantics ----------------------------------------------------------
+
+def test_node_crash_kills_and_restarts_pods():
+    """One node, one crash window mid-trace: running pods die, restart
+    from pending after the node returns, and nothing oversubscribes."""
+    trace = [
+        # two pods that will be running when the node dies at t=5
+        *({"arrival": 1.0 + i, "duration": 100.0, "hbm_mib": 4096}
+          for i in range(2)),
+    ]
+    from tpushare.sim.simulator import SimPod
+    trace = [SimPod(**p) for p in trace]
+    faults = [FaultEvent(time=5.0, kind="node_down", node=0,
+                         lose_pods=True),
+              FaultEvent(time=10.0, kind="node_up", node=0)]
+    r = run_sim(_fleet(1), trace, "binpack", faults=faults)
+    assert r.fault_lost_pods == 2
+    # killed pods restarted after node_up: placed counts re-placements
+    assert r.placed == 4 and r.never_placed == 0
+    # the restart waits key to the ORIGINAL arrival (crash cost is in
+    # the wait tail): the survivors waited (10 - arrival) = 9 and 8
+    assert r.p99_wait >= 8.0
+    assert abs(r.mean_wait - (9.0 + 8.0) / 4) < 1e-6
+    native, _ = run_sim_native(_fleet(1), trace, faults=faults)
+    assert _canon(r) == _canon(native)
+
+
+def test_notready_window_blocks_placement_but_keeps_pods():
+    trace = [_mk(1.0, 50.0), _mk(6.0, 5.0)]
+    faults = [FaultEvent(time=5.0, kind="node_down", node=0),
+              FaultEvent(time=20.0, kind="node_up", node=0)]
+    r = run_sim(_fleet(1), trace, "binpack", faults=faults)
+    assert r.fault_lost_pods == 0        # NotReady: pod 1 survives
+    assert r.placed == 2
+    # pod 2 arrived during the window and had to wait for node_up:
+    # waits are 0 and 14, so the mean is 7
+    assert abs(r.mean_wait - 7.0) < 1e-6
+    native, _ = run_sim_native(_fleet(1), trace, faults=faults)
+    assert _canon(r) == _canon(native)
+
+
+def test_degrade_shrinks_the_chip_set_permanently():
+    """Degrading every chip of a 1-node fleet strands all later
+    arrivals; an exclusive-chip pod can never land on a degraded chip."""
+    trace = [_mk(10.0, 5.0)]
+    faults = [FaultEvent(time=1.0, kind="degrade", node=0,
+                         chips=(0, 1, 2, 3))]
+    r = run_sim(_fleet(1), trace, "binpack", faults=faults)
+    assert r.placed == 0 and r.never_placed == 1
+    native, _ = run_sim_native(_fleet(1), trace, faults=faults)
+    assert _canon(r) == _canon(native)
+
+
+def test_brownout_stalls_scheduling_until_heal():
+    """Arrivals inside the brownout queue; the heal edge retries the
+    backlog at the brownout_end instant exactly."""
+    trace = [_mk(5.0, 2.0), _mk(6.0, 2.0)]
+    faults = [FaultEvent(time=4.0, kind="brownout_start"),
+              FaultEvent(time=9.0, kind="brownout_end")]
+    r = run_sim(_fleet(1), trace, "binpack", faults=faults)
+    assert r.placed == 2
+    assert abs(r.mean_wait - 3.5) < 1e-6  # (9-5 + 9-6) / 2
+    native, _ = run_sim_native(_fleet(1), trace, faults=faults)
+    assert _canon(r) == _canon(native)
+
+
+def test_overlapping_stall_windows_nest():
+    """A replica crash inside a brownout: scheduling resumes only when
+    BOTH windows close."""
+    trace = [_mk(2.0, 1.0)]
+    faults = [FaultEvent(time=1.0, kind="brownout_start"),
+              FaultEvent(time=1.5, kind="replica_crash", replica=0),
+              FaultEvent(time=3.0, kind="brownout_end"),
+              FaultEvent(time=6.0, kind="replica_restart", replica=0)]
+    r = run_sim(_fleet(1), trace, "binpack", faults=faults)
+    assert abs(r.mean_wait - 4.0) < 1e-6  # placed at 6.0, arrived 2.0
+    native, _ = run_sim_native(_fleet(1), trace, faults=faults)
+    assert _canon(r) == _canon(native)
+
+
+def _mk(arrival, duration, hbm=4096, **kw):
+    from tpushare.sim.simulator import SimPod
+    return SimPod(arrival=arrival, duration=duration, hbm_mib=hbm, **kw)

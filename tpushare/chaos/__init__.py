@@ -1,0 +1,27 @@
+"""Fault-domain chaos tooling: the conductor that replays sim fault
+schedules against a live fleet, the continuous invariant monitors, and
+the hermetic drill bench.py and tier-1 both run (ISSUE 13 tentpole b).
+"""
+
+from tpushare.chaos.conductor import CHAOS_FAULTS, ChaosConductor
+from tpushare.chaos.drill import (
+    HermeticFleet,
+    assert_drill_invariants,
+    run_hermetic_drill,
+)
+from tpushare.chaos.invariants import (
+    CHAOS_VIOLATIONS,
+    InvariantMonitor,
+    oversubscription,
+)
+
+__all__ = [
+    "CHAOS_FAULTS",
+    "CHAOS_VIOLATIONS",
+    "ChaosConductor",
+    "HermeticFleet",
+    "InvariantMonitor",
+    "assert_drill_invariants",
+    "oversubscription",
+    "run_hermetic_drill",
+]

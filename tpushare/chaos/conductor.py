@@ -1,0 +1,113 @@
+"""The chaos conductor: replays a sim fault schedule against a fleet.
+
+``tpushare/sim/traces.py::synth_faults`` produces one seeded, sorted
+schedule of :class:`~tpushare.sim.traces.FaultEvent` objects. The sim
+engines consume it to model faults; this module is the third consumer —
+it maps the same events onto *actions against a running fleet*, so the
+wind tunnel and the real stack are falsified by the identical storm:
+
+==================  =====================================================
+event kind          fleet action (via the target adapter)
+==================  =====================================================
+``node_down``       partition the node (NotReady; ``lose_pods`` kills
+                    its running pods too — a hard crash)
+``node_up``         heal the partition
+``degrade``         shrink the node's healthy chip set (the device
+                    plugin's unhealthy-configmap channel)
+``brownout_start``  apiserver brownout: sever watches, 503 node verbs
+``brownout_end``    heal the brownout
+``replica_crash``   kill one extender replica (mid-bind, if it can)
+``replica_restart`` bring the replica back (cold start + recovery pass)
+==================  =====================================================
+
+The conductor owns only pacing and dispatch. *What* a "replica" or a
+"node" is — an in-process stack over a FakeCluster, or a real OS
+process against the wire-format stub apiserver — lives in the target
+adapter (see :class:`~tpushare.chaos.drill.HermeticFleet` for the
+hermetic one; the multi-process harness in tests/test_chaos_fleet.py
+builds the real-process one). Event times are sim-units; the conductor
+compresses them by ``seconds_per_unit`` so a 10-unit schedule can storm
+a test fleet in half a second.
+
+A target implements a subset of the action methods; events with no
+matching method are counted as skipped, not errors, so one schedule
+drives targets of different fidelity.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Iterable
+
+from tpushare.metrics import LabeledCounter
+
+log = logging.getLogger("tpushare.chaos")
+
+CHAOS_FAULTS = LabeledCounter(
+    "tpushare_chaos_faults_injected_total",
+    "Fault events the chaos conductor injected into a fleet, by kind "
+    "(a drill that injected nothing proved nothing — bench's chaos "
+    "section asserts this is nonzero)",
+    ("kind",))
+
+# event kind -> (target method, args builder)
+_DISPATCH: dict[str, tuple[str, Callable[[Any], tuple]]] = {
+    "node_down": ("node_down", lambda ev: (ev.node, ev.lose_pods)),
+    "node_up": ("node_up", lambda ev: (ev.node,)),
+    "degrade": ("degrade", lambda ev: (ev.node, ev.chips)),
+    "brownout_start": ("brownout_start", lambda ev: ()),
+    "brownout_end": ("brownout_end", lambda ev: ()),
+    "replica_crash": ("replica_crash", lambda ev: (ev.replica,)),
+    "replica_restart": ("replica_restart", lambda ev: (ev.replica,)),
+}
+
+
+class ChaosConductor:
+    """Paces a fault schedule onto a target adapter.
+
+    ``run`` is synchronous (callers wanting a background storm wrap it
+    in a thread); it returns per-kind applied/skipped counts so a drill
+    can assert the storm it asked for is the storm it got.
+    """
+
+    def __init__(self, target: Any, *, seconds_per_unit: float = 0.05,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep) -> None:
+        if seconds_per_unit <= 0:
+            raise ValueError("seconds_per_unit must be positive")
+        self.target = target
+        self.seconds_per_unit = seconds_per_unit
+        self._clock = clock
+        self._sleep = sleep
+
+    def run(self, schedule: Iterable[Any]) -> dict[str, int]:
+        """Apply every event at its compressed wall-clock offset.
+
+        Returns ``{kind: applied_count, ..., "skipped": n}``. An action
+        that raises is logged and counted as skipped — the conductor
+        must outlive the faults it causes (a brownout that 503s the
+        conductor's own probe is working as intended).
+        """
+        start = self._clock()
+        applied: dict[str, int] = {"skipped": 0}
+        for ev in schedule:
+            deadline = start + ev.time * self.seconds_per_unit
+            delay = deadline - self._clock()
+            if delay > 0:
+                self._sleep(delay)
+            method, argsfn = _DISPATCH[ev.kind]
+            fn = getattr(self.target, method, None)
+            if fn is None:
+                applied["skipped"] += 1
+                continue
+            try:
+                fn(*argsfn(ev))
+            except Exception as e:  # noqa: BLE001 — the storm goes on
+                log.warning("chaos: %s at t=%.2f failed: %s",
+                            ev.kind, ev.time, e)
+                applied["skipped"] += 1
+                continue
+            applied[ev.kind] = applied.get(ev.kind, 0) + 1
+            CHAOS_FAULTS.inc(ev.kind)
+        return applied

@@ -1,0 +1,355 @@
+"""The hermetic chaos drill: one seeded fault schedule, a real in-process
+fleet, continuous invariants, and a verdict dict.
+
+This is the FakeCluster-backed target for the conductor — the whole
+scheduler stack (SchedulerCache + Controller + Filter/Bind handlers
+behind the hardened client, two replicas of it) runs against one shared
+in-memory apiserver while :class:`~tpushare.chaos.conductor.ChaosConductor`
+replays a ``synth_faults`` schedule onto it:
+
+- ``node_down``/``node_up``   -> node-scoped partition (``lose_pods``
+  additionally fails the node's running pods — a hard host crash);
+- ``degrade``                 -> the device plugin's unhealthy-chip
+  configmap, shrinking the schedulable chip set;
+- ``brownout_*``              -> sever every watch stream + partition
+  every node (apiserver-wide 503s on the bind path);
+- ``replica_crash``           -> stop one replica's stack *after* it
+  stamps placement annotations on a victim pod it never binds — the
+  exact half-bound state a real crash in the patch->bind gap leaves;
+- ``replica_restart``         -> cold-start a fresh stack (build_cache
+  from truth + ``reconcile_once``), the production startup sequence.
+
+Used by tests/test_chaos_fleet.py (tier-1) and bench.py's ``chaos``
+section; both assert the same self-checks on the returned dict: zero
+oversubscription at every sampled instant, zero cache-vs-truth drift
+after healing, every half-bound orphan adopted-or-GC'd within the
+bounded recovery window, and a storm that actually stormed.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any
+
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.chaos.conductor import ChaosConductor
+from tpushare.chaos.invariants import InvariantMonitor, oversubscription
+from tpushare.contract.constants import (
+    UNHEALTHY_CM_KEY,
+    UNHEALTHY_CM_NAMESPACE,
+    UNHEALTHY_CM_PREFIX,
+)
+from tpushare.controller import Controller
+from tpushare.controller.recovery import (
+    RECOVERY_ADOPTED,
+    RECOVERY_GC,
+    reconcile_once,
+)
+from tpushare.k8s import CircuitBreaker, FakeCluster, RetryPolicy, harden
+from tpushare.sim import FaultSpec, synth_faults
+
+HBM_PER_CHIP = 16000
+
+
+def _make_pod(name: str, hbm: int) -> dict[str, Any]:
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {}},
+        "spec": {"containers": [{"name": "c0", "resources": {
+            "limits": {"aliyun.com/tpu-hbm": str(hbm)}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+class _Replica:
+    """One in-process extender stack over the shared fake apiserver,
+    with the production wiring: hardened client, controller heartbeat,
+    recovery pass at startup and on every resync."""
+
+    def __init__(self, fc: FakeCluster, seed: int, resync_s: float,
+                 stale_after_s: float) -> None:
+        self._fc = fc
+        self._seed = seed
+        self._resync_s = resync_s
+        self._stale_after_s = stale_after_s
+        self.alive = False
+        self._build()
+
+    def _build(self) -> None:
+        from tpushare.extender.handlers import BindHandler, FilterHandler
+        from tpushare.extender.metrics import Registry
+        cluster = harden(
+            self._fc,
+            breaker=CircuitBreaker(failure_threshold=4,
+                                   reset_timeout_s=0.05),
+            policy=RetryPolicy(max_attempts=3, base_s=0.002, cap_s=0.01,
+                               rng=random.Random(self._seed)))
+        self.cluster = cluster
+        self.cache = SchedulerCache(cluster)
+        self.ctl = Controller(cluster, self.cache,
+                              resync_seconds=self._resync_s)
+        self.ctl.build_cache()
+        # the production startup sequence (extender/__main__.py): one
+        # recovery pass now, then one on every resync heartbeat
+        reconcile_once(cluster, self.cache,
+                       stale_after_s=self._stale_after_s)
+        self.ctl.resync_hooks.append(lambda: reconcile_once(
+            cluster, self.cache, stale_after_s=self._stale_after_s))
+        self.ctl.start()
+        registry = Registry()
+        self.fil = FilterHandler(self.cache, registry)
+        # two independent replicas bind concurrently: the per-node claim
+        # CAS (ha_claims) is what keeps apiserver truth single-writer —
+        # the drill proved its absence oversubscribes within seconds
+        self.binder = BindHandler(self.cache, cluster, registry,
+                                  ha_claims=True)
+        self.alive = True
+
+    def crash(self, victim_name: str | None = None) -> None:
+        """Die the worst way: placement annotations stamped on a pod
+        that never gets bound, then the whole stack stops cold."""
+        self.alive = False
+        if victim_name is not None:
+            try:
+                self._fc.create_pod(_make_pod(victim_name, 1024))
+                ann = contract.placement_annotations(
+                    [0], 1024, HBM_PER_CHIP, now_ns=time.time_ns())
+                self._fc.patch_pod("default", victim_name,
+                                   {"metadata": {"annotations": ann}})
+            except Exception:  # noqa: BLE001 — mid-brownout crash, fine
+                pass
+        self.ctl.stop()
+
+    def restart(self) -> None:
+        if not self.alive:
+            self._build()
+
+
+class HermeticFleet:
+    """The conductor target: sim fault kinds mapped onto FakeCluster
+    chaos primitives and in-process replica crash/restart."""
+
+    def __init__(self, fc: FakeCluster, node_names: list[str],
+                 replicas: list[_Replica]) -> None:
+        self._fc = fc
+        self._names = node_names
+        self._replicas = replicas
+        self._crashes = 0
+
+    # -- node faults ---------------------------------------------------------
+
+    def node_down(self, idx: int, lose_pods: bool) -> None:
+        name = self._names[idx % len(self._names)]
+        self._fc.partition(name)
+        if lose_pods:
+            for pod in self._fc.list_pods(node_name=name):
+                if not contract.is_complete_pod(pod):
+                    self._fc.set_pod_phase(
+                        pod["metadata"]["namespace"],
+                        pod["metadata"]["name"], "Failed")
+
+    def node_up(self, idx: int) -> None:
+        self._fc.heal(self._names[idx % len(self._names)])
+
+    def degrade(self, idx: int, chips: tuple[int, ...]) -> None:
+        name = self._names[idx % len(self._names)]
+        self._fc.set_configmap(
+            UNHEALTHY_CM_NAMESPACE, UNHEALTHY_CM_PREFIX + name,
+            {UNHEALTHY_CM_KEY: ",".join(str(c) for c in chips)})
+
+    # -- apiserver brownout --------------------------------------------------
+
+    def brownout_start(self) -> None:
+        self._fc.break_watches()
+        for name in self._names:
+            self._fc.partition(name)
+
+    def brownout_end(self) -> None:
+        self._fc.heal()
+
+    # -- replica faults ------------------------------------------------------
+
+    def replica_crash(self, idx: int) -> None:
+        rep = self._replicas[idx % len(self._replicas)]
+        if rep.alive and sum(r.alive for r in self._replicas) > 1:
+            self._crashes += 1
+            rep.crash(victim_name=f"victim-{self._crashes}")
+
+    def replica_restart(self, idx: int) -> None:
+        self._replicas[idx % len(self._replicas)].restart()
+
+    def heal_all(self) -> None:
+        self._fc.heal()
+        for rep in self._replicas:
+            rep.restart()
+
+
+def run_hermetic_drill(*, seed: int = 1234, n_nodes: int = 3,
+                       n_pods: int = 24, hours: float = 20.0,
+                       seconds_per_unit: float = 0.05,
+                       stale_after_s: float = 0.2,
+                       resync_s: float = 0.1,
+                       threads: int = 4) -> dict[str, Any]:
+    """One full drill; returns the verdict for self-checks.
+
+    Deterministic in its *schedule* (seeded synth_faults + seeded
+    retries); thread interleavings vary, which is the point — the
+    invariants must hold on every interleaving.
+    """
+    fc = FakeCluster()
+    names = [f"n{i}" for i in range(n_nodes)]
+    for n in names:
+        fc.add_tpu_node(n, chips=4, hbm_per_chip_mib=HBM_PER_CHIP,
+                        mesh="2x2")
+    replicas = [_Replica(fc, seed + i, resync_s, stale_after_s)
+                for i in range(2)]
+    fleet = HermeticFleet(fc, names, replicas)
+    schedule = synth_faults(FaultSpec(
+        hours=hours, n_nodes=n_nodes, chips_per_node=4,
+        node_crashes=1, notready_windows=1, degradations=1,
+        brownouts=1, replica_crashes=1, replicas=2,
+        mean_outage=3.0, seed=seed))
+    monitor = InvariantMonitor(fc.list_pods, HBM_PER_CHIP,
+                               interval_s=0.003).start()
+    gc_before = RECOVERY_GC.total()
+    adopted_before = RECOVERY_ADOPTED.total()
+
+    conductor = ChaosConductor(fleet, seconds_per_unit=seconds_per_unit)
+    applied: dict[str, int] = {}
+    storm = threading.Thread(
+        target=lambda: applied.update(conductor.run(schedule)),
+        name="chaos-conductor", daemon=True)
+    storm.start()
+
+    storm_end = time.monotonic() + hours * seconds_per_unit + 10.0
+
+    def schedule_pod(pod: dict[str, Any]) -> bool:
+        ns, name = pod["metadata"]["namespace"], pod["metadata"]["name"]
+        attempt = 0
+        while time.monotonic() < storm_end:
+            reps = [r for r in replicas if r.alive]
+            if not reps:
+                time.sleep(0.01)
+                continue
+            rep = reps[attempt % len(reps)]
+            try:
+                res = rep.fil.handle({"Pod": pod, "NodeNames": names})
+                nodes = res["NodeNames"]
+                if nodes:
+                    out = rep.binder.handle({
+                        "PodNamespace": ns, "PodName": name,
+                        "PodUID": pod["metadata"]["uid"],
+                        "Node": nodes[attempt % len(nodes)]})
+                    if out["Error"] == "":
+                        return True
+            except Exception:  # noqa: BLE001 — brownout/crash races
+                pass
+            attempt += 1
+            time.sleep(0.004)
+        return False
+
+    pods = [fc.create_pod(_make_pod(f"d{i}", 2048)) for i in range(n_pods)]
+    with ThreadPoolExecutor(threads) as ex:
+        results = list(ex.map(schedule_pod, pods))
+    storm.join(timeout=hours * seconds_per_unit + 30.0)
+
+    # -- healing: lift everything, then measure the recovery window ----------
+    heal_t0 = time.monotonic()
+    fleet.heal_all()
+
+    def half_bound_left() -> list[str]:
+        out = []
+        for pod in fc.list_pods():
+            if contract.is_complete_pod(pod) or \
+                    (pod.get("spec") or {}).get("nodeName"):
+                continue
+            if contract.chip_ids_from_annotations(pod) is not None:
+                out.append(pod["metadata"]["name"])
+        return out
+
+    # the bound: stale_after_s + one resync heartbeat + scheduling slack
+    window_bound_s = stale_after_s + resync_s + 5.0
+    while half_bound_left() and \
+            time.monotonic() - heal_t0 < window_bound_s:
+        time.sleep(0.01)
+    recovery_window_s = time.monotonic() - heal_t0
+
+    # any pod the storm stranded binds now, against a healthy fleet
+    retried = [schedule_pod(pods[i]) for i, ok in enumerate(results)
+               if not ok]
+    placed = sum(1 for ok in results if ok) + sum(1 for ok in retried
+                                                 if ok)
+
+    # -- drift audit: every surviving cache vs apiserver truth ---------------
+    truth_per_chip: dict[tuple[str, int], int] = {}
+    for pod in fc.list_pods():
+        if contract.is_complete_pod(pod):
+            continue
+        node = (pod.get("spec") or {}).get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        if not node or ids is None:
+            continue
+        hbm = contract.hbm_from_annotations(pod)
+        for c in ids:
+            truth_per_chip[(node, c)] = \
+                truth_per_chip.get((node, c), 0) + hbm
+    drift: list[tuple] = []
+    for i, rep in enumerate(replicas):
+        rep.ctl.resync_once()
+        rep.ctl.drain(timeout=10.0)
+        tree = rep.cache.describe()
+        for node in tree["nodes"]:
+            for chip in node["chips"]:
+                want = truth_per_chip.get((node["name"], chip["idx"]), 0)
+                if chip["used_hbm_mib"] != want:
+                    drift.append((i, node["name"], chip["idx"],
+                                  chip["used_hbm_mib"], want))
+        rep.ctl.stop()
+
+    verdict = monitor.stop()
+    verdict.update({
+        "placed": placed,
+        "n_pods": n_pods,
+        "faults_applied": applied,
+        "faults_total": len(schedule),
+        "recovery": {
+            "adopted": RECOVERY_ADOPTED.total() - adopted_before,
+            "gc": RECOVERY_GC.total() - gc_before,
+        },
+        "half_bound_left": half_bound_left(),
+        "recovery_window_s": recovery_window_s,
+        "window_bound_s": window_bound_s,
+        "drift": drift,
+        "final_oversubscription": oversubscription(fc.list_pods(),
+                                                   HBM_PER_CHIP),
+    })
+    return verdict
+
+
+def assert_drill_invariants(r: dict[str, Any]) -> None:
+    """The self-checks bench.py and the tier-1 test share."""
+    assert r["samples"] > 0, "the monitor never sampled truth"
+    assert not r["oversubscription"], \
+        f"oversubscription under faults: {r['oversubscription'][:3]}"
+    assert not r["final_oversubscription"], \
+        f"oversubscription after heal: {r['final_oversubscription'][:3]}"
+    assert not r["drift"], \
+        f"cache != apiserver truth after healing: {r['drift'][:5]}"
+    assert not r["half_bound_left"], \
+        f"half-bound orphans survived recovery: {r['half_bound_left']}"
+    assert r["recovery_window_s"] <= r["window_bound_s"], \
+        f"recovery blew its bound: {r['recovery_window_s']:.2f}s"
+    assert r["placed"] == r["n_pods"], \
+        f"{r['n_pods'] - r['placed']} pods never bound"
+    injected = sum(v for k, v in r["faults_applied"].items()
+                   if k != "skipped")
+    assert injected > 0, "the storm injected nothing; it proved nothing"
+    assert r["faults_applied"].get("replica_crash", 0) >= 1
+    assert r["faults_applied"].get("brownout_start", 0) >= 1
+    assert r["recovery"]["gc"] >= 1, \
+        "the crash left no half-bound orphan for recovery to reclaim"

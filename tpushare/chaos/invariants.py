@@ -1,0 +1,140 @@
+"""Continuous invariant checkers for chaos drills.
+
+The system's one unbreakable promise (PAPER.md designs: annotations are
+the only channel) is that **apiserver truth never oversubscribes a
+chip** — not at the end of a storm, at *every instant of it*. The cache
+may transiently overcount (that only makes binds conservative); the
+placements the apiserver holds must always sum within capacity.
+
+:func:`oversubscription` checks one snapshot; :class:`InvariantMonitor`
+runs it continuously from a sampler thread while a drill storms, and
+also tracks the oldest pending placement so a drill can assert the
+bounded-pending-age promise after healing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from tpushare import contract
+from tpushare.contract import pod as podlib
+from tpushare.metrics import LabeledCounter
+
+CHAOS_VIOLATIONS = LabeledCounter(
+    "tpushare_chaos_invariant_violations_total",
+    "Invariant violations observed by chaos-drill monitors, by check "
+    '("oversubscription": a chip\'s summed live grants exceeded its '
+    "HBM on apiserver truth). MUST stay 0 — nonzero is a real "
+    "scheduler bug, not a chaos artifact",
+    ("check",))
+
+
+def oversubscription(pods: list[dict[str, Any]], chip_hbm_mib: int
+                     ) -> list[tuple[tuple[str, int], int]]:
+    """Per-chip grant sums over BOUND live pods vs capacity.
+
+    Returns ``[((node, chip), total_mib), ...]`` for every chip whose
+    summed grants exceed ``chip_hbm_mib``. Unbound pods (half-bound
+    placements mid-fault) hold nothing real and are skipped — they are
+    the *recovery* reconciler's problem, not an oversubscription.
+    """
+    per: dict[tuple[str, int], int] = {}
+    for pod in pods:
+        if contract.is_complete_pod(pod):
+            continue
+        node = (pod.get("spec") or {}).get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        if not node or ids is None:
+            continue
+        hbm = contract.hbm_from_annotations(pod)
+        for c in ids:
+            per[(node, c)] = per.get((node, c), 0) + hbm
+    return [(k, v) for k, v in sorted(per.items()) if v > chip_hbm_mib]
+
+
+class InvariantMonitor:
+    """Samples apiserver truth continuously while a drill storms.
+
+    ``list_pods`` is any zero-arg callable returning the current pod
+    list (a FakeCluster method, or an InClusterClient against the stub
+    apiserver). Sampling errors are tolerated and counted — during a
+    brownout the monitor's own reads fail too, by design — but at least
+    one *successful* sample is required for a drill to claim coverage.
+    """
+
+    def __init__(self, list_pods: Callable[[], list[dict[str, Any]]],
+                 chip_hbm_mib: int, *, interval_s: float = 0.005) -> None:
+        self._list_pods = list_pods
+        self._chip_hbm_mib = chip_hbm_mib
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._violations: list[tuple[tuple[str, int], int]] = []
+        self._samples = 0
+        self._errors = 0
+        self._max_pending_age_s = 0.0
+        self._pending_since: dict[str, float] = {}
+
+    def _sample(self) -> None:
+        try:
+            pods = self._list_pods()
+        except Exception:  # noqa: BLE001 — brownouts hit us too
+            with self._lock:
+                self._errors += 1
+            return
+        bad = oversubscription(pods, self._chip_hbm_mib)
+        now = time.monotonic()
+        seen_pending: set[str] = set()
+        for pod in pods:
+            if contract.is_complete_pod(pod) or \
+                    (pod.get("spec") or {}).get("nodeName"):
+                continue
+            if contract.chip_ids_from_annotations(pod) is None:
+                continue
+            key = podlib.pod_cache_key(pod)
+            seen_pending.add(key)
+        with self._lock:
+            self._samples += 1
+            for key in list(self._pending_since):
+                if key not in seen_pending:
+                    del self._pending_since[key]
+            for key in seen_pending:
+                since = self._pending_since.setdefault(key, now)
+                self._max_pending_age_s = max(self._max_pending_age_s,
+                                              now - since)
+            if bad:
+                self._violations.extend(bad)
+        for _ in bad:
+            CHAOS_VIOLATIONS.inc("oversubscription")
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._sample()
+            self._stop.wait(self._interval_s)
+
+    def start(self) -> "InvariantMonitor":
+        self._thread = threading.Thread(target=self._run,
+                                        name="chaos-invariants",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict[str, Any]:
+        """Stop sampling, take one final sample, return the verdict."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._sample()
+        return self.report()
+
+    def report(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "samples": self._samples,
+                "sample_errors": self._errors,
+                "oversubscription": list(self._violations),
+                "max_pending_age_s": self._max_pending_age_s,
+            }

@@ -1,6 +1,7 @@
 """Sync layer: keeps the SchedulerCache consistent with the apiserver."""
 
 from tpushare.controller.controller import Controller
+from tpushare.controller.recovery import reconcile_once
 from tpushare.controller.workqueue import WorkQueue
 
-__all__ = ["Controller", "WorkQueue"]
+__all__ = ["Controller", "WorkQueue", "reconcile_once"]

@@ -1,0 +1,148 @@
+"""Crash-restart reconciliation: adopt-or-GC what a dead replica left.
+
+The allocate path is two apiserver writes with a gap between them —
+patch the placement annotations (chip ids, HBM split, assume-time
+stamp), then bind. A replica that crashes inside that gap leaves a
+**half-bound** pod: placement annotations patched by an incarnation
+that no longer exists, ``spec.nodeName`` never set. Nothing in the
+normal event flow heals it — the default scheduler retries the pod
+through Filter, but the stale annotations sit there forever, and a
+careless replay would double-account the chips.
+
+The node-local analogue already exists (deviceplugin
+``gc_stale_assignments`` reclaims placements whose container start
+never reached Allocate). This module is the scheduler-side,
+cross-replica version, run by every replica on the controller's
+anti-entropy heartbeat and once at startup right after ``build_cache``:
+
+- **adopt**: a pod with nodeName + chip-ids the cache does not know
+  (bound by a dead incarnation after our replay, or a bind that landed
+  mid-reconcile) is accounted via ``add_or_update_pod`` —
+  ``tpushare_recovery_adopted_total{kind="bound"|"late_bind"}``.
+- **GC**: a half-bound pod older than ``stale_after_s`` (by its
+  assume-time stamp) has its placement annotations stripped with the
+  same resourceVersion-CAS PUT the stale-placement reclaim uses
+  (contract.strip_placement) — a concurrent live allocate that
+  re-stamped or bound wins the CAS and the placement stands.
+  ``tpushare_recovery_gc_total{kind="half_bound"|"unstamped"}``.
+
+The recovery window is bounded by construction: startup runs one pass
+immediately, and the resync heartbeat (30 s default) re-runs it, so a
+half-bound orphan lives at most ``stale_after_s`` + one heartbeat.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from tpushare import contract
+from tpushare.contract import pod as podlib
+from tpushare.k8s.client import ApiError
+from tpushare.metrics import LabeledCounter
+
+log = logging.getLogger("tpushare.recovery")
+
+# Adoption grace: comfortably past one allocate round-trip (the
+# patch->bind gap is two apiserver writes plus the claim CAS), far
+# under the resync heartbeat — a LIVE in-flight bind is never raced.
+DEFAULT_STALE_AFTER_S = 15.0
+
+RECOVERY_ADOPTED = LabeledCounter(
+    "tpushare_recovery_adopted_total",
+    "Pods adopted into the cache by crash-restart reconciliation "
+    '(kind="bound": placed+bound pod the cache did not know; '
+    '"late_bind": a half-bound pod whose bind landed mid-reconcile)',
+    ("kind",))
+RECOVERY_GC = LabeledCounter(
+    "tpushare_recovery_gc_total",
+    "Half-bound placements reclaimed by crash-restart reconciliation "
+    '(kind="half_bound": annotations stamped by a dead incarnation, '
+    'never bound; "unstamped": malformed — chip ids without an '
+    "assume-time stamp)",
+    ("kind",))
+
+
+def reconcile_once(cluster, cache, *, now_ns: int | None = None,
+                   stale_after_s: float = DEFAULT_STALE_AFTER_S
+                   ) -> dict[str, int]:
+    """One adopt-or-GC pass over the apiserver's pod truth.
+
+    Returns ``{"adopted": n, "gc": n}`` for the pass. Safe to run
+    concurrently with live scheduling on any replica: adoption is the
+    same idempotent accounting the informer path uses, and GC is a
+    resourceVersion CAS that loses (409) to any concurrent mutation.
+    """
+    if now_ns is None:
+        now_ns = time.time_ns()
+    adopted = reclaimed = 0
+    try:
+        pods = cluster.list_pods()
+    except ApiError as e:
+        log.warning("recovery: pod list failed, skipping pass: %s", e)
+        return {"adopted": 0, "gc": 0}
+    for pod in pods:
+        if not contract.is_tpushare_pod(pod) \
+                or contract.is_complete_pod(pod):
+            continue
+        if contract.chip_ids_from_annotations(pod) is None:
+            continue
+        if podlib.pod_node_name(pod):
+            # bound + placed: the normal replay shape. build_cache
+            # already accounted everything it listed; this covers pods
+            # bound by a DEAD incarnation after our replay ran.
+            if not cache.known_pod(podlib.pod_cache_key(pod)):
+                cache.add_or_update_pod(pod)
+                adopted += 1
+                RECOVERY_ADOPTED.inc("bound")
+                log.info("recovery: adopted bound pod %s",
+                         podlib.pod_key(pod))
+            continue
+        # half-bound: placement annotations, no nodeName. Age by the
+        # assume-time stamp the allocate path wrote per attempt.
+        t = contract.assume_time_from_annotations(pod)
+        if t and (now_ns - t) / 1e9 <= stale_after_s:
+            continue  # inside a live allocate's window — leave it
+        adp, rec = _adopt_or_gc(cluster, cache, pod, t)
+        adopted += adp
+        reclaimed += rec
+    return {"adopted": adopted, "gc": reclaimed}
+
+
+def _adopt_or_gc(cluster, cache, pod: dict[str, Any], t: int
+                 ) -> tuple[int, int]:
+    """Re-read one stale half-bound pod and adopt (the bind landed
+    after our LIST) or GC it (CAS-strip the placement annotations)."""
+    ns, name = podlib.pod_namespace(pod), podlib.pod_name(pod)
+    try:
+        fresh = cluster.get_pod(ns, name)
+    except ApiError:
+        return 0, 0  # vanished; termination frees everything
+    if podlib.pod_node_name(fresh):
+        # the bind landed between LIST and now: adopt, don't reclaim
+        if contract.chip_ids_from_annotations(fresh) is not None and \
+                not cache.known_pod(podlib.pod_cache_key(fresh)):
+            cache.add_or_update_pod(fresh)
+            RECOVERY_ADOPTED.inc("late_bind")
+            log.info("recovery: adopted late-bound pod %s",
+                     podlib.pod_key(fresh))
+            return 1, 0
+        return 0, 0
+    if contract.is_assigned(fresh) or \
+            contract.assume_time_from_annotations(fresh) != t:
+        return 0, 0  # runtime granted chips / a live re-placement
+    try:
+        cluster.replace_pod(ns, name, contract.strip_placement(fresh))
+    except ApiError as e:
+        if e.is_conflict:
+            log.info("recovery: reclaim of %s/%s lost a CAS race "
+                     "(placement stands)", ns, name)
+        else:
+            log.warning("recovery: reclaim of %s/%s failed: %s",
+                        ns, name, e)
+        return 0, 0
+    RECOVERY_GC.inc("half_bound" if t else "unstamped")
+    log.warning("recovery: reclaimed half-bound placement of %s/%s",
+                ns, name)
+    return 0, 1

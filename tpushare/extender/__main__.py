@@ -116,7 +116,9 @@ def main(argv: list[str] | None = None) -> int:
     from tpushare.k8s.informer import Informer
     informer = Informer(cluster).start()
     cache = SchedulerCache(cluster, node_lister=informer.nodes)
-    controller = Controller(cluster, cache, workers=args.workers)
+    controller = Controller(
+        cluster, cache, workers=args.workers,
+        resync_seconds=float(os.environ.get("TPUSHARE_RESYNC_S", "30.0")))
     replayed = controller.build_cache()
     log.info("cache built: %d pods replayed", replayed)
     controller.start()
@@ -182,6 +184,16 @@ def main(argv: list[str] | None = None) -> int:
     # abandoned-gang expiry rides the controller's 30 s anti-entropy
     # heartbeat (docs/designs/multihost-gang.md protocol step 5)
     controller.resync_hooks.append(server.gang.gc)
+    # crash-restart reconciliation (controller/recovery.py): one pass
+    # now — a replica restarting mid-storm adopts what a dead
+    # incarnation bound and reclaims what it half-bound — then again on
+    # every resync heartbeat, which bounds the orphan window
+    from tpushare.controller.recovery import reconcile_once
+    recovery_stale_s = float(os.environ.get(
+        "TPUSHARE_RECOVERY_STALE_S", "15.0"))
+    reconcile_once(cluster, cache, stale_after_s=recovery_stale_s)
+    controller.resync_hooks.append(lambda: reconcile_once(
+        cluster, cache, stale_after_s=recovery_stale_s))
 
     stop = threading.Event()
 

@@ -855,6 +855,12 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
     from tpushare.k8s.stats import APISERVER_REQUESTS
 
     registry.register(CLAIM_CAS_RETRIES)
+    # crash-restart reconciliation: adopt-or-GC attribution after a
+    # replica dies in the patch->bind gap (controller/recovery.py)
+    from tpushare.controller.recovery import RECOVERY_ADOPTED, RECOVERY_GC
+
+    registry.register(RECOVERY_ADOPTED)
+    registry.register(RECOVERY_GC)
     # fault-containment set: retry volume, budget exhaustion, deadline
     # hits, degraded serves — what docs/ops.md says to alert on
     registry.register(RETRY_ATTEMPTS)

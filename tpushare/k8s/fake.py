@@ -34,6 +34,10 @@ from tpushare.contract.constants import (
 )
 from tpushare.k8s.client import ApiError, WatchEvent, strategic_merge
 
+# queued into a live watch stream by break_watches(): the consumer side
+# raises mid-iteration, exactly like a dropped apiserver connection
+_SEVER = object()
+
 
 class FakeCluster:
     def __init__(self) -> None:
@@ -46,6 +50,7 @@ class FakeCluster:
         self._events: list[dict[str, Any]] = []
         self._watchers: dict[str, list[queue.Queue]] = {
             "pods": [], "nodes": [], "configmaps": []}
+        self._partitioned: set[str] = set()
 
     # -- internal ------------------------------------------------------------
 
@@ -63,6 +68,40 @@ class FakeCluster:
     @staticmethod
     def _key(namespace: str, name: str) -> str:
         return f"{namespace}/{name}"
+
+    def _check_partition(self, node_name: str) -> None:
+        if node_name in self._partitioned:
+            raise ApiError(503, f"node {node_name} partitioned (chaos)")
+
+    # -- chaos primitives ----------------------------------------------------
+
+    def break_watches(self) -> int:
+        """Sever every live watch stream once — the consumer's iterator
+        raises mid-iteration, exactly like a dropped apiserver
+        connection. New watches connect normally, so an informer's
+        backoff -> relist healing path is what gets exercised. Returns
+        the number of streams severed."""
+        with self._lock:
+            queues = [q for qs in self._watchers.values() for q in qs]
+        for q in queues:
+            q.put(_SEVER)
+        return len(queues)
+
+    def partition(self, node_name: str) -> None:
+        """Node-scoped network partition: every verb that names this
+        node (get/patch/bind) fails 503 until :meth:`heal` — the shape
+        of a rack losing its uplink while the apiserver stays up."""
+        with self._lock:
+            self._partitioned.add(node_name)
+
+    def heal(self, node_name: str | None = None) -> None:
+        """Lift a node partition (all of them when ``node_name`` is
+        None)."""
+        with self._lock:
+            if node_name is None:
+                self._partitioned.clear()
+            else:
+                self._partitioned.discard(node_name)
 
     # -- seeding helpers -----------------------------------------------------
 
@@ -195,6 +234,7 @@ class FakeCluster:
 
     def get_node(self, name: str) -> dict[str, Any]:
         with self._lock:
+            self._check_partition(name)
             node = self._nodes.get(name)
             if node is None:
                 raise ApiError(404, f"node {name}")
@@ -252,6 +292,7 @@ class FakeCluster:
     def bind_pod(self, namespace: str, name: str, node: str,
                  uid: str | None = None) -> None:
         with self._lock:
+            self._check_partition(node)
             pod = self._pods.get(self._key(namespace, name))
             if pod is None:
                 raise ApiError(404, f"pod {namespace}/{name}")
@@ -312,6 +353,7 @@ class FakeCluster:
     def patch_node(self, name: str, patch: dict[str, Any],
                    status: bool = False) -> dict[str, Any]:
         with self._lock:
+            self._check_partition(name)
             node = self._nodes.get(name)
             if node is None:
                 raise ApiError(404, f"node {name}")
@@ -347,9 +389,12 @@ class FakeCluster:
         try:
             while not stop.is_set():
                 try:
-                    yield q.get(timeout=0.05)
+                    ev = q.get(timeout=0.05)
                 except queue.Empty:
                     continue
+                if ev is _SEVER:
+                    raise ApiError(500, f"{kind} watch severed (chaos)")
+                yield ev
         finally:
             with self._lock:
                 self._watchers[kind].remove(q)

@@ -91,8 +91,12 @@ class PeerPool:
     third round-trip.
     """
 
-    def __init__(self, timeout: float | None = None) -> None:
+    def __init__(self, timeout: float | None = None, *,
+                 failure_threshold: int = 3,
+                 reset_timeout_s: float = 2.0) -> None:
         self._timeout = timeout
+        self._failure_threshold = failure_threshold
+        self._reset_timeout_s = reset_timeout_s
         self._lock = threading.Lock()  # guards the map only, never I/O
         self._transports: dict[str, object] = {}
 
@@ -102,8 +106,9 @@ class PeerPool:
             if t is None:
                 t = harden(
                     PeerTransport(base_url, timeout=self._timeout),
-                    breaker=CircuitBreaker(failure_threshold=3,
-                                           reset_timeout_s=2.0),
+                    breaker=CircuitBreaker(
+                        failure_threshold=self._failure_threshold,
+                        reset_timeout_s=self._reset_timeout_s),
                     policy=RetryPolicy(max_attempts=2))
                 self._transports[base_url] = t
             return t

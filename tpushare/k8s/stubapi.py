@@ -92,6 +92,7 @@ class StubApiServer:
         self._close_after_events: int | None = None
         self._live_watch_sockets: list[socket.socket] = []
         self._bookmark_seq = 0
+        self._partitioned: set[str] = set()
         state = self.state
         stub = self
 
@@ -166,6 +167,18 @@ class StubApiServer:
             def _key(kind, ns, name):
                 return f"{ns}/{name}" if ns else name
 
+            def _partitioned(self, node: str) -> bool:
+                """Node-scoped partition gate: a 503 for any verb that
+                names a partitioned node (chaos conductor primitive)."""
+                if not node:
+                    return False
+                with stub._fault_lock:
+                    hit = node in stub._partitioned
+                if hit:
+                    self._fail(503, "ServiceUnavailable",
+                               f"node {node} partitioned (chaos)")
+                return hit
+
             # -- verbs ---------------------------------------------------------
 
             def do_GET(self):
@@ -180,6 +193,8 @@ class StubApiServer:
                 q = self._query()
                 if q.get("watch") == "true" and not name:
                     return self._watch(kind, q)
+                if kind == "nodes" and self._partitioned(name):
+                    return
                 with state.lock:
                     if name:
                         obj = state.objects[kind].get(self._key(kind, ns, name))
@@ -210,6 +225,10 @@ class StubApiServer:
                 if ct != "application/strategic-merge-patch+json":
                     return self._fail(415, "UnsupportedMediaType", ct)
                 patch = self._body()
+                # gate AFTER draining the body: an unread body on a
+                # keep-alive connection desyncs the next request
+                if kind == "nodes" and self._partitioned(name):
+                    return
                 key = self._key(kind, ns, name)
                 with state.lock:
                     obj = state.objects.get(kind, {}).get(key)
@@ -307,6 +326,8 @@ class StubApiServer:
                 key = f"{ns}/{name}"
                 node = ((body.get("target") or {}).get("name")) or ""
                 uid = (body.get("metadata") or {}).get("uid")
+                if self._partitioned(node):
+                    return
                 with state.lock:
                     pod = state.objects["pods"].get(key)
                     if pod is None:
@@ -477,6 +498,31 @@ class StubApiServer:
                 s.close()
             except OSError:
                 pass
+
+    def break_watches(self) -> int:
+        """Sever every live watch stream (FakeCluster-parity name for
+        :meth:`drop_watch_connections`): the chaos conductor speaks one
+        verb against either backend. Returns the number of streams cut."""
+        with self._fault_lock:
+            n = len(self._live_watch_sockets)
+        self.drop_watch_connections()
+        return n
+
+    def partition(self, node_name: str) -> None:
+        """Node-scoped partition: GET/PATCH on the node and any bind
+        targeting it fail 503 until :meth:`heal` — the rack-lost-uplink
+        fault, distinct from a full apiserver brownout."""
+        with self._fault_lock:
+            self._partitioned.add(node_name)
+
+    def heal(self, node_name: str | None = None) -> None:
+        """Lift a node partition (all of them when ``node_name`` is
+        None)."""
+        with self._fault_lock:
+            if node_name is None:
+                self._partitioned.clear()
+            else:
+                self._partitioned.discard(node_name)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -15,10 +15,11 @@ from tpushare.sim.engine_loop import LoopKnobs, run_sim_native
 from tpushare.sim.simulator import (
     POLICIES, Fleet, SimReport, TraceSpec, run_sim, synth_trace)
 from tpushare.sim.traces import (
-    DEFAULT_TIERS, DiurnalSpec, PodTier, SpikeWindow, synth_diurnal,
-    synth_fleet)
+    DEFAULT_TIERS, DiurnalSpec, FaultEvent, FaultSpec, PodTier,
+    SpikeWindow, synth_diurnal, synth_faults, synth_fleet)
 
-__all__ = ["DEFAULT_TIERS", "DiurnalSpec", "Fleet", "LoopKnobs",
-           "POLICIES", "PodTier", "SimReport", "SpikeWindow",
-           "TraceSpec", "run_sim", "run_sim_native", "synth_diurnal",
-           "synth_fleet", "synth_trace"]
+__all__ = ["DEFAULT_TIERS", "DiurnalSpec", "FaultEvent", "FaultSpec",
+           "Fleet", "LoopKnobs", "POLICIES", "PodTier", "SimReport",
+           "SpikeWindow", "TraceSpec", "run_sim", "run_sim_native",
+           "synth_diurnal", "synth_faults", "synth_fleet",
+           "synth_trace"]

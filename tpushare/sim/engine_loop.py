@@ -183,16 +183,24 @@ class EngineLoop:
             np.int64, n)
         # fragmentation bookkeeping: free-value histogram + lazy max-heap
         # (run_sim recomputes fragmentation() fleet-wide per event; this
-        # maintains the same max(free)/total_free pair incrementally)
+        # maintains the same max(free)/total_free pair incrementally).
+        # Fault-aware: the histogram and _free_sum span HEALTHY chips
+        # only — exactly the set core.placement.fragmentation() reduces
+        # over — while _used_total spans every chip (run_sim's
+        # fleet.used_hbm does too: a pod finishing on a degraded chip
+        # still occupies HBM until it departs)
         self._free_cnt: dict[int, int] = {}
         self._free_heap: list[int] = []
         self._total_hbm = fleet.total_hbm
         self._used_total = 0
+        self._free_sum = 0
         for nd in fleet.nodes:
-            for u in nd.used:
-                f = nd.hbm - u
-                self._free_cnt[f] = self._free_cnt.get(f, 0) + 1
+            for i, u in enumerate(nd.used):
                 self._used_total += u
+                if nd.chip_healthy(i):
+                    f = nd.hbm - u
+                    self._free_cnt[f] = self._free_cnt.get(f, 0) + 1
+                    self._free_sum += f
         for f in self._free_cnt:
             heapq.heappush(self._free_heap, -f)
         # signature residency (the eqclass LRU)
@@ -205,6 +213,8 @@ class EngineLoop:
         self._lock = threading.Lock()
         # run state
         self._active: dict[int, tuple] = {}
+        self._cancelled: set[int] = set()   # fault-killed departures
+        self._stalled = 0                   # open brownout/crash windows
         self._dep_heap: list[tuple] = []
         self._pending: list[tuple] = []
         self._pending_keys: dict[tuple, int] = {}
@@ -227,6 +237,7 @@ class EngineLoop:
         self._batch_groups = self._batch_pods = 0
         self._batch_pods_pending = 0
         self._defrag_passes = self._defrag_moves = 0
+        self._faults_applied = self._fault_lost = 0
 
     # -- observability --------------------------------------------------------
 
@@ -259,11 +270,16 @@ class EngineLoop:
         used = node.used
         hbm = node.hbm
         cnt = self._free_cnt
+        # faulted chips are absent from the frag histogram (they are
+        # invisible to fragmentation()); their used still moves
+        faulted = node.down or node.unhealthy
         for cid in chip_ids:
             old = used[cid]
             new = old + delta
             assert 0 <= new <= hbm, "sim oversubscription"
             used[cid] = new
+            if faulted and not node.chip_healthy(cid):
+                continue
             of, nf = hbm - old, hbm - new
             c = cnt[of] - 1
             if c:
@@ -275,12 +291,42 @@ class EngineLoop:
             else:
                 cnt[nf] = 1
                 heapq.heappush(self._free_heap, -nf)
+            self._free_sum -= delta
         self._used_total += delta * len(chip_ids)
         self._versions[ni] += 1
         self._view_cache[ni] = None
         self._log.append(ni)
+        # on a faulted node these stay conservative OVERestimates (the
+        # unhealthy chips' free counts in) — the index prune skips less
+        # and never skips a node the native scan could place on
         self._maxfree[ni] = hbm - min(used)
         self._freechips[ni] = sum(1 for u in used if u == 0)
+
+    def _exclude_chips(self, ni: int, cids) -> None:
+        """Drop chips from the frag histogram (node_down / degrade)."""
+        node = self.fleet.nodes[ni]
+        cnt = self._free_cnt
+        for cid in cids:
+            f = node.hbm - node.used[cid]
+            c = cnt[f] - 1
+            if c:
+                cnt[f] = c
+            else:
+                del cnt[f]
+            self._free_sum -= f
+
+    def _include_chips(self, ni: int, cids) -> None:
+        """Re-admit chips to the frag histogram (node_up)."""
+        node = self.fleet.nodes[ni]
+        cnt = self._free_cnt
+        for cid in cids:
+            f = node.hbm - node.used[cid]
+            if f in cnt:
+                cnt[f] += 1
+            else:
+                cnt[f] = 1
+                heapq.heappush(self._free_heap, -f)
+            self._free_sum += f
 
     def _max_free_chip(self) -> int:
         heap, cnt = self._free_heap, self._free_cnt
@@ -293,13 +339,73 @@ class EngineLoop:
         if dt > 0:
             used = self._used_total
             self._util_integral += used * dt
-            total_free = self._total_hbm - used
+            # _free_sum == total_hbm - used while the fleet is healthy;
+            # under faults it is the healthy-chip free total, exactly
+            # fragmentation()'s denominator
+            total_free = self._free_sum
             frag = 0.0 if total_free == 0 \
                 else 1.0 - self._max_free_chip() / total_free
             self._frag_integral += frag * dt
             self._peak = max(self._peak,
                              used / self._total_hbm * 100.0)
         self._last_t = to
+
+    # -- fault schedule (ISSUE 13) --------------------------------------------
+
+    def _fault_dirty(self, ni: int) -> None:
+        """A fault changed a node's schedulability WITHOUT a chip-usage
+        mutation: bump the version so resident score vectors, placement
+        memos and the arena slot all see the node as dirty."""
+        self._versions[ni] += 1
+        self._view_cache[ni] = None
+        self._log.append(ni)
+
+    def _apply_fault(self, ev) -> None:
+        """Mirror of run_sim's kind==-1 branch, byte-for-byte in its
+        observable effects (tests/test_sim_faults.py proves it)."""
+        self._faults_applied += 1
+        kind = ev.kind
+        if kind in ("brownout_start", "replica_crash"):
+            self._stalled += 1
+        elif kind in ("brownout_end", "replica_restart"):
+            self._stalled = max(0, self._stalled - 1)
+        elif kind == "node_down":
+            ni = ev.node
+            nd = self.fleet.nodes[ni]
+            if not nd.down:
+                self._exclude_chips(ni, [c for c in range(len(nd.used))
+                                         if c not in nd.unhealthy])
+                nd.down = True
+            if ev.lose_pods:
+                for vid in sorted(v for v, e in self._active.items()
+                                  if e[0] == ni):
+                    vni, chips, demand, pod = self._active.pop(vid)
+                    self._mutate(vni, chips, -demand)
+                    self._cancelled.add(vid)
+                    self._fault_lost += 1
+                    key, req = self._effective(pod)
+                    self._pend(pod, req, key)
+            self._fault_dirty(ni)
+        elif kind == "node_up":
+            ni = ev.node
+            nd = self.fleet.nodes[ni]
+            if nd.down:
+                nd.down = False
+                self._include_chips(ni, [c for c in range(len(nd.used))
+                                         if c not in nd.unhealthy])
+            self._fault_dirty(ni)
+        elif kind == "degrade":
+            ni = ev.node
+            nd = self.fleet.nodes[ni]
+            fresh = [c for c in ev.chips if c not in nd.unhealthy]
+            if not nd.down:
+                self._exclude_chips(ni, fresh)
+            nd.unhealthy.update(fresh)
+            self._fault_dirty(ni)
+        # run_sim retries the pending FIFO after every fault unless a
+        # stall window is open — capacity/schedulability may have moved
+        if self._stalled == 0:
+            self._retry_pending()
 
     # -- the index_scheme prune (superset-safe no-fit certificates) -----------
 
@@ -472,6 +578,10 @@ class EngineLoop:
     def _pend(self, pod: SimPod, req, key: tuple) -> None:
         self._pending.append((pod, req, key))
         if self._stable_sigs:
+            # a pod can pend before its signature ever scanned (stalled
+            # arrival, fault-killed restart): register the request so
+            # the no-fit fast path can fault the signature in later
+            self._key_reqs.setdefault(key, req)
             self._pending_keys[key] = self._pending_keys.get(key, 0) + 1
 
     def _retry_pending(self) -> None:
@@ -548,6 +658,12 @@ class EngineLoop:
         return out
 
     def _flush(self, buf: list) -> None:
+        if self._stalled:
+            # window closed inside a brownout: nothing can bind
+            for pod in buf:
+                key, req = self._effective(pod)
+                self._pend(pod, req, key)
+            return
         groups: dict[tuple, list] = {}
         order: list[tuple] = []
         for pod in buf:
@@ -645,12 +761,14 @@ class EngineLoop:
 
     # -- the event loop -------------------------------------------------------
 
-    def run(self, trace) -> SimReport:
+    def run(self, trace, faults=None) -> SimReport:
         """Replay ``trace`` (list or arrival-ordered iterator of
-        SimPod). Event ordering is run_sim's exactly: departures before
-        arrivals at equal times, departures by placement order, trace
-        order among simultaneous arrivals — so default-knob replays
-        yield byte-identical scorecards."""
+        SimPod). Event ordering is run_sim's exactly: faults before
+        departures before arrivals at equal times, departures by
+        placement order, trace order among simultaneous arrivals — so
+        default-knob replays yield byte-identical scorecards.
+        ``faults`` is the same time-sorted FaultEvent list run_sim
+        takes (tpushare.sim.traces.synth_faults)."""
         INF = float("inf")
         if isinstance(trace, list):
             trace = sorted(trace, key=lambda p: p.arrival)
@@ -662,17 +780,29 @@ class EngineLoop:
         flush_at = INF
         defrag_on = self.knobs.defrag_budget > 0
         next_defrag = self.knobs.defrag_period if defrag_on else INF
+        faults = list(faults) if faults else []
+        fi = 0
+        nfaults = len(faults)
         pods = 0
         flushes = 0
-        while nxt is not None or dep or buf:
+        while nxt is not None or dep or buf or fi < nfaults:
             ta = nxt.arrival if nxt is not None else INF
             td = dep[0][0] if dep else INF
             tf = flush_at if buf else INF
+            tflt = faults[fi].time if fi < nfaults else INF
             # defrag is a maintenance tick, not workload: it only fires
             # while real events remain, so a drained sim terminates
             tdf = next_defrag if defrag_on and (nxt is not None or dep) \
                 else INF
-            t = min(ta, td, tf, tdf)
+            t = min(ta, td, tf, tdf, tflt)
+            if tflt <= t:                  # fault (wins ALL ties, as
+                self._advance(tflt)        # run_sim's kind -1 does)
+                self._now = tflt
+                if self._busy_start is None:
+                    self._busy_start = tflt
+                self._apply_fault(faults[fi])
+                fi += 1
+                continue
             if tf <= t:                    # close the batch window
                 self._advance(tf)
                 self._now = tf
@@ -694,10 +824,15 @@ class EngineLoop:
                 self._now = td
                 if self._busy_start is None:
                     self._busy_start = td
+                if vid in self._cancelled:
+                    # fault-killed earlier: chips already freed then
+                    self._cancelled.discard(vid)
+                    continue
                 ni, chip_ids, demand, _pod = self._active.pop(vid)
                 self._mutate(ni, chip_ids, -demand)
                 self._departures += 1
-                self._retry_pending()
+                if not self._stalled:
+                    self._retry_pending()
                 continue
             # arrival
             self._advance(ta)
@@ -710,6 +845,9 @@ class EngineLoop:
                 if not buf:
                     flush_at = ta + window
                 buf.append(nxt)
+            elif self._stalled:
+                key, req = self._effective(nxt)
+                self._pend(nxt, req, key)
             else:
                 key, req = self._effective(nxt)
                 if not self._try_place_now(nxt, key, req):
@@ -724,6 +862,8 @@ class EngineLoop:
             SIM_EVENTS.inc("flush", n=flushes)
         if self._defrag_passes:
             SIM_EVENTS.inc("defrag_pass", n=self._defrag_passes)
+        if self._faults_applied:
+            SIM_EVENTS.inc("fault", n=self._faults_applied)
         if self._full_builds:
             SIM_SCORE_REFRESHES.inc("full", n=self._full_builds)
         if self._delta_refreshes:
@@ -753,6 +893,8 @@ class EngineLoop:
             contig_violations=self._violations,
             hp_mean_wait=sum(hp) / len(hp) if hp else 0.0,
             hp_p99_wait=_p99(hp),
+            faults_applied=self._faults_applied,
+            fault_lost_pods=self._fault_lost,
             waits=waits,
         )
 
@@ -775,18 +917,21 @@ class EngineLoop:
             "batch_pods_pending": self._batch_pods_pending,
             "defrag_passes": self._defrag_passes,
             "defrag_moves": self._defrag_moves,
+            "faults_applied": self._faults_applied,
+            "fault_lost_pods": self._fault_lost,
             "knobs": asdict(self.knobs),
             "arena": self._arena.describe(),
         }
 
 
 def run_sim_native(fleet: Fleet, trace,
-                   knobs: LoopKnobs | None = None
-                   ) -> tuple[SimReport, dict]:
+                   knobs: LoopKnobs | None = None,
+                   faults=None) -> tuple[SimReport, dict]:
     """The wind tunnel's entry point: replay ``trace`` over ``fleet``
     through the native engine loop. Returns (report, stats) — the
     report is scorecard-compatible with :func:`run_sim` and, at default
-    knobs, byte-identical to it."""
+    knobs, byte-identical to it (with or without a ``faults``
+    schedule)."""
     loop = EngineLoop(fleet, knobs)
-    report = loop.run(trace)
+    report = loop.run(trace, faults=faults)
     return report, loop.stats()

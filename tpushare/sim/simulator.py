@@ -97,9 +97,21 @@ class _Node:
             else MeshTopology.for_chip_count(chips)
         self.hbm = hbm
         self.used = [0] * chips
+        # fault state (ISSUE 13): a down node schedules nothing; a
+        # degraded chip is permanently out of the healthy set
+        self.down = False
+        self.unhealthy: set[int] = set()
+
+    def chip_healthy(self, i: int) -> bool:
+        return not self.down and i not in self.unhealthy
 
     def views(self) -> list[ChipView]:
-        return [ChipView(i, self.topo.coords(i), self.hbm, u)
+        if not self.down and not self.unhealthy:
+            # healthy fast path: identical objects to the pre-fault code
+            return [ChipView(i, self.topo.coords(i), self.hbm, u)
+                    for i, u in enumerate(self.used)]
+        return [ChipView(i, self.topo.coords(i), self.hbm, u,
+                         self.chip_healthy(i))
                 for i, u in enumerate(self.used)]
 
 
@@ -136,6 +148,8 @@ class Fleet:
 # -- policies: (fleet, request) -> (node_index, chip_ids) or None ------------
 
 def _eligible(view: ChipView, req: PlacementRequest) -> bool:
+    if not view.healthy:
+        return False
     if req.hbm_mib == 0:
         return view.used_hbm_mib == 0
     return view.free_hbm_mib >= req.hbm_mib
@@ -242,6 +256,12 @@ class SimReport:
     noop_preemptions: int = 0
     hp_mean_wait: float = 0.0
     hp_p99_wait: float = 0.0
+    # fault schedule (ISSUE 13): events consumed from the trace's fault
+    # list, and running pods killed by node_down(lose_pods=True) —
+    # those restart with full duration, so fault cost lands in the
+    # victims' wait tail exactly like preemption evictions
+    faults_applied: int = 0
+    fault_lost_pods: int = 0
     waits: list[float] = field(default_factory=list, repr=False)
 
     def scorecard(self) -> dict:
@@ -270,8 +290,17 @@ class SimReport:
 
 
 def run_sim(fleet: Fleet, trace: list[SimPod],
-            policy: str = "binpack", preempt: str = "off") -> SimReport:
+            policy: str = "binpack", preempt: str = "off",
+            faults: list | None = None) -> SimReport:
     """Run one policy over one trace. Deterministic for a given input.
+
+    ``faults`` is an optional :class:`tpushare.sim.traces.FaultEvent`
+    schedule (see :func:`tpushare.sim.traces.synth_faults`). Fault
+    events enter the same event heap with a kind that sorts BEFORE
+    departures and arrivals at equal times, so both engines observe
+    the fault at the same instant; the native engine loop consumes the
+    identical list and must produce a byte-identical report
+    (tests/test_sim_faults.py).
 
     ``preempt`` models priority preemption for arrivals that fit nowhere:
 
@@ -298,11 +327,15 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
     place = policy if callable(policy) else POLICIES[policy]
     policy = policy if isinstance(policy, str) \
         else getattr(policy, "policy_name", "custom")
-    # event heap: (time, kind, seq, payload); kind 0=departure, 1=arrival
-    # (departures first at equal times: free capacity before retrying)
+    # event heap: (time, kind, seq, payload); kind -1=fault,
+    # 0=departure, 1=arrival (faults first at equal times — the fleet
+    # changes state before capacity frees or pods land; then
+    # departures: free capacity before retrying)
     heap: list[tuple] = []
     for seq, pod in enumerate(sorted(trace, key=lambda p: p.arrival)):
         heapq.heappush(heap, (pod.arrival, 1, seq, pod))
+    for fidx, ev in enumerate(faults or []):
+        heapq.heappush(heap, (ev.time, -1, fidx, ev))
     pending: list[SimPod] = []
     waits: list[float] = []
     hp_waits: list[float] = []
@@ -311,6 +344,9 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
     evictions = 0
     wasted_evictions = 0
     noop_preemptions = 0
+    faults_applied = 0
+    fault_lost = 0
+    stalled = 0  # open brownout/replica-crash windows: scheduling pauses
     # seq2 id -> (pod, node_index, chip_ids, demand); departures whose id
     # is in `cancelled` were evicted and are skipped lazily
     active: dict[int, tuple] = {}
@@ -410,7 +446,8 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
                         for cid in e[2]:
                             freed[cid] = freed.get(cid, 0) + e[3]
                     views = [ChipView(i, node.topo.coords(i), node.hbm,
-                                      u - freed.get(i, 0))
+                                      u - freed.get(i, 0),
+                                      node.chip_healthy(i))
                              for i, u in enumerate(node.used)]
                     return select_chips_py(views, node.topo, req) is not None
                 chosen = []
@@ -454,8 +491,42 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
         now = t
         if busy_start is None:
             busy_start = t
-        if kind == 1:  # arrival
-            if not try_place(payload):
+        if kind == -1:  # fault event (traces.FaultEvent)
+            ev = payload
+            faults_applied += 1
+            if ev.kind in ("brownout_start", "replica_crash"):
+                stalled += 1
+            elif ev.kind in ("brownout_end", "replica_restart"):
+                stalled = max(0, stalled - 1)
+            elif ev.kind == "node_down":
+                node = fleet.nodes[ev.node]
+                node.down = True
+                if ev.lose_pods:
+                    # crash: running pods die and restart — free their
+                    # chips, cancel their queued departures lazily, and
+                    # requeue with full duration (waits keep the
+                    # original arrival, like preemption evictions)
+                    for vid in sorted(v for v, e in active.items()
+                                      if e[1] == ev.node):
+                        pod, ni, chip_ids, demand = active.pop(vid)
+                        for cid in chip_ids:
+                            fleet.nodes[ni].used[cid] -= demand
+                        cancelled.add(vid)
+                        fault_lost += 1
+                        pending.append(pod)
+            elif ev.kind == "node_up":
+                fleet.nodes[ev.node].down = False
+            elif ev.kind == "degrade":
+                fleet.nodes[ev.node].unhealthy.update(ev.chips)
+            # any fault may have moved capacity or schedulability
+            # (restored node, killed pods freeing room elsewhere via
+            # restarts, healed brownout) — retry unless still stalled
+            if stalled == 0:
+                pending = [q for q in pending if not try_place(q)]
+        elif kind == 1:  # arrival
+            if stalled:
+                pending.append(payload)  # apiserver dark: nothing binds
+            elif not try_place(payload):
                 attempted = preempt != "off" and payload.priority > 0
                 if not (attempted and try_preempt(payload)):
                     pending.append(payload)
@@ -479,6 +550,8 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
             node = fleet.nodes[ni]
             for cid in chip_ids:
                 node.used[cid] -= demand
+            if stalled:
+                continue  # capacity freed, but nothing can bind now
             still = []
             for pod in pending:
                 if not try_place(pod):
@@ -504,6 +577,8 @@ def run_sim(fleet: Fleet, trace: list[SimPod],
         noop_preemptions=noop_preemptions,
         hp_mean_wait=sum(hp_waits) / len(hp_waits) if hp_waits else 0.0,
         hp_p99_wait=_p99(hp_waits),
+        faults_applied=faults_applied,
+        fault_lost_pods=fault_lost,
         waits=waits,
     )
 

@@ -179,3 +179,119 @@ def synth_fleet(n_nodes: int, chips: int = 4, hbm: int = 16384,
     Fleet.homogeneous, named so call sites read as what they are —
     bench.py builds 50k-node fleets through this."""
     return Fleet.homogeneous(n_nodes, chips, hbm, mesh)
+
+
+# -- fault schedules (the fault-domain wind tunnel, ISSUE 13) ----------------
+
+# FaultEvent.kind values. Node-scoped kinds carry ``node`` (and
+# ``chips`` for degradation); the fleet-scoped stall kinds
+# (brownout / replica crash) carry no target — the sim models one
+# logical scheduler, so any of them pauses scheduling; the chaos
+# conductor maps ``replica`` onto a real process instead.
+FAULT_KINDS = ("node_down", "node_up", "degrade",
+               "brownout_start", "brownout_end",
+               "replica_crash", "replica_restart")
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One deterministic fault at one sim instant.
+
+    - ``node_down`` / ``node_up``: the node becomes unschedulable /
+      schedulable again. ``lose_pods`` on the down edge kills every
+      running pod on the node (they restart: full duration, wait keyed
+      to original arrival — a crash); False models NotReady (running
+      pods survive, nothing new lands).
+    - ``degrade``: ``chips`` drop out of the node's healthy set
+      permanently (an HBM/ICI fault shrinking the chip set). Running
+      pods on those chips finish; nothing new lands on them.
+    - ``brownout_start`` / ``brownout_end``: the apiserver goes dark —
+      scheduling stalls (arrivals queue, departures free capacity but
+      nothing retries) until the window closes.
+    - ``replica_crash`` / ``replica_restart``: a scheduler replica
+      dies and comes back. In the sim this is a scheduling stall like
+      a brownout; the chaos conductor kills/restarts the real process
+      ``replica`` names.
+    """
+
+    time: float
+    kind: str
+    node: int = -1
+    chips: tuple[int, ...] = ()
+    lose_pods: bool = False
+    replica: int = -1
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.time < 0:
+            raise ValueError("fault time must be >= 0")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Knobs of one seeded fault schedule. Everything is a pure
+    function of ``seed`` (``random.Random``, no wall clock), so the
+    same spec replays byte-identically in run_sim, the native engine
+    loop, and the real-fleet chaos conductor."""
+
+    hours: float = 24.0
+    n_nodes: int = 8
+    chips_per_node: int = 4
+    node_crashes: int = 1        # down windows that KILL running pods
+    notready_windows: int = 1    # down windows running pods survive
+    degradations: int = 1        # permanent chip-set shrinks
+    brownouts: int = 1           # apiserver-dark windows
+    replica_crashes: int = 1     # scheduler replica crash+restart pairs
+    replicas: int = 2
+    mean_outage: float = 0.5     # expovariate outage length (time units)
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.hours <= 0 or self.n_nodes <= 0 \
+                or self.chips_per_node <= 0 or self.mean_outage <= 0:
+            raise ValueError("bad fault spec (hours/n_nodes/"
+                             "chips_per_node/mean_outage must be > 0)")
+        if min(self.node_crashes, self.notready_windows,
+               self.degradations, self.brownouts,
+               self.replica_crashes) < 0 or self.replicas < 1:
+            raise ValueError("fault counts must be >= 0, replicas >= 1")
+
+
+def synth_faults(spec: FaultSpec) -> list[FaultEvent]:
+    """Materialize the schedule: paired down/up windows clamped inside
+    ``hours``, sorted by time (stable, so the draw order breaks ties
+    deterministically). Both sim engines consume this list as-is, and
+    the chaos conductor replays the same objects against real
+    processes — one schedule, three consumers."""
+    rng = random.Random(spec.seed)
+    events: list[FaultEvent] = []
+
+    def window(kind_down: str, kind_up: str, **kw) -> None:
+        t0 = rng.uniform(0.0, spec.hours)
+        t1 = min(t0 + rng.expovariate(1.0 / spec.mean_outage),
+                 spec.hours)
+        events.append(FaultEvent(time=t0, kind=kind_down, **kw))
+        events.append(FaultEvent(time=t1, kind=kind_up,
+                                 node=kw.get("node", -1),
+                                 replica=kw.get("replica", -1)))
+
+    for _ in range(spec.node_crashes):
+        window("node_down", "node_up",
+               node=rng.randrange(spec.n_nodes), lose_pods=True)
+    for _ in range(spec.notready_windows):
+        window("node_down", "node_up",
+               node=rng.randrange(spec.n_nodes), lose_pods=False)
+    for _ in range(spec.degradations):
+        k = 1 + rng.randrange(max(1, spec.chips_per_node // 2))
+        events.append(FaultEvent(
+            time=rng.uniform(0.0, spec.hours), kind="degrade",
+            node=rng.randrange(spec.n_nodes),
+            chips=tuple(sorted(rng.sample(range(spec.chips_per_node),
+                                          k)))))
+    for _ in range(spec.brownouts):
+        window("brownout_start", "brownout_end")
+    for _ in range(spec.replica_crashes):
+        window("replica_crash", "replica_restart",
+               replica=rng.randrange(spec.replicas))
+    return sorted(events, key=lambda e: e.time)
