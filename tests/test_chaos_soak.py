@@ -385,6 +385,169 @@ def test_chaos_soak_through_http_front_end():
     assert r["front_end"] == "SelectorHTTPServer", r["front_end"]
 
 
+def _leg_partition_soak(fail_verb: str, seed: int) -> None:
+    """ISSUE 14 satellite: the bind write path is now PIPELINED — the
+    annotation PATCH and the binding POST are concurrently in flight —
+    so partition exactly ONE leg mid-flight and hold the PR-13 sweep:
+    zero oversubscription on apiserver truth at every instant, no pod
+    left unbound with placement annotations, every bound-but-unannotated
+    orphan resolved (repaired or loudly counted) within a bounded
+    window, and cache == truth after resync."""
+    from tpushare.cache.nodeinfo import BIND_PIPELINE
+
+    fc = FakeCluster()
+    names = ["n0", "n1"]
+    for n in names:
+        fc.add_tpu_node(n, chips=4, hbm_per_chip_mib=HBM_PER_CHIP,
+                        mesh="2x2")
+    chaos = ChaosCluster(fc, seed=seed)
+    policy = RetryPolicy(max_attempts=3, base_s=0.002, cap_s=0.01,
+                         rng=random.Random(seed))
+    # the 8 dropped legs legitimately trip the breaker (5 consecutive
+    # transport failures); scale its reset window down to this soak's
+    # millisecond timescale like the retry policy above, or the
+    # post-heal retries all fast-fail inside the production 5 s window
+    cluster = harden(chaos, breaker=CircuitBreaker(reset_timeout_s=0.2),
+                     policy=policy)
+    cache = SchedulerCache(cluster)
+    ctl = Controller(cluster, cache, resync_seconds=0.2)
+    ctl.build_cache()
+    ctl.start()
+    registry = Registry()
+    fil = FilterHandler(cache, registry)
+    binder = BindHandler(cache, cluster, registry)
+    pipeline_before = BIND_PIPELINE.snapshot()
+
+    # the partition: ONE leg of the pipelined pair drops its transport
+    # (status=0) for the first injections while the storm is in flight;
+    # the OTHER leg keeps landing, which is exactly the partial-failure
+    # state the pipelining introduced
+    chaos.fail(fail_verb, status=0, times=8)
+
+    overcommit: list = []
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            per: dict = {}
+            for pod in fc.list_pods():
+                if contract.is_complete_pod(pod):
+                    continue
+                node = pod["spec"].get("nodeName")
+                ids = contract.chip_ids_from_annotations(pod)
+                if not node or ids is None:
+                    continue
+                h = contract.hbm_from_annotations(pod)
+                for c in ids:
+                    per[(node, c)] = per.get((node, c), 0) + h
+            for k, v in per.items():
+                if v > HBM_PER_CHIP:
+                    overcommit.append((k, v))
+            time.sleep(0.001)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+
+    hbm = 2048
+    pods = [fc.create_pod(make_pod(hbm=hbm, name=f"lp{i}"))
+            for i in range(8)]
+
+    def schedule(pod) -> bool:
+        ns, name = pod["metadata"]["namespace"], pod["metadata"]["name"]
+        for attempt in range(60):
+            # a pod our POST already bound mid-partition must not be
+            # re-driven through the webhook: it IS placed
+            fresh = fc.peek_pod(ns, name)
+            if fresh is not None and fresh["spec"].get("nodeName"):
+                return True
+            res = fil.handle({"Pod": pod, "NodeNames": names})
+            nodes = res["NodeNames"]
+            if not nodes:
+                time.sleep(0.003)
+                continue
+            with request_deadline(1.0):
+                out = binder.handle({
+                    "PodNamespace": ns, "PodName": name,
+                    "PodUID": pod["metadata"]["uid"],
+                    "Node": nodes[attempt % len(nodes)]})
+            if out["Error"] == "":
+                return True
+            time.sleep(0.002)
+        return False
+
+    try:
+        with ThreadPoolExecutor(4) as ex:
+            results = list(ex.map(schedule, pods))
+        chaos.clear()  # partition heals
+        results = [ok or schedule(pods[i])
+                   for i, ok in enumerate(results)]
+    finally:
+        stop.set()
+        sampler_t.join(timeout=2)
+
+    assert all(results), "pods never bound through the leg partition"
+    assert sum(chaos.injected.values()) > 0, \
+        "the partition injected nothing; this proved nothing"
+
+    # bounded-window orphan resolution: every bind-first partial failure
+    # must be RESOLVED (annotations repaired, found moot, or loudly
+    # orphaned) — a repair stuck in flight past the window is a leak
+    def repairs_resolved() -> bool:
+        now = BIND_PIPELINE.snapshot()
+
+        def moved(k):
+            return now.get((k,), 0) - pipeline_before.get((k,), 0)
+        return moved("bind_first_repair") == (
+            moved("repair_ok") + moved("repair_moot")
+            + moved("repair_orphaned"))
+    window_end = time.monotonic() + 8.0
+    while time.monotonic() < window_end and not repairs_resolved():
+        time.sleep(0.02)
+    assert repairs_resolved(), \
+        f"async annotation repairs unresolved: {BIND_PIPELINE.snapshot()}"
+
+    ctl.resync_once()
+    ctl.drain(timeout=10.0)
+    ctl.stop()
+
+    assert not overcommit, f"oversubscription under leg partition: " \
+        f"{overcommit[:3]}"
+    # truth sweep: no unbound pod may carry placement annotations, and
+    # bound+annotated pods must account for every cache-held chip
+    per_chip: dict = {}
+    for pod in fc.list_pods():
+        if contract.is_complete_pod(pod):
+            continue
+        node = pod["spec"].get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        if ids is None:
+            continue  # an orphaned bound pod was already counted above
+        assert node, \
+            f"unbound pod {pod['metadata']['name']} kept annotations"
+        for cid in ids:
+            per_chip[(node, cid)] = per_chip.get((node, cid), 0) + hbm
+    assert max(per_chip.values(), default=0) <= HBM_PER_CHIP
+    tree = cache.describe()
+    for node in tree["nodes"]:
+        for chip in node["chips"]:
+            want = per_chip.get((node["name"], chip["idx"]), 0)
+            assert chip["used_hbm_mib"] == want, (
+                node["name"], chip["idx"], chip["used_hbm_mib"], want)
+
+
+def test_pipelined_bind_leg_partition_post_leg():
+    """The binding POST leg is partitioned: the PATCH lands, the POST
+    dies — the allocator must roll back and the retry must converge."""
+    _leg_partition_soak("bind_pod", seed=140001)
+
+
+def test_pipelined_bind_leg_partition_patch_leg():
+    """The annotation PATCH leg is partitioned: the POST lands first —
+    forward-only repair territory (a bound pod's chips must never be
+    rolled back), healed asynchronously once the partition lifts."""
+    _leg_partition_soak("patch_pod", seed=140002)
+
+
 @pytest.mark.slow
 def test_chaos_soak_rolling_brownout():
     """The full soak: three rolling brownout waves over several seconds,

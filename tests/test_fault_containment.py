@@ -289,14 +289,23 @@ def test_chaos_brownout_edges_are_quiet():
 # -- transport replay safety (k8s/incluster.py) -------------------------------
 
 class _DeadConn:
-    sock = None
+    """A reused connection that PASSES the recv-before-send staleness
+    probe (its socket is real and quiet) and then dies mid-request: the
+    probe-miss race window — a close racing the request itself — that
+    the replay-safety rule exists for."""
+
     timeout = None
 
+    def __init__(self):
+        import socket
+        self.sock, self._peer = socket.socketpair()
+
     def request(self, *a, **k):
-        raise http.client.CannotSendRequest("stale keep-alive")
+        raise http.client.CannotSendRequest("died mid-request")
 
     def close(self):
-        pass
+        self.sock.close()
+        self._peer.close()
 
 
 class _GoodResp:

@@ -51,6 +51,10 @@ RANKS = {
     # guards only the pending-window dict and is NEVER held across the
     # solve or any cache/node call — the leader pops its window first)
     ("gang.py", "self._lock"): 5,           # gang coordinator
+    ("wirecache.py", "self._lock"): 6,      # wire digest map (leftmost
+    # family: guards only the digest->entry OrderedDict bookkeeping and
+    # is NEVER held across a parse, a solve, or any cache/node call —
+    # decode copies the entry reference out and releases before work)
     ("cache.py", "self._stripes.for_key"): 10,   # node-map stripes
     ("index.py", "self._flush_lock"): 15,   # whole-flush serialization
     ("nodeinfo.py", "self._lock"): 20,      # per-node chip state

@@ -258,10 +258,25 @@ class SchedulerCache:
         # lock needed for a statistical 1-in-N)
         import itertools
         self._verify_ctr = itertools.count()
+        # fleet-wide mutation stamp for the wire-plane response cache
+        # (extender/wirecache.py): bumped on EVERY node mutation via the
+        # same _on_mutate hook that feeds the index, plus node adopt/
+        # remove/ownership changes. Plain int under the GIL: concurrent
+        # bumps may lose increments, but the value still CHANGES, and
+        # the wirecache only ever tests equality — a lost increment can
+        # only force an extra recompute, never a stale serve.
+        self._wire_gen = 0
         # flipped by build_cache: /readyz refuses traffic until the
         # startup replay has reconstructed chip assignments (a bind
         # against an un-replayed cache could oversubscribe)
         self.built = False
+
+    def mutation_stamp(self) -> int:
+        """Monotonically-changing fleet mutation stamp (see _wire_gen).
+        Equal stamps => no node adopted, removed, mutated, or re-owned
+        in between, so any verdict computed at the first read is still
+        byte-identical at the second."""
+        return self._wire_gen
 
     def _adopt_node_info(self, info: NodeInfo) -> None:
         """Wire a newly tracked NodeInfo into the capacity index: its
@@ -270,8 +285,13 @@ class SchedulerCache:
         built at the next flush."""
         name = info.name
         index = self._index
-        info._on_mutate = lambda: index.mark_dirty(name)
+
+        def on_mutate() -> None:
+            index.mark_dirty(name)
+            self._wire_gen += 1  # leaf int bump, legal under the node lock
+        info._on_mutate = on_mutate
         index.mark_dirty(name)
+        self._wire_gen += 1
 
     # -- node access ----------------------------------------------------------
 
@@ -328,6 +348,7 @@ class SchedulerCache:
     def remove_node(self, node_name: str) -> None:
         with self._stripes.for_key(node_name):
             self._nodes.pop(node_name, None)
+        self._wire_gen += 1
         # no fleet-wide invalidation: a removed node has no live
         # NodeInfo, so its memoized stamps can never validate again.
         # The index summary and the arena slot ARE dropped eagerly —
@@ -354,6 +375,7 @@ class SchedulerCache:
         footprint and flush work shrink to ~1/N."""
         self._owned = owned
         self._index.set_owned(owned)
+        self._wire_gen += 1
         names = self.node_names()
         for n in names:
             self._index.mark_dirty(n)

@@ -26,6 +26,7 @@ from tpushare.cache.nodeinfo import no_fit_reason, request_from_pod
 from tpushare.contract import pod as podlib
 from tpushare.core.placement import fragmentation, utilization_pct
 from tpushare.extender.metrics import LATENCY_BUCKETS, Registry
+from tpushare.extender.wirecache import WireEncoded
 from tpushare.ha.sharding import SHARD_CONFLICTS
 from tpushare.k8s.breaker import OPEN as BREAKER_IS_OPEN
 from tpushare.k8s.client import ApiError
@@ -63,9 +64,15 @@ class FilterHandler:
 
     def __init__(self, cache: SchedulerCache, registry: Registry,
                  gang=None, breaker=None, staleness_fn=None,
-                 tracer=None, explain=None, batcher=None) -> None:
+                 tracer=None, explain=None, batcher=None,
+                 wire=None) -> None:
         self._cache = cache
         self._gang = gang  # GangCoordinator | None
+        # wire-plane cache (extender/wirecache.py): when the server front
+        # end digest-decoded this request, the encoded reply is cached
+        # under (digest, request signature, mutation stamp) and served as
+        # raw bytes. None = always compute + dict-encode (direct callers).
+        self._wire = wire
         # batched decision cycles (cache/batch.py BatchPlanner):
         # concurrently-arriving same-signature pods coalesce into one
         # multi-pod native solve; a member's Filter answers with its
@@ -89,27 +96,34 @@ class FilterHandler:
         self._filter_latency = registry.histogram(
             "tpushare_filter_seconds", "Filter latency", LATENCY_BUCKETS)
 
-    def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+    def handle(self, args: dict[str, Any],
+               wire_ctx=None) -> dict[str, Any] | WireEncoded:
         with api_origin("filter"):
-            return self._handle(args)
+            return self._handle(args, wire_ctx)
 
-    def _handle(self, args: dict[str, Any]) -> dict[str, Any]:
+    def _handle(self, args: dict[str, Any],
+                wire_ctx=None) -> dict[str, Any] | WireEncoded:
         t0 = time.perf_counter()
         self._filter_total.inc()
         pod = args.get("Pod") or {}
         pod_key = podlib.pod_cache_key(pod)
         trace = self._tracer.begin_cycle(pod_key, pod)
         with self._tracer.root_span(trace, "filter") as sp:
-            result = self._filter(args, pod, pod_key, trace, sp)
-            sp.set_tags(ok=len(result["NodeNames"]),
-                        failed=len(result["FailedNodes"]))
+            result = self._filter(args, pod, pod_key, trace, sp, wire_ctx)
+            if isinstance(result, WireEncoded):
+                sp.set_tags(ok=result.ok, failed=result.failed,
+                            wire=result.outcome)
+            else:
+                sp.set_tags(ok=len(result["NodeNames"]),
+                            failed=len(result["FailedNodes"]))
         self._filter_latency.observe(
             time.perf_counter() - t0,
             exemplar=trace.trace_id if trace else None)
         return result
 
     def _filter(self, args: dict[str, Any], pod: dict[str, Any],
-                pod_key: str, trace, sp) -> dict[str, Any]:
+                pod_key: str, trace, sp,
+                wire_ctx=None) -> dict[str, Any] | WireEncoded:
         if self._breaker is not None and \
                 self._breaker.state == BREAKER_IS_OPEN:
             DEGRADED_SERVES.inc("filter")
@@ -185,6 +199,24 @@ class FilterHandler:
                           spec.batch_size)
                 return {"NodeNames": [spec.node], "FailedNodes": {},
                         "Error": ""}
+        wire, wire_key, wire_hit = self._wire, None, None
+        if wire is not None and wire_ctx is not None and req is not None \
+                and (self._batcher is None or not self._batcher.enabled):
+            # response cache: same digest + same request signature + no
+            # cache mutation since => byte-identical verdict. Batched
+            # deployments bypass (a hit would dodge the batch window).
+            wire_key = req  # frozen dataclass: the signature IS the key
+            wire_hit = wire.lookup(wire_ctx, "filter", wire_key)
+            if wire_hit is not None and not wire.verify:
+                wire.served_hit("filter")
+                if self._explain is not None:
+                    self._explain.record_wire(
+                        pod_key, pod, trace_id, "filter",
+                        ok=wire_hit.ok, candidates=wire_hit.ok
+                        + wire_hit.failed)
+                log.debug("filter %s: wirecache hit (%d ok / %d failed)",
+                          podlib.pod_key(pod), wire_hit.ok, wire_hit.failed)
+                return wire_hit
         if req is None:
             # not a tpushare pod: nothing to check (handler shouldn't even
             # be consulted thanks to managedResources, but be permissive)
@@ -237,6 +269,14 @@ class FilterHandler:
         audit(verdicts)
         log.debug("filter %s: %d ok / %d failed",
                   podlib.pod_key(pod), len(ok_nodes), len(failed))
+        if wire_key is not None:
+            # transient fetch failures ("node unavailable: ...") are never
+            # memoized — the node's recovery would not bump the stamp
+            cacheable = not any(r.startswith("node unavailable:")
+                                for r in failed.values())
+            return wire.finish_filter(wire_ctx, wire_key, ok_nodes, failed,
+                                      cacheable=cacheable,
+                                      expected=wire_hit)
         return {"NodeNames": ok_nodes, "FailedNodes": failed, "Error": ""}
 
 
@@ -261,8 +301,10 @@ class PrioritizeHandler:
     MAX_PRIORITY = 10  # k8s MaxExtenderPriority
 
     def __init__(self, cache: SchedulerCache, registry: Registry,
-                 breaker=None, tracer=None, explain=None) -> None:
+                 breaker=None, tracer=None, explain=None,
+                 wire=None) -> None:
         self._cache = cache
+        self._wire = wire  # wire-plane response cache, like Filter
         self._breaker = breaker  # degraded-mode accounting, like Filter
         self._tracer = tracer or TRACER  # joins the cycle Filter opened
         self._explain = explain  # ExplainStore | None
@@ -272,25 +314,28 @@ class PrioritizeHandler:
             "tpushare_prioritize_seconds", "Prioritize latency",
             LATENCY_BUCKETS)
 
-    def handle(self, args: dict[str, Any]) -> list[dict[str, Any]]:
+    def handle(self, args: dict[str, Any],
+               wire_ctx=None) -> list[dict[str, Any]] | WireEncoded:
         with api_origin("prioritize"):
-            return self._handle(args)
+            return self._handle(args, wire_ctx)
 
-    def _handle(self, args: dict[str, Any]) -> list[dict[str, Any]]:
+    def _handle(self, args: dict[str, Any],
+                wire_ctx=None) -> list[dict[str, Any]] | WireEncoded:
         t0 = time.perf_counter()
         self._prioritize_total.inc()
         pod = args.get("Pod") or {}
         pod_key = podlib.pod_cache_key(pod)
         trace = self._tracer.join_or_begin(pod_key, pod)
         with self._tracer.root_span(trace, "prioritize") as sp:
-            out = self._prioritize(args, pod, pod_key, trace, sp)
+            out = self._prioritize(args, pod, pod_key, trace, sp, wire_ctx)
         self._prioritize_latency.observe(
             time.perf_counter() - t0,
             exemplar=trace.trace_id if trace else None)
         return out
 
     def _prioritize(self, args: dict[str, Any], pod: dict[str, Any],
-                    pod_key: str, trace, sp) -> list[dict[str, Any]]:
+                    pod_key: str, trace, sp,
+                    wire_ctx=None) -> list[dict[str, Any]] | WireEncoded:
         if self._breaker is not None and \
                 self._breaker.state == BREAKER_IS_OPEN:
             DEGRADED_SERVES.inc("prioritize")
@@ -302,12 +347,32 @@ class PrioritizeHandler:
                           for n in items]
         node_names = [n for n in node_names if n]
         req = request_from_pod(pod)
+        wire, wire_key, wire_hit = self._wire, None, None
+        if wire is not None and wire_ctx is not None and req is not None:
+            wire_key = req
+            wire_hit = wire.lookup(wire_ctx, "prioritize", wire_key)
+            if wire_hit is not None and not wire.verify:
+                wire.served_hit("prioritize")
+                if wire_hit.best is not None:
+                    # keep Bind's seed hint warm exactly like a computed
+                    # pass would (the hint is stamp-revalidated there)
+                    self._cache.memo_best_placement(pod, req, wire_hit.best)
+                sp.set_tags(candidates=wire_hit.count, best=wire_hit.best,
+                            wire="hit")
+                if self._explain is not None:
+                    self._explain.record_wire(
+                        pod_key, pod, trace.trace_id if trace else None,
+                        "prioritize", best=wire_hit.best,
+                        candidates=wire_hit.count)
+                return wire_hit
+        had_errors = False
         raw: dict[str, int | None] = {}  # name -> leftover score (lower=tighter)
         if req is not None:
             # the memoized fleet pass: when Filter just ran for this pod
             # (the normal webhook sequence), this is a pure dict read —
             # zero native scans, zero snapshot assembly
             scores, errors = self._cache.score_nodes(pod, req, node_names)
+            had_errors = bool(errors)
             for name in node_names:
                 raw[name] = None if name in errors else scores.get(name)
         fitting = [s for s in raw.values() if s is not None]
@@ -340,6 +405,11 @@ class PrioritizeHandler:
             self._explain.record_prioritize(
                 pod_key, pod, trace.trace_id if trace else None,
                 {h["Host"]: h["Score"] for h in out}, best_name)
+        if wire_key is not None:
+            return wire.finish_prioritize(wire_ctx, wire_key, out,
+                                          best_name,
+                                          cacheable=not had_errors,
+                                          expected=wire_hit)
         return out
 
 
@@ -921,6 +991,20 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
     registry.register(TRACES_TOTAL)
     registry.register(METRIC_SERIES_CLAMPED)
     registry.register(ALLOCATE_SECONDS)
+    # wire-plane set (extender/wirecache.py + the k8s transport): digest
+    # and response cache outcomes, the stale-serve tripwire, candidate-
+    # list sizes, pipelined-bind leg outcomes, and keep-alive pool reuse
+    from tpushare.cache.nodeinfo import BIND_PIPELINE
+    from tpushare.extender.wirecache import (
+        WIRE_CANDIDATES, WIRE_DIGEST, WIRE_RESPONSES, WIRE_STALE_SERVES)
+    from tpushare.k8s.stats import CONN_POOL_REQUESTS
+
+    registry.register(WIRE_DIGEST)
+    registry.register(WIRE_RESPONSES)
+    registry.register(WIRE_STALE_SERVES)
+    registry.register(WIRE_CANDIDATES)
+    registry.register(BIND_PIPELINE)
+    registry.register(CONN_POOL_REQUESTS)
     register_build_info(registry)
 
 

@@ -39,6 +39,7 @@ from tpushare.extender.handlers import (
     PrioritizeHandler,
 )
 from tpushare.extender.metrics import Registry
+from tpushare.extender.wirecache import WireEncoded
 from tpushare.ha.forward import FORWARD_HEADER, ForwardRouter
 
 log = logging.getLogger("tpushare.extender.http")
@@ -124,16 +125,24 @@ class ExtenderServer:
         # layer entirely — quiet deployments pay nothing.
         from tpushare.cache.batch import BatchPlanner
         self.batcher = BatchPlanner(cache)
+        # wire-plane cache (extender/wirecache.py): digest-keyed decode
+        # of the fleet-size NodeNames list + pre-encoded responses,
+        # stamp-revalidated against cache mutations. TPUSHARE_NO_WIRECACHE=1
+        # opts out; TPUSHARE_WIRE_VERIFY=1 recomputes every hit.
+        from tpushare.extender.wirecache import WireCache
+        self.wirecache = WireCache(cache)
         self.filter_handler = FilterHandler(cache, self.registry,
                                             gang=self.gang, breaker=breaker,
                                             staleness_fn=staleness_fn,
                                             tracer=self.tracer,
                                             explain=self.explain,
-                                            batcher=self.batcher)
+                                            batcher=self.batcher,
+                                            wire=self.wirecache)
         self.prioritize_handler = PrioritizeHandler(cache, self.registry,
                                                     breaker=breaker,
                                                     tracer=self.tracer,
-                                                    explain=self.explain)
+                                                    explain=self.explain,
+                                                    wire=self.wirecache)
         self.preempt_handler = PreemptHandler(cache, self.registry)
         # HA (an elector is wired): binds also CAS a per-node claim so two
         # replicas in a stale-leader window cannot co-place onto one chip;
@@ -198,8 +207,15 @@ class ExtenderServer:
         only needs a case-insensitive-enough ``get`` (the loop-guard
         header is looked up by its canonical name).
         """
+        wctx = None
         try:
-            args = json.loads(raw) if raw else {}
+            if self.wirecache is not None and _POST_ROUTES.get(path) in (
+                    "filter", "prioritize"):
+                # digest-cached decode: a steady-storm repeat of the same
+                # fleet-size candidate list parses ~0 of its bytes
+                args, wctx = self.wirecache.decode(raw)
+            else:
+                args = json.loads(raw) if raw else {}
         except json.JSONDecodeError as e:
             return _enc(400, {"error": f"bad JSON: {e}"})
         try:
@@ -208,14 +224,14 @@ class ExtenderServer:
             # included — and stops before the scheduler's httpTimeout
             from tpushare.k8s.retry import request_deadline
             with request_deadline(self.request_deadline_s):
-                return self._post_routed(path, raw, args, headers)
+                return self._post_routed(path, raw, args, headers, wctx)
         except Exception as e:  # noqa: BLE001 — webhook must answer
             log.error("POST %s crashed: %s\n%s", path, e,
                       traceback.format_exc())
             return _enc(500, {"Error": f"internal error: {e}"})
 
     def _post_routed(self, path: str, raw: bytes, args: Any,
-                     headers) -> tuple[int, bytes, str]:
+                     headers, wctx=None) -> tuple[int, bytes, str]:
         route = _POST_ROUTES.get(path)
         if route in ("filter", "prioritize", "bind") and \
                 self.forwarder is not None:
@@ -227,9 +243,15 @@ class ExtenderServer:
                 # the owner's verdict, relayed verbatim
                 return fwd[0], fwd[1], "application/json"
         if route == "filter":
-            return _enc(200, self.filter_handler.handle(args))
+            out = self.filter_handler.handle(args, wire_ctx=wctx)
+            if isinstance(out, WireEncoded):
+                return 200, out.body, "application/json"
+            return _enc(200, out)
         if route == "prioritize":
-            return _enc(200, self.prioritize_handler.handle(args))
+            out = self.prioritize_handler.handle(args, wire_ctx=wctx)
+            if isinstance(out, WireEncoded):
+                return 200, out.body, "application/json"
+            return _enc(200, out)
         if route == "preempt":
             return _enc(200, self.preempt_handler.handle(args))
         if route == "bind":

@@ -1,0 +1,320 @@
+"""Wire-plane cache: digest-keyed candidate decode + pre-encoded replies.
+
+The in-memory layers of the hot path are sublinear or native, but the
+webhook *wire* path still pays full per-request Python cost: every
+Filter/Prioritize POST re-parses a fleet-size ``NodeNames`` JSON list
+(50k strings per call at wind-tunnel scale) and re-encodes a fleet-size
+result. The scheduler sends the SAME candidate list every cycle of a
+storm, so both costs are almost pure waste — the bytes on the wire are
+identical request after request.
+
+Three layers, all keyed off the raw bytes:
+
+- **candidate-set digest cache** — locate the ``"NodeNames": [...]``
+  byte-span in the raw body without parsing it (``bytes.rfind`` runs at
+  C speed; the remainder of the body is parsed with the span spliced to
+  ``null``, which doubles as a guard that the located span really was
+  the top-level value). blake2b of the span keys a small LRU of
+  previously parsed, ``sys.intern``-ed name lists: a digest hit decodes
+  a fleet-size request without creating a single name string.
+- **response cache + fragment encoder** — per digest entry, the encoded
+  ``ExtenderFilterResult`` / ``HostPriorityList`` bytes are cached under
+  ``(verb, request signature, cache mutation stamp)``. Any cache/ring
+  mutation bumps the stamp (SchedulerCache.mutation_stamp), so a hit is
+  only served while the fleet state that produced it is untouched —
+  byte-identical to recomputing, which ``TPUSHARE_WIRE_VERIFY=1``
+  enforces by recomputing every hit and counting mismatches in
+  ``tpushare_wire_stale_serves_total`` (serving the fresh truth).
+  Misses encode through an interned name->fragment table, skipping the
+  per-call fleet-size ``json.dumps``.
+- the encoded bytes reproduce ``json.dumps`` byte-for-byte (default
+  separators, default ensure_ascii), so turning the layer off
+  (``TPUSHARE_NO_WIRECACHE=1``) can never change what is on the wire.
+
+Locking: ONE lock guards the digest map and the per-entry response
+tables. It is never held across a parse, a solve, or an encode — lookup
+and store are dict operations; everything expensive happens outside.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+from collections import OrderedDict
+from typing import Any
+
+from tpushare.metrics import Counter, Histogram, LabeledCounter
+
+WIRE_DIGEST = LabeledCounter(
+    "tpushare_wire_digest_total",
+    "Candidate-set digest cache outcomes on the webhook decode path "
+    '("hit": fleet list reused without parsing; "miss": parsed once and '
+    'cached; "bypass": request shape not digestable — absent/odd '
+    "NodeNames, non-list span, or the layer is disabled)",
+    ("outcome",))
+WIRE_RESPONSES = LabeledCounter(
+    "tpushare_wire_responses_total",
+    "Pre-encoded response cache outcomes by webhook verb "
+    '("hit": cached bytes served under an unchanged mutation stamp; '
+    '"encoded": fragment-encoded fresh and cached; "bypass": verdict '
+    "not cacheable — transient node-fetch errors, gang/batched pods, "
+    "or no TPU request)",
+    ("verb", "outcome"))
+WIRE_STALE_SERVES = Counter(
+    "tpushare_wire_stale_serves_total",
+    "Wirecache verify-mode mismatches (TPUSHARE_WIRE_VERIFY=1): a digest "
+    "or response hit whose recomputed truth differed — the truth was "
+    "served. Any nonzero value is a bug in the stamp protocol.")
+WIRE_CANDIDATES = Histogram(
+    "tpushare_wire_candidates",
+    "Candidate-list length per digest-decoded Filter/Prioritize request "
+    "(the fleet-size work the digest cache removes on a hit)",
+    (16, 128, 1024, 8192, 20000, 50000, 100000))
+
+_KEY = b'"NodeNames"'
+_WS = b" \t\r\n"
+# scores are 0..MaxExtenderPriority (10): pre-encode the whole range
+_INT_FRAGS = {i: str(i).encode() for i in range(11)}
+
+
+def _find_span(raw: bytes) -> tuple[int, int] | None:
+    """Byte range of the ``[...]`` array value of the LAST ``"NodeNames"``
+    key in ``raw``, or None. rfind because the fleet list is marshaled
+    last in ExtenderArgs; a spoofed earlier occurrence (e.g. inside a pod
+    annotation string) either fails the splice guard in decode() or IS
+    the top-level value. A ``]`` inside a name makes the span invalid
+    JSON (unterminated string), which the miss-path parse rejects — so a
+    span that parses is exactly the array."""
+    i = raw.rfind(_KEY)
+    if i < 0:
+        return None
+    j, n = i + len(_KEY), len(raw)
+    while j < n and raw[j] in _WS:
+        j += 1
+    if j >= n or raw[j] != 0x3A:  # ':'
+        return None
+    j += 1
+    while j < n and raw[j] in _WS:
+        j += 1
+    if j >= n or raw[j] != 0x5B:  # '['
+        return None
+    k = raw.find(b"]", j)
+    if k < 0:
+        return None
+    return j, k + 1
+
+
+class WireEncoded:
+    """A handler result already encoded to wire bytes (hit or fragment-
+    encoded miss). The server front end sends ``body`` verbatim instead
+    of ``json.dumps``-ing a dict; the counts carry what the trace span
+    and audit record need without re-parsing."""
+
+    __slots__ = ("body", "ok", "failed", "best", "count", "outcome")
+
+    def __init__(self, body: bytes, *, ok: int = 0, failed: int = 0,
+                 best: str | None = None, count: int = 0,
+                 outcome: str = "encoded") -> None:
+        self.body = body
+        self.ok, self.failed = ok, failed
+        self.best, self.count = best, count
+        self.outcome = outcome
+
+
+class _Entry:
+    __slots__ = ("names", "responses")
+
+    def __init__(self, names: list[str]) -> None:
+        self.names = names
+        # (verb, request signature) -> (mutation stamp, WireEncoded)
+        self.responses: dict[tuple, tuple[int, WireEncoded]] = {}
+
+
+class _Ctx:
+    """Per-request decode context: the digest entry plus the mutation
+    stamps read at lookup time, BEFORE the handler computed — a store
+    under a pre-compute stamp can only ever be too conservative."""
+
+    __slots__ = ("entry", "stamps")
+
+    def __init__(self, entry: _Entry) -> None:
+        self.entry = entry
+        self.stamps: dict[tuple, int] = {}
+
+
+class WireCache:
+    MAX_DIGESTS = 64       # distinct candidate sets kept decoded
+    MAX_RESPONSES = 16     # per digest: (verb, sig) response variants
+    MAX_FRAGMENTS = 200_000  # interned name/reason byte fragments
+
+    def __init__(self, cache, *, enabled: bool | None = None,
+                 verify: bool | None = None) -> None:
+        self._cache = cache  # needs .mutation_stamp() -> int
+        if enabled is None:
+            enabled = os.environ.get("TPUSHARE_NO_WIRECACHE", "") != "1"
+        if verify is None:
+            verify = os.environ.get("TPUSHARE_WIRE_VERIFY", "") == "1"
+        self.enabled = enabled
+        self.verify = verify
+        self._entries: OrderedDict[bytes, _Entry] = OrderedDict()
+        self._frags: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    # -- decode ----------------------------------------------------------
+
+    def decode(self, raw: bytes) -> tuple[Any, _Ctx | None]:
+        """Parse one Filter/Prioritize body; digest-hit requests reuse
+        the cached interned name list and decode only the (small)
+        remainder. Raises json.JSONDecodeError exactly like a plain
+        ``json.loads`` would — the caller's 400 path is unchanged."""
+        if not raw:
+            return {}, None
+        if not self.enabled:
+            return json.loads(raw), None
+        span = _find_span(raw)
+        if span is None:
+            WIRE_DIGEST.inc("bypass")
+            return json.loads(raw), None
+        s, e = span
+        digest = hashlib.blake2b(raw[s:e], digest_size=16).digest()
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                self._entries.move_to_end(digest)
+        try:
+            args = json.loads(b"".join((raw[:s], b"null", raw[e:])))
+        except json.JSONDecodeError:
+            # the scan found "]" early (a ] inside a name string): the
+            # splice chopped mid-value. The BODY may still be fine —
+            # only the shortcut failed, so fall back to a plain parse
+            WIRE_DIGEST.inc("bypass")
+            return json.loads(raw), None
+        if not (isinstance(args, dict) and "NodeNames" in args
+                and args["NodeNames"] is None):
+            # the located span was not the top-level NodeNames value
+            # (spoofed key inside a string, nested object, ...): the
+            # splice didn't null it out, so fall back to a plain parse
+            WIRE_DIGEST.inc("bypass")
+            return json.loads(raw), None
+        if entry is None:
+            try:
+                names = json.loads(raw[s:e])
+            except json.JSONDecodeError:
+                WIRE_DIGEST.inc("bypass")
+                return json.loads(raw), None
+            if not isinstance(names, list):
+                WIRE_DIGEST.inc("bypass")
+                args["NodeNames"] = names
+                return args, None
+            names = [sys.intern(n) if type(n) is str else n for n in names]
+            entry = _Entry(names)
+            with self._lock:
+                cur = self._entries.setdefault(digest, entry)
+                if cur is not entry:
+                    entry = cur  # lost a benign race: reuse the winner
+                else:
+                    while len(self._entries) > self.MAX_DIGESTS:
+                        self._entries.popitem(last=False)
+            WIRE_DIGEST.inc("miss")
+        else:
+            if self.verify:
+                truth = json.loads(raw).get("NodeNames")
+                if truth != entry.names:
+                    WIRE_STALE_SERVES.inc()
+                    WIRE_DIGEST.inc("hit")
+                    args["NodeNames"] = truth
+                    return args, None  # serve the truth, skip the entry
+            WIRE_DIGEST.inc("hit")
+        WIRE_CANDIDATES.observe(len(entry.names))
+        args["NodeNames"] = entry.names  # shared: handlers never mutate it
+        return args, _Ctx(entry)
+
+    # -- response cache --------------------------------------------------
+
+    def lookup(self, ctx: _Ctx, verb: str, sig: tuple) -> WireEncoded | None:
+        """Cached encoded response for (digest, verb, sig) at the CURRENT
+        mutation stamp, else None. The stamp is read before returning —
+        and remembered for the store — so a response computed now can
+        never be served across a mutation that raced the compute."""
+        key = (verb, sig)
+        stamp = self._cache.mutation_stamp()
+        ctx.stamps[key] = stamp
+        with self._lock:
+            rec = ctx.entry.responses.get(key)
+        if rec is not None and rec[0] == stamp:
+            return rec[1]
+        return None
+
+    def served_hit(self, verb: str) -> None:
+        WIRE_RESPONSES.inc(verb, "hit")
+
+    def finish_filter(self, ctx: _Ctx, sig: tuple, ok_nodes: list[str],
+                      failed: dict[str, str], *, cacheable: bool,
+                      expected: WireEncoded | None) -> WireEncoded:
+        """Encode a freshly computed Filter verdict from fragments and
+        (when cacheable) store it under the pre-compute stamp.
+        ``expected`` is the verify-mode hit being double-checked."""
+        body = self.encode_filter(ok_nodes, failed)
+        enc = WireEncoded(body, ok=len(ok_nodes), failed=len(failed))
+        return self._finish(ctx, ("filter", sig), enc, "filter",
+                            cacheable, expected)
+
+    def finish_prioritize(self, ctx: _Ctx, sig: tuple,
+                          out: list[dict[str, Any]], best: str | None, *,
+                          cacheable: bool,
+                          expected: WireEncoded | None) -> WireEncoded:
+        body = self.encode_prioritize(out)
+        enc = WireEncoded(body, best=best, count=len(out))
+        return self._finish(ctx, ("prioritize", sig), enc, "prioritize",
+                            cacheable, expected)
+
+    def _finish(self, ctx: _Ctx, key: tuple, enc: WireEncoded, verb: str,
+                cacheable: bool, expected: WireEncoded | None) -> WireEncoded:
+        if expected is not None:
+            # verify mode recomputed a hit: a byte difference means the
+            # stamp protocol failed to invalidate — count it, serve truth
+            if expected.body != enc.body:
+                WIRE_STALE_SERVES.inc()
+            WIRE_RESPONSES.inc(verb, "hit")
+        else:
+            WIRE_RESPONSES.inc(verb, "encoded" if cacheable else "bypass")
+        if cacheable:
+            stamp = ctx.stamps.get(key)
+            if stamp is not None:
+                with self._lock:
+                    resp = ctx.entry.responses
+                    if len(resp) >= self.MAX_RESPONSES and key not in resp:
+                        resp.clear()
+                    resp[key] = (stamp, enc)
+        return enc
+
+    # -- fragment encoders (byte-identical to json.dumps defaults) ------
+
+    def _frag(self, s: str) -> bytes:
+        f = self._frags.get(s)
+        if f is None:
+            f = json.dumps(s).encode()
+            if len(self._frags) >= self.MAX_FRAGMENTS:
+                self._frags.clear()
+            self._frags[s] = f
+        return f
+
+    def encode_filter(self, ok_nodes: list[str],
+                      failed: dict[str, str]) -> bytes:
+        frag = self._frag
+        return b"".join((
+            b'{"NodeNames": [', b", ".join(map(frag, ok_nodes)),
+            b'], "FailedNodes": {',
+            b", ".join(frag(n) + b": " + frag(r)
+                       for n, r in failed.items()),
+            b'}, "Error": ""}'))
+
+    def encode_prioritize(self, out: list[dict[str, Any]]) -> bytes:
+        frag = self._frag
+        return b"[" + b", ".join(
+            b'{"Host": ' + frag(h["Host"]) + b', "Score": '
+            + (_INT_FRAGS.get(h["Score"]) or str(h["Score"]).encode())
+            + b"}" for h in out) + b"]"

@@ -30,6 +30,7 @@ import urllib.request
 from typing import Any, Iterator
 
 from tpushare.k8s.client import ApiError, WatchEvent
+from tpushare.k8s.stats import CONN_POOL_REQUESTS
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -88,11 +89,41 @@ class _ConnPool:
     # (k8s/retry.py), whose call sites tolerate duplicates explicitly.
     _REPLAY_SAFE = frozenset({"GET", "HEAD", "PUT", "PATCH", "DELETE"})
 
+    @staticmethod
+    def _looks_stale(conn: http.client.HTTPConnection) -> bool:
+        """Recv-before-send staleness probe for a REUSED connection.
+
+        An idle keep-alive connection the peer has half-closed (the
+        apiserver's idle timeout) is READABLE: EOF, a TLS close_notify,
+        or stray bytes are all waiting. A healthy idle connection has
+        nothing to read. One zero-timeout select answers which, BEFORE
+        any request bytes leave — so a binding POST can reuse pooled
+        connections again (keep-alive setup cost off the bind path)
+        without ever reaching the ambiguous sent-then-died state the
+        replay-safety rule exists for. The probe cannot catch a close
+        that races the request itself; that window still surfaces as an
+        error for non-replay-safe verbs, exactly as before."""
+        sock = conn.sock
+        if sock is None:
+            return True
+        try:
+            if isinstance(sock, ssl.SSLSocket) and sock.pending():
+                return True  # already-decrypted bytes: close_notify
+            import select
+            readable, _, _ = select.select([sock], [], [], 0)
+            return bool(readable)
+        except (OSError, ValueError):
+            return True  # unselectable socket = unusable connection
+
     def request(self, method: str, path: str, body: bytes | None,
                 headers: dict[str, str], timeout: float
                 ) -> tuple[int, bytes, str | None]:
         with self._lock:
             conn = self._idle.pop() if self._idle else None
+        if conn is not None and self._looks_stale(conn):
+            CONN_POOL_REQUESTS.inc("stale_replaced")
+            conn.close()
+            conn = None
         fresh = conn is None
         if conn is None:
             conn = self._new_conn(timeout)
@@ -100,6 +131,7 @@ class _ConnPool:
             conn.timeout = timeout
             if conn.sock is not None:
                 conn.sock.settimeout(timeout)
+        CONN_POOL_REQUESTS.inc("fresh" if fresh else "reused")
         try:
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
@@ -115,6 +147,7 @@ class _ConnPool:
                 raise
             # stale keep-alive connection (apiserver idle-closed it):
             # safe-to-replay request, retry exactly once on a fresh socket
+            CONN_POOL_REQUESTS.inc("replayed")
             conn = self._new_conn(timeout)
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import logging
 import random
+import sys
 import threading
 import time
 from typing import Any
@@ -110,7 +111,7 @@ class PodLister:
             self._by_uid[uid] = key
         node = (pod.get("spec") or {}).get("nodeName", "")
         if node:
-            self._by_node.setdefault(node, set()).add(key)
+            self._by_node.setdefault(sys.intern(node), set()).add(key)
         gid = (_meta(pod).get("annotations") or {}).get(ANN_GANG, "")
         if gid:
             self._by_gang.setdefault((key[0], gid), set()).add(key)
@@ -169,7 +170,10 @@ class NodeLister:
         self._by_name: dict[str, dict[str, Any]] = {}
 
     def apply(self, etype: str, node: dict[str, Any]) -> None:
-        name = _meta(node).get("name", "")
+        # interned at the ingestion boundary: every layer keyed by node
+        # name (cache, index, arena, wirecache) shares ONE string per
+        # node instead of one per watch event
+        name = sys.intern(_meta(node).get("name", ""))
         if not name:
             return
         with self._lock:
@@ -181,7 +185,7 @@ class NodeLister:
     def replace(self, nodes: list[dict[str, Any]]) -> None:
         with self._lock:
             self._by_name = {
-                _meta(n).get("name", ""): n for n in nodes
+                sys.intern(_meta(n).get("name", "")): n for n in nodes
                 if _meta(n).get("name")}
 
     def __len__(self) -> int:

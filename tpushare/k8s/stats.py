@@ -30,6 +30,16 @@ APISERVER_REQUESTS = LabeledCounter(
     "(origin set via tpushare.k8s.stats.api_origin)",
     ("verb", "origin"))
 
+CONN_POOL_REQUESTS = LabeledCounter(
+    "tpushare_conn_pool_requests_total",
+    "Keep-alive pool outcomes per request/response apiserver call "
+    '("reused": idle connection checked out; "fresh": none idle, new '
+    'connect (+TLS); "stale_replaced": the recv-before-send probe '
+    "caught a peer-closed idle connection and replaced it BEFORE the "
+    'request left; "replayed": a replay-safe verb was resent once '
+    "after a reused connection died mid-request)",
+    ("outcome",))
+
 # verbs that transfer state FROM the apiserver on a request/response call
 # (watches are long-lived streams, counted once at attach, and excluded
 # from the read budget — they are the mechanism that REMOVES reads)

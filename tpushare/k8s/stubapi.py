@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -84,9 +85,18 @@ class _State:
 
 
 class StubApiServer:
-    def __init__(self, token: str | None = None) -> None:
+    def __init__(self, token: str | None = None,
+                 write_delay_s: float = 0.0) -> None:
         self.state = _State()
         self.token = token  # None = no auth required
+        # per-write commit latency (etcd raft+fsync emulation): a plain
+        # loopback stub answers writes in pure-CPU time, which the GIL
+        # serializes across this process's threads — concurrency wins
+        # (e.g. the pipelined PATCH+POST bind) are only measurable when
+        # writes carry real, GIL-released wait time like a production
+        # apiserver's. Applied per mutating request, OUTSIDE the store
+        # lock (commit batching: concurrent writes wait concurrently).
+        self.write_delay_s = write_delay_s
         self._fault_lock = threading.Lock()
         self._gone_next_watch = 0
         self._close_after_events: int | None = None
@@ -214,9 +224,14 @@ class StubApiServer:
                         "kind": "List", "items": items,
                         "metadata": {"resourceVersion": str(state.rv)}})
 
+            def _commit_wait(self) -> None:
+                if stub.write_delay_s:
+                    time.sleep(stub.write_delay_s)
+
             def do_PATCH(self):
                 if not self._authed():
                     return
+                self._commit_wait()
                 route = self._route()
                 if route is None:
                     return self._fail(404, "NotFound", self.path)
@@ -252,6 +267,7 @@ class StubApiServer:
             def do_POST(self):
                 if not self._authed():
                     return
+                self._commit_wait()
                 route = self._route()
                 if route is None:
                     return self._fail(404, "NotFound", self.path)
@@ -279,6 +295,7 @@ class StubApiServer:
             def do_PUT(self):
                 if not self._authed():
                     return
+                self._commit_wait()
                 route = self._route()
                 if route is None:
                     return self._fail(404, "NotFound", self.path)
@@ -306,6 +323,7 @@ class StubApiServer:
             def do_DELETE(self):
                 if not self._authed():
                     return
+                self._commit_wait()
                 route = self._route()
                 if route is None:
                     return self._fail(404, "NotFound", self.path)

@@ -128,6 +128,35 @@ class ExplainStore:
             }
         self._notify("filter_recorded", pod_key, 1, 1)
 
+    def record_wire(self, pod_key: str, pod: dict[str, Any] | None,
+                    trace_id: str | None, verb: str, *,
+                    ok: int | None = None, candidates: int = 0,
+                    best: str | None = None) -> None:
+        """The verb was served from the wire-plane response cache: the
+        pre-encoded bytes went out without re-running filter/score, so
+        there are no per-node verdicts to record. Keep an aggregate
+        record with ``source: wirecache`` — the audit must never present
+        a digest-hit as individually computed — and keep the observer
+        stream flowing so scorecards don't go blind under cache hits."""
+        with self._lock:
+            rec = self._entry(pod_key, pod, trace_id)
+            if verb == "filter":
+                rec["filter"] = {
+                    "candidates": candidates,
+                    "ok": ok if ok is not None else 0,
+                    "nodes": {},
+                    "source": "wirecache",
+                }
+            else:
+                rec["prioritize"] = {
+                    "scores": {},
+                    "best": best,
+                    "source": "wirecache",
+                }
+        if verb == "filter":
+            self._notify("filter_recorded", pod_key,
+                         ok if ok is not None else 0, candidates)
+
     def record_prioritize(self, pod_key: str, pod: dict[str, Any] | None,
                           trace_id: str | None,
                           scores: dict[str, int],
