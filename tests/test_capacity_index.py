@@ -449,3 +449,98 @@ def test_summarize_nontpu_node_is_never_bucketed():
                                        names + ["plain"])
     assert errors.get("plain") == "not a TPU-share node"
     assert scores.get(names[0]) is not None
+
+
+# -- adjacency tier: gang_prune over host groups (ABI v5) ------------------
+
+
+def _slice_fleet(grid=(2, 2), sid="slc"):
+    fc = FakeCluster()
+    names = []
+    for i in range(grid[0]):
+        for j in range(grid[1]):
+            n = f"{sid}-h{i}x{j}"
+            fc.add_tpu_node(n, chips=4, hbm_per_chip_mib=HBM, mesh="2x2",
+                            slice_id=sid, slice_origin=f"{2*i}x{2*j}")
+            names.append(n)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    return fc, cache, names
+
+
+def _slice_geometry(grid, names):
+    from tpushare.core.slice import SliceTopology
+    from tpushare.core.topology import HostMesh
+
+    return (SliceTopology.from_host_grid(grid, (2, 2), names),
+            HostMesh(grid, (2, 2), tuple(names)))
+
+
+def test_gang_prune_never_prunes_a_feasible_gang():
+    """Soundness property (the adjacency-tier analogue of the
+    never-wrongly-prunes tentpole claim): whenever select_gang finds a
+    placement on the slice's REAL state, gang_prune must say None.
+    Randomized occupancy via real allocations through the cache."""
+    from tpushare.core.slice import select_gang
+
+    rng = random.Random(51)
+    grid = (2, 4)
+    fc, cache, names = _slice_fleet(grid)
+    st, hmesh = _slice_geometry(grid, names)
+    cache.index.register_group("slc", hmesh)
+    pruned_any = 0
+    for trial in range(120):
+        # churn: a random allocate or release on a random host
+        node = rng.choice(names)
+        info = cache.get_node_info(node)
+        if rng.random() < 0.6:
+            pod = fc.create_pod(make_pod(
+                hbm=rng.choice([2 * GIB, HBM]),
+                count=rng.choice([0, 1]), name=f"f{trial}"))
+            try:
+                info.allocate(pod, fc)
+            except AllocationError:
+                fc.delete_pod("default", f"f{trial}")
+        else:
+            pods = fc.list_pods(node_name=node)
+            if pods:
+                victim = rng.choice(pods)
+                cache.remove_pod(victim)
+                fc.delete_pod(victim["metadata"]["namespace"],
+                              victim["metadata"]["name"])
+        for count, hbm in ((8, 0), (8, 2 * GIB), (4, HBM), (16, 0)):
+            req = PlacementRequest(hbm_mib=hbm, chip_count=count,
+                                   topology=None, allow_scatter=False)
+            views = {n: cache.get_node_info(n).stamped_snapshot()[1]
+                     for n in names}
+            placeable = select_gang(st, views, req) is not None
+            cache.index.flush()
+            verdict = cache.index.gang_prune("slc", req)
+            if placeable:
+                assert verdict is None, (trial, count, hbm, verdict)
+            elif verdict is not None:
+                pruned_any += 1
+    # the sweep must actually exercise the pruning side too
+    assert pruned_any > 0
+
+
+def test_gang_prune_full_slice_and_unknown_summary():
+    fc, cache, names = _slice_fleet((2, 2))
+    _st, hmesh = _slice_geometry((2, 2), names)
+    cache.index.register_group("slc", hmesh)
+    req = PlacementRequest(hbm_mib=0, chip_count=8, topology=None,
+                           allow_scatter=False)
+    cache.index.flush()
+    assert cache.index.gang_prune("slc", req) is None  # empty: fits
+    # exclusively fill every host -> certain no-fit at the top tier
+    for n in names:
+        pod = fc.create_pod(make_pod(count=4, name=f"x{n}"))
+        cache.get_node_info(n).allocate(pod, fc)
+    cache.index.flush()
+    verdict = cache.index.gang_prune("slc", req)
+    assert verdict is not None and "gang capacity" in verdict
+    # unknown group: never prune
+    assert cache.index.gang_prune("nope", req) is None
+    # dropped group: never prune
+    cache.index.drop_group("slc")
+    assert cache.index.gang_prune("slc", req) is None

@@ -309,3 +309,18 @@ def test_preempt_route_refines_victims(rig):
     assert status == 200
     assert out["NodeNameToMetaVictims"]["n2"]["Pods"] == [
         {"UID": uids["v3"]}]
+
+
+def test_inspect_gang_route(rig):
+    fc, cache, base = rig
+    status, snap = get(f"{base}/tpushare-scheduler/inspect/gang")
+    assert status == 200
+    # full planner-snapshot schema, even on a gang-free fleet
+    for key in ("plans", "provisional", "catalog", "solves", "members"):
+        assert key in snap, key
+    assert snap["plans"] == [] and snap["provisional"] == []
+    # n1/n2 carry no slice labels: the catalog has no slices to solve
+    assert snap["catalog"] == []
+    # unprefixed alias serves the same snapshot (debug ergonomics)
+    status2, snap2 = get(f"{base}/inspect/gang")
+    assert status2 == 200 and snap2.keys() == snap.keys()

@@ -9,6 +9,7 @@ cannot express any of this (single-node allocator, nodeinfo.go:312-363).
 """
 
 import json
+import os
 import urllib.error
 import urllib.request
 
@@ -16,7 +17,8 @@ import pytest
 
 from tpushare import contract
 from tpushare.cache import SchedulerCache
-from tpushare.cache.gang import GangCoordinator, GangError
+from tpushare.cache.gang import (GANG_MEMBERS, GangCoordinator,
+                                 GangError)
 from tpushare.controller import Controller
 from tpushare.extender.metrics import Registry
 from tpushare.extender.server import ExtenderServer
@@ -409,3 +411,104 @@ def test_finished_gang_does_not_block_resubmission():
     assert hosts, reason  # re-planned fresh, not "already bound"
     placement = b.bind_member(p0, hosts[0], fc, now_ns=lambda: 2)
     assert placement.chip_ids
+
+
+# -- ABI v5 one-shot solve: escape hatch identity + demotion race ----------
+
+def _direct_rig():
+    """Coordinator over a fresh slice fleet, no HTTP (byte-level pod
+    comparisons must not pick up tracer/server annotations)."""
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    return fc, cache, GangCoordinator(cache, fc)
+
+
+def _drive_gang_direct(fc, gang, now_ns, gang_id="g1"):
+    names = []
+    for rank in (0, 1):
+        pod = gang_pod(fc, f"{gang_id}p{rank}", rank=rank,
+                       gang_id=gang_id)
+        hosts, err = gang.filter_hosts(pod, now_ns=now_ns)
+        assert err == "" and len(hosts) == 1, err
+        gang.bind_member(pod, hosts[0], fc, now_ns=now_ns)
+        names.append(pod["metadata"]["name"])
+    return names
+
+
+def test_no_gang_solve_escape_hatch_is_byte_identical():
+    """TPUSHARE_NO_GANG_SOLVE restores the sequential (pre-v5)
+    plan-at-bind flow; with a pinned clock the apiserver-visible
+    member placements must be byte-for-byte identical to the one-shot
+    path — annotations, chip ids, stamped plan JSON, timestamps."""
+    now_ns = lambda: 1_700_000_000_000_000_000
+
+    def run(no_gang_solve):
+        old = os.environ.pop("TPUSHARE_NO_GANG_SOLVE", None)
+        if no_gang_solve:
+            os.environ["TPUSHARE_NO_GANG_SOLVE"] = "1"
+        try:
+            fc, cache, gang = _direct_rig()
+            names = _drive_gang_direct(fc, gang, now_ns)
+            return [json.dumps(
+                fc.get_pod("default", n)["metadata"]["annotations"],
+                sort_keys=True) for n in names]
+        finally:
+            os.environ.pop("TPUSHARE_NO_GANG_SOLVE", None)
+            if old is not None:
+                os.environ["TPUSHARE_NO_GANG_SOLVE"] = old
+
+    assert run(False) == run(True)
+
+
+def test_demotion_race_demotes_exactly_the_mutated_member():
+    """Between the leader's Filter-time solve and the first Bind, one
+    planned host's stamp moves (same occupancy). The in-lock stamp
+    revalidation must demote EXACTLY that member to the per-chip walk
+    — the untouched member keeps its walk-free promotion — and the
+    final placements must not oversubscribe any chip."""
+    fc, cache, gang = _direct_rig()
+    p0 = gang_pod(fc, "gp0", rank=0)
+    hosts, err = gang.filter_hosts(p0)
+    assert err == ""
+    planned = gang.plan_info("g1")["hosts"]
+    assert len(planned) == 2
+
+    # bump ONLY the stamp of the rank-1 host: allocate+release a
+    # sharing pod — occupancy is exactly what the solve saw, but the
+    # node's (epoch, counter) generation moved
+    bump = fc.create_pod({
+        "metadata": {"name": "bump", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {"limits": {
+            contract.RESOURCE_HBM: str(4096)}}}]}})
+    info = cache.get_node_info(planned[1])
+    info.allocate(bump, fc)
+    bound = fc.get_pod("default", "bump")
+    cache.add_or_update_pod(bound)
+    cache.remove_pod(bound)
+    fc.delete_pod("default", "bump")
+
+    base = GANG_MEMBERS.snapshot()
+    gang.bind_member(p0, hosts[0], fc)
+    assert gang.plan_info("g1")["demoted"] == [1]
+    p1 = gang_pod(fc, "gp1", rank=1)
+    hosts1, err = gang.filter_hosts(p1)
+    assert err == "" and hosts1 == [planned[1]]
+    gang.bind_member(p1, hosts1[0], fc)
+    snap = GANG_MEMBERS.snapshot()
+
+    def delta(source):
+        return snap.get((source,), 0.0) - base.get((source,), 0.0)
+
+    assert delta("demoted") == 1
+    assert delta("planned") == 1
+    # no chip is claimed twice across the fleet (apiserver truth)
+    claimed = set()
+    for pod in fc.list_pods():
+        ids = contract.chip_ids_from_annotations(pod)
+        if ids is None:
+            continue
+        node = pod["spec"].get("nodeName", "")
+        for c in ids:
+            assert (node, c) not in claimed, (node, c)
+            claimed.add((node, c))

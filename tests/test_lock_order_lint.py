@@ -51,6 +51,13 @@ RANKS = {
     # guards only the pending-window dict and is NEVER held across the
     # solve or any cache/node call — the leader pops its window first)
     ("gang.py", "self._lock"): 5,           # gang coordinator
+    # gang solve (ISSUE 15): the slice-catalog bookkeeping lock — guards
+    # ONLY the cached _SliceState list + its build timestamp, and is
+    # NEVER held across a solve, a node lock, or the coordinator lock
+    # (test_state_lock_never_held_across_a_solve enforces the solve
+    # half); sits between the coordinator lock and the stripes so a
+    # catalog-read under the coordinator lock stays legal
+    ("gang.py", "self._state_lock"): 9,
     ("wirecache.py", "self._lock"): 6,      # wire digest map (leftmost
     # family: guards only the digest->entry OrderedDict bookkeeping and
     # is NEVER held across a parse, a solve, or any cache/node call —
@@ -60,6 +67,10 @@ RANKS = {
     ("nodeinfo.py", "self._lock"): 20,      # per-node chip state
     ("cache.py", "self._memo_lock"): 30,    # placement + eqclass memos
     ("index.py", "self._lock"): 40,         # capacity index (rightmost)
+    # adjacency tier (ISSUE 15): per host-group gang-capacity caps —
+    # rightmost of the cache chain; acquired only AFTER or WITHOUT the
+    # index lock (gang_prune reads caps under it, recomputes outside)
+    ("index.py", "self._adj_lock"): 41,
     ("cache.py", "self._pods_lock"): 90,    # known-pods leaf
     ("engine.py", "_lock"): 60,             # native loader
     ("engine.py", "_pool_lock"): 61,        # scan pool
@@ -171,6 +182,58 @@ def test_lock_acquisitions_follow_documented_order():
     assert seen >= 10, "the lint saw almost no lock acquisitions — " \
         "the scan or the regex rotted"
     assert not problems, "lock-order violations:\n" + "\n".join(problems)
+
+
+def test_state_lock_never_held_across_a_solve():
+    """The gang planner's catalog lock (_state_lock) is documented as
+    NEVER held across a solve — the one-shot gang solve walks every
+    member host's node lock, so holding planner bookkeeping state
+    across it would couple catalog reads to fleet-wide solve latency
+    (and invite cross-function inversions the nesting lint can't see).
+    AST check: no call whose name smells like a solve/build appears
+    inside a ``with self._state_lock:`` block in gang.py."""
+    path = os.path.join(ROOT, "tpushare", "cache", "gang.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    banned = re.compile(
+        r"solve|select_gang|_build_catalog|sync|snapshot\b.*node")
+    problems: list[str] = []
+
+    def scan_calls(body):
+        for n in body:
+            for sub in ast.walk(n) if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)) else []:
+                if isinstance(sub, ast.Call):
+                    src = ast.unparse(sub.func)
+                    if banned.search(src):
+                        problems.append(
+                            f"gang.py:{sub.lineno}: '{src}(...)' called "
+                            "under self._state_lock — the catalog lock "
+                            "must never be held across a solve")
+
+    def walk(body, held):
+        for n in body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(n.body, False)
+                continue
+            if isinstance(n, ast.With):
+                holds = held or any(
+                    _with_expr_key(i.context_expr) == "self._state_lock"
+                    for i in n.items)
+                if holds:
+                    scan_calls(n.body)
+                walk(n.body, holds)
+                continue
+            for cb in (getattr(n, "body", None),
+                       getattr(n, "orelse", None),
+                       getattr(n, "finalbody", None)):
+                if isinstance(cb, list):
+                    walk(cb, held)
+            for h in getattr(n, "handlers", []) or []:
+                walk(h.body, held)
+
+    walk(tree.body, False)
+    assert not problems, "\n".join(problems)
 
 
 def test_lint_actually_detects_an_inversion():

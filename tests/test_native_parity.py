@@ -197,3 +197,190 @@ def test_select_gang_parity_native_vs_python():
         checked += 1
     assert native_hits > 100  # the native path actually ran
     assert checked > 20  # ...and the deep-equality leg actually ran too
+
+
+# -- ABI v5 one-shot gang solve (tpushare_solve_gang) ----------------------
+
+
+def random_gang_case(rng):
+    """A random multi-host slice (2-d grids, mixed host boxes), random
+    per-chip occupancy/health, and a random gang request."""
+    from tpushare.core.slice import SliceTopology
+    from tpushare.core.topology import HostMesh
+
+    grid = rng.choice([(1, 2), (2, 2), (2, 4), (4, 2), (2, 3), (3, 3)])
+    hbox = rng.choice([(2, 2), (1, 2), (2, 1)])
+    n_hosts = grid[0] * grid[1]
+    names = [f"h{i}" for i in range(n_hosts)]
+    st = SliceTopology.from_host_grid(grid, hbox, names)
+    hmesh = HostMesh(grid, hbox, tuple(names))
+    total = rng.choice([8192, 16384])
+    views = {}
+    for name in names:
+        local = st.local_topology(name)
+        views[name] = [
+            ChipView(i, local.coords(i), total,
+                     rng.choice([0, 0, 512, total // 2, total]),
+                     healthy=rng.random() > 0.1)
+            for i in range(local.num_chips)
+        ]
+    if n_hosts > 2 and rng.random() < 0.2:
+        # absent host (down, unreported): boxes touching it must be
+        # ineligible in BOTH engines — the degraded-fleet contract
+        del views[rng.choice(names)]
+    mesh_chips = st.mesh.num_chips
+    count = rng.choice([c for c in (2, 4, 8, 16) if c <= mesh_chips])
+    topology = None
+    if rng.random() < 0.5:
+        shapes = st.mesh.box_shapes(count)
+        if shapes:
+            topology = rng.choice(shapes)
+    req = PlacementRequest(
+        hbm_mib=rng.choice([0, 0, 512, 2048, total // 2]),
+        chip_count=count, topology=topology, allow_scatter=False)
+    return st, hmesh, views, req
+
+
+@pytest.mark.skipif(not native_engine.gang_solve_supported(),
+                    reason="solve_gang entry point unavailable")
+def test_solve_gang_differential_vs_python_spec():
+    """engine.solve_gang (ABI v5 one-shot: C search + in-C member
+    decomposition off a resident arena) must match _py_solve_gang (the
+    pure-python behavioral spec) on randomized fleets — box, origin,
+    score, AND every member's local chip ids/box/origin."""
+    from tpushare.core.slice import _py_solve_gang
+
+    rng = random.Random(41)
+    native_hits = placed = 0
+    for trial in range(400):
+        st, hmesh, views, req = random_gang_case(rng)
+        py = _py_solve_gang(st, views, req)
+        nat = native_engine.solve_gang(st, hmesh, views, req)
+        assert nat != "fallback", "supported build must not fall back"
+        native_hits += 1
+        if py is None:
+            assert nat is None, (trial, req)
+            continue
+        placed += 1
+        assert nat is not None, (trial, req)
+        assert nat.box == py.box, (trial, req)
+        assert nat.origin == py.origin, (trial, req)
+        assert nat.score == py.score, (trial, req)
+        assert sorted(nat.per_host) == sorted(py.per_host), (trial, req)
+        for host, pp in py.per_host.items():
+            np_ = nat.per_host[host]
+            assert np_.chip_ids == pp.chip_ids, (trial, host, req)
+            assert np_.box == pp.box, (trial, host, req)
+            assert np_.origin == pp.origin, (trial, host, req)
+    # the sweep must actually exercise both engines and real placements
+    assert native_hits == 400
+    assert placed > 50, f"only {placed} placements — weak sweep"
+
+
+@pytest.mark.skipif(not native_engine.gang_solve_supported(),
+                    reason="solve_gang entry point unavailable")
+def test_solve_gang_resident_arena_incremental_sync_parity():
+    """A RESIDENT arena synced incrementally (stamp-hit hosts skipped,
+    moved hosts resynced, one host promised-unchanged-but-moved) must
+    answer exactly like a fresh full solve of the same state."""
+    from tpushare.core.native.engine import SliceArena
+    from tpushare.core.slice import SliceTopology, _py_solve_gang
+    from tpushare.core.topology import HostMesh
+
+    rng = random.Random(43)
+    grid, hbox = (2, 4), (2, 2)
+    names = [f"h{i}" for i in range(8)]
+    st = SliceTopology.from_host_grid(grid, hbox, names)
+    hmesh = HostMesh(grid, hbox, tuple(names))
+    total = 16384
+
+    def fresh_views(used):
+        return {n: [ChipView(i, st.local_topology(n).coords(i), total,
+                             used[n][i]) for i in range(4)]
+                for n in names}
+
+    used = {n: [0] * 4 for n in names}
+    arena = SliceArena(st, hmesh)
+    arena.sync({n: ((1, i), fresh_views(used)[n])
+                for i, n in enumerate(names)})
+    req = PlacementRequest(hbm_mib=2048, chip_count=8, topology=(2, 4),
+                           allow_scatter=False)
+    for step in range(60):
+        # mutate a couple of hosts; the rest sync by stamp alone
+        moved = rng.sample(names, rng.randint(0, 2))
+        for n in moved:
+            used[n][rng.randrange(4)] = rng.choice([0, 512, total])
+        views = fresh_views(used)
+        sync_map = {}
+        for i, n in enumerate(names):
+            stamp = (2 + step, i) if n in moved else arena.stamp(n)
+            sync_map[n] = (stamp, views[n] if n in moved else None)
+        arena.sync(sync_map)
+        got = arena.solve(req)
+        want = _py_solve_gang(st, views, req)
+        if want is None:
+            assert got is None, step
+            continue
+        assert got is not None and got != "fallback", step
+        assert got.box == want.box and got.origin == want.origin, step
+        assert {h: p.chip_ids for h, p in got.per_host.items()} == \
+            {h: p.chip_ids for h, p in want.per_host.items()}, step
+
+
+@pytest.mark.skipif(not native_engine.gang_solve_supported(),
+                    reason="solve_gang entry point unavailable")
+def test_slice_arena_sync_unit_semantics():
+    """The delta-sync contract, host by host: stamp-hit hosts cost no
+    rewrite, a promised-unchanged host whose stamp moved anyway goes
+    ineligible (never solved stale), and a host absent from the
+    mapping goes ineligible — the degraded global_view semantics."""
+    from tpushare.core.native.engine import SliceArena
+    from tpushare.core.slice import SliceTopology
+    from tpushare.core.topology import HostMesh
+
+    grid, hbox = (1, 2), (2, 2)
+    names = ["h0", "h1"]
+    st = SliceTopology.from_host_grid(grid, hbox, names)
+    hmesh = HostMesh(grid, hbox, tuple(names))
+    total = 16384
+
+    def views(name):
+        lt = st.local_topology(name)
+        return [ChipView(i, lt.coords(i), total, 0) for i in range(4)]
+
+    arena = SliceArena(st, hmesh)
+    assert arena.stamp("h0") is None  # never synced
+    arena.sync({n: ((1, 0), views(n)) for n in names})
+    assert arena.stamp("h0") == (1, 0)
+    assert arena.host_updates == 2
+    req8 = PlacementRequest(hbm_mib=0, chip_count=8, topology=(2, 4),
+                            allow_scatter=False)
+    gp = arena.solve(req8)
+    assert gp is not None and gp != "fallback"
+    assert set(gp.per_host) == {"h0", "h1"}
+
+    # stamp-hit skip: promised-unchanged hosts cost zero rewrites
+    arena.sync({n: ((1, 0), None) for n in names})
+    assert arena.host_updates == 2
+    assert arena.solve(req8) is not None
+
+    # promised-unchanged host whose stamp MOVED: the caller skipped the
+    # snapshot, so the arena must refuse to solve that host stale
+    arena.sync({"h0": ((1, 7), None), "h1": ((1, 0), None)})
+    assert arena.stamp("h0") is None
+    assert arena.solve(req8) is None  # h0 ineligible: no 2x4 box
+    req4 = PlacementRequest(hbm_mib=0, chip_count=4, topology=(2, 2),
+                            allow_scatter=False)
+    gp4 = arena.solve(req4)
+    assert gp4 is not None and set(gp4.per_host) == {"h1"}
+
+    # a real resync with fresh chips brings the host back
+    arena.sync({"h0": ((1, 8), views("h0")), "h1": ((1, 0), None)})
+    assert arena.solve(req8) is not None
+
+    # absent host (down/unreported): ineligible until it reappears
+    arena.sync({"h0": ((1, 8), None)})
+    assert arena.stamp("h1") is None
+    assert arena.solve(req8) is None
+    gp4b = arena.solve(req4)
+    assert gp4b is not None and set(gp4b.per_host) == {"h0"}

@@ -358,3 +358,49 @@ def test_span_event_cap_bounds_memory():
     s.finish()
     d = s.to_dict(t)
     assert d["events_dropped"] == 50
+
+
+def test_gang_members_share_leader_trace_in_explain():
+    """Every gang member's explain record points at the LEADER's trace
+    (one ABI v5 solve planned the whole gang) with source=gang — the
+    audit must never present a follower as individually computed."""
+    from tests.test_gang import gang_pod, make_slice_cluster
+
+    fc = make_slice_cluster()
+    cache = SchedulerCache(fc)
+    ctl = Controller(fc, cache)
+    ctl.build_cache()
+    ctl.start()
+    server = ExtenderServer(cache, fc, Registry(),
+                            host="127.0.0.1", port=0)
+    port = server.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        nodes = ["s0h0", "s0h1", "s0h2", "s0h3", "lone"]
+        for rank in (0, 1):
+            pod = gang_pod(fc, f"gp{rank}", rank=rank)
+            _, flt = post(f"{base}/tpushare-scheduler/filter",
+                          {"Pod": pod, "NodeNames": nodes})
+            assert len(flt["NodeNames"]) == 1, flt
+        recs = []
+        for name in ("gp0", "gp1"):
+            status, out = get(f"{base}/inspect/explain/default/{name}")
+            assert status == 200
+            recs.append(out["cycles"][-1])
+        leader, follower = recs
+        # the leader's own trace IS the gang's planning trace
+        assert leader["gang"]["leader_trace_id"] == leader["trace_id"]
+        # the follower shares it (its own trace id differs)
+        assert follower["gang"]["leader_trace_id"] == \
+            leader["trace_id"]
+        assert follower["trace_id"] != leader["trace_id"]
+        for rank, rec in enumerate(recs):
+            g = rec["gang"]
+            assert g["source"] == "gang"
+            assert g["gang_id"] == "g1" and g["rank"] == rank
+            (verdict,) = rec["filter"]["nodes"].values()
+            assert verdict["source"] == "gang"
+            assert verdict["leader_trace_id"] == leader["trace_id"]
+    finally:
+        server.stop()
+        ctl.stop()

@@ -81,3 +81,115 @@ def test_for_chip_count_default_shapes():
     assert MeshTopology.for_chip_count(4).shape == (2, 2)
     assert MeshTopology.for_chip_count(1).shape == (1,)
     assert MeshTopology.for_chip_count(7).shape == (7,)  # prime -> 1-D
+
+
+# -- HostMesh: the inter-node adjacency model (ABI v5 gang solve) ----------
+
+
+def _hm(grid, hbox=(2, 2)):
+    from tpushare.core.topology import HostMesh
+    n = 1
+    for d in grid:
+        n *= d
+    return HostMesh(grid, hbox, tuple(f"h{i}" for i in range(n)))
+
+
+def test_host_mesh_ordering_matches_slice_topology():
+    """HostMesh.hosts is row-major over the host grid — the SAME order
+    SliceTopology.from_host_grid assigns tile origins, so host-level
+    and chip-level coordinates compose without translation."""
+    from tpushare.core.slice import SliceTopology
+
+    hm = _hm((2, 3))
+    st = SliceTopology.from_host_grid((2, 3), (2, 2), list(hm.hosts))
+    for name in hm.hosts:
+        assert hm.chip_origin(name) == st.hosts[name].origin
+
+
+def test_host_mesh_from_layout_roundtrip():
+    from tpushare.core.topology import HostMesh
+
+    layout = {
+        "a": ((0, 0), (2, 2)), "b": ((0, 2), (2, 2)),
+        "c": ((2, 0), (2, 2)), "d": ((2, 2), (2, 2)),
+    }
+    hm = HostMesh.from_layout(layout)
+    assert hm.grid == (2, 2)
+    assert hm.hbox == (2, 2)
+    assert hm.hosts == ("a", "b", "c", "d")
+
+
+@pytest.mark.parametrize("layout,why", [
+    ({}, "empty"),
+    ({"a": ((0, 0), (2, 2)), "b": ((0, 2), (1, 4))}, "non-uniform boxes"),
+    ({"a": ((0, 0), (2, 2)), "b": ((0, 1), (2, 2))}, "unaligned origin"),
+    ({"a": ((0, 0), (2, 2)), "b": ((0, 0), (2, 2))},
+     "duplicate origin"),
+    ({"a": ((0, 0), (2, 2)), "b": ((2, 2), (2, 2))},
+     "hole at (0,2)/(2,0)"),
+])
+def test_host_mesh_from_layout_rejects_bad_tilings(layout, why):
+    from tpushare.core.topology import HostMesh
+
+    with pytest.raises(ValueError):
+        HostMesh.from_layout(layout)
+
+
+def _brute_best_box(grid, weights):
+    """Reference enumeration for best_eligible_box: every shape x
+    position x cell (the pre-v5 implementation, O(hosts^3))."""
+    import itertools
+
+    from tpushare.core.topology import MeshTopology
+
+    gm = MeshTopology(grid)
+    best = 0
+    for shape in itertools.product(*[range(1, d + 1) for d in grid]):
+        for origin in gm.box_positions(shape):
+            total = 0
+            for c in itertools.product(
+                    *[range(o, o + s) for o, s in zip(origin, shape)]):
+                w = weights[gm.index(c)]
+                if w <= 0:
+                    total = -1
+                    break
+                total += w
+            best = max(best, total)
+    return best
+
+
+def test_best_eligible_box_matches_brute_force_2d():
+    """The O(hosts) maximal-rectangle fast path must be exactly the
+    shapes x positions enumeration it replaced."""
+    import random
+
+    rng = random.Random(13)
+    for _ in range(300):
+        grid = (rng.randint(1, 6), rng.randint(1, 6))
+        hm = _hm(grid)
+        weights = [rng.choice([0, 0, 1, 2, 4]) for _ in hm.hosts]
+        by_host = dict(zip(hm.hosts, weights))
+        assert hm.best_eligible_box(by_host.__getitem__) == \
+            _brute_best_box(grid, weights), (grid, weights)
+
+
+def test_best_eligible_box_3d_fallback():
+    """Non-2-d grids keep the enumeration path."""
+    import random
+
+    from tpushare.core.topology import HostMesh
+
+    rng = random.Random(29)
+    grid = (2, 2, 3)
+    hm = HostMesh(grid, (1, 2, 2), tuple(f"h{i}" for i in range(12)))
+    for _ in range(50):
+        weights = [rng.choice([0, 1, 4]) for _ in hm.hosts]
+        by_host = dict(zip(hm.hosts, weights))
+        assert hm.best_eligible_box(by_host.__getitem__) == \
+            _brute_best_box(grid, weights), weights
+
+
+def test_best_eligible_box_zero_and_full():
+    hm = _hm((2, 4))
+    assert hm.best_eligible_box(lambda h: 0) == 0
+    assert hm.best_eligible_box(lambda h: 4) == 32  # whole grid

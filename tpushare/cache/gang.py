@@ -28,17 +28,44 @@ TPU-first design outgrows it.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from tpushare import contract
+from tpushare.cache.index import INDEX_STALE_SERVES
 from tpushare.cache.nodeinfo import AllocationError
 from tpushare.contract import pod as podlib
 from tpushare.core.placement import PlacementRequest
 from tpushare.k8s.client import ApiError
 from tpushare.core.slice import HostBox, SliceTopology, select_gang
+from tpushare.core.topology import HostMesh
+from tpushare.metrics import LabeledCounter
+
+# one-shot gang solve attempts per (gang, slice): "planned" = a slice
+# admitted the gang, "no_fit" = a slice was solved and had no placement,
+# "pruned" = the adjacency tier rejected the slice O(1) WITHOUT a solve
+# (the perf win this metric exists to make visible)
+GANG_SOLVES = LabeledCounter(
+    "tpushare_gang_solves_total",
+    "Multi-node gang solve attempts per (gang, slice) by outcome "
+    "(planned = slice admitted the gang; no_fit = solved, no placement; "
+    "pruned = rejected O(1) by the adjacency tier without a solve)",
+    ("outcome",))
+# gang member binds by how their share was seeded: "planned" = straight
+# from the stamped plan (stamp still valid in-lock), "demoted" = the
+# member's node mutated between solve and bind so exactly that member
+# re-validated on the solo path, "recovered" = seeded from a plan
+# rebuilt off the stamped annotation after a coordinator restart
+GANG_MEMBERS = LabeledCounter(
+    "tpushare_gang_members_total",
+    "Gang member binds by seed source (planned = stamped plan still "
+    "valid; demoted = that member's node mutated between solve and "
+    "bind, solo-path revalidation; recovered = plan rebuilt from the "
+    "stamped annotation after a coordinator restart)",
+    ("source",))
 
 
 class GangError(AllocationError):
@@ -61,6 +88,20 @@ class _Plan:
     # TTL fired: unbound ranks' reservations were released (late binds
     # re-reserve on demand against the SAME geometry)
     shares_released: bool = False
+    # per-member (epoch, counter) node stamps captured by the one-shot
+    # solve (ABI v5): bind revalidates each member against its stamp and
+    # demotes exactly the mutated one. None on recovered plans (the
+    # stamp's proof value died with the coordinator) — every member then
+    # takes the solo validation path. NOT serialized: the wire schema
+    # (ANN_GANG_PLAN, consumed by the device plugin) is geometry only.
+    stamps: list[tuple[int, int] | None] | None = None
+    demoted: set[int] = field(default_factory=set)
+    # observability: which trace computed the plan (members share it in
+    # /inspect/explain, source=gang), which engine solved it, and how
+    # the plan came to be ("solve" | "recovered")
+    leader_trace_id: str | None = None
+    engine: str = ""
+    source: str = "solve"
 
     def to_json(self) -> str:
         return json.dumps({
@@ -79,6 +120,19 @@ def _gang_key(gang_id: str, rank: int) -> str:
     return f"gang:{gang_id}#{rank}"
 
 
+@dataclass
+class _SliceState:
+    """Cached planner state for one slice: the assembled topology, its
+    host-grid adjacency model (None when the labels don't describe a
+    uniform tiled grid — the v5 solve then falls back to the sequential
+    kernel), and the resident native arena."""
+
+    sid: str
+    st: SliceTopology
+    hmesh: HostMesh | None
+    arena: Any  # engine.SliceArena | None
+
+
 class GangCoordinator:
     # reserved-only gang shares older than this are an abandoned gang
     # (members never bound — JobSet deleted, scheduler crashed): release
@@ -89,6 +143,12 @@ class GangCoordinator:
     # slice search inside every Filter webhook call
     PROVISIONAL_TTL_NS = 2 * 1_000_000_000
 
+    # the slice catalog (topologies + resident arenas, built from node
+    # labels) is rebuilt at most this often — labels move at node
+    # lifecycle cadence, and every real validity check (stamped views,
+    # reserve eligibility) happens per solve/bind regardless
+    CATALOG_TTL_NS = 5 * 1_000_000_000
+
     def __init__(self, cache, cluster=None) -> None:
         self._cache = cache  # SchedulerCache
         # the apiserver client, for plan recovery (listing gang peers
@@ -98,6 +158,12 @@ class GangCoordinator:
         self._lock = threading.Lock()
         self._plans: dict[str, _Plan] = {}
         self._provisional: dict[str, tuple[_Plan | None, int]] = {}
+        # slice-catalog bookkeeping (rank 9 in the lock lint): guards
+        # ONLY the cached _SliceState list + its build time; NEVER held
+        # across a solve, a node lock, or the coordinator lock
+        self._state_lock = threading.Lock()
+        self._states: list[_SliceState] = []
+        self._states_t_ns = -(10 ** 18)  # force first build
 
     # -- slice discovery ----------------------------------------------------
 
@@ -141,6 +207,135 @@ class GangCoordinator:
                 out.add(sid)
         return sorted(out)
 
+    # -- slice catalog (resident planner state) ------------------------------
+
+    def _build_catalog(self) -> list[_SliceState]:
+        """One fleet walk -> the list of valid slices with assembled
+        topologies, host meshes, and resident native arenas, in sorted
+        slice-id order (deterministic solve order = byte-identity with
+        the sequential path). Runs OUTSIDE every lock; the result is
+        swapped in under the catalog lock."""
+        from tpushare.core import native  # late import: optional engine
+        by_sid: dict[str, dict[str, HostBox] | None] = {}
+        for name in self._cache.node_names():
+            info = self._cache.get_node_info(name)
+            sid = getattr(info, "slice_id", None)
+            if not sid:
+                continue
+            origin = info.slice_origin
+            shape = info.topology.shape
+            if len(origin) != len(shape):
+                by_sid[sid] = None  # mis-labeled: refuse the slice
+                continue
+            hosts = by_sid.setdefault(sid, {})
+            if hosts is not None:
+                hosts[name] = HostBox(tuple(origin), tuple(shape))
+        states: list[_SliceState] = []
+        from tpushare.core.topology import MeshTopology
+        for sid in sorted(by_sid):
+            hosts = by_sid[sid]
+            if not hosts:
+                continue
+            rank = len(next(iter(hosts.values())).origin)
+            mesh_dims = tuple(
+                max(hb.origin[ax] + hb.shape[ax] for hb in hosts.values())
+                for ax in range(rank))
+            try:
+                st = SliceTopology(MeshTopology(mesh_dims), hosts)
+            except ValueError:
+                continue  # mis-labeled fleet: refuse to gang-place
+            hmesh = arena = None
+            try:
+                hmesh = HostMesh.from_layout(
+                    {n: (hb.origin, hb.shape) for n, hb in hosts.items()})
+                arena = native.SliceArena(st, hmesh)
+            except ValueError:
+                pass  # non-uniform tiling: sequential kernel only
+            states.append(_SliceState(sid, st, hmesh, arena))
+        return states
+
+    def _catalog(self, now_ns: int) -> list[_SliceState]:
+        """The resident slice catalog, rebuilt past CATALOG_TTL_NS.
+        Also (re)registers each slice's host group with the capacity
+        index's adjacency tier."""
+        with self._state_lock:
+            if now_ns - self._states_t_ns < self.CATALOG_TTL_NS:
+                return self._states
+        states = self._build_catalog()  # outside the catalog lock
+        index = getattr(self._cache, "index", None)
+        if index is not None:
+            fresh = {s.sid for s in states if s.hmesh is not None}
+            for s in states:
+                if s.hmesh is not None:
+                    index.register_group(s.sid, s.hmesh)
+            with self._state_lock:
+                for old in self._states:
+                    if old.hmesh is not None and old.sid not in fresh:
+                        index.drop_group(old.sid)
+        with self._state_lock:
+            # first writer past the TTL wins; a concurrent rebuild of
+            # the same labels produces an equivalent catalog anyway
+            if now_ns - self._states_t_ns >= self.CATALOG_TTL_NS:
+                self._states = states
+                self._states_t_ns = now_ns
+            return self._states
+
+    def invalidate_catalog(self) -> None:
+        """Force the next plan to rebuild the slice catalog (tests,
+        label-change hooks)."""
+        with self._state_lock:
+            self._states_t_ns = -(10 ** 18)
+
+    def _solve_slice(self, state: _SliceState, req: PlacementRequest):
+        """One slice attempt: the ABI v5 one-shot native solve against
+        the resident arena, falling back to the sequential select_gang
+        kernel (same result by the parity contract) when the engine
+        can't run. The resident path stamp-checks each member host
+        LOCK-FREE and snapshots only the hosts whose version moved —
+        on a quiet slice a solve is a dict compare per host plus one C
+        call, where the sequential path re-materializes and re-merges
+        every chip of every host. Returns
+        (GangPlacement | None, stamps_by_host, engine)."""
+        from tpushare.core import native
+        views: dict[str, Any] = {}
+        stamps: dict[str, tuple[int, int]] = {}
+        arena = state.arena
+        if arena is not None and native.gang_solve_supported():
+            sync_map: dict[str, tuple] = {}
+            for host in state.st.hosts:
+                info = self._cache.get_node_info(host)
+                if info is None:
+                    continue  # absent from the map: arena marks down
+                v = info.version
+                if arena.stamp(host) == v:
+                    stamps[host] = v
+                    sync_map[host] = (v, None)  # snapshot skipped
+                else:
+                    stamp, snap = info.stamped_snapshot()
+                    stamps[host] = stamp
+                    views[host] = snap
+                    sync_map[host] = (stamp, snap)
+            arena.sync(sync_map)
+            gp = arena.solve(req)
+            if gp != "fallback":
+                native.NATIVE_FLEET_SCANS.inc("solve_gang", "native")
+                return gp, stamps, "native"
+        # sequential behavioral-spec path (engine off or stale .so,
+        # TPUSHARE_NO_GANG_SOLVE, or a runtime engine error): full
+        # stamped snapshots, then the select_gang kernel
+        for host in state.st.hosts:
+            if host in views:
+                continue
+            info = self._cache.get_node_info(host)
+            if info is None:
+                continue  # down host: its chips go ineligible
+            stamp, snap = info.stamped_snapshot()
+            stamps[host] = stamp
+            views[host] = snap
+        gp = select_gang(state.st, views, req)
+        native.NATIVE_FLEET_SCANS.inc("solve_gang", "python")
+        return gp, stamps, "python"
+
     # -- planning -----------------------------------------------------------
 
     def _request(self, pod: dict[str, Any], size: int) -> PlacementRequest:
@@ -162,26 +357,54 @@ class GangCoordinator:
             topology=topology)
 
     def _compute_plan(self, gang_id: str, pod: dict[str, Any],
-                      size: int, now_ns: int) -> _Plan | None:
+                      size: int, now_ns: int,
+                      trace_id: str | None = None) -> _Plan | None:
+        """ONE solve plans all members: walk the slice catalog in
+        deterministic order, prune no-fit slices O(1) off the capacity
+        index's adjacency tier, and run the one-shot (native when
+        available) gang solve on the survivors. The winning plan carries
+        per-member node stamps so each bind can prove its host hasn't
+        moved since this snapshot."""
         req = self._request(pod, size)
-        for sid in self.slice_ids():
-            assembled = self.slice_topology(sid)
-            if assembled is None:
-                continue
-            st, views = assembled
-            gp = select_gang(st, views, req)
+        index = getattr(self._cache, "index", None)
+        use_index = index is not None and \
+            getattr(self._cache, "_index_enabled", False)
+        verify = use_index and getattr(self._cache, "_verify_index",
+                                       False)
+        for state in self._catalog(now_ns):
+            pruned = False
+            if use_index and state.hmesh is not None:
+                index.flush()
+                if index.gang_prune(state.sid, req) is not None:
+                    GANG_SOLVES.inc("pruned")
+                    pruned = True
+                    if not verify:
+                        continue
+                    # oracle mode: solve anyway; a placement on a
+                    # pruned slice means the adjacency tier lied
+            gp, stamps, engine = self._solve_slice(state, req)
             if gp is None:
+                if not pruned:
+                    GANG_SOLVES.inc("no_fit")
                 continue
+            if pruned:
+                INDEX_STALE_SERVES.inc()  # wrong prune; honor the solve
+            GANG_SOLVES.inc("planned")
             members = [
                 (host, p.chip_ids, p.box, p.origin)
                 for host, p in sorted(gp.per_host.items())]
-            return _Plan(gang_id=gang_id, t_ns=now_ns, slice_id=sid,
+            return _Plan(gang_id=gang_id, t_ns=now_ns,
+                         slice_id=state.sid,
                          box=gp.box, origin=gp.origin,
-                         hbm_mib=req.hbm_mib, members=members)
+                         hbm_mib=req.hbm_mib, members=members,
+                         stamps=[stamps.get(h) for h, _c, _b, _o
+                                 in members],
+                         leader_trace_id=trace_id, engine=engine)
         return None
 
     def filter_hosts(self, pod: dict[str, Any],
-                     now_ns: Callable[[], int] = time.time_ns
+                     now_ns: Callable[[], int] = time.time_ns,
+                     trace_id: str | None = None
                      ) -> tuple[list[str], str]:
         """Filter verb for a gang member: ([host], "") or ([], reason).
 
@@ -213,7 +436,8 @@ class GangCoordinator:
                 with self._lock:
                     plan = self._plans.setdefault(gid, plan)
             else:
-                plan = self._compute_plan(gid, pod, size, t)
+                plan = self._compute_plan(gid, pod, size, t,
+                                          trace_id=trace_id)
                 with self._lock:
                     self._provisional[gid] = (plan, t)
                     # opportunistic cleanup; stays O(live gangs)
@@ -231,6 +455,60 @@ class GangCoordinator:
                         f"placement spans {len(plan.members)} hosts; "
                         "the gang must run one member per host")
         return [plan.members[rank][0]], ""
+
+    # -- observability ------------------------------------------------------
+
+    @staticmethod
+    def _plan_view(plan: _Plan) -> dict[str, Any]:
+        return {
+            "gang_id": plan.gang_id, "slice": plan.slice_id,
+            "size": len(plan.members),
+            "hosts": [h for h, _c, _b, _o in plan.members],
+            "box": list(plan.box), "origin": list(plan.origin),
+            "bound": sorted(plan.bound),
+            "demoted": sorted(plan.demoted),
+            "stamped": plan.stamps is not None,
+            "leader_trace_id": plan.leader_trace_id,
+            "engine": plan.engine, "source": plan.source,
+        }
+
+    def plan_info(self, gang_id: str) -> dict[str, Any] | None:
+        """A reserved (or cached provisional) plan's observable facets —
+        the Filter handler threads leader_trace_id into each member's
+        explain record from here."""
+        with self._lock:
+            plan = self._plans.get(gang_id)
+            if plan is None:
+                prov = self._provisional.get(gang_id)
+                plan = prov[0] if prov is not None else None
+            if plan is None:
+                return None
+            return self._plan_view(plan)
+
+    def snapshot(self) -> dict[str, Any]:
+        """GET /inspect/gang: live plans, provisional cache, and the
+        slice catalog the planner is currently solving against."""
+        with self._lock:
+            plans = [self._plan_view(p)
+                     for _, p in sorted(self._plans.items())]
+            provisional = sorted(
+                gid for gid, (p, _t) in self._provisional.items()
+                if p is not None)
+        with self._state_lock:
+            catalog = [{
+                "slice": s.sid, "hosts": len(s.st.hosts),
+                "host_grid": list(s.hmesh.grid)
+                if s.hmesh is not None else None,
+                "native_arena": s.arena is not None,
+            } for s in self._states]
+        return {
+            "plans": plans, "provisional": provisional,
+            "catalog": catalog,
+            "solves": {k[0]: v
+                       for k, v in GANG_SOLVES.snapshot().items()},
+            "members": {k[0]: v
+                        for k, v in GANG_MEMBERS.snapshot().items()},
+        }
 
     # -- binding ------------------------------------------------------------
 
@@ -276,7 +554,7 @@ class GangCoordinator:
                          box=tuple(int(b) for b in stamped["box"]),
                          origin=tuple(int(o) for o in stamped["origin"]),
                          hbm_mib=int(stamped["hbm"]), members=members,
-                         shares_released=True)
+                         shares_released=True, source="recovered")
         except (KeyError, TypeError, ValueError):
             return None  # corrupted stamp: treat as no plan
         host_rank = {h: r for r, (h, _c, _b, _o) in enumerate(members)}
@@ -317,7 +595,25 @@ class GangCoordinator:
             plan = self._plans.get(gid)
             first = plan is None
             if first:
-                plan = self._compute_plan(gid, pod, size, t)
+                # promote the Filter-time provisional plan instead of
+                # re-solving: the ONE leader solve already planned all
+                # members, and its per-member stamps make the promotion
+                # safe — reserve revalidates each stamp in-lock below,
+                # demoting exactly the members whose host moved since
+                # the solve (any real conflict still all-or-nothing
+                # aborts). Followers are memo reads off this plan.
+                # TPUSHARE_NO_GANG_SOLVE opts out: bind re-solves from
+                # live state, the full sequential (pre-v5) flow —
+                # identical geometry, because the solver is
+                # deterministic over unchanged state.
+                prov = self._provisional.pop(gid, None)
+                if prov is not None and prov[0] is not None \
+                        and prov[0].stamps is not None \
+                        and not os.environ.get("TPUSHARE_NO_GANG_SOLVE"):
+                    plan = prov[0]
+                    plan.t_ns = t
+                else:
+                    plan = self._compute_plan(gid, pod, size, t)
                 if plan is None:
                     raise GangError(
                         f"gang {gid}: no slice admits {size} chips "
@@ -333,9 +629,18 @@ class GangCoordinator:
                             raise AllocationError(
                                 f"gang {gid}: host {host} left the "
                                 "cache during planning")
-                        info.reserve_planned(_gang_key(gid, r), chips,
-                                             plan.hbm_mib
-                                             or info.hbm_per_chip)
+                        # in-lock stamp revalidation (ABI v5): a stamp
+                        # still matching the solve's snapshot proves
+                        # the host hasn't moved — reserve skips the
+                        # per-chip walk; a moved host demotes EXACTLY
+                        # this member to the solo validation path
+                        expect = plan.stamps[r] if plan.stamps \
+                            else None
+                        if info.reserve_planned(
+                                _gang_key(gid, r), chips,
+                                plan.hbm_mib or info.hbm_per_chip,
+                                expect_stamp=expect):
+                            plan.demoted.add(r)
                         reserved.append((host, r))
                 except AllocationError as e:
                     for host, r in reserved:
@@ -376,6 +681,10 @@ class GangCoordinator:
             extra_annotations=extra)
         with self._lock:
             plan.bound.add(rank)
+            GANG_MEMBERS.inc(
+                "demoted" if rank in plan.demoted
+                else "recovered" if plan.source == "recovered"
+                else "planned")
             if len(plan.bound) == len(plan.members):
                 # fully bound: the per-pod accounting owns everything now
                 self._plans.pop(gid, None)

@@ -220,6 +220,22 @@ class _PruneMap(dict):
         self.reasons: dict[tuple[str, int], str] = {}
 
 
+class _HostGroup:
+    """Adjacency-tier record for one multi-host slice: its host grid
+    plus the cached per-tier gang capacity (see gang_prune). ``ver``
+    counts member-summary changes; ``caps`` is valid only while
+    ``caps_ver == ver`` (the recompute-vs-mark race is resolved by
+    leaving the group dirty, never by serving a torn capacity)."""
+
+    __slots__ = ("hmesh", "caps", "caps_ver", "ver")
+
+    def __init__(self, hmesh) -> None:
+        self.hmesh = hmesh
+        self.caps: tuple[int, ...] | None = None
+        self.caps_ver = -1
+        self.ver = 0
+
+
 class CapacityIndex:
     """Incrementally maintained bucket index over node capability
     summaries. See the module docstring for semantics and lock order."""
@@ -256,6 +272,14 @@ class CapacityIndex:
         # of re-deriving every node's verdict per call
         self._prune_maps: OrderedDict[tuple, _PruneMap] = OrderedDict()
         self._gen = 0  # bumped on every summary install/drop
+        # adjacency tier (multi-host gangs): host-group records + the
+        # host -> group reverse map, guarded by their own leaf lock to
+        # the RIGHT of the index lock (rank 41 in the lint) — a summary
+        # install marks the member's group dirty while holding the
+        # index lock; gang_prune recomputes lazily
+        self._adj_lock = threading.Lock()
+        self._groups: dict[str, _HostGroup] = {}
+        self._host_group: dict[str, str] = {}
 
     # -- maintenance ----------------------------------------------------------
 
@@ -331,6 +355,7 @@ class CapacityIndex:
     def _install_locked(self, name: str, s: _Summary) -> None:
         self._summaries[name] = s
         self._gen += 1
+        self._mark_adj_dirty(name)
         if s.non_tpu:
             # never bucketed OR prune-mapped: their verdict is a
             # structural error message, not a no-fit
@@ -355,6 +380,7 @@ class CapacityIndex:
     def _drop_locked(self, name: str) -> None:
         s = self._summaries.pop(name, None)
         self._gen += 1
+        self._mark_adj_dirty(name)
         for m in self._prune_maps.values():
             m.pop(name, None)
             m.gen = self._gen
@@ -393,6 +419,111 @@ class CapacityIndex:
                 self._prune_maps.popitem(last=False)
             self._prune_maps[key] = m
             return m
+
+    # -- adjacency tier (multi-host gangs) ------------------------------------
+
+    def _mark_adj_dirty(self, name: str) -> None:
+        """Index lock held; _adj_lock (rank 41) is to its right."""
+        if not self._groups:  # common case: no slices registered
+            return
+        with self._adj_lock:
+            gid = self._host_group.get(name)
+            if gid is not None:
+                g = self._groups.get(gid)
+                if g is not None:
+                    g.ver += 1
+
+    def register_group(self, group_id: str, hmesh) -> None:
+        """Register (or replace) a host group — one multi-host slice's
+        :class:`~tpushare.core.topology.HostMesh`. The gang coordinator
+        calls this when its slice catalog (re)builds; per-tier gang
+        capacities are maintained from member summaries from then on."""
+        with self._adj_lock:
+            old = self._groups.get(group_id)
+            if old is not None:
+                for h in old.hmesh.hosts:
+                    if self._host_group.get(h) == group_id:
+                        del self._host_group[h]
+            self._groups[group_id] = _HostGroup(hmesh)
+            for h in hmesh.hosts:
+                self._host_group[h] = group_id
+
+    def drop_group(self, group_id: str) -> None:
+        with self._adj_lock:
+            g = self._groups.pop(group_id, None)
+            if g is not None:
+                for h in g.hmesh.hosts:
+                    if self._host_group.get(h) == group_id:
+                        del self._host_group[h]
+
+    def _compute_gang_caps(self, hmesh) -> tuple[int, ...] | None:
+        """Per-tier gang capacity of a host group: the max, over
+        contiguous host sub-boxes whose hosts each have >=1 eligible
+        chip at the tier, of the summed eligible-chip counts. Any gang
+        placement's chips form a contiguous global box whose host
+        projection is such a sub-box (each touched host contributing
+        >=1 eligible chip), so chip_count > capacity is a CERTAIN
+        no-fit. None (never prune) while any member lacks a summary —
+        unknown capacity must not reject."""
+        with self._lock:
+            weights = []
+            for h in hmesh.hosts:
+                s = self._summaries.get(h)
+                if s is None:
+                    return None
+                weights.append(s.n_ge)  # non_tpu summaries are all-zero
+        caps: list[int] = []
+        prev_col: tuple[int, ...] | None = None
+        for ti in range(len(TIERS) + 1):
+            col = tuple(w[ti] for w in weights)
+            if col == prev_col:
+                caps.append(caps[-1])  # tiers sharing an eligibility
+                # column share the (host sub-box) enumeration
+                continue
+            prev_col = col
+            by_host = dict(zip(hmesh.hosts, col))
+            caps.append(hmesh.best_eligible_box(by_host.__getitem__))
+        return tuple(caps)
+
+    def gang_prune(self, group_id: str, req: PlacementRequest
+                   ) -> str | None:
+        """O(1) certain-no-fit check for a gang of ``req`` on the host
+        group (the adjacency-tier analogue of :meth:`prune_verdict`):
+        a reason string when the gang certainly cannot fit at the
+        request's tier, else None (solve it). Capacities are cached and
+        recomputed only after a member summary moved; the recompute
+        reads summaries under the index lock, never node locks, so this
+        is safe on the Filter path. Callers flush() first — the same
+        protocol as partition()."""
+        with self._adj_lock:
+            g = self._groups.get(group_id)
+            if g is None:
+                return None
+            hmesh, ver0 = g.hmesh, g.ver
+            caps = g.caps if g.caps_ver == g.ver else None
+        if caps is None:
+            caps = self._compute_gang_caps(hmesh)
+            if caps is None:
+                return None  # member without a summary: cannot prune
+            with self._adj_lock:
+                g2 = self._groups.get(group_id)
+                if g2 is g and g.ver == ver0:
+                    g.caps = caps
+                    g.caps_ver = ver0
+        ti = tier_for(req)
+        if req.chip_count > caps[ti]:
+            return (f"host-group gang capacity tier={tier_label(ti)} "
+                    f"{caps[ti]} < {req.chip_count}")
+        return None
+
+    def gang_caps(self, group_id: str) -> tuple[int, ...] | None:
+        """The group's cached (or freshly computed) per-tier gang
+        capacities — /inspect and property tests."""
+        with self._adj_lock:
+            g = self._groups.get(group_id)
+        if g is None:
+            return None
+        return self._compute_gang_caps(g.hmesh)
 
     # -- queries --------------------------------------------------------------
 
@@ -466,11 +597,14 @@ class CapacityIndex:
 
     def describe(self) -> dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "nodes": len(self._summaries),
                 "dirty": len(self._dirty),
                 "buckets": sum(1 for v in self._buckets.values() if v),
             }
+        with self._adj_lock:
+            out["host_groups"] = len(self._groups)
+        return out
 
     # -- self-audit (property tests + debugging) ------------------------------
 

@@ -586,14 +586,34 @@ class NodeInfo:
     # -- planned placements (gang coordination) -----------------------------
 
     def reserve_planned(self, key: str, chip_ids: Sequence[int],
-                        demand: int) -> None:
+                        demand: int,
+                        expect_stamp: tuple[int, int] | None = None) -> bool:
         """Reserve SPECIFIC chips under ``key`` (the gang coordinator's
         all-or-nothing reserve: the placement was decided at slice scope,
         this node just holds its share). Raises AllocationError if any
         chip cannot currently host ``demand`` — the caller rolls back
         the sibling nodes' reservations.
+
+        ``expect_stamp`` is the (epoch, counter) stamp the gang solve
+        snapshotted this node at (ABI v5 one-shot plan). When it still
+        matches in-lock, the node provably has not mutated since the
+        solve, so the per-chip eligibility walk is skipped — the stamp
+        IS the proof. When it moved, exactly this member is demoted to
+        the solo validation path (the full per-chip check below), which
+        either admits the planned chips anyway (the mutation was
+        elsewhere on the node) or raises for the coordinator's
+        all-or-nothing rollback — never oversubscribes. Returns True
+        when the member was demoted (caller feeds the gang metrics).
         """
         with self._lock:
+            demoted = False
+            if expect_stamp is not None \
+                    and (self._epoch, self._version) == expect_stamp:
+                for cid in chip_ids:
+                    self.chips[cid].reserve(key, demand)
+                self._dirty()
+                return False
+            demoted = expect_stamp is not None
             views = {c.idx: c.view(healthy=c.idx not in self._unhealthy)
                      for c in self.chips}
             for cid in chip_ids:
@@ -609,6 +629,7 @@ class NodeInfo:
             for cid in chip_ids:
                 self.chips[cid].reserve(key, demand)
             self._dirty()
+            return demoted
 
     def release_planned(self, key: str, chip_ids: Sequence[int]) -> None:
         """Drop a reserved-only planned share (rollback / plan expiry)."""

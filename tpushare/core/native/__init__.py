@@ -11,14 +11,17 @@ as a gauge, so the degradation is diagnosable rather than silent.
 from tpushare.core.native.engine import (
     NATIVE_FALLBACKS,
     NATIVE_FLEET_SCANS,
+    SliceArena,
     abi_version,
     available,
     describe,
+    gang_solve_supported,
     select_chips,
     select_gang_box,
+    solve_gang,
     warmup,
 )
 
-__all__ = ["NATIVE_FALLBACKS", "NATIVE_FLEET_SCANS", "abi_version",
-           "available", "describe", "select_chips", "select_gang_box",
-           "warmup"]
+__all__ = ["NATIVE_FALLBACKS", "NATIVE_FLEET_SCANS", "SliceArena",
+           "abi_version", "available", "describe", "gang_solve_supported",
+           "select_chips", "select_gang_box", "solve_gang", "warmup"]

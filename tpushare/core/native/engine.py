@@ -281,6 +281,53 @@ def cycle_supported() -> bool:
     return _cycle_fn() is not None
 
 
+def _gang_fn():
+    """The ABI v5 tpushare_solve_gang symbol, or None when gang
+    placement must run the sequential select_gang + Python-decompose
+    path (no lib, stale pre-v5 .so, or the TPUSHARE_NO_GANG_SOLVE
+    escape hatch). Both paths are byte-identical by the parity
+    contract; this one runs the whole solve in one GIL-released call."""
+    if os.environ.get("TPUSHARE_NO_GANG_SOLVE"):
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    fn = getattr(lib, "tpushare_solve_gang", None)
+    if fn is not None and not getattr(fn, "_tpushare_typed", False):
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_int,    # n_chips (global slice mesh)
+            i64p,            # free per global chip (-1 = ineligible)
+            i64p,            # total per global chip
+            ctypes.c_int,    # rank
+            i64p,            # mesh dims
+            i64p,            # uniform host box dims
+            ctypes.c_int64,  # req hbm
+            ctypes.c_int,    # req count
+            ctypes.c_int,    # topo rank
+            i64p,            # topo dims
+            ctypes.c_int,    # max members (out-array capacity)
+            i64p,            # out box (rank)
+            i64p,            # out origin (rank)
+            i64p,            # out score (1)
+            i64p,            # out n_members (1)
+            i64p,            # out member host ordinal (max_members)
+            i64p,            # out member chip count (max_members)
+            i64p,            # out member local ids (m * req_count stride)
+            i64p,            # out member box (m * rank stride)
+            i64p,            # out member origin (m * rank stride)
+            i64p,            # out member score (max_members)
+        ]
+        fn._tpushare_typed = True
+    return fn
+
+
+def gang_solve_supported() -> bool:
+    """True when gang placement runs the one-call ABI v5 path."""
+    return _gang_fn() is not None
+
+
 def describe() -> "dict":
     """Observability snapshot for /inspect and bench: availability, ABI,
     scan worker config, and the fallback/scan counters."""
@@ -288,6 +335,7 @@ def describe() -> "dict":
         "available": available(),
         "abi_version": abi_version(),
         "cycle_supported": cycle_supported(),
+        "gang_solve_supported": gang_solve_supported(),
         "scan_workers": _scan_workers(),
         "fleet_scans": {f"{call}/{engine}": v for (call, engine), v
                         in NATIVE_FLEET_SCANS.snapshot().items()},
@@ -1443,3 +1491,153 @@ def select_gang_box(slice_topo, views, req, merged=None):
         return None
     return (tuple(int(out_box[i]) for i in range(rank)),
             tuple(int(out_origin[i]) for i in range(rank)))
+
+
+class SliceArena:
+    """Resident marshalled state for ONE multi-host slice (the gang
+    analogue of :class:`FleetArena`): the global used/total/healthy chip
+    arrays in slice-mesh row-major layout, delta-synced per host by
+    (epoch, counter) stamp, against which :meth:`solve` runs the ABI v5
+    one-shot gang solve. The per-host global-index maps are computed
+    once at construction; a sync touches only hosts whose stamp moved,
+    so a quiet slice costs a dict compare per host per solve instead of
+    a full remarshal (the Python select_gang path re-merges every chip
+    of every host on every attempt).
+    """
+
+    def __init__(self, slice_topo, hmesh) -> None:
+        self.topo = slice_topo
+        self.hmesh = hmesh
+        mesh = slice_topo.mesh
+        self.rank = len(mesh.shape)
+        self.n = mesh.num_chips
+        self._mesh_arr = (ctypes.c_int64 * self.rank)(*mesh.shape)
+        self._hbox_arr = (ctypes.c_int64 * self.rank)(*hmesh.hbox)
+        self._used = (ctypes.c_int64 * self.n)()
+        self._total = (ctypes.c_int64 * self.n)()
+        self._healthy = (ctypes.c_uint8 * self.n)()
+        self._free = (ctypes.c_int64 * self.n)()  # per-solve scratch
+        self._stamps: dict = {}  # host -> last synced stamp
+        # per host: local chip id -> global mesh index (local row-major)
+        self._gidx: dict = {}
+        for name in hmesh.hosts:
+            hb = slice_topo.hosts[name]
+            local = slice_topo.local_topology(name)
+            self._gidx[name] = [
+                mesh.index(tuple(o + c for o, c in
+                                 zip(hb.origin, local.coords(li))))
+                for li in range(local.num_chips)]
+        self.host_updates = 0  # observability: delta work done
+
+    def stamp(self, name):
+        """The last synced stamp for ``name`` (None if never synced) —
+        callers compare it against the node's lock-free version to skip
+        even the SNAPSHOT for unchanged hosts, not just the remarshal."""
+        return self._stamps.get(name)
+
+    def sync(self, host_views) -> None:
+        """Bring the arena up to ``{host: (stamp, chips)}``: no-op for
+        stamp-matched hosts, window rewrite for moved stamps; hosts
+        absent from the mapping (down, unreported) go ineligible —
+        the same degraded semantics as SliceTopology.global_view.
+        ``chips=None`` asserts a stamp match (the caller skipped the
+        snapshot); if the stamp moved anyway the host goes ineligible
+        rather than solving against stale chip state."""
+        for name, idxs in self._gidx.items():
+            entry = host_views.get(name)
+            if entry is None:
+                if name in self._stamps:  # was synced: go ineligible
+                    del self._stamps[name]
+                    for g in idxs:
+                        self._healthy[g] = 0
+                    self.host_updates += 1
+                continue
+            stamp, chips = entry
+            if stamp is not None and self._stamps.get(name) == stamp:
+                continue
+            if chips is None:  # promised-unchanged host actually moved
+                if name in self._stamps:
+                    del self._stamps[name]
+                    for g in idxs:
+                        self._healthy[g] = 0
+                    self.host_updates += 1
+                continue
+            for g in idxs:
+                self._healthy[g] = 0  # chips missing from the snapshot
+            for c in chips:
+                if 0 <= c.idx < len(idxs):
+                    g = idxs[c.idx]
+                    self._used[g] = c.used_hbm_mib
+                    self._total[g] = c.total_hbm_mib
+                    self._healthy[g] = 1 if c.healthy else 0
+            self._stamps[name] = stamp
+            self.host_updates += 1
+
+    def solve(self, req: "PlacementRequest"):
+        """One-shot native gang solve against the resident arrays:
+        GangPlacement | None (no fit) | "fallback" (engine can't express
+        the problem — caller runs the sequential select_gang path)."""
+        fn = _gang_fn()
+        if fn is None or req.allow_scatter:
+            return "fallback"
+        from tpushare.core.placement import Placement
+        from tpushare.core.slice import GangPlacement
+
+        # fold request-dependent eligibility into the free scratch the
+        # same way select_chips marshalling does (exclusive => used==0)
+        exclusive = req.hbm_mib == 0
+        for i in range(self.n):
+            if self._healthy[i] and not (exclusive and self._used[i]):
+                self._free[i] = self._total[i] - self._used[i]
+            else:
+                self._free[i] = -1
+
+        rank = self.rank
+        n_hosts = self.hmesh.num_hosts
+        t_rank = len(req.topology) if req.topology else 0
+        t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
+        out_box = (ctypes.c_int64 * rank)()
+        out_origin = (ctypes.c_int64 * rank)()
+        out_score = (ctypes.c_int64 * 1)()
+        out_nmem = (ctypes.c_int64 * 1)()
+        out_mhost = (ctypes.c_int64 * n_hosts)()
+        out_mn = (ctypes.c_int64 * n_hosts)()
+        out_mids = (ctypes.c_int64 * (n_hosts * req.chip_count))()
+        out_mbox = (ctypes.c_int64 * (n_hosts * rank))()
+        out_morigin = (ctypes.c_int64 * (n_hosts * rank))()
+        out_mscore = (ctypes.c_int64 * n_hosts)()
+        rc = fn(self.n, self._free, self._total, rank, self._mesh_arr,
+                self._hbox_arr, req.hbm_mib, req.chip_count,
+                t_rank, t_dims, n_hosts,
+                out_box, out_origin, out_score, out_nmem,
+                out_mhost, out_mn, out_mids, out_mbox, out_morigin,
+                out_mscore)
+        if rc < 0:
+            NATIVE_FALLBACKS.inc("engine_error")
+            return "fallback"
+        if rc == 0:
+            return None
+        per_host: dict = {}
+        for m in range(int(out_nmem[0])):
+            name = self.hmesh.hosts[int(out_mhost[m])]
+            k = int(out_mn[m])
+            per_host[name] = Placement(
+                tuple(int(out_mids[m * req.chip_count + j])
+                      for j in range(k)),
+                box=tuple(int(out_mbox[m * rank + i]) for i in range(rank)),
+                origin=tuple(int(out_morigin[m * rank + i])
+                             for i in range(rank)),
+                score=int(out_mscore[m]))
+        return GangPlacement(
+            box=tuple(int(out_box[i]) for i in range(rank)),
+            origin=tuple(int(out_origin[i]) for i in range(rank)),
+            per_host=per_host, score=int(out_score[0]))
+
+
+def solve_gang(slice_topo, hmesh, views, req):
+    """One-shot gang solve convenience (parity tests, non-resident
+    callers): marshal a throwaway :class:`SliceArena` and solve. The
+    GangCoordinator keeps a resident arena per slice instead."""
+    arena = SliceArena(slice_topo, hmesh)
+    arena.sync({h: (None, v) for h, v in views.items()})
+    return arena.solve(req)

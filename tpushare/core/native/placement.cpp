@@ -148,7 +148,22 @@ bool fits_one(int n_chips, const int64_t* free_hbm, const int64_t* total_hbm,
 // the inputs (node n's chips at [offsets[n], offsets[n]+req_count)),
 // and box/origin at the mesh_rank_offsets — so the sharding and
 // resident-arena contracts below carry over to the outputs verbatim.
-extern "C" int64_t tpushare_abi_version() { return 4; }
+//
+// ABI v5 COMPATIBILITY NOTE: v5 adds tpushare_solve_gang (one-shot
+// multi-node gang solve: the tpushare_select_gang box search PLUS the
+// per-member host decomposition that used to run in Python, against a
+// resident slice arena). Every v4 entry point keeps its exact signature
+// and semantics -- a v4 caller against a v5 .so is fully compatible; a
+// v5 caller against a v4 .so detects the missing symbol (AttributeError
+// at bind time, engine.py _gang_fn) and runs the sequential
+// select_gang + Python-decomposition path, which is byte-identical by
+// the parity contract (tests/test_native_parity.py). v5 member-array
+// layout: member m's local chip ids sit at out_m_ids[m * req_count ..),
+// geometry at out_m_box/out_m_origin[m * rank ..) -- member windows are
+// per-member strided and independent, so the resident-arena reuse
+// contract (caller keeps ONE marshalled slice and re-solves against
+// delta-updated free values, engine.py SliceArena) carries over.
+extern "C" int64_t tpushare_abi_version() { return 5; }
 
 // Fleet-wide Filter: one call evaluates every candidate node, avoiding
 // per-node FFI marshalling (the reference's hot loop #1 x #2,
@@ -645,4 +660,194 @@ extern "C" int tpushare_solve_batch(
     rescore(best);
   }
   return 0;
+}
+
+// -- ABI v5: one-shot multi-node gang solve ----------------------------------
+
+// tpushare_select_gang's box search PLUS the per-member host
+// decomposition (tpushare/core/slice.py _build_gang is the behavioral
+// spec), in one GIL-released call. The host partition is given as the
+// uniform per-host box dims `hbox` (mesh must tile exactly: mesh[i] %
+// hbox[i] == 0) — host ordinal = row-major index over the host grid
+// mesh/hbox, matching HostMesh in core/topology.py. Compared to
+// select_gang this removes the Python-side merge/decompose passes and
+// lets the caller keep a RESIDENT marshalled slice (engine.py
+// SliceArena) whose free values are delta-synced per host.
+//
+// Outputs on return 1: global best box/origin/score as select_gang,
+// plus *out_n_members member records in FIRST-APPEARANCE order over the
+// row-major box walk (the same order slice.py _build_gang discovers
+// hosts): out_m_host[m] = host ordinal, out_m_nchips[m] chips with
+// sorted LOCAL ids at out_m_ids[m * req_count ..), local geometry at
+// out_m_box/out_m_origin[m * rank ..), binpack sub-score at
+// out_m_score[m]. The member windows are strided by the caller-known
+// req_count / rank, never by n_members — windows are independent.
+// Return 0 = no placement, -1 = not expressible (caller falls back).
+extern "C" int tpushare_solve_gang(
+    int n_chips,
+    const int64_t* free_hbm,   // -1 => ineligible (caller folds eligibility)
+    const int64_t* total_hbm,
+    int rank,
+    const int64_t* mesh,
+    const int64_t* hbox,       // uniform per-host box dims (rank)
+    int64_t req_hbm,           // 0 => exclusive (demand = chip total)
+    int req_count,
+    int topo_rank,             // 0 => any shape
+    const int64_t* topo_dims,
+    int max_members,           // capacity of the member out arrays
+    int64_t* out_box,
+    int64_t* out_origin,
+    int64_t* out_score,
+    int64_t* out_n_members,
+    int64_t* out_m_host,
+    int64_t* out_m_nchips,
+    int64_t* out_m_ids,
+    int64_t* out_m_box,
+    int64_t* out_m_origin,
+    int64_t* out_m_score) {
+  if (n_chips <= 0 || rank <= 0 || req_count <= 0 || max_members <= 0)
+    return -1;
+  if (req_count > n_chips) return 0;
+  int64_t mesh_n = 1, n_hosts = 1;
+  for (int i = 0; i < rank; ++i) {
+    if (hbox[i] <= 0 || mesh[i] % hbox[i] != 0) return -1;
+    mesh_n *= mesh[i];
+    n_hosts *= mesh[i] / hbox[i];
+  }
+  if (mesh_n != n_chips) return -1;
+
+  auto demand = [&](int i) -> int64_t {
+    return req_hbm == 0 ? total_hbm[i] : req_hbm;
+  };
+  auto eligible = [&](int i) -> bool {
+    return free_hbm[i] >= 0 && free_hbm[i] >= demand(i);
+  };
+  // host ordinal of a global coordinate: row-major over the host grid
+  std::vector<int64_t> grid(rank);
+  for (int i = 0; i < rank; ++i) grid[i] = mesh[i] / hbox[i];
+  auto host_of = [&](const int64_t* coords) -> int64_t {
+    int64_t h = 0;
+    for (int i = 0; i < rank; ++i) h = h * grid[i] + coords[i] / hbox[i];
+    return h;
+  };
+
+  std::vector<Shape> shapes;
+  if (topo_rank > 0) {
+    if (topo_rank != rank) return 0;  // rank-mismatched pin cannot match
+    Shape s; s.d.assign(topo_dims, topo_dims + topo_rank);
+    int64_t prod = 1;
+    for (auto d : s.d) prod *= d;
+    if (prod != req_count) return 0;
+    shapes.push_back(std::move(s));
+  } else {
+    std::vector<int64_t> prefix;
+    enum_shapes(mesh, rank, 0, req_count, prefix, shapes);
+    std::sort(shapes.begin(), shapes.end(), shape_less);
+  }
+
+  std::vector<int64_t> origin(rank), c(rank), abs(rank);
+  std::vector<int64_t> best_origin(rank), best_box(rank);
+  std::vector<char> host_seen(n_hosts);
+  bool found = false;
+  for (const auto& shape : shapes) {
+    bool fits_mesh = true;
+    for (int i = 0; i < rank; ++i)
+      if (shape.d[i] > mesh[i]) { fits_mesh = false; break; }
+    if (!fits_mesh) continue;
+
+    int64_t best_score = 0, best_hosts = 0;
+    std::fill(origin.begin(), origin.end(), 0);
+    while (true) {
+      int64_t score = 0, hosts = 0;
+      bool ok = true;
+      std::fill(host_seen.begin(), host_seen.end(), 0);
+      std::fill(c.begin(), c.end(), 0);
+      while (true) {
+        for (int i = 0; i < rank; ++i) abs[i] = origin[i] + c[i];
+        int64_t idx = chip_index(mesh, rank, abs.data());
+        if (!eligible((int)idx)) { ok = false; break; }
+        score += free_hbm[idx] - demand((int)idx);
+        int64_t h = host_of(abs.data());
+        if (!host_seen[h]) { host_seen[h] = 1; ++hosts; }
+        int ax = rank - 1;
+        while (ax >= 0 && ++c[ax] == shape.d[ax]) c[ax--] = 0;
+        if (ax < 0) break;
+      }
+      // ascending-origin iteration + strict less keeps the earliest
+      // origin on (hosts, score) ties — same key as select_gang
+      if (ok && (!found || hosts < best_hosts ||
+                 (hosts == best_hosts && score < best_score))) {
+        found = true;
+        best_hosts = hosts;
+        best_score = score;
+        best_origin = origin;
+        best_box = shape.d;
+      }
+      int ax = rank - 1;
+      while (ax >= 0 && ++origin[ax] > mesh[ax] - shape.d[ax]) origin[ax--] = 0;
+      if (ax < 0) break;
+    }
+    if (found) break;  // first shape class with a placement wins
+  }
+  if (!found) return 0;
+
+  // -- decompose the winning box into per-host member records ----------------
+  // member index per host ordinal, assigned in first-appearance order
+  // over the SAME row-major box walk the search used (and slice.py
+  // _build_gang uses), so member order matches the Python spec exactly
+  std::vector<int> member_of(n_hosts, -1);
+  int n_members = 0;
+  int64_t total_score = 0;
+  std::fill(c.begin(), c.end(), 0);
+  while (true) {
+    for (int i = 0; i < rank; ++i) abs[i] = best_origin[i] + c[i];
+    int64_t idx = chip_index(mesh, rank, abs.data());
+    int64_t h = host_of(abs.data());
+    int m = member_of[h];
+    if (m < 0) {
+      if (n_members >= max_members) return -1;  // caller sized too small
+      m = member_of[h] = n_members++;
+      out_m_host[m] = h;
+      out_m_nchips[m] = 0;
+      out_m_score[m] = 0;
+      for (int i = 0; i < rank; ++i) {
+        // host-local box accumulators: origin tracks the min local
+        // coord, box temporarily the max (turned into extent below)
+        out_m_origin[(int64_t)m * rank + i] = hbox[i];
+        out_m_box[(int64_t)m * rank + i] = -1;
+      }
+    }
+    // local coordinate within the host's tile + row-major local id
+    int64_t lid = 0;
+    for (int i = 0; i < rank; ++i) {
+      int64_t lc = abs[i] % hbox[i];
+      lid = lid * hbox[i] + lc;
+      int64_t* mo = out_m_origin + (int64_t)m * rank + i;
+      int64_t* mb = out_m_box + (int64_t)m * rank + i;
+      if (lc < *mo) *mo = lc;
+      if (lc > *mb) *mb = lc;
+    }
+    // row-major walk visits each host's cells in ascending local id
+    // order, so the per-member id list lands sorted without a sort
+    out_m_ids[(int64_t)m * req_count + out_m_nchips[m]++] = lid;
+    out_m_score[m] += free_hbm[idx] - demand((int)idx);
+    int ax = rank - 1;
+    while (ax >= 0 && ++c[ax] == best_box[ax]) c[ax--] = 0;
+    if (ax < 0) break;
+  }
+  for (int m = 0; m < n_members; ++m) {
+    total_score += out_m_score[m];
+    for (int i = 0; i < rank; ++i) {
+      int64_t o = out_m_origin[(int64_t)m * rank + i];
+      out_m_box[(int64_t)m * rank + i] =
+          out_m_box[(int64_t)m * rank + i] - o + 1;
+    }
+  }
+  for (int i = 0; i < rank; ++i) {
+    out_box[i] = best_box[i];
+    out_origin[i] = best_origin[i];
+  }
+  *out_score = total_score;
+  *out_n_members = n_members;
+  return 1;
 }

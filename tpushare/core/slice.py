@@ -203,6 +203,18 @@ def select_gang(slice_topo: SliceTopology,
     return _search_gang(slice_topo, views, req, first_only=False)
 
 
+def _py_solve_gang(slice_topo: SliceTopology,
+                   views: Mapping[str, Sequence[ChipView]],
+                   req: PlacementRequest) -> GangPlacement | None:
+    """Behavioral spec for the ABI v5 one-shot native gang solve
+    (placement.cpp tpushare_solve_gang): the full Python search +
+    decomposition, bypassing every native entry point. Parity between
+    this and engine.solve_gang over randomized fleets/meshes/gang
+    shapes is enforced by tests/test_native_parity.py; byte-identity
+    is what lets TPUSHARE_NO_GANG_SOLVE be a pure perf knob."""
+    return _search_gang(slice_topo, views, req, first_only=False)
+
+
 def _search_gang(slice_topo: SliceTopology,
                  views: Mapping[str, Sequence[ChipView]],
                  req: PlacementRequest,

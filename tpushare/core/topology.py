@@ -132,6 +132,161 @@ class MeshTopology:
         return "x".join(str(d) for d in self.shape)
 
 
+@dataclass(frozen=True)
+class HostMesh:
+    """Inter-node adjacency model: hosts as points in a *host grid*.
+
+    A multi-host slice is a chip mesh tiled by identical per-host boxes; the
+    device plugin publishes each host's tile origin as the node label
+    ``tpushare.aliyun.com/slice-origin`` (``"0x2"`` form). Dividing those
+    origins by the uniform host-box dims places every host at an integer
+    point of a coarse grid — the geometry a cross-host gang must satisfy:
+    its member hosts form a contiguous axis-aligned sub-box of this grid,
+    exactly as a single-host placement forms a sub-box of the chip mesh.
+
+    ``grid`` is the host-grid dims, ``hbox`` the uniform per-host chip box,
+    ``hosts`` the host names row-major over the grid (last axis fastest),
+    matching :class:`MeshTopology` index order so chip-level and host-level
+    coordinates compose without translation tables.
+    """
+
+    grid: tuple[int, ...]
+    hbox: tuple[int, ...]
+    hosts: tuple[str, ...]
+
+    def __post_init__(self) -> None:
+        n = 1
+        for d in self.grid:
+            n *= d
+        if len(self.hosts) != n:
+            raise ValueError(
+                f"{len(self.hosts)} hosts cannot tile host grid {self.grid}")
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def mesh(self) -> MeshTopology:
+        """The host grid viewed as a (tiny) mesh of host-points."""
+        return MeshTopology(self.grid)
+
+    def host_coord(self, name: str) -> tuple[int, ...]:
+        return self.mesh.coords(self.hosts.index(name))
+
+    def chip_origin(self, name: str) -> tuple[int, ...]:
+        """The host's tile origin in global chip coordinates."""
+        return tuple(c * b for c, b in zip(self.host_coord(name), self.hbox))
+
+    def best_eligible_box(self, weight_of) -> int:
+        """Max total weight over contiguous host sub-boxes of all-eligible
+        hosts (``weight_of(name) > 0``). Powers the adjacency-tier prune in
+        :mod:`tpushare.cache.index`: any gang placement's member hosts form
+        such a sub-box with >=1 eligible chip each, so a gang whose chip
+        demand exceeds this bound cannot fit — regardless of chip geometry.
+
+        2-d grids (every v5e/v5p pod slice) run in O(hosts) via the
+        maximal-rectangle histogram scan: weights are positive inside an
+        eligible box, so the best box is a MAXIMAL eligible rectangle,
+        every one of which surfaces as a stack pop at its bottom row;
+        a 2-d prefix sum prices each candidate O(1). This sits on the
+        Filter hot path (recomputed per mutated host group), where the
+        shapes x positions x cells enumeration was O(hosts^3) — seconds
+        per solve at 512 hosts. Other ranks keep the enumeration.
+        """
+        w = [weight_of(h) for h in self.hosts]
+        if len(self.grid) == 2:
+            return _best_box_2d(self.grid[0], self.grid[1], w)
+        gm = self.mesh
+        best = 0
+        for shape in itertools.product(*[range(1, d + 1) for d in self.grid]):
+            for origin in gm.box_positions(shape):
+                total = 0
+                for c in itertools.product(
+                        *[range(o, o + s) for o, s in zip(origin, shape)]):
+                    wt = w[gm.index(c)]
+                    if wt <= 0:
+                        total = -1
+                        break
+                    total += wt
+                if total > best:
+                    best = total
+        return best
+
+    @classmethod
+    def from_layout(
+        cls, layout: dict[str, tuple[tuple[int, ...], tuple[int, ...]]],
+    ) -> "HostMesh":
+        """Build from ``{host: (chip_origin, chip_shape)}`` as read off the
+        slice-origin / mesh node labels. Raises ``ValueError`` when the
+        labels do not describe a uniform, aligned, fully-tiled host grid —
+        callers treat that as "this slice has no gang geometry" and skip it.
+        """
+        if not layout:
+            raise ValueError("empty slice layout")
+        shapes = {shape for _, shape in layout.values()}
+        if len(shapes) != 1:
+            raise ValueError(f"non-uniform host boxes {sorted(shapes)}")
+        hbox = next(iter(shapes))
+        rank = len(hbox)
+        grid = [0] * rank
+        for name, (origin, _) in layout.items():
+            if len(origin) != rank:
+                raise ValueError(f"host {name}: origin rank != box rank")
+            for ax, (o, b) in enumerate(zip(origin, hbox)):
+                if o % b:
+                    raise ValueError(
+                        f"host {name}: origin {origin} not aligned to {hbox}")
+                grid[ax] = max(grid[ax], o // b + 1)
+        gm = MeshTopology(tuple(grid))
+        cells: list[str | None] = [None] * gm.num_chips
+        for name, (origin, _) in layout.items():
+            idx = gm.index(tuple(o // b for o, b in zip(origin, hbox)))
+            if cells[idx] is not None:
+                raise ValueError(
+                    f"hosts {cells[idx]} and {name} share origin {origin}")
+            cells[idx] = name
+        if any(c is None for c in cells):
+            raise ValueError(f"host grid {tuple(grid)} not fully tiled")
+        return cls(tuple(grid), hbox, tuple(cells))  # type: ignore[arg-type]
+
+
+def _best_box_2d(rows: int, cols: int, w: list[int]) -> int:
+    """Max-weight all-positive sub-rectangle of a row-major ``rows x
+    cols`` weight grid. Any all-positive rectangle extends to a MAXIMAL
+    one with no smaller sum (extensions only add positive weight), and
+    every maximal rectangle is popped off the histogram stack at its
+    true bottom row with its exact extent — priced O(1) off the prefix
+    sum, O(rows * cols) overall."""
+    # P[r+1][c+1] = sum of w over rows 0..r, cols 0..c
+    pref = [[0] * (cols + 1) for _ in range(rows + 1)]
+    for r in range(rows):
+        pr, pq = pref[r + 1], pref[r]
+        base = r * cols
+        for c in range(cols):
+            pr[c + 1] = pq[c + 1] + pr[c] - pq[c] + w[base + c]
+    best = 0
+    heights = [0] * cols  # consecutive all-positive rows ending at row r
+    for r in range(rows):
+        base = r * cols
+        for c in range(cols):
+            heights[c] = heights[c] + 1 if w[base + c] > 0 else 0
+        stack: list[tuple[int, int]] = []  # (leftmost col, height)
+        for c in range(cols + 1):
+            h = heights[c] if c < cols else 0
+            start = c
+            while stack and stack[-1][1] >= h:
+                start, sh = stack.pop()
+                # maximal candidate: rows [r-sh+1, r] x cols [start, c-1]
+                s = pref[r + 1][c] - pref[r - sh + 1][c] \
+                    - pref[r + 1][start] + pref[r - sh + 1][start]
+                if s > best:
+                    best = s
+            if h and (not stack or stack[-1][1] < h):
+                stack.append((start, h))
+    return best
+
+
 @lru_cache(maxsize=4096)
 def _box_shapes(mesh: tuple[int, ...], count: int) -> list[tuple[int, ...]]:
     rank = len(mesh)

@@ -154,16 +154,31 @@ class FilterHandler:
                 return {"NodeNames": [], "FailedNodes": {},
                         "Error": str(e)}
             if membership is not None:
-                sp.set_tag("gang", membership[0])
-                hosts, reason = self._gang.filter_hosts(pod)
+                gid, size, rank = membership
+                sp.set_tag("gang", gid)
+                hosts, reason = self._gang.filter_hosts(
+                    pod, trace_id=trace_id)
                 hosts = [h for h in hosts if h in set(node_names)]
                 failed = {} if hosts else {
                     n: reason or "not the planned gang host"
                     for n in node_names if n}
-                audit({n: {"verdict": "ok", "reason": "planned gang host"}
-                       for n in hosts}
-                      | {n: {"verdict": "rejected", "reason": r}
-                         for n, r in failed.items()})
+                if hosts and self._explain is not None:
+                    # every member's explain record points at the
+                    # LEADER's trace (one solve planned the whole
+                    # gang; followers are memo reads off that plan)
+                    info = self._gang.plan_info(gid)
+                    self._explain.record_gang(
+                        pod_key, pod, trace_id,
+                        leader_trace_id=(info or {}).get(
+                            "leader_trace_id") or trace_id,
+                        gang_id=gid, size=size, rank=rank,
+                        node=hosts[0])
+                else:
+                    audit({n: {"verdict": "ok",
+                               "reason": "planned gang host"}
+                           for n in hosts}
+                          | {n: {"verdict": "rejected", "reason": r}
+                             for n, r in failed.items()})
                 log.debug("filter gang %s: -> %s",
                           podlib.pod_key(pod), hosts)
                 return {"NodeNames": hosts, "FailedNodes": failed,
@@ -973,6 +988,14 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
     registry.register(_native.BATCH_NATIVE_SOLVES)
     registry.register(BATCH_SOLVES)
     registry.register(BATCH_WINDOW_PODS)
+    # gang-solve set (ABI v5): one-shot cross-host solves by outcome
+    # (pruned = the adjacency tier skipped a solve entirely) and member
+    # binds by seed source (a rising demoted share = heavy mutation
+    # between solve and bind)
+    from tpushare.cache.gang import GANG_MEMBERS, GANG_SOLVES
+
+    registry.register(GANG_SOLVES)
+    registry.register(GANG_MEMBERS)
     registry.gauge_func(
         "tpushare_native_engine_available",
         "1 when the C++ placement engine is loaded, 0 when scans run "

@@ -315,6 +315,8 @@ class ExtenderServer:
             return _enc(200, self.fleetwatch.snapshot())
         if path in ("/inspect/defrag", f"{PREFIX}/inspect/defrag"):
             return _enc(200, self.defrag.snapshot())
+        if path in ("/inspect/gang", f"{PREFIX}/inspect/gang"):
+            return _enc(200, self.gang.snapshot())
         if path in ("/inspect/ring", f"{PREFIX}/inspect/ring"):
             if self._sharding is not None:
                 return _enc(200, self._sharding.snapshot())

@@ -6,6 +6,7 @@ the operator subcommands over the extender's diagnostic endpoints:
     tpushare-inspect fleet             # /inspect/fleet health snapshot
     tpushare-inspect defrag            # /inspect/defrag rebalancer state
     tpushare-inspect ring              # /inspect/ring shard membership
+    tpushare-inspect gang              # /inspect/gang planner snapshot
     tpushare-inspect explain [<pod>]   # /inspect/explain decision audit
     tpushare-inspect traces [-n N]     # /debug/traces flight recorder
 
@@ -240,6 +241,54 @@ def render_ring(snap: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_gang(snap: dict[str, Any]) -> str:
+    """Terminal rendering of the /inspect/gang planner snapshot."""
+    lines: list[str] = []
+    plans = snap.get("plans") or []
+    catalog = snap.get("catalog") or []
+    lines.append(
+        f"gang planner: {len(plans)} live plan(s), "
+        f"{len(snap.get('provisional') or [])} provisional, "
+        f"{len(catalog)} slice(s) in catalog")
+    for s in catalog:
+        grid = s.get("host_grid")
+        lines.append(
+            f"  slice {s.get('slice')}: {s.get('hosts', 0)} host(s), "
+            + (f"host grid {'x'.join(str(d) for d in grid)}"
+               if grid else "non-uniform tiling")
+            + (", native arena" if s.get("native_arena")
+               else ", sequential kernel"))
+    if plans:
+        lines.append("")
+        rows = [["GANG", "SLICE", "SIZE", "BOUND", "DEMOTED", "ENGINE",
+                 "SOURCE", "LEADER TRACE"]]
+        for p in plans:
+            rows.append([
+                p.get("gang_id", "-"), p.get("slice", "-"),
+                str(p.get("size", 0)),
+                f"{len(p.get('bound') or [])}/{p.get('size', 0)}",
+                str(len(p.get("demoted") or [])),
+                p.get("engine") or "-", p.get("source", "-"),
+                p.get("leader_trace_id") or "-"])
+        widths = [max(len(r[i]) for r in rows)
+                  for i in range(len(rows[0]))]
+        lines.extend(_fmt_row(r, widths) for r in rows)
+    else:
+        lines.append("no live plans")
+    solves = snap.get("solves") or {}
+    members = snap.get("members") or {}
+    lines.append("")
+    lines.append(
+        "solves: " + (", ".join(
+            f"{k}={int(v)}" for k, v in sorted(solves.items()))
+            or "none"))
+    lines.append(
+        "member binds: " + (", ".join(
+            f"{k}={int(v)}" for k, v in sorted(members.items()))
+            or "none"))
+    return "\n".join(lines)
+
+
 def render_traces(dump: dict[str, Any], limit: int | None = None) -> str:
     """Terminal rendering of the /debug/traces flight recorder."""
     lines: list[str] = []
@@ -274,7 +323,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="traces: show at most N traces")
     ap.add_argument("target", nargs="*", default=[],
                     help="node name, or a subcommand: 'fleet', 'defrag', "
-                         "'ring', 'explain [pod]', 'traces'")
+                         "'ring', 'gang', 'explain [pod]', 'traces'")
     args = ap.parse_args(argv)
     cmd = args.target[0] if args.target else None
     try:
@@ -292,6 +341,11 @@ def main(argv: list[str] | None = None) -> int:
             snap = fetch_path(args.endpoint, "/inspect/ring")
             print(json.dumps(snap, indent=2) if args.json
                   else render_ring(snap))
+            return 0
+        if cmd == "gang":
+            snap = fetch_path(args.endpoint, "/inspect/gang")
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_gang(snap))
             return 0
         if cmd == "explain":
             path = "/inspect/explain"

@@ -128,6 +128,36 @@ class ExplainStore:
             }
         self._notify("filter_recorded", pod_key, 1, 1)
 
+    def record_gang(self, pod_key: str, pod: dict[str, Any] | None,
+                    trace_id: str | None, leader_trace_id: str | None,
+                    gang_id: str, size: int, rank: int,
+                    node: str) -> None:
+        """The pod is a gang member served off the leader's one-shot
+        slice solve (ABI v5): record its membership (which leader's
+        trace planned the gang, the gang id/size/rank, the planned
+        host) and a filter record whose single verdict carries
+        ``source: gang`` — followers are memo reads, and the audit
+        must never present them as individually computed."""
+        with self._lock:
+            rec = self._entry(pod_key, pod, trace_id)
+            rec["gang"] = {
+                "leader_trace_id": leader_trace_id,
+                "gang_id": gang_id,
+                "size": size,
+                "rank": rank,
+                "node": node,
+                "source": "gang",
+            }
+            rec["filter"] = {
+                "candidates": 1,
+                "ok": 1,
+                "nodes": {node: {"verdict": "ok", "source": "gang",
+                                 "leader_trace_id": leader_trace_id,
+                                 "gang_id": gang_id,
+                                 "gang_rank": rank}},
+            }
+        self._notify("filter_recorded", pod_key, 1, 1)
+
     def record_wire(self, pod_key: str, pod: dict[str, Any] | None,
                     trace_id: str | None, verb: str, *,
                     ok: int | None = None, candidates: int = 0,

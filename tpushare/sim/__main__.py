@@ -133,6 +133,15 @@ def _build_parser() -> argparse.ArgumentParser:
                          "determinism; tpushare/sim/procs.py). Honors "
                          "--engine. Exits nonzero on scorecard "
                          "divergence")
+    sg.add_argument("--gangs", action="store_true",
+                    help="gang-solve A/B mode: a gang-heavy trace "
+                         "(cross-host 2x4/4x2 exclusive gangs + "
+                         "sharing-tenant background, "
+                         "sim/traces.synth_gangs) replayed through "
+                         "BOTH gang kernels on one v5e-16 — the ABI v5 "
+                         "one-shot solve and the sequential Python "
+                         "spec; emits one standard scorecard per "
+                         "engine (identical by the parity contract)")
     sg.add_argument("--slice", action="store_true",
                     help="multi-host slice (gang) mode: one v5e-16 "
                          "(2x2 hosts of 2x2 chips), mixed single-chip "
@@ -230,6 +239,30 @@ def _run(ap, args, emit) -> int:
                                     chips=args.chips, hbm=args.hbm,
                                     mesh=mesh):
             emit(report)
+        return 0
+
+    if args.gangs:
+        # gang mode replays ONE gang-heavy trace through both gang
+        # kernels on the fixed v5e-16; flags that would silently not
+        # apply are rejected rather than ignored
+        for flag, default in (("nodes", 8), ("chips", 4), ("hbm", 16384),
+                              ("mesh", None), ("policy", "all"),
+                              ("preempt", "off"), ("engine", "python"),
+                              ("high_priority_fraction", 0.0),
+                              ("slice", False)):
+            if getattr(args, flag) != default:
+                ap.error(f"--{flag.replace('_', '-')} does not apply to "
+                         "--gangs mode (fixed v5e-16 geometry, "
+                         "oneshot-vs-sequential duel)")
+        from tpushare.sim.simulator import run_slice_sim
+        from tpushare.sim.traces import GangSpec, synth_gangs
+        gtrace = synth_gangs(GangSpec(
+            n_pods=args.pods, seed=args.seed,
+            gang_fraction=max(args.multi_chip_fraction, 0.5),
+            arrival_rate=args.arrival_rate,
+            mean_duration=args.mean_duration))
+        for eng in ("sequential", "oneshot"):
+            emit(run_slice_sim(gtrace, "pack", engine=eng))
         return 0
 
     if args.slice:

@@ -666,7 +666,8 @@ def synth_slice_trace(n_pods: int = 120, seed: int = 0,
 
 
 def run_slice_sim(trace: list[SimPod], singles_policy: str = "pack",
-                  host_grid=(2, 2), host_box=(2, 2)) -> dict:
+                  host_grid=(2, 2), host_box=(2, 2),
+                  engine: str = "sequential") -> dict:
     """Discrete-event sim over ONE slice (v5e-16 default: 2x2 hosts of
     2x2 chips) through the gang kernel (core/slice.select_gang).
 
@@ -678,22 +679,46 @@ def run_slice_sim(trace: list[SimPod], singles_policy: str = "pack",
     - ``"spread"`` — least-allocated with host-rotating ties (what the
                      default scheduler's scoring does to a slice).
 
-    Gangs always go through :func:`select_gang`; what differs is how
-    much contiguous room the singles policy left. Returns admission and
-    utilization stats. Reference ceiling for context: its allocator is
-    single-node, so every cross-host gang (2x4 here) is unplaceable by
-    construction — this sim quantifies what slice-awareness buys BEYOND
-    that structural gap.
+    Gangs go through the gang kernel picked by ``engine``:
+    ``"sequential"`` runs :func:`select_gang` (the Python behavioral
+    spec); ``"oneshot"`` runs the ABI v5 one-shot native solve
+    (:func:`tpushare.core.native.solve_gang`) and falls back to the
+    sequential kernel when the native engine is unavailable — by the
+    parity contract the scorecard is IDENTICAL either way, which the
+    ``--gangs`` leg demonstrates by emitting both. Returns admission
+    and utilization stats. Reference ceiling for context: its allocator
+    is single-node, so every cross-host gang (2x4 here) is unplaceable
+    by construction — this sim quantifies what slice-awareness buys
+    BEYOND that structural gap.
     """
     from tpushare.core.slice import SliceTopology, select_gang
 
     assert singles_policy in ("pack", "spread")
+    assert engine in ("sequential", "oneshot")
     n_hosts = 1
     for d in host_grid:
         n_hosts *= d
     names = [f"host{i}" for i in range(n_hosts)]
     st = SliceTopology.from_host_grid(tuple(host_grid), tuple(host_box),
                                       names)
+    solves = {"oneshot": 0, "sequential": 0}
+    if engine == "oneshot":
+        from tpushare.core import native
+        from tpushare.core.topology import HostMesh
+        hmesh = HostMesh(grid=tuple(host_grid), hbox=tuple(host_box),
+                         hosts=tuple(names))
+
+        def solve(views_, req):
+            gp = native.solve_gang(st, hmesh, views_, req)
+            if gp == "fallback":
+                solves["sequential"] += 1
+                return select_gang(st, views_, req)
+            solves["oneshot"] += 1
+            return gp
+    else:
+        def solve(views_, req):
+            solves["sequential"] += 1
+            return select_gang(st, views_, req)
     local = MeshTopology(tuple(host_box))
     hbm = 16384
     used: dict[str, list[int]] = {h: [0] * local.num_chips
@@ -728,7 +753,7 @@ def run_slice_sim(trace: list[SimPod], singles_policy: str = "pack",
             req = PlacementRequest(hbm_mib=pod.hbm_mib,
                                    chip_count=pod.chip_count,
                                    topology=pod.topology)
-            gp = select_gang(st, views(), req)
+            gp = solve(views(), req)
             if gp is None:
                 return False
             demand = req.chip_demand_mib(hbm)  # full chip iff exclusive
@@ -780,6 +805,8 @@ def run_slice_sim(trace: list[SimPod], singles_policy: str = "pack",
     span = max(last_t - busy_start, 1e-9)
     return {
         "singles_policy": singles_policy,
+        "gang_engine": engine,
+        "gang_solves": dict(solves),
         "pods": len(trace),
         "placed": placed,
         "never_placed": len(pending),

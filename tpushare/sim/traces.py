@@ -173,6 +173,58 @@ def synth_diurnal(spec: DiurnalSpec) -> list[SimPod]:
     return list(iter_diurnal(spec))
 
 
+@dataclass(frozen=True)
+class GangSpec:
+    """Knobs of a gang-heavy slice workload (the ``--gangs`` leg):
+    cross-host exclusive gangs (shapes that CANNOT fit one host box, so
+    they exist only under slice-aware placement) over a single-chip
+    sharing-tenant background. Pure function of the seed, like every
+    other trace generator here."""
+
+    n_pods: int = 200
+    seed: int = 0
+    gang_fraction: float = 0.5
+    # default shapes target a v5e-16 (2x2 hosts of 2x2 chips): 2x4 and
+    # 4x2 each span two hosts in one axis; 2x2 fits one host and keeps
+    # the solver honest about NOT crossing hosts when it needn't
+    shapes: tuple[tuple[int, ...], ...] = ((2, 4), (4, 2), (2, 2))
+    arrival_rate: float = 1.0
+    mean_duration: float = 30.0
+    single_hbm: tuple[int, ...] = (4096, 8192)
+
+    def __post_init__(self) -> None:
+        if self.n_pods <= 0 or not (0.0 <= self.gang_fraction <= 1.0) \
+                or self.arrival_rate <= 0 or self.mean_duration <= 0:
+            raise ValueError("bad gang spec")
+        if not self.shapes:
+            raise ValueError("gang spec needs at least one shape")
+
+
+def synth_gangs(spec: GangSpec) -> list[SimPod]:
+    """Materialize the gang-heavy trace: Poisson arrivals, expovariate
+    holds, gang shapes drawn uniformly from ``spec.shapes`` (exclusive:
+    hbm_mib=0 means whole-chip demand), singles from
+    ``spec.single_hbm``."""
+    rng = random.Random(spec.seed)
+    t = 0.0
+    out: list[SimPod] = []
+    for _ in range(spec.n_pods):
+        t += rng.expovariate(spec.arrival_rate)
+        dur = rng.expovariate(1.0 / spec.mean_duration)
+        if rng.random() < spec.gang_fraction:
+            shape = rng.choice(spec.shapes)
+            n = 1
+            for d in shape:
+                n *= d
+            out.append(SimPod(arrival=t, duration=dur, hbm_mib=0,
+                              chip_count=n, topology=tuple(shape)))
+        else:
+            out.append(SimPod(arrival=t, duration=dur,
+                              hbm_mib=rng.choice(spec.single_hbm),
+                              chip_count=1))
+    return out
+
+
 def synth_fleet(n_nodes: int, chips: int = 4, hbm: int = 16384,
                 mesh: tuple[int, ...] | None = (2, 2)) -> Fleet:
     """Fleet synthesis to wind-tunnel scale. Thin veneer over
