@@ -262,3 +262,39 @@ def test_cli_gang_subcommand(capsys):
         assert snap["catalog"][0]["slice"] == "slc0"
     finally:
         server.stop()
+
+
+def test_cli_wire_subcommand(live, capsys):
+    """ISSUE 16: `tpushare-inspect wire` renders digest-table occupancy
+    and the native hit rate from /inspect/wire."""
+    import http.client
+    import json as jsonlib
+
+    # storm one filter twice over a keep-alive connection so the digest
+    # cache, the response cache, and (where the engine built) the native
+    # table all have something to show
+    host, port = live.removeprefix("http://").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    body = jsonlib.dumps({"Pod": make_pod(hbm=1000, name="wcli"),
+                          "NodeNames": ["n1", "n2"]}).encode()
+    for _ in range(3):
+        conn.request("POST", "/tpushare-scheduler/filter", body,
+                     {"Content-Type": "application/json"})
+        assert conn.getresponse().read()
+    conn.close()
+
+    assert main(["--endpoint", live, "wire"]) == 0
+    out = capsys.readouterr().out
+    assert "wirecache: enabled" in out
+    assert "digests" in out and "stale serves" in out
+    assert "native table:" in out
+    assert "serve outcomes: " in out or "DISABLED" in out
+
+    assert main(["--endpoint", live, "--json", "wire"]) == 0
+    snap = jsonlib.loads(capsys.readouterr().out)
+    assert "wirecache" in snap and "native" in snap
+    assert snap["wirecache"]["digests"] >= 1
+    from tpushare.core.native import engine as native_engine
+    if native_engine.wire_probe_supported():
+        assert snap["native"]["enabled"] is True
+        assert snap["native"]["probes"] >= 1

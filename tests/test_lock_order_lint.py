@@ -62,6 +62,16 @@ RANKS = {
     # family: guards only the digest->entry OrderedDict bookkeeping and
     # is NEVER held across a parse, a solve, or any cache/node call —
     # decode copies the entry reference out and releases before work)
+    # zero-Python steady state (ISSUE 16): the native wire table's
+    # bookkeeping lock — guards table lifecycle (create/destroy/clear/
+    # stats) and the install call only, and is NEVER held across a
+    # probe (test_native_table_lock_never_held_across_a_probe enforces
+    # that half: the selector loop probes lock-free against the C
+    # table's own mutex). One above the wirecache's rank 6 because the
+    # only legal chain is _finish -> install: the wirecache lock is
+    # released first today, but a future finish-under-lock install must
+    # stay 6 -> 7 and the reverse must red-line.
+    ("nativewire.py", "self._lock"): 7,
     ("cache.py", "self._stripes.for_key"): 10,   # node-map stripes
     ("index.py", "self._flush_lock"): 15,   # whole-flush serialization
     ("nodeinfo.py", "self._lock"): 20,      # per-node chip state
@@ -234,6 +244,86 @@ def test_state_lock_never_held_across_a_solve():
 
     walk(tree.body, False)
     assert not problems, "\n".join(problems)
+
+
+def test_native_table_lock_never_held_across_a_probe():
+    """The native wire table's bookkeeping lock (nativewire.py
+    self._lock, rank 7) is documented as NEVER held across a probe —
+    the probe is the serve path's single GIL-released call, and a
+    worker-side install holding bookkeeping state across it would stall
+    every connection behind one sync. AST check: no call whose name
+    smells like a probe appears inside a ``with self._lock:`` block in
+    nativewire.py."""
+    path = os.path.join(ROOT, "tpushare", "extender", "nativewire.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    banned = re.compile(r"probe")
+    problems: list[str] = []
+
+    def scan_calls(body):
+        for n in body:
+            for sub in ast.walk(n) if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)) else []:
+                if isinstance(sub, ast.Call):
+                    src = ast.unparse(sub.func)
+                    if banned.search(src):
+                        problems.append(
+                            f"nativewire.py:{sub.lineno}: '{src}(...)' "
+                            "called under self._lock — the table lock "
+                            "must never be held across a probe")
+
+    def walk(body, held):
+        for n in body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(n.body, False)
+                continue
+            if isinstance(n, ast.With):
+                holds = held or any(
+                    _with_expr_key(i.context_expr) == "self._lock"
+                    for i in n.items)
+                if holds:
+                    scan_calls(n.body)
+                walk(n.body, holds)
+                continue
+            for cb in (getattr(n, "body", None),
+                       getattr(n, "orelse", None),
+                       getattr(n, "finalbody", None)):
+                if isinstance(cb, list):
+                    walk(cb, held)
+            for h in getattr(n, "handlers", []) or []:
+                walk(h.body, held)
+
+    walk(tree.body, False)
+    assert not problems, "\n".join(problems)
+
+
+def test_reuseport_listener_setup_is_lock_free():
+    """SO_REUSEPORT replica startup (httpserver.start) must take no
+    locks: N replicas bind the shared port concurrently, and a lock in
+    the bind path would only ever be process-local — it could not order
+    anything across replicas, so its presence would be a bug waiting to
+    look like a fix. The accept path owns its sockets single-threaded;
+    the server's one lock (_done_lock, rank 91) belongs to the
+    worker->loop handoff exclusively."""
+    path = os.path.join(ROOT, "tpushare", "extender", "httpserver.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    offenders: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in ("start", "_accept"):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    src = ast.unparse(item.context_expr)
+                    if _LOCKISH.search(src):
+                        offenders.append(
+                            f"httpserver.py:{sub.lineno}: 'with {src}:'"
+                            f" inside {node.name}() — listener setup "
+                            "and accept must stay lock-free")
+    assert not offenders, "\n".join(offenders)
 
 
 def test_lint_actually_detects_an_inversion():

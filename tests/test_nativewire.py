@@ -1,0 +1,269 @@
+"""Zero-Python wire fast path (ISSUE 16): the native probe table.
+
+The native table is a CACHE OF THE PYTHON PATH keyed by exact request
+bytes and the cache mutation stamp. These tests pin the two safety
+properties that make it deployable:
+
+- **stamp seam** — any cache mutation between table sync and probe
+  demotes that digest to the Python path (rc 0, zero bytes consumed);
+  a hit is only ever the bytes the Python path would serve RIGHT NOW.
+- **verify honesty** — with ``TPUSHARE_WIRE_VERIFY`` semantics on, a
+  corrupted resident fragment is caught by the recompute-and-compare
+  seam: the client gets the truth and the stale-serve counter moves.
+
+Skipped wholesale when the shared object cannot be built (no g++) or
+the wire entry points are absent (stale ``.so`` → graceful degrade).
+"""
+
+import hashlib
+import http.client
+import json
+import random
+import socket
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare.cache import SchedulerCache
+from tpushare.core.native import engine as native_engine
+from tpushare.extender.nativewire import (
+    PROBE_BYPASS,
+    PROBE_HIT,
+    PROBE_INCOMPLETE,
+    PROBE_MISS,
+    NativeWireTable,
+)
+from tpushare.extender.server import ExtenderServer
+from tpushare.extender.wirecache import WIRE_STALE_SERVES, _find_span
+from tpushare.k8s import FakeCluster
+
+pytestmark = pytest.mark.skipif(
+    not native_engine.wire_probe_supported(),
+    reason="native wire probe unavailable")
+
+FILTER_PATH = "/tpushare-scheduler/filter"
+PRIORITIZE_PATH = "/tpushare-scheduler/prioritize"
+
+
+def http_bytes(path: str, body: bytes) -> bytes:
+    """The exact frame a keep-alive kube-scheduler connection carries."""
+    return (f"POST {path} HTTP/1.1\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+
+
+def resp_body(resp: bytes) -> bytes:
+    return resp.partition(b"\r\n\r\n")[2]
+
+
+@pytest.fixture
+def rig():
+    fc = FakeCluster()
+    for i in range(6):
+        fc.add_tpu_node(f"n{i}", chips=4, hbm_per_chip_mib=16000,
+                        mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    srv = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    assert srv.nativewire.enabled
+    yield fc, cache, srv
+    srv.nativewire.close()
+
+
+def serve_py(srv, path: str, body: bytes) -> bytes:
+    status, payload, _ = srv.handle_post(path, body)
+    assert status == 200
+    return payload
+
+
+def prime(srv, path: str, body: bytes) -> bytes:
+    """Serve through the Python path until the stamp settles: the first
+    serve installs, but its own memo stash moves the stamp, so the
+    SECOND serve re-installs under the now-stable stamp."""
+    serve_py(srv, path, body)
+    return serve_py(srv, path, body)
+
+
+def test_probe_hit_is_byte_identical_to_python(rig):
+    fc, cache, srv = rig
+    names = [f"n{i}" for i in range(6)]
+    for path in (FILTER_PATH, PRIORITIZE_PATH):
+        body = json.dumps({"Pod": make_pod(hbm=2048),
+                           "NodeNames": names}).encode()
+        truth = prime(srv, path, body)
+        raw = http_bytes(path, body)
+        rc, resp, consumed = srv.nativewire.probe_request(bytearray(raw))
+        assert rc == PROBE_HIT, path
+        assert consumed == len(raw)
+        assert resp_body(resp) == truth
+        assert resp.startswith(b"HTTP/1.1 200 ")
+        # a pipelined second copy: only the first frame is consumed
+        rc2, _resp2, consumed2 = srv.nativewire.probe_request(
+            bytearray(raw + raw))
+        assert rc2 == PROBE_HIT
+        assert consumed2 == len(raw)
+
+
+def test_any_mutation_between_sync_and_probe_demotes(rig):
+    """Property: over randomized mutate/probe interleavings, a moved
+    stamp ALWAYS demotes the digest to the Python path, and a hit is
+    ALWAYS byte-equal to what the Python path serves at that instant."""
+    fc, cache, srv = rig
+    rng = random.Random(1234)
+    names = [f"n{i}" for i in range(6)]
+    body = json.dumps({"Pod": make_pod(hbm=512),
+                       "NodeNames": names}).encode()
+    raw = http_bytes(FILTER_PATH, body)
+    demoted = 0
+    for trial in range(40):
+        truth = prime(srv, FILTER_PATH, body)
+        if rng.random() < 0.5:
+            node = f"n{rng.randrange(6)}"
+            cache.get_node_info(node).allocate(
+                fc.create_pod(make_pod(hbm=64, name=f"mut-{trial}")), fc)
+            rc, resp, consumed = srv.nativewire.probe_request(
+                bytearray(raw))
+            # the mutation moved the stamp: even if the verdict bytes
+            # would not change, the probe must fall back — never a
+            # maybe-stale serve
+            assert rc == PROBE_MISS, trial
+            assert resp is None and consumed == 0
+            demoted += 1
+            # the Python path re-arms the table; the next probe serves
+            # the POST-mutation truth
+            truth = prime(srv, FILTER_PATH, body)
+        rc, resp, consumed = srv.nativewire.probe_request(bytearray(raw))
+        assert rc == PROBE_HIT, trial
+        assert resp_body(resp) == truth, trial
+    assert demoted >= 10  # the rng actually exercised the seam
+
+
+def test_poisoned_fragment_is_caught_by_verify(rig):
+    """TPUSHARE_WIRE_VERIFY semantics end-to-end over a real socket: a
+    corrupted resident entry must never reach a client — the recompute
+    seam serves the truth and counts one stale serve."""
+    fc, cache, srv = rig
+    port = srv.start()
+    try:
+        srv.nativewire.verify = True
+        names = [f"n{i}" for i in range(6)]
+        body = json.dumps({"Pod": make_pod(hbm=1024),
+                           "NodeNames": names}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+
+        def post() -> tuple[int, bytes]:
+            conn.request("POST", FILTER_PATH, body,
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, r.read()
+
+        post()
+        _, truth = post()  # stamp settled: table armed
+        s, e = _find_span(body)
+        span_d = hashlib.blake2b(body[s:e], digest_size=16).digest()
+        h = hashlib.blake2b(body[:s], digest_size=16)
+        h.update(body[e:])
+        poison = b'{"Error": "poisoned fragment"}'
+        srv.nativewire.install(span_d, h.digest(), "filter",
+                               cache.mutation_stamp(), poison)
+        assert srv.nativewire.stats()["installs"] >= 2  # poison resident
+        stale0 = WIRE_STALE_SERVES.value
+        status, served = post()
+        conn.close()
+        assert status == 200
+        assert served == truth  # the client saw the truth, not poison
+        assert b"poisoned" not in served
+        assert WIRE_STALE_SERVES.value == stale0 + 1
+    finally:
+        srv.stop()
+
+
+def test_kill_switch_env_disables(monkeypatch):
+    monkeypatch.setenv("TPUSHARE_NO_NATIVE_WIRE", "1")
+    assert not native_engine.wire_probe_supported()
+    t = NativeWireTable(lambda: 0)
+    assert not t.enabled
+    assert t.stats()["enabled"] is False
+    t.close()
+
+
+def test_probe_protocol_edges():
+    """Framing verdicts on a bare table: ineligible or incomplete input
+    never consumes bytes and never fabricates a response."""
+    t = NativeWireTable(lambda: 7)
+    try:
+        # partial head: wait for more bytes
+        rc, resp, consumed = t.probe_request(bytearray(b"POST /tpush"))
+        assert (rc, resp, consumed) == (PROBE_INCOMPLETE, None, 0)
+        # non-POST and non-fast-path routes: hand to the Python stack
+        for frame in (b"GET /metrics HTTP/1.1\r\n\r\n",
+                      b"POST /tpushare-scheduler/bind HTTP/1.1\r\n"
+                      b"Content-Length: 2\r\n\r\n{}"):
+            rc, resp, consumed = t.probe_request(bytearray(frame))
+            assert (rc, resp, consumed) == (PROBE_BYPASS, None, 0)
+        body = b'{"Pod": {}, "NodeNames": ["a"]}'
+        # Connection: close wants a one-shot response envelope the
+        # resident fragment does not carry — bypass
+        framed = (b"POST /tpushare-scheduler/filter HTTP/1.1\r\n"
+                  b"Connection: close\r\n"
+                  + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                  + body)
+        rc, resp, consumed = t.probe_request(bytearray(framed))
+        assert (rc, resp, consumed) == (PROBE_BYPASS, None, 0)
+        # a well-framed filter nobody installed: plain miss
+        raw = http_bytes(FILTER_PATH, body)
+        rc, resp, consumed = t.probe_request(bytearray(raw))
+        assert (rc, resp, consumed) == (PROBE_MISS, None, 0)
+        # truncated body: wait, don't guess
+        rc, resp, consumed = t.probe_request(bytearray(raw[:-4]))
+        assert (rc, resp, consumed) == (PROBE_INCOMPLETE, None, 0)
+    finally:
+        t.close()
+
+
+@pytest.mark.skipif(not hasattr(socket, "SO_REUSEPORT"),
+                    reason="platform lacks SO_REUSEPORT")
+def test_reuseport_two_listeners_share_one_port(monkeypatch):
+    """Two full extender servers bind the SAME port under
+    TPUSHARE_REUSEPORT=1 and both actually receive connections (the
+    kernel balances per-connection across listeners)."""
+    monkeypatch.setenv("TPUSHARE_REUSEPORT", "1")
+
+    def build():
+        fc = FakeCluster()
+        for i in range(4):
+            fc.add_tpu_node(f"r{i}", chips=4, hbm_per_chip_mib=16000,
+                            mesh="2x2")
+        cache = SchedulerCache(fc)
+        cache.build_cache()
+        return ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+
+    srv1 = build()
+    port = srv1.start()
+    srv2 = build()
+    srv2.port = port
+    try:
+        assert srv2.start() == port
+        assert srv1._httpd.reuseport_active
+        assert srv2._httpd.reuseport_active
+        body = json.dumps({"Pod": make_pod(hbm=256),
+                           "NodeNames": [f"r{i}" for i in range(4)]
+                           }).encode()
+        answers = set()
+        for _ in range(40):
+            # fresh connection each time: a fresh 4-tuple re-rolls the
+            # kernel's listener choice
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            c.request("POST", FILTER_PATH, body,
+                      {"Content-Type": "application/json"})
+            r = c.getresponse()
+            answers.add(r.read())
+            assert r.status == 200
+            c.close()
+        assert len(answers) == 1  # byte-identical verdicts across both
+        seen1 = srv1.nativewire.stats()["probes"]
+        seen2 = srv2.nativewire.stats()["probes"]
+        assert seen1 + seen2 == 40
+        assert seen1 > 0 and seen2 > 0  # p(all-one-listener) ~ 2^-39
+    finally:
+        srv1.stop()
+        srv2.stop()

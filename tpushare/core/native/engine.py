@@ -328,6 +328,65 @@ def gang_solve_supported() -> bool:
     return _gang_fn() is not None
 
 
+def _wire_lib():
+    """The loaded library with every ABI v6 wire-plane symbol typed, or
+    None when the wire fast path must stay on the Python selector +
+    wirecache route (no lib, stale pre-v6 .so, or the
+    TPUSHARE_NO_NATIVE_WIRE escape hatch). Both routes serve
+    byte-identical responses — the native table is delta-synced FROM the
+    Python path's encodes, never computed independently."""
+    if os.environ.get("TPUSHARE_NO_NATIVE_WIRE"):
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    fn = getattr(lib, "tpushare_wire_probe", None)
+    if fn is None:
+        return None
+    if not getattr(fn, "_tpushare_typed", False):
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.tpushare_wire_table_create.restype = ctypes.c_void_p
+        lib.tpushare_wire_table_create.argtypes = []
+        lib.tpushare_wire_table_destroy.restype = None
+        lib.tpushare_wire_table_destroy.argtypes = [ctypes.c_void_p]
+        lib.tpushare_wire_install.restype = ctypes.c_int
+        lib.tpushare_wire_install.argtypes = [
+            ctypes.c_void_p,   # table
+            ctypes.c_char_p,   # span digest (16)
+            ctypes.c_char_p,   # remainder digest (16)
+            ctypes.c_int32,    # verb (0 filter / 1 prioritize)
+            ctypes.c_int64,    # mutation stamp at compute time
+            ctypes.c_char_p,   # full pre-encoded HTTP response
+            ctypes.c_int64,    # response length
+        ]
+        lib.tpushare_wire_clear.restype = None
+        lib.tpushare_wire_clear.argtypes = [ctypes.c_void_p]
+        lib.tpushare_wire_stats.restype = None
+        lib.tpushare_wire_stats.argtypes = [ctypes.c_void_p, i64p]
+        lib.tpushare_wire_digest2.restype = None
+        lib.tpushare_wire_digest2.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p]
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_void_p,   # table
+            ctypes.c_char_p,   # raw request bytes (conn inbuf)
+            ctypes.c_int64,    # len
+            ctypes.c_int64,    # caller's CURRENT mutation stamp
+            ctypes.c_char_p,   # out response buffer
+            ctypes.c_int64,    # out capacity
+            i64p,              # out response length (or needed, on -3)
+            i64p,              # out consumed request bytes
+        ]
+        fn._tpushare_typed = True
+    return lib
+
+
+def wire_probe_supported() -> bool:
+    """True when digest-hit serves can run the ABI v6 native probe."""
+    return _wire_lib() is not None
+
+
 def describe() -> "dict":
     """Observability snapshot for /inspect and bench: availability, ABI,
     scan worker config, and the fallback/scan counters."""
@@ -336,6 +395,7 @@ def describe() -> "dict":
         "abi_version": abi_version(),
         "cycle_supported": cycle_supported(),
         "gang_solve_supported": gang_solve_supported(),
+        "wire_probe_supported": wire_probe_supported(),
         "scan_workers": _scan_workers(),
         "fleet_scans": {f"{call}/{engine}": v for (call, engine), v
                         in NATIVE_FLEET_SCANS.snapshot().items()},

@@ -68,11 +68,21 @@ def main(argv: list[str] | None = None) -> int:
                          "cmd/main.go:24-38)")
     ap.add_argument("--workers", type=int,
                     default=int(os.environ.get("THREADNESS", "1")))
+    ap.add_argument("--reuseport", action="store_true",
+                    default=os.environ.get("TPUSHARE_REUSEPORT", "") == "1",
+                    help="bind the listener with SO_REUSEPORT so N "
+                         "replica processes share ONE port with "
+                         "kernel-balanced accepts (requires an explicit "
+                         "--port; no-op where the platform lacks it)")
     ap.add_argument("--ha", action="store_true",
                     default=os.environ.get("ENABLE_HA", "") == "true",
                     help="run Lease-based leader election; only the leader "
                          "serves Bind (multi-replica deployments)")
     args = ap.parse_args(argv)
+    if args.reuseport:
+        # the httpserver front end reads the env knob at bind time; the
+        # flag is the operator-facing spelling of the same switch
+        os.environ["TPUSHARE_REUSEPORT"] = "1"
 
     # structured JSON logging with the active trace id in every line
     # (obs/logging.py; TPUSHARE_LOG_FORMAT=plain for the dev format)

@@ -1028,6 +1028,14 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
     registry.register(WIRE_CANDIDATES)
     registry.register(BIND_PIPELINE)
     registry.register(CONN_POOL_REQUESTS)
+    # native wire table (extender/nativewire.py, ABI v6): GIL-released
+    # serve outcomes + probe latency. A growing `fallback` series under
+    # a steady digest-hit load means the table is being invalidated
+    # faster than it resyncs — see docs/ops.md.
+    from tpushare.extender.nativewire import (
+        WIRE_NATIVE_PROBE_SECONDS, WIRE_NATIVE_SERVES)
+    registry.register(WIRE_NATIVE_SERVES)
+    registry.register(WIRE_NATIVE_PROBE_SECONDS)
     register_build_info(registry)
 
 

@@ -24,6 +24,27 @@ Lock discipline (tests/test_lock_order_lint.py): ``self._done_lock`` is
 the only lock — it guards the finished-response queue and the in-flight
 counter for a few instructions at a time and is NEVER held across a
 handler call, a socket operation, or a forward hop.
+
+Two steady-state fast paths ride the loop thread (ISSUE 16):
+
+- **native wire probe**: when a ``native_wire`` table is attached
+  (extender/nativewire.py), freshly read bytes are offered to one
+  GIL-released C call before the Python parser ever runs. A digest-hit
+  Filter/Prioritize request is answered by a memcpy of pre-encoded
+  response bytes — no header dict, no pool hop. Everything the probe
+  is not positive about falls through to the Python path unchanged.
+- **batched writes**: worker responses drained on one selector wake are
+  coalesced into the connection buffers first and flushed once per
+  connection (``TPUSHARE_WRITE_BATCH=0`` restores flush-per-response),
+  so a storm of small verdicts costs one ``send()`` per connection per
+  wake instead of one per response.
+
+``TPUSHARE_REUSEPORT=1`` binds the listener with ``SO_REUSEPORT`` where
+the platform has it: N independent server processes then share ONE
+port with kernel-balanced accepts (no port probing, no userspace
+proxy). Replicas must be verdict-equivalent — the kube-scheduler does
+not care which replica answers, which is exactly the sharded-replica
+deployment contract (docs/ops.md).
 """
 
 from __future__ import annotations
@@ -56,7 +77,7 @@ _MAX_BODY_BYTES = 64 * 1024 * 1024  # a 50k-node Nodes list is ~20 MiB
 
 class _Conn:
     __slots__ = ("sock", "inbuf", "outbuf", "busy", "close_after",
-                 "closed")
+                 "closed", "verify_expected")
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
@@ -65,6 +86,7 @@ class _Conn:
         self.busy = False         # a request is in flight in the pool
         self.close_after = False  # close once outbuf drains
         self.closed = False
+        self.verify_expected: bytes | None = None  # TPUSHARE_WIRE_VERIFY
 
 
 class SelectorHTTPServer:
@@ -76,11 +98,18 @@ class SelectorHTTPServer:
 
     def __init__(self, host: str, port: int,
                  handle_get: Callable, handle_post: Callable,
-                 max_workers: int | None = None) -> None:
+                 max_workers: int | None = None,
+                 native_wire=None) -> None:
         self.host, self.port = host, port
         self._handle_get = handle_get
         self._handle_post = handle_post
         self.max_workers = max_workers or http_workers()
+        # duck-typed NativeWireTable (extender/nativewire.py) — this
+        # module stays import-free of the wire plane
+        self._native = native_wire
+        self._write_batch = os.environ.get(
+            "TPUSHARE_WRITE_BATCH", "1") != "0"
+        self.reuseport_active = False
         self._sel = selectors.DefaultSelector()
         self._listener: socket.socket | None = None
         self._pool: ThreadPoolExecutor | None = None
@@ -111,6 +140,14 @@ class SelectorHTTPServer:
         """Bind, start the loop thread + pool; returns the bound port."""
         lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if os.environ.get("TPUSHARE_REUSEPORT", "") == "1" \
+                and hasattr(socket, "SO_REUSEPORT"):
+            # N replica processes share ONE listening port; the kernel
+            # balances accepts across them. Only meaningful with an
+            # explicit --port (with port 0 each replica gets its own
+            # ephemeral port and nothing is shared).
+            lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            self.reuseport_active = True
         lst.bind((self.host, self.port))
         lst.listen(256)
         lst.setblocking(False)
@@ -220,10 +257,33 @@ class SelectorHTTPServer:
             return
         if conn.closed:
             return
+        if not conn.busy and self._native is not None:
+            self._native_serve(conn)
         if not conn.busy:
             self._try_dispatch(conn)
         if conn.outbuf:
             self._flush(conn)
+
+    def _native_serve(self, conn: _Conn) -> None:
+        """Serve pipelined digest-hit requests GIL-released, coalescing
+        their responses into one outbuf (flushed once by _service). Any
+        non-hit leaves the buffer untouched for _try_dispatch — the
+        probe never consumes bytes it did not answer."""
+        nat = self._native
+        if not nat.enabled:
+            return
+        while conn.inbuf and conn.verify_expected is None:
+            rc, resp, consumed = nat.probe_request(conn.inbuf)
+            if rc != 1:  # PROBE_HIT
+                return
+            if nat.verify:
+                # don't serve: pin the native bytes and let the Python
+                # path recompute this request — _work compares the two
+                # (the TPUSHARE_WIRE_VERIFY stale tripwire)
+                conn.verify_expected = resp
+                return
+            del conn.inbuf[:consumed]
+            conn.outbuf += resp
 
     def _flush(self, conn: _Conn) -> None:
         try:
@@ -242,7 +302,14 @@ class SelectorHTTPServer:
             return
         self._interest(conn)
         if not conn.outbuf and not conn.busy:
-            self._try_dispatch(conn)  # a pipelined request may be buffered
+            # a pipelined request may be buffered; offer it to the
+            # native probe first, exactly like a fresh read
+            if self._native is not None:
+                self._native_serve(conn)
+            if not conn.busy:
+                self._try_dispatch(conn)
+            if conn.outbuf:
+                self._flush(conn)  # natively served bytes
 
     def _close(self, conn: _Conn) -> None:
         if conn.closed:
@@ -325,6 +392,11 @@ class SelectorHTTPServer:
             status, data, ctype = 500, b'{"error": "internal error"}', \
                 "application/json"
         resp = _response(status, data, ctype, close=conn.close_after)
+        expected = conn.verify_expected
+        if expected is not None:
+            conn.verify_expected = None
+            if self._native is not None:
+                self._native.check_verify(expected, resp)
         with self._done_lock:
             self._done.append((conn, resp))
             self._inflight -= 1
@@ -333,6 +405,22 @@ class SelectorHTTPServer:
     def _drain_done(self) -> None:
         with self._done_lock:
             done, self._done = self._done, []
+        if self._write_batch:
+            # coalesce: append every finished response first, then one
+            # flush per connection per wake — a verdict storm costs one
+            # send() per connection instead of one per response
+            for conn, resp in done:
+                if conn.closed:
+                    continue
+                conn.busy = False
+                conn.outbuf += resp
+            seen = set()
+            for conn, _ in done:
+                if conn.closed or id(conn) in seen:
+                    continue
+                seen.add(id(conn))
+                self._flush(conn)
+            return
         for conn, resp in done:
             if conn.closed:
                 continue

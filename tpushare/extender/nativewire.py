@@ -1,0 +1,209 @@
+"""Native wire-plane table: the ABI v6 zero-Python steady state.
+
+PR 14's wirecache made a digest-hit Filter serve skip JSON entirely, but
+every request still round-trips the Python selector loop under the GIL:
+parse the head, build a header dict, hop to a pool thread, look the
+response up, hop back. This module closes that last gap. The selector
+loop hands a connection's raw bytes to one GIL-released C call
+(placement.cpp tpushare_wire_probe) that frames the request, digests the
+NodeNames span and the body remainder, and copies back a pre-encoded
+response — the steady-state serve path is one native call.
+
+The table is a CACHE OF THE PYTHON PATH, never an independent encoder:
+
+- :meth:`install` is called by ``wirecache._finish`` after a fresh
+  encode, with the exact response body the Python path just served and
+  the mutation stamp it was computed under. The native entry's bytes are
+  therefore byte-identical to a Python serve by construction.
+- a probe carries the caller's CURRENT mutation stamp (read immediately
+  before the call, ``stamp_fn``); the C side serves only on stamp
+  equality. Any fleet mutation between sync and probe moves the stamp,
+  so the entry misses and the request falls back to the Python path —
+  never a stale serve (tests/test_nativewire.py proves the seam).
+- matching is by exact request bytes (span digest + remainder digest +
+  verb), deliberately NARROWER than the Python response cache's
+  signature-level match: the native side answers only what it has
+  literally seen before; anything novel is Python's problem.
+
+``TPUSHARE_NO_NATIVE_WIRE=1`` disables the whole path (engine-side knob,
+see engine._wire_lib); a stale pre-v6 ``.so`` degrades the same way.
+Under ``TPUSHARE_WIRE_VERIFY=1`` a native hit is NOT served directly:
+the expected bytes are pinned on the connection, the Python path
+recomputes, and a divergence counts into ``tpushare_wire_stale_serves``
+while the recomputed truth is what goes out (httpserver._work).
+
+Lock discipline (tests/test_lock_order_lint.py): ``self._lock`` (rank 7,
+one above the wirecache's rank-6 lock — installs arrive from
+``_finish`` AFTER it released the wirecache lock) guards table lifecycle
+and install bookkeeping for a few instructions at a time. It is NEVER
+held across a native probe: the probe runs lock-free on the selector
+loop thread against the C table's own internal mutex, so a worker-side
+install can never stall the serve path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import time
+
+from tpushare.metrics import Histogram, LabeledCounter
+
+WIRE_NATIVE_SERVES = LabeledCounter(
+    "tpushare_wire_native_serves_total",
+    "Digest-path serve outcomes at the native probe: native (served "
+    "GIL-released), fallback (eligible but cold/stamp-moved, Python "
+    "served), bypass (not a fast-path request)",
+    ("outcome",))
+WIRE_NATIVE_PROBE_SECONDS = Histogram(
+    "tpushare_wire_native_probe_seconds",
+    "Wall time of one tpushare_wire_probe call (frame + digest + table "
+    "lookup + response copy), any outcome",
+    buckets=(2e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 1e-3,
+             5e-3, 2.5e-2))
+
+# probe return protocol (placement.cpp tpushare_wire_probe)
+PROBE_HIT = 1
+PROBE_MISS = 0
+PROBE_ERROR = -1
+PROBE_INCOMPLETE = -2
+PROBE_GROW = -3
+PROBE_BYPASS = -4
+
+_VERBS = {"filter": 0, "prioritize": 1}
+
+_OUT_INITIAL = 256 * 1024  # grows on PROBE_GROW; 50k-name bodies ~2 MiB
+
+
+class NativeWireTable:
+    """One resident digest→response table per server process.
+
+    ``stamp_fn`` is ``SchedulerCache.mutation_stamp`` — the same clock
+    the wirecache response cache keys currency on.
+    """
+
+    def __init__(self, stamp_fn, *, wirecache_enabled: bool = True,
+                 verify: bool | None = None) -> None:
+        from tpushare.core.native import engine
+        self._stamp_fn = stamp_fn
+        self._lib = engine._wire_lib() if wirecache_enabled else None
+        self.enabled = self._lib is not None
+        if verify is None:
+            verify = os.environ.get("TPUSHARE_WIRE_VERIFY", "") == "1"
+        self.verify = verify
+        # lifecycle + install bookkeeping; NEVER held across a probe
+        self._lock = threading.Lock()
+        self._table = (self._lib.tpushare_wire_table_create()
+                       if self.enabled else None)
+        if self._table is None:
+            self.enabled = False
+        # probe scratch — selector-loop-thread only, grown on demand
+        self._out = ctypes.create_string_buffer(_OUT_INITIAL)
+        self._out_len = ctypes.c_int64(0)
+        self._consumed = ctypes.c_int64(0)
+
+    # -- worker side: delta-sync from the Python wirecache --------------------
+
+    def install(self, span_digest: bytes, rem_digest: bytes, verb: str,
+                stamp: int, body: bytes) -> None:
+        """Sync one freshly Python-encoded response into the table.
+
+        ``body`` is the exact payload ``wirecache._finish`` just stored;
+        the resident entry is the full HTTP response those bytes produce
+        on the keep-alive path, so a hit is a pure memcpy."""
+        vid = _VERBS.get(verb)
+        if vid is None or not self.enabled:
+            return
+        from tpushare.extender.httpserver import _response
+        resp = _response(200, body, "application/json")
+        with self._lock:
+            table = self._table
+            if table is None:
+                return
+            self._lib.tpushare_wire_install(
+                table, span_digest, rem_digest, vid, stamp, resp,
+                len(resp))
+
+    # -- loop side: the probe itself ------------------------------------------
+
+    def probe_request(self, inbuf: bytearray):
+        """One native probe over a connection's raw input buffer.
+
+        Returns ``(rc, response_bytes | None, consumed)``. Counts the
+        outcome (native/fallback/bypass) and times the call. Selector
+        loop thread ONLY (owns the scratch buffer)."""
+        table = self._table
+        if table is None or not inbuf:
+            return PROBE_BYPASS, None, 0
+        stamp = self._stamp_fn()
+        # zero-copy view of the bytearray; released when req goes away
+        req = (ctypes.c_char * len(inbuf)).from_buffer(inbuf)
+        t0 = time.perf_counter()
+        rc = self._lib.tpushare_wire_probe(
+            table, req, len(inbuf), stamp, self._out, len(self._out),
+            ctypes.byref(self._out_len), ctypes.byref(self._consumed))
+        if rc == PROBE_GROW:
+            self._out = ctypes.create_string_buffer(
+                int(self._out_len.value) + 4096)
+            rc = self._lib.tpushare_wire_probe(
+                table, req, len(inbuf), stamp, self._out, len(self._out),
+                ctypes.byref(self._out_len), ctypes.byref(self._consumed))
+        del req
+        WIRE_NATIVE_PROBE_SECONDS.observe(time.perf_counter() - t0)
+        if rc == PROBE_HIT:
+            WIRE_NATIVE_SERVES.inc("native")
+            return (PROBE_HIT, self._out.raw[:self._out_len.value],
+                    int(self._consumed.value))
+        if rc == PROBE_MISS:
+            WIRE_NATIVE_SERVES.inc("fallback")
+        elif rc in (PROBE_BYPASS, PROBE_ERROR):
+            WIRE_NATIVE_SERVES.inc("bypass")
+        return rc, None, 0
+
+    def check_verify(self, expected: bytes, actual: bytes) -> None:
+        """TPUSHARE_WIRE_VERIFY tripwire: the native hit's bytes vs the
+        Python path's recompute for the same request. A divergence is
+        the bug class this knob exists to catch — count it loudly; the
+        recomputed truth is what was served."""
+        if expected != actual:
+            from tpushare.extender.wirecache import WIRE_STALE_SERVES
+            WIRE_STALE_SERVES.inc()
+
+    # -- lifecycle + observability --------------------------------------------
+
+    def clear(self) -> None:
+        with self._lock:
+            if self._table is not None:
+                self._lib.tpushare_wire_clear(self._table)
+
+    def close(self) -> None:
+        """Destroy the C table. Only call after the serving loop has
+        stopped — probes read the handle lock-free."""
+        with self._lock:
+            table, self._table = self._table, None
+            self.enabled = False
+            if table is not None:
+                self._lib.tpushare_wire_table_destroy(table)
+
+    def stats(self) -> dict:
+        """Occupancy + outcome counters for /inspect/wire and bench."""
+        out = {"enabled": self.enabled, "verify": self.verify}
+        raw = (ctypes.c_int64 * 8)()
+        with self._lock:
+            if self._table is None:
+                return out
+            self._lib.tpushare_wire_stats(self._table, raw)
+        probes = int(raw[2])
+        out.update({
+            "entries": int(raw[0]),
+            "capacity": int(raw[1]),
+            "probes": probes,
+            "hits": int(raw[3]),
+            "misses": int(raw[4]),
+            "stamp_misses": int(raw[5]),
+            "installs": int(raw[6]),
+            "evictions": int(raw[7]),
+            "hit_rate": round(int(raw[3]) / probes, 4) if probes else None,
+        })
+        return out

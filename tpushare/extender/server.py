@@ -131,6 +131,18 @@ class ExtenderServer:
         # opts out; TPUSHARE_WIRE_VERIFY=1 recomputes every hit.
         from tpushare.extender.wirecache import WireCache
         self.wirecache = WireCache(cache)
+        # native wire table (extender/nativewire.py, ABI v6): the
+        # selector loop serves byte-identical repeats of digest-hit
+        # requests GIL-released; wirecache._finish delta-syncs fresh
+        # encodes into it under the same mutation-stamp protocol.
+        # Degrades to pure-Python serving on a pre-v6 .so or
+        # TPUSHARE_NO_NATIVE_WIRE=1.
+        from tpushare.extender.nativewire import NativeWireTable
+        self.nativewire = NativeWireTable(
+            cache.mutation_stamp,
+            wirecache_enabled=self.wirecache.enabled,
+            verify=self.wirecache.verify)
+        self.wirecache.native = self.nativewire
         self.filter_handler = FilterHandler(cache, self.registry,
                                             gang=self.gang, breaker=breaker,
                                             staleness_fn=staleness_fn,
@@ -317,6 +329,8 @@ class ExtenderServer:
             return _enc(200, self.defrag.snapshot())
         if path in ("/inspect/gang", f"{PREFIX}/inspect/gang"):
             return _enc(200, self.gang.snapshot())
+        if path in ("/inspect/wire", f"{PREFIX}/inspect/wire"):
+            return _enc(200, self.wire_snapshot())
         if path in ("/inspect/ring", f"{PREFIX}/inspect/ring"):
             if self._sharding is not None:
                 return _enc(200, self._sharding.snapshot())
@@ -466,7 +480,8 @@ class ExtenderServer:
             self._httpd = SelectorHTTPServer(
                 self.host, self.port,
                 handle_get=self.handle_get, handle_post=self.handle_post,
-                max_workers=http_workers)
+                max_workers=http_workers,
+                native_wire=self.nativewire)
             self.port = self._httpd.start()
             httpd = self._httpd
             self.registry.gauge_func(
@@ -495,8 +510,38 @@ class ExtenderServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # after the loop thread is down: probes read the handle lock-free
+        self.nativewire.close()
         if self._serve_done is not None:
             self._serve_done.set()
+
+    def wire_snapshot(self) -> dict:
+        """GET /inspect/wire: the whole wire plane in one read — Python
+        digest/response-cache occupancy plus the native table's
+        occupancy, hit rate and serve outcomes (tpushare-inspect wire)."""
+        from tpushare.extender.nativewire import WIRE_NATIVE_SERVES
+        from tpushare.extender.wirecache import (
+            WIRE_DIGEST, WIRE_RESPONSES, WIRE_STALE_SERVES)
+        wc = self.wirecache
+        digests, responses = wc.occupancy()
+        return {
+            "wirecache": {
+                "enabled": wc.enabled,
+                "verify": wc.verify,
+                "digests": digests,
+                "max_digests": wc.MAX_DIGESTS,
+                "responses": responses,
+                "digest_outcomes": {k[0]: v for k, v
+                                    in WIRE_DIGEST.snapshot().items()},
+                "response_outcomes": {
+                    f"{verb}/{outcome}": v for (verb, outcome), v
+                    in WIRE_RESPONSES.snapshot().items()},
+                "stale_serves": WIRE_STALE_SERVES.value,
+            },
+            "native": self.nativewire.stats(),
+            "native_outcomes": {k[0]: v for k, v
+                                in WIRE_NATIVE_SERVES.snapshot().items()},
+        }
 
 
 def _thread_dump() -> str:

@@ -136,13 +136,21 @@ class _Entry:
 class _Ctx:
     """Per-request decode context: the digest entry plus the mutation
     stamps read at lookup time, BEFORE the handler computed — a store
-    under a pre-compute stamp can only ever be too conservative."""
+    under a pre-compute stamp can only ever be too conservative.
 
-    __slots__ = ("entry", "stamps")
+    When a native wire table is attached, ``span_digest``/``rem_digest``
+    carry the request's exact-byte identity (the NodeNames span and the
+    body remainder, each BLAKE2b-128): ``_finish`` syncs the freshly
+    encoded response into the native table under those keys, so the
+    NEXT byte-identical request can be served GIL-released."""
+
+    __slots__ = ("entry", "stamps", "span_digest", "rem_digest")
 
     def __init__(self, entry: _Entry) -> None:
         self.entry = entry
         self.stamps: dict[tuple, int] = {}
+        self.span_digest: bytes | None = None
+        self.rem_digest: bytes | None = None
 
 
 class WireCache:
@@ -162,6 +170,9 @@ class WireCache:
         self._entries: OrderedDict[bytes, _Entry] = OrderedDict()
         self._frags: dict[str, bytes] = {}
         self._lock = threading.Lock()
+        # optional NativeWireTable (extender/nativewire.py), attached by
+        # the server; _finish delta-syncs fresh encodes into it
+        self.native = None
 
     # -- decode ----------------------------------------------------------
 
@@ -230,7 +241,26 @@ class WireCache:
             WIRE_DIGEST.inc("hit")
         WIRE_CANDIDATES.observe(len(entry.names))
         args["NodeNames"] = entry.names  # shared: handlers never mutate it
-        return args, _Ctx(entry)
+        ctx = _Ctx(entry)
+        native = self.native
+        if native is not None and native.enabled:
+            # exact-byte identity for the native table: span digest plus
+            # a streamed digest of everything around the span. Identical
+            # (span, remainder) digests mean the identical request body,
+            # so the synced response answers it verbatim.
+            ctx.span_digest = digest
+            h = hashlib.blake2b(raw[:s], digest_size=16)
+            h.update(raw[e:])
+            ctx.rem_digest = h.digest()
+        return args, ctx
+
+    def occupancy(self) -> tuple[int, int]:
+        """(digest entries, cached responses) — /inspect/wire reads
+        the bookkeeping under the rank-6 lock like every other access."""
+        with self._lock:
+            return (len(self._entries),
+                    sum(len(e.responses)
+                        for e in self._entries.values()))
 
     # -- response cache --------------------------------------------------
 
@@ -289,6 +319,13 @@ class WireCache:
                     if len(resp) >= self.MAX_RESPONSES and key not in resp:
                         resp.clear()
                     resp[key] = (stamp, enc)
+                # delta-sync the native table AFTER releasing self._lock
+                # (rank 6): install takes the nativewire bookkeeping
+                # lock (rank 7), never the reverse
+                native = self.native
+                if native is not None and ctx.rem_digest is not None:
+                    native.install(ctx.span_digest, ctx.rem_digest,
+                                   verb, stamp, enc.body)
         return enc
 
     # -- fragment encoders (byte-identical to json.dumps defaults) ------

@@ -7,6 +7,7 @@ the operator subcommands over the extender's diagnostic endpoints:
     tpushare-inspect defrag            # /inspect/defrag rebalancer state
     tpushare-inspect ring              # /inspect/ring shard membership
     tpushare-inspect gang              # /inspect/gang planner snapshot
+    tpushare-inspect wire              # /inspect/wire serve-path caches
     tpushare-inspect explain [<pod>]   # /inspect/explain decision audit
     tpushare-inspect traces [-n N]     # /debug/traces flight recorder
 
@@ -289,6 +290,50 @@ def render_gang(snap: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_wire(snap: dict[str, Any]) -> str:
+    """Terminal rendering of the /inspect/wire serve-path snapshot:
+    Python digest/response-cache occupancy, native table occupancy and
+    hit rate, and the native/fallback/bypass serve split an operator
+    alerts on (docs/ops.md: growing ``fallback`` means the steady state
+    stopped being steady)."""
+    lines: list[str] = []
+    wc = snap.get("wirecache") or {}
+    lines.append(
+        f"wirecache: {'enabled' if wc.get('enabled') else 'DISABLED'}"
+        + (", verify mode" if wc.get("verify") else "")
+        + f", {wc.get('digests', 0)}/{wc.get('max_digests', 0)} digests, "
+        f"{wc.get('responses', 0)} cached responses, "
+        f"{int(wc.get('stale_serves', 0))} stale serves")
+    dig = wc.get("digest_outcomes") or {}
+    if dig:
+        lines.append("  digest outcomes: " + ", ".join(
+            f"{k}={int(v)}" for k, v in sorted(dig.items())))
+    resp = wc.get("response_outcomes") or {}
+    if resp:
+        lines.append("  response outcomes: " + ", ".join(
+            f"{k}={int(v)}" for k, v in sorted(resp.items())))
+    nat = snap.get("native") or {}
+    if not nat.get("enabled"):
+        lines.append("native table: DISABLED (no ABI v6 engine, or "
+                     "TPUSHARE_NO_NATIVE_WIRE=1)")
+        return "\n".join(lines)
+    hit_rate = nat.get("hit_rate")
+    lines.append(
+        f"native table: {nat.get('entries', 0)}/{nat.get('capacity', 0)} "
+        f"entries, {nat.get('probes', 0)} probes, hit rate "
+        + (f"{100.0 * hit_rate:.1f}%" if hit_rate is not None else "-")
+        + (", verify mode" if nat.get("verify") else ""))
+    lines.append(
+        f"  hits {nat.get('hits', 0)}, misses {nat.get('misses', 0)} "
+        f"(stamp-moved {nat.get('stamp_misses', 0)}), installs "
+        f"{nat.get('installs', 0)}, evictions {nat.get('evictions', 0)}")
+    outcomes = snap.get("native_outcomes") or {}
+    lines.append("serve outcomes: " + (", ".join(
+        f"{k}={int(v)}" for k, v in sorted(outcomes.items()))
+        or "none"))
+    return "\n".join(lines)
+
+
 def render_traces(dump: dict[str, Any], limit: int | None = None) -> str:
     """Terminal rendering of the /debug/traces flight recorder."""
     lines: list[str] = []
@@ -323,7 +368,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="traces: show at most N traces")
     ap.add_argument("target", nargs="*", default=[],
                     help="node name, or a subcommand: 'fleet', 'defrag', "
-                         "'ring', 'gang', 'explain [pod]', 'traces'")
+                         "'ring', 'gang', 'wire', 'explain [pod]', "
+                         "'traces'")
     args = ap.parse_args(argv)
     cmd = args.target[0] if args.target else None
     try:
@@ -346,6 +392,11 @@ def main(argv: list[str] | None = None) -> int:
             snap = fetch_path(args.endpoint, "/inspect/gang")
             print(json.dumps(snap, indent=2) if args.json
                   else render_gang(snap))
+            return 0
+        if cmd == "wire":
+            snap = fetch_path(args.endpoint, "/inspect/wire")
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_wire(snap))
             return 0
         if cmd == "explain":
             path = "/inspect/explain"
