@@ -2999,7 +2999,7 @@ def fleet_health() -> dict:
     fleet_brute = [0] * (len(TIERS) + 1)
     for name in ("fh-frag", "fh-full", "fh-free"):
         info = cache.get_node_info(name)
-        _st, _nt, n_ge, contig_ge = summaries[name]
+        _st, _nt, n_ge, contig_ge, _r = summaries[name]
         got = stranded_gap_mib(n_ge, contig_ge, info.hbm_per_chip)
         want = brute_node_gaps(info)
         matches = matches and got == want

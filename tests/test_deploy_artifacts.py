@@ -259,3 +259,44 @@ def test_chart_sharding_mode_wires_scaleout_env_and_rbac():
     text = _render_helm(
         os.path.join(chart, "templates", "extender.yaml"), values)
     assert "TPUSHARE_SHARD_REPLICAS" not in text
+
+
+def test_chart_wires_qos_knobs_everywhere():
+    """ISSUE 17: the QoS env knobs must reach both consumers — the
+    extender (admission + pressure monitor) and the device plugin
+    (container env stamping sized against the same overcommit bound) —
+    and the evictor DaemonSet's manifest path / re-park interval must
+    be values-driven (non-kubeadm hosts relocate /etc/kubernetes)."""
+    chart = os.path.join(REPO, "deployer/chart/tpushare-installer")
+    with open(os.path.join(chart, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+
+    text = _render_helm(
+        os.path.join(chart, "templates", "extender.yaml"), values)
+    dep = next(d for d in yaml.safe_load_all(text)
+               if d and d["kind"] == "Deployment")
+    env = {e["name"]: e.get("value") for e in
+           dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUSHARE_QOS_OVERCOMMIT"] == "1.25"
+    assert env["TPUSHARE_QOS_EVICT_BUDGET"] == "4"
+    assert env["TPUSHARE_QOS_EVICT_WINDOW_S"] == "60"
+    assert env["TPUSHARE_QOS_EVICT_BACKOFF_S"] == "120"
+    assert env["TPUSHARE_QOS_DRF_CAP"] == "1.0"
+
+    text = _render_helm(
+        os.path.join(chart, "templates", "device-plugin.yaml"), values)
+    ds = next(d for d in yaml.safe_load_all(text)
+              if d and d["kind"] == "DaemonSet")
+    env = {e["name"]: e.get("value") for e in
+           ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUSHARE_QOS_OVERCOMMIT"] == "1.25"
+
+    values["evictor"] = {"hostManifestsDir": "/srv/kubernetes",
+                         "intervalSeconds": 60}
+    text = _render_helm(os.path.join(
+        chart, "templates", "device-plugin-evictor.yaml"), values)
+    ds = next(d for d in yaml.safe_load_all(text)
+              if d and d["kind"] == "DaemonSet")
+    spec = ds["spec"]["template"]["spec"]
+    assert "sleep 60" in spec["containers"][0]["args"][0]
+    assert spec["volumes"][0]["hostPath"]["path"] == "/srv/kubernetes"

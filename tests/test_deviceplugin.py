@@ -112,6 +112,24 @@ def test_allocate_matches_amount_and_injects_env():
         plugin.allocate(hbm_mib=4096)
 
 
+def test_allocate_stamps_qos_tier_env():
+    # the container learns its own tier (runtime hint for in-process
+    # throttling); unannotated pods land on the burstable default
+    fc, plugin = rig()
+    cache = SchedulerCache(fc)
+    be = fc.create_pod(make_pod(
+        hbm=2048, name="be", ann={contract.ANN_QOS_TIER: "best-effort"}))
+    cache.get_node_info("n1").allocate(be, fc)
+    resp = plugin.allocate(pod_uid=be["metadata"]["uid"])
+    assert resp["env"][contract.ENV_QOS_TIER] == "best-effort"
+
+    plain = fc.create_pod(make_pod(hbm=2048, name="plain"))
+    cache.build_cache()
+    cache.get_node_info("n1").allocate(plain, fc)
+    resp2 = plugin.allocate(pod_uid=plain["metadata"]["uid"])
+    assert resp2["env"][contract.ENV_QOS_TIER] == "burstable"
+
+
 def test_allocate_tie_broken_by_assume_time_then_uid():
     fc, plugin = rig()
     place(fc, "late", hbm=2048, now_ns=2000)

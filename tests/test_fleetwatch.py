@@ -244,7 +244,7 @@ def test_stranded_gap_matches_bruteforce_on_random_fleets(seed):
     assert set(summaries) == set(names)
     for n in names:
         info = cache.get_node_info(n)
-        _stamp, _non_tpu, n_ge, contig_ge = summaries[n]
+        _stamp, _non_tpu, n_ge, contig_ge, _r_ge = summaries[n]
         got = stranded_gap_mib(n_ge, contig_ge, info.hbm_per_chip)
         want = _brute_gap(info.snapshot(), info.topology,
                           info.hbm_per_chip)

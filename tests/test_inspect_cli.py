@@ -264,6 +264,73 @@ def test_cli_gang_subcommand(capsys):
         server.stop()
 
 
+def test_cli_qos_subcommand_inactive(live, capsys):
+    """ISSUE 17: with TPUSHARE_QOS_OVERCOMMIT unset the endpoint still
+    serves — knobs show off, no oversubscription, empty eviction state."""
+    import json as jsonlib
+
+    assert main(["--endpoint", live, "qos"]) == 0
+    out = capsys.readouterr().out
+    assert "qos: overcommit 1.0 (off)" in out
+    assert "no node oversubscribed" in out
+    assert "evictions: 0/" in out
+    assert "tenant dominant shares" in out  # the bound worker pod
+
+    assert main(["--endpoint", live, "--json", "qos"]) == 0
+    snap = jsonlib.loads(capsys.readouterr().out)
+    assert snap["overcommit"] == 1.0
+    assert snap["effective_overcommit"] == 1.0
+    assert snap["evictor_degraded"] is False
+    assert snap["oversubscribed_nodes"] == {}
+    assert snap["fleet"]["by_tier_hbm_mib"] == {"burstable": 9000}
+    assert snap["fleet"]["reclaimable_hbm_mib"] == 0
+    assert snap["eviction"]["budget"] >= 1
+    assert snap["tenant_dominant_share"]["default"] > 0
+
+
+def test_cli_qos_subcommand_active(capsys, monkeypatch):
+    """ISSUE 17: an oversubscribed fleet renders its borrow state — the
+    best-effort tier row, the oversubscribed node, the DRF shares."""
+    import json as jsonlib
+
+    from tpushare.contract import ANN_QOS_TIER
+
+    monkeypatch.setenv("TPUSHARE_QOS_OVERCOMMIT", "1.5")
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=1, hbm_per_chip_mib=10000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    info = cache.get_node_info("n1")
+    be = make_pod(hbm=8000, name="scavenger", namespace="batch",
+                  ann={ANN_QOS_TIER: "best-effort"})
+    info.allocate(fc.create_pod(be), fc)
+    cache.add_or_update_pod(fc.get_pod("batch", "scavenger"))
+    gp = make_pod(hbm=6000, name="inference",
+                  ann={ANN_QOS_TIER: "guaranteed"})
+    info.allocate(fc.create_pod(gp), fc)
+    cache.add_or_update_pod(fc.get_pod("default", "inference"))
+    server = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    port = server.start()
+    try:
+        live = f"http://127.0.0.1:{port}"
+        assert main(["--endpoint", live, "qos"]) == 0
+        out = capsys.readouterr().out
+        assert "qos: overcommit 1.5 (active)" in out
+        assert "best-effort" in out and "guaranteed" in out
+        assert "n1: 4000 MiB over" in out
+        assert "reclaimable (best-effort, evictable): 8000 MiB" in out
+        assert "batch:" in out and "default:" in out
+
+        assert main(["--endpoint", live, "--json", "qos"]) == 0
+        snap = jsonlib.loads(capsys.readouterr().out)
+        assert snap["fleet"]["by_tier_hbm_mib"] == {
+            "best-effort": 8000, "guaranteed": 6000}
+        assert snap["oversubscribed_nodes"] == {"n1": 4000}
+        assert snap["tenant_dominant_share"]["batch"] == 1.0
+    finally:
+        server.stop()
+
+
 def test_cli_wire_subcommand(live, capsys):
     """ISSUE 16: `tpushare-inspect wire` renders digest-table occupancy
     and the native hit rate from /inspect/wire."""

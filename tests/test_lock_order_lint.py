@@ -34,6 +34,7 @@ SCOPES = (
     os.path.join(ROOT, "tpushare", "extender"),
     os.path.join(ROOT, "tpushare", "sim"),
     os.path.join(ROOT, "tpushare", "chaos"),
+    os.path.join(ROOT, "tpushare", "qos"),
 )
 
 # (file basename, with-expression prefix) -> rank. Nested acquisitions
@@ -114,6 +115,12 @@ RANKS = {
     # a cluster list or any cache call; leftmost like the other
     # bookkeeping locks so a future monitor-under-cache nesting red-lines
     ("invariants.py", "self._lock"): 8,
+    # QoS (ISSUE 17): the pressure monitor's budget/backoff/in-flight
+    # bookkeeping lock — leftmost like the defrag governor it copies,
+    # NEVER held across an eviction, a node lock, or a solve
+    # (test_pressure_lock_never_held_across_an_eviction enforces the
+    # eviction half)
+    ("pressure.py", "self._lock"): 8,
 }
 
 _LOCKISH = re.compile(r"(?:^|[._])(?:[a-z_]*lock[a-z_]*)(?:$|\()|for_key\(")
@@ -271,6 +278,59 @@ def test_native_table_lock_never_held_across_a_probe():
                             f"nativewire.py:{sub.lineno}: '{src}(...)' "
                             "called under self._lock — the table lock "
                             "must never be held across a probe")
+
+    def walk(body, held):
+        for n in body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(n.body, False)
+                continue
+            if isinstance(n, ast.With):
+                holds = held or any(
+                    _with_expr_key(i.context_expr) == "self._lock"
+                    for i in n.items)
+                if holds:
+                    scan_calls(n.body)
+                walk(n.body, holds)
+                continue
+            for cb in (getattr(n, "body", None),
+                       getattr(n, "orelse", None),
+                       getattr(n, "finalbody", None)):
+                if isinstance(cb, list):
+                    walk(cb, held)
+            for h in getattr(n, "handlers", []) or []:
+                walk(h.body, held)
+
+    walk(tree.body, False)
+    assert not problems, "\n".join(problems)
+
+
+def test_pressure_lock_never_held_across_an_eviction():
+    """The QoS pressure monitor's bookkeeping lock (pressure.py
+    self._lock, rank 8) is documented as NEVER held across an eviction,
+    a node lock, or a solve — an eviction is apiserver I/O plus cache
+    mutation, and budget bookkeeping held across it would serialize the
+    fleet's admission paths behind one slow delete. AST check: no call
+    whose name smells like an eviction/delete/solve/cache-walk appears
+    inside a ``with self._lock:`` block in pressure.py."""
+    path = os.path.join(ROOT, "tpushare", "qos", "pressure.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    banned = re.compile(
+        r"evict|delete_pod|remove_pod|solve|peek_node|pressure_victim"
+        r"|scan_node|scan_once")
+    problems: list[str] = []
+
+    def scan_calls(body):
+        for n in body:
+            for sub in ast.walk(n) if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)) else []:
+                if isinstance(sub, ast.Call):
+                    src = ast.unparse(sub.func)
+                    if banned.search(src):
+                        problems.append(
+                            f"pressure.py:{sub.lineno}: '{src}(...)' "
+                            "called under self._lock — the budget lock "
+                            "must never be held across an eviction")
 
     def walk(body, held):
         for n in body:

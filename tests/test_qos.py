@@ -1,0 +1,413 @@
+"""QoS tiers (ISSUE 17): admission math, pressure eviction defenses,
+the degraded latch, DRF caps — and the acceptance race.
+
+The one scenario that justifies the whole subsystem: a guaranteed bind
+lands concurrently with a best-effort oversubscribed admission on the
+same chip. Exactly the best-effort borrower is evicted, the guaranteed
+reservation is never violated at any sampled instant on apiserver
+truth, and cache vs apiserver drift is zero.
+
+Budget/backoff tests drive the monitor on a fake clock, mirroring
+tests/test_defrag.py::test_budget_governor_and_backoff.
+"""
+
+import threading
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.chaos.invariants import QosInvariantMonitor, qos_violations
+from tpushare.k8s import FakeCluster
+from tpushare.qos.drf import (admission_would_exceed, dominant_shares,
+                              drf_cap, tenant_usage)
+from tpushare.qos.pressure import QOS_EVICTIONS, QosPressureMonitor
+from tpushare.qos.tiers import (ENV_DRF_CAP, ENV_OVERCOMMIT,
+                                TIER_BEST_EFFORT, TIER_BURSTABLE,
+                                TIER_GUARANTEED, clear_degraded,
+                                effective_overcommit, is_degraded,
+                                overcommit, pod_tier, set_degraded,
+                                tier_rank)
+
+HBM = 10000
+
+
+@pytest.fixture(autouse=True)
+def _latch_hygiene():
+    clear_degraded()
+    yield
+    clear_degraded()
+
+
+def tier_pod(name, hbm, tier=None, namespace="default"):
+    ann = {contract.ANN_QOS_TIER: tier} if tier else None
+    return make_pod(hbm=hbm, name=name, namespace=namespace, ann=ann)
+
+
+def qos_fleet(monkeypatch, oc="1.5", nodes=1, chips=1):
+    monkeypatch.setenv(ENV_OVERCOMMIT, oc)
+    fc = FakeCluster()
+    for i in range(nodes):
+        fc.add_tpu_node(f"n{i}", chips=chips, hbm_per_chip_mib=HBM)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    return fc, cache
+
+
+def bind(fc, cache, node, pod):
+    info = cache.get_node_info(node)
+    info.allocate(fc.create_pod(pod), fc)
+    ns, name = pod["metadata"]["namespace"], pod["metadata"]["name"]
+    cache.add_or_update_pod(fc.get_pod(ns, name))
+
+
+def outcome_deltas(fn):
+    outcomes = ("completed", "failed", "demoted", "skipped_budget",
+                "skipped_backoff", "skipped_inflight")
+    before = {o: QOS_EVICTIONS.get(TIER_BEST_EFFORT, o)
+              for o in outcomes}
+    fn()
+    return {o: QOS_EVICTIONS.get(TIER_BEST_EFFORT, o) - before[o]
+            for o in outcomes}
+
+
+# -- tier vocabulary ----------------------------------------------------------
+
+def test_pod_tier_parsing():
+    assert pod_tier(tier_pod("p", 100)) == TIER_BURSTABLE
+    assert pod_tier(tier_pod("p", 100, "guaranteed")) == TIER_GUARANTEED
+    assert pod_tier(tier_pod("p", 100, "best-effort")) == TIER_BEST_EFFORT
+    assert pod_tier(tier_pod("p", 100, "  GUARANTEED ")) == TIER_GUARANTEED
+    assert pod_tier(tier_pod("p", 100, "platinum")) == TIER_BURSTABLE
+    assert pod_tier(None) == TIER_BURSTABLE
+
+
+def test_tier_rank_orders_eviction():
+    assert tier_rank(TIER_BEST_EFFORT) < tier_rank(TIER_BURSTABLE) \
+        < tier_rank(TIER_GUARANTEED)
+    assert tier_rank("nonsense") == tier_rank(TIER_BURSTABLE)
+
+
+def test_overcommit_env_clamps(monkeypatch):
+    monkeypatch.delenv(ENV_OVERCOMMIT, raising=False)
+    assert overcommit() == 1.0
+    monkeypatch.setenv(ENV_OVERCOMMIT, "1.5")
+    assert overcommit() == 1.5
+    monkeypatch.setenv(ENV_OVERCOMMIT, "0.5")   # < 1.0 is meaningless
+    assert overcommit() == 1.0
+    monkeypatch.setenv(ENV_OVERCOMMIT, "banana")
+    assert overcommit() == 1.0
+
+
+def test_degraded_latch_collapses_effective_overcommit(monkeypatch):
+    monkeypatch.setenv(ENV_OVERCOMMIT, "2.0")
+    assert effective_overcommit() == 2.0
+    set_degraded()
+    assert is_degraded()
+    assert effective_overcommit() == 1.0   # knob unchanged, gate shut
+    assert overcommit() == 2.0
+    clear_degraded()
+    assert effective_overcommit() == 2.0
+
+
+# -- admission views ----------------------------------------------------------
+
+def test_best_effort_borrows_beyond_physical(monkeypatch):
+    fc, cache = qos_fleet(monkeypatch, oc="1.5")
+    info = cache.get_node_info("n0")
+    bind(fc, cache, "n0", tier_pod("be-1", 8000, "best-effort"))
+    # 8000 + 6000 = 14000 > 10000 physical but <= 15000 cap
+    ok, _ = info.assume_qos(tier_pod("be-2", 6000, "best-effort"))
+    assert ok
+    # ... and the cap is a hard bound: 8000 + 7001 > 15000
+    ok, reason = info.assume_qos(tier_pod("be-3", 7001, "best-effort"))
+    assert not ok and reason
+
+
+def test_guaranteed_counts_reclaimable_but_honors_both_bounds(
+        monkeypatch):
+    fc, cache = qos_fleet(monkeypatch, oc="1.5")
+    info = cache.get_node_info("n0")
+    bind(fc, cache, "n0", tier_pod("be-1", 8000, "best-effort"))
+    # guaranteed headroom = min(physical - non-BE used, cap - used)
+    #                     = min(10000 - 0, 15000 - 8000) = 7000
+    ok, _ = info.assume_qos(tier_pod("g-1", 7000, "guaranteed"))
+    assert ok
+    ok, _ = info.assume_qos(tier_pod("g-2", 7001, "guaranteed"))
+    assert not ok
+    # non-BE usage alone can never pass physical, however large the cap
+    bind(fc, cache, "n0", tier_pod("g-3", 6000, "guaranteed"))
+    ok, _ = info.assume_qos(tier_pod("g-4", 4001, "guaranteed"))
+    assert not ok
+    ok, _ = info.assume_qos(tier_pod("g-5", 1000, "guaranteed"))
+    assert ok
+
+
+def test_inactive_overcommit_is_legacy_admission(monkeypatch):
+    fc, cache = qos_fleet(monkeypatch, oc="1.0")
+    info = cache.get_node_info("n0")
+    bind(fc, cache, "n0", tier_pod("be-1", 8000, "best-effort"))
+    # no borrowing at oc=1.0 — even best-effort sees physical HBM
+    ok, _ = info.assume_qos(tier_pod("be-2", 2001, "best-effort"))
+    assert not ok
+    ok, _ = info.assume_qos(tier_pod("be-3", 2000, "best-effort"))
+    assert ok
+
+
+def test_pressure_victim_smallest_clearing_else_largest(monkeypatch):
+    fc, cache = qos_fleet(monkeypatch, oc="2.0")
+    info = cache.get_node_info("n0")
+    bind(fc, cache, "n0", tier_pod("be-small", 3000, "best-effort"))
+    bind(fc, cache, "n0", tier_pod("be-big", 5000, "best-effort"))
+    assert info.pressure_victim() is None   # pure BE borrow: no pressure
+    bind(fc, cache, "n0", tier_pod("g-1", 4000, "guaranteed"))
+    # overage 2000: smallest clearing entry is be-small (3000)
+    plan = info.pressure_victim()
+    assert plan is not None
+    key, hbm, chip, _stamp = plan
+    victim = cache.pod_by_key(key)
+    assert victim["metadata"]["name"] == "be-small"
+    assert hbm == 3000 and chip == 0
+    # overage 5500: nothing clears -> the largest (be-big) goes first
+    bind(fc, cache, "n0", tier_pod("g-2", 3500, "guaranteed"))
+    key, hbm, _chip, _stamp = info.pressure_victim()
+    assert cache.pod_by_key(key)["metadata"]["name"] == "be-big"
+    assert hbm == 5000
+
+
+# -- DRF tenant caps ----------------------------------------------------------
+
+def test_dominant_shares_over_chips_and_hbm(monkeypatch):
+    fc, cache = qos_fleet(monkeypatch, oc="1.0", chips=2)
+    bind(fc, cache, "n0", tier_pod("wide", 1000, namespace="a"))
+    bind(fc, cache, "n0", tier_pod("deep", 8000, namespace="b"))
+    usage = tenant_usage(cache)
+    assert usage["_fleet"] == {"chips": 2.0, "hbm_mib": 20000.0}
+    shares = dominant_shares(cache)
+    # "a" is chip-dominant (1/2 chips), "b" HBM-dominant would be 0.4
+    # but also holds a chip: max(0.5, 0.4) = 0.5
+    assert shares["a"] == 0.5
+    assert shares["b"] == 0.5
+
+
+def test_admission_would_exceed_caps_tenant(monkeypatch):
+    fc, cache = qos_fleet(monkeypatch, oc="1.0", chips=2)
+    bind(fc, cache, "n0", tier_pod("deep", 8000, namespace="b"))
+    assert not admission_would_exceed(cache, "b", 0, 4000, cap=0.6)
+    assert admission_would_exceed(cache, "b", 0, 4001, cap=0.6)
+    assert admission_would_exceed(cache, "b", 1, 0, cap=0.6)  # 2/2 chips
+    # cap 1.0 is "off" — never rejects
+    assert not admission_would_exceed(cache, "b", 2, 99999, cap=1.0)
+
+
+def test_drf_cap_env_parsing(monkeypatch):
+    monkeypatch.delenv(ENV_DRF_CAP, raising=False)
+    assert drf_cap() == 1.0
+    monkeypatch.setenv(ENV_DRF_CAP, "0.25")
+    assert drf_cap() == 0.25
+    monkeypatch.setenv(ENV_DRF_CAP, "1.7")   # out of (0, 1] -> off
+    assert drf_cap() == 1.0
+    monkeypatch.setenv(ENV_DRF_CAP, "zero")
+    assert drf_cap() == 1.0
+
+
+# -- the pressure monitor on a fake clock -------------------------------------
+
+def pressured_fleet(monkeypatch, nodes=1):
+    """Every node's chip 0 is at 14000/10000 with 8000 reclaimable."""
+    fc, cache = qos_fleet(monkeypatch, oc="1.5", nodes=nodes)
+    for i in range(nodes):
+        bind(fc, cache, f"n{i}",
+             tier_pod(f"be-{i}", 8000, "best-effort",
+                      namespace="batch"))
+        bind(fc, cache, f"n{i}", tier_pod(f"g-{i}", 6000, "guaranteed"))
+    return fc, cache
+
+
+def test_budget_governor_and_window_roll(monkeypatch):
+    fc, cache = pressured_fleet(monkeypatch, nodes=2)
+    now = [1000.0]
+    mon = QosPressureMonitor(cache, fc, budget=1, window_s=60.0,
+                             backoff_s=30.0, time_fn=lambda: now[0])
+    d = outcome_deltas(mon.scan_once)
+    # one eviction spends the window's only slot; n1 is deferred
+    assert d["completed"] == 1 and d["skipped_budget"] == 1
+    assert fc.get_pod("default", "g-0") and fc.get_pod("default", "g-1")
+    state = mon.budget_state()
+    assert state["used_in_window"] == 1 and state["budget"] == 1
+    # the window rolls: the deferred node is now served
+    now[0] += 61.0
+    d = outcome_deltas(mon.scan_once)
+    assert d["completed"] == 1 and d["skipped_budget"] == 0
+    assert qos_violations(fc.list_pods(), HBM, 1.5) == ([], [])
+
+
+class FailingDeletes:
+    """Delegates to a FakeCluster; delete_pod raises while armed."""
+
+    def __init__(self, fc):
+        self._fc = fc
+        self.armed = True
+
+    def __getattr__(self, name):
+        return getattr(self._fc, name)
+
+    def delete_pod(self, ns, name, **kw):
+        if self.armed:
+            raise OSError("evictor transport down")
+        return self._fc.delete_pod(ns, name, **kw)
+
+
+def test_failed_eviction_backs_off_the_node(monkeypatch):
+    fc, cache = pressured_fleet(monkeypatch)
+    now = [1000.0]
+    mon = QosPressureMonitor(cache, FailingDeletes(fc), budget=8,
+                             window_s=60.0, backoff_s=30.0,
+                             time_fn=lambda: now[0])
+    d = outcome_deltas(mon.scan_once)
+    assert d["failed"] == 1
+    assert mon.budget_state()["backoff_nodes"] == ["n0"]
+    # in backoff: the node is skipped, nothing is retried
+    d = outcome_deltas(mon.scan_once)
+    assert d["skipped_backoff"] == 1 and d["failed"] == 0
+    # backoff expires -> retried (and fails again)
+    now[0] += 31.0
+    d = outcome_deltas(mon.scan_once)
+    assert d["failed"] == 1
+
+
+def test_degraded_latch_stops_oversubscription_until_success(
+        monkeypatch):
+    fc, cache = pressured_fleet(monkeypatch)
+    cluster = FailingDeletes(fc)
+    now = [1000.0]
+    mon = QosPressureMonitor(cache, cluster, budget=16, window_s=60.0,
+                             backoff_s=0.0, time_fn=lambda: now[0])
+    info = cache.get_node_info("n0")
+    for i in range(3):
+        assert not is_degraded()
+        assert mon.scan_node("n0", max_evictions=1) == 0
+        now[0] += 1.0
+    # 3 consecutive transport failures latch degraded fleet-wide ...
+    assert is_degraded()
+    assert effective_overcommit() == 1.0
+    # ... oversubscribed admissions stop (14000 used of 10000 physical)
+    ok, _ = info.assume_qos(tier_pod("be-x", 500, "best-effort"))
+    assert not ok
+    # the first successful eviction clears the latch and reclaims
+    cluster.armed = False
+    d = outcome_deltas(lambda: mon.scan_node("n0"))
+    assert d["completed"] == 1
+    assert not is_degraded()
+    assert effective_overcommit() == 1.5
+    assert fc.get_pod("default", "g-0")
+
+
+def test_demoted_when_victim_departs_after_planning(monkeypatch):
+    fc, cache = pressured_fleet(monkeypatch)
+
+    class VanishingVictim:
+        def __init__(self, fc):
+            self._fc = fc
+
+        def __getattr__(self, name):
+            return getattr(self._fc, name)
+
+    cluster = VanishingVictim(fc)
+    mon = QosPressureMonitor(cache, cluster, budget=16)
+    # the victim departs between planning and revalidation: stamp moved
+    plan = cache.get_node_info("n0").pressure_victim()
+    assert plan is not None
+    gone = fc.get_pod("batch", "be-0")
+    fc.delete_pod("batch", "be-0")
+    cache.remove_pod(gone)
+    d = outcome_deltas(lambda: mon.scan_node("n0"))
+    assert d["demoted"] == 0 and d["completed"] == 0  # no pressure left
+    # re-create pressure, then move the stamp AFTER planning via a
+    # concurrent bind: _evict_one revalidates and demotes, untouched
+    bind(fc, cache, "n0", tier_pod("be-new", 8000, "best-effort",
+                                   namespace="batch"))
+    orig = type(cache).peek_node
+    state = {"n": 0, "busy": False}
+
+    def racy_peek(self, name):
+        # peek #1 plans the eviction; a concurrent bind lands before
+        # peek #2 (the revalidation), moving the node stamp
+        if state["busy"]:
+            return orig(self, name)
+        state["n"] += 1
+        if state["n"] == 2:
+            state["busy"] = True
+            bind(fc, cache, "n0", tier_pod("g-race", 100, "guaranteed"))
+            state["busy"] = False
+        return orig(self, name)
+
+    monkeypatch.setattr(type(cache), "peek_node", racy_peek)
+    d = outcome_deltas(lambda: mon.scan_node("n0", max_evictions=1))
+    assert d["demoted"] == 1 and d["completed"] == 0
+    assert fc.get_pod("batch", "be-new")  # victim untouched
+
+
+# -- the acceptance race ------------------------------------------------------
+
+def test_guaranteed_bind_races_best_effort_admission(monkeypatch):
+    """Guaranteed bind concurrent with a best-effort oversubscribed
+    admission on the same chip: exactly the best-effort borrower is
+    evicted, zero guaranteed violations on sampled apiserver truth,
+    zero cache drift."""
+    fc, cache = qos_fleet(monkeypatch, oc="1.5")
+    bind(fc, cache, "n0", tier_pod("be-old", 8000, "best-effort",
+                                   namespace="batch"))
+    qmon = QosInvariantMonitor(fc.list_pods, HBM, 1.5,
+                               interval_s=0.001).start()
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def bind_one(pod):
+        try:
+            barrier.wait(timeout=2.0)
+            bind(fc, cache, "n0", pod)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=bind_one,
+                         args=(tier_pod("g-hot", 6000, "guaranteed"),)),
+        threading.Thread(target=bind_one,
+                         args=(tier_pod("be-late", 1000, "best-effort",
+                                        namespace="batch"),)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert errors == []
+    # chip: 8000 BE + 6000 G + 1000 BE = 15000 granted, 10000 physical
+    mon = QosPressureMonitor(cache, fc, budget=16)
+    d = outcome_deltas(mon.scan_once)
+    # overage 5000: be-old (8000) is the only clearing victim — exactly
+    # one eviction makes the chip physically whole (7000 used)
+    assert d["completed"] == 1
+    assert fc.get_pod("default", "g-hot")
+    assert fc.get_pod("batch", "be-late")
+    with pytest.raises(Exception):
+        fc.get_pod("batch", "be-old")
+    report = qmon.stop()
+    assert report["samples"] > 0
+    assert report["guaranteed_violations"] == []
+    assert report["overcommit_violations"] == []
+    assert qos_violations(fc.list_pods(), HBM, 1.5) == ([], [])
+    # zero drift: cache per-chip sums match apiserver truth annotations
+    truth = {}
+    for pod in fc.list_pods():
+        node = (pod.get("spec") or {}).get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        if not node or ids is None:
+            continue
+        for c in ids:
+            truth[c] = truth.get(c, 0) \
+                + contract.hbm_from_annotations(pod)
+    for node in cache.describe()["nodes"]:
+        for chip in node["chips"]:
+            assert chip["used_hbm_mib"] == truth.get(chip["idx"], 0)

@@ -73,3 +73,81 @@ def test_knob_grid_shape():
     grid = knob_grid()
     assert len(grid) >= 16
     assert len(set(grid)) == len(grid)
+
+
+# -- the tiered QoS gate (ISSUE 17) -------------------------------------------
+#
+# tests/data/qos_wind_tunnel_golden.json pins the oversubscribed
+# (overcommit=1.25) tiered-diurnal scorecard AND the single-class
+# baseline it must beat. Re-baselining is deliberate:
+# ``python -m tpushare.sim --qos --pin``.
+
+from tpushare.sim.qos import (
+    GATE_OVERCOMMIT, GUARANTEED, QOS_DEFAULT_BANDS, QOS_GATE_FLEET,
+    QOS_GATE_SPEC, load_qos_golden, qos_gate_report)
+
+
+@pytest.fixture(scope="module")
+def qos_golden():
+    return load_qos_golden()
+
+
+@pytest.fixture(scope="module")
+def qos_report():
+    return qos_gate_report()
+
+
+def test_qos_golden_schema(qos_golden):
+    assert set(qos_golden) == {"gate_spec", "gate_fleet", "overcommit",
+                               "scorecard", "qos", "bands"}
+    assert qos_golden["overcommit"] == GATE_OVERCOMMIT
+    assert qos_golden["bands"] == QOS_DEFAULT_BANDS
+    # the golden must describe THIS code's gate workload
+    assert qos_golden["gate_spec"]["seed"] == QOS_GATE_SPEC.seed
+    assert qos_golden["gate_spec"]["peak_rate"] == QOS_GATE_SPEC.peak_rate
+    assert qos_golden["gate_spec"]["n_tiers"] == len(QOS_GATE_SPEC.tiers)
+    assert qos_golden["gate_fleet"]["nodes"] == QOS_GATE_FLEET["nodes"]
+
+
+def test_qos_gate_scorecard_within_bands(qos_golden, qos_report):
+    violations = check_scorecard(qos_report.scorecard(), qos_golden)
+    assert violations == [], "\n".join(violations)
+
+
+def test_qos_gate_isolation_invariants(qos_golden, qos_report):
+    """The robustness half of the gate: zero guaranteed violations and
+    zero beyond-bound grants at EVERY sampled instant, evictions
+    governed by the budget — the same three assertions the chaos drill
+    makes against apiserver truth."""
+    assert qos_report.guaranteed_violations == 0
+    assert qos_report.overcommit_violations == 0
+    assert qos_report.evictions > 0, \
+        "gate workload must actually exercise pressure eviction"
+    assert qos_report.max_window_evictions <= 4  # GATE_EVICT_BUDGET
+    assert qos_golden["qos"]["guaranteed_violations"] == 0
+    assert qos_golden["qos"]["overcommit_violations"] == 0
+
+
+def test_qos_gate_beats_single_class_baseline(qos_report):
+    """What oversubscription must BUY: a time-weighted utilization win
+    over the single-class (overcommit=1.0) baseline at equal-or-better
+    guaranteed-tier SLO, with best-effort HBM actually reclaimed under
+    pressure. If the tiered run cannot beat its own off-switch, the
+    subsystem has no reason to exist."""
+    base = qos_gate_report(overcommit=1.0)
+    assert base.evictions == 0  # the off-switch really is off
+    assert base.guaranteed_violations == 0
+    tiered = qos_report
+    assert tiered.scorecard()["time_weighted_util_pct"] > \
+        base.scorecard()["time_weighted_util_pct"]
+    assert tiered.by_tier[GUARANTEED]["p99_wait"] <= \
+        base.by_tier[GUARANTEED]["p99_wait"]
+    assert tiered.reclaimed_mib > 0
+
+
+def test_qos_gate_is_falsifiable(qos_golden):
+    """An unbounded overcommit (2.0) shifts the scorecard outside the
+    pinned bands — the bands are tight enough to catch an accidental
+    knob regression, not just a policy rewrite."""
+    loose = qos_gate_report(overcommit=2.0)
+    assert check_scorecard(loose.scorecard(), qos_golden) != []

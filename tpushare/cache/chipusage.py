@@ -20,6 +20,7 @@ from tpushare.core.chips import ChipView
 class _Entry:
     hbm_mib: int
     reserved: bool  # True while the bind-path patch/bind is in flight
+    tier: str = "burstable"  # QoS tier (tpushare/qos/tiers.py)
 
 
 class ChipUsage:
@@ -34,6 +35,9 @@ class ChipUsage:
         self.total_hbm_mib = total_hbm_mib
         self._pods: dict[str, _Entry] = {}  # pod UID -> entry
         self._used = 0  # invariant: == sum of entry hbm_mib
+        # invariant: == sum of best-effort entry hbm_mib; maintained
+        # incrementally for the same hot-loop reason as _used
+        self._reclaimable = 0
 
     @property
     def used_hbm_mib(self) -> int:
@@ -42,6 +46,12 @@ class ChipUsage:
         # re-summing the pod map is what made the reference's fit check
         # O(pods) per chip (deviceinfo.go:41-54)
         return self._used
+
+    @property
+    def reclaimable_hbm_mib(self) -> int:
+        """HBM held by best-effort-tier entries (evictable under
+        pressure)."""
+        return self._reclaimable
 
     @property
     def pod_uids(self) -> list[str]:
@@ -53,6 +63,27 @@ class ChipUsage:
 
     def has_pod(self, uid: str) -> bool:
         return uid in self._pods
+
+    def entry_tier(self, uid: str) -> str:
+        """The entry's QoS tier ('burstable' when unknown) — used for
+        state carry-over across chip rebuilds and by eviction planning."""
+        e = self._pods.get(uid)
+        return e.tier if e else "burstable"
+
+    def tier_usage(self) -> dict[str, int]:
+        """HBM grant sum per QoS tier on this chip (inspect/gauges —
+        not the hot loop, so iterating the pod map is fine here)."""
+        out: dict[str, int] = {}
+        for e in self._pods.values():
+            out[e.tier] = out.get(e.tier, 0) + e.hbm_mib
+        return out
+
+    def best_effort_entries(self) -> list[tuple[str, int]]:
+        """(uid, hbm_mib) of confirmed best-effort entries — the victim
+        pool for pressure-driven eviction (reserved entries are an
+        in-flight bind's business, not the evictor's)."""
+        return [(uid, e.hbm_mib) for uid, e in self._pods.items()
+                if e.tier == "best-effort" and not e.reserved]
 
     def holds(self, uid: str, hbm_mib: int) -> bool:
         """True iff a CONFIRMED entry with exactly this HBM exists —
@@ -71,34 +102,44 @@ class ChipUsage:
 
     def view(self, healthy: bool = True) -> ChipView:
         return ChipView(self.idx, self.coords, self.total_hbm_mib,
-                        self.used_hbm_mib, healthy)
+                        self.used_hbm_mib, healthy,
+                        reclaimable_hbm_mib=self._reclaimable)
 
     # -- mutations (NodeInfo-lock held) --------------------------------------
 
-    def _put(self, uid: str, hbm_mib: int, reserved: bool) -> None:
+    def _put(self, uid: str, hbm_mib: int, reserved: bool,
+             tier: str = "burstable") -> None:
         old = self._pods.get(uid)
         if old is not None:
             self._used -= old.hbm_mib
-        self._pods[uid] = _Entry(hbm_mib, reserved=reserved)
+            if old.tier == "best-effort":
+                self._reclaimable -= old.hbm_mib
+        self._pods[uid] = _Entry(hbm_mib, reserved=reserved, tier=tier)
         self._used += hbm_mib
+        if tier == "best-effort":
+            self._reclaimable += hbm_mib
 
-    def reserve(self, uid: str, hbm_mib: int) -> None:
-        self._put(uid, hbm_mib, reserved=True)
+    def reserve(self, uid: str, hbm_mib: int,
+                tier: str = "burstable") -> None:
+        self._put(uid, hbm_mib, reserved=True, tier=tier)
 
     def confirm(self, uid: str) -> None:
         e = self._pods.get(uid)
         if e:
             e.reserved = False
 
-    def add_pod(self, uid: str, hbm_mib: int) -> None:
+    def add_pod(self, uid: str, hbm_mib: int,
+                tier: str = "burstable") -> None:
         """Record a pod known from its annotations (sync/replay path,
         reference deviceinfo.go addPod)."""
-        self._put(uid, hbm_mib, reserved=False)
+        self._put(uid, hbm_mib, reserved=False, tier=tier)
 
     def remove_pod(self, uid: str) -> bool:
         e = self._pods.pop(uid, None)
         if e is not None:
             self._used -= e.hbm_mib
+            if e.tier == "best-effort":
+                self._reclaimable -= e.hbm_mib
             return True
         return False
 
@@ -110,6 +151,8 @@ class ChipUsage:
         if e is not None and e.reserved:
             del self._pods[uid]
             self._used -= e.hbm_mib
+            if e.tier == "best-effort":
+                self._reclaimable -= e.hbm_mib
             return True
         return False
 

@@ -93,14 +93,21 @@ def tier_label(tier: int) -> str:
 class _Summary:
     """Per-node capability record at one generation stamp."""
 
-    __slots__ = ("stamp", "non_tpu", "n_ge", "contig_ge")
+    __slots__ = ("stamp", "non_tpu", "n_ge", "contig_ge", "r_ge")
 
     def __init__(self, stamp: tuple[int, int], non_tpu: bool,
-                 n_ge: tuple[int, ...], contig_ge: tuple[int, ...]) -> None:
+                 n_ge: tuple[int, ...], contig_ge: tuple[int, ...],
+                 r_ge: tuple[int, ...] | None = None) -> None:
         self.stamp = stamp
         self.non_tpu = non_tpu
         self.n_ge = n_ge          # eligible chip count per tier
         self.contig_ge = contig_ge  # max contiguous box size per tier
+        # reclaimable-aware eligibility: chips that WOULD be eligible at
+        # the tier if their best-effort (evictable) usage were reclaimed
+        # (tpushare/qos/). Observability only — prune verdicts stay
+        # strictly physical, so index pruning is byte-identical whether
+        # or not a fleet runs QoS tiers (TPUSHARE_INDEX_VERIFY clean).
+        self.r_ge = n_ge if r_ge is None else r_ge
 
 
 def _max_rect_in_histogram(heights: list[int]) -> int:
@@ -173,22 +180,29 @@ def summarize(stamp: tuple[int, int], snap: Iterable[ChipView],
     chips = list(snap)
     if chip_count <= 0 or not chips:
         empty = (0,) * (len(TIERS) + 1)
-        return _Summary(stamp, True, empty, empty)
+        return _Summary(stamp, True, empty, empty, empty)
     if len(chips) != topo.num_chips:
         # same partial-host repair the fit/select path applies
         topo = MeshTopology((len(chips),))
     n_ge = [0] * (len(TIERS) + 1)
     contig_ge = [0] * (len(TIERS) + 1)
+    r_ge = [0] * (len(TIERS) + 1)
     prev_set: frozenset[int] | None = None
     prev_val = (0, 0)
     for ti in range(len(TIERS) + 1):
         if ti == EXCL_TIER:
             elig = frozenset(c.idx for c in chips
                              if c.healthy and c.used_hbm_mib == 0)
+            r_ge[ti] = sum(
+                1 for c in chips if c.healthy
+                and c.used_hbm_mib - c.reclaimable_hbm_mib == 0)
         else:
             t = TIERS[ti]
             elig = frozenset(c.idx for c in chips
                              if c.healthy and c.free_hbm_mib >= t)
+            r_ge[ti] = sum(
+                1 for c in chips if c.healthy
+                and c.free_hbm_mib + c.reclaimable_hbm_mib >= t)
         if elig == prev_set:
             n_ge[ti], contig_ge[ti] = prev_val  # tiers sharing an
             # eligibility set share the (expensive) box computation
@@ -196,7 +210,8 @@ def summarize(stamp: tuple[int, int], snap: Iterable[ChipView],
             prev_set = elig
             prev_val = (len(elig), max_box_size(topo, elig))
             n_ge[ti], contig_ge[ti] = prev_val
-    return _Summary(stamp, False, tuple(n_ge), tuple(contig_ge))
+    return _Summary(stamp, False, tuple(n_ge), tuple(contig_ge),
+                    tuple(r_ge))
 
 
 class _PruneMap(dict):
@@ -585,14 +600,18 @@ class CapacityIndex:
             return name in self._summaries
 
     def summaries_snapshot(self) -> dict[str, tuple[
-            tuple[int, int], bool, tuple[int, ...], tuple[int, ...]]]:
-        """``name -> (stamp, non_tpu, n_ge, contig_ge)`` for every
+            tuple[int, int], bool, tuple[int, ...], tuple[int, ...],
+            tuple[int, ...]]]:
+        """``name -> (stamp, non_tpu, n_ge, contig_ge, r_ge)`` for every
         resident summary — the fleet-health sampler's raw material
         (obs/fleetwatch.py derives the per-tier schedulable-chip and
-        stranded-HBM gauges from this). One dict copy under the lock;
-        the value tuples are immutable and safe to share."""
+        stranded-HBM gauges from this; ``r_ge`` adds the
+        reclaimable-aware eligibility QoS fleets report). One dict copy
+        under the lock; the value tuples are immutable and safe to
+        share."""
         with self._lock:
-            return {name: (s.stamp, s.non_tpu, s.n_ge, s.contig_ge)
+            return {name: (s.stamp, s.non_tpu, s.n_ge, s.contig_ge,
+                           s.r_ge)
                     for name, s in self._summaries.items()}
 
     def describe(self) -> dict[str, Any]:
@@ -641,12 +660,13 @@ class CapacityIndex:
                 problems.append(f"{name}: stale stamp {s.stamp} != "
                                 f"{fresh.stamp} (unflushed mutation?)")
                 continue
-            if (s.non_tpu, s.n_ge, s.contig_ge) != \
-                    (fresh.non_tpu, fresh.n_ge, fresh.contig_ge):
+            if (s.non_tpu, s.n_ge, s.contig_ge, s.r_ge) != \
+                    (fresh.non_tpu, fresh.n_ge, fresh.contig_ge,
+                     fresh.r_ge):
                 problems.append(
                     f"{name}: summary diverged from rebuild: "
-                    f"{(s.n_ge, s.contig_ge)} != "
-                    f"{(fresh.n_ge, fresh.contig_ge)}")
+                    f"{(s.n_ge, s.contig_ge, s.r_ge)} != "
+                    f"{(fresh.n_ge, fresh.contig_ge, fresh.r_ge)}")
         # bucket membership must match the summaries exactly
         with self._lock:
             for (kind, ti, cap), bucket in self._buckets.items():
@@ -719,12 +739,13 @@ class CapacityIndex:
                         f"the index hook)")
                 continue
             fresh = summarize(stamp, snap, info.topology, info.chip_count)
-            if (s.non_tpu, s.n_ge, s.contig_ge) != \
-                    (fresh.non_tpu, fresh.n_ge, fresh.contig_ge):
+            if (s.non_tpu, s.n_ge, s.contig_ge, s.r_ge) != \
+                    (fresh.non_tpu, fresh.n_ge, fresh.contig_ge,
+                     fresh.r_ge):
                 problems.append(
                     f"{name}: summary diverged from rebuild: "
-                    f"{(s.n_ge, s.contig_ge)} != "
-                    f"{(fresh.n_ge, fresh.contig_ge)}")
+                    f"{(s.n_ge, s.contig_ge, s.r_ge)} != "
+                    f"{(fresh.n_ge, fresh.contig_ge, fresh.r_ge)}")
                 continue
             if s.non_tpu:
                 continue

@@ -45,6 +45,14 @@ from tpushare.core.placement import Placement, PlacementRequest, fits, select_ch
 from tpushare.core.topology import MeshTopology
 from tpushare.metrics import Counter, LabeledCounter
 from tpushare.k8s.client import ApiError
+# qos.tiers is a leaf module (contract + stdlib only) — importing it
+# here does not invert the layering; qos.pressure (which imports the
+# cache) must NEVER be imported from this module
+from tpushare.qos.tiers import (
+    TIER_BEST_EFFORT,
+    effective_overcommit,
+    pod_tier,
+)
 
 log = logging.getLogger("tpushare.cache.nodeinfo")
 
@@ -498,6 +506,121 @@ class NodeInfo:
                 return False  # exclusive chips must be completely free
         return True
 
+    # -- QoS admission (tpushare/qos/) ----------------------------------------
+
+    def _qos_views(self, oc: float, tier: str) -> list[ChipView]:
+        """Caller holds self._lock. Admission views under overcommit.
+
+        Per chip with physical ``total``, grant sum ``used`` and
+        best-effort (evictable) share ``reclaimable``:
+
+        - best-effort sees ``total' = int(total * oc)`` — it may borrow
+          idle HBM up to the overcommit bound;
+        - guaranteed/burstable see ``total' = used + max(0, headroom)``
+          with ``headroom = min(total - (used - reclaimable),
+          int(total * oc) - used)`` — reclaimable usage counts as free
+          (the pressure monitor evicts it), but never so much free that
+          an admission could push non-best-effort usage past ``total``
+          (the guaranteed invariant) or total usage past ``total * oc``
+          (the overcommit bound). Both hold AT admission time, so the
+          chaos monitor's every-instant assertions need no transient
+          grace window.
+
+        At ``oc == 1.0`` both cases reduce exactly to ``c.view()``;
+        callers gate on ``oc > 1.0`` so this never runs then.
+        """
+        views: list[ChipView] = []
+        for c in self.chips:
+            healthy = c.idx not in self._unhealthy
+            v = c.view(healthy=healthy)
+            cap = int(c.total_hbm_mib * oc)
+            if tier == TIER_BEST_EFFORT:
+                adj_total = cap
+            else:
+                headroom = min(
+                    c.total_hbm_mib
+                    - (v.used_hbm_mib - v.reclaimable_hbm_mib),
+                    cap - v.used_hbm_mib)
+                adj_total = v.used_hbm_mib + max(0, headroom)
+            views.append(ChipView(c.idx, c.coords, adj_total,
+                                  v.used_hbm_mib, healthy,
+                                  v.reclaimable_hbm_mib))
+        return views
+
+    def assume_qos(self, pod: dict[str, Any]) -> tuple[bool, str]:
+        """Filter-path fit check under the active overcommit factor —
+        the QoS branch's per-node replacement for :meth:`assume`. Falls
+        back to the legacy check when QoS is inactive (oc == 1.0) or
+        the request is whole-chip (overcommitting an exclusive chip is
+        meaningless)."""
+        req = request_from_pod(pod)
+        if req is None:
+            return True, ""
+        if self.chip_count == 0:
+            return False, "node has no TPU chips"
+        oc = effective_overcommit()
+        if oc <= 1.0 or req.hbm_mib <= 0:
+            return self.assume(pod)
+        with self._lock:
+            views = self._qos_views(oc, pod_tier(pod))
+        if fits(views, self.topology, req):
+            return True, ""
+        return False, no_fit_reason(req, self.name)
+
+    def pressure_victim(self) -> tuple[str, int, int,
+                                       tuple[int, int]] | None:
+        """One planned eviction for the pressure monitor: ``(pod key,
+        hbm_mib, chip idx, node stamp)`` naming the best-effort entry
+        whose eviction best relieves the most-oversubscribed chip.
+        None when no chip is under pressure.
+
+        Pressure = a chip's grant sum exceeds physical HBM *and*
+        non-best-effort usage is present (a purely best-effort chip
+        within the overcommit bound is the intended borrow state, not
+        pressure). The victim is the smallest entry clearing the whole
+        overage, else the largest available — fewest evictions first.
+        One victim per call: an eviction bumps the node stamp, so a
+        batch planned against one stamp would self-demote; the monitor
+        loops plan-evict-replan instead."""
+        with self._lock:
+            worst: tuple[int, ChipUsage] | None = None
+            for c in self.chips:
+                over = c.used_hbm_mib - c.total_hbm_mib
+                if over > 0 and \
+                        c.used_hbm_mib - c.reclaimable_hbm_mib > 0:
+                    if worst is None or over > worst[0]:
+                        worst = (over, c)
+            if worst is None:
+                return None
+            over, chip = worst
+            pool = chip.best_effort_entries()
+            if not pool:
+                return None  # only in-flight reservations: next scan
+            clearing = [e for e in pool if e[1] >= over]
+            key, hbm = min(clearing, key=lambda e: e[1]) if clearing \
+                else max(pool, key=lambda e: e[1])
+            return key, hbm, chip.idx, (self._epoch, self._version)
+
+    def qos_usage(self) -> dict[str, Any]:
+        """Per-node QoS accounting in one lock acquisition (the
+        /inspect/qos snapshot + the oversubscription gauge): per-tier
+        HBM grant sums, reclaimable HBM, and physical overage."""
+        with self._lock:
+            by_tier: dict[str, int] = {}
+            oversub = 0
+            reclaimable = 0
+            for c in self.chips:
+                for t, mib in c.tier_usage().items():
+                    by_tier[t] = by_tier.get(t, 0) + mib
+                oversub += max(0, c.used_hbm_mib - c.total_hbm_mib)
+                reclaimable += c.reclaimable_hbm_mib
+            return {
+                "by_tier_hbm_mib": by_tier,
+                "oversubscribed_hbm_mib": oversub,
+                "reclaimable_hbm_mib": reclaimable,
+                "total_hbm_mib": self.hbm_per_chip * self.chip_count,
+            }
+
     def allocate(
         self,
         pod: dict[str, Any],
@@ -564,15 +687,26 @@ class NodeInfo:
                     hint, req, req.chip_demand_mib(self.hbm_per_chip)):
                 placement = hint
             else:
-                views = [c.view(healthy=c.idx not in self._unhealthy)
-                         for c in self.chips]
+                oc = effective_overcommit()
+                if oc > 1.0 and req.hbm_mib > 0:
+                    # QoS admission views: best-effort sees capacity
+                    # stretched to total*oc; guaranteed/burstable see
+                    # best-effort (reclaimable) usage as headroom —
+                    # bounded so no admission can violate either the
+                    # non-best-effort <= total invariant or the
+                    # total <= total*oc overcommit bound (see _qos_views)
+                    views = self._qos_views(oc, pod_tier(pod))
+                else:
+                    views = [c.view(healthy=c.idx not in self._unhealthy)
+                             for c in self.chips]
                 placement = select_chips(views, self.topology, req)
             if placement is None:
                 raise AllocationError(
                     f"no placement for {podlib.pod_key(pod)} on {self.name}")
             demand = req.chip_demand_mib(self.hbm_per_chip)
+            tier = pod_tier(pod)
             for cid in placement.chip_ids:
-                self.chips[cid].reserve(key, demand)
+                self.chips[cid].reserve(key, demand, tier=tier)
             self._inflight.add(key)
             self._dirty()
         try:
@@ -697,10 +831,11 @@ class NodeInfo:
                     raise AllocationError(
                         f"planned chip {cid} on {self.name} can no "
                         f"longer hold {demand} MiB for {key}")
+            tier = pod_tier(pod)
             for cid in placement.chip_ids:
                 if planned_key is not None:
                     self.chips[cid].remove_reserved(planned_key)
-                self.chips[cid].reserve(key, demand)
+                self.chips[cid].reserve(key, demand, tier=tier)
             self._inflight.add(key)
             self._dirty()
         try:
@@ -1072,10 +1207,11 @@ class NodeInfo:
         if ids is None:
             return False
         key = podlib.pod_cache_key(pod)
+        tier = pod_tier(pod)
         with self._lock:
             for cid in ids:
                 if 0 <= cid < len(self.chips):
-                    self.chips[cid].add_pod(key, hbm)
+                    self.chips[cid].add_pod(key, hbm, tier=tier)
             self._dirty()
         return True
 
@@ -1094,11 +1230,14 @@ class NodeInfo:
         ids = contract.chip_ids_from_annotations(pod)
         hbm = contract.hbm_from_annotations(pod)
         key = podlib.pod_cache_key(pod)
+        tier = pod_tier(pod)
         with self._lock:
             if ids is not None:
                 wanted = {cid for cid in ids if 0 <= cid < len(self.chips)}
                 if len(wanted) == len(ids) and all(
-                        self.chips[cid].holds(key, hbm) for cid in wanted) \
+                        self.chips[cid].holds(key, hbm)
+                        and self.chips[cid].entry_tier(key) == tier
+                        for cid in wanted) \
                         and not any(c.has_pod(key) for c in self.chips
                                     if c.idx not in wanted):
                     # watch echo of occupancy we already hold — usually
@@ -1113,7 +1252,7 @@ class NodeInfo:
             if ids is not None:
                 for cid in ids:
                     if 0 <= cid < len(self.chips):
-                        self.chips[cid].add_pod(key, hbm)
+                        self.chips[cid].add_pod(key, hbm, tier=tier)
             self._dirty()
         return ids is not None
 
@@ -1152,11 +1291,15 @@ class NodeInfo:
                         # reserved-ness survives the rebuild: an
                         # in-flight (or gang-coordinator) reservation
                         # promoted to confirmed could never be released
-                        # by remove_reserved and would leak forever
+                        # by remove_reserved and would leak forever.
+                        # The QoS tier survives too, or a rebuild would
+                        # silently promote evictable best-effort usage
+                        # to unreclaimable burstable.
+                        tier = oc.entry_tier(uid)
                         if reserved:
-                            nc.reserve(uid, hbm)
+                            nc.reserve(uid, hbm, tier=tier)
                         else:
-                            nc.add_pod(uid, hbm)
+                            nc.add_pod(uid, hbm, tier=tier)
             self._dirty()
             return True
 
@@ -1195,7 +1338,8 @@ class NodeInfo:
                 pods = []
                 for uid in c.pod_uids:
                     entry: dict[str, Any] = {"uid": uid,
-                                             "hbm_mib": c.pod_hbm(uid)}
+                                             "hbm_mib": c.pod_hbm(uid),
+                                             "tier": c.entry_tier(uid)}
                     if pod_index and uid in pod_index:
                         p = pod_index[uid]
                         entry["name"] = podlib.pod_name(p)
@@ -1214,6 +1358,7 @@ class NodeInfo:
                     "coords": list(c.coords),
                     "total_hbm_mib": c.total_hbm_mib,
                     "used_hbm_mib": c.used_hbm_mib,
+                    "reclaimable_hbm_mib": c.reclaimable_hbm_mib,
                     "healthy": c.idx not in self._unhealthy,
                     "pods": pods,
                 })

@@ -20,7 +20,8 @@ from typing import Any, Callable
 
 from tpushare import contract
 from tpushare.contract import pod as podlib
-from tpushare.metrics import LabeledCounter
+from tpushare.metrics import Counter, LabeledCounter
+from tpushare.qos.tiers import TIER_BEST_EFFORT, pod_tier
 
 CHAOS_VIOLATIONS = LabeledCounter(
     "tpushare_chaos_invariant_violations_total",
@@ -29,6 +30,14 @@ CHAOS_VIOLATIONS = LabeledCounter(
     "HBM on apiserver truth). MUST stay 0 — nonzero is a real "
     "scheduler bug, not a chaos artifact",
     ("check",))
+
+QOS_GUARANTEED_VIOLATIONS = Counter(
+    "tpushare_qos_guaranteed_violations_total",
+    "Sampled instants where a chip's summed non-best-effort grants "
+    "exceeded its physical HBM on apiserver truth — a guaranteed/"
+    "burstable reservation backed by borrowed memory. MUST stay 0; "
+    "nonzero pages (docs/ops.md): QoS admission or the pressure "
+    "evictor is broken, not merely slow")
 
 
 def oversubscription(pods: list[dict[str, Any]], chip_hbm_mib: int
@@ -52,6 +61,47 @@ def oversubscription(pods: list[dict[str, Any]], chip_hbm_mib: int
         for c in ids:
             per[(node, c)] = per.get((node, c), 0) + hbm
     return [(k, v) for k, v in sorted(per.items()) if v > chip_hbm_mib]
+
+
+def qos_violations(pods: list[dict[str, Any]], chip_hbm_mib: int,
+                   overcommit: float
+                   ) -> tuple[list[tuple[tuple[str, int], int]],
+                              list[tuple[tuple[str, int], int]]]:
+    """Tier-aware per-chip checks over BOUND live pods.
+
+    Returns ``(guaranteed_violations, overcommit_violations)``:
+
+    - a *guaranteed violation* is a chip whose summed non-best-effort
+      grants exceed physical ``chip_hbm_mib`` — someone's reservation
+      is backed by borrowed memory;
+    - an *overcommit violation* is a chip whose TOTAL grant sum exceeds
+      ``chip_hbm_mib * overcommit`` — admission blew the declared
+      borrow bound.
+
+    The legacy :func:`oversubscription` checker would flag intended
+    best-effort borrowing (total > physical) as a violation, so QoS
+    drills use this pair instead; non-QoS drills keep the strict one.
+    """
+    total: dict[tuple[str, int], int] = {}
+    non_be: dict[tuple[str, int], int] = {}
+    for pod in pods:
+        if contract.is_complete_pod(pod):
+            continue
+        node = (pod.get("spec") or {}).get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        if not node or ids is None:
+            continue
+        hbm = contract.hbm_from_annotations(pod)
+        tier = pod_tier(pod)
+        for c in ids:
+            total[(node, c)] = total.get((node, c), 0) + hbm
+            if tier != TIER_BEST_EFFORT:
+                non_be[(node, c)] = non_be.get((node, c), 0) + hbm
+    bound = int(chip_hbm_mib * overcommit)
+    return (
+        [(k, v) for k, v in sorted(non_be.items()) if v > chip_hbm_mib],
+        [(k, v) for k, v in sorted(total.items()) if v > bound],
+    )
 
 
 class InvariantMonitor:
@@ -137,4 +187,77 @@ class InvariantMonitor:
                 "sample_errors": self._errors,
                 "oversubscription": list(self._violations),
                 "max_pending_age_s": self._max_pending_age_s,
+            }
+
+
+class QosInvariantMonitor:
+    """The tier-aware sampler for QoS drills: continuously asserts the
+    guaranteed-reservation invariant and the overcommit bound on
+    apiserver truth (:func:`qos_violations`), instead of the strict
+    total<=capacity check a non-overcommitted fleet uses. Same
+    lifecycle and verdict shape as :class:`InvariantMonitor`."""
+
+    def __init__(self, list_pods: Callable[[], list[dict[str, Any]]],
+                 chip_hbm_mib: int, overcommit: float, *,
+                 interval_s: float = 0.005) -> None:
+        self._list_pods = list_pods
+        self._chip_hbm_mib = chip_hbm_mib
+        self._overcommit = overcommit
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._guaranteed: list[tuple[tuple[str, int], int]] = []
+        self._overcommitted: list[tuple[tuple[str, int], int]] = []
+        self._samples = 0
+        self._errors = 0
+
+    def _sample(self) -> None:
+        try:
+            pods = self._list_pods()
+        except Exception:  # noqa: BLE001 — brownouts hit us too
+            with self._lock:
+                self._errors += 1
+            return
+        bad_g, bad_oc = qos_violations(pods, self._chip_hbm_mib,
+                                       self._overcommit)
+        with self._lock:
+            self._samples += 1
+            if bad_g:
+                self._guaranteed.extend(bad_g)
+            if bad_oc:
+                self._overcommitted.extend(bad_oc)
+        for _ in bad_g:
+            QOS_GUARANTEED_VIOLATIONS.inc()
+            CHAOS_VIOLATIONS.inc("qos_guaranteed")
+        for _ in bad_oc:
+            CHAOS_VIOLATIONS.inc("qos_overcommit_bound")
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._sample()
+            self._stop.wait(self._interval_s)
+
+    def start(self) -> "QosInvariantMonitor":
+        self._thread = threading.Thread(target=self._run,
+                                        name="qos-invariants",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict[str, Any]:
+        """Stop sampling, take one final sample, return the verdict."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._sample()
+        return self.report()
+
+    def report(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "samples": self._samples,
+                "sample_errors": self._errors,
+                "guaranteed_violations": list(self._guaranteed),
+                "overcommit_violations": list(self._overcommitted),
             }

@@ -1,0 +1,319 @@
+"""The QoS chaos drill: eviction under pressure, storms included.
+
+The hermetic drill (:mod:`tpushare.chaos.drill`) proves the strict
+no-oversubscription invariant on a single-class fleet. This drill
+proves the *tiered* contract on an oversubscribed one: best-effort
+scavengers borrow beyond physical HBM, guaranteed demand then lands on
+the borrowed chips, and the pressure monitor pays the debt down by
+evicting the borrowers — while a seeded fault schedule (NotReady
+window + apiserver brownout) storms the same fleet and a
+:class:`~tpushare.chaos.invariants.QosInvariantMonitor` samples
+apiserver truth continuously. The verdict it must return:
+
+- **zero guaranteed violations** at every sampled instant — no chip's
+  non-best-effort grant sum ever exceeds physical HBM;
+- **zero overcommit violations** — no chip's total grant sum ever
+  exceeds ``physical * overcommit``;
+- **borrowing actually happened** (chips over physical after the
+  best-effort fill) and **eviction actually fired** (completed
+  evictions >= 1, within the window budget) — a drill that never
+  oversubscribed or never evicted proved nothing;
+- **zero drift** between every surviving cache and apiserver truth
+  after healing.
+
+The same tiered contention is replayed through the discrete-event sim
+(:func:`tpushare.sim.qos.run_qos_sim`) by the tier-1 test, so the wind
+tunnel and the live stack are falsified against the same invariants.
+
+Deterministic in its *schedule* (seeded synth_faults + seeded retries);
+thread interleavings vary, which is the point.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any
+
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.chaos.conductor import ChaosConductor
+from tpushare.chaos.drill import HermeticFleet
+from tpushare.chaos.invariants import QosInvariantMonitor, qos_violations
+from tpushare.controller import Controller
+from tpushare.k8s import CircuitBreaker, FakeCluster, RetryPolicy, harden
+from tpushare.qos.pressure import QOS_EVICTIONS, QosPressureMonitor
+from tpushare.qos.tiers import (
+    ENV_OVERCOMMIT,
+    TIER_BEST_EFFORT,
+    TIER_GUARANTEED,
+    clear_degraded,
+)
+from tpushare.sim import FaultSpec, synth_faults
+
+HBM_PER_CHIP = 16000
+
+
+def _tier_pod(name: str, hbm: int, tier: str) -> dict[str, Any]:
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {contract.ANN_QOS_TIER: tier}},
+        "spec": {"containers": [{"name": "c0", "resources": {
+            "limits": {"aliyun.com/tpu-hbm": str(hbm)}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def _truth_oversubscribed(fc: FakeCluster) -> list[tuple]:
+    """Chips whose TOTAL grant sum on apiserver truth exceeds physical
+    HBM — the intended borrow state, counted as evidence that the drill
+    actually oversubscribed (NOT as a violation)."""
+    per: dict[tuple[str, int], int] = {}
+    for pod in fc.list_pods():
+        if contract.is_complete_pod(pod):
+            continue
+        node = (pod.get("spec") or {}).get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        if not node or ids is None:
+            continue
+        hbm = contract.hbm_from_annotations(pod)
+        for c in ids:
+            per[(node, c)] = per.get((node, c), 0) + hbm
+    return [(k, v) for k, v in sorted(per.items()) if v > HBM_PER_CHIP]
+
+
+def run_qos_drill(*, seed: int = 77, n_nodes: int = 2,
+                  overcommit: float = 2.0,
+                  evict_budget: int = 6, evict_window_s: float = 60.0,
+                  hours: float = 8.0, seconds_per_unit: float = 0.05,
+                  threads: int = 4) -> dict[str, Any]:
+    """One full tiered drill; returns the verdict for self-checks.
+
+    Phases (all while the seeded storm runs): best-effort scavengers
+    fill and oversubscribe the fleet; then guaranteed + burstable
+    demand arrives and must be admitted against reclaimable headroom,
+    triggering budget-governed pressure evictions of the borrowers.
+    """
+    prev_env = os.environ.get(ENV_OVERCOMMIT)
+    os.environ[ENV_OVERCOMMIT] = str(overcommit)
+    clear_degraded()
+    ev_before = {o: QOS_EVICTIONS.get(TIER_BEST_EFFORT, o)
+                 for o in ("completed", "failed", "demoted",
+                           "skipped_budget", "skipped_backoff",
+                           "skipped_inflight")}
+    try:
+        return _run(seed, n_nodes, overcommit, evict_budget,
+                    evict_window_s, hours, seconds_per_unit, threads,
+                    ev_before)
+    finally:
+        if prev_env is None:
+            os.environ.pop(ENV_OVERCOMMIT, None)
+        else:
+            os.environ[ENV_OVERCOMMIT] = prev_env
+        clear_degraded()
+
+
+def _run(seed, n_nodes, overcommit, evict_budget, evict_window_s,
+         hours, seconds_per_unit, threads, ev_before) -> dict[str, Any]:
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tpushare.extender.handlers import BindHandler, FilterHandler
+    from tpushare.extender.metrics import Registry
+
+    fc = FakeCluster()
+    names = [f"n{i}" for i in range(n_nodes)]
+    for n in names:
+        fc.add_tpu_node(n, chips=4, hbm_per_chip_mib=HBM_PER_CHIP,
+                        mesh="2x2")
+    cluster = harden(
+        fc,
+        breaker=CircuitBreaker(failure_threshold=4, reset_timeout_s=0.05),
+        policy=RetryPolicy(max_attempts=3, base_s=0.002, cap_s=0.01,
+                           rng=random.Random(seed)))
+    cache = SchedulerCache(cluster)
+    ctl = Controller(cluster, cache, resync_seconds=0.1)
+    ctl.build_cache()
+    ctl.start()
+    registry = Registry()
+    fil = FilterHandler(cache, registry)
+    binder = BindHandler(cache, cluster, registry)
+    pressure = QosPressureMonitor(cache, cluster, budget=evict_budget,
+                                  window_s=evict_window_s,
+                                  backoff_s=0.05, interval_s=0.01)
+    pressure.start()
+    qmon = QosInvariantMonitor(fc.list_pods, HBM_PER_CHIP, overcommit,
+                               interval_s=0.003).start()
+
+    # the governor's high-water mark, sampled from outside: the proof
+    # that an eviction storm stayed within its declared budget
+    max_window_used = [0]
+    sampler_stop = threading.Event()
+
+    def _sample_budget() -> None:
+        while not sampler_stop.is_set():
+            used = pressure.budget_state()["used_in_window"]
+            max_window_used[0] = max(max_window_used[0], used)
+            sampler_stop.wait(0.004)
+
+    sampler = threading.Thread(target=_sample_budget,
+                               name="qos-budget-sampler", daemon=True)
+    sampler.start()
+
+    # storm: one NotReady window + one apiserver brownout, seeded —
+    # the faults most likely to wedge an evictor (deletes 503) or
+    # stale a cache mid-admission
+    schedule = synth_faults(FaultSpec(
+        hours=hours, n_nodes=n_nodes, chips_per_node=4,
+        node_crashes=0, notready_windows=1, degradations=0,
+        brownouts=1, replica_crashes=0, replicas=1,
+        mean_outage=1.5, seed=seed))
+    conductor = ChaosConductor(HermeticFleet(fc, names, []),
+                               seconds_per_unit=seconds_per_unit)
+    applied: dict[str, int] = {}
+    storm = threading.Thread(
+        target=lambda: applied.update(conductor.run(schedule)),
+        name="qos-chaos-conductor", daemon=True)
+    storm.start()
+    storm_end = time.monotonic() + hours * seconds_per_unit + 10.0
+
+    def schedule_pod(pod: dict[str, Any]) -> bool:
+        ns, name = pod["metadata"]["namespace"], pod["metadata"]["name"]
+        attempt = 0
+        while time.monotonic() < storm_end:
+            try:
+                res = fil.handle({"Pod": pod, "NodeNames": names})
+                nodes = res["NodeNames"]
+                if nodes:
+                    out = binder.handle({
+                        "PodNamespace": ns, "PodName": name,
+                        "PodUID": pod["metadata"]["uid"],
+                        "Node": nodes[attempt % len(nodes)]})
+                    if out["Error"] == "":
+                        return True
+            except Exception:  # noqa: BLE001 — brownout races
+                pass
+            attempt += 1
+            time.sleep(0.004)
+        return False
+
+    # phase A: best-effort scavengers borrow beyond physical. 8 x
+    # 11000 MiB: binpack stacks two per chip (22000 > 16000 physical —
+    # the borrow state the invariant monitor must NOT flag), leaving
+    # 10000 MiB of under-the-bound headroom per borrowed chip that
+    # phase B's guaranteed demand can only claim by eviction.
+    be_pods = [fc.create_pod(_tier_pod(f"be-{i}", 11000,
+                                       TIER_BEST_EFFORT))
+               for i in range(8)]
+    with ThreadPoolExecutor(threads) as ex:
+        be_placed = sum(ex.map(schedule_pod, be_pods))
+    oversub_after_fill = _truth_oversubscribed(fc)
+
+    # phase B: guaranteed + burstable demand lands mid-storm — it must
+    # be admitted against reclaimable best-effort headroom, and every
+    # admission that pushes a chip past physical HBM must be paid down
+    # by a budget-governed eviction.
+    hi_pods = [fc.create_pod(_tier_pod(f"g-{i}", 8000, TIER_GUARANTEED))
+               for i in range(10)]
+    hi_pods += [fc.create_pod(_tier_pod(f"b-{i}", 4000, "burstable"))
+                for i in range(4)]
+    with ThreadPoolExecutor(threads) as ex:
+        hi_results = list(ex.map(schedule_pod, hi_pods))
+    storm.join(timeout=hours * seconds_per_unit + 30.0)
+
+    # healing: lift every fault, retry anything the storm stranded,
+    # let the evictor pay down any remaining pressure
+    fc.heal()
+    retried = [schedule_pod(hi_pods[i]) for i, ok in enumerate(hi_results)
+               if not ok]
+    hi_placed = sum(1 for ok in hi_results if ok) + \
+        sum(1 for ok in retried if ok)
+    settle_end = time.monotonic() + 5.0
+    while time.monotonic() < settle_end:
+        bad_g, _ = qos_violations(fc.list_pods(), HBM_PER_CHIP,
+                                  overcommit)
+        if not bad_g and pressure.scan_once() == 0:
+            break
+        time.sleep(0.02)
+
+    # drift audit: cache vs apiserver truth after healing
+    ctl.resync_once()
+    ctl.drain(timeout=10.0)
+    truth: dict[tuple[str, int], int] = {}
+    for pod in fc.list_pods():
+        if contract.is_complete_pod(pod):
+            continue
+        node = (pod.get("spec") or {}).get("nodeName")
+        ids = contract.chip_ids_from_annotations(pod)
+        if not node or ids is None:
+            continue
+        hbm = contract.hbm_from_annotations(pod)
+        for c in ids:
+            truth[(node, c)] = truth.get((node, c), 0) + hbm
+    drift: list[tuple] = []
+    for node in cache.describe()["nodes"]:
+        for chip in node["chips"]:
+            want = truth.get((node["name"], chip["idx"]), 0)
+            if chip["used_hbm_mib"] != want:
+                drift.append((node["name"], chip["idx"],
+                              chip["used_hbm_mib"], want))
+
+    sampler_stop.set()
+    sampler.join(timeout=2.0)
+    pressure.stop()
+    ctl.stop()
+    verdict = qmon.stop()
+    final_g, final_oc = qos_violations(fc.list_pods(), HBM_PER_CHIP,
+                                       overcommit)
+    evictions = {o: QOS_EVICTIONS.get(TIER_BEST_EFFORT, o) - before
+                 for o, before in ev_before.items()}
+    verdict.update({
+        "overcommit": overcommit,
+        "be_pods": len(be_pods),
+        "be_placed": be_placed,
+        "hi_pods": len(hi_pods),
+        "hi_placed": hi_placed,
+        "oversubscribed_after_fill": oversub_after_fill,
+        "evictions": evictions,
+        "evict_budget": evict_budget,
+        "max_window_evictions": max_window_used[0],
+        "budget_state": pressure.budget_state(),
+        "faults_applied": applied,
+        "faults_total": len(schedule),
+        "final_guaranteed_violations": final_g,
+        "final_overcommit_violations": final_oc,
+        "drift": drift,
+    })
+    return verdict
+
+
+def assert_qos_drill_invariants(r: dict[str, Any]) -> None:
+    """The self-checks the tier-1 test and bench share: guaranteed
+    isolation held at every sampled instant, borrowing and eviction
+    both actually happened, the eviction storm stayed within budget,
+    and the caches match truth after healing."""
+    assert r["samples"] > 0, "the monitor never sampled truth"
+    assert not r["guaranteed_violations"], \
+        f"guaranteed reservation violated: {r['guaranteed_violations'][:3]}"
+    assert not r["overcommit_violations"], \
+        f"overcommit bound blown: {r['overcommit_violations'][:3]}"
+    assert not r["final_guaranteed_violations"]
+    assert not r["final_overcommit_violations"]
+    assert r["oversubscribed_after_fill"], \
+        "the fill never oversubscribed; the drill proved nothing"
+    assert r["evictions"]["completed"] >= 1, \
+        "pressure never triggered an eviction"
+    assert r["max_window_evictions"] <= r["evict_budget"], \
+        (f"eviction storm blew its budget: {r['max_window_evictions']} "
+         f"> {r['evict_budget']}")
+    assert not r["drift"], \
+        f"cache != apiserver truth after healing: {r['drift'][:5]}"
+    assert r["be_placed"] >= 1
+    assert r["hi_placed"] == r["hi_pods"], \
+        f"{r['hi_pods'] - r['hi_placed']} guaranteed/burstable pods " \
+        "never bound"
+    injected = sum(v for k, v in r["faults_applied"].items()
+                   if k != "skipped")
+    assert injected > 0, "the storm injected nothing; it proved nothing"

@@ -20,6 +20,7 @@ from tpushare.contract.constants import (
     ANN_TOPOLOGY,
     ANN_TRACE_CONTEXT,
     ANN_NODE_CLAIMS,
+    ANN_QOS_TIER,
     ANN_GANG,
     ANN_GANG_PLAN,
     ANN_GANG_RANK,
@@ -32,6 +33,7 @@ from tpushare.contract.constants import (
     ENV_HBM_LIMIT,
     ENV_HBM_CHIP_TOTAL,
     ENV_MEM_FRACTION,
+    ENV_QOS_TIER,
     ENV_GANG_ID,
     ENV_GANG_SIZE,
     ENV_GANG_BOX,
@@ -78,10 +80,10 @@ from tpushare.contract.node import (
 __all__ = [
     "RESOURCE_HBM", "RESOURCE_COUNT",
     "ANN_CHIP_IDS", "ANN_HBM_POD", "ANN_HBM_CHIP", "ANN_ASSIGNED",
-    "ANN_ASSUME_TIME", "ANN_TOPOLOGY", "ANN_NODE_CLAIMS",
+    "ANN_ASSUME_TIME", "ANN_TOPOLOGY", "ANN_NODE_CLAIMS", "ANN_QOS_TIER",
     "LABEL_MESH", "LABEL_TPUSHARE_NODE",
     "ENV_VISIBLE_CHIPS", "ENV_HBM_LIMIT", "ENV_HBM_CHIP_TOTAL",
-    "ENV_MEM_FRACTION",
+    "ENV_MEM_FRACTION", "ENV_QOS_TIER",
     "ENV_GANG_ID", "ENV_GANG_SIZE", "ENV_GANG_BOX", "ENV_GANG_ORIGIN",
     "ENV_GANG_LOCAL_BOX", "ENV_GANG_LOCAL_ORIGIN",
     "ENV_GANG_MEMBER_ORIGIN", "ENV_NUM_PROCESSES",

@@ -50,6 +50,11 @@ ANN_TRACE_CONTEXT = _PREFIX + "trace-context"
 # bind to serialize same-node placements across HA replicas; see
 # NodeInfo._claim_chips.
 ANN_NODE_CLAIMS = _PREFIX + "claims"
+# QoS tier (tpushare/qos/tiers.py): "guaranteed" | "burstable" (the
+# default for unannotated pods — the legacy single class) |
+# "best-effort" (may oversubscribe idle HBM; first evicted under
+# pressure). Set by the workload author, consumed end to end.
+ANN_QOS_TIER = _PREFIX + "qos-tier"
 
 # -- multi-host gang (slice) placement (docs/designs/multihost-gang.md) ------
 # A gang is a SET of pods, one per participating host, linked by id. The
@@ -78,6 +83,10 @@ ENV_HBM_CHIP_TOTAL = "TPUSHARE_HBM_CHIP_TOTAL_MIB"
 # analogue of the TF per_process_gpu_memory_fraction guidance in the
 # reference's userguide.md:67-77:
 ENV_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+# The container's QoS tier, injected at Allocate so a workload (e.g. a
+# best-effort trainer) can self-select checkpoint cadence / preemption
+# handling without re-reading its own pod annotations:
+ENV_QOS_TIER = "TPUSHARE_QOS_TIER"
 
 # -- gang runtime env (injected at Allocate for gang members, r5) ------------
 # The scheduling half of a gang ends at the stamped plan annotations; the

@@ -29,6 +29,11 @@ class ChipView:
     total_hbm_mib: int
     used_hbm_mib: int = 0
     healthy: bool = True
+    # HBM held by best-effort-tier pods — evictable under pressure, so
+    # guaranteed/burstable admission may count it as headroom when the
+    # QoS overcommit knob is active (tpushare/qos/tiers.py). Zero on a
+    # fleet that never sets the tier annotation.
+    reclaimable_hbm_mib: int = 0
 
     @property
     def free_hbm_mib(self) -> int:
@@ -36,11 +41,13 @@ class ChipView:
 
     def with_used(self, used_hbm_mib: int) -> "ChipView":
         return ChipView(self.idx, self.coords, self.total_hbm_mib,
-                        used_hbm_mib, self.healthy)
+                        used_hbm_mib, self.healthy,
+                        self.reclaimable_hbm_mib)
 
     def with_healthy(self, healthy: bool) -> "ChipView":
         return ChipView(self.idx, self.coords, self.total_hbm_mib,
-                        self.used_hbm_mib, healthy)
+                        self.used_hbm_mib, healthy,
+                        self.reclaimable_hbm_mib)
 
 
 class ChipSnapshot(list):

@@ -320,11 +320,13 @@ class DefragPlanner:
         """Stamped NodeStates for every fragmented TPU node. A node
         whose audit and view snapshots carry different stamps mutated
         mid-read and is skipped — the next pass will see it settled."""
+        from tpushare.qos.tiers import effective_overcommit, pod_tier
+        qos_active = effective_overcommit() > 1.0
         cache = self._cache
         index = cache.index
         index.flush()
         states: list[NodeState] = []
-        for name, (_stamp, non_tpu, n_ge, contig_ge) \
+        for name, (_stamp, non_tpu, n_ge, contig_ge, _r_ge) \
                 in index.summaries_snapshot().items():
             if non_tpu:
                 continue
@@ -351,6 +353,11 @@ class DefragPlanner:
                 mode = self._movable_fn(pod)
                 req = request_from_pod(pod)
                 if mode is None or req is None:
+                    continue
+                if qos_active and pod_tier(pod) == "guaranteed":
+                    # An oversubscribing fleet never relocates a
+                    # guaranteed reservation — the contiguity a move
+                    # would buy accrues mostly to evictable borrowers.
                     continue
                 victims.append(Victim(
                     pod_key=key, chip_ids=tuple(sorted(ids)),

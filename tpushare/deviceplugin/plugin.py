@@ -43,6 +43,7 @@ from tpushare.contract.constants import (
 )
 from tpushare.k8s.client import ApiError
 from tpushare.k8s.informer import LISTER_REQUESTS
+from tpushare.qos.tiers import pod_tier
 from tpushare.k8s.singleflight import Singleflight
 from tpushare.metrics import LATENCY_BUCKETS, Histogram
 from tpushare.obs.trace import TRACER
@@ -615,6 +616,12 @@ class DevicePlugin:
             # bound XLA's preallocation to the grant (the analogue of the
             # reference's TF gpu-memory-fraction guidance, userguide.md:67-77)
             env[ENV_MEM_FRACTION] = f"{grant_mib / chip_total:.4f}"
+        # QoS tier (tpushare/qos/tiers.py): surfaced into the container
+        # so best-effort workloads can self-identify as evictable (e.g.
+        # checkpoint more aggressively). Annotation-derived, never
+        # trusted for enforcement — admission and eviction act on the
+        # scheduler's accounting, not on what the container sees.
+        env[contract.ENV_QOS_TIER] = pod_tier(chosen)
         devices = [by_idx[i].device_path for i in ids if i in by_idx]
         env.update(self._gang_env(chosen))
         log.info("allocate: pod %s/%s -> chips %s (%s MiB/chip)",

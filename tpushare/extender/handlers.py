@@ -36,6 +36,10 @@ from tpushare.k8s.singleflight import Singleflight
 from tpushare.k8s.stats import api_origin
 from tpushare.metrics import Counter, LabeledCounter
 from tpushare.obs.trace import TRACER
+from tpushare.qos.drf import admission_would_exceed, dominant_shares
+from tpushare.qos.tiers import ENV_DRF_CAP, pod_tier, tier_rank
+from tpushare.qos.tiers import effective_overcommit as \
+    qos_effective_overcommit
 
 log = logging.getLogger("tpushare.extender")
 
@@ -188,6 +192,21 @@ class FilterHandler:
         verdicts: dict[str, dict[str, Any]] = {}
         req = request_from_pod(pod)
         node_names = [n for n in node_names if n]
+        if req is not None and req.hbm_mib > 0:
+            oc = qos_effective_overcommit()
+            if oc > 1.0:
+                # QoS-active fleet (TPUSHARE_QOS_OVERCOMMIT > 1 and the
+                # evictor healthy): every tpushare pod takes the tiered
+                # per-candidate path — best-effort may borrow idle HBM
+                # up to total*oc, guaranteed/burstable count evictable
+                # best-effort usage as headroom. This deliberately
+                # bypasses memo/native/index/batcher/wirecache: those
+                # layers reason about PHYSICAL free HBM, and serving a
+                # tier-adjusted verdict from a tier-blind cache is how
+                # byte-honesty dies. At oc == 1.0 (the default) this
+                # branch never runs and the fast paths are untouched.
+                return self._filter_qos(pod, pod_key, req, node_names,
+                                        sp, audit)
         if req is not None and self._batcher is not None \
                 and self._batcher.enabled:
             # batched decision cycles: same-signature pods arriving
@@ -292,6 +311,52 @@ class FilterHandler:
             return wire.finish_filter(wire_ctx, wire_key, ok_nodes, failed,
                                       cacheable=cacheable,
                                       expected=wire_hit)
+        return {"NodeNames": ok_nodes, "FailedNodes": failed, "Error": ""}
+
+    def _filter_qos(self, pod: dict[str, Any], pod_key: str, req,
+                    node_names: list[str], sp, audit) -> dict[str, Any]:
+        """Tiered per-candidate Filter (QoS active, ISSUE 17): DRF
+        tenant cap first, then NodeInfo.assume_qos per candidate. Plain
+        dict return — no wirecache finish, no memoized placement hint
+        (Bind re-searches fresh under the node lock, where the same
+        tier-adjusted views are applied atomically)."""
+        tier = pod_tier(pod)
+        sp.set_tags(qos_tier=tier)
+        ok_nodes: list[str] = []
+        failed: dict[str, str] = {}
+        verdicts: dict[str, dict[str, Any]] = {}
+        ns = podlib.pod_namespace(pod)
+        if admission_would_exceed(self._cache, ns, req.chip_count,
+                                  req.hbm_mib * req.chip_count):
+            reason = (f"namespace {ns} dominant share (chips or HBM) "
+                      f"would exceed the tenant DRF cap ({ENV_DRF_CAP})")
+            for name in node_names:
+                failed[name] = reason
+                verdicts[name] = {"verdict": "rejected", "reason": reason,
+                                  "source": "qos-drf"}
+            audit(verdicts)
+            log.debug("filter %s: DRF cap rejection (ns=%s)",
+                      podlib.pod_key(pod), ns)
+            return {"NodeNames": [], "FailedNodes": failed, "Error": ""}
+        for name in node_names:
+            try:
+                info = self._cache.get_node_info(name)
+            except ApiError as e:
+                failed[name] = f"node unavailable: {e}"
+                verdicts[name] = {"verdict": "rejected",
+                                  "reason": failed[name], "source": "qos"}
+                continue
+            ok, reason = info.assume_qos(pod)
+            if ok:
+                ok_nodes.append(name)
+                verdicts[name] = {"verdict": "ok", "source": "qos"}
+            else:
+                failed[name] = reason
+                verdicts[name] = {"verdict": "rejected", "reason": reason,
+                                  "source": "qos"}
+        audit(verdicts)
+        log.debug("filter %s (qos tier=%s): %d ok / %d failed",
+                  podlib.pod_key(pod), tier, len(ok_nodes), len(failed))
         return {"NodeNames": ok_nodes, "FailedNodes": failed, "Error": ""}
 
 
@@ -480,22 +545,33 @@ class PreemptHandler:
         self._preempt_latency = registry.histogram(
             "tpushare_preempt_seconds", "Preempt latency", LATENCY_BUCKETS)
 
-    def _victim_order(self, victims: dict[str, Any],
-                      meta: bool) -> list[str]:
+    def _victim_order(self, victims: dict[str, Any], meta: bool,
+                      preemptor: dict[str, Any] | None = None
+                      ) -> list[str]:
         """Victim UIDs, cheapest eviction first.
 
         When every victim's priority resolves (full pods on the wire, or
-        UIDs found in the known-pods registry), sort lowest priority
-        first, stable within ties. When ANY victim is unresolvable (meta
-        form during controller watch lag), priority-sorting with a
-        guessed default could put a priority-100 pod ahead of a
-        priority-0 one — instead fall back to REVERSING the scheduler's
-        own list, which kube-scheduler builds highest-priority-first, so
-        reversed order is still cheapest-first without inventing
-        priorities.
+        UIDs found in the known-pods registry), sort by (QoS tier rank,
+        priority) — best-effort victims go before burstable before
+        guaranteed, lowest priority first within a tier, stable within
+        ties. When ``preemptor`` is given (only when shrinking is
+        allowed — see _handle), victims at a strictly HIGHER tier than
+        the preemptor are excluded outright: preemption escalates by
+        tier, and a best-effort pod must never cost a guaranteed pod
+        its reservation (ISSUE 17 isolation invariant). On a fleet that
+        never sets the tier annotation every pod is burstable, so
+        nothing is excluded and the order is exactly the legacy
+        priority order.
+
+        When ANY victim is unresolvable (meta form during controller
+        watch lag), sorting or tier-filtering with guessed defaults
+        could put a priority-100 pod ahead of a priority-0 one — instead
+        fall back to REVERSING the scheduler's own list, which
+        kube-scheduler builds highest-priority-first, so reversed order
+        is still cheapest-first without inventing priorities.
         """
         entries = (victims or {}).get("Pods") or []
-        cand: list[tuple[int, str]] = []
+        cand: list[tuple[int, int, str]] = []
         unresolved = False
         for p in entries:
             if meta:
@@ -508,14 +584,17 @@ class PreemptHandler:
                 continue
             if pobj is None:
                 unresolved = True
-                cand.append((0, uid))
+                cand.append((0, 0, uid))
                 continue
             prio = (pobj.get("spec") or {}).get("priority") or 0
-            cand.append((prio, uid))
+            cand.append((tier_rank(pod_tier(pobj)), prio, uid))
         if unresolved:
-            return [uid for _, uid in reversed(cand)]
-        cand.sort(key=lambda t: t[0])
-        return [uid for _, uid in cand]
+            return [uid for _, _, uid in reversed(cand)]
+        if preemptor is not None:
+            pr = tier_rank(pod_tier(preemptor))
+            cand = [t for t in cand if t[0] <= pr]
+        cand.sort(key=lambda t: (t[0], t[1]))
+        return [uid for _, _, uid in cand]
 
     @staticmethod
     def _tpu_only(pod: dict[str, Any]) -> bool:
@@ -563,7 +642,12 @@ class PreemptHandler:
         shrink = self._tpu_only(pod)
         result: dict[str, Any] = {}
         for node_name, victims in source.items():
-            order = self._victim_order(victims, meta_map is not None)
+            # tier exclusion rides the shrink gate: dropping a victim
+            # from the reply is only sound when this extender is allowed
+            # to edit the set at all (see class docstring)
+            order = self._victim_order(
+                victims, meta_map is not None,
+                preemptor=pod if shrink else None)
             try:
                 info = self._cache.get_node_info(node_name)
             except ApiError as e:
@@ -586,6 +670,13 @@ class PreemptHandler:
             # choice. Eviction is monotone for TPU fit, so the full set
             # still satisfies this extender's dimension.
             kept = subset if shrink and subset else order
+            if not kept and (victims or {}).get("Pods"):
+                # tier escalation excluded EVERY victim (all at a higher
+                # tier than the preemptor): an empty-victim reply would
+                # nominate the node and evict nobody, looping the pod
+                # Pending — drop the node instead
+                self._preempt_nodes_dropped.inc()
+                continue
             result[node_name] = {
                 "Pods": [{"UID": u} for u in kept],
                 "NumPDBViolations":
@@ -1036,6 +1127,45 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
         WIRE_NATIVE_PROBE_SECONDS, WIRE_NATIVE_SERVES)
     registry.register(WIRE_NATIVE_SERVES)
     registry.register(WIRE_NATIVE_PROBE_SECONDS)
+
+    # QoS tiers (tpushare/qos/, ISSUE 17): eviction outcomes, the
+    # guaranteed-isolation page counter, the borrowed-HBM gauge, and the
+    # per-tenant DRF dominant share. All flat zero / empty on a fleet
+    # with TPUSHARE_QOS_OVERCOMMIT unset.
+    from tpushare.chaos.invariants import QOS_GUARANTEED_VIOLATIONS
+    from tpushare.qos.pressure import QOS_EVICTIONS
+    registry.register(QOS_EVICTIONS)
+    registry.register(QOS_GUARANTEED_VIOLATIONS)
+
+    def qos_oversub() -> list[tuple[str, float]]:
+        out = []
+        for name in cache.node_names():
+            info = cache.peek_node(name)
+            if info is None:
+                continue
+            u = info.qos_usage()
+            out.append((f'{{node="{name}"}}',
+                        float(u["oversubscribed_hbm_mib"])))
+        return out
+
+    registry.gauge_func(
+        "tpushare_qos_oversubscribed_hbm_mib",
+        "Per-node HBM granted beyond physical chip capacity (borrowed "
+        "by best-effort pods under the QoS overcommit bound). Sustained "
+        "growth alongside rising eviction rate is a capacity incident "
+        "(docs/ops.md)",
+        qos_oversub)
+
+    def tenant_share() -> list[tuple[str, float]]:
+        return [(f'{{namespace="{ns}"}}', round(s, 6))
+                for ns, s in sorted(dominant_shares(cache).items())]
+
+    registry.gauge_func(
+        "tpushare_tenant_dominant_share",
+        "Per-namespace dominant-resource share of the fleet (max of "
+        "chips fraction and HBM fraction — the DRF coordinate the "
+        "TPUSHARE_QOS_DRF_CAP admission cap is enforced against)",
+        tenant_share)
     register_build_info(registry)
 
 

@@ -114,6 +114,13 @@ class ExtenderServer:
         self.defrag = DefragController(cache, cluster=cluster,
                                        explain=self.explain)
         self.defrag.attach(self.registry)
+        # QoS tiers (tpushare/qos/, ISSUE 17): the pressure monitor
+        # reclaims best-effort HBM when higher-tier demand lands on an
+        # oversubscribed chip, behind GET /inspect/qos. Its background
+        # thread only starts when TPUSHARE_QOS_OVERCOMMIT > 1 — a
+        # single-class fleet pays nothing.
+        from tpushare.qos.pressure import QosPressureMonitor
+        self.qos_pressure = QosPressureMonitor(cache, cluster)
         # multi-host gang placement (docs/designs/multihost-gang.md):
         # engages only for pods carrying the gang annotations, on nodes
         # labeled into slices — zero cost otherwise
@@ -331,6 +338,8 @@ class ExtenderServer:
             return _enc(200, self.gang.snapshot())
         if path in ("/inspect/wire", f"{PREFIX}/inspect/wire"):
             return _enc(200, self.wire_snapshot())
+        if path in ("/inspect/qos", f"{PREFIX}/inspect/qos"):
+            return _enc(200, self.qos_snapshot())
         if path in ("/inspect/ring", f"{PREFIX}/inspect/ring"):
             if self._sharding is not None:
                 return _enc(200, self._sharding.snapshot())
@@ -458,6 +467,9 @@ class ExtenderServer:
             self.fleetwatch.start()
         if self.defrag.enabled():
             self.defrag.start()
+        from tpushare.qos.tiers import overcommit
+        if overcommit() > 1.0:
+            self.qos_pressure.start()
 
     def start(self, http_workers: int | None = None) -> int:
         """Bind and serve on background threads; returns the bound port.
@@ -505,6 +517,7 @@ class ExtenderServer:
         self._serve_done.wait()
 
     def stop(self) -> None:
+        self.qos_pressure.stop()
         self.defrag.stop()
         self.fleetwatch.stop()
         if self._httpd:
@@ -514,6 +527,47 @@ class ExtenderServer:
         self.nativewire.close()
         if self._serve_done is not None:
             self._serve_done.set()
+
+    def qos_snapshot(self) -> dict:
+        """GET /inspect/qos: the QoS tier plane in one read — knobs and
+        their effective values, per-tier fleet usage, oversubscribed
+        nodes, the eviction budget/backoff/degraded state, and every
+        tenant's DRF dominant share (tpushare-inspect qos)."""
+        from tpushare.qos.drf import dominant_shares, drf_cap
+        from tpushare.qos.tiers import (
+            effective_overcommit, is_degraded, overcommit)
+        by_tier: dict[str, int] = {}
+        oversub_nodes: dict[str, int] = {}
+        reclaimable = 0
+        total = 0
+        for name in self._cache.node_names():
+            info = self._cache.peek_node(name)
+            if info is None:
+                continue
+            u = info.qos_usage()
+            for t, mib in u["by_tier_hbm_mib"].items():
+                by_tier[t] = by_tier.get(t, 0) + mib
+            if u["oversubscribed_hbm_mib"] > 0:
+                oversub_nodes[name] = u["oversubscribed_hbm_mib"]
+            reclaimable += u["reclaimable_hbm_mib"]
+            total += u["total_hbm_mib"]
+        return {
+            "overcommit": overcommit(),
+            "effective_overcommit": effective_overcommit(),
+            "evictor_degraded": is_degraded(),
+            "drf_cap": drf_cap(),
+            "fleet": {
+                "by_tier_hbm_mib": by_tier,
+                "reclaimable_hbm_mib": reclaimable,
+                "total_hbm_mib": total,
+                "oversubscribed_hbm_mib": sum(oversub_nodes.values()),
+            },
+            "oversubscribed_nodes": oversub_nodes,
+            "eviction": self.qos_pressure.budget_state(),
+            "tenant_dominant_share": {
+                ns: round(s, 6)
+                for ns, s in sorted(dominant_shares(self._cache).items())},
+        }
 
     def wire_snapshot(self) -> dict:
         """GET /inspect/wire: the whole wire plane in one read — Python

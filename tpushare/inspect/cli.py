@@ -8,6 +8,7 @@ the operator subcommands over the extender's diagnostic endpoints:
     tpushare-inspect ring              # /inspect/ring shard membership
     tpushare-inspect gang              # /inspect/gang planner snapshot
     tpushare-inspect wire              # /inspect/wire serve-path caches
+    tpushare-inspect qos               # /inspect/qos tier/eviction state
     tpushare-inspect explain [<pod>]   # /inspect/explain decision audit
     tpushare-inspect traces [-n N]     # /debug/traces flight recorder
 
@@ -334,6 +335,66 @@ def render_wire(snap: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_qos(snap: dict[str, Any]) -> str:
+    """Terminal rendering of the /inspect/qos tier-plane snapshot:
+    overcommit knobs and their effective values, per-tier fleet usage,
+    oversubscribed nodes, the eviction budget/backoff state, and each
+    tenant's DRF dominant share (docs/ops.md runbook surface)."""
+    lines: list[str] = []
+    oc = snap.get("overcommit", 1.0)
+    eff = snap.get("effective_overcommit", oc)
+    lines.append(
+        f"qos: overcommit {oc}"
+        + (f" (EFFECTIVE {eff}: evictor degraded — oversubscribed "
+           "admissions stopped)" if snap.get("evictor_degraded")
+           else (" (active)" if oc > 1.0 else " (off)"))
+        + f", DRF cap {snap.get('drf_cap', 1.0)}")
+    fleet = snap.get("fleet") or {}
+    by_tier = fleet.get("by_tier_hbm_mib") or {}
+    rows = [["TIER", "HBM USED (MiB)"]]
+    for tier in ("guaranteed", "burstable", "best-effort"):
+        if tier in by_tier:
+            rows.append([tier, str(by_tier[tier])])
+    for tier in sorted(set(by_tier) - {"guaranteed", "burstable",
+                                       "best-effort"}):
+        rows.append([tier, str(by_tier[tier])])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines.extend(_fmt_row(r, widths) for r in rows)
+    lines.append(
+        f"reclaimable (best-effort, evictable): "
+        f"{fleet.get('reclaimable_hbm_mib', 0)} MiB of "
+        f"{fleet.get('total_hbm_mib', 0)} MiB physical")
+    over = snap.get("oversubscribed_nodes") or {}
+    if over:
+        lines.append(f"oversubscribed nodes "
+                     f"({fleet.get('oversubscribed_hbm_mib', 0)} MiB "
+                     "borrowed beyond physical):")
+        for node, mib in sorted(over.items()):
+            lines.append(f"  {node}: {mib} MiB over")
+    else:
+        lines.append("no node oversubscribed")
+    ev = snap.get("eviction") or {}
+    lines.append("")
+    lines.append(
+        f"evictions: {ev.get('used_in_window', 0)}/{ev.get('budget', 0)} "
+        f"this {ev.get('window_s')} s window, "
+        f"{int(ev.get('consecutive_failures', 0))} consecutive failure(s)")
+    for key, label in (("backoff_nodes", "backoff"),
+                       ("inflight_nodes", "in flight")):
+        nodes = ev.get(key) or []
+        if nodes:
+            lines.append(f"  {label}: {', '.join(nodes)}")
+    shares = snap.get("tenant_dominant_share") or {}
+    lines.append("")
+    if shares:
+        lines.append("tenant dominant shares (DRF):")
+        for ns, s in sorted(shares.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {ns}: {100.0 * s:.1f}%")
+    else:
+        lines.append("no tenant usage")
+    return "\n".join(lines)
+
+
 def render_traces(dump: dict[str, Any], limit: int | None = None) -> str:
     """Terminal rendering of the /debug/traces flight recorder."""
     lines: list[str] = []
@@ -368,7 +429,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="traces: show at most N traces")
     ap.add_argument("target", nargs="*", default=[],
                     help="node name, or a subcommand: 'fleet', 'defrag', "
-                         "'ring', 'gang', 'wire', 'explain [pod]', "
+                         "'ring', 'gang', 'wire', 'qos', 'explain [pod]', "
                          "'traces'")
     args = ap.parse_args(argv)
     cmd = args.target[0] if args.target else None
@@ -397,6 +458,11 @@ def main(argv: list[str] | None = None) -> int:
             snap = fetch_path(args.endpoint, "/inspect/wire")
             print(json.dumps(snap, indent=2) if args.json
                   else render_wire(snap))
+            return 0
+        if cmd == "qos":
+            snap = fetch_path(args.endpoint, "/inspect/qos")
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_qos(snap))
             return 0
         if cmd == "explain":
             path = "/inspect/explain"

@@ -284,11 +284,13 @@ class FleetWatch:
         sched = [0] * n_tiers
         contig = [0] * n_tiers
         stranded = [0] * n_tiers
+        reclaim = [0] * n_tiers
         per_node: list[dict[str, Any]] = []
         used_mib = 0
         total_mib = 0
         covered = 0
-        for name, (_stamp, non_tpu, n_ge, contig_ge) in summaries.items():
+        for name, (_stamp, non_tpu, n_ge, contig_ge,
+                   r_ge) in summaries.items():
             info = self._cache.peek_node(name)
             if info is None or non_tpu:
                 continue
@@ -302,6 +304,10 @@ class FleetWatch:
                 sched[ti] += n_ge[ti]
                 contig[ti] += contig_ge[ti]
                 stranded[ti] += gaps[ti]
+                # chips schedulable at the tier only AFTER evicting
+                # their best-effort borrowers (tpushare/qos/): 0
+                # everywhere on a single-class fleet
+                reclaim[ti] += r_ge[ti] - n_ge[ti]
             if gaps[worst_t] > 0:
                 per_node.append({
                     "node": name,
@@ -323,6 +329,7 @@ class FleetWatch:
                     "schedulable_chips": sched[ti],
                     "contiguous_chips": contig[ti],
                     "stranded_hbm_mib": stranded[ti],
+                    "reclaimable_chips": reclaim[ti],
                 } for ti in range(n_tiers)},
             "fragmented_nodes": len(per_node),
             "top_fragmented": per_node[:self.TOP_K],

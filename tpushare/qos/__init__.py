@@ -1,0 +1,42 @@
+"""QoS tiers: guaranteed / burstable / best-effort HBM classes.
+
+Three submodules, layered so imports stay acyclic:
+
+- :mod:`tpushare.qos.tiers` — the tier vocabulary (annotation parsing,
+  rank order, overcommit knobs). Imports only ``tpushare.contract``;
+  the cache layer imports it freely.
+- :mod:`tpushare.qos.drf` — per-tenant dominant-resource shares over
+  (chips x HBM) and the namespace cap.
+- :mod:`tpushare.qos.pressure` — the pressure monitor that evicts
+  best-effort victims from physically oversubscribed chips. Imports
+  the cache layer, so nothing below the extender may import it; it is
+  deliberately NOT re-exported here.
+"""
+
+from tpushare.qos.drf import dominant_shares, drf_cap, tenant_usage
+from tpushare.qos.tiers import (
+    TIER_BEST_EFFORT,
+    TIER_BURSTABLE,
+    TIER_GUARANTEED,
+    TIER_RANK,
+    TIERS,
+    effective_overcommit,
+    overcommit,
+    pod_tier,
+    tier_rank,
+)
+
+__all__ = [
+    "TIER_BEST_EFFORT",
+    "TIER_BURSTABLE",
+    "TIER_GUARANTEED",
+    "TIER_RANK",
+    "TIERS",
+    "dominant_shares",
+    "drf_cap",
+    "effective_overcommit",
+    "overcommit",
+    "pod_tier",
+    "tenant_usage",
+    "tier_rank",
+]

@@ -1,0 +1,110 @@
+"""Per-tenant dominant-resource fairness over (chips x HBM).
+
+DRF (Ghodsi et al., NSDI'11) generalizes max-min fairness to multiple
+resource types: a tenant's *dominant share* is the larger of its
+fractional claims on the fleet's two scarce resources — chips occupied
+and HBM reserved. The cap (``TPUSHARE_QOS_DRF_CAP``, a fraction in
+(0, 1]; 1.0 = off, the default) bounds any one namespace's dominant
+share: an admission that would push a tenant past the cap is rejected
+in the QoS filter branch, so a single namespace cannot monopolize the
+fleet however it mixes wide-and-shallow (many chips, little HBM) with
+narrow-and-deep (few chips, huge HBM) pods.
+
+Tenancy is the pod's namespace — the one identity the scheduler always
+has, already a Kubernetes isolation boundary, and low-cardinality
+enough to be a metric label (``tpushare_tenant_dominant_share``).
+
+Usage is read from each node's ``audit_snapshot()`` (confirmed grants
+only — in-flight reservations are the caller's concern) and attributed
+via ``cache.pod_by_key``; keys the cache no longer knows fall back to
+their ``ns/name`` spelling, so a just-deleted pod cannot unattribute
+its residual accounting mid-scan.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+def drf_cap() -> float:
+    """The dominant-share cap per namespace. 1.0 (default) disables
+    enforcement; values outside (0, 1] are treated as disabled."""
+    from tpushare.qos.tiers import ENV_DRF_CAP
+    raw = os.environ.get(ENV_DRF_CAP, "") or "1.0"
+    try:
+        cap = float(raw)
+    except ValueError:
+        return 1.0
+    return cap if 0.0 < cap <= 1.0 else 1.0
+
+
+def _key_namespace(cache: Any, key: str) -> str:
+    pod = cache.pod_by_key(key) if cache is not None else None
+    if isinstance(pod, dict):
+        ns = (pod.get("metadata") or {}).get("namespace")
+        if ns:
+            return str(ns)
+    return key.split("/", 1)[0] if "/" in key else "default"
+
+
+def tenant_usage(cache: Any) -> dict[str, dict[str, float]]:
+    """Per-namespace ``{"chips": n, "hbm_mib": m}`` plus the fleet
+    totals under the ``"_fleet"`` pseudo-tenant. Chips count once per
+    (node, chip) a tenant touches, however many of its pods share it."""
+    totals_chips = 0
+    totals_hbm = 0
+    tenants: dict[str, dict[str, float]] = {}
+    tenant_chips: dict[str, set[tuple[str, int]]] = {}
+    for name in cache.node_names():
+        info = cache.peek_node(name)
+        if info is None:
+            continue
+        _, node_total = info.hbm_usage()
+        totals_hbm += node_total
+        _, per_chip = info.audit_snapshot()
+        totals_chips += len(info.chips)
+        for cid, entries in enumerate(per_chip):
+            for key, hbm in entries.items():
+                ns = _key_namespace(cache, key)
+                t = tenants.setdefault(ns, {"chips": 0.0, "hbm_mib": 0.0})
+                t["hbm_mib"] += hbm
+                tenant_chips.setdefault(ns, set()).add((name, cid))
+    for ns, chips in tenant_chips.items():
+        tenants[ns]["chips"] = float(len(chips))
+    tenants["_fleet"] = {"chips": float(totals_chips),
+                         "hbm_mib": float(totals_hbm)}
+    return tenants
+
+
+def dominant_shares(cache: Any) -> dict[str, float]:
+    """``{namespace: dominant share in [0, 1]}`` for every namespace
+    with any confirmed grant. Empty fleet -> empty dict."""
+    usage = tenant_usage(cache)
+    fleet = usage.pop("_fleet")
+    if fleet["chips"] <= 0 or fleet["hbm_mib"] <= 0:
+        return {}
+    return {
+        ns: max(t["chips"] / fleet["chips"],
+                t["hbm_mib"] / fleet["hbm_mib"])
+        for ns, t in usage.items()
+    }
+
+
+def admission_would_exceed(cache: Any, namespace: str,
+                           add_chips: int, add_hbm_mib: int,
+                           cap: float | None = None) -> bool:
+    """Would granting ``namespace`` another ``add_chips`` chips /
+    ``add_hbm_mib`` MiB push its dominant share past the cap? Always
+    False when the cap is disabled (1.0) or fleet totals are zero."""
+    cap = drf_cap() if cap is None else cap
+    if cap >= 1.0:
+        return False
+    usage = tenant_usage(cache)
+    fleet = usage.pop("_fleet")
+    if fleet["chips"] <= 0 or fleet["hbm_mib"] <= 0:
+        return False
+    t = usage.get(namespace, {"chips": 0.0, "hbm_mib": 0.0})
+    share = max((t["chips"] + add_chips) / fleet["chips"],
+                (t["hbm_mib"] + add_hbm_mib) / fleet["hbm_mib"])
+    return share > cap

@@ -1,0 +1,293 @@
+"""Pressure-driven eviction: reclaim best-effort HBM from chips that a
+guaranteed/burstable bind has pushed past physical capacity.
+
+Oversubscription is an *admission-time* promise ("best-effort work may
+borrow idle HBM") that becomes a *runtime* debt the moment higher-tier
+demand lands on the borrowed chip: the chip's grant sum now exceeds its
+physical HBM, and only evicting best-effort borrowers pays it down.
+This monitor is the collector. It scans the cache for chips where
+``used > total`` with non-best-effort usage present (pure best-effort
+overcommit below the bound is the intended state, not pressure) and
+deletes best-effort victims until the chip is physically whole again.
+
+Every defense the defrag executor earned is reused verbatim:
+
+1. **Budget governor** — ``TPUSHARE_QOS_EVICT_BUDGET`` evictions per
+   ``TPUSHARE_QOS_EVICT_WINDOW_S`` rolling window, one in-flight
+   eviction per node, per-node backoff (``TPUSHARE_QOS_EVICT_BACKOFF_S``)
+   after a failure. An eviction storm is bounded disruption, never a
+   cascade.
+2. **Stamp revalidation** — the victim is planned under the node lock
+   against the node's ``(epoch, counter)`` stamp; immediately before
+   the delete, the live stamp is compared and the victim's identity
+   (still cached, still bound here, still best-effort) re-checked. Any
+   mismatch demotes the eviction un-executed; the next scan re-derives
+   it from fresh state. One victim is planned per pass — an eviction
+   bumps the stamp, so batching victims against one stamp would
+   self-demote.
+3. **Graceful degradation** — ``_FAILURE_LATCH_N`` consecutive delete
+   transport failures latch the evictor-degraded flag
+   (:func:`tpushare.qos.tiers.set_degraded`): ``effective_overcommit``
+   collapses to 1.0, oversubscribed admissions stop fleet-wide, and
+   guaranteed/burstable admissions continue on the unchanged legacy
+   path. The first successful delete clears the latch.
+
+``self._lock`` guards ONLY budget/backoff/in-flight/pressure-note
+bookkeeping and is NEVER held across an eviction, a node lock, or a
+solve — leftmost in the lock order (tests/test_lock_order_lint.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from tpushare.metrics import LabeledCounter
+from tpushare.qos.tiers import clear_degraded, set_degraded
+
+log = logging.getLogger("tpushare.qos")
+
+# eviction outcomes are a CLOSED enum (label cardinality):
+#   completed       — victim deleted and un-accounted; HBM reclaimed
+#   failed          — the delete raised; node enters backoff
+#   demoted         — the node's stamp (or the victim's identity) moved
+#                     between planning and eviction; nothing was touched
+#   skipped_budget  — the window's eviction budget is spent
+#   skipped_backoff — the node is in post-failure backoff
+#   skipped_inflight— the node already has an eviction in flight
+QOS_EVICTIONS = LabeledCounter(
+    "tpushare_qos_evictions_total",
+    "Pressure-driven best-effort evictions by tier and outcome "
+    "(completed / failed / demoted / skipped_budget / skipped_backoff / "
+    "skipped_inflight). Sustained growth of 'completed' is a capacity "
+    "incident — guaranteed demand is routinely landing on borrowed HBM "
+    "(docs/ops.md); sustained 'failed' latches the evictor-degraded "
+    "flag and stops oversubscribed admissions",
+    ("tier", "outcome"))
+
+_FAILURE_LATCH_N = 3
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class QosPressureMonitor:
+    """Scans for physically oversubscribed chips and evicts best-effort
+    victims under the defrag executor's budget/backoff/stamp regime."""
+
+    def __init__(self, cache, cluster,
+                 budget: int | None = None,
+                 window_s: float | None = None,
+                 backoff_s: float | None = None,
+                 interval_s: float = 2.0,
+                 time_fn: Callable[[], float] = time.monotonic) -> None:
+        self._cache = cache
+        self._cluster = cluster
+        self.interval_s = interval_s
+        self._time = time_fn
+        self.budget = int(_env_float("TPUSHARE_QOS_EVICT_BUDGET", 4)) \
+            if budget is None else budget
+        self.window_s = _env_float("TPUSHARE_QOS_EVICT_WINDOW_S", 60.0) \
+            if window_s is None else window_s
+        self.backoff_s = _env_float("TPUSHARE_QOS_EVICT_BACKOFF_S", 120.0) \
+            if backoff_s is None else backoff_s
+        # guards ONLY the bookkeeping below; never held across an
+        # eviction, a node lock or a solve (lock-order: leftmost)
+        self._lock = threading.Lock()
+        self._window_started: float | None = None
+        self._window_used = 0
+        self._backoff: dict[str, float] = {}   # node -> retry-after time
+        self._inflight: set[str] = set()       # nodes with an evict running
+        self._notes: set[str] = set()          # nodes prodded by admission
+        self._consecutive_failures = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- pressure notes -------------------------------------------------------
+
+    def note_pressure(self, node_name: str) -> None:
+        """Admission saw (or caused) pressure on this node: scan it at
+        the front of the next pass instead of waiting a full sweep."""
+        with self._lock:
+            self._notes.add(node_name)
+
+    def _drain_notes(self) -> list[str]:
+        with self._lock:
+            notes = sorted(self._notes)
+            self._notes.clear()
+        return notes
+
+    # -- budget governor (the defrag executor's, verbatim) --------------------
+
+    def budget_state(self) -> dict[str, Any]:
+        now = self._time()
+        with self._lock:
+            remaining = None
+            if self._window_started is not None:
+                remaining = max(
+                    self.window_s - (now - self._window_started), 0.0)
+            return {
+                "budget": self.budget,
+                "window_s": self.window_s,
+                "used_in_window": self._window_used,
+                "window_remaining_s": round(remaining, 3)
+                if remaining is not None else None,
+                "backoff_nodes": sorted(
+                    n for n, t in self._backoff.items() if t > now),
+                "inflight_nodes": sorted(self._inflight),
+                "consecutive_failures": self._consecutive_failures,
+            }
+
+    def _admit(self, node_name: str) -> str | None:
+        """Budget/backoff/in-flight gate; returns the skip outcome or
+        None (admitted — the window slot is consumed and the node is
+        marked in flight)."""
+        now = self._time()
+        with self._lock:
+            if self._window_started is None \
+                    or now - self._window_started >= self.window_s:
+                self._window_started = now
+                self._window_used = 0
+            if self._window_used >= self.budget:
+                return "skipped_budget"
+            if self._backoff.get(node_name, 0.0) > now:
+                return "skipped_backoff"
+            if node_name in self._inflight:
+                return "skipped_inflight"
+            self._window_used += 1
+            self._inflight.add(node_name)
+            return None
+
+    def _settle(self, node_name: str, failed: bool) -> None:
+        now = self._time()
+        with self._lock:
+            self._inflight.discard(node_name)
+            if failed:
+                self._backoff[node_name] = now + self.backoff_s
+            # drop expired entries so the map cannot grow unboundedly
+            self._backoff = {n: t for n, t in self._backoff.items()
+                             if t > now}
+
+    # -- degraded latch -------------------------------------------------------
+
+    def _record_transport(self, failed: bool) -> None:
+        with self._lock:
+            if failed:
+                self._consecutive_failures += 1
+                n = self._consecutive_failures
+            else:
+                self._consecutive_failures = 0
+                n = 0
+        if failed and n >= _FAILURE_LATCH_N:
+            if n == _FAILURE_LATCH_N:
+                log.warning(
+                    "qos: %d consecutive eviction failures — latching "
+                    "degraded (oversubscribed admissions stop)", n)
+            set_degraded()
+        elif not failed:
+            clear_degraded()
+
+    # -- one eviction, three defenses -----------------------------------------
+
+    def _evict_one(self, node_name: str) -> str | None:
+        """Plan and execute at most one eviction on this node. Returns
+        the outcome, or None when the node shows no pressure."""
+        from tpushare.contract import pod as podlib
+        from tpushare.qos.tiers import TIER_BEST_EFFORT, pod_tier
+        info = self._cache.peek_node(node_name)
+        if info is None:
+            return None
+        plan = info.pressure_victim()
+        if plan is None:
+            return None
+        key, hbm, chip, stamp = plan
+        outcome = self._admit(node_name)
+        if outcome is not None:
+            QOS_EVICTIONS.inc(TIER_BEST_EFFORT, outcome)
+            return outcome
+        failed_transport = False
+        try:
+            # stamp + identity revalidation: the plan is speculative
+            live = self._cache.peek_node(node_name)
+            pod = self._cache.pod_by_key(key)
+            if live is None or live.version != stamp \
+                    or pod is None \
+                    or podlib.pod_node_name(pod) != node_name \
+                    or pod_tier(pod) != TIER_BEST_EFFORT:
+                outcome = "demoted"
+                return outcome
+            ns, name = podlib.pod_namespace(pod), podlib.pod_name(pod)
+            try:
+                self._cluster.delete_pod(ns, name)
+            except Exception as e:  # noqa: BLE001 — transport, not logic
+                failed_transport = True
+                outcome = "failed"
+                log.warning("qos: evicting %s from %s/%d failed: %s",
+                            key, node_name, chip, e)
+                return outcome
+            self._cache.remove_pod(pod)
+            outcome = "completed"
+            log.info("qos: evicted best-effort %s (%d MiB) from %s/%d "
+                     "under pressure", key, hbm, node_name, chip)
+            return outcome
+        finally:
+            self._settle(node_name, failed=outcome == "failed")
+            self._record_transport(failed_transport)
+            QOS_EVICTIONS.inc(TIER_BEST_EFFORT, outcome)
+
+    def scan_node(self, node_name: str, max_evictions: int = 16) -> int:
+        """Evict until this node shows no pressure, a skip outcome
+        stops progress, or ``max_evictions`` is hit. Returns completed
+        eviction count."""
+        done = 0
+        for _ in range(max_evictions):
+            outcome = self._evict_one(node_name)
+            if outcome is None:
+                break
+            if outcome != "completed":
+                break
+            done += 1
+        return done
+
+    def scan_once(self) -> int:
+        """One full pass: prodded nodes first, then the whole fleet.
+        Returns completed eviction count."""
+        done = 0
+        seen: set[str] = set()
+        for name in self._drain_notes():
+            seen.add(name)
+            done += self.scan_node(name)
+        for name in self._cache.node_names():
+            if name not in seen:
+                done += self.scan_node(name)
+        return done
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scan_once()
+            except Exception:  # noqa: BLE001 — the monitor must outlive
+                log.exception("qos: pressure scan failed; continuing")
+
+    def start(self) -> "QosPressureMonitor":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="qos-pressure", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,110 @@
+"""The QoS tier vocabulary: annotation parsing, rank order, overcommit.
+
+Three tiers, ranked for eviction/preemption purposes:
+
+==============  ====  =====================================================
+tier            rank  semantics
+==============  ====  =====================================================
+``guaranteed``     2  hard HBM reservation — NEVER violated at any
+                      sampled instant on apiserver truth (the QoS
+                      invariant monitor pages on it)
+``burstable``      1  the legacy single-class behavior; every pod
+                      without a tier annotation lands here, so a fleet
+                      that never sets the annotation behaves byte-for-
+                      byte as before this subsystem existed
+``best-effort``    0  may be admitted into idle guaranteed/burstable
+                      headroom beyond a chip's physical HBM (bounded by
+                      ``TPUSHARE_QOS_OVERCOMMIT``); first evicted when
+                      higher-tier demand arrives
+==============  ====  =====================================================
+
+Everything here is pure functions over pod dicts + env knobs; the only
+import is ``tpushare.contract`` so the cache layer (nodeinfo, chipusage)
+can use it without cycles.
+
+The master gate is :func:`effective_overcommit`: when it returns 1.0
+(the library default — the chart ships 1.25) every QoS code path in the
+scheduler collapses to the legacy behavior. It also consults the
+evictor-degraded latch (set by the pressure monitor after consecutive
+eviction-transport failures): a dead evictor means oversubscribed
+admissions must stop — admitting reclaimable work nobody can reclaim
+converts "best-effort slowdown" into "guaranteed violation" — while
+guaranteed/burstable admissions continue on the unchanged legacy path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+from tpushare import contract
+
+TIER_GUARANTEED = "guaranteed"
+TIER_BURSTABLE = "burstable"
+TIER_BEST_EFFORT = "best-effort"
+
+TIER_RANK: dict[str, int] = {
+    TIER_BEST_EFFORT: 0,
+    TIER_BURSTABLE: 1,
+    TIER_GUARANTEED: 2,
+}
+TIERS: tuple[str, ...] = (TIER_BEST_EFFORT, TIER_BURSTABLE,
+                          TIER_GUARANTEED)
+
+ENV_OVERCOMMIT = "TPUSHARE_QOS_OVERCOMMIT"
+ENV_DRF_CAP = "TPUSHARE_QOS_DRF_CAP"
+
+
+def pod_tier(pod: dict[str, Any] | None) -> str:
+    """The pod's QoS tier from its annotation; unannotated (or
+    unparseable) pods are ``burstable`` — the legacy class."""
+    if not isinstance(pod, dict):
+        return TIER_BURSTABLE
+    ann = (pod.get("metadata") or {}).get("annotations") or {}
+    raw = str(ann.get(contract.ANN_QOS_TIER, "")).strip().lower()
+    return raw if raw in TIER_RANK else TIER_BURSTABLE
+
+
+def tier_rank(tier: str) -> int:
+    """Eviction order rank; unknown strings rank as burstable."""
+    return TIER_RANK.get(tier, TIER_RANK[TIER_BURSTABLE])
+
+
+def overcommit() -> float:
+    """The configured overcommit factor (>= 1.0). 1.0 — the library
+    default — disables oversubscription entirely."""
+    raw = os.environ.get(ENV_OVERCOMMIT, "") or "1.0"
+    try:
+        oc = float(raw)
+    except ValueError:
+        return 1.0
+    return oc if oc >= 1.0 else 1.0
+
+
+# -- evictor-degraded latch ---------------------------------------------------
+# Module-level so the pressure monitor (which owns setting it) and the
+# admission path (which only reads it) need no object plumbing between
+# the extender layer and the cache layer. threading.Event is atomic;
+# no lock order to classify.
+_degraded = threading.Event()
+
+
+def set_degraded() -> None:
+    """Evictor transport is down: stop oversubscribed admissions."""
+    _degraded.set()
+
+
+def clear_degraded() -> None:
+    _degraded.clear()
+
+
+def is_degraded() -> bool:
+    return _degraded.is_set()
+
+
+def effective_overcommit() -> float:
+    """The overcommit factor admission must honor RIGHT NOW: the
+    configured knob, degraded to 1.0 while the evictor latch is set.
+    Every QoS branch in the scheduler gates on ``> 1.0`` of this."""
+    return 1.0 if _degraded.is_set() else overcommit()

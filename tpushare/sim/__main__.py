@@ -110,6 +110,14 @@ def _build_parser() -> argparse.ArgumentParser:
                          "scorecard + tolerance bands to "
                          "tests/data/wind_tunnel_golden.json "
                          "(deliberate act; see docs/ops.md)")
+    sg.add_argument("--qos", action="store_true",
+                    help="tiered QoS mode: replay the standard tiered "
+                         "diurnal mix under the overcommit sweep "
+                         "(1.0/1.1/1.25/1.5) — scorecard, evictions, "
+                         "and the zero-violation isolation proof per "
+                         "point (tpushare/sim/qos.py); with --pin, "
+                         "re-baseline the tier-1 QoS gate golden "
+                         "tests/data/qos_wind_tunnel_golden.json")
     sg.add_argument("--defrag", action="store_true",
                     help="repack-rebalancer mode: replay a churn trace "
                          "through the defrag planner core, sweeping the "
@@ -197,9 +205,18 @@ def _run(ap, args, emit) -> int:
         ap.error("engine knobs (--batch-window/--index-scheme/"
                  "--eqclass-lru/--defrag-budget/--defrag-period/"
                  "--scatter-util-pct) require --engine native")
-    if args.pin and not args.autotune:
-        ap.error("--pin re-baselines the autotune gate: it requires "
-                 "--autotune")
+    if args.pin and not (args.autotune or args.qos):
+        ap.error("--pin re-baselines a pinned gate: it requires "
+                 "--autotune or --qos")
+
+    if args.qos:
+        from tpushare.sim import qos
+        out = qos.overcommit_sweep()
+        if args.pin:
+            out["golden"] = qos.pin_qos_golden()
+            out["golden_path"] = qos.QOS_GOLDEN_PATH
+        emit(out)
+        return 0
 
     if args.autotune:
         # the sweep owns its workload and fleet so the winners table —

@@ -1,0 +1,436 @@
+"""Tiered QoS wind tunnel: HBM oversubscription under eviction pressure.
+
+The classic wind tunnel treats every pod as one class; this module
+replays the same discrete-event loop with the QoS subsystem's admission
+arithmetic (``NodeInfo._qos_views``) and eviction policy
+(``NodeInfo.pressure_victim`` + the pressure monitor's budget governor)
+so the oversubscription design can be measured before it touches a
+fleet:
+
+- **best-effort** pods borrow idle HBM up to ``int(hbm * overcommit)``
+  per chip — they may push a chip's grant sum past physical.
+- **guaranteed / burstable** pods admit against physical HBM but count
+  best-effort bytes as *reclaimable* (the pressure monitor will evict
+  the borrowers), still bounded by the overcommit ceiling.
+- **pressure** — a chip whose grant sum exceeds physical HBM while
+  non-best-effort usage is present — triggers eviction of the smallest
+  best-effort entry clearing the whole overage (else the largest),
+  governed by a sliding-window budget exactly like the live monitor.
+  Evicted pods restart: full duration, wait keyed to original arrival,
+  so eviction cost lands in the best-effort wait tail.
+
+Both invariants the chaos drill asserts hold *by admission*, so the sim
+samples them at every event and reports violation counts that must be
+zero: non-best-effort bytes never exceed physical HBM on any chip
+(guaranteed isolation), and no chip's grant sum ever exceeds the
+declared overcommit bound.
+
+At ``overcommit <= 1.0`` the loop degrades to single-class physical
+admission with zero evictions — the baseline the pinned gate compares
+against: the tiered run must buy utilization *without* degrading the
+guaranteed tier's wait tail (tests/test_wind_tunnel_gate.py).
+
+Everything is a pure function of (fleet, trace, knobs) — no wall
+clock, no ambient randomness — so the golden is byte-reproducible.
+Re-pinning is deliberate: ``python -m tpushare.sim --qos --pin``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import os
+from dataclasses import dataclass, field
+
+from tpushare.sim.simulator import Fleet, SimPod, _p99
+from tpushare.sim.traces import DiurnalSpec, PodTier, synth_diurnal
+
+BEST_EFFORT = "best-effort"
+GUARANTEED = "guaranteed"
+
+# The gate mix: guaranteed inference replicas with fast churn, a
+# burstable middle, and long-squatting best-effort batch scavengers —
+# the workload shape oversubscription exists for. Weights keep the
+# fleet saturated at the diurnal peak so the overcommit headroom is
+# actually contended (an idle fleet proves nothing).
+QOS_GATE_TIERS: tuple[PodTier, ...] = (
+    PodTier("g-serve-6g", 0.20, 6144, mean_duration=0.2,
+            qos_tier=GUARANTEED),
+    PodTier("g-serve-4g", 0.15, 4096, mean_duration=0.4,
+            qos_tier=GUARANTEED),
+    PodTier("b-dev-4g", 0.25, 4096, mean_duration=0.4),
+    PodTier("b-dev-2g", 0.15, 2048, mean_duration=0.2),
+    PodTier("be-batch-8g", 0.15, 8192, mean_duration=1.0,
+            qos_tier=BEST_EFFORT),
+    PodTier("be-batch-4g", 0.10, 4096, mean_duration=0.7,
+            qos_tier=BEST_EFFORT),
+)
+
+QOS_GATE_SPEC = DiurnalSpec(hours=2.0, period=2.0, base_rate=150.0,
+                            peak_rate=450.0, tiers=QOS_GATE_TIERS,
+                            seed=17)
+QOS_GATE_FLEET = {"nodes": 8, "chips": 4, "hbm": 16384, "mesh": (2, 2)}
+GATE_OVERCOMMIT = 1.25
+GATE_EVICT_BUDGET = 4      # evictions per sliding window (live default)
+GATE_EVICT_WINDOW = 0.25   # window length in trace-time units
+
+# The premise oversubscription monetizes: guaranteed/burstable requests
+# are sized for peak (OOM kills are unacceptable), so their RESERVED
+# bytes overstate ACTUAL residency — best-effort scavengers harvest the
+# slack. Utilization integrates actual bytes (reserved x this fraction
+# for non-best-effort, full demand for best-effort, clamped at physical
+# HBM); admission, pressure, and both invariants stay on reservations,
+# exactly like the live fleet where apiserver grants are the truth.
+NONBE_USE_FRAC = 0.6
+
+QOS_GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "data",
+    "qos_wind_tunnel_golden.json")
+
+# same semantics as autotune.DEFAULT_BANDS: deterministic replays, so
+# bands absorb intended small shifts while a policy regression cannot
+# hide inside them
+QOS_DEFAULT_BANDS = {
+    "time_weighted_util_pct": 1.0,
+    "rejection_rate": 0.03,
+    "p99_pending_age_s": 3.0,
+}
+
+
+@dataclass
+class QosSimReport:
+    overcommit: float
+    pods: int
+    placed: int
+    never_placed: int
+    mean_wait: float
+    p99_wait: float
+    util_pct: float            # ACTUAL bytes (NONBE_USE_FRAC model),
+                               # clamped per chip at physical HBM
+    makespan: float
+    evictions: int
+    max_window_evictions: int  # proof the governor held: <= budget
+    budget_deferred: int       # pressured scans the governor postponed
+    reclaimed_mib: int         # best-effort bytes evicted back
+    oversub_time_weighted_mib: float
+    guaranteed_violations: int # sampled instants; MUST be zero
+    overcommit_violations: int # sampled instants; MUST be zero
+    by_tier: dict = field(default_factory=dict)
+    waits: list[float] = field(default_factory=list, repr=False)
+
+    def scorecard(self) -> dict:
+        """Same currency as SimReport.scorecard / the live fleetwatch
+        scorecard, so one band checker serves both gates."""
+        return {
+            "time_weighted_util_pct": round(self.util_pct, 4),
+            "rejection_rate": round(self.never_placed / self.pods, 4)
+            if self.pods else None,
+            "p99_pending_age_s": round(self.p99_wait, 4),
+        }
+
+    def to_json(self) -> dict:
+        out = {k: (round(v, 4) if isinstance(v, float) else v)
+               for k, v in self.__dict__.items() if k != "waits"}
+        out["scorecard"] = self.scorecard()
+        return {k: out[k] for k in sorted(out)}
+
+
+def run_qos_sim(fleet: Fleet, trace: list[SimPod],
+                overcommit: float = 1.0,
+                evict_budget: int = GATE_EVICT_BUDGET,
+                evict_window: float = GATE_EVICT_WINDOW) -> QosSimReport:
+    """Replay ``trace`` under tiered admission. Deterministic.
+
+    Admission per chip mirrors ``NodeInfo._qos_views``: best-effort
+    headroom is ``int(hbm * oc) - used``; non-best-effort headroom is
+    ``max(0, min(hbm - used + reclaimable, int(hbm * oc) - used))`` —
+    both constraints hold AT admission, so the sampled invariants need
+    no grace window. ``overcommit <= 1.0`` is plain physical admission
+    for every tier (the live master gate collapses identically).
+    """
+    oc = max(1.0, overcommit)
+    nchips = [len(n.used) for n in fleet.nodes]
+    # best-effort grant sum per (node, chip) — the reclaimable pool
+    be = [[0] * c for c in nchips]
+    heap: list[tuple] = []
+    for seq, pod in enumerate(sorted(trace, key=lambda p: p.arrival)):
+        heapq.heappush(heap, (pod.arrival, 1, seq, pod))
+    pending: list[SimPod] = []
+    waits: list[float] = []
+    tier_waits: dict[str, list[float]] = {}
+    tier_counts: dict[str, list[int]] = {}  # tier -> [pods, placed]
+    for pod in trace:
+        tier_counts.setdefault(pod.qos_tier, [0, 0])[0] += 1
+    placed = 0
+    evictions = 0
+    budget_deferred = 0
+    reclaimed = 0
+    g_viol = 0
+    oc_viol = 0
+    evict_times: list[float] = []
+    max_window = 0
+    # seq2 -> (pod, node_index, chip_ids, per-chip demand)
+    active: dict[int, tuple] = {}
+    cancelled: set[int] = set()
+    now = 0.0
+    last_t = 0.0
+    util_integral = 0.0
+    oversub_integral = 0.0
+    busy_start: float | None = None
+    seq2 = len(trace)
+
+    def advance(to: float) -> None:
+        nonlocal util_integral, oversub_integral, last_t, g_viol, oc_viol
+        dt = to - last_t
+        if dt > 0:
+            for ni, node in enumerate(fleet.nodes):
+                cap = int(node.hbm * oc)
+                for i, u in enumerate(node.used):
+                    actual = (u - be[ni][i]) * NONBE_USE_FRAC + be[ni][i]
+                    util_integral += min(actual, node.hbm) * dt
+                    oversub_integral += max(0, u - node.hbm) * dt
+                    # sampled invariants (chaos drill currency): the
+                    # guaranteed reservation is physically backed and
+                    # the declared bound holds at every instant
+                    if u - be[ni][i] > node.hbm:
+                        g_viol += 1
+                    if u > cap:
+                        oc_viol += 1
+        last_t = to
+
+    def adj_free(node, ni: int, i: int, tier: str) -> int:
+        u = node.used[i]
+        if oc <= 1.0:
+            return node.hbm - u
+        cap = int(node.hbm * oc)
+        if tier == BEST_EFFORT:
+            return cap - u
+        return max(0, min(node.hbm - u + be[ni][i], cap - u))
+
+    def try_place(pod: SimPod) -> bool:
+        nonlocal placed, seq2
+        demand = pod.hbm_mib
+        tier = pod.qos_tier
+        best = None  # (press_sum, free_sum, ni, chip_ids)
+        for ni, node in enumerate(fleet.nodes):
+            if node.down:
+                continue
+            cands = []
+            for i in range(len(node.used)):
+                if not node.chip_healthy(i):
+                    continue
+                free = adj_free(node, ni, i, tier)
+                if free < demand:
+                    continue
+                u = node.used[i]
+                nonbe_after = u - be[ni][i] + (0 if tier == BEST_EFFORT
+                                              else demand)
+                press = 1 if (u + demand > node.hbm
+                              and nonbe_after > 0) else 0
+                cands.append((press, free, i))
+            if len(cands) < pod.chip_count:
+                continue
+            cands.sort()
+            pick = cands[:pod.chip_count]
+            key = (sum(c[0] for c in pick), sum(c[1] for c in pick), ni)
+            if best is None or key < best[:3]:
+                best = (*key, tuple(c[2] for c in pick))
+        if best is None:
+            return False
+        _press, _free, ni, chip_ids = best
+        node = fleet.nodes[ni]
+        for cid in chip_ids:
+            node.used[cid] += demand
+            if tier == BEST_EFFORT:
+                be[ni][cid] += demand
+        heapq.heappush(heap, (now + pod.duration, 0, seq2,
+                              (ni, chip_ids, demand)))
+        active[seq2] = (pod, ni, chip_ids, demand)
+        seq2 += 1
+        placed += 1
+        tier_counts.setdefault(tier, [0, 0])[1] += 1
+        waits.append(now - pod.arrival)
+        tier_waits.setdefault(tier, []).append(now - pod.arrival)
+        return True
+
+    def _release(vid: int) -> SimPod:
+        pod, ni, chip_ids, demand = active.pop(vid)
+        node = fleet.nodes[ni]
+        for cid in chip_ids:
+            node.used[cid] -= demand
+            if pod.qos_tier == BEST_EFFORT:
+                be[ni][cid] -= demand
+        cancelled.add(vid)
+        return pod
+
+    def pressure_scan() -> None:
+        """Evict best-effort borrowers off pressured chips, one victim
+        per pass (pressure_victim's loop), under the budget governor."""
+        nonlocal evictions, budget_deferred, reclaimed, max_window
+        while True:
+            worst = None  # (overage, ni, chip)
+            for ni, node in enumerate(fleet.nodes):
+                for i, u in enumerate(node.used):
+                    over = u - node.hbm
+                    # pressure: over physical AND non-best-effort usage
+                    # present AND something evictable on the chip — a
+                    # purely best-effort chip within the bound is the
+                    # intended borrow state, not pressure
+                    if over > 0 and u - be[ni][i] > 0 and be[ni][i] > 0:
+                        if worst is None or over > worst[0]:
+                            worst = (over, ni, i)
+            if worst is None:
+                return
+            while evict_times and evict_times[0] <= now - evict_window:
+                evict_times.pop(0)
+            if len(evict_times) >= evict_budget:
+                budget_deferred += 1
+                return  # governor: the next event's scan retries
+            over, ni, chip = worst
+            pool = [(vid, e[3]) for vid, e in active.items()
+                    if e[0].qos_tier == BEST_EFFORT and e[1] == ni
+                    and chip in e[2]]
+            if not pool:
+                return
+            clearing = [p for p in pool if p[1] >= over]
+            vid, _ = min(clearing, key=lambda p: (p[1], p[0])) \
+                if clearing else max(pool, key=lambda p: (p[1], -p[0]))
+            victim = _release(vid)
+            evictions += 1
+            evict_times.append(now)
+            max_window = max(max_window, len(evict_times))
+            reclaimed += victim.hbm_mib * victim.chip_count
+            pending.append(victim)  # restarts: full duration again
+
+    while heap:
+        t, kind, seq_id, payload = heapq.heappop(heap)
+        advance(t)
+        now = t
+        if busy_start is None:
+            busy_start = t
+        if kind == 1:  # arrival
+            if not try_place(payload):
+                pending.append(payload)
+        else:          # departure
+            if seq_id in cancelled:
+                cancelled.discard(seq_id)
+                continue
+            pod, ni, chip_ids, demand = active.pop(seq_id)
+            node = fleet.nodes[ni]
+            for cid in chip_ids:
+                node.used[cid] -= demand
+                if pod.qos_tier == BEST_EFFORT:
+                    be[ni][cid] -= demand
+            pending = [q for q in pending if not try_place(q)]
+        pressure_scan()
+
+    span = max(last_t - (busy_start or 0.0), 1e-9)
+    by_tier = {}
+    for tier, (n_pods, n_placed) in sorted(tier_counts.items()):
+        ws = tier_waits.get(tier, [])
+        by_tier[tier] = {
+            "pods": n_pods, "placed": n_placed,
+            "mean_wait": round(sum(ws) / len(ws), 4) if ws else 0.0,
+            "p99_wait": round(_p99(ws), 4),
+        }
+    return QosSimReport(
+        overcommit=oc,
+        pods=len(trace),
+        placed=placed,
+        never_placed=len(pending),
+        mean_wait=sum(waits) / len(waits) if waits else 0.0,
+        p99_wait=_p99(waits),
+        util_pct=util_integral / (fleet.total_hbm * span) * 100.0,
+        makespan=span,
+        evictions=evictions,
+        max_window_evictions=max_window,
+        budget_deferred=budget_deferred,
+        reclaimed_mib=reclaimed,
+        oversub_time_weighted_mib=oversub_integral / span,
+        guaranteed_violations=g_viol,
+        overcommit_violations=oc_viol,
+        by_tier=by_tier,
+        waits=waits,
+    )
+
+
+# -- the pinned tiered gate ---------------------------------------------------
+
+def _gate_fleet() -> Fleet:
+    return Fleet.homogeneous(
+        QOS_GATE_FLEET["nodes"], QOS_GATE_FLEET["chips"],
+        QOS_GATE_FLEET["hbm"], QOS_GATE_FLEET["mesh"])
+
+
+def qos_gate_report(overcommit: float = GATE_OVERCOMMIT) -> QosSimReport:
+    """The gate replay: standard tiered diurnal trace over the standard
+    fleet. ``overcommit=1.0`` is the single-class baseline leg."""
+    return run_qos_sim(_gate_fleet(), synth_diurnal(QOS_GATE_SPEC),
+                       overcommit=overcommit)
+
+
+def overcommit_sweep(values: tuple[float, ...] = (1.0, 1.1, 1.25, 1.5)
+                     ) -> dict:
+    """Sweep the overcommit knob over the gate workload — the capacity
+    question the knob table sends operators here to answer. Rows keep
+    trace order (the knob IS the x-axis); each carries the scorecard
+    plus the tier-isolation evidence."""
+    rows = []
+    for v in values:
+        rep = run_qos_sim(_gate_fleet(), synth_diurnal(QOS_GATE_SPEC),
+                          overcommit=v)
+        rows.append({
+            "overcommit": v,
+            "scorecard": rep.scorecard(),
+            "evictions": rep.evictions,
+            "guaranteed_violations": rep.guaranteed_violations,
+            "overcommit_violations": rep.overcommit_violations,
+            "guaranteed_p99_wait": rep.by_tier.get(
+                GUARANTEED, {}).get("p99_wait", 0.0),
+            "reclaimed_mib": rep.reclaimed_mib,
+        })
+    return {"mode": "qos-sweep", "rows": rows}
+
+
+def pin_qos_golden(path: str | None = None,
+                   bands: dict | None = None) -> dict:
+    """Write the tiered gate golden: the overcommitted scorecard, the
+    single-class baseline it must beat, and the isolation evidence.
+    Deliberate re-baselining ONLY (docs/ops.md)."""
+    rep = qos_gate_report()
+    base = qos_gate_report(overcommit=1.0)
+    golden = {
+        "gate_spec": {"hours": QOS_GATE_SPEC.hours,
+                      "base_rate": QOS_GATE_SPEC.base_rate,
+                      "peak_rate": QOS_GATE_SPEC.peak_rate,
+                      "seed": QOS_GATE_SPEC.seed,
+                      "n_tiers": len(QOS_GATE_SPEC.tiers)},
+        "gate_fleet": {k: (list(v) if isinstance(v, tuple) else v)
+                       for k, v in QOS_GATE_FLEET.items()},
+        "overcommit": GATE_OVERCOMMIT,
+        "scorecard": rep.scorecard(),
+        "qos": {
+            "evictions": rep.evictions,
+            "max_window_evictions": rep.max_window_evictions,
+            "guaranteed_violations": rep.guaranteed_violations,
+            "overcommit_violations": rep.overcommit_violations,
+            "reclaimed_mib": rep.reclaimed_mib,
+            "guaranteed_p99_wait": rep.by_tier[GUARANTEED]["p99_wait"],
+            "baseline_util_pct": base.scorecard()[
+                "time_weighted_util_pct"],
+            "baseline_guaranteed_p99_wait":
+                base.by_tier[GUARANTEED]["p99_wait"],
+        },
+        "bands": dict(bands or QOS_DEFAULT_BANDS),
+    }
+    path = path or QOS_GOLDEN_PATH
+    with open(path, "w") as f:
+        json.dump(golden, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return golden
+
+
+def load_qos_golden(path: str | None = None) -> dict:
+    with open(path or QOS_GOLDEN_PATH) as f:
+        return json.load(f)

@@ -44,6 +44,10 @@ class SimPod:
     chip_count: int = 1
     topology: tuple[int, ...] | None = None
     priority: int = 0
+    # QoS tier (ISSUE 17): consumed only by the tiered oversubscription
+    # sim (tpushare.sim.qos); the classic loops ignore it, so existing
+    # traces and goldens are untouched.
+    qos_tier: str = "burstable"
 
     @property
     def request(self) -> PlacementRequest:

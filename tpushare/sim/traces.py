@@ -56,6 +56,7 @@ class PodTier:
     topology: tuple[int, ...] | None = None
     mean_duration: float = 1.0
     priority: int = 0
+    qos_tier: str = "burstable"
 
 
 @dataclass(frozen=True)
@@ -163,7 +164,7 @@ def iter_diurnal(spec: DiurnalSpec) -> Iterator[SimPod]:
         duration = rng.expovariate(1.0 / tier.mean_duration)
         yield SimPod(arrival=t, duration=duration, hbm_mib=tier.hbm_mib,
                      chip_count=tier.chip_count, topology=tier.topology,
-                     priority=tier.priority)
+                     priority=tier.priority, qos_tier=tier.qos_tier)
 
 
 def synth_diurnal(spec: DiurnalSpec) -> list[SimPod]:
