@@ -3995,6 +3995,280 @@ def wind_tunnel() -> dict:
             "diurnal_50k": diurnal}
 
 
+def topo_placement() -> dict:
+    """Mesh-aware placement (ISSUE 18): the tier-weighted adjacency
+    blend vs the shape-blind binpack on a deliberately fragmented
+    fleet, the escape-hatch byte-identity proofs, and a verified
+    mutation storm.
+
+    The A/B fact: on a fleet where the binpack-tightest node offers
+    ONLY a strung-out 1x4, the blend lands the declared 2x2 on a
+    pristine box (achieved occupancy adjacency 1.0) while the blind
+    arm takes the fragmented node (0.75) — the live-handler analogue
+    of the ``sim --topo`` gate. Self-checks: TPUSHARE_NO_TOPO_SCORE=1
+    and annotation-free pods are byte-identical to today's path; the
+    MEMO/INDEX/WIRE verify oracles serve 0 stale entries under a
+    mesh-pod mutation storm; apiserver truth shows zero chip
+    oversubscription after it.
+    """
+    import threading
+    from tpushare import contract as _contract
+    from tpushare.cache import INDEX_STALE_SERVES, MEMO_STALE_SERVES
+    from tpushare.cache.nodeinfo import AllocationError
+    from tpushare.chaos.invariants import oversubscription
+    from tpushare.extender.handlers import (
+        BindHandler, FilterHandler, PrioritizeHandler)
+    from tpushare.extender.wirecache import WIRE_DIGEST, WIRE_STALE_SERVES
+
+    _seq = [0]
+
+    def with_env(env, fn):
+        old = {k: os.environ.get(k) for k in env}
+        for k, v in env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            return fn()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def pin(fc, cache, node, chips, hbm):
+        """Apiserver-backed placement on explicit chips (per-chip
+        grant semantics, like the defrag rig's fragmenters)."""
+        _seq[0] += 1
+        name = f"topo-pin-{_seq[0]}"
+        ann = _contract.placement_annotations(list(chips), hbm, V5E_HBM)
+        ann[_contract.ANN_ASSIGNED] = "true"
+        pod = {"metadata": {"name": name, "namespace": "bench",
+                            "uid": f"uid-{name}", "annotations": ann},
+               "spec": {"nodeName": node,
+                        "containers": [{"name": "c", "resources": {
+                            "limits": {"aliyun.com/tpu-hbm":
+                                       str(hbm)}}}]},
+               "status": {"phase": "Running"}}
+        cache.add_or_update_pod(fc.create_pod(pod))
+
+    def build(fragment=True):
+        fc = FakeCluster()
+        names = [f"t{i}" for i in range(4)]
+        for n in names:
+            fc.add_tpu_node(n, chips=8, hbm_per_chip_mib=V5E_HBM,
+                            mesh="2x4")
+        cache = SchedulerCache(fc)
+        cache.build_cache()
+        if fragment:
+            # t0: top row pinned full, bottom row half-full — the
+            # binpack-tightest candidate offers ONLY a 1x4 (adj 0.75)
+            pin(fc, cache, "t0", [0, 1, 2, 3], V5E_HBM)
+            pin(fc, cache, "t0", [4, 5, 6, 7], 4 * GIB)
+        registry = Registry()
+        flt = FilterHandler(cache, registry)
+        prio = PrioritizeHandler(cache, registry)
+        bind = BindHandler(cache, fc, registry,
+                           pod_lister=FakePodLister(fc))
+        return fc, cache, names, flt, prio, bind
+
+    def serve_pod(mesh="2x2"):
+        pod = make_pod(8 * GIB, count=4)
+        # serving replicas run guaranteed (full tier factor: the blend
+        # weight is not discounted), like the sim gate's serve pods
+        pod["metadata"]["annotations"][_contract.ANN_QOS_TIER] = \
+            "guaranteed"
+        if mesh:
+            pod["metadata"]["annotations"][_contract.ANN_MESH_SHAPE] = \
+                mesh
+        return pod
+
+    # -- the A/B: blend vs blind on the fragmented fleet ----------------
+    def run_arm(no_topo):
+        env = {"TPUSHARE_TOPO_WEIGHT": "1.0",
+               "TPUSHARE_NO_TOPO_SCORE": "1" if no_topo else None}
+
+        def go():
+            fc, cache, names, flt, prio, bind = build()
+            pod = fc.create_pod(serve_pod())
+            ok = flt.handle({"Pod": pod, "NodeNames": names})
+            lat = []
+            ranked = None
+            for _ in range(20):
+                t0 = time.perf_counter()
+                ranked = prio.handle({"Pod": pod,
+                                      "NodeNames": ok["NodeNames"]})
+                lat.append((time.perf_counter() - t0) * 1e3)
+            top = max(r["Score"] for r in ranked)
+            node = next(r["Host"] for r in ranked if r["Score"] == top)
+            out = bind.handle({"PodName": pod["metadata"]["name"],
+                               "PodNamespace": "bench",
+                               "PodUID": pod["metadata"]["uid"],
+                               "Node": node})
+            bound = fc.get_pod("bench", pod["metadata"]["name"])
+            cache.add_or_update_pod(bound)
+            # achieved adjacency read through the LIVE scorecard path
+            # (nodeinfo.pod_adjacency, the /inspect/fleet source)
+            adj = cache.get_node_info(node).pod_adjacency().get(
+                bound["metadata"]["uid"])
+            return {"node": node,
+                    "chip_ids": _contract.chip_ids_from_annotations(
+                        bound),
+                    "achieved_adjacency": adj,
+                    "prioritize_p50_ms": round(statistics.median(lat),
+                                               3),
+                    "bind_error": out.get("Error") or ""}
+        return with_env(env, go)
+
+    aware = run_arm(no_topo=False)
+    blind = run_arm(no_topo=True)
+
+    # -- escape-hatch + annotation-free byte identity -------------------
+    def verdicts(pod, env):
+        def go():
+            fc, cache, names, flt, prio, _ = build()
+            created = fc.create_pod(pod)
+            ok = flt.handle({"Pod": created, "NodeNames": names})
+            ranked = prio.handle({"Pod": created,
+                                  "NodeNames": ok["NodeNames"]})
+            return json.dumps({"filter": ok, "prioritize": ranked},
+                              sort_keys=True)
+        return with_env(env, go)
+
+    mesh_pod = serve_pod()
+    plain_pod = serve_pod(mesh=None)
+    plain_pod["metadata"].update(mesh_pod["metadata"] | {
+        "annotations": {}})
+    hatch_identical = (
+        verdicts(mesh_pod, {"TPUSHARE_TOPO_WEIGHT": "1.0",
+                            "TPUSHARE_NO_TOPO_SCORE": "1"})
+        == verdicts(plain_pod, {"TPUSHARE_TOPO_WEIGHT": "1.0",
+                                "TPUSHARE_NO_TOPO_SCORE": None}))
+    free_pod = serve_pod(mesh=None)
+    plain_identical = (
+        verdicts(free_pod, {"TPUSHARE_TOPO_WEIGHT": "1.0",
+                            "TPUSHARE_NO_TOPO_SCORE": None})
+        == verdicts(free_pod, {"TPUSHARE_TOPO_WEIGHT": None,
+                               "TPUSHARE_NO_TOPO_SCORE": None}))
+
+    # -- verified mutation storm ----------------------------------------
+    def storm():
+        fc, cache, names, flt, prio, bind = build()
+        server = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+        port = server.start()
+        stale0 = (MEMO_STALE_SERVES.value, INDEX_STALE_SERVES.value,
+                  WIRE_STALE_SERVES.value)
+        stop = threading.Event()
+        binds = [0] * 4
+
+        def worker(w):
+            for i in range(24):
+                keep = i >= 20  # final wave stays bound for the audit
+                pod = fc.create_pod(
+                    serve_pod("2x2" if i % 2 else "1x4"))
+                key = ("bench", pod["metadata"]["name"])
+                ok = flt.handle({"Pod": pod, "NodeNames": names})
+                if not ok["NodeNames"]:
+                    fc.delete_pod(*key)
+                    continue
+                ranked = prio.handle({"Pod": pod,
+                                      "NodeNames": ok["NodeNames"]})
+                top = max(r["Score"] for r in ranked)
+                node = next(r["Host"] for r in ranked
+                            if r["Score"] == top)
+                out = bind.handle({"PodName": key[1],
+                                   "PodNamespace": key[0],
+                                   "PodUID": pod["metadata"]["uid"],
+                                   "Node": node})
+                if out.get("Error"):
+                    fc.delete_pod(*key)
+                    continue
+                bound = fc.get_pod(*key)
+                cache.add_or_update_pod(bound)
+                binds[w] += 1
+                if not keep:
+                    cache.remove_pod(bound)
+                    fc.delete_pod(*key)
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                node = names[i % len(names)]
+                i += 1
+                pod = fc.create_pod(make_pod(4 * GIB))
+                key = (pod["metadata"]["namespace"],
+                       pod["metadata"]["name"])
+                try:
+                    cache.get_node_info(node).allocate(pod, fc)
+                except AllocationError:
+                    fc.delete_pod(*key)
+                    continue
+                bound = fc.get_pod(*key)
+                cache.add_or_update_pod(bound)
+                cache.remove_pod(bound)
+                fc.delete_pod(*key)
+
+        threads = [threading.Thread(target=worker, args=(w,),
+                                    daemon=True) for w in range(4)]
+        churn_t = threading.Thread(target=churn, daemon=True)
+        for t in threads:
+            t.start()
+        churn_t.start()
+        deadlocked = False
+        for t in threads:
+            t.join(timeout=180)
+            deadlocked = deadlocked or t.is_alive()
+        stop.set()
+        churn_t.join(timeout=10)
+
+        # wire-verify leg on the now-quiescent fleet: one miss, then
+        # digest hits each recomputed under TPUSHARE_WIRE_VERIFY
+        probe = fc.create_pod(serve_pod())
+        body = json.dumps({"Pod": probe,
+                           "NodeNames": names}).encode()
+        hits0 = WIRE_DIGEST.snapshot().get(("hit",), 0)
+        for _ in range(40):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/tpushare-scheduler/filter",
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                r.read()
+        wire_hits = WIRE_DIGEST.snapshot().get(("hit",), 0) - hits0
+        server.stop()
+        oversub = [f"{n}/{c}: {u} MiB > {V5E_HBM}"
+                   for (n, c), u in oversubscription(fc.list_pods(),
+                                                     V5E_HBM)]
+        stale1 = (MEMO_STALE_SERVES.value, INDEX_STALE_SERVES.value,
+                  WIRE_STALE_SERVES.value)
+        return {
+            "binds": sum(binds),
+            "deadlocked": deadlocked,
+            "wire_digest_hits": wire_hits,
+            "memo_stale_serves": stale1[0] - stale0[0],
+            "index_stale_serves": stale1[1] - stale0[1],
+            "wire_stale_serves": stale1[2] - stale0[2],
+            "oversubscribed_chips": oversub,
+        }
+
+    storm_out = with_env(
+        {"TPUSHARE_MEMO_VERIFY": "1", "TPUSHARE_INDEX_VERIFY": "1",
+         "TPUSHARE_WIRE_VERIFY": "1", "TPUSHARE_TOPO_WEIGHT": "1.0"},
+        storm)
+
+    return {
+        "hermetic": True,
+        "aware": aware,
+        "blind": blind,
+        "hatch_identical": hatch_identical,
+        "plain_identical": plain_identical,
+        "storm": storm_out,
+    }
+
+
 SLICE_HOSTS = [f"v5e16-h{i}" for i in range(4)]
 
 
@@ -4402,6 +4676,45 @@ def main() -> int:
            f"{wt['diurnal_50k']['arena']['nodes']} nodes, "
            f"{wt['diurnal_50k']['arena']['slot_updates']} slot updates)")
 
+    # mesh-aware placement (ISSUE 18): the blend lands the declared
+    # 2x2 on a pristine box while blind binpack takes the fragmented
+    # 1x4; escape hatch + annotation-free pods byte-identical; verified
+    # mutation storm serves 0 stale entries with 0 oversubscription
+    topo = topo_placement()
+    expect(topo["aware"]["achieved_adjacency"] == 1_000_000
+           and not topo["aware"]["bind_error"],
+           f"topo blend landed the declared 2x2 on a pristine box "
+           f"(node {topo['aware']['node']}, adjacency "
+           f"{topo['aware']['achieved_adjacency']})")
+    expect(topo["blind"]["node"] == "t0"
+           and topo["blind"]["achieved_adjacency"] == 750_000,
+           f"shape-blind binpack took the fragmented 1x4 as designed "
+           f"(node {topo['blind']['node']}, adjacency "
+           f"{topo['blind']['achieved_adjacency']})")
+    expect(topo["hatch_identical"],
+           "TPUSHARE_NO_TOPO_SCORE=1 verdicts byte-identical to the "
+           "annotation-free pod (the escape hatch is the off-switch)")
+    expect(topo["plain_identical"],
+           "annotation-free pod verdicts byte-identical with and "
+           "without the topo weight configured (shape-blind today-path "
+           "untouched)")
+    tst = topo["storm"]
+    expect(not tst["deadlocked"] and tst["binds"] > 0,
+           f"topo mutation storm completed ({tst['binds']} mesh binds, "
+           f"no deadlock)")
+    expect(tst["memo_stale_serves"] == 0
+           and tst["index_stale_serves"] == 0
+           and tst["wire_stale_serves"] == 0
+           and tst["wire_digest_hits"] > 0,
+           f"0 stale serves under TPUSHARE_MEMO/INDEX/WIRE_VERIFY with "
+           f"mesh-shape pods (memo {tst['memo_stale_serves']}, index "
+           f"{tst['index_stale_serves']}, wire "
+           f"{tst['wire_stale_serves']} over {tst['wire_digest_hits']} "
+           f"digest hits)")
+    expect(not tst["oversubscribed_chips"],
+           f"zero chip oversubscription on apiserver truth after the "
+           f"topo storm ({tst['oversubscribed_chips'][:3]})")
+
     # fault-domain wind tunnel (ISSUE 13): the hermetic chaos drill —
     # two full replica stacks over one FakeCluster, a conductor
     # replaying the seeded fault schedule (replica SIGKILL + cold
@@ -4677,6 +4990,11 @@ def main() -> int:
             # native-loop A/B on the standard trace (byte-identical)
             # and the 50k-node diurnal leg with the 1M-pod projection
             "wind_tunnel": wt,
+            # mesh-aware placement (ISSUE 18): blend-vs-blind achieved
+            # adjacency on the fragmented fleet, the escape-hatch and
+            # annotation-free byte-identity proofs, and the verified
+            # mesh-pod mutation storm's stale/oversubscription audit
+            "topo_placement": topo,
             # fault-domain wind tunnel (ISSUE 13): the hermetic chaos
             # drill's verdict — fault mix applied, recovery
             # adopt/GC attribution, orphan-recovery window vs bound,
@@ -4787,6 +5105,9 @@ if __name__ == "__main__":
         sys.exit(1 if result["failed"] else 0)
     if "wind_tunnel" in sys.argv:
         print(json.dumps(wind_tunnel(), indent=2))
+        sys.exit(0)
+    if "topo_placement" in sys.argv:
+        print(json.dumps(topo_placement(), indent=2))
         sys.exit(0)
     if "wire_fastpath" in sys.argv:
         procs = int(sys.argv[sys.argv.index("--procs") + 1]) \

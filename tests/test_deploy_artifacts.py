@@ -300,3 +300,32 @@ def test_chart_wires_qos_knobs_everywhere():
     spec = ds["spec"]["template"]["spec"]
     assert "sleep 60" in spec["containers"][0]["args"][0]
     assert spec["volumes"][0]["hostPath"]["path"] == "/srv/kubernetes"
+
+def test_chart_wires_topo_knobs_into_extender():
+    """ISSUE 18: the mesh-aware placement knobs must reach the extender
+    env — topoWeight drives Prioritize's adjacency blend, noTopoScore
+    is the byte-identical shape-blind escape hatch — and must be
+    values-driven so an operator can retune without editing templates."""
+    chart = os.path.join(REPO, "deployer/chart/tpushare-installer")
+    with open(os.path.join(chart, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    assert values["topo"] == {"topoWeight": "0.5", "noTopoScore": "0"}
+
+    text = _render_helm(
+        os.path.join(chart, "templates", "extender.yaml"), values)
+    dep = next(d for d in yaml.safe_load_all(text)
+               if d and d["kind"] == "Deployment")
+    env = {e["name"]: e.get("value") for e in
+           dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUSHARE_TOPO_WEIGHT"] == "0.5"
+    assert env["TPUSHARE_NO_TOPO_SCORE"] == "0"
+
+    values["topo"] = {"topoWeight": "1.0", "noTopoScore": "1"}
+    text = _render_helm(
+        os.path.join(chart, "templates", "extender.yaml"), values)
+    dep = next(d for d in yaml.safe_load_all(text)
+               if d and d["kind"] == "Deployment")
+    env = {e["name"]: e.get("value") for e in
+           dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUSHARE_TOPO_WEIGHT"] == "1.0"
+    assert env["TPUSHARE_NO_TOPO_SCORE"] == "1"

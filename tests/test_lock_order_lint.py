@@ -27,6 +27,9 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 SCOPES = (
     os.path.join(ROOT, "tpushare", "cache"),
+    # the pure scoring layer (topology.py, placement.py): lock-free by
+    # design — any lock that ever appears here must be classified
+    os.path.join(ROOT, "tpushare", "core"),
     os.path.join(ROOT, "tpushare", "core", "native"),
     os.path.join(ROOT, "tpushare", "controller"),
     os.path.join(ROOT, "tpushare", "defrag"),
@@ -383,6 +386,51 @@ def test_reuseport_listener_setup_is_lock_free():
                             f"httpserver.py:{sub.lineno}: 'with {src}:'"
                             f" inside {node.name}() — listener setup "
                             "and accept must stay lock-free")
+    assert not offenders, "\n".join(offenders)
+
+
+def test_topo_scoring_path_takes_no_locks():
+    """The mesh-aware scoring path (ISSUE 18) must stay lock-free: the
+    ABI v7 fleet scan releases the GIL, so a lock held across
+    ``cycle_fleet_topo`` (or inside the pure adjacency scorer) would
+    serialize every Prioritize behind one bookkeeping mutex — the exact
+    cost the one-pass design exists to avoid. AST check: no ``with
+    <lock>:`` anywhere in topology.py, and none inside engine.py's topo
+    entry points."""
+    offenders: list[str] = []
+
+    path = os.path.join(ROOT, "tpushare", "core", "topology.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                src = ast.unparse(item.context_expr)
+                if _LOCKISH.search(src):
+                    offenders.append(
+                        f"topology.py:{node.lineno}: 'with {src}:' — "
+                        "the adjacency scorer is pure and lock-free")
+
+    path = os.path.join(ROOT, "tpushare", "core", "native", "engine.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    topo_fns = {"cycle_fleet_topo", "_py_cycle_topo", "_topo_cycle_fn"}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in topo_fns:
+            continue
+        topo_fns.discard(node.name)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    src = ast.unparse(item.context_expr)
+                    if _LOCKISH.search(src):
+                        offenders.append(
+                            f"engine.py:{sub.lineno}: 'with {src}:' "
+                            f"inside {node.name}() — no lock may be "
+                            "held across the v7 topo scan")
+    assert not topo_fns, f"topo entry points renamed? missing {topo_fns}"
     assert not offenders, "\n".join(offenders)
 
 

@@ -1,0 +1,350 @@
+"""Mesh-aware placement properties (ISSUE 18).
+
+The adjacency scorer against brute-force enumeration, the 2-D
+monotonicity law (and the 3-D counterexample that scopes it), native
+ABI v7 topo-cycle parity with the Python spec on randomized fleets,
+the mesh-shape annotation grammar, the Filter-side strict rejection,
+and the serving workload's device-order composition.
+"""
+
+import itertools
+import json
+import random
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.cache.nodeinfo import request_from_pod
+from tpushare.core.chips import ChipView
+from tpushare.core.native import engine as native_engine
+from tpushare.core.placement import PlacementRequest, select_chips_py
+from tpushare.core.topology import (
+    ADJ_SCALE, MeshTopology, adjacency_quality, box_links, congruent,
+    congruent_first, max_box_links, occupancy_adjacency)
+from tpushare.extender.handlers import (
+    MESH_SHAPE_REJECTS, FilterHandler, PrioritizeHandler)
+from tpushare.extender.metrics import Registry
+from tpushare.k8s import FakeCluster
+from tpushare.workloads.serve import compose_mesh_devices
+
+HBM = 16384
+
+
+# -- the scorer vs brute force ------------------------------------------------
+
+def _grid_edges(shape):
+    """Literal ICI link count: adjacent coordinate pairs of the box."""
+    coords = list(itertools.product(*[range(d) for d in shape]))
+    return sum(1 for a, b in itertools.combinations(coords, 2)
+               if sum(abs(x - y) for x, y in zip(a, b)) == 1)
+
+
+def _all_factorizations(n):
+    """Every sorted dims tuple with product n (rank unconstrained)."""
+    if n == 1:
+        return {(1,)}
+    out = set()
+
+    def rec(remaining, start, dims):
+        if remaining == 1:
+            out.add(tuple(sorted(dims)))
+            return
+        d = start
+        while d <= remaining:
+            if remaining % d == 0:
+                rec(remaining // d, d, dims + [d])
+            d += 1
+
+    rec(n, 2, [])
+    return out
+
+
+def test_box_links_is_the_grid_edge_count():
+    shapes = [(1,), (4,), (2, 2), (1, 8), (2, 4), (3, 3), (2, 2, 2),
+              (2, 3, 4), (1, 2, 3), (4, 4), (2, 2, 9)]
+    for shape in shapes:
+        assert box_links(shape) == _grid_edges(shape), shape
+
+
+def test_max_box_links_vs_bruteforce():
+    for n in range(1, 49):
+        want = max((box_links(dims) for dims in _all_factorizations(n)),
+                   default=0)
+        assert max_box_links(n) == want, n
+
+
+def test_2d_monotone_more_square_more_links():
+    """Among 2-D boxes of equal area, squarer is strictly better:
+    links(a, b) = 2n - a - b, so shrinking the perimeter always adds
+    links. This is the law Prioritize's blend leans on for the 2-D
+    node meshes the fleet actually runs."""
+    for n in range(2, 65):
+        pairs = sorted((a, n // a) for a in range(1, n + 1)
+                       if n % a == 0 and a <= n // a)
+        for (a1, b1), (a2, b2) in zip(pairs, pairs[1:]):
+            assert box_links((a2, b2)) > box_links((a1, b1)), \
+                (n, (a1, b1), (a2, b2))
+
+
+def test_monotonicity_does_not_extend_to_3d():
+    """The counterexample that scopes the law above to 2-D: at 36
+    chips the squarest 2-D box (6x6, 60 links) LOSES to a 3-D
+    factorization (2x2x9, 68 links). The normalizer must enumerate
+    all ranks, not pick the squarest 2-D shape."""
+    assert box_links((6, 6)) == 60
+    assert box_links((2, 2, 9)) == 68
+    assert max_box_links(36) >= 68 > box_links((6, 6))
+
+
+def test_adjacency_quality_range_and_sentinels():
+    assert adjacency_quality(0, None) == -1
+    assert adjacency_quality(-3, (2, 2)) == -1
+    assert adjacency_quality(1, None) == ADJ_SCALE  # single chip
+    assert adjacency_quality(4, None) == 0          # scatter
+    for n in range(2, 33):
+        for dims in _all_factorizations(n):
+            q = adjacency_quality(n, dims)
+            assert 0 <= q <= ADJ_SCALE, (n, dims)
+    # the best factorization (and only it) scores ADJ_SCALE
+    assert adjacency_quality(4, (2, 2)) == ADJ_SCALE
+    assert adjacency_quality(4, (1, 4)) == 750_000
+    # for 8 chips the 3-D cube (2,2,2) with 12 links is the normalizer,
+    # so even the best 2-D box only scores 10/12
+    assert adjacency_quality(8, (2, 4)) == 10 * ADJ_SCALE // 12
+    assert adjacency_quality(8, (1, 8)) == 7 * ADJ_SCALE // 12
+
+
+def test_occupancy_adjacency_boxes_holes_translation():
+    assert occupancy_adjacency([]) == -1
+    assert occupancy_adjacency([(0, 0)]) == ADJ_SCALE
+    # a 2x2 box anywhere in the mesh scores its box quality
+    square = [(0, 0), (0, 1), (1, 0), (1, 1)]
+    assert occupancy_adjacency(square) == ADJ_SCALE
+    shifted = [(r + 3, c + 5) for r, c in square]
+    assert occupancy_adjacency(shifted) == ADJ_SCALE
+    # a row is the 1x4 box
+    assert occupancy_adjacency([(1, c) for c in range(4)]) == \
+        adjacency_quality(4, (1, 4))
+    # holes in the bounding box = scatter
+    assert occupancy_adjacency([(0, 0), (0, 2)]) == 0
+    assert occupancy_adjacency([(0, 0), (1, 1)]) == 0
+
+
+def test_congruent_up_to_axis_order_and_unit_dims():
+    assert congruent((4, 2), (2, 4))
+    assert congruent((1, 2, 4), (2, 4))
+    assert congruent((4,), (1, 4))
+    assert not congruent((2, 2), (1, 4))
+    assert not congruent((2, 4), (2, 2))
+
+
+def test_congruent_first_is_a_stable_partition():
+    shapes = [(2, 2), (1, 4), (4, 1), (2, 4), (4, 2)]
+    out = congruent_first(shapes, (4, 2))
+    assert out == [(2, 4), (4, 2), (2, 2), (1, 4), (4, 1)]
+    assert sorted(out) == sorted(shapes)
+    # a shape-blind request order is untouched by an all-miss partition
+    assert congruent_first(shapes, (3, 3)) == shapes
+
+
+# -- native ABI v7 topo-cycle parity ------------------------------------------
+
+def _random_node(rng):
+    n = rng.choice([4, 8, 16])
+    shape = MeshTopology.for_chip_count(n).shape
+    topo = MeshTopology(shape)
+    total = rng.choice([8192, 16276])
+    chips = [
+        ChipView(i, topo.coords(i), total, rng.randrange(0, total + 1),
+                 healthy=rng.random() > 0.15)
+        for i in range(n)
+    ]
+    rng.shuffle(chips)
+    return chips, topo
+
+
+def _random_mesh_req(rng):
+    count = rng.choice([2, 4, 4, 8])
+    factorizations = [dims for dims in _all_factorizations(count)]
+    mesh = tuple(rng.choice(factorizations))
+    return PlacementRequest(
+        hbm_mib=rng.choice([0, 512, 2048, 8138]),
+        chip_count=count,
+        allow_scatter=rng.random() < 0.5,
+        mesh_shape=mesh,
+    )
+
+
+@pytest.mark.skipif(not native_engine.topo_cycle_supported(),
+                    reason="ABI v7 native topo cycle unavailable")
+def test_topo_cycle_parity_randomized_fleets():
+    """ABI v7 cycle_fleet_topo vs the Python spec on randomized
+    fleets: per node, the same (score, chip set, box, adjacency) —
+    including the congruent-first box walk the mesh shape triggers."""
+    rng = random.Random(1811)
+    for trial in range(60):
+        nodes = [_random_node(rng)
+                 for _ in range(rng.randrange(1, 10))]
+        req = _random_mesh_req(rng)
+        fleet = native_engine.cycle_fleet_topo(nodes, req)
+        assert len(fleet) == len(nodes)
+        # materialization is winner-only (like cycle_fleet): the one
+        # Placement in the result belongs to the best-scoring node
+        scores = [s for s, _p, _a in fleet if s is not None]
+        winners = [ni for ni, (_s, p, _a) in enumerate(fleet)
+                   if p is not None]
+        assert len(winners) == (1 if scores else 0), (trial, req)
+        for ni, (chips, topo) in enumerate(nodes):
+            py = select_chips_py(chips, topo, req)
+            score, placement, adj = fleet[ni]
+            if py is None:
+                assert (score, placement, adj) == (None, None, -1), \
+                    (trial, ni, req)
+            else:
+                assert score == py.score, (trial, ni, req)
+                assert adj == py.adjacency, (trial, ni, req)
+                if placement is not None:
+                    # lowest score = tightest fit wins materialization
+                    assert score == min(scores), (trial, ni, req)
+                    assert placement.chip_ids == py.chip_ids, \
+                        (trial, ni, req)
+                    assert placement.box == py.box, (trial, ni, req)
+
+
+def test_mesh_shape_never_changes_admissibility():
+    """The declared shape is a soft preference: a node fits with the
+    mesh shape iff it fits without it (only the box choice may move)."""
+    rng = random.Random(77)
+    for trial in range(200):
+        chips, topo = _random_node(rng)
+        req = _random_mesh_req(rng)
+        blind = select_chips_py(
+            chips, topo,
+            PlacementRequest(hbm_mib=req.hbm_mib,
+                             chip_count=req.chip_count,
+                             allow_scatter=req.allow_scatter))
+        aware = select_chips_py(chips, topo, req)
+        assert (blind is None) == (aware is None), (trial, req)
+
+
+# -- annotation grammar + Filter strict rejection -----------------------------
+
+def _mesh_pod(shape_raw, count=4, hbm=2048, name="mesh-p"):
+    return make_pod(hbm=hbm, count=count, name=name,
+                    ann={contract.ANN_MESH_SHAPE: shape_raw})
+
+
+def test_pod_mesh_shape_grammar():
+    assert contract.pod_mesh_shape(make_pod(hbm=1024)) is None
+    assert contract.pod_mesh_shape(_mesh_pod("2x4", count=8),
+                                   chip_count=8) == (2, 4)
+    assert contract.pod_mesh_shape(_mesh_pod(" 1x4 "),
+                                   chip_count=4) == (1, 4)
+    with pytest.raises(ValueError, match="integers joined by 'x'"):
+        contract.pod_mesh_shape(_mesh_pod("2xtwo"), chip_count=4)
+    with pytest.raises(ValueError, match="non-positive"):
+        contract.pod_mesh_shape(_mesh_pod("0x4"), chip_count=4)
+    with pytest.raises(ValueError, match="covers 8 chip"):
+        contract.pod_mesh_shape(_mesh_pod("2x4"), chip_count=4)
+
+
+def test_request_from_pod_strict_vs_lenient(monkeypatch):
+    bad = _mesh_pod("3x3")
+    lenient = request_from_pod(bad)
+    assert lenient is not None and lenient.mesh_shape is None
+    with pytest.raises(ValueError):
+        request_from_pod(bad, strict_mesh=True)
+    # the escape hatch ignores the annotation entirely, even strict
+    monkeypatch.setenv("TPUSHARE_NO_TOPO_SCORE", "1")
+    hatch = request_from_pod(bad, strict_mesh=True)
+    assert hatch is not None and hatch.mesh_shape is None
+    good = request_from_pod(_mesh_pod("2x2"))
+    assert good.mesh_shape is None  # hatch still on
+    monkeypatch.delenv("TPUSHARE_NO_TOPO_SCORE")
+    assert request_from_pod(_mesh_pod("2x2")).mesh_shape == (2, 2)
+
+
+def _filter_rig():
+    fc = FakeCluster()
+    for n in ("n0", "n1"):
+        fc.add_tpu_node(n, chips=8, hbm_per_chip_mib=HBM, mesh="2x4")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    registry = Registry()
+    return (fc, cache, FilterHandler(cache, registry),
+            PrioritizeHandler(cache, registry))
+
+
+def test_filter_rejects_malformed_mesh_shape_with_distinct_reason():
+    _fc, _cache, flt, _prio = _filter_rig()
+    before = MESH_SHAPE_REJECTS.value
+    out = flt.handle({"Pod": _mesh_pod("3x3"),
+                      "NodeNames": ["n0", "n1"]})
+    assert out["NodeNames"] == []
+    assert set(out["FailedNodes"]) == {"n0", "n1"}
+    for reason in out["FailedNodes"].values():
+        assert "invalid mesh-shape annotation" in reason
+        assert "covers 9 chip" in reason
+    assert MESH_SHAPE_REJECTS.value == before + 1
+
+
+def test_filter_admits_wellformed_mesh_shape():
+    _fc, _cache, flt, _prio = _filter_rig()
+    before = MESH_SHAPE_REJECTS.value
+    out = flt.handle({"Pod": _mesh_pod("2x2"),
+                      "NodeNames": ["n0", "n1"]})
+    assert sorted(out["NodeNames"]) == ["n0", "n1"]
+    assert MESH_SHAPE_REJECTS.value == before
+
+
+def test_prioritize_is_lenient_on_malformed_mesh_shape():
+    """A malformed pod never passed Filter; downstream verbs treat the
+    annotation as absent instead of erroring the whole verb."""
+    _fc, _cache, _flt, prio = _filter_rig()
+    ranked = prio.handle({"Pod": _mesh_pod("3x3"),
+                          "NodeNames": ["n0", "n1"]})
+    assert {r["Host"] for r in ranked} == {"n0", "n1"}
+    clean = prio.handle({"Pod": make_pod(hbm=2048, count=4,
+                                         name="mesh-p"),
+                         "NodeNames": ["n0", "n1"]})
+    assert json.dumps(ranked, sort_keys=True) == \
+        json.dumps(clean, sort_keys=True)
+
+
+# -- serving device-order composition -----------------------------------------
+
+def test_compose_congruent_box_transposes_onto_logical_axes():
+    devs = list("abcdefgh")
+    # 2x4 box (row-major TPU_VISIBLE_CHIPS order), tp=4 ep=2: each tp
+    # group along the last axis is a physically adjacent column pair
+    out = compose_mesh_devices(devs, "2x4", (1, 4, 2))
+    assert out == [[["a", "e"], ["b", "f"], ["c", "g"], ["d", "h"]]]
+    # 2x2 box onto (1, 2, 2) is the identity reshape
+    assert compose_mesh_devices(list("abcd"), "2x2", (1, 2, 2)) == \
+        [[["a", "b"], ["c", "d"]]]
+
+
+def test_compose_snake_makes_single_axis_ring_adjacent():
+    # one logical axis over a 2x2 box: boustrophedon — every
+    # consecutive pair (and the wrap) is one ICI hop apart
+    out = compose_mesh_devices(list("abcd"), "2x2", (1, 4))
+    assert out == [["a", "b", "d", "c"]]
+    coords = {"a": (0, 0), "b": (0, 1), "c": (1, 0), "d": (1, 1)}
+    ring = out[0]
+    for x, y in zip(ring, ring[1:] + ring[:1]):
+        dist = sum(abs(p - q)
+                   for p, q in zip(coords[x], coords[y]))
+        assert dist == 1, (x, y)
+
+
+def test_compose_falls_back_to_plain_reshape():
+    devs = list("abcd")
+    plain = compose_mesh_devices(devs, None, (1, 4))
+    assert plain == [["a", "b", "c", "d"]]
+    # incongruent box label: no safe mapping, plain reshape
+    assert compose_mesh_devices(devs, "3x3", (1, 2, 2)) == \
+        [[["a", "b"], ["c", "d"]]]
+    assert compose_mesh_devices(list("abcdefgh"), "1x8", (1, 4, 2)) == \
+        [[["a", "b"], ["c", "d"], ["e", "f"], ["g", "h"]]]

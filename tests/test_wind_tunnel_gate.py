@@ -151,3 +151,88 @@ def test_qos_gate_is_falsifiable(qos_golden):
     knob regression, not just a policy rewrite."""
     loose = qos_gate_report(overcommit=2.0)
     assert check_scorecard(loose.scorecard(), qos_golden) != []
+
+
+# -- the mesh-aware placement gate (ISSUE 18) ---------------------------------
+#
+# tests/data/topo_wind_tunnel_golden.json pins the seed-averaged
+# mesh-aware serving scorecard AND the shape-blind baseline it must
+# beat: lower serving p99 wait at equal-or-better utilization, bought
+# by a strictly better adjacency scorecard. Re-baselining is
+# deliberate: ``python -m tpushare.sim --topo --pin``.
+
+from tpushare.sim.topo import (
+    GATE_SEEDS, GATE_SLOWDOWN, GATE_TOPO_WEIGHT, TOPO_DEFAULT_BANDS,
+    TOPO_GATE_FLEET, TOPO_GATE_SPEC, check_topo, gate_aggregate,
+    load_topo_golden)
+
+
+@pytest.fixture(scope="module")
+def topo_golden():
+    return load_topo_golden()
+
+
+@pytest.fixture(scope="module")
+def topo_aware():
+    return gate_aggregate()
+
+
+@pytest.fixture(scope="module")
+def topo_blind():
+    return gate_aggregate(mesh_aware=False)
+
+
+def test_topo_golden_schema(topo_golden):
+    assert set(topo_golden) == {"gate_spec", "gate_fleet", "topo_weight",
+                                "slowdown", "scorecard", "adjacency",
+                                "serve_p99_wait", "baseline", "bands"}
+    assert topo_golden["bands"] == TOPO_DEFAULT_BANDS
+    assert topo_golden["topo_weight"] == GATE_TOPO_WEIGHT
+    assert topo_golden["slowdown"] == GATE_SLOWDOWN
+    # the golden must describe THIS code's gate workload
+    assert topo_golden["gate_spec"]["n_pods"] == TOPO_GATE_SPEC.n_pods
+    assert topo_golden["gate_spec"]["seeds"] == list(GATE_SEEDS)
+    assert topo_golden["gate_fleet"]["nodes"] == TOPO_GATE_FLEET["nodes"]
+    assert tuple(topo_golden["gate_fleet"]["mesh"]) == \
+        TOPO_GATE_FLEET["mesh"]
+
+
+def test_topo_gate_within_bands(topo_golden, topo_aware):
+    """THE regression gate: replay the seed-averaged mesh-aware leg;
+    scorecard within bands, adjacency no worse than pinned, serving
+    tail still beating the pinned shape-blind baseline."""
+    violations = check_topo(topo_aware, topo_golden)
+    assert violations == [], "\n".join(violations)
+
+
+def test_topo_gate_beats_shape_blind_baseline(topo_golden, topo_aware,
+                                              topo_blind):
+    """What the blend must BUY (the live replay, not just the pinned
+    numbers): a lower serving p99 wait at equal-or-better utilization,
+    via a strictly better adjacency scorecard — more congruent boxes,
+    higher mean quality, less step-time stretch."""
+    assert topo_aware["serve_p99_wait"] < topo_blind["serve_p99_wait"]
+    util_band = topo_golden["bands"]["time_weighted_util_pct"]
+    assert topo_aware["scorecard"]["time_weighted_util_pct"] >= \
+        topo_blind["scorecard"]["time_weighted_util_pct"] - util_band
+    assert topo_aware["scorecard"]["rejection_rate"] <= \
+        topo_blind["scorecard"]["rejection_rate"]
+    a, b = topo_aware["adjacency"], topo_blind["adjacency"]
+    assert a["placements"] == b["placements"]  # same admitted work
+    assert a["mean_quality"] > b["mean_quality"]
+    assert a["congruent_rate"] > b["congruent_rate"]
+    assert a["stretch_time"] < b["stretch_time"]
+    # and the pinned baseline in the golden is the real blind leg
+    assert topo_golden["baseline"]["serve_p99_wait"] == \
+        topo_blind["serve_p99_wait"]
+
+
+def test_topo_gate_is_falsifiable(topo_golden, topo_blind):
+    """The shape-blind leg must red the gate on every adjacency
+    dimension — otherwise the tolerances are too loose to protect the
+    tentpole's actual claim."""
+    violations = check_topo(topo_blind, topo_golden)
+    assert any("mean_quality" in v for v in violations)
+    assert any("congruent_rate" in v for v in violations)
+    assert any("stretch_time" in v for v in violations)
+    assert any("serve_p99_wait" in v for v in violations)

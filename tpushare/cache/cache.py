@@ -143,8 +143,8 @@ def memo_node_reuse_rate() -> float | None:
 
 class _MemoEntry:
     __slots__ = ("req_sig", "scores", "errors", "stamps", "placements",
-                 "placement_node", "placement", "placement_stamp",
-                 "speculative")
+                 "adjacency", "placement_node", "placement",
+                 "placement_stamp", "speculative")
 
     def __init__(self, req_sig: tuple) -> None:
         self.req_sig = req_sig
@@ -153,6 +153,11 @@ class _MemoEntry:
         # node name -> NodeInfo.version stamp ((epoch, counter) tuple)
         # the score/error was computed at
         self.stamps: dict[str, tuple[int, int]] = {}
+        # node name -> adjacency quality of the node's best box (ABI v7
+        # topo cycle / Placement.adjacency), populated only for
+        # mesh-shape requests — Prioritize's tier-weighted blend reads
+        # these under the same per-node stamps as the scores
+        self.adjacency: dict[str, int] = {}
         # node name -> winning Placement from the SAME native cycle that
         # produced the score (ABI v4): Bind's seed lookup serves from
         # here instead of re-running the chip search. Valid under the
@@ -168,7 +173,11 @@ class _MemoEntry:
 
 
 def _req_sig(req: PlacementRequest) -> tuple:
-    return (req.hbm_mib, req.chip_count, req.topology, req.allow_scatter)
+    # mesh_shape is part of the signature: congruent-first reordering
+    # changes the winning box (and so the score), so a mesh-shape pod
+    # must never join a shape-blind pod's equivalence class
+    return (req.hbm_mib, req.chip_count, req.topology, req.allow_scatter,
+            req.mesh_shape)
 
 
 class _LockStripes:
@@ -409,7 +418,8 @@ class SchedulerCache:
 
     def score_nodes(self, pod: dict[str, Any], req: PlacementRequest,
                     node_names: list[str],
-                    provenance: dict[str, str] | None = None
+                    provenance: dict[str, str] | None = None,
+                    adjacency: dict[str, int] | None = None
                     ) -> tuple[dict[str, int | None], dict[str, str]]:
         """Fleet scores for ``pod`` over ``node_names``, memoized per
         (pod, request signature) with per-node generation stamps.
@@ -422,6 +432,12 @@ class SchedulerCache:
         actually scanned this call. The explain audit (obs/explain.py)
         records it per decision, and the cache.score_nodes trace span
         carries the aggregate counts.
+
+        ``adjacency`` (optional out-param) is filled with ``node ->
+        adjacency quality`` (topology.adjacency_quality fixed-point)
+        for mesh-shape requests — produced by the SAME topo cycle that
+        scored the node (zero extra engine calls) and memoized under
+        the same stamps; empty for shape-blind requests.
 
         Returns ``(scores, errors)``: ``scores[name]`` is the native
         engine's best binpack score (lower = tighter; None = no
@@ -474,6 +490,7 @@ class SchedulerCache:
         joined_errors: dict[str, str] = {}
         joined_stamps: dict[str, tuple[int, int]] = {}
         joined_placements: dict[str, Placement] = {}
+        joined_adjacency: dict[str, int] = {}
         with self._memo_lock:
             entry = self._memo.get(key)
             if entry is not None and entry.req_sig != sig:
@@ -507,6 +524,7 @@ class SchedulerCache:
                             entry.errors.pop(n, None)
                             entry.stamps.pop(n, None)
                             entry.placements.pop(n, None)
+                            entry.adjacency.pop(n, None)
                             MEMO_DELTA_INVALIDATIONS.inc()
                         missing.append(n)
             full_hit = not missing
@@ -518,6 +536,10 @@ class SchedulerCache:
                         if n in entry.scores},
                        {n: entry.errors[n] for n in node_names
                         if n in entry.errors})
+                if adjacency is not None:
+                    adjacency.update({n: entry.adjacency[n]
+                                      for n in node_names
+                                      if n in entry.adjacency})
             elif self._eqclass:
                 # equivalence-class join: a pod with the same request
                 # signature may have scanned these nodes already — a
@@ -541,6 +563,9 @@ class SchedulerCache:
                                 jp = sig_entry.placements.get(n)
                                 if jp is not None:
                                     joined_placements[n] = jp
+                                ja = sig_entry.adjacency.get(n)
+                                if ja is not None:
+                                    joined_adjacency[n] = ja
                                 if verify_serves:
                                     verify.append(
                                         (n, st, sig_entry.scores[n]))
@@ -584,7 +609,8 @@ class SchedulerCache:
                              nodes_joined=joined,
                              nodes_pruned=len(pruned),
                              nodes_computed=len(to_scan)):
-                scores, fetch_errors, node_errors, stamps, placements = \
+                (scores, fetch_errors, node_errors, stamps, placements,
+                 scanned_adj) = \
                     self._compute_missing(to_scan, req, native_engine)
         else:
             # join+prune covered everything: no snapshot was taken and
@@ -592,8 +618,8 @@ class SchedulerCache:
             annotate_current("score_nodes", memo="shared",
                              nodes_reused=reused, nodes_joined=joined,
                              nodes_pruned=len(pruned))
-            scores, fetch_errors, node_errors, stamps, placements = \
-                {}, {}, {}, {}, {}
+            (scores, fetch_errors, node_errors, stamps, placements,
+             scanned_adj) = {}, {}, {}, {}, {}, {}
         # pruned verdicts are NOT folded into the memos: re-deriving
         # them is one O(1) summary read per node, while memoizing tens
         # of thousands of None entries per pod costs more dict plumbing
@@ -617,6 +643,8 @@ class SchedulerCache:
             entry.stamps.update(joined_stamps)
             entry.placements.update(placements)
             entry.placements.update(joined_placements)
+            entry.adjacency.update(scanned_adj)
+            entry.adjacency.update(joined_adjacency)
             if reused:
                 MEMO_NODE_SCORES.inc("reused", n=reused)
             if to_scan:
@@ -658,6 +686,10 @@ class SchedulerCache:
                     {n: p for n, p in placements.items()
                      if n in pub_scores}
                     if owned_fn is not None else placements)
+                sig_entry.adjacency.update(
+                    {n: a for n, a in scanned_adj.items()
+                     if n in pub_scores}
+                    if owned_fn is not None else scanned_adj)
                 EQCLASS_SHARES.inc(
                     "computed", n=len(pub_scores) + len(pub_errors))
             out = ({n: entry.scores[n] for n in node_names
@@ -666,6 +698,10 @@ class SchedulerCache:
                     if n in entry.errors})
             for n, msg in fetch_errors.items():
                 out[1][n] = msg
+            if adjacency is not None:
+                adjacency.update({n: entry.adjacency[n]
+                                  for n in node_names
+                                  if n in entry.adjacency})
         if pruned:
             out[0].update(dict.fromkeys(pruned, None))
         self._verify_served(verify, req)
@@ -676,21 +712,25 @@ class SchedulerCache:
                          native_engine) -> tuple[
                              dict[str, int | None], dict[str, str],
                              dict[str, str], dict[str, tuple[int, int]],
-                             dict[str, Placement]]:
+                             dict[str, Placement], dict[str, int]]:
         """The recompute half of :meth:`score_nodes`: snapshot every
         stale/uncovered node and run the END-TO-END cycle through the
         resident fleet arena (delta-packed; see engine.FleetArena) — one
         ABI v4 native call yields both the binpack score AND the winning
         chip set per node, so Bind's seed lookup stops costing a second
         selection round trip. Returns (scores, fetch_errors,
-        node_errors, stamps, placements); ``placements`` is empty on the
-        v3/TPUSHARE_NO_CYCLE path (callers then re-derive lazily, the
-        old behavior)."""
+        node_errors, stamps, placements, adjacency); ``placements`` is
+        empty on the v3/TPUSHARE_NO_CYCLE path (callers then re-derive
+        lazily, the old behavior), and ``adjacency`` is populated only
+        for mesh-shape requests (the ABI v7 topo cycle emits it in the
+        same pass)."""
         scores: dict[str, int | None] = {}
         fetch_errors: dict[str, str] = {}
         node_errors: dict[str, str] = {}
         stamps: dict[str, tuple[int, int]] = {}
         placements: dict[str, Placement] = {}
+        adjacency: dict[str, int] = {}
+        topo_pref = req.mesh_shape is not None
         entries = []
         for name in missing:
             try:
@@ -716,22 +756,37 @@ class SchedulerCache:
             if resident:
                 if self._arena is None:
                     self._arena = native_engine.FleetArena()
-                for (name, _st, _sn, _tp), (score, placement) in zip(
-                        resident, self._arena.cycle(resident, req)):
+                adj = [None] * len(resident) if topo_pref else None
+                for k, ((name, _st, _sn, _tp), (score, placement)) in \
+                        enumerate(zip(resident, self._arena.cycle(
+                            resident, req, adj=adj))):
                     scores[name] = score
                     if placement is not None:
                         placements[name] = placement
+                    if adj is not None and adj[k] is not None:
+                        adjacency[name] = adj[k]
             if transient:
                 # foreign-shard nodes: a spillover pod must still find
                 # its only fit, but a foreign node never becomes arena-
                 # resident — per-call marshalled cycle, same verdicts
                 nodes = [(snap, topo) for _n, _s, snap, topo in transient]
-                for (name, _st, _sn, _tp), (score, placement) in zip(
-                        transient, native_engine.cycle_fleet(nodes, req)):
-                    scores[name] = score
-                    if placement is not None:
-                        placements[name] = placement
-        return scores, fetch_errors, node_errors, stamps, placements
+                if topo_pref:
+                    for (name, _st, _sn, _tp), (score, placement, a) in \
+                            zip(transient, native_engine.cycle_fleet_topo(
+                                nodes, req)):
+                        scores[name] = score
+                        if placement is not None:
+                            placements[name] = placement
+                        adjacency[name] = a
+                else:
+                    for (name, _st, _sn, _tp), (score, placement) in zip(
+                            transient,
+                            native_engine.cycle_fleet(nodes, req)):
+                        scores[name] = score
+                        if placement is not None:
+                            placements[name] = placement
+        return (scores, fetch_errors, node_errors, stamps, placements,
+                adjacency)
 
     def _verify_pruned(self, pruned: dict[str, tuple[tuple[int, int], str]],
                        req: PlacementRequest,
@@ -955,6 +1010,8 @@ class SchedulerCache:
             entry.scores[node_name] = placement.score
             entry.stamps[node_name] = stamp
             entry.placements[node_name] = placement
+            if req.mesh_shape is not None:
+                entry.adjacency[node_name] = placement.adjacency
             entry.placement_node = node_name
             entry.placement = placement
             entry.placement_stamp = stamp

@@ -42,7 +42,7 @@ from tpushare.contract import node as nodelib
 from tpushare.contract import pod as podlib
 from tpushare.core.chips import ChipSnapshot, ChipView
 from tpushare.core.placement import Placement, PlacementRequest, fits, select_chips
-from tpushare.core.topology import MeshTopology
+from tpushare.core.topology import MeshTopology, occupancy_adjacency
 from tpushare.metrics import Counter, LabeledCounter
 from tpushare.k8s.client import ApiError
 # qos.tiers is a leaf module (contract + stdlib only) — importing it
@@ -264,12 +264,22 @@ class ClaimConflictError(AllocationError):
     replicas are fighting over the same nodes."""
 
 
-def request_from_pod(pod: dict[str, Any]) -> PlacementRequest | None:
+def request_from_pod(pod: dict[str, Any], *,
+                     strict_mesh: bool = False) -> PlacementRequest | None:
     """Translate a pod's resource limits + annotations into a placement
     request. Returns None for non-tpushare pods.
 
     Reference semantics: mem>0 && count==0 -> count=1 (nodeinfo.go:157-159);
-    count>0 means N devices each offering the full per-device amount."""
+    count>0 means N devices each offering the full per-device amount.
+
+    ``strict_mesh`` (Filter only): a malformed mesh-shape annotation
+    raises ValueError so the pod is rejected with a distinct reason
+    instead of silently scheduling shape-blind. Every other verb runs
+    lenient — a malformed pod never passed Filter, so treating its
+    mesh-shape as absent there can only affect a pod that was admitted
+    before the annotation was corrupted. ``TPUSHARE_NO_TOPO_SCORE``
+    ignores the annotation entirely (the byte-identity escape hatch:
+    verdicts match a pre-mesh-shape build exactly)."""
     hbm = contract.pod_hbm_request(pod)
     count = contract.pod_chip_count_request(pod)
     if hbm <= 0 and count <= 0:
@@ -281,12 +291,22 @@ def request_from_pod(pod: dict[str, Any]) -> PlacementRequest | None:
             n *= d
         if n != count:
             topology = None  # inconsistent pin; ignore rather than reject
+    mesh_shape = None
+    if not os.environ.get("TPUSHARE_NO_TOPO_SCORE"):
+        try:
+            mesh_shape = contract.pod_mesh_shape(
+                pod, chip_count=count if count > 0 else 1)
+        except ValueError:
+            if strict_mesh:
+                raise
+            mesh_shape = None
     return PlacementRequest(
         hbm_mib=hbm,
         chip_count=count if count > 0 else 1,
         topology=topology if count > 1 else None,
         allow_scatter=(pod.get("metadata", {}).get("annotations") or {})
         .get("tpushare.aliyun.com/allow-scatter") == "true",
+        mesh_shape=mesh_shape if count > 1 else None,
     )
 
 
@@ -1312,6 +1332,21 @@ class NodeInfo:
         with self._lock:
             return (sum(c.used_hbm_mib for c in self.chips),
                     self.hbm_per_chip * self.chip_count)
+
+    def pod_adjacency(self) -> dict[str, int]:
+        """Per-pod adjacency quality of every multi-chip allocation on
+        this node (``{pod key: 0..ADJ_SCALE}``), computed from the chip
+        coordinates the bound annotations pin. Single-chip entries are
+        skipped — they are trivially 'perfect' and would drown the
+        fleet mean the scorecard reports. Sampler-path only (one lock
+        hold, O(chips)); never on the Filter hot loop."""
+        per_pod: dict[str, list[tuple[int, ...]]] = {}
+        with self._lock:
+            for c in self.chips:
+                for uid in c.pod_uids:
+                    per_pod.setdefault(uid, []).append(c.coords)
+        return {uid: occupancy_adjacency(coords)
+                for uid, coords in per_pod.items() if len(coords) > 1}
 
     def audit_snapshot(self) -> tuple[tuple[int, int],
                                       list[dict[int, int]]]:

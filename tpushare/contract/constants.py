@@ -56,6 +56,14 @@ ANN_NODE_CLAIMS = _PREFIX + "claims"
 # pressure). Set by the workload author, consumed end to end.
 ANN_QOS_TIER = _PREFIX + "qos-tier"
 
+# Declared JAX mesh shape, e.g. "2x4" (docs/perf.md "Mesh-aware
+# placement"): a SOFT adjacency preference, unlike ANN_TOPOLOGY's hard
+# pin — placement prefers a congruent contiguous box and scores its
+# adjacency, but still admits whatever fits. The axis product must
+# equal the requested chip count; malformed values are rejected at
+# Filter with a distinct reason (never silently shape-blind).
+ANN_MESH_SHAPE = _PREFIX + "mesh-shape"
+
 # -- multi-host gang (slice) placement (docs/designs/multihost-gang.md) ------
 # A gang is a SET of pods, one per participating host, linked by id. The
 # whole gang's geometry lives on every member; the coordinator assigns
@@ -87,6 +95,14 @@ ENV_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
 # best-effort trainer) can self-select checkpoint cadence / preemption
 # handling without re-reading its own pod annotations:
 ENV_QOS_TIER = "TPUSHARE_QOS_TIER"
+# The granted chip box's dims ("2x2" label form), injected at Allocate
+# when the granted chips form a contiguous axis-aligned sub-box of the
+# host mesh (absent for scatter grants). TPU_VISIBLE_CHIPS lists chips
+# in ascending id order, which is row-major over this box — together
+# they let a replica lay its JAX Mesh axes along physical ICI adjacency
+# (workloads/serve.py compose_mesh_devices) instead of trusting
+# enumeration order to be geometry:
+ENV_PLACEMENT_BOX = "TPUSHARE_PLACEMENT_BOX"
 
 # -- gang runtime env (injected at Allocate for gang members, r5) ------------
 # The scheduling half of a gang ends at the stamped plan annotations; the

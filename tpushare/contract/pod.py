@@ -26,6 +26,7 @@ from tpushare.contract.constants import (
     ANN_GANG_SIZE,
     ANN_HBM_CHIP,
     ANN_HBM_POD,
+    ANN_MESH_SHAPE,
     ANN_TOPOLOGY,
     RESOURCE_COUNT,
     RESOURCE_HBM,
@@ -114,6 +115,45 @@ def pod_topology_request(pod: Pod) -> tuple[int, ...] | None:
         return MeshTopology.from_label(raw).shape
     except ValueError:
         return None
+
+
+def pod_mesh_shape(pod: Pod,
+                   chip_count: int | None = None
+                   ) -> tuple[int, ...] | None:
+    """Declared JAX mesh shape (soft adjacency preference), or None.
+
+    Unlike :func:`pod_topology_request` — a best-effort hint that
+    degrades to None on garbage — a malformed mesh-shape RAISES
+    ValueError: the pod author declared a performance contract, and
+    silently scheduling it shape-blind would hide the misconfiguration
+    until the replica's collectives run slow (the gang_membership
+    precedent: surface it at Filter time). Checked: every axis a
+    positive integer, and when ``chip_count`` is given the axis product
+    must equal it — a "2x4" mesh on a 4-chip request is a contradiction,
+    not a preference.
+    """
+    raw = annotations(pod).get(ANN_MESH_SHAPE)
+    if raw is None:
+        return None
+    parts = str(raw).strip().split("x")
+    try:
+        shape = tuple(int(p) for p in parts)
+    except ValueError:
+        raise ValueError(
+            f"pod {pod_key(pod)}: mesh-shape {raw!r} must be "
+            f"integers joined by 'x' (e.g. \"2x4\")") from None
+    if not shape or any(d <= 0 for d in shape):
+        raise ValueError(
+            f"pod {pod_key(pod)}: mesh-shape {raw!r} has a "
+            f"non-positive axis")
+    product = 1
+    for d in shape:
+        product *= d
+    if chip_count is not None and product != chip_count:
+        raise ValueError(
+            f"pod {pod_key(pod)}: mesh-shape {raw!r} covers {product} "
+            f"chips but the pod requests {chip_count}")
+    return shape
 
 
 # -- lifecycle predicates ----------------------------------------------------

@@ -102,6 +102,18 @@ BATCH_NATIVE_SOLVES = LabeledCounter(
     "one ABI v4 solve_batch call per batch; python = per-member "
     "interpreter fallback)",
     ("engine",))
+# mesh-aware (topology-scored) placement evaluations for requests
+# carrying a declared mesh-shape: engine=native is the one-call ABI v7
+# tpushare_cycle_fleet_topo scan (congruent-first shape walk + adjacency
+# score in the same GIL-released pass); engine=python is the interpreter
+# spec (pre-v7 .so, TPUSHARE_NO_TOPO_SCORE, or a non-marshallable
+# fleet). Sustained python with a current build means mesh-shape pods
+# silently lost the native win.
+TOPO_SCORES = LabeledCounter(
+    "tpushare_topo_scores_total",
+    "Mesh-aware placement scoring passes by executing engine (native = "
+    "one ABI v7 cycle_fleet_topo call; python = interpreter fallback)",
+    ("engine",))
 
 
 def _build() -> bool:
@@ -281,6 +293,51 @@ def cycle_supported() -> bool:
     return _cycle_fn() is not None
 
 
+def _topo_cycle_fn():
+    """The ABI v7 tpushare_cycle_fleet_topo symbol, or None when
+    mesh-aware (congruent-first) evaluation must run the Python spec
+    (no lib, stale pre-v7 .so, or the TPUSHARE_NO_TOPO_SCORE /
+    TPUSHARE_NO_CYCLE escape hatches — the topo scan IS a cycle
+    variant, so the cycle kill switch covers it too)."""
+    if os.environ.get("TPUSHARE_NO_TOPO_SCORE") \
+            or os.environ.get("TPUSHARE_NO_CYCLE"):
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    fn = getattr(lib, "tpushare_cycle_fleet_topo", None)
+    if fn is not None and not getattr(fn, "_tpushare_typed", False):
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_int,    # n_nodes
+            i64p,            # node chip offsets (n+1)
+            i64p,            # free per chip (concat)
+            i64p,            # total per chip (concat)
+            i64p,            # mesh rank offsets (n+1)
+            i64p,            # mesh dims (concat)
+            ctypes.c_int64,  # req hbm
+            ctypes.c_int,    # req count
+            ctypes.c_int,    # topo rank
+            i64p,            # topo dims
+            ctypes.c_int,    # allow_scatter
+            ctypes.c_int,    # pref (mesh-shape) rank
+            i64p,            # pref dims
+            i64p,            # out scores (n)
+            i64p,            # out chip ids (concat, chip offsets)
+            i64p,            # out box (concat, mesh offsets)
+            i64p,            # out origin (concat, mesh offsets)
+            i64p,            # out adjacency (n; -1 = no placement)
+        ]
+        fn._tpushare_typed = True
+    return fn
+
+
+def topo_cycle_supported() -> bool:
+    """True when mesh-aware scoring runs the one-call ABI v7 path."""
+    return _topo_cycle_fn() is not None
+
+
 def _gang_fn():
     """The ABI v5 tpushare_solve_gang symbol, or None when gang
     placement must run the sequential select_gang + Python-decompose
@@ -394,6 +451,7 @@ def describe() -> "dict":
         "available": available(),
         "abi_version": abi_version(),
         "cycle_supported": cycle_supported(),
+        "topo_cycle_supported": topo_cycle_supported(),
         "gang_solve_supported": gang_solve_supported(),
         "wire_probe_supported": wire_probe_supported(),
         "scan_workers": _scan_workers(),
@@ -706,6 +764,11 @@ def score_fleet(nodes, req: "PlacementRequest",
         p = select_chips_py(chips, topo, req)
         return None if p is None else p.score
 
+    if req.mesh_shape is not None:
+        # congruent-first shape walk: only the ABI v7 topo cycle (or
+        # the Python spec) can express it — the v3 score entry is
+        # shape-blind and would rank a different winning box
+        return [s for s, _p, _a in cycle_fleet_topo(nodes, req, workers)]
     lib = _load()
     if lib is None:
         _fleet_fallback("score", "no_lib")
@@ -778,6 +841,16 @@ def _py_cycle(nodes, req):
     return out
 
 
+def _py_cycle_topo(nodes, req):
+    """Interpreter fallback for a mesh-aware cycle: the Python spec
+    honors ``req.mesh_shape`` inside select_chips_py, so the placements
+    are byte-identical to the v7 scan — adjacency comes off the derived
+    ``Placement.adjacency`` property (-1 = no placement, the same
+    no-placement sentinel the C side writes)."""
+    return [(s, p, -1 if p is None else p.adjacency)
+            for s, p in _py_cycle(nodes, req)]
+
+
 def _placement_from(np_ids, box_arr, origin_arr, rank, req, score):
     """Build a Placement from a cycle/batch out window (node-local chip
     ids; box[0] == -1 marks scatter)."""
@@ -803,6 +876,9 @@ def cycle_fleet(nodes, req: "PlacementRequest", workers: int | None = None,
     and placements are ``None`` (callers recompute lazily, exactly the
     old behavior). ``_count`` suppresses the per-call cycle accounting
     when this runs as the redo half of an arena scan."""
+    if req.mesh_shape is not None:
+        return [(s, p) for s, p, _a
+                in cycle_fleet_topo(nodes, req, workers, _count)]
     fn = _cycle_fn()
     if fn is None:
         if _count:
@@ -880,6 +956,90 @@ def cycle_fleet(nodes, req: "PlacementRequest", workers: int | None = None,
     return results  # type: ignore[return-value]
 
 
+def cycle_fleet_topo(nodes, req: "PlacementRequest",
+                     workers: int | None = None, _count: bool = True
+                     ) -> "list[tuple[int | None, Placement | None, int]]":
+    """Mesh-aware decision cycle per node in one (sharded) ABI v7 scan:
+    ``(best score, winning Placement, adjacency)`` — the topo-scored
+    analogue of :func:`cycle_fleet` for requests carrying a declared
+    ``mesh_shape``. The native entry walks shape classes
+    congruent-first (topology.congruent_first is the spec) and returns
+    each node's best box adjacency (fixed-point,
+    topology.adjacency_quality; -1 = no placement) in the same
+    GIL-released pass, so Prioritize's tier-weighted blend costs zero
+    extra engine calls. On a pre-v7 .so or under
+    ``TPUSHARE_NO_TOPO_SCORE`` every node runs the Python spec —
+    byte-identical placements, just O(nodes) slower."""
+    fn = _topo_cycle_fn()
+    np = None
+    if fn is not None:
+        try:
+            import numpy as np  # noqa: F811
+        except ImportError:
+            np = None
+    marshalled = _marshal_fleet(np, nodes, req) if np is not None else None
+    if fn is None or marshalled is None:
+        if _count:
+            TOPO_SCORES.inc("python")
+        return _py_cycle_topo(nodes, req)
+    dense_idx, free, total, dims, chip_offsets, mesh_offsets = marshalled
+
+    n = len(dense_idx)
+    t_rank = len(req.topology) if req.topology else 0
+    t_dims = (ctypes.c_int64 * max(t_rank, 1))(*(req.topology or (0,)))
+    p_rank = len(req.mesh_shape)
+    p_dims = (ctypes.c_int64 * max(p_rank, 1))(*(req.mesh_shape or (0,)))
+    out_scores = np.zeros(n, np.int64)
+    out_adj = np.zeros(n, np.int64)
+    # same absolute-offset layout contract as cycle_fleet: shards pass
+    # the full arrays and write disjoint windows
+    out_ids = np.zeros(len(free), np.int64)
+    out_box = np.zeros(len(dims), np.int64)
+    out_origin = np.zeros(len(dims), np.int64)
+
+    def call_range(a: int, b: int) -> int:
+        return fn(
+            b - a, _i64p(chip_offsets[a:]), _i64p(free), _i64p(total),
+            _i64p(mesh_offsets[a:]), _i64p(dims),
+            req.hbm_mib, req.chip_count, t_rank, t_dims,
+            1 if req.allow_scatter else 0, p_rank, p_dims,
+            _i64p(out_scores[a:]), _i64p(out_ids), _i64p(out_box),
+            _i64p(out_origin), _i64p(out_adj[a:]))
+
+    rc = _fleet_call(call_range, n, "cycle", workers)
+    if rc != 0:
+        NATIVE_FALLBACKS.inc("engine_error")
+        if _count:
+            TOPO_SCORES.inc("python")
+        return _py_cycle_topo(nodes, req)
+    if _count:
+        TOPO_SCORES.inc("native")
+    results: "list[tuple[int | None, Placement | None, int] | None]" = \
+        [None] * len(nodes)
+    # winner-only Placement materialization, exactly like cycle_fleet;
+    # adjacency is per NODE (that is what the blend consumes)
+    best = _np_best(np, out_scores)
+    for pos, i in enumerate(dense_idx):
+        s = int(out_scores[pos])
+        if s >= 0:
+            if pos == best:
+                c0 = int(chip_offsets[pos])
+                m0 = int(mesh_offsets[pos])
+                rank = int(mesh_offsets[pos + 1]) - m0
+                results[i] = (s, _placement_from(
+                    out_ids[c0:], out_box[m0:], out_origin[m0:], rank,
+                    req, s), int(out_adj[pos]))
+            else:
+                results[i] = (s, None, int(out_adj[pos]))
+        elif s == -1:
+            results[i] = (None, None, -1)
+        # -2: not expressible after all — per-node Python below
+    for i, r in enumerate(results):
+        if r is None:
+            results[i] = _py_cycle_topo([nodes[i]], req)[0]
+    return results  # type: ignore[return-value]
+
+
 def solve_batch(nodes, req: "PlacementRequest", k: int
                 ) -> "list[tuple[int, Placement]]":
     """Place ``k`` identical requests onto ``nodes`` in ONE native call,
@@ -892,6 +1052,11 @@ def solve_batch(nodes, req: "PlacementRequest", k: int
     Prioritize first-best-wins rule)."""
     if k <= 0 or not nodes:
         return []
+    if req.mesh_shape is not None:
+        # the v4 batch entry is shape-blind; mesh-shape members solve
+        # through the Python spec (which honors congruent-first)
+        BATCH_NATIVE_SOLVES.inc("python")
+        return _py_solve_batch(nodes, req, k)
     fn = _batch_fn()
     np = None
     if fn is not None:
@@ -1230,27 +1395,38 @@ class FleetArena:
                                           cycle=False)]
 
     def cycle(self, entries, req: "PlacementRequest",
-              workers: int | None = None
+              workers: int | None = None, adj: "list | None" = None
               ) -> "list[tuple[int | None, Placement | None]]":
         """End-to-end cycle per entry over the resident arena:
         ``(score, winning Placement)`` in ONE ABI v4 native call —
         :meth:`score` plus the chip selection, so the cache's Bind seed
         lookup stops paying a second select round trip. On a pre-v4 .so
         or under ``TPUSHARE_NO_CYCLE`` the scores still flow (v3 path)
-        with placements ``None``."""
-        return self._scan(entries, req, workers, cycle=True)
+        with placements ``None``. For a request carrying ``mesh_shape``
+        the scan runs the ABI v7 topo entry instead, and ``adj`` (a
+        caller-allocated list of len(entries)) receives each node's
+        adjacency score in the same pass."""
+        return self._scan(entries, req, workers, cycle=True, adj=adj)
 
     def _scan(self, entries, req: "PlacementRequest",
-              workers: int | None, cycle: bool
+              workers: int | None, cycle: bool,
+              adj: "list | None" = None
               ) -> "list[tuple[int | None, Placement | None]]":
         if not entries:
             return []
         nodes = [(chips, topo) for _k, _s, chips, topo in entries]
+        topo_pref = req.mesh_shape is not None
 
         def off_arena():
             # not arena-backed: the per-call marshalling path (which
             # owns the fallback accounting); cycle mode keeps its
             # placement outputs when the v4 symbol exists
+            if topo_pref:
+                out3 = cycle_fleet_topo(nodes, req, workers)
+                if adj is not None:
+                    for i, (_s, _p, a) in enumerate(out3):
+                        adj[i] = a
+                return [(s, p) for s, p, _a in out3]
             if cycle:
                 return cycle_fleet(nodes, req, workers)
             return [(s, None) for s in score_fleet(nodes, req, workers)]
@@ -1261,13 +1437,23 @@ class FleetArena:
             import numpy as np
         except ImportError:
             return off_arena()  # counts no_numpy
-        cycle_fn = _cycle_fn() if cycle else None
-        if cycle and cycle_fn is None:
-            # v3 .so or TPUSHARE_NO_CYCLE: the arena still delta-packs
-            # and scores in one call, but placements must be re-derived
-            # by the caller — count the compatibility path once here
-            CYCLE_CALLS.inc("v3")
-            return self._scan(entries, req, workers, False)
+        if topo_pref:
+            # mesh-aware requests always need the cycle-style v7 call
+            # (the v3/v4 entries are shape-blind and would score a
+            # different winning box); absent the symbol, the per-call
+            # path owns the Python-spec fallback
+            cycle_fn = _topo_cycle_fn()
+            if cycle_fn is None:
+                return off_arena()
+        else:
+            cycle_fn = _cycle_fn() if cycle else None
+            if cycle and cycle_fn is None:
+                # v3 .so or TPUSHARE_NO_CYCLE: the arena still
+                # delta-packs and scores in one call, but placements
+                # must be re-derived by the caller — count the
+                # compatibility path once here
+                CYCLE_CALLS.inc("v3")
+                return self._scan(entries, req, workers, False)
 
         with self._lock:
             self._sync(np, entries)
@@ -1340,8 +1526,30 @@ class FleetArena:
             t_dims = (ctypes.c_int64 * max(t_rank, 1))(
                 *(req.topology or (0,)))
             out = np.zeros(n, np.int64)
+            out_adj = np.zeros(n, np.int64) if topo_pref else None
             lib = _load()
-            if cycle_fn is not None:
+            if topo_pref:
+                # v7 one-call topo cycle: same layout contract as the
+                # v4 cycle below, plus the mesh-shape preference in and
+                # the per-node adjacency out
+                p_rank = len(req.mesh_shape)
+                p_dims = (ctypes.c_int64 * max(p_rank, 1))(
+                    *(req.mesh_shape or (0,)))
+                out_ids = np.zeros(len(free_s), np.int64)
+                out_box = np.zeros(len(dims_s), np.int64)
+                out_origin = np.zeros(len(dims_s), np.int64)
+
+                def call_range(a: int, b: int) -> int:
+                    return cycle_fn(
+                        b - a, _i64p(off_s[a:]), _i64p(free_s),
+                        _i64p(total_s), _i64p(moff_s[a:]),
+                        _i64p(dims_s),
+                        req.hbm_mib, req.chip_count, t_rank, t_dims,
+                        1 if req.allow_scatter else 0, p_rank, p_dims,
+                        _i64p(out[a:]), _i64p(out_ids),
+                        _i64p(out_box), _i64p(out_origin),
+                        _i64p(out_adj[a:]))
+            elif cycle_fn is not None:
                 # v4 one-call cycle: ids/geometry land at the gathered
                 # subset's (absolute, rebased) offsets — the same layout
                 # contract the score scan already relies on
@@ -1374,7 +1582,9 @@ class FleetArena:
                 NATIVE_FALLBACKS.inc("engine_error")
                 fallback.extend(i for i, _p, _s in resident)
             else:
-                if cycle_fn is not None:
+                if topo_pref:
+                    TOPO_SCORES.inc("native")
+                elif cycle_fn is not None:
                     CYCLE_CALLS.inc("native")
                 # materialize a Placement for the BEST-scoring slot
                 # only (see cycle_fleet: the seed lookup consumes the
@@ -1391,6 +1601,8 @@ class FleetArena:
                         if current.get(key) is slot \
                                 and slot.stamp == stamp:
                             s = int(out[k])
+                            if adj is not None and out_adj is not None:
+                                adj[i] = int(out_adj[k])
                             if s >= 0:
                                 if cycle_fn is not None and k == best:
                                     c0 = int(off_s[k])
@@ -1409,6 +1621,14 @@ class FleetArena:
                             stale.append(i)
         if stale or fallback:
             redo = stale + fallback
+            if topo_pref:
+                redo3 = cycle_fleet_topo([nodes[i] for i in redo], req,
+                                         workers, _count=False)
+                for i, (s, p, a) in zip(redo, redo3):
+                    results[i] = (s, p)
+                    if adj is not None:
+                        adj[i] = a
+                return results
             if cycle:
                 redo_out = cycle_fleet([nodes[i] for i in redo], req,
                                        workers, _count=False)
@@ -1424,6 +1644,11 @@ def select_chips(chips: "Sequence[ChipView]", topo: "MeshTopology",
                  req: "PlacementRequest") -> "Placement | None":
     from tpushare.core.placement import Placement, select_chips_py
 
+    if req.mesh_shape is not None:
+        # the v3 single-node entry is shape-blind; route through the
+        # one-node v7 topo cycle (which owns the Python-spec fallback)
+        _s, p, _a = cycle_fleet_topo([(chips, topo)], req)[0]
+        return p
     lib = _load()
     if lib is None:
         NATIVE_FALLBACKS.inc("no_lib")
@@ -1486,7 +1711,9 @@ def select_gang_box(slice_topo, views, req, merged=None):
     pass per decision instead of two).
     """
     lib = _load()
-    if lib is None or req.allow_scatter:
+    if lib is None or req.allow_scatter or req.mesh_shape is not None:
+        # mesh-shape gangs: the native box search is shape-blind, and
+        # the congruent preference lives in the Python search order
         return "fallback"
     try:
         fn = lib.tpushare_select_gang
@@ -1638,7 +1865,10 @@ class SliceArena:
         GangPlacement | None (no fit) | "fallback" (engine can't express
         the problem — caller runs the sequential select_gang path)."""
         fn = _gang_fn()
-        if fn is None or req.allow_scatter:
+        if fn is None or req.allow_scatter \
+                or req.mesh_shape is not None:
+            # mesh-shape gangs run the sequential Python search, whose
+            # decomposition walk applies the congruent preference
             return "fallback"
         from tpushare.core.placement import Placement
         from tpushare.core.slice import GangPlacement

@@ -63,6 +63,69 @@ void chip_coords(const int64_t* mesh, int rank, int64_t idx, int64_t* out) {
   for (int i = rank - 1; i >= 0; --i) { out[i] = idx % mesh[i]; idx /= mesh[i]; }
 }
 
+// -- adjacency quality (ABI v7; tpushare/core/topology.py is the spec) -------
+// All-integer fixed point: quality = links * kAdjScale / max_links so the
+// Python and native scores are bit-identical, never float-rounded.
+
+constexpr int64_t kAdjScale = 1000000;
+
+int64_t box_links_of(const std::vector<int64_t>& d) {
+  int64_t n = 1;
+  for (auto x : d) n *= x;
+  int64_t total = 0;
+  for (auto x : d) total += (x - 1) * (n / x);
+  return total;
+}
+
+void max_links_rec(int64_t remaining, int64_t start,
+                   std::vector<int64_t>& dims, int64_t* best) {
+  for (int64_t f = start; f * f <= remaining; ++f) {
+    if (remaining % f == 0) {
+      dims.push_back(f);
+      max_links_rec(remaining / f, f, dims, best);
+      dims.pop_back();
+    }
+  }
+  dims.push_back(remaining);
+  int64_t l = box_links_of(dims);
+  if (l > *best) *best = l;
+  dims.pop_back();
+}
+
+// Max links over ALL factorizations of count (mesh-independent normalizer;
+// mirrors topology.max_box_links including its factor enumeration order).
+int64_t max_box_links_of(int64_t count) {
+  if (count <= 1) return 0;
+  int64_t best = 0;
+  std::vector<int64_t> dims;
+  max_links_rec(count, 2, dims, &best);
+  return best;
+}
+
+// adjacency_quality(count, box): kAdjScale for one chip, 0 for scatter
+// (box == nullptr), -1 for no placement, else scaled links.
+int64_t adjacency_of(int req_count, const int64_t* box, int rank) {
+  if (req_count <= 0) return -1;
+  if (req_count == 1) return kAdjScale;
+  if (box == nullptr) return 0;
+  std::vector<int64_t> d(box, box + rank);
+  return box_links_of(d) * kAdjScale / max_box_links_of(req_count);
+}
+
+// congruent(box, pref): multisets of the >1 dims match — the geometry,
+// not the axis order or 1-padding, is the contract (topology.congruent).
+std::vector<int64_t> nontrivial_sorted(const int64_t* d, int n) {
+  std::vector<int64_t> out;
+  for (int i = 0; i < n; ++i)
+    if (d[i] > 1) out.push_back(d[i]);
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+bool shape_congruent(const Shape& s, const std::vector<int64_t>& pref_nt) {
+  return nontrivial_sorted(s.d.data(), (int)s.d.size()) == pref_nt;
+}
+
 }  // namespace
 
 namespace {
@@ -184,7 +247,24 @@ bool fits_one(int n_chips, const int64_t* free_hbm, const int64_t* total_hbm,
 // CURRENT mutation stamp equals the stamp the entry was installed
 // under — a moved stamp is a miss (Python fallback), never a stale
 // serve.
-extern "C" int64_t tpushare_abi_version() { return 6; }
+//
+// ABI v7 COMPATIBILITY NOTE: v7 adds tpushare_cycle_fleet_topo — the v4
+// cycle entry extended with a mesh-shape soft preference (pref_rank /
+// pref_dims reorder the shape walk congruent-first, stable within each
+// group) and a per-node adjacency-quality output (out_adj, fixed-point
+// [0, 1000000], -1 = no placement), computed in the same GIL-released
+// pass. pref_rank == 0 makes the walk byte-identical to
+// tpushare_cycle_fleet (same impl, same ordering) — the off/absent
+// path never diverges. Every v6 entry point keeps its exact signature
+// and semantics — a v6 caller against a v7 .so is fully compatible; a
+// v7 caller against a v6 .so detects the missing symbol
+// (AttributeError at bind time, engine.py _topo_cycle_fn) and scores
+// adjacency in Python from the returned geometry, which is
+// bit-identical by the fixed-point parity contract
+// (tests/test_topo_properties.py). Offsets stay ABSOLUTE and per-node
+// evaluation independent, so the thread-sharding and resident-arena
+// contracts hold for out_adj too.
+extern "C" int64_t tpushare_abi_version() { return 7; }
 
 // Fleet-wide Filter: one call evaluates every candidate node, avoiding
 // per-node FFI marshalling (the reference's hot loop #1 x #2,
@@ -281,7 +361,13 @@ extern "C" int tpushare_score_fleet(
   return 0;
 }
 
-extern "C" int tpushare_select_chips(
+namespace {
+
+// The shared selector body. pref_rank/pref_dims (ABI v7 mesh-shape soft
+// preference) reorder the shape walk congruent-first; pref_rank == 0
+// leaves the walk byte-identical to the v3 semantics. out_adj (nullable)
+// receives the winner's adjacency quality.
+int select_chips_impl(
     int n_chips,
     const int64_t* free_hbm,   // -1 => ineligible (unhealthy / exclusive-busy)
     const int64_t* total_hbm,
@@ -292,10 +378,13 @@ extern "C" int tpushare_select_chips(
     int topo_rank,             // 0 => any shape
     const int64_t* topo_dims,
     int allow_scatter,
+    int pref_rank,             // 0 => shape-blind walk
+    const int64_t* pref_dims,
     int64_t* out_ids,
     int64_t* out_box,          // out_box[0] == -1 => scattered
     int64_t* out_origin,
-    int64_t* out_score) {
+    int64_t* out_score,
+    int64_t* out_adj) {
   if (n_chips <= 0 || rank <= 0 || req_count <= 0 || req_count > n_chips)
     return req_count > n_chips ? 0 : -1;
   int64_t mesh_n = 1;
@@ -319,6 +408,7 @@ extern "C" int tpushare_select_chips(
     for (int i = 0; i < rank; ++i) out_box[i] = 1;
     chip_coords(mesh, rank, best, out_origin);
     *out_score = free_hbm[best] - demand(best);
+    if (out_adj != nullptr) *out_adj = kAdjScale;
     return 1;
   }
 
@@ -334,6 +424,14 @@ extern "C" int tpushare_select_chips(
     std::vector<int64_t> prefix;
     enum_shapes(mesh, rank, 0, req_count, prefix, shapes);
     std::sort(shapes.begin(), shapes.end(), shape_less);
+    if (pref_rank > 0) {
+      // congruent-first STABLE partition: compactness order preserved
+      // within each group (topology.congruent_first is the spec)
+      std::vector<int64_t> pref_nt = nontrivial_sorted(pref_dims, pref_rank);
+      std::stable_partition(
+          shapes.begin(), shapes.end(),
+          [&](const Shape& s) { return shape_congruent(s, pref_nt); });
+    }
   }
 
   {
@@ -385,6 +483,8 @@ extern "C" int tpushare_select_chips(
           out_origin[i] = best_origin[i];
         }
         *out_score = best_score;
+        if (out_adj != nullptr)
+          *out_adj = adjacency_of(req_count, best_box.data(), rank);
         return 1;
       }
     }
@@ -406,8 +506,33 @@ scatter:
     }
     out_box[0] = -1;
     *out_score = score;
+    if (out_adj != nullptr) *out_adj = adjacency_of(req_count, nullptr, rank);
     return 1;
   }
+}
+
+}  // namespace
+
+extern "C" int tpushare_select_chips(
+    int n_chips,
+    const int64_t* free_hbm,
+    const int64_t* total_hbm,
+    int rank,
+    const int64_t* mesh,
+    int64_t req_hbm,
+    int req_count,
+    int topo_rank,
+    const int64_t* topo_dims,
+    int allow_scatter,
+    int64_t* out_ids,
+    int64_t* out_box,
+    int64_t* out_origin,
+    int64_t* out_score) {
+  return select_chips_impl(
+      n_chips, free_hbm, total_hbm, rank, mesh, req_hbm, req_count,
+      topo_rank, topo_dims, allow_scatter, /*pref_rank=*/0,
+      /*pref_dims=*/nullptr, out_ids, out_box, out_origin, out_score,
+      /*out_adj=*/nullptr);
 }
 
 // Gang selector over a multi-host SLICE mesh (tpushare/core/slice.py
@@ -566,6 +691,58 @@ extern "C" int tpushare_cycle_fleet(
         req_hbm, req_count, topo_rank, topo_dims, allow_scatter,
         out_ids + c0, out_box + m0, out_origin + m0, &score);
     out_scores[n] = rc == 1 ? score : (rc == 0 ? -1 : -2);
+  }
+  return 0;
+}
+
+// -- ABI v7: topology-scored cycle -------------------------------------------
+
+// tpushare_cycle_fleet with a mesh-shape soft preference and adjacency
+// scoring fused into the same pass. pref_rank/pref_dims declare the
+// pod's JAX mesh (e.g. {2, 4}); each node's shape walk runs
+// mesh-congruent shape classes first (stable partition of the
+// compactness order), so the returned box realizes the declared mesh
+// whenever ANY congruent box fits, and out_adj[n] carries the winner's
+// adjacency quality (fixed-point [0, kAdjScale]; kAdjScale for single
+// chip, 0 for scatter, -1 for no placement / not expressible).
+// pref_rank == 0 degrades to exactly tpushare_cycle_fleet's decisions
+// with adjacency scored on the side — the byte-identity escape hatch
+// TPUSHARE_NO_TOPO_SCORE relies on. Same absolute-offset layout and
+// per-node independence as every other fleet entry: thread-sharding
+// and resident-arena subset scans carry over, out_adj[n] is one slot
+// per node.
+extern "C" int tpushare_cycle_fleet_topo(
+    int n_nodes,
+    const int64_t* node_chip_offsets,
+    const int64_t* free_hbm,
+    const int64_t* total_hbm,
+    const int64_t* mesh_rank_offsets,
+    const int64_t* mesh_dims,
+    int64_t req_hbm,
+    int req_count,
+    int topo_rank,
+    const int64_t* topo_dims,
+    int allow_scatter,
+    int pref_rank,
+    const int64_t* pref_dims,
+    int64_t* out_scores,
+    int64_t* out_ids,
+    int64_t* out_box,
+    int64_t* out_origin,
+    int64_t* out_adj) {
+  if (n_nodes < 0) return -1;
+  for (int n = 0; n < n_nodes; ++n) {
+    int64_t c0 = node_chip_offsets[n], c1 = node_chip_offsets[n + 1];
+    int64_t m0 = mesh_rank_offsets[n], m1 = mesh_rank_offsets[n + 1];
+    int64_t score = 0, adj = -1;
+    int rc = select_chips_impl(
+        (int)(c1 - c0), free_hbm + c0, total_hbm + c0,
+        (int)(m1 - m0), mesh_dims + m0,
+        req_hbm, req_count, topo_rank, topo_dims, allow_scatter,
+        pref_rank, pref_dims,
+        out_ids + c0, out_box + m0, out_origin + m0, &score, &adj);
+    out_scores[n] = rc == 1 ? score : (rc == 0 ? -1 : -2);
+    out_adj[n] = rc == 1 ? adj : -1;
   }
   return 0;
 }

@@ -44,13 +44,18 @@ class PlacementRequest:
     normalized to 1 chip. ``hbm_mib == 0`` with ``chip_count > 0`` means
     *exclusive* chips (the whole-device case: only completely-free chips
     qualify). ``topology`` optionally pins the sub-slice shape (e.g. (2, 2));
-    ``allow_scatter`` permits non-contiguous fallback.
+    ``allow_scatter`` permits non-contiguous fallback. ``mesh_shape`` is
+    the SOFT analogue of ``topology``: a declared JAX mesh (e.g. (2, 4))
+    that reorders shape enumeration congruent-first without constraining
+    what is admissible — ``None`` leaves every decision byte-identical
+    to the shape-blind path.
     """
 
     hbm_mib: int
     chip_count: int = 1
     topology: tuple[int, ...] | None = None
     allow_scatter: bool = False
+    mesh_shape: tuple[int, ...] | None = None
 
     def __post_init__(self) -> None:
         if self.hbm_mib < 0 or self.chip_count < 0:
@@ -67,6 +72,14 @@ class PlacementRequest:
                 raise ValueError(
                     f"topology {self.topology} has {n} chips, "
                     f"request asks for {self.chip_count}")
+        if self.mesh_shape is not None:
+            n = 1
+            for d in self.mesh_shape:
+                n *= d
+            if n != self.chip_count or any(d <= 0 for d in self.mesh_shape):
+                raise ValueError(
+                    f"mesh_shape {self.mesh_shape} does not cover "
+                    f"exactly {self.chip_count} chips")
 
     @property
     def exclusive(self) -> bool:
@@ -89,6 +102,17 @@ class Placement:
     @property
     def contiguous(self) -> bool:
         return self.box is not None
+
+    @property
+    def adjacency(self) -> int:
+        """Fixed-point adjacency quality of this placement (see
+        :func:`tpushare.core.topology.adjacency_quality`). A derived
+        property, not a field: every placement anywhere in the system —
+        memo, arena, gang member — scores identically with no new state
+        to keep coherent, and the ABI v7 native scores parity-check
+        against this exact computation."""
+        from tpushare.core.topology import adjacency_quality
+        return adjacency_quality(len(self.chip_ids), self.box)
 
 
 def _eligible(chip: ChipView, req: PlacementRequest) -> bool:
@@ -173,8 +197,16 @@ def select_chips_py(chips: Sequence[ChipView], topo: MeshTopology,
                          score=best.free_hbm_mib - req.chip_demand_mib(best.total_hbm_mib))
 
     by_idx = {c.idx: c for c in chips}
-    shapes = [req.topology] if req.topology is not None \
-        else topo.box_shapes(req.chip_count)
+    if req.topology is not None:
+        shapes = [req.topology]
+    else:
+        shapes = topo.box_shapes(req.chip_count)
+        if req.mesh_shape is not None:
+            # soft preference: mesh-congruent shape classes first, the
+            # compactness order untouched within each group — absent a
+            # congruent fit the walk degrades to the shape-blind order
+            from tpushare.core.topology import congruent_first
+            shapes = congruent_first(shapes, req.mesh_shape)
 
     best_p: Placement | None = None
     for box in shapes:

@@ -25,7 +25,7 @@ from typing import Mapping, Sequence
 
 from tpushare.core.chips import ChipView
 from tpushare.core.placement import Placement, PlacementRequest, _eligible
-from tpushare.core.topology import MeshTopology
+from tpushare.core.topology import MeshTopology, congruent_first
 
 
 @dataclass(frozen=True)
@@ -226,6 +226,14 @@ def _search_gang(slice_topo: SliceTopology,
     merged = slice_topo.global_view(views)
     shapes = [req.topology] if req.topology is not None \
         else mesh.box_shapes(req.chip_count)
+    if req.mesh_shape is not None and req.topology is None:
+        # mesh-declared gangs: congruent global boxes outrank compactness
+        # (the same stable partition select_chips_py applies per host) —
+        # the member decomposition then hands each host a share of a box
+        # the replica's dp x tp Mesh can be laid over without relabeling.
+        # Soft preference only: admissibility and the per-shape-class
+        # first-fit policy below are unchanged.
+        shapes = congruent_first(shapes, req.mesh_shape)
 
     best: tuple[tuple[int, int, tuple[int, ...]], GangPlacement] | None \
         = None

@@ -318,3 +318,119 @@ def _divisors(n: int) -> list[int]:
         if n % d == 0:
             out.append(d)
     return out
+
+
+# -- adjacency quality (mesh-aware placement) --------------------------------
+
+# Fixed-point scale of adjacency_quality: scores are integers in
+# [0, ADJ_SCALE] so the native engine (int64 arithmetic, no doubles)
+# reproduces the Python spec bit-for-bit. -1 is the no-placement
+# sentinel, distinct from a legal 0 (fully scattered chips).
+ADJ_SCALE = 1_000_000
+
+
+def box_links(shape: tuple[int, ...]) -> int:
+    """Internal ICI links of an axis-aligned chip box: sum over axes of
+    ``(d_i - 1) * prod_{j != i} d_j`` — the complement of the discrete
+    surface/perimeter. More internal links means shorter collective
+    rings and more bisection bandwidth for a JAX Mesh laid out over the
+    box; 1-dims contribute zero, so padding a shape with 1s never
+    changes its score."""
+    n = 1
+    for d in shape:
+        n *= d
+    return sum((d - 1) * (n // d) for d in shape)
+
+
+@lru_cache(maxsize=4096)
+def max_box_links(count: int) -> int:
+    """Max of :func:`box_links` over ALL factorizations of ``count``
+    (any rank, mesh-independent) — the normalizer that makes adjacency
+    quality comparable across nodes with different mesh shapes. The
+    native engine mirrors this enumeration exactly."""
+    if count <= 1:
+        return 0
+    best = 0
+
+    def rec(remaining: int, start: int, dims: list[int]) -> None:
+        nonlocal best
+        d = start
+        while d * d <= remaining:
+            if remaining % d == 0:
+                rec(remaining // d, d, dims + [d])
+            d += 1
+        best = max(best, box_links(tuple(dims + [remaining])))
+
+    rec(count, 2, [])
+    return best
+
+
+def adjacency_quality(count: int, box: tuple[int, ...] | None) -> int:
+    """Fixed-point adjacency score of one placement: ``ADJ_SCALE`` for
+    a single chip (nothing to be adjacent to — perfect by definition),
+    0 for scatter (``box=None``), else ``box_links`` scaled against the
+    best achievable for this chip count. Returns -1 for ``count <= 0``
+    (the native engine's no-placement sentinel)."""
+    if count <= 0:
+        return -1
+    if count == 1:
+        return ADJ_SCALE
+    if box is None:
+        return 0
+    return box_links(box) * ADJ_SCALE // max_box_links(count)
+
+
+def congruent(box: tuple[int, ...], mesh_shape: tuple[int, ...]) -> bool:
+    """Does the box realize the declared mesh shape (up to axis order
+    and 1-dims)? A (4, 2) box serves a ``"2x4"`` Mesh by transposing
+    the device array — the geometry, not the orientation, is the
+    performance contract."""
+    return sorted(d for d in box if d > 1) \
+        == sorted(d for d in mesh_shape if d > 1)
+
+
+def congruent_first(shapes: list[tuple[int, ...]],
+                    mesh_shape: tuple[int, ...]) -> list[tuple[int, ...]]:
+    """Stable partition of a compactness-ordered shape list: congruent
+    shapes first, original order preserved within each group — the
+    ordering both :func:`tpushare.core.placement.select_chips_py` and
+    the ABI v7 native cycle apply when a pod declares a mesh shape.
+    Stability is load-bearing: within each group the first-working-
+    shape-class semantics of the shape-blind path are unchanged."""
+    hit = [s for s in shapes if congruent(s, mesh_shape)]
+    miss = [s for s in shapes if not congruent(s, mesh_shape)]
+    return hit + miss
+
+
+def occupancy_adjacency(coords: list[tuple[int, ...]]) -> int:
+    """Adjacency quality of an ALREADY-BOUND allocation, from the chip
+    coordinates its annotations pin. Box allocations (the bounding box
+    is exactly full) score :func:`adjacency_quality` of that box;
+    scattered allocations (holes in the bounding box) score 0, same as
+    ``allow_scatter`` placements at selection time. -1 for an empty
+    coordinate list. Powers the fleet adjacency scorecard — an
+    after-the-fact audit of what Prioritize's blend actually won."""
+    if not coords:
+        return -1
+    rank = len(coords[0])
+    box = tuple(max(c[ax] for c in coords) - min(c[ax] for c in coords) + 1
+                for ax in range(rank))
+    vol = 1
+    for d in box:
+        vol *= d
+    if vol != len(coords):
+        return 0  # holes: not a contiguous axis-aligned box
+    return adjacency_quality(len(coords), box)
+
+
+def gang_hop_span(hmesh: HostMesh, names) -> int:
+    """Worst-case inter-host ICI hop distance across a gang's member
+    hosts: sum over host-grid axes of (coordinate extent - 1). 0 means
+    the gang sits on one host; a 2x1 host pair scores 1. The gang
+    planner prefers member decompositions minimizing this span when the
+    gang declares a mesh shape."""
+    coords = [hmesh.host_coord(n) for n in names]
+    if not coords:
+        return 0
+    return sum(max(c[ax] for c in coords) - min(c[ax] for c in coords)
+               for ax in range(len(hmesh.grid)))

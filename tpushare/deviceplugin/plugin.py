@@ -622,6 +622,25 @@ class DevicePlugin:
         # trusted for enforcement — admission and eviction act on the
         # scheduler's accounting, not on what the container sees.
         env[contract.ENV_QOS_TIER] = pod_tier(chosen)
+        if ids:
+            # contiguous grants carry their box geometry into the
+            # container: chip ids ascend row-major over the box, so the
+            # replica can lay its JAX Mesh along physical ICI adjacency
+            # (workloads/serve.py compose_mesh_devices). Scatter grants
+            # have no box — the env var is simply absent.
+            mesh = self._enumerator.mesh
+            coords = [mesh.coords(i) for i in ids if i < mesh.num_chips]
+            if len(coords) == len(ids):
+                box = tuple(
+                    max(c[ax] for c in coords)
+                    - min(c[ax] for c in coords) + 1
+                    for ax in range(len(mesh.shape)))
+                vol = 1
+                for d in box:
+                    vol *= d
+                if vol == len(ids):
+                    env[contract.ENV_PLACEMENT_BOX] = \
+                        "x".join(str(d) for d in box)
         devices = [by_idx[i].device_path for i in ids if i in by_idx]
         env.update(self._gang_env(chosen))
         log.info("allocate: pod %s/%s -> chips %s (%s MiB/chip)",

@@ -15,6 +15,7 @@ scheduler extender v1 API (vendored reference types.go:258-302):
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any
 
@@ -25,6 +26,7 @@ from tpushare.cache import (
 from tpushare.cache.nodeinfo import no_fit_reason, request_from_pod
 from tpushare.contract import pod as podlib
 from tpushare.core.placement import fragmentation, utilization_pct
+from tpushare.core.topology import ADJ_SCALE
 from tpushare.extender.metrics import LATENCY_BUCKETS, Registry
 from tpushare.extender.wirecache import WireEncoded
 from tpushare.ha.sharding import SHARD_CONFLICTS
@@ -60,6 +62,35 @@ DEGRADED_SERVES = LabeledCounter(
     "apiserver circuit was open (answers are bounded-stale; the bound "
     "is the informer staleness /readyz reports)",
     ("verb",))
+# mesh-aware Prioritize: how hard adjacency pulls against the binpack
+# leftover score. Guaranteed serving replicas get the full configured
+# weight (their dp x tp collectives run every step, so ICI contiguity is
+# throughput), burstable a majority share, best-effort a taste — a
+# best-effort pod should soak fragments, not claim the pristine boxes.
+_TIER_TOPO_FACTOR = {"guaranteed": 1.0, "burstable": 0.6,
+                     "best-effort": 0.3}
+
+
+def topo_weight(pod: dict[str, Any]) -> float:
+    """Effective adjacency blend weight for this pod's tier: the
+    ``TPUSHARE_TOPO_WEIGHT`` knob (default 0.5, clamped to [0, 1])
+    scaled by the QoS tier factor. 0 disables the blend entirely."""
+    try:
+        w = float(os.environ.get("TPUSHARE_TOPO_WEIGHT", "0.5"))
+    except ValueError:
+        w = 0.5
+    w = min(max(w, 0.0), 1.0)
+    return w * _TIER_TOPO_FACTOR.get(pod_tier(pod), 0.3)
+
+
+MESH_SHAPE_REJECTS = Counter(
+    "tpushare_mesh_shape_rejects_total",
+    "Filter calls rejected outright because the pod's mesh-shape "
+    "annotation was malformed (bad grammar, non-positive axis, or a "
+    "product that disagrees with the chip-count request). The pod "
+    "stays Pending with a per-node FailedNodes reason naming the "
+    "defect; fix the annotation and resubmit (alert: a template is "
+    "stamping broken shapes)")
 
 
 class FilterHandler:
@@ -190,7 +221,22 @@ class FilterHandler:
         ok_nodes: list[str] = []
         failed: dict[str, str] = {}
         verdicts: dict[str, dict[str, Any]] = {}
-        req = request_from_pod(pod)
+        # strict_mesh: a malformed mesh-shape annotation is a user error
+        # the author can fix, so Filter rejects every node with a distinct
+        # reason instead of silently scheduling shape-blind. Later verbs
+        # stay lenient — a pod that failed here never reaches them, and
+        # leniency keeps eviction/accounting paths total.
+        try:
+            req = request_from_pod(pod, strict_mesh=True)
+        except ValueError as e:
+            MESH_SHAPE_REJECTS.inc()
+            reason = f"invalid mesh-shape annotation: {e}"
+            audit({n: {"verdict": "rejected", "reason": reason}
+                   for n in node_names if n})
+            log.warning("filter %s: %s", podlib.pod_key(pod), reason)
+            return {"NodeNames": [],
+                    "FailedNodes": {n: reason for n in node_names if n},
+                    "Error": ""}
         node_names = [n for n in node_names if n]
         if req is not None and req.hbm_mib > 0:
             oc = qos_effective_overcommit()
@@ -447,14 +493,23 @@ class PrioritizeHandler:
                 return wire_hit
         had_errors = False
         raw: dict[str, int | None] = {}  # name -> leftover score (lower=tighter)
+        # mesh-shape pods: score_nodes also surfaces each node's best-box
+        # adjacency quality (0..ADJ_SCALE, same stamps as the scores) so
+        # the ranking below can trade binpack tightness against ICI
+        # contiguity. None for everyone else — the shape-blind path is
+        # byte-identical.
+        adjacency: dict[str, int] | None = \
+            {} if req is not None and req.mesh_shape is not None else None
         if req is not None:
             # the memoized fleet pass: when Filter just ran for this pod
             # (the normal webhook sequence), this is a pure dict read —
             # zero native scans, zero snapshot assembly
-            scores, errors = self._cache.score_nodes(pod, req, node_names)
+            scores, errors = self._cache.score_nodes(pod, req, node_names,
+                                                     adjacency=adjacency)
             had_errors = bool(errors)
             for name in node_names:
                 raw[name] = None if name in errors else scores.get(name)
+        w_eff = topo_weight(pod) if adjacency else 0.0
         fitting = [s for s in raw.values() if s is not None]
         lo, hi = (min(fitting), max(fitting)) if fitting else (0, 0)
         out = []
@@ -470,11 +525,27 @@ class PrioritizeHandler:
             else:
                 # tightest (lowest leftover) -> 10, loosest -> 0
                 score = round(self.MAX_PRIORITY * (hi - s) / (hi - lo))
+            if s is not None and w_eff > 0.0:
+                adj = adjacency.get(name)  # type: ignore[union-attr]
+                if adj is not None and adj >= 0:
+                    # tier-weighted blend: binpack pulls toward tight
+                    # nodes, adjacency toward mesh-congruent boxes; the
+                    # tier factor decides who wins the argument
+                    p_adj = self.MAX_PRIORITY * adj / ADJ_SCALE
+                    score = round((1.0 - w_eff) * score + w_eff * p_adj)
             if s is not None and best_name is None:
                 best_name = name  # ties resolve to the first, like max()
             elif s is not None and s < raw[best_name]:  # type: ignore[index]
                 best_name = name
             out.append({"Host": name, "Score": score})
+        if w_eff > 0.0:
+            # Bind's seed hint must chase the node the scheduler will
+            # actually pick — the blended top, not the binpack top
+            ranked = [h for h in out if raw.get(h["Host"]) is not None]
+            if ranked:
+                top = max(h["Score"] for h in ranked)
+                best_name = next(h["Host"] for h in ranked
+                                 if h["Score"] == top)
         if req is not None and best_name is not None:
             # pre-compute the chip selection for the top-ranked node: the
             # scheduler's weighted choice almost always lands there, and
@@ -1087,6 +1158,13 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
 
     registry.register(GANG_SOLVES)
     registry.register(GANG_MEMBERS)
+    # mesh-aware placement set (ABI v7): topo scoring passes by engine
+    # (a sustained python share on a current build = the v7 entry is
+    # missing — stale .so) and Filter rejections of malformed
+    # mesh-shape annotations (a nonzero rate = a pod template is
+    # stamping broken shapes; the FailedNodes reason names the defect)
+    registry.register(_native.TOPO_SCORES)
+    registry.register(MESH_SHAPE_REJECTS)
     registry.gauge_func(
         "tpushare_native_engine_available",
         "1 when the C++ placement engine is loaded, 0 when scans run "

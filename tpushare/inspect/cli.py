@@ -130,6 +130,14 @@ def render_fleet(snap: dict[str, Any]) -> str:
         f"p99 pending age {sc.get('p99_pending_age_s')} s "
         f"({sc.get('cycles', 0)} cycles, {sc.get('binds', 0)} binds, "
         f"{sc.get('pending', 0)} pending)")
+    adj = snap.get("adjacency") or {}
+    if adj.get("placements"):
+        lines.append(
+            f"adjacency: {adj['placements']} multi-chip placements, "
+            f"mean quality {adj.get('mean_quality')}, "
+            f"min {adj.get('min_quality')}, "
+            f"{adj.get('scattered', 0)} scattered "
+            "(1.0 = every placement is its chip count's best box)")
     audit = snap.get("audit") or {}
     drift = audit.get("drift_total") or {}
     total_drift = sum(drift.values())

@@ -67,6 +67,7 @@ from typing import Any, Callable
 from tpushare import contract
 from tpushare.cache.index import EXCL_TIER, TIERS, tier_label
 from tpushare.contract import pod as podlib
+from tpushare.core.topology import ADJ_SCALE
 from tpushare.metrics import Counter, LabeledCounter
 
 # drift kinds are a CLOSED enum (label cardinality):
@@ -289,6 +290,13 @@ class FleetWatch:
         used_mib = 0
         total_mib = 0
         covered = 0
+        # adjacency scorecard: quality of every bound multi-chip
+        # allocation (0..ADJ_SCALE fixed point) — the after-the-fact
+        # audit of the mesh-aware Prioritize blend
+        adj_sum = 0
+        adj_min: int | None = None
+        adj_n = 0
+        adj_scattered = 0
         for name, (_stamp, non_tpu, n_ge, contig_ge,
                    r_ge) in summaries.items():
             info = self._cache.peek_node(name)
@@ -298,6 +306,12 @@ class FleetWatch:
             u, t = info.hbm_usage()
             used_mib += u
             total_mib += t
+            for q in info.pod_adjacency().values():
+                adj_sum += q
+                adj_n += 1
+                adj_min = q if adj_min is None else min(adj_min, q)
+                if q == 0:
+                    adj_scattered += 1
             gaps = stranded_gap_mib(n_ge, contig_ge, info.hbm_per_chip)
             worst_t = max(range(n_tiers), key=lambda ti: gaps[ti])
             for ti in range(n_tiers):
@@ -333,6 +347,14 @@ class FleetWatch:
                 } for ti in range(n_tiers)},
             "fragmented_nodes": len(per_node),
             "top_fragmented": per_node[:self.TOP_K],
+            "adjacency": {
+                "placements": adj_n,
+                "mean_quality": round(adj_sum / (adj_n * ADJ_SCALE), 4)
+                if adj_n else None,
+                "min_quality": round(adj_min / ADJ_SCALE, 4)
+                if adj_min is not None else None,
+                "scattered": adj_scattered,
+            },
         }
         self.scorecard.util_sample(used_mib, total_mib)
         with self._lock:
@@ -544,6 +566,30 @@ class FleetWatch:
             "summarized by the capacity index, fragmented = carrying a "
             "nonzero stranded-HBM gap",
             _nodes)
+
+        def _adjacency() -> list[tuple[str, float]]:
+            with self._lock:
+                sample = self._sample
+            if sample is None:
+                return []
+            adj = sample.get("adjacency") or {}
+            out = [('{stat="placements"}', float(adj.get("placements", 0))),
+                   ('{stat="scattered"}', float(adj.get("scattered", 0)))]
+            for stat in ("mean_quality", "min_quality"):
+                v = adj.get(stat)
+                if v is not None:
+                    out.append((f'{{stat="{stat}"}}', float(v)))
+            return out
+
+        registry.gauge_func(
+            "tpushare_fleet_adjacency_quality",
+            "Adjacency quality of bound multi-chip allocations in the "
+            "latest fleet sample: mean_quality/min_quality are 0..1 "
+            "(1 = every placement is its chip count's best possible "
+            "box), placements/scattered are counts. A falling mean "
+            "under mesh-shape load means binpack is outvoting "
+            "adjacency — raise TPUSHARE_TOPO_WEIGHT (docs/perf.md)",
+            _adjacency)
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -118,6 +118,14 @@ def _build_parser() -> argparse.ArgumentParser:
                          "point (tpushare/sim/qos.py); with --pin, "
                          "re-baseline the tier-1 QoS gate golden "
                          "tests/data/qos_wind_tunnel_golden.json")
+    sg.add_argument("--topo", action="store_true",
+                    help="mesh-aware placement mode: replay the serving "
+                         "mix sweeping TPUSHARE_TOPO_WEIGHT "
+                         "(0/0.25/0.5/1.0) — seed-averaged scorecard, "
+                         "adjacency quality, and serving wait tail per "
+                         "weight (tpushare/sim/topo.py); with --pin, "
+                         "re-baseline the tier-1 topo gate golden "
+                         "tests/data/topo_wind_tunnel_golden.json")
     sg.add_argument("--defrag", action="store_true",
                     help="repack-rebalancer mode: replay a churn trace "
                          "through the defrag planner core, sweeping the "
@@ -205,9 +213,18 @@ def _run(ap, args, emit) -> int:
         ap.error("engine knobs (--batch-window/--index-scheme/"
                  "--eqclass-lru/--defrag-budget/--defrag-period/"
                  "--scatter-util-pct) require --engine native")
-    if args.pin and not (args.autotune or args.qos):
+    if args.pin and not (args.autotune or args.qos or args.topo):
         ap.error("--pin re-baselines a pinned gate: it requires "
-                 "--autotune or --qos")
+                 "--autotune, --qos, or --topo")
+
+    if args.topo:
+        from tpushare.sim import topo
+        out = topo.weight_sweep()
+        if args.pin:
+            out["golden"] = topo.pin_topo_golden()
+            out["golden_path"] = topo.TOPO_GOLDEN_PATH
+        emit(out)
+        return 0
 
     if args.qos:
         from tpushare.sim import qos

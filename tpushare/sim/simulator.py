@@ -48,6 +48,10 @@ class SimPod:
     # sim (tpushare.sim.qos); the classic loops ignore it, so existing
     # traces and goldens are untouched.
     qos_tier: str = "burstable"
+    # declared dp x tp mesh shape (ABI v7): consumed only by the
+    # topology wind tunnel (tpushare.sim.topo); the classic loops and
+    # the `request` property ignore it, so existing goldens hold.
+    mesh_shape: tuple[int, ...] | None = None
 
     @property
     def request(self) -> PlacementRequest:

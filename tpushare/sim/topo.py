@@ -1,0 +1,440 @@
+"""Mesh-aware placement wind tunnel: topology-scored serving replicas.
+
+The classic wind tunnel measures *whether* pods fit; this module
+measures *where* — the ABI v7 question. Serving replicas declare a
+``mesh-shape`` (their dp x tp JAX Mesh) and pay a **step-time tax**
+when the box they land on has poor ICI contiguity: a replica's service
+duration stretches by ``1 + slowdown * (1 - q)`` where ``q`` is the
+achieved box's adjacency quality (:func:`adjacency_quality` fraction;
+0 for scatter). That is the physical claim the tentpole monetizes —
+collectives over a tight box ride short rings; a strung-out or
+scattered replica burns its quantum on hops — rendered as the only
+currency a scheduler simulation speaks: occupancy time.
+
+Two legs replay the SAME trace over the SAME fleet:
+
+- **mesh-aware** — requests carry the declared shape, so per-node
+  selection walks congruent boxes first (``congruent_first``), and the
+  node choice blends binpack leftover with adjacency exactly like the
+  live Prioritize handler (normalize leftovers to 0..10, ``p_adj =
+  10 * adj / ADJ_SCALE``, ``final = round((1-w) * p_bin + w * p_adj)``,
+  first-best ties) at the guaranteed-tier effective weight.
+- **shape-blind** — the identical loop with the shape stripped and
+  weight 0: pure tightest-fit, today's behavior.
+
+Both legs pay the same step-time tax, so the gate's claim is causal:
+the blend buys its lower serving wait tail *by* landing replicas on
+better boxes (the adjacency scorecard must be strictly better), not by
+admitting fewer pods (utilization must hold). Because stretch shifts
+departure times, the two legs' dynamics are COUPLED — a single
+divergent choice cascades — so the pinned gate aggregates over
+``GATE_SEEDS`` to average out placement chaos rather than betting the
+claim on one trajectory. Pinned as
+``tests/data/topo_wind_tunnel_golden.json``; re-pin deliberately with
+``python -m tpushare.sim --topo --pin`` (docs/ops.md).
+
+Everything is a pure function of (fleet, trace, knobs) — no wall
+clock, no ambient randomness — so the golden is byte-reproducible.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import os
+import random
+from dataclasses import dataclass, field, replace
+
+from tpushare.core.placement import PlacementRequest, select_chips_py
+from tpushare.core.topology import ADJ_SCALE, congruent
+from tpushare.sim.simulator import Fleet, SimPod, _p99
+
+# The gate workload: 2x2-replica serving traffic over 2x4 hosts, with
+# single-chip fillers churning fast enough to fragment rows unevenly.
+# Fillers are what make the two legs diverge — they carve nodes into
+# states where one host still has a pristine 2x2 while another (often
+# the binpack-tightest one) only has a 1x4 or worse left.
+TOPO_GATE_FLEET = {"nodes": 8, "chips": 8, "hbm": 16384, "mesh": (2, 4)}
+GATE_TOPO_WEIGHT = 0.5   # TPUSHARE_TOPO_WEIGHT default x guaranteed tier
+GATE_SLOWDOWN = 1.5      # step-time stretch at q=0 (scatter)
+# Chaos-averaging: the gate's numbers are means over these replays.
+GATE_SEEDS = (23, 24, 25, 26, 27)
+
+TOPO_GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "data",
+    "topo_wind_tunnel_golden.json")
+
+# same semantics as qos.QOS_DEFAULT_BANDS: deterministic replays, so
+# bands absorb intended small shifts while a regression cannot hide
+TOPO_DEFAULT_BANDS = {
+    "time_weighted_util_pct": 1.5,
+    "rejection_rate": 0.03,
+    "p99_pending_age_s": 1.0,
+}
+
+# One-sided tolerances for the adjacency scorecard: quality may drift
+# up freely, but a drop past these margins reds the gate. Sized so the
+# shape-blind baseline leg violates every one of them (falsifiability).
+TOPO_ADJ_TOL = {
+    "mean_quality": 0.005,
+    "congruent_rate": 0.02,
+    "stretch_time": 1.5,
+}
+
+
+@dataclass(frozen=True)
+class TopoSpec:
+    """Synthetic serving+filler mix (sizes MiB, times abstract units)."""
+    n_pods: int = 400
+    arrival_rate: float = 28.0
+    serve_fraction: float = 0.4      # 4-chip mesh-declared replicas
+    serve_hbm: int = 6144
+    serve_mean_duration: float = 1.6
+    filler_sizes: tuple[int, ...] = (4096, 8192, 12288)
+    filler_mean_duration: float = 2.4
+    seed: int = 23
+
+
+TOPO_GATE_SPEC = TopoSpec()
+
+
+def synth_topo(spec: TopoSpec) -> list[SimPod]:
+    """Seeded trace: serving replicas declare a (2, 2) mesh; fillers
+    are single-chip and shape-blind. Deterministic in ``spec.seed``."""
+    rng = random.Random(spec.seed)
+    t = 0.0
+    pods = []
+    for _ in range(spec.n_pods):
+        t += rng.expovariate(spec.arrival_rate)
+        if rng.random() < spec.serve_fraction:
+            dur = rng.expovariate(1.0 / spec.serve_mean_duration)
+            pods.append(SimPod(t, dur, spec.serve_hbm, chip_count=4,
+                               qos_tier="guaranteed",
+                               mesh_shape=(2, 2)))
+        else:
+            dur = rng.expovariate(1.0 / spec.filler_mean_duration)
+            pods.append(SimPod(t, dur, rng.choice(spec.filler_sizes)))
+    return pods
+
+
+@dataclass
+class TopoSimReport:
+    mesh_aware: bool
+    topo_weight: float
+    pods: int
+    placed: int
+    never_placed: int
+    mean_wait: float
+    p99_wait: float
+    serve_p99_wait: float        # the gate's headline: replica wait tail
+    util_pct: float              # granted bytes, time-weighted
+    makespan: float
+    # the adjacency scorecard (multi-chip placements only):
+    adj_placements: int
+    adj_mean: float              # 0..1 (1 = best box for the count)
+    adj_min: float
+    congruent_rate: float        # placements landing a declared-shape box
+    stretch_time: float          # total extra occupancy paid to poor q
+    by_kind: dict = field(default_factory=dict)
+    waits: list[float] = field(default_factory=list, repr=False)
+
+    def scorecard(self) -> dict:
+        """Same currency as SimReport.scorecard / fleetwatch."""
+        return {
+            "time_weighted_util_pct": round(self.util_pct, 4),
+            "rejection_rate": round(self.never_placed / self.pods, 4)
+            if self.pods else None,
+            "p99_pending_age_s": round(self.p99_wait, 4),
+        }
+
+    def adjacency(self) -> dict:
+        """Same keys as the live fleet sampler's adjacency scorecard."""
+        return {
+            "placements": self.adj_placements,
+            "mean_quality": round(self.adj_mean, 4),
+            "min_quality": round(self.adj_min, 4),
+            "congruent_rate": round(self.congruent_rate, 4),
+            "stretch_time": round(self.stretch_time, 4),
+        }
+
+    def to_json(self) -> dict:
+        out = {k: (round(v, 4) if isinstance(v, float) else v)
+               for k, v in self.__dict__.items() if k != "waits"}
+        out["scorecard"] = self.scorecard()
+        out["adjacency"] = self.adjacency()
+        return {k: out[k] for k in sorted(out)}
+
+
+def _choose(fleet: Fleet, req: PlacementRequest, topo_weight: float):
+    """One scheduling decision: per-node best placement via the real
+    kernel, node choice via the live Prioritize arithmetic. Returns
+    (node_index, Placement) or None."""
+    cands = []
+    for ni, node in enumerate(fleet.nodes):
+        if node.down:
+            continue
+        p = select_chips_py(node.views(), node.topo, req)
+        if p is not None:
+            cands.append((ni, p))
+    if not cands:
+        return None
+    if req.mesh_shape is None or topo_weight <= 0.0:
+        # tightest fit, first-best ties — _policy_binpack's argmin
+        return min(cands, key=lambda c: (c[1].score, c[0]))
+    scores = [p.score for _ni, p in cands]
+    lo, hi = min(scores), max(scores)
+    best = None
+    for ni, p in cands:
+        p_bin = 10 if hi == lo else round(10 * (hi - p.score) / (hi - lo))
+        p_adj = 10 * p.adjacency / ADJ_SCALE
+        final = round((1.0 - topo_weight) * p_bin + topo_weight * p_adj)
+        key = (-final, ni)  # scheduler picks max score, first-best ties
+        if best is None or key < best[0]:
+            best = (key, ni, p)
+    return best[1], best[2]
+
+
+def run_topo_sim(fleet: Fleet, trace: list[SimPod],
+                 mesh_aware: bool = True,
+                 topo_weight: float = GATE_TOPO_WEIGHT,
+                 slowdown: float = GATE_SLOWDOWN) -> TopoSimReport:
+    """Replay ``trace``; serving durations stretch with poor adjacency.
+
+    ``mesh_aware=False`` strips every declared shape and zeroes the
+    blend weight — the shape-blind baseline leg. The step-time tax
+    applies to BOTH legs (physics does not care what the scheduler
+    knew), which is what makes the A/B causal.
+    """
+    w = topo_weight if mesh_aware else 0.0
+    heap: list[tuple] = []
+    for seq, pod in enumerate(sorted(trace, key=lambda p: p.arrival)):
+        heapq.heappush(heap, (pod.arrival, 1, seq, pod))
+    pending: list[SimPod] = []
+    waits: list[float] = []
+    serve_waits: list[float] = []
+    kind_counts: dict[str, list[int]] = {}
+    for pod in trace:
+        kind = "serve" if pod.mesh_shape is not None else "filler"
+        kind_counts.setdefault(kind, [0, 0])[0] += 1
+    placed = 0
+    adj_samples: list[float] = []
+    congruent_hits = 0
+    stretch_total = 0.0
+    active: dict[int, tuple] = {}
+    now = 0.0
+    last_t = 0.0
+    util_integral = 0.0
+    busy_start: float | None = None
+    seq2 = len(trace)
+
+    def advance(to: float) -> None:
+        nonlocal util_integral, last_t
+        dt = to - last_t
+        if dt > 0:
+            util_integral += fleet.used_hbm * dt
+        last_t = to
+
+    def req_of(pod: SimPod) -> PlacementRequest:
+        return PlacementRequest(
+            hbm_mib=pod.hbm_mib, chip_count=pod.chip_count,
+            topology=pod.topology,
+            mesh_shape=pod.mesh_shape if mesh_aware else None)
+
+    def try_place(pod: SimPod) -> bool:
+        nonlocal placed, seq2, congruent_hits, stretch_total
+        got = _choose(fleet, req_of(pod), w)
+        if got is None:
+            return False
+        ni, p = got
+        node = fleet.nodes[ni]
+        for cid in p.chip_ids:
+            node.used[cid] += pod.hbm_mib
+        q = max(0, p.adjacency) / ADJ_SCALE
+        if pod.chip_count > 1:
+            adj_samples.append(q)
+            if pod.mesh_shape is not None and p.box is not None \
+                    and congruent(p.box, pod.mesh_shape):
+                congruent_hits += 1
+        stretch = pod.duration * slowdown * (1.0 - q)
+        stretch_total += stretch
+        heapq.heappush(heap, (now + pod.duration + stretch, 0, seq2,
+                              (ni, p.chip_ids, pod.hbm_mib)))
+        active[seq2] = (pod, ni, p.chip_ids)
+        seq2 += 1
+        placed += 1
+        kind = "serve" if pod.mesh_shape is not None else "filler"
+        kind_counts.setdefault(kind, [0, 0])[1] += 1
+        waits.append(now - pod.arrival)
+        if pod.mesh_shape is not None:
+            serve_waits.append(now - pod.arrival)
+        return True
+
+    while heap:
+        t, kind, seq_id, payload = heapq.heappop(heap)
+        advance(t)
+        now = t
+        if busy_start is None:
+            busy_start = t
+        if kind == 1:  # arrival
+            if not try_place(payload):
+                pending.append(payload)
+        else:          # departure
+            pod, ni, chip_ids = active.pop(seq_id)
+            node = fleet.nodes[ni]
+            for cid in chip_ids:
+                node.used[cid] -= pod.hbm_mib
+            pending = [q_ for q_ in pending if not try_place(q_)]
+
+    span = max(last_t - (busy_start or 0.0), 1e-9)
+    by_kind = {k: {"pods": n, "placed": pl}
+               for k, (n, pl) in sorted(kind_counts.items())}
+    return TopoSimReport(
+        mesh_aware=mesh_aware,
+        topo_weight=w,
+        pods=len(trace),
+        placed=placed,
+        never_placed=len(pending),
+        mean_wait=sum(waits) / len(waits) if waits else 0.0,
+        p99_wait=_p99(waits),
+        serve_p99_wait=_p99(serve_waits),
+        util_pct=util_integral / (fleet.total_hbm * span) * 100.0,
+        makespan=span,
+        adj_placements=len(adj_samples),
+        adj_mean=sum(adj_samples) / len(adj_samples)
+        if adj_samples else 0.0,
+        adj_min=min(adj_samples) if adj_samples else 0.0,
+        congruent_rate=congruent_hits / len(adj_samples)
+        if adj_samples else 0.0,
+        stretch_time=stretch_total,
+        by_kind=by_kind,
+        waits=waits,
+    )
+
+
+# -- the pinned topo gate -----------------------------------------------------
+
+def _gate_fleet() -> Fleet:
+    return Fleet.homogeneous(
+        TOPO_GATE_FLEET["nodes"], TOPO_GATE_FLEET["chips"],
+        TOPO_GATE_FLEET["hbm"], TOPO_GATE_FLEET["mesh"])
+
+
+def topo_gate_report(mesh_aware: bool = True,
+                     topo_weight: float = GATE_TOPO_WEIGHT,
+                     seed: int | None = None) -> TopoSimReport:
+    """One gate replay: standard serving mix over the standard fleet.
+    ``mesh_aware=False`` is the shape-blind baseline leg."""
+    spec = TOPO_GATE_SPEC if seed is None else replace(TOPO_GATE_SPEC,
+                                                       seed=seed)
+    return run_topo_sim(_gate_fleet(), synth_topo(spec),
+                        mesh_aware=mesh_aware, topo_weight=topo_weight)
+
+
+def gate_aggregate(mesh_aware: bool = True,
+                   topo_weight: float = GATE_TOPO_WEIGHT) -> dict:
+    """Seed-averaged gate numbers — what the golden pins. Means over
+    ``GATE_SEEDS`` so a single chaotic trajectory (stretch perturbs
+    departure times, which perturbs every later choice) cannot decide
+    the A/B either way."""
+    reps = [topo_gate_report(mesh_aware=mesh_aware,
+                             topo_weight=topo_weight, seed=s)
+            for s in GATE_SEEDS]
+    n = len(reps)
+    return {
+        "scorecard": {
+            "time_weighted_util_pct":
+                round(sum(r.util_pct for r in reps) / n, 4),
+            "rejection_rate":
+                round(sum(r.never_placed / r.pods for r in reps) / n, 4),
+            "p99_pending_age_s":
+                round(sum(r.p99_wait for r in reps) / n, 4),
+        },
+        "adjacency": {
+            "placements": sum(r.adj_placements for r in reps),
+            "mean_quality":
+                round(sum(r.adj_mean for r in reps) / n, 4),
+            "min_quality": round(min(r.adj_min for r in reps), 4),
+            "congruent_rate":
+                round(sum(r.congruent_rate for r in reps) / n, 4),
+            "stretch_time":
+                round(sum(r.stretch_time for r in reps) / n, 4),
+        },
+        "serve_p99_wait":
+            round(sum(r.serve_p99_wait for r in reps) / n, 4),
+    }
+
+
+def weight_sweep(values: tuple[float, ...] = (0.0, 0.25, 0.5, 1.0)
+                 ) -> dict:
+    """Sweep TPUSHARE_TOPO_WEIGHT over the gate workload — the tuning
+    question docs/perf.md sends operators here to answer. Weight 0.0 is
+    byte-identical to the shape-blind leg (the blend multiplies out)."""
+    rows = []
+    for v in values:
+        agg = gate_aggregate(mesh_aware=v > 0.0, topo_weight=v)
+        rows.append({"topo_weight": v, **agg})
+    return {"mode": "topo-sweep", "seeds": list(GATE_SEEDS),
+            "rows": rows}
+
+
+def pin_topo_golden(path: str | None = None,
+                    bands: dict | None = None) -> dict:
+    """Write the topo gate golden: the seed-averaged mesh-aware
+    scorecard, the shape-blind baseline it must beat, and the adjacency
+    evidence. Deliberate re-baselining ONLY (docs/ops.md)."""
+    agg = gate_aggregate()
+    base = gate_aggregate(mesh_aware=False)
+    golden = {
+        "gate_spec": {"n_pods": TOPO_GATE_SPEC.n_pods,
+                      "arrival_rate": TOPO_GATE_SPEC.arrival_rate,
+                      "serve_fraction": TOPO_GATE_SPEC.serve_fraction,
+                      "seeds": list(GATE_SEEDS)},
+        "gate_fleet": {k: (list(v) if isinstance(v, tuple) else v)
+                       for k, v in TOPO_GATE_FLEET.items()},
+        "topo_weight": GATE_TOPO_WEIGHT,
+        "slowdown": GATE_SLOWDOWN,
+        **agg,
+        "baseline": base,
+        "bands": dict(bands or TOPO_DEFAULT_BANDS),
+    }
+    path = path or TOPO_GOLDEN_PATH
+    with open(path, "w") as f:
+        json.dump(golden, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return golden
+
+
+def load_topo_golden(path: str | None = None) -> dict:
+    with open(path or TOPO_GOLDEN_PATH) as f:
+        return json.load(f)
+
+
+def check_topo(agg: dict, golden: dict) -> list[str]:
+    """Compare a gate aggregate against the pinned golden. Scorecard
+    metrics are two-sided (within ``bands``); the adjacency scorecard
+    is one-sided (improvement is free, degradation past ``TOPO_ADJ_TOL``
+    is a violation); the headline serving tail must keep beating the
+    pinned shape-blind baseline."""
+    from tpushare.sim.autotune import check_scorecard
+    violations = check_scorecard(agg["scorecard"], golden)
+    adj, g = agg["adjacency"], golden["adjacency"]
+    for key, tol in TOPO_ADJ_TOL.items():
+        got, want = adj.get(key), g[key]
+        if got is None:
+            violations.append(f"adjacency.{key}: missing")
+        elif key == "stretch_time":
+            if got > want + tol:
+                violations.append(
+                    f"adjacency.{key}: {got} exceeds pinned {want} "
+                    f"by more than {tol}")
+        elif got < want - tol:
+            violations.append(
+                f"adjacency.{key}: {got} below pinned {want} "
+                f"by more than {tol}")
+    base_p99 = golden["baseline"]["serve_p99_wait"]
+    if not agg["serve_p99_wait"] < base_p99:
+        violations.append(
+            f"serve_p99_wait: {agg['serve_p99_wait']} does not beat "
+            f"the pinned shape-blind baseline {base_p99}")
+    return violations

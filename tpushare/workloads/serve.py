@@ -15,6 +15,90 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import itertools
+
+
+def compose_mesh_devices(devices, box_label, axes_shape):
+    """Order ``devices`` into a physical-adjacency-aligned device array
+    of ``axes_shape`` (e.g. ``(1, tp)`` or ``(1, tp, ep)``).
+
+    ``devices`` is the JAX device list, which on a tpushare grant is
+    ``TPU_VISIBLE_CHIPS`` order — ascending chip ids, i.e. row-major
+    over the granted box the device plugin reports via
+    ``TPUSHARE_PLACEMENT_BOX`` (``box_label``, \"2x2\" form). When the
+    box's non-trivial dims match the non-trivial logical axes (the
+    mesh-shape annotation made the extender prefer exactly such a box),
+    the devices are reshaped over the box and the box axes transposed
+    onto the logical axes — each logical axis then walks a physical
+    mesh line, so collectives over it ride contiguous ICI links. Any
+    mismatch (no label, scatter grant, incongruent shapes) degrades to
+    the plain ``reshape`` order serve always used.
+
+    Pure function of its inputs (unit-tested without a TPU); the
+    returned nested list feeds ``np.array(...)`` / ``Mesh`` unchanged.
+    """
+    n = 1
+    for d in axes_shape:
+        n *= d
+    devs = list(devices[:n])
+    if len(devs) < n or not box_label:
+        return devs if len(axes_shape) == 1 else _reshape(devs, axes_shape)
+    try:
+        box = tuple(int(p) for p in str(box_label).lower().split("x"))
+    except ValueError:
+        return _reshape(devs, axes_shape)
+    vol = 1
+    for d in box:
+        vol *= d
+    nt_box = [d for d in box if d > 1]
+    nt_axes = [d for d in axes_shape if d > 1]
+    if vol != n or any(d <= 0 for d in box):
+        return _reshape(devs, axes_shape)
+    strides = []
+    acc = 1
+    for d in reversed(nt_box):
+        strides.append(acc)
+        acc *= d
+    strides = list(reversed(strides))
+    if sorted(nt_box) != sorted(nt_axes):
+        if len(nt_axes) == 1 and len(nt_box) > 1:
+            # one logical axis over a multi-axis box (plain tp over a
+            # 2x2 grant): walk the box boustrophedon — consecutive ring
+            # members are then always 1 ICI hop apart, where row-major
+            # pays a full edge length at every row boundary
+            ordered = []
+            for c in itertools.product(*[range(d) for d in nt_box]):
+                eff = []
+                for ax, v in enumerate(c):
+                    if ax and sum(eff) % 2:
+                        v = nt_box[ax] - 1 - v
+                    eff.append(v)
+                ordered.append(devs[sum(v * s
+                                        for v, s in zip(eff, strides))])
+            return _reshape(ordered, axes_shape)
+        return _reshape(devs, axes_shape)
+    # congruent: index the flat (row-major over box) list by box coords,
+    # read it out with the box axes permuted onto the logical axes order
+    for perm in itertools.permutations(range(len(nt_box))):
+        if [nt_box[p] for p in perm] == nt_axes:
+            ordered = [
+                devs[sum(c[i] * strides[perm[i]]
+                         for i in range(len(perm)))]
+                for c in itertools.product(*[range(d) for d in nt_axes])]
+            return _reshape(ordered, axes_shape)
+    return _reshape(devs, axes_shape)
+
+
+def _reshape(flat, shape):
+    """Row-major nested-list reshape (np.array(out).shape == shape)."""
+    if len(shape) == 1:
+        return list(flat)
+    sub = 1
+    for d in shape[1:]:
+        sub *= d
+    return [_reshape(flat[i * sub:(i + 1) * sub], shape[1:])
+            for i in range(shape[0])]
+
 
 class _EngineFrontend:
     """Queue + single engine thread between HTTP handlers and a
@@ -290,6 +374,13 @@ def main(argv: list[str] | None = None) -> int:
         attn_window=args.attn_window or None).validate()
     devices = jax.devices()
     tp = args.tp or len(devices)
+    # the granted box's geometry, when the device plugin injected it:
+    # lets the logical mesh axes walk physical ICI lines instead of
+    # trusting device enumeration order (absent = old behavior)
+    import os as _os
+
+    from tpushare import contract as _contract
+    box_label = _os.environ.get(_contract.ENV_PLACEMENT_BOX)
     if cfg.moe_experts > 0:
         # MoE presets shard experts over "ep": give that axis the devices
         # (largest divisor of tp that divides n_experts) and the rest to tp.
@@ -299,10 +390,11 @@ def main(argv: list[str] | None = None) -> int:
                 ep = cand
                 break
         tp //= ep
-        mesh = Mesh(np.array(devices[:tp * ep]).reshape(1, tp, ep),
-                    ("dp", "tp", "ep"))
+        mesh = Mesh(np.array(compose_mesh_devices(
+            devices, box_label, (1, tp, ep))), ("dp", "tp", "ep"))
     else:
-        mesh = Mesh(np.array(devices[:tp]).reshape(1, tp), ("dp", "tp"))
+        mesh = Mesh(np.array(compose_mesh_devices(
+            devices, box_label, (1, tp))), ("dp", "tp"))
 
     params = init_params(cfg, jax.random.key(0))
     specs = param_specs(cfg)
