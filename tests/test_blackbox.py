@@ -1,0 +1,227 @@
+"""Fleet black box (ISSUE 19): the native event ring and its pump.
+
+PR 16's zero-Python steady state made the fast path invisible — a
+digest-hit Filter is served with the GIL released and leaves no trace,
+no explain record and no honest latency sample. These tests pin the
+properties that make the ABI v8 ring + RingPump a truthful fix:
+
+- **overflow is loud, never corrupt** — a full ring drops and counts;
+  every drained event still decodes (``tpushare_blackbox_dropped_total``
+  carries the loss, the data carries no garbage);
+- **one serve, one sample** — with the pump running, the phase
+  histogram gets exactly one observation per probe (the ring's native
+  tick delta), not the Python envelope on top;
+- **zero unexplained pods** — a native-heavy storm over a real socket
+  leaves every pod with a truthful ``source: native`` explain record
+  (the regression this PR exists to close).
+
+Skipped wholesale when the shared object lacks the v8 entry points
+(stale ``.so`` → graceful degrade).
+"""
+
+import http.client
+import json
+
+import pytest
+
+from tests.test_contract import make_pod
+from tpushare.cache import SchedulerCache
+from tpushare.core.native import engine as native_engine
+from tpushare.extender import nativewire
+from tpushare.extender.nativewire import PROBE_HIT
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+from tpushare.obs import blackbox as bb
+
+pytestmark = pytest.mark.skipif(
+    not (native_engine.wire_probe_supported()
+         and native_engine.blackbox_supported()),
+    reason="native black-box ring unavailable")
+
+FILTER_PATH = "/tpushare-scheduler/filter"
+NAMES = [f"n{i}" for i in range(6)]
+
+
+def http_bytes(path: str, body: bytes) -> bytes:
+    return (f"POST {path} HTTP/1.1\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+
+
+def drain_raw(batch: int = 1024) -> list[tuple]:
+    rows = []
+    while True:
+        got = native_engine.blackbox_drain(batch)
+        if not got:
+            return rows
+        rows.extend(got)
+
+
+@pytest.fixture
+def rig():
+    fc = FakeCluster()
+    for i in range(6):
+        fc.add_tpu_node(f"n{i}", chips=4, hbm_per_chip_mib=16000,
+                        mesh="2x2")
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    srv = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    assert srv.nativewire.enabled
+    # start from a quiet ring: no leftovers from a previous test
+    native_engine.blackbox_disable()
+    drain_raw()
+    yield fc, cache, srv
+    srv.nativewire.close()
+    native_engine.blackbox_disable()
+    nativewire.RING_LATENCY_ACTIVE = False
+    drain_raw()
+
+
+def serve_py(srv, body: bytes) -> bytes:
+    status, payload, _ = srv.handle_post(FILTER_PATH, body)
+    assert status == 200
+    return payload
+
+
+def prime(srv, body: bytes) -> bytes:
+    """Two Python serves: the first installs, the second re-installs
+    under the settled stamp (and registers the digest map entry)."""
+    serve_py(srv, body)
+    return serve_py(srv, body)
+
+
+def armed_frame(srv, hbm: int = 1024) -> bytes:
+    body = json.dumps({"Pod": make_pod(hbm=hbm),
+                       "NodeNames": NAMES}).encode()
+    prime(srv, body)
+    return http_bytes(FILTER_PATH, body)
+
+
+def test_ring_captures_probe_events_with_native_timing(rig):
+    fc, cache, srv = rig
+    raw = armed_frame(srv)
+    native_engine.blackbox_enable()
+    try:
+        for _ in range(5):
+            rc, _, _ = srv.nativewire.probe_request(bytearray(raw))
+            assert rc == PROBE_HIT
+        rows = drain_raw()
+    finally:
+        native_engine.blackbox_disable()
+    hits = [r for r in rows if r[0] == bb.KIND_WIRE_PROBE
+            and bb.decode_wire_outcome(r[1])[0] == 1]
+    assert len(hits) == 5
+    for _kind, outcome, t_ns, dur_ns, span8, rem8 in hits:
+        rc, verb_id = bb.decode_wire_outcome(outcome)
+        assert (rc, verb_id) == (1, 0)  # hit, filter
+        assert t_ns > 0
+        assert 0 < dur_ns < 1_000_000_000  # native µs-scale, not garbage
+        assert (span8, rem8) != (0, 0)  # digest prefixes travelled
+
+
+def test_ring_overflow_drops_counted_never_corrupted(rig):
+    """5000 un-drained probes into a 4096-slot ring: the producer must
+    drop and count, and everything that IS drained must still decode —
+    and the pump must surface the loss as the dropped-total counter."""
+    fc, cache, srv = rig
+    raw = armed_frame(srv)
+    native_engine.blackbox_enable()
+    dropped0 = native_engine.blackbox_stats()["dropped_total"]
+    metric0 = bb.BLACKBOX_DROPPED.value
+    try:
+        for _ in range(5000):
+            rc, _, _ = srv.nativewire.probe_request(bytearray(raw))
+            assert rc == PROBE_HIT  # drop-on-full never fails the serve
+        ring_dropped = (native_engine.blackbox_stats()["dropped_total"]
+                        - dropped0)
+        assert ring_dropped > 0
+        # the pump turns the cumulative ring count into metric deltas
+        pumped = srv.blackbox.drain_once()
+    finally:
+        native_engine.blackbox_disable()
+    assert 0 < pumped <= 4096
+    assert bb.BLACKBOX_DROPPED.value - metric0 >= ring_dropped
+    for kind, outcome, t_ns, dur_ns, _s8, _r8 in drain_raw():
+        assert kind in bb.KINDS
+        assert t_ns > 0 and dur_ns >= 0
+        if kind == bb.KIND_WIRE_PROBE:
+            rc, verb_id = bb.decode_wire_outcome(outcome)
+            assert rc in bb.WIRE_OUTCOMES
+            assert verb_id in (0, 1, 255)
+
+
+def test_pump_attributes_native_latency_exactly_once(rig):
+    """Satellite: with the pump active the histogram's samples are the
+    ring's tick deltas — exactly one per probe, the serve path's
+    perf_counter envelope suppressed (no double count)."""
+    fc, cache, srv = rig
+    raw = armed_frame(srv)
+    hist0 = sum(nativewire.WIRE_NATIVE_PROBE_SECONDS.state()["counts"])
+    native_engine.blackbox_enable()
+    nativewire.RING_LATENCY_ACTIVE = True
+    try:
+        for _ in range(7):
+            rc, _, _ = srv.nativewire.probe_request(bytearray(raw))
+            assert rc == PROBE_HIT
+        assert srv.blackbox.drain_once() == 7
+    finally:
+        nativewire.RING_LATENCY_ACTIVE = False
+        native_engine.blackbox_disable()
+    hist1 = sum(nativewire.WIRE_NATIVE_PROBE_SECONDS.state()["counts"])
+    assert hist1 - hist0 == 7
+
+
+def test_native_storm_leaves_zero_unexplained_pods(rig):
+    """The regression this PR closes: a native-heavy storm over a real
+    socket must leave EVERY pod with a truthful ``source: native``
+    explain record (joined through the digest map), honest per-serve
+    durations, and — with the pin threshold at zero — native serves in
+    the flight recorder."""
+    fc, cache, srv = rig
+    port = srv.start()  # starts the ring pump alongside fleetwatch
+    try:
+        srv.tracer.recorder.slow_ms = 0.0  # pin every native serve
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        pods = [make_pod(hbm=256 * (i + 1), name=f"storm-{i}")
+                for i in range(6)]
+        for pod in pods:
+            body = json.dumps({"Pod": pod, "NodeNames": NAMES}).encode()
+            for _ in range(7):  # 2 python serves arm, then 5 native hits
+                conn.request("POST", FILTER_PATH, body,
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                assert r.status == 200
+                r.read()
+        conn.close()
+        assert srv.nativewire.stats()["hits"] >= 5 * len(pods)
+        srv.blackbox.drain_once()  # deterministic: don't wait on period
+        events0 = bb.BLACKBOX_EVENTS.get("wire_probe", "hit")
+        assert events0 >= 5 * len(pods)
+        for i in range(len(pods)):
+            out = srv.explain.get(f"default/storm-{i}")
+            assert out is not None, f"storm-{i} unexplained"
+            native = [c["filter"] for c in out["cycles"]
+                      if c.get("filter", {}).get("source") == "native"]
+            assert native, f"storm-{i} has no source=native record"
+            assert native[-1]["duration_ms"] is not None
+            assert native[-1]["ok"] == len(NAMES)
+        pinned = srv.tracer.recorder.pinned()
+        assert any(getattr(t, "outcome", "") == "native_serve"
+                   for t in pinned)
+    finally:
+        srv.stop()
+
+
+def test_pump_stop_restores_python_side_latency(rig):
+    fc, cache, srv = rig
+    pump = srv.blackbox
+    pump.start()
+    assert nativewire.RING_LATENCY_ACTIVE
+    pump.stop()
+    assert not nativewire.RING_LATENCY_ACTIVE
+    # after stop the serve path observes its own envelope again
+    raw = armed_frame(srv)
+    hist0 = sum(nativewire.WIRE_NATIVE_PROBE_SECONDS.state()["counts"])
+    rc, _, _ = srv.nativewire.probe_request(bytearray(raw))
+    assert rc == PROBE_HIT
+    hist1 = sum(nativewire.WIRE_NATIVE_PROBE_SECONDS.state()["counts"])
+    assert hist1 - hist0 == 1

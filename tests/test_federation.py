@@ -1,0 +1,218 @@
+"""Cross-process metrics federation (ISSUE 19).
+
+``--procs N`` runs N whole-server replicas behind one SO_REUSEPORT
+socket; each keeps its own registry, so a bare ``/metrics`` scrape
+undercounts the fleet by the replica factor. These tests pin the
+properties that make the shared-memory federation segment a truthful
+fix:
+
+- **merge is arithmetic** — counters and per-series labeled counters
+  sum, histogram bucket counts sum bucket-wise, gauges never federate;
+- **the segment is the wire** — two publishers on one segment each see
+  the other's snapshot merged with their own live registry;
+- **death freezes, never loses** — a crashed replica's slot stops
+  updating but its last snapshot keeps being merged (monotone counters:
+  freezing loses the tail, never the history);
+- **the HTTP surface holds** — ``/metrics/federated`` equals the sum of
+  the per-replica registries, carries no gauge series, and degrades to
+  the local registry when federation is disabled.
+"""
+
+import http.client
+import json
+import os
+
+import pytest
+
+from tpushare.cache import SchedulerCache
+from tpushare.extender import federation as fedlib
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s import FakeCluster
+from tpushare.metrics import (
+    Histogram,
+    Registry,
+    expose_merged,
+    merge_states,
+)
+
+
+def _registry(binds: float, hits_by_verb: dict[str, float],
+              samples: list[float]) -> Registry:
+    r = Registry()
+    c = r.counter("t_binds_total", "binds")
+    c.inc(binds)
+    lc = r.labeled_counter("t_hits_total", "hits", ("verb",))
+    for verb, n in hits_by_verb.items():
+        lc.inc(verb, n=n)
+    h = r.histogram("t_latency_seconds", "lat", buckets=(0.1, 1.0))
+    for s in samples:
+        h.observe(s)
+    r.gauge_func("t_free_chips", "free", lambda: [("", 12.0)])
+    return r
+
+
+def test_merge_states_sums_counters_series_and_buckets():
+    a = _registry(3, {"filter": 2, "bind": 1}, [0.05, 0.5])
+    b = _registry(4, {"filter": 5}, [0.5, 5.0])
+    merged = merge_states([a.federation_state(), b.federation_state()])
+    assert merged["t_binds_total"]["value"] == 7
+    series = {tuple(k): v for k, v in merged["t_hits_total"]["series"]}
+    assert series == {("filter",): 7, ("bind",): 1}
+    hist = merged["t_latency_seconds"]
+    assert hist["counts"] == [1, 2, 1]  # [<=0.1, <=1.0, +Inf] summed
+    assert hist["sum"] == pytest.approx(6.05)
+    # gauges are per-process statements about one shared fleet: summing
+    # them double-counts, so they must never enter the federation
+    assert "t_free_chips" not in merged
+    text = expose_merged(merged)
+    assert "t_binds_total 7" in text
+    assert 't_hits_total{verb="filter"} 7' in text
+    assert "t_free_chips" not in text
+
+
+def test_merge_skips_shape_conflicts_keeps_first():
+    a = {"m": {"type": "counter", "help": "h", "value": 1.0}}
+    b = {"m": {"type": "histogram", "help": "h", "buckets": [1.0],
+               "counts": [1, 0], "sum": 0.5}}
+    merged = merge_states([a, b])
+    assert merged["m"]["type"] == "counter"
+    assert merged["m"]["value"] == 1.0
+
+
+def _segment(reg, path, **kw) -> fedlib.FederationSegment:
+    return fedlib.FederationSegment(reg, port=0, path=path,
+                                    nslots=4, slot_size=64 * 1024,
+                                    period_s=60.0, **kw)
+
+
+def test_two_publishers_one_segment_merge_to_the_sum(tmp_path):
+    path = str(tmp_path / "fed.seg")
+    ra = _registry(10, {"filter": 4}, [])
+    rb = _registry(5, {"filter": 1, "bind": 2}, [])
+    a, b = _segment(ra, path), _segment(rb, path)
+    try:
+        assert a.start() and b.start()
+        assert a.slot != b.slot
+        assert b.publish_once()
+        merged, meta = a.merged_state()
+        assert merged["t_binds_total"]["value"] == 15
+        series = {tuple(k): v
+                  for k, v in merged["t_hits_total"]["series"]}
+        assert series == {("filter",): 5, ("bind",): 2}
+        assert meta["replica_count"] == 2
+        # the local registry is live: an un-published increment on the
+        # ANSWERING replica is already in the merge
+        ra.get("t_binds_total").inc(1)
+        merged2, _ = a.merged_state()
+        assert merged2["t_binds_total"]["value"] == 16
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_dead_replica_slot_is_frozen_but_still_merged(tmp_path):
+    path = str(tmp_path / "fed.seg")
+    parent = _segment(_registry(100, {}, []), path)
+    try:
+        assert parent.start()
+        pid = os.fork()
+        if pid == 0:  # the replica that will crash
+            try:
+                child = _segment(_registry(7, {"filter": 3}, []), path)
+                child.start()  # claims its own slot, publishes once
+            finally:
+                os._exit(0)  # no stop(): die with the slot claimed
+        _, status = os.waitpid(pid, 0)
+        assert status == 0
+        merged, meta = parent.merged_state()
+        assert merged["t_binds_total"]["value"] == 107
+        dead = [r for r in meta["replicas"] if not r["self"]]
+        assert len(dead) == 1 and not dead[0]["alive"]
+        # a third replica prefers an EMPTY slot over the frozen one, so
+        # the dead history keeps merging as long as the segment has room
+        late = _segment(_registry(1, {}, []), path)
+        try:
+            assert late.start()
+            assert late.slot not in (parent.slot, dead[0]["slot"])
+            merged3, _ = late.merged_state()
+            assert merged3["t_binds_total"]["value"] == 108
+        finally:
+            late.stop()
+    finally:
+        parent.stop()
+
+
+@pytest.fixture
+def served(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUSHARE_FEDERATION_PATH",
+                       str(tmp_path / "srv.seg"))
+    fc = FakeCluster()
+    for i in range(4):
+        fc.add_tpu_node(f"n{i}", chips=4, hbm_per_chip_mib=16000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    srv = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+def _get(port: int, path: str) -> tuple[int, str, str]:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    ctype = r.getheader("Content-Type") or ""
+    conn.close()
+    return r.status, body, ctype
+
+
+def test_federated_scrape_equals_registry_sum_no_gauges(served):
+    srv, port = served
+    assert srv.federation is not None  # the segment came up
+    peer = _segment(_registry(41, {}, []), srv.federation.path)
+    try:
+        assert peer.start()
+        status, body, ctype = _get(port, "/metrics/federated")
+        assert status == 200
+        assert "text/plain" in ctype
+        assert "# TYPE t_binds_total counter" in body
+        assert "t_binds_total 41" in body  # the peer's slot merged in
+        # every federated value is the sum across replicas: the local
+        # native-serve counter must match the live registry exactly
+        local = srv.registry.get(
+            "tpushare_wire_native_serves_total")
+        if local is not None:
+            fed_total = sum(v for line in body.splitlines()
+                            if line.startswith(
+                                "tpushare_wire_native_serves_total")
+                            for v in [float(line.rsplit(" ", 1)[1])])
+            assert fed_total == sum(local.snapshot().values())
+        # gauges stay per-process: none may appear in the federated sum
+        assert "tpushare_fleet_free_chips" not in body
+        snap_status, snap_body, _ = _get(
+            port, "/inspect/fleet?federated=1")
+        assert snap_status == 200
+        snap = json.loads(snap_body)
+        assert snap["federation"]["replica_count"] >= 2
+        assert snap["federation"]["merged_totals"]["t_binds_total"] == 41
+    finally:
+        peer.stop()
+
+
+def test_disabled_federation_falls_back_to_local_scrape(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUSHARE_FEDERATION", "0")
+    fc = FakeCluster()
+    fc.add_tpu_node("n0", chips=4, hbm_per_chip_mib=16000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    srv = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    port = srv.start()
+    try:
+        assert srv.federation is None
+        status, body, _ = _get(port, "/metrics/federated")
+        assert status == 200  # same surface, local-only sum
+        assert "# TYPE" in body
+    finally:
+        srv.stop()

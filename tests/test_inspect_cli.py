@@ -331,6 +331,83 @@ def test_cli_qos_subcommand_active(capsys, monkeypatch):
         server.stop()
 
 
+def test_cli_journal_subcommand(live, capsys):
+    """ISSUE 19: `tpushare-inspect journal` renders the black-box plane
+    — ring pump health, journal state (disabled on this rig: the knob
+    hint must say how to turn it on), federation slot."""
+    import json as jsonlib
+
+    assert main(["--endpoint", live, "journal"]) == 0
+    out = capsys.readouterr().out
+    from tpushare.core.native import engine as native_engine
+    if native_engine.blackbox_supported():
+        assert "black box: running" in out
+        assert "pending in ring" in out
+    else:
+        assert "black box: UNSUPPORTED" in out
+    assert "journal: disabled (set TPUSHARE_JOURNAL_DIR" in out
+    assert "federation: slot" in out or "federation: disabled" in out
+
+    assert main(["--endpoint", live, "--json", "journal"]) == 0
+    snap = jsonlib.loads(capsys.readouterr().out)
+    assert set(snap) == {"blackbox", "journal", "federation"}
+    assert snap["journal"] == {"enabled": False}
+
+
+def test_cli_journal_subcommand_recording(tmp_path, capsys, monkeypatch):
+    """With TPUSHARE_JOURNAL_DIR set the rendering carries the recorded
+    aggregate and the copy-pasteable replay command."""
+    import json as jsonlib
+    import urllib.request
+
+    monkeypatch.setenv("TPUSHARE_JOURNAL_DIR", str(tmp_path / "jrn"))
+    fc = FakeCluster()
+    fc.add_tpu_node("n1", chips=2, hbm_per_chip_mib=15000)
+    cache = SchedulerCache(fc)
+    cache.build_cache()
+    server = ExtenderServer(cache, fc, host="127.0.0.1", port=0)
+    port = server.start()
+    try:
+        live = f"http://127.0.0.1:{port}"
+        pod = fc.create_pod(make_pod(hbm=1024, name="jp"))
+        req = urllib.request.Request(
+            f"{live}/tpushare-scheduler/filter",
+            data=jsonlib.dumps({"Pod": pod,
+                                "NodeNames": ["n1"]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert jsonlib.loads(r.read())["NodeNames"]
+        server.journal.flush()
+        assert main(["--endpoint", live, "journal"]) == 0
+        out = capsys.readouterr().out
+        assert "journal: " in out and "1 file(s)" in out
+        assert "recorded: 1 pod(s) — 1 admitted" in out
+        assert f"replay: python -m tpushare.sim --replay" in out
+    finally:
+        server.stop()
+
+
+def test_cli_metrics_subcommand(live, capsys):
+    """`tpushare-inspect metrics` prints the scrape verbatim; with
+    --federated it prints the merged fleet-wide sum (counters and
+    histograms only — gauges are per-process and stay out)."""
+    assert main(["--endpoint", live, "metrics"]) == 0
+    local = capsys.readouterr().out
+    assert "# TYPE" in local
+
+    assert main(["--endpoint", live, "--federated", "metrics"]) == 0
+    fed = capsys.readouterr().out
+    assert "# TYPE" in fed
+
+    def types(text):
+        return {ln.split()[-1] for ln in text.splitlines()
+                if ln.startswith("# TYPE")}
+
+    assert "gauge" in types(local)  # the local scrape has gauges...
+    # ...the federated sum never does: gauges are per-process statements
+    assert types(fed) <= {"counter", "histogram"}
+
+
 def test_cli_wire_subcommand(live, capsys):
     """ISSUE 16: `tpushare-inspect wire` renders digest-table occupancy
     and the native hit rate from /inspect/wire."""

@@ -38,6 +38,9 @@ SCOPES = (
     os.path.join(ROOT, "tpushare", "sim"),
     os.path.join(ROOT, "tpushare", "chaos"),
     os.path.join(ROOT, "tpushare", "qos"),
+    # fleet black box (ISSUE 19): the observability layer grew real
+    # locks (ring pump, decision journal, federation slots) — scan it
+    os.path.join(ROOT, "tpushare", "obs"),
 )
 
 # (file basename, with-expression prefix) -> rank. Nested acquisitions
@@ -124,6 +127,29 @@ RANKS = {
     # (test_pressure_lock_never_held_across_an_eviction enforces the
     # eviction half)
     ("pressure.py", "self._lock"): 8,
+    # fleet black box (ISSUE 19) — the ring pump's lifecycle lock and
+    # the digest map's LRU lock share one key: both are pure
+    # bookkeeping, NEVER held across a ring drain, a histogram observe,
+    # or an explain/recorder call (the drain loop runs entirely
+    # lock-free; test_blackbox_and_journal_locks_never_held_across_
+    # drain_or_flush enforces that half)
+    ("blackbox.py", "self._lock"): 8,
+    # decision journal: the ONLY legal obs nesting is flush's
+    # io -> buffer handoff (swap the buffer out under the inner lock,
+    # write to disk under the outer one alone), so the io lock must
+    # rank strictly below the buffer lock
+    ("journal.py", "self._io_lock"): 50,
+    ("journal.py", "self._lock"): 51,
+    # metrics federation: seqlock slot bookkeeping + publish — never
+    # held across an apiserver call or any other lock; the mmap write
+    # under it is wait-free by design (readers retry, never block)
+    ("federation.py", "self._lock"): 8,
+    # explain/fleetwatch/recorder: terminal leaves like _pods_lock —
+    # observers are notified OUTSIDE the explain lock, the scorecard
+    # and flight recorder guard only their own deques/counters
+    ("explain.py", "self._lock"): 93,
+    ("fleetwatch.py", "self._lock"): 94,
+    ("recorder.py", "self._lock"): 95,
 }
 
 _LOCKISH = re.compile(r"(?:^|[._])(?:[a-z_]*lock[a-z_]*)(?:$|\()|for_key\(")
@@ -357,6 +383,69 @@ def test_pressure_lock_never_held_across_an_eviction():
                 walk(h.body, held)
 
     walk(tree.body, False)
+    assert not problems, "\n".join(problems)
+
+
+def test_blackbox_and_journal_locks_never_held_across_drain_or_flush():
+    """The black box's locks (ISSUE 19) are documented as NEVER held
+    across the work they schedule: the ring pump's lifecycle lock must
+    not be held across a drain or a consumer (histogram observe,
+    explain record, recorder pin) — the drain loop is the path that
+    keeps the native ring from overflowing, and bookkeeping held across
+    it would stall producers into drop-on-full; the journal's buffer
+    lock must not be held across a disk write — decision_recorded runs
+    on webhook worker threads, and fsync latency under the buffer lock
+    would put disk stalls on the serve path. AST check: no call whose
+    name smells like a drain/consumer (blackbox.py) or a disk op
+    (journal.py) appears inside a ``with self._lock:`` block."""
+    cases = [
+        ("obs", "blackbox.py",
+         re.compile(r"drain|observe|record|lookup|flush|urlopen|request"),
+         "the pump lock must never be held across a drain or a "
+         "consumer call"),
+        ("obs", "journal.py",
+         re.compile(r"write|flush|_rotate|unlink|drain|urlopen|request"),
+         "the buffer lock must never be held across a disk write"),
+    ]
+    problems: list[str] = []
+    for pkg, fname, banned, why in cases:
+        path = os.path.join(ROOT, "tpushare", pkg, fname)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+
+        def scan_calls(body):
+            for n in body:
+                for sub in ast.walk(n) if not isinstance(
+                        n, (ast.FunctionDef, ast.AsyncFunctionDef)) else []:
+                    if isinstance(sub, ast.Call):
+                        src = ast.unparse(sub.func)
+                        if banned.search(src):
+                            problems.append(
+                                f"{fname}:{sub.lineno}: '{src}(...)' "
+                                f"called under self._lock — {why}")
+
+        def walk(body, held):
+            for n in body:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk(n.body, False)
+                    continue
+                if isinstance(n, ast.With):
+                    holds = held or any(
+                        _with_expr_key(i.context_expr) == "self._lock"
+                        for i in n.items)
+                    if holds:
+                        scan_calls(n.body)
+                    walk(n.body, holds)
+                    continue
+                for cb in (getattr(n, "body", None),
+                           getattr(n, "orelse", None),
+                           getattr(n, "finalbody", None)):
+                    if isinstance(cb, list):
+                        walk(cb, held)
+                for h in getattr(n, "handlers", []) or []:
+                    walk(h.body, held)
+
+        walk(tree.body, False)
     assert not problems, "\n".join(problems)
 
 

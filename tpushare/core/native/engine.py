@@ -444,6 +444,83 @@ def wire_probe_supported() -> bool:
     return _wire_lib() is not None
 
 
+def _blackbox_lib():
+    """The loaded library with every ABI v8 black-box symbol typed, or
+    None when the native event ring is unavailable (no lib, stale pre-v8
+    .so, or the TPUSHARE_BLACKBOX=0 opt-out). Absence degrades, never
+    breaks: native serves still happen, the obs pump just reports
+    blackbox_supported=False and Python-side latency attribution stays
+    active."""
+    if os.environ.get("TPUSHARE_BLACKBOX", "1") == "0":
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    fn = getattr(lib, "tpushare_blackbox_drain", None)
+    if fn is None:
+        return None
+    if not getattr(fn, "_tpushare_typed", False):
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.tpushare_blackbox_enable.restype = ctypes.c_int64
+        lib.tpushare_blackbox_enable.argtypes = []
+        lib.tpushare_blackbox_disable.restype = None
+        lib.tpushare_blackbox_disable.argtypes = []
+        lib.tpushare_blackbox_stats.restype = None
+        lib.tpushare_blackbox_stats.argtypes = [i64p]
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [
+            ctypes.c_int64,    # max events to drain
+            i64p,              # out rows (6 int64 per event)
+        ]
+        fn._tpushare_typed = True
+    return lib
+
+
+def blackbox_supported() -> bool:
+    """True when the GIL-released paths can record into the event ring."""
+    return _blackbox_lib() is not None
+
+
+def blackbox_enable() -> int:
+    """Reset the ring and start recording. Returns ring capacity in
+    events, or 0 when unsupported."""
+    lib = _blackbox_lib()
+    if lib is None:
+        return 0
+    return int(lib.tpushare_blackbox_enable())
+
+
+def blackbox_disable() -> None:
+    lib = _blackbox_lib()
+    if lib is not None:
+        lib.tpushare_blackbox_disable()
+
+
+def blackbox_drain(max_events: int = 1024) -> list[tuple[int, ...]]:
+    """Drain up to max_events ring records. Each row is
+    (kind, outcome, t_ns, dur_ns, span8, rem8) — kind 1=wire_probe
+    2=cycle_topo 3=solve_gang; wire outcomes pack rc * 256 + verb (see
+    placement.cpp); span8/rem8 are the signed-int64 bit patterns of the
+    digest prefixes (0 outside the wire path)."""
+    lib = _blackbox_lib()
+    if lib is None or max_events <= 0:
+        return []
+    buf = (ctypes.c_int64 * (6 * max_events))()
+    n = int(lib.tpushare_blackbox_drain(max_events, buf))
+    return [tuple(buf[i * 6:i * 6 + 6]) for i in range(n)]
+
+
+def blackbox_stats() -> dict:
+    """Ring health: {enabled, capacity, dropped_total, pending}.
+    All zeros when unsupported."""
+    lib = _blackbox_lib()
+    out = (ctypes.c_int64 * 4)()
+    if lib is not None:
+        lib.tpushare_blackbox_stats(out)
+    return {"enabled": bool(out[0]), "capacity": int(out[1]),
+            "dropped_total": int(out[2]), "pending": int(out[3])}
+
+
 def describe() -> "dict":
     """Observability snapshot for /inspect and bench: availability, ABI,
     scan worker config, and the fallback/scan counters."""
@@ -454,6 +531,7 @@ def describe() -> "dict":
         "topo_cycle_supported": topo_cycle_supported(),
         "gang_solve_supported": gang_solve_supported(),
         "wire_probe_supported": wire_probe_supported(),
+        "blackbox_supported": blackbox_supported(),
         "scan_workers": _scan_workers(),
         "fleet_scans": {f"{call}/{engine}": v for (call, engine), v
                         in NATIVE_FLEET_SCANS.snapshot().items()},

@@ -16,8 +16,102 @@
 #include <cstdint>
 #include <cstring>
 #include <algorithm>
+#include <atomic>
+#include <ctime>
 #include <mutex>
 #include <vector>
+
+// -- ABI v8: black-box event ring --------------------------------------------
+//
+// A process-global, fixed-slot, lock-free event ring the GIL-released
+// entry points (tpushare_wire_probe, tpushare_cycle_fleet_topo,
+// tpushare_solve_gang) write into when enabled: operation kind, outcome,
+// CLOCK_MONOTONIC completion tick, duration ticks, and (for wire probes)
+// the first 8 bytes of the span/remainder digests so the Python pump
+// (tpushare/obs/blackbox.py) can join an event back to the pod it
+// served. Classic bounded MPMC design (per-slot sequence counters): a
+// producer that finds the ring full DROPS the event and bumps an atomic
+// counter — it never blocks, spins unboundedly, or overwrites a record
+// a drain is reading. Disabled (the default) the whole feature is one
+// relaxed atomic load and a predictable branch per call.
+
+namespace blackbox {
+
+constexpr int kWireProbe = 1;
+constexpr int kCycleTopo = 2;
+constexpr int kSolveGang = 3;
+
+constexpr uint64_t kCapacity = 4096;  // power of two; ~192 KiB of BSS
+
+struct Slot {
+  std::atomic<uint64_t> seq;
+  int64_t kind;
+  int64_t outcome;
+  int64_t t_ns;
+  int64_t dur_ns;
+  int64_t span8;
+  int64_t rem8;
+};
+
+struct Ring {
+  std::atomic<uint64_t> head{0};     // producers claim
+  std::atomic<uint64_t> tail{0};     // drainers claim
+  std::atomic<uint64_t> dropped{0};  // ring-full events discarded
+  std::atomic<int> enabled{0};
+  Slot slots[kCapacity];
+};
+
+Ring g_ring;                // zero-initialized: every slot seq starts 0
+std::mutex g_enable_mu;     // enable/disable only (never on a hot path)
+
+inline bool on() {
+  return g_ring.enabled.load(std::memory_order_acquire) != 0;
+}
+
+inline uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ULL + (uint64_t)ts.tv_nsec;
+}
+
+inline int64_t prefix8(const uint8_t* digest16) {
+  int64_t v;
+  std::memcpy(&v, digest16, 8);  // little-endian hosts only, same as wire
+  return v;
+}
+
+void emit(int64_t kind, int64_t outcome, int64_t span8, int64_t rem8,
+          uint64_t t0_ns) {
+  uint64_t pos = g_ring.head.load(std::memory_order_relaxed);
+  for (;;) {
+    Slot* s = &g_ring.slots[pos & (kCapacity - 1)];
+    uint64_t seq = s->seq.load(std::memory_order_acquire);
+    int64_t dif = (int64_t)seq - (int64_t)pos;
+    if (dif == 0) {
+      if (g_ring.head.compare_exchange_weak(pos, pos + 1,
+                                            std::memory_order_relaxed))
+        break;
+      // lost the claim race: pos was reloaded by compare_exchange
+    } else if (dif < 0) {
+      // ring full (the slot still holds an undrained record): drop
+      g_ring.dropped.fetch_add(1, std::memory_order_relaxed);
+      return;
+    } else {
+      pos = g_ring.head.load(std::memory_order_relaxed);
+    }
+  }
+  Slot* s = &g_ring.slots[pos & (kCapacity - 1)];
+  uint64_t now = now_ns();
+  s->kind = kind;
+  s->outcome = outcome;
+  s->t_ns = (int64_t)now;
+  s->dur_ns = (int64_t)(now - t0_ns);
+  s->span8 = span8;
+  s->rem8 = rem8;
+  s->seq.store(pos + 1, std::memory_order_release);
+}
+
+}  // namespace blackbox
 
 namespace {
 
@@ -264,7 +358,95 @@ bool fits_one(int n_chips, const int64_t* free_hbm, const int64_t* total_hbm,
 // (tests/test_topo_properties.py). Offsets stay ABSOLUTE and per-node
 // evaluation independent, so the thread-sharding and resident-arena
 // contracts hold for out_adj too.
-extern "C" int64_t tpushare_abi_version() { return 7; }
+//
+// ABI v8 COMPATIBILITY NOTE: v8 adds the black-box event ring —
+// tpushare_blackbox_enable / _disable / _drain / _stats over a
+// process-global lock-free bounded ring that the GIL-released entry
+// points (wire_probe, cycle_fleet_topo, solve_gang) write
+// {kind, outcome, t_ns, dur_ns, span8, rem8} events into when enabled.
+// Every v7 entry point keeps its exact signature and semantics — a v7
+// caller against a v8 .so is fully compatible; a v8 caller against a
+// v7 .so detects the missing symbols (AttributeError at bind time,
+// engine.py _blackbox_fns) and runs with the ring absent: native
+// serves still happen, the Python pump (tpushare/obs/blackbox.py)
+// simply reports blackbox_supported=False and the Python-side latency
+// fallback stays active. Disabled (the default at load) the ring costs
+// one relaxed atomic load per instrumented call; producers NEVER block
+// or spin unboundedly — a full ring drops the event and counts it in
+// _stats, it never corrupts a record a drain is reading.
+extern "C" int64_t tpushare_abi_version() { return 8; }
+
+// -- ABI v8: black-box ring entry points -------------------------------------
+
+// Reset the ring to empty and start recording. Idempotent; safe to call
+// while producers are live (enable/disable serialize on a mutex that no
+// hot path ever takes). Returns ring capacity in events.
+extern "C" int64_t tpushare_blackbox_enable() {
+  std::lock_guard<std::mutex> g(blackbox::g_enable_mu);
+  blackbox::g_ring.enabled.store(0, std::memory_order_release);
+  // Producers that already passed the enabled check may still be
+  // completing an emit; the slot-sequence protocol makes that benign —
+  // reinitializing seq below simply reclaims every slot.
+  blackbox::g_ring.head.store(0, std::memory_order_relaxed);
+  blackbox::g_ring.tail.store(0, std::memory_order_relaxed);
+  for (uint64_t i = 0; i < blackbox::kCapacity; ++i)
+    blackbox::g_ring.slots[i].seq.store(i, std::memory_order_relaxed);
+  blackbox::g_ring.enabled.store(1, std::memory_order_release);
+  return (int64_t)blackbox::kCapacity;
+}
+
+extern "C" void tpushare_blackbox_disable() {
+  std::lock_guard<std::mutex> g(blackbox::g_enable_mu);
+  blackbox::g_ring.enabled.store(0, std::memory_order_release);
+}
+
+// Drain up to max_events records into out (6 int64 per row:
+// kind, outcome, t_ns, dur_ns, span8, rem8). Returns rows written.
+// Safe against concurrent producers and concurrent drains.
+extern "C" int64_t tpushare_blackbox_drain(int64_t max_events,
+                                           int64_t* out) {
+  if (max_events <= 0 || out == nullptr) return 0;
+  int64_t n = 0;
+  while (n < max_events) {
+    uint64_t pos = blackbox::g_ring.tail.load(std::memory_order_relaxed);
+    blackbox::Slot* s = nullptr;
+    for (;;) {
+      s = &blackbox::g_ring.slots[pos & (blackbox::kCapacity - 1)];
+      uint64_t seq = s->seq.load(std::memory_order_acquire);
+      int64_t dif = (int64_t)seq - (int64_t)(pos + 1);
+      if (dif == 0) {
+        if (blackbox::g_ring.tail.compare_exchange_weak(
+                pos, pos + 1, std::memory_order_relaxed))
+          break;
+      } else if (dif < 0) {
+        return n;  // ring empty
+      } else {
+        pos = blackbox::g_ring.tail.load(std::memory_order_relaxed);
+      }
+    }
+    int64_t* row = out + n * 6;
+    row[0] = s->kind;
+    row[1] = s->outcome;
+    row[2] = s->t_ns;
+    row[3] = s->dur_ns;
+    row[4] = s->span8;
+    row[5] = s->rem8;
+    s->seq.store(pos + blackbox::kCapacity, std::memory_order_release);
+    ++n;
+  }
+  return n;
+}
+
+// out4 = {enabled, capacity, dropped_total, pending}.
+extern "C" void tpushare_blackbox_stats(int64_t* out4) {
+  if (out4 == nullptr) return;
+  out4[0] = (int64_t)blackbox::g_ring.enabled.load(std::memory_order_acquire);
+  out4[1] = (int64_t)blackbox::kCapacity;
+  out4[2] = (int64_t)blackbox::g_ring.dropped.load(std::memory_order_relaxed);
+  uint64_t h = blackbox::g_ring.head.load(std::memory_order_acquire);
+  uint64_t t = blackbox::g_ring.tail.load(std::memory_order_acquire);
+  out4[3] = (int64_t)(h > t ? h - t : 0);
+}
 
 // Fleet-wide Filter: one call evaluates every candidate node, avoiding
 // per-node FFI marshalling (the reference's hot loop #1 x #2,
@@ -731,6 +913,9 @@ extern "C" int tpushare_cycle_fleet_topo(
     int64_t* out_origin,
     int64_t* out_adj) {
   if (n_nodes < 0) return -1;
+  const bool bb = blackbox::on();
+  const uint64_t bb_t0 = bb ? blackbox::now_ns() : 0;
+  int64_t feasible = 0;
   for (int n = 0; n < n_nodes; ++n) {
     int64_t c0 = node_chip_offsets[n], c1 = node_chip_offsets[n + 1];
     int64_t m0 = mesh_rank_offsets[n], m1 = mesh_rank_offsets[n + 1];
@@ -743,7 +928,10 @@ extern "C" int tpushare_cycle_fleet_topo(
         out_ids + c0, out_box + m0, out_origin + m0, &score, &adj);
     out_scores[n] = rc == 1 ? score : (rc == 0 ? -1 : -2);
     out_adj[n] = rc == 1 ? adj : -1;
+    if (rc == 1) ++feasible;
   }
+  // Black-box event: outcome = feasible-node count for the whole pass.
+  if (bb) blackbox::emit(blackbox::kCycleTopo, feasible, 0, 0, bb_t0);
   return 0;
 }
 
@@ -881,7 +1069,7 @@ extern "C" int tpushare_solve_batch(
 // out_m_score[m]. The member windows are strided by the caller-known
 // req_count / rank, never by n_members — windows are independent.
 // Return 0 = no placement, -1 = not expressible (caller falls back).
-extern "C" int tpushare_solve_gang(
+static int solve_gang_impl(
     int n_chips,
     const int64_t* free_hbm,   // -1 => ineligible (caller folds eligibility)
     const int64_t* total_hbm,
@@ -1048,6 +1236,41 @@ extern "C" int tpushare_solve_gang(
   *out_score = total_score;
   *out_n_members = n_members;
   return 1;
+}
+
+// Exported shim: unchanged v5 signature/semantics; adds only the v8
+// black-box event (kind=kSolveGang, outcome = impl return code).
+extern "C" int tpushare_solve_gang(
+    int n_chips,
+    const int64_t* free_hbm,
+    const int64_t* total_hbm,
+    int rank,
+    const int64_t* mesh,
+    const int64_t* hbox,
+    int64_t req_hbm,
+    int req_count,
+    int topo_rank,
+    const int64_t* topo_dims,
+    int max_members,
+    int64_t* out_box,
+    int64_t* out_origin,
+    int64_t* out_score,
+    int64_t* out_n_members,
+    int64_t* out_m_host,
+    int64_t* out_m_nchips,
+    int64_t* out_m_ids,
+    int64_t* out_m_box,
+    int64_t* out_m_origin,
+    int64_t* out_m_score) {
+  const bool bb = blackbox::on();
+  const uint64_t bb_t0 = bb ? blackbox::now_ns() : 0;
+  int rc = solve_gang_impl(
+      n_chips, free_hbm, total_hbm, rank, mesh, hbox, req_hbm, req_count,
+      topo_rank, topo_dims, max_members, out_box, out_origin, out_score,
+      out_n_members, out_m_host, out_m_nchips, out_m_ids, out_m_box,
+      out_m_origin, out_m_score);
+  if (bb) blackbox::emit(blackbox::kSolveGang, rc, 0, 0, bb_t0);
+  return rc;
 }
 
 // ---------------------------------------------------------------------------
@@ -1374,10 +1597,12 @@ extern "C" void tpushare_wire_digest2(const uint8_t* pre, int64_t pre_len,
 //   -4  bypass — not a fast-path request (wrong verb/route/version,
 //       chunked, close semantics, no NodeNames span, oversized)
 //   -1  error (bad arguments)
-extern "C" int tpushare_wire_probe(void* tp, const uint8_t* req,
-                                   int64_t req_len, int64_t stamp,
-                                   uint8_t* out, int64_t out_cap,
-                                   int64_t* out_len, int64_t* consumed) {
+static int wire_probe_impl(void* tp, const uint8_t* req,
+                           int64_t req_len, int64_t stamp,
+                           uint8_t* out, int64_t out_cap,
+                           int64_t* out_len, int64_t* consumed,
+                           int64_t* bb_span8, int64_t* bb_rem8,
+                           int64_t* bb_verb) {
   if (tp == nullptr || req == nullptr || out_len == nullptr ||
       consumed == nullptr)
     return wire::kError;
@@ -1417,6 +1642,7 @@ extern "C" int tpushare_wire_probe(void* tp, const uint8_t* req,
   } else {
     return wire::kBypass;
   }
+  *bb_verb = verb;
 
   // headers: Content-Length required; Transfer-Encoding or an explicit
   // Connection: close demotes to the Python path (it owns close/chunked
@@ -1479,6 +1705,8 @@ extern "C" int tpushare_wire_probe(void* tp, const uint8_t* req,
   uint8_t span_d[wire::kDigest], rem_d[wire::kDigest];
   tpushare_wire_digest2(body + s, e - s, nullptr, 0, span_d);
   tpushare_wire_digest2(body, s, body + e, content_length - e, rem_d);
+  *bb_span8 = blackbox::prefix8(span_d);
+  *bb_rem8 = blackbox::prefix8(rem_d);
 
   auto* t = static_cast<wire::Table*>(tp);
   std::lock_guard<std::mutex> lock(t->mu);
@@ -1507,4 +1735,26 @@ extern "C" int tpushare_wire_probe(void* tp, const uint8_t* req,
   }
   t->misses++;
   return wire::kMiss;
+}
+
+// Exported shim: unchanged v6 signature/semantics; adds only the v8
+// black-box event. kIncomplete/kGrow are retry artifacts (the caller
+// re-probes the same request) and are NOT emitted — one serve, one
+// event. Event outcome packs {probe rc, verb}: rc * 256 + verb, verb
+// 0=filter 1=prioritize 255=undetermined (bypass before route match).
+extern "C" int tpushare_wire_probe(void* tp, const uint8_t* req,
+                                   int64_t req_len, int64_t stamp,
+                                   uint8_t* out, int64_t out_cap,
+                                   int64_t* out_len, int64_t* consumed) {
+  int64_t span8 = 0, rem8 = 0, verb = 255;
+  if (!blackbox::on())
+    return wire_probe_impl(tp, req, req_len, stamp, out, out_cap, out_len,
+                           consumed, &span8, &rem8, &verb);
+  const uint64_t t0 = blackbox::now_ns();
+  int rc = wire_probe_impl(tp, req, req_len, stamp, out, out_cap, out_len,
+                           consumed, &span8, &rem8, &verb);
+  if (rc != wire::kIncomplete && rc != wire::kGrow)
+    blackbox::emit(blackbox::kWireProbe, (int64_t)rc * 256 + verb, span8,
+                   rem8, t0);
+  return rc;
 }

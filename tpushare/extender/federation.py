@@ -1,0 +1,368 @@
+"""Cross-process metrics federation over a shared-memory segment.
+
+``--procs N`` serving (extender/__main__.py, SO_REUSEPORT) runs N
+independent replicas of the whole server; the kernel load-balances
+accepted connections across them. Each replica keeps its own metrics
+registry, so a scrape of ``/metrics`` sees only the ~1/N of traffic that
+landed on the answering process — fleet counters (binds, native serves,
+black-box events) appear to undercount by the replica factor.
+
+This module federates them without any network hop: every replica
+claims one slot in a small mmap'd segment (keyed by uid + port, so
+replicas of one server group share it and different servers don't) and
+periodically publishes its registry's mergeable snapshot
+(metrics.Registry.federation_state) into its slot under a seqlock.
+``GET /metrics/federated`` on ANY replica then merges the live local
+registry with every peer slot and exposes the sum in the same text
+format — one scrape, the whole fleet.
+
+Crash tolerance: a slot is claimed once (pid + a random nonce) and
+written only by its owner. When a replica dies, its slot simply stops
+updating — the last published snapshot stays readable and keeps being
+merged (counters are monotone; freezing loses the tail, never the
+history). A FUTURE replica may reclaim a dead slot only when no empty
+slot remains, so the frozen tail survives as long as the segment has
+room. The seqlock (odd = write in progress) means a reader never
+observes a torn payload: it retries a few times, then skips the slot.
+
+Only counters and histograms federate; scrape-time gauges are
+per-process statements and stay local (see Registry.federation_state).
+
+Knobs: ``TPUSHARE_FEDERATION=0`` disables the whole layer;
+``TPUSHARE_FEDERATION_PERIOD_S`` (default 1.0) is the publish cadence;
+``TPUSHARE_FEDERATION_PATH`` overrides the segment path.
+
+Lock discipline (tests/test_lock_order_lint.py): ``self._lock`` guards
+the mmap handle and publish/read plumbing — memory and local-file work
+only, NEVER held across an apiserver call, a ring drain, or a journal
+flush.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+import tempfile
+import threading
+import time
+from typing import Any
+
+MAGIC = b"TPUSHFED"
+VERSION = 1
+
+# header: magic, version, nslots, slot_size, zero pad -> 32 bytes
+_HEADER = struct.Struct("<8sIII12x")
+# slot header: pid, nonce, seqlock seq, payload len -> 32 bytes
+_SLOT = struct.Struct("<qqqq")
+
+DEFAULT_NSLOTS = 32
+DEFAULT_SLOT_SIZE = 256 * 1024  # payload is the whole registry as JSON
+
+
+def enabled() -> bool:
+    return os.environ.get("TPUSHARE_FEDERATION", "1") != "0"
+
+
+def default_path(port: int) -> str:
+    override = os.environ.get("TPUSHARE_FEDERATION_PATH")
+    if override:
+        return override
+    return os.path.join(tempfile.gettempdir(),
+                        f"tpushare-fed-{os.getuid()}-{port}.seg")
+
+
+def _flock(fh, exclusive: bool):
+    try:
+        import fcntl
+        fcntl.flock(fh.fileno(),
+                    fcntl.LOCK_EX if exclusive else fcntl.LOCK_SH)
+        return True
+    except (ImportError, OSError):
+        return False  # best effort: claim races are pid-arbitrated anyway
+
+
+def _funlock(fh) -> None:
+    try:
+        import fcntl
+        fcntl.flock(fh.fileno(), fcntl.LOCK_UN)
+    except (ImportError, OSError):
+        pass
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return False
+
+
+class FederationSegment:
+    """One replica's handle on the shared segment: claims a slot at
+    start(), publishes the registry snapshot periodically, merges every
+    slot on demand."""
+
+    def __init__(self, registry, port: int, *, path: str | None = None,
+                 nslots: int = DEFAULT_NSLOTS,
+                 slot_size: int = DEFAULT_SLOT_SIZE,
+                 period_s: float | None = None) -> None:
+        if period_s is None:
+            period_s = float(os.environ.get(
+                "TPUSHARE_FEDERATION_PERIOD_S", "1.0"))
+        self.registry = registry
+        self.path = path or default_path(port)
+        self.nslots = nslots
+        self.slot_size = slot_size
+        self.period_s = period_s
+        self.pid = os.getpid()
+        # nonce disambiguates pid reuse across slot generations; derived
+        # from urandom, not time (replay-safe, fork-safe)
+        self.nonce = int.from_bytes(os.urandom(7), "little") or 1
+        self.slot: int | None = None
+        # mmap handle + publish/read plumbing; memory + local file only
+        self._lock = threading.Lock()
+        self._fh = None
+        self._mm: mmap.mmap | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._publishes = 0
+        self._publish_errors = 0
+
+    # -- segment plumbing ------------------------------------------------
+
+    def _size(self) -> int:
+        return _HEADER.size + self.nslots * self.slot_size
+
+    def _slot_off(self, i: int) -> int:
+        return _HEADER.size + i * self.slot_size
+
+    def _open(self) -> bool:
+        """Map the segment, creating/initializing it if needed (under an
+        exclusive flock so two racing replicas don't both format)."""
+        size = self._size()
+        fh = open(self.path, "a+b")
+        locked = _flock(fh, exclusive=True)
+        try:
+            fh.seek(0, os.SEEK_END)
+            fresh = fh.tell() < size
+            if fresh:
+                fh.truncate(size)
+            mm = mmap.mmap(fh.fileno(), size)
+            magic, ver, nslots, slot_size = _HEADER.unpack_from(mm, 0)
+            if magic != MAGIC or ver != VERSION or \
+                    nslots != self.nslots or slot_size != self.slot_size:
+                if not fresh and magic == MAGIC:
+                    # an existing segment with a different geometry wins:
+                    # adopt it rather than clobber peers' slots
+                    if ver == VERSION and nslots > 0 and slot_size > 0:
+                        self.nslots, self.slot_size = nslots, slot_size
+                        if len(mm) < self._size():
+                            mm.close()
+                            fh.truncate(self._size())
+                            mm = mmap.mmap(fh.fileno(), self._size())
+                    else:
+                        mm.close()
+                        fh.close()
+                        return False
+                else:
+                    mm[:] = b"\x00" * len(mm)
+                    _HEADER.pack_into(mm, 0, MAGIC, VERSION,
+                                      self.nslots, self.slot_size)
+            self._fh, self._mm = fh, mm
+            return True
+        finally:
+            if locked:
+                _funlock(fh)
+            if self._fh is None:
+                fh.close()
+
+    def _claim(self) -> int | None:
+        """Pick a slot: empty first, then a dead owner's (reclaiming a
+        frozen slot only under segment pressure — see module doc)."""
+        mm = self._mm
+        locked = _flock(self._fh, exclusive=True)
+        try:
+            empty, dead = None, None
+            for i in range(self.nslots):
+                pid, _, _, _ = _SLOT.unpack_from(mm, self._slot_off(i))
+                if pid == 0 and empty is None:
+                    empty = i
+                elif pid != 0 and dead is None and not _pid_alive(pid):
+                    dead = i
+            slot = empty if empty is not None else dead
+            if slot is None:
+                return None
+            off = self._slot_off(slot)
+            _SLOT.pack_into(mm, off, self.pid, self.nonce, 0, 0)
+            return slot
+        finally:
+            if locked:
+                _funlock(self._fh)
+
+    # -- publishing ------------------------------------------------------
+
+    def publish_once(self) -> bool:
+        """Seqlock-write the current registry snapshot into our slot."""
+        with self._lock:
+            mm, slot = self._mm, self.slot
+            if mm is None or slot is None:
+                return False
+            try:
+                payload = json.dumps(
+                    {"pid": self.pid, "nonce": self.nonce,
+                     "t": round(time.time(), 3),
+                     "state": self.registry.federation_state()},
+                    separators=(",", ":")).encode()
+            except Exception:  # noqa: BLE001 — scrape-side must survive
+                self._publish_errors += 1
+                return False
+            if len(payload) > self.slot_size - _SLOT.size:
+                self._publish_errors += 1
+                return False
+            off = self._slot_off(slot)
+            pid, nonce, seq, _ = _SLOT.unpack_from(mm, off)
+            if pid != self.pid or nonce != self.nonce:
+                return False  # slot was reclaimed out from under us
+            _SLOT.pack_into(mm, off, self.pid, self.nonce, seq + 1, 0)
+            mm[off + _SLOT.size:off + _SLOT.size + len(payload)] = payload
+            _SLOT.pack_into(mm, off, self.pid, self.nonce, seq + 2,
+                            len(payload))
+            self._publishes += 1
+            return True
+
+    # -- reading + merging -----------------------------------------------
+
+    def read_slots(self) -> list[dict[str, Any]]:
+        """Every claimed slot's last published snapshot (self included),
+        torn or unparseable payloads skipped."""
+        out: list[dict[str, Any]] = []
+        with self._lock:
+            mm = self._mm
+            if mm is None:
+                return out
+            for i in range(self.nslots):
+                off = self._slot_off(i)
+                for _ in range(8):  # seqlock retry budget
+                    pid, nonce, seq, length = _SLOT.unpack_from(mm, off)
+                    if pid == 0 or length <= 0:
+                        break
+                    if seq % 2:  # write in progress
+                        time.sleep(0.0005)
+                        continue
+                    raw = bytes(mm[off + _SLOT.size:
+                                   off + _SLOT.size + length])
+                    pid2, nonce2, seq2, _ = _SLOT.unpack_from(mm, off)
+                    if (pid2, nonce2, seq2) != (pid, nonce, seq):
+                        continue  # torn read: retry
+                    try:
+                        payload = json.loads(raw)
+                    except ValueError:
+                        break
+                    if isinstance(payload, dict):
+                        payload["slot"] = i
+                        payload["alive"] = _pid_alive(pid)
+                        out.append(payload)
+                    break
+        return out
+
+    def merged_state(self) -> tuple[dict[str, dict], dict[str, Any]]:
+        """(merged metric state, meta) across the live LOCAL registry
+        and every OTHER slot — local truth is always current; peers are
+        at most one publish period stale."""
+        from tpushare.metrics import merge_states
+        slots = self.read_slots()
+        states = [self.registry.federation_state()]
+        replicas = [{"pid": self.pid, "slot": self.slot,
+                     "alive": True, "self": True}]
+        for s in slots:
+            if s.get("pid") == self.pid and s.get("nonce") == self.nonce:
+                continue  # our slot: the live registry already covers it
+            states.append(s.get("state") or {})
+            replicas.append({"pid": s.get("pid"), "slot": s.get("slot"),
+                             "alive": bool(s.get("alive")),
+                             "t": s.get("t"), "self": False})
+        return merge_states(states), {
+            "path": self.path,
+            "replicas": replicas,
+            "replica_count": len(replicas),
+        }
+
+    def merged_text(self) -> str:
+        """GET /metrics/federated: the fleet-wide sum, text format."""
+        from tpushare.metrics import expose_merged
+        merged, _ = self.merged_state()
+        return expose_merged(merged)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> bool:
+        """Map + claim + start the publish thread. False (and inert)
+        when the segment can't be set up — federation is an overlay; the
+        server must come up without it."""
+        with self._lock:
+            if self._mm is None:
+                try:
+                    if not self._open():
+                        return False
+                    self.slot = self._claim()
+                except OSError:
+                    self._mm = None
+                    return False
+                if self.slot is None:
+                    return False
+        self.publish_once()
+        if self._thread is None:
+            self._stop.clear()
+            t = threading.Thread(target=self._run, daemon=True,
+                                 name="tpushare-federation")
+            self._thread = t
+            t.start()
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.publish_once()
+            except Exception:  # noqa: BLE001 — publisher must not die
+                self._publish_errors += 1
+
+    def stop(self) -> None:
+        t, self._thread = self._thread, None
+        if t is not None:
+            self._stop.set()
+            t.join(timeout=5.0)
+        # final publish so the frozen slot carries the complete history
+        try:
+            self.publish_once()
+        except Exception:  # noqa: BLE001
+            pass
+        with self._lock:
+            mm, self._mm = self._mm, None
+            fh, self._fh = self._fh, None
+            if mm is not None:
+                mm.close()
+            if fh is not None:
+                fh.close()
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            mapped = self._mm is not None
+        return {
+            "enabled": mapped,
+            "path": self.path,
+            "slot": self.slot,
+            "pid": self.pid,
+            "nslots": self.nslots,
+            "slot_size": self.slot_size,
+            "period_s": self.period_s,
+            "publishes": self._publishes,
+            "publish_errors": self._publish_errors,
+        }

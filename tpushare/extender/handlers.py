@@ -354,6 +354,7 @@ class FilterHandler:
             # memoized — the node's recovery would not bump the stamp
             cacheable = not any(r.startswith("node unavailable:")
                                 for r in failed.values())
+            wire_ctx.pod_key, wire_ctx.pod = pod_key, pod
             return wire.finish_filter(wire_ctx, wire_key, ok_nodes, failed,
                                       cacheable=cacheable,
                                       expected=wire_hit)
@@ -557,6 +558,7 @@ class PrioritizeHandler:
                 pod_key, pod, trace.trace_id if trace else None,
                 {h["Host"]: h["Score"] for h in out}, best_name)
         if wire_key is not None:
+            wire_ctx.pod_key, wire_ctx.pod = pod_key, pod
             return wire.finish_prioritize(wire_ctx, wire_key, out,
                                           best_name,
                                           cacheable=not had_errors,
@@ -1205,6 +1207,12 @@ def register_cache_gauges(registry: Registry, cache: SchedulerCache) -> None:
         WIRE_NATIVE_PROBE_SECONDS, WIRE_NATIVE_SERVES)
     registry.register(WIRE_NATIVE_SERVES)
     registry.register(WIRE_NATIVE_PROBE_SECONDS)
+    # fleet black box (obs/blackbox.py, ABI v8): ring events drained by
+    # instrumented call + outcome, and the producer-side overflow drop
+    # counter — the ring's loud-never-corrupt contract in one series
+    from tpushare.obs.blackbox import BLACKBOX_DROPPED, BLACKBOX_EVENTS
+    registry.register(BLACKBOX_EVENTS)
+    registry.register(BLACKBOX_DROPPED)
 
     # QoS tiers (tpushare/qos/, ISSUE 17): eviction outcomes, the
     # guaranteed-isolation page counter, the borrowed-HBM gauge, and the

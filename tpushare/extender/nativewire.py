@@ -58,10 +58,19 @@ WIRE_NATIVE_SERVES = LabeledCounter(
     ("outcome",))
 WIRE_NATIVE_PROBE_SECONDS = Histogram(
     "tpushare_wire_native_probe_seconds",
-    "Wall time of one tpushare_wire_probe call (frame + digest + table "
-    "lookup + response copy), any outcome",
+    "Native serve time of one tpushare_wire_probe call (frame + digest "
+    "+ table lookup + response copy). With the black-box pump running "
+    "the samples are the ring's GIL-released tick deltas (actual native "
+    "time); otherwise the Python-side perf_counter envelope",
     buckets=(2e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 1e-3,
              5e-3, 2.5e-2))
+
+# True while a blackbox RingPump is draining the ABI v8 event ring: the
+# pump observes the ring's per-probe tick deltas into the histogram
+# above, so the serve path must NOT also observe its (wider,
+# GIL-reacquisition-polluted) perf_counter envelope — one serve, one
+# sample. Flipped by RingPump.start/stop; plain bool read, GIL-atomic.
+RING_LATENCY_ACTIVE = False
 
 # probe return protocol (placement.cpp tpushare_wire_probe)
 PROBE_HIT = 1
@@ -150,7 +159,8 @@ class NativeWireTable:
                 table, req, len(inbuf), stamp, self._out, len(self._out),
                 ctypes.byref(self._out_len), ctypes.byref(self._consumed))
         del req
-        WIRE_NATIVE_PROBE_SECONDS.observe(time.perf_counter() - t0)
+        if not RING_LATENCY_ACTIVE:
+            WIRE_NATIVE_PROBE_SECONDS.observe(time.perf_counter() - t0)
         if rc == PROBE_HIT:
             WIRE_NATIVE_SERVES.inc("native")
             return (PROBE_HIT, self._out.raw[:self._out_len.value],

@@ -81,7 +81,6 @@ class ExtenderServer:
         # /readyz reports its state. None = no degraded-mode wiring.
         self._breaker = breaker
         if request_deadline_s is None:
-            import os
             request_deadline_s = float(os.environ.get(
                 "TPUSHARE_REQUEST_DEADLINE_S",
                 self.DEFAULT_REQUEST_DEADLINE_S))
@@ -102,7 +101,35 @@ class ExtenderServer:
         from tpushare.obs.fleetwatch import FleetWatch
         self.fleetwatch = FleetWatch(cache, cluster=cluster,
                                      informer=informer)
-        self.explain.observer = self.fleetwatch.scorecard
+        # fleet black box (obs/blackbox.py, ABI v8): the ring pump
+        # drains native fast-path events — GIL-released wire serves,
+        # cycle solves, gang solves — back into the phase histograms,
+        # the flight recorder and the explain store, so the zero-Python
+        # steady state stops being invisible. Inert on a pre-v8 .so or
+        # TPUSHARE_BLACKBOX=0.
+        from tpushare.obs.blackbox import RingPump
+        self.blackbox = RingPump(explain=self.explain,
+                                 recorder=self.tracer.recorder)
+        # incident journal (obs/journal.py): every admitted/rejected/
+        # bound pod as a replayable decision record, fed off the explain
+        # decision stream. Enabled by TPUSHARE_JOURNAL_DIR; replay with
+        # `python -m tpushare.sim --replay <dir>`.
+        from tpushare.obs.explain import FanoutObserver
+        from tpushare.obs.journal import DecisionJournal
+        self.journal = None
+        jdir = os.environ.get("TPUSHARE_JOURNAL_DIR")
+        if jdir:
+            try:
+                self.journal = DecisionJournal(
+                    jdir, fleet_info=self._journal_fleet_info())
+            except OSError as e:
+                log.error("decision journal disabled: %s", e)
+        self.explain.observer = FanoutObserver(self.fleetwatch.scorecard,
+                                               self.journal)
+        # cross-process metrics federation (extender/federation.py):
+        # created at start() once the port is known — SO_REUSEPORT
+        # replicas of one port share a segment
+        self.federation = None
         self.fleetwatch.attach(self.registry)
         # live defragmentation (defrag/): the repack rebalancer consumes
         # the same capacity-index stranded-gap picture the fleetwatch
@@ -318,6 +345,19 @@ class ExtenderServer:
         if path == "/metrics":
             return _enc(200, self.registry.expose(),
                         content_type="text/plain; version=0.0.4")
+        if path in ("/metrics/federated", f"{PREFIX}/metrics/federated"):
+            # fleet-wide counters/histograms: local live registry merged
+            # with every peer replica's published snapshot. With no
+            # federation segment this degenerates to the local registry
+            # in the merged (sorted, gauge-free) rendering.
+            from tpushare.metrics import expose_merged, merge_states
+            if self.federation is not None:
+                text = self.federation.merged_text()
+            else:
+                text = expose_merged(merge_states(
+                    [self.registry.federation_state()]))
+            return _enc(200, text,
+                        content_type="text/plain; version=0.0.4")
         if path.startswith("/debug/traces") or \
                 path.startswith(f"{PREFIX}/debug/traces"):
             limit = None
@@ -330,8 +370,14 @@ class ExtenderServer:
         if path.startswith("/inspect/explain") or \
                 path.startswith(f"{PREFIX}/inspect/explain"):
             return self._serve_explain(path)
-        if path in ("/inspect/fleet", f"{PREFIX}/inspect/fleet"):
-            return _enc(200, self.fleetwatch.snapshot())
+        if path.split("?", 1)[0] in ("/inspect/fleet",
+                                     f"{PREFIX}/inspect/fleet"):
+            snap = self.fleetwatch.snapshot()
+            if "federated=1" in path:
+                snap["federation"] = self.federation_snapshot()
+            return _enc(200, snap)
+        if path in ("/inspect/journal", f"{PREFIX}/inspect/journal"):
+            return _enc(200, self.journal_snapshot())
         if path in ("/inspect/defrag", f"{PREFIX}/inspect/defrag"):
             return _enc(200, self.defrag.snapshot())
         if path in ("/inspect/gang", f"{PREFIX}/inspect/gang"):
@@ -470,6 +516,14 @@ class ExtenderServer:
         from tpushare.qos.tiers import overcommit
         if overcommit() > 1.0:
             self.qos_pressure.start()
+        self.blackbox.start()  # no-op without an ABI v8 .so
+        if self.journal is not None:
+            self.journal.start()
+        from tpushare.extender import federation as fedlib
+        if fedlib.enabled():
+            fed = fedlib.FederationSegment(self.registry, self.port)
+            if fed.start():
+                self.federation = fed
 
     def start(self, http_workers: int | None = None) -> int:
         """Bind and serve on background threads; returns the bound port.
@@ -523,6 +577,13 @@ class ExtenderServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # serving is down: drain the ring tail, flush the journal, and
+        # leave our federation slot frozen with the complete history
+        self.blackbox.stop()
+        if self.journal is not None:
+            self.journal.stop()
+        if self.federation is not None:
+            self.federation.stop()
         # after the loop thread is down: probes read the handle lock-free
         self.nativewire.close()
         if self._serve_done is not None:
@@ -567,6 +628,66 @@ class ExtenderServer:
             "tenant_dominant_share": {
                 ns: round(s, 6)
                 for ns, s in sorted(dominant_shares(self._cache).items())},
+        }
+
+    def _journal_fleet_info(self) -> dict[str, Any] | None:
+        """Best-effort fleet geometry for the journal header, in the
+        sim/replay vocabulary (sim.replay.DEFAULT_FLEET keys). None when
+        the cache hasn't seen a node yet — replay falls back to
+        defaults, the journal stays valid."""
+        try:
+            names = self._cache.node_names()
+            if not names:
+                return None
+            info = self._cache.peek_node(names[0])
+            if info is None:
+                return {"n_nodes": len(names)}
+            mesh = getattr(getattr(info, "topology", None), "shape", None)
+            return {
+                "n_nodes": len(names),
+                "chips_per_node": int(info.chip_count),
+                "hbm_per_chip_mib": int(info.hbm_per_chip),
+                "mesh": list(mesh) if mesh and len(mesh) > 1 else None,
+            }
+        except Exception:  # noqa: BLE001 — header info is best-effort
+            return None
+
+    def federation_snapshot(self) -> dict:
+        """/inspect/fleet?federated=1 payload: who is publishing into
+        the segment and the fleet-wide merged counter totals."""
+        if self.federation is None:
+            return {"enabled": False, "replica_count": 1}
+        merged, meta = self.federation.merged_state()
+        totals: dict[str, Any] = {}
+        for name in sorted(merged):
+            s = merged[name]
+            if s["type"] == "counter":
+                totals[name] = s["value"]
+            elif s["type"] == "labeled_counter":
+                totals[name] = sum(v for _, v in s.get("series", []))
+            elif s["type"] == "histogram":
+                totals[name] = {"count": sum(s.get("counts", [])),
+                                "sum": round(s.get("sum", 0.0), 6)}
+        return {
+            "enabled": True,
+            "replica_count": meta["replica_count"],
+            "replicas": meta["replicas"],
+            "merged_totals": totals,
+        }
+
+    def journal_snapshot(self) -> dict:
+        """GET /inspect/journal: the whole black-box plane in one read —
+        ring pump state, decision-journal files/counters, federation
+        slot state (tpushare-inspect journal)."""
+        journal = ({"enabled": True, **self.journal.stats()}
+                   if self.journal is not None else {"enabled": False})
+        federation = (self.federation.stats()
+                      if self.federation is not None
+                      else {"enabled": False})
+        return {
+            "blackbox": self.blackbox.stats(),
+            "journal": journal,
+            "federation": federation,
         }
 
     def wire_snapshot(self) -> dict:

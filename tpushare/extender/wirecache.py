@@ -142,15 +142,22 @@ class _Ctx:
     carry the request's exact-byte identity (the NodeNames span and the
     body remainder, each BLAKE2b-128): ``_finish`` syncs the freshly
     encoded response into the native table under those keys, so the
-    NEXT byte-identical request can be served GIL-released."""
+    NEXT byte-identical request can be served GIL-released.
 
-    __slots__ = ("entry", "stamps", "span_digest", "rem_digest")
+    ``pod_key``/``pod`` are set by the handler before finish so the
+    black-box digest map (obs/blackbox.DIGEST_MAP) can attribute future
+    native hits of these digests to the pod they serve."""
+
+    __slots__ = ("entry", "stamps", "span_digest", "rem_digest",
+                 "pod_key", "pod")
 
     def __init__(self, entry: _Entry) -> None:
         self.entry = entry
         self.stamps: dict[tuple, int] = {}
         self.span_digest: bytes | None = None
         self.rem_digest: bytes | None = None
+        self.pod_key: str | None = None
+        self.pod: Any = None
 
 
 class WireCache:
@@ -326,6 +333,25 @@ class WireCache:
                 if native is not None and ctx.rem_digest is not None:
                     native.install(ctx.span_digest, ctx.rem_digest,
                                    verb, stamp, enc.body)
+                    if ctx.pod_key is not None:
+                        # shadow the install in the black-box digest map:
+                        # a future native hit of these exact digests
+                        # serves THIS pod with THIS verdict, and the ring
+                        # pump joins the event back here for the
+                        # source=native explain record
+                        from tpushare.obs.blackbox import DIGEST_MAP
+                        DIGEST_MAP.register(
+                            ctx.span_digest, ctx.rem_digest, verb, {
+                                "pod_key": ctx.pod_key,
+                                "pod": ctx.pod,
+                                "ok": enc.ok if verb == "filter" else None,
+                                "candidates": (enc.ok + enc.failed
+                                               if verb == "filter"
+                                               else enc.count),
+                                "best": enc.best,
+                                "stamp": stamp,
+                                "digest": ctx.span_digest.hex(),
+                            })
         return enc
 
     # -- fragment encoders (byte-identical to json.dumps defaults) ------

@@ -11,6 +11,8 @@ the operator subcommands over the extender's diagnostic endpoints:
     tpushare-inspect qos               # /inspect/qos tier/eviction state
     tpushare-inspect explain [<pod>]   # /inspect/explain decision audit
     tpushare-inspect traces [-n N]     # /debug/traces flight recorder
+    tpushare-inspect journal           # /inspect/journal black-box state
+    tpushare-inspect metrics [--federated]  # /metrics[/federated] scrape
 
 No hand-rolled curl: every JSON surface the extender serves has a CLI
 verb (the fleet/explain/traces trio is rendered for terminals; raw
@@ -30,6 +32,13 @@ def fetch_path(endpoint: str, path: str) -> Any:
     with urllib.request.urlopen(endpoint.rstrip("/") + path,
                                 timeout=10) as r:
         return json.loads(r.read())
+
+
+def fetch_text(endpoint: str, path: str) -> str:
+    """Raw text surface (/metrics is exposition format, not JSON)."""
+    with urllib.request.urlopen(endpoint.rstrip("/") + path,
+                                timeout=10) as r:
+        return r.read().decode()
 
 
 def fetch(endpoint: str, node: str | None = None) -> dict[str, Any]:
@@ -403,6 +412,61 @@ def render_qos(snap: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_journal(snap: dict[str, Any]) -> str:
+    """Terminal rendering of the /inspect/journal black-box snapshot:
+    ring pump health (the zero-Python fast path's telemetry), decision-
+    journal files and recorded aggregate, federation slot state — the
+    one-read answer to "is the flight data actually being recorded"."""
+    lines: list[str] = []
+    bb = snap.get("blackbox") or {}
+    ring = bb.get("ring") or {}
+    if not bb.get("supported"):
+        lines.append("black box: UNSUPPORTED (pre-v8 .so or "
+                     "TPUSHARE_BLACKBOX=0) — native fast-path serves "
+                     "are not recorded")
+    else:
+        lines.append(
+            f"black box: {'running' if bb.get('running') else 'STOPPED'}, "
+            f"{int(bb.get('events_total', 0))} events drained "
+            f"(period {bb.get('period_s')} s), "
+            f"{int(ring.get('dropped_total', 0))} dropped, "
+            f"{int(ring.get('pending', 0))}/"
+            f"{int(ring.get('capacity', 0))} pending in ring, "
+            f"{int(bb.get('digest_map_entries', 0))} digest-map entries")
+    j = snap.get("journal") or {}
+    if not j.get("enabled"):
+        lines.append("journal: disabled (set TPUSHARE_JOURNAL_DIR to "
+                     "record an incident journal)")
+    else:
+        rec = j.get("recorded") or {}
+        lines.append(
+            f"journal: {j.get('directory')} "
+            f"({len(j.get('files') or [])} file(s), "
+            f"{int(j.get('bytes', 0))}/{int(j.get('max_bytes', 0))} "
+            f"bytes), {int(j.get('written', 0))} written, "
+            f"{int(j.get('buffered', 0))} buffered, "
+            f"{int(j.get('dropped', 0))} dropped")
+        lines.append(
+            f"  recorded: {int(rec.get('pods', 0))} pod(s) — "
+            f"{int(rec.get('admitted', 0))} admitted, "
+            f"{int(rec.get('rejected', 0))} rejected; "
+            f"{int(rec.get('binds', 0))} bind(s), "
+            f"{int(rec.get('bind_failures', 0))} failed")
+        lines.append(
+            f"  replay: python -m tpushare.sim --replay "
+            f"{j.get('directory')}")
+    f = snap.get("federation") or {}
+    if not f.get("enabled"):
+        lines.append("federation: disabled")
+    else:
+        lines.append(
+            f"federation: slot {f.get('slot')} of {f.get('nslots')} "
+            f"(pid {f.get('pid')}), {int(f.get('publishes', 0))} "
+            f"publish(es), {int(f.get('publish_errors', 0))} error(s), "
+            f"period {f.get('period_s')} s")
+    return "\n".join(lines)
+
+
 def render_traces(dump: dict[str, Any], limit: int | None = None) -> str:
     """Terminal rendering of the /debug/traces flight recorder."""
     lines: list[str] = []
@@ -435,10 +499,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="print the raw JSON instead of a table")
     ap.add_argument("-n", "--limit", type=int, default=None,
                     help="traces: show at most N traces")
+    ap.add_argument("--federated", action="store_true",
+                    help="metrics: scrape /metrics/federated (counters "
+                         "and histograms merged across every replica "
+                         "publishing into the shared segment) instead "
+                         "of this replica's /metrics")
     ap.add_argument("target", nargs="*", default=[],
                     help="node name, or a subcommand: 'fleet', 'defrag', "
                          "'ring', 'gang', 'wire', 'qos', 'explain [pod]', "
-                         "'traces'")
+                         "'traces', 'journal', 'metrics'")
     args = ap.parse_args(argv)
     cmd = args.target[0] if args.target else None
     try:
@@ -487,6 +556,17 @@ def main(argv: list[str] | None = None) -> int:
             # decision records are nested per-cycle trees; JSON is the
             # honest rendering (the table would lie by omission)
             print(json.dumps(out, indent=2))
+            return 0
+        if cmd == "journal":
+            snap = fetch_path(args.endpoint, "/inspect/journal")
+            print(json.dumps(snap, indent=2) if args.json
+                  else render_journal(snap))
+            return 0
+        if cmd == "metrics":
+            path = "/metrics/federated" if args.federated else "/metrics"
+            # already text exposition format: print verbatim (--json has
+            # nothing to add — the scrape IS the raw surface)
+            print(fetch_text(args.endpoint, path), end="")
             return 0
         if cmd == "traces":
             path = "/debug/traces"

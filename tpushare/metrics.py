@@ -62,6 +62,12 @@ class Counter:
         with self._lock:
             return self._v
 
+    def state(self) -> dict:
+        """JSON-able snapshot for cross-process federation (see
+        extender/federation.py): mergeable by summing."""
+        with self._lock:
+            return {"type": "counter", "help": self.help, "value": self._v}
+
     def expose(self) -> str:
         return (f"# HELP {self.name} {_escape_help(self.help)}\n"
                 f"# TYPE {self.name} counter\n"
@@ -117,6 +123,14 @@ class LabeledCounter:
         with self._lock:
             return sum(v for key, v in self._series.items()
                        if all(key[i] == want for i, want in idx.items()))
+
+    def state(self) -> dict:
+        """JSON-able snapshot for federation: series as [labels, value]
+        pairs (JSON has no tuple keys); merged by summing per key."""
+        with self._lock:
+            series = [[list(k), v] for k, v in sorted(self._series.items())]
+        return {"type": "labeled_counter", "help": self.help,
+                "labelnames": list(self.labelnames), "series": series}
 
     def expose(self) -> str:
         out = [f"# HELP {self.name} {_escape_help(self.help)}",
@@ -200,6 +214,15 @@ class Histogram:
             cum += c
         return self.buckets[-1] if self.buckets else None
 
+    def state(self) -> dict:
+        """JSON-able snapshot for federation: per-bucket RAW counts (not
+        cumulative) plus sum — mergeable element-wise when the bucket
+        layout matches (it does across replicas of one binary)."""
+        with self._lock:
+            return {"type": "histogram", "help": self.help,
+                    "buckets": list(self.buckets),
+                    "counts": list(self._counts), "sum": self._sum}
+
     def expose(self) -> str:
         with self._lock:
             counts = list(self._counts)
@@ -261,6 +284,20 @@ class Registry:
         where labels is the rendered label string ('' for none)."""
         self._gauges.append((name, help_, fn))
 
+    def federation_state(self) -> dict[str, dict]:
+        """Every counter/histogram's mergeable snapshot, keyed by metric
+        name. Scrape-time gauges are deliberately EXCLUDED: a gauge is a
+        statement about THIS process's current view (cache age, pending
+        depth) — summing gauges across replicas of one shared fleet
+        would double-count the world. Counters and histograms are event
+        streams, and events federate by addition."""
+        out: dict[str, dict] = {}
+        for m in self._metrics:
+            state = getattr(m, "state", None)
+            if callable(state):
+                out[m.name] = state()
+        return out
+
     def expose(self) -> str:
         parts = [m.expose() for m in self._metrics]
         for name, help_, fn in self._gauges:
@@ -273,6 +310,89 @@ class Registry:
                 continue  # scrape must not fail because one gauge did
             parts.append("\n".join(lines) + "\n")
         return "".join(parts)
+
+
+# -- federation merge ---------------------------------------------------------
+# Pure functions over the state() snapshots above: merge_states sums N
+# per-process snapshots into one fleet view; expose_merged renders it in
+# the same text format a single process exposes. Both live here (not in
+# extender/federation.py) so the transport — mmap segment, file, test
+# fixture — stays orthogonal to the arithmetic.
+
+def merge_states(states: list[dict[str, dict]]) -> dict[str, dict]:
+    """Sum mergeable metric snapshots. Type or bucket-layout conflicts
+    (a mid-rollout mixed fleet) keep the FIRST seen shape and skip the
+    conflicting contribution — a partial merge beats a failed scrape."""
+    merged: dict[str, dict] = {}
+    series_acc: dict[str, dict[tuple, float]] = {}
+    for st in states:
+        if not isinstance(st, dict):
+            continue
+        for name, s in st.items():
+            if not isinstance(s, dict) or "type" not in s:
+                continue
+            cur = merged.get(name)
+            if cur is None:
+                cur = merged[name] = {k: (list(v) if isinstance(v, list)
+                                          else v) for k, v in s.items()}
+                if s["type"] == "labeled_counter":
+                    series_acc[name] = {tuple(k): v
+                                        for k, v in s.get("series", [])}
+                continue
+            if cur["type"] != s["type"]:
+                continue
+            if s["type"] == "counter":
+                cur["value"] += s.get("value", 0.0)
+            elif s["type"] == "labeled_counter":
+                acc = series_acc[name]
+                for k, v in s.get("series", []):
+                    key = tuple(k)
+                    acc[key] = acc.get(key, 0.0) + v
+            elif s["type"] == "histogram":
+                if list(cur.get("buckets", [])) != list(s.get("buckets", [])):
+                    continue
+                cur["counts"] = [a + b for a, b in
+                                 zip(cur["counts"], s.get("counts", []))]
+                cur["sum"] += s.get("sum", 0.0)
+    for name, acc in series_acc.items():
+        merged[name]["series"] = [[list(k), v]
+                                  for k, v in sorted(acc.items())]
+    return merged
+
+
+def expose_merged(merged: dict[str, dict]) -> str:
+    """Render a merged snapshot in text exposition format, sorted by
+    metric name (deterministic across scrapes of the same state)."""
+    parts: list[str] = []
+    for name in sorted(merged):
+        s = merged[name]
+        help_ = _escape_help(str(s.get("help", "")))
+        if s["type"] == "counter":
+            parts.append(f"# HELP {name} {help_}\n# TYPE {name} counter\n"
+                         f"{name} {s.get('value', 0.0)}\n")
+        elif s["type"] == "labeled_counter":
+            out = [f"# HELP {name} {help_}", f"# TYPE {name} counter"]
+            labelnames = s.get("labelnames", [])
+            for key, v in s.get("series", []):
+                labels = ",".join(
+                    f'{n}="{_escape_label_value(str(val))}"'
+                    for n, val in zip(labelnames, key))
+                out.append(f"{name}{{{labels}}} {v}")
+            parts.append("\n".join(out) + "\n")
+        elif s["type"] == "histogram":
+            counts = s.get("counts", [])
+            buckets = s.get("buckets", [])
+            total = sum(counts)
+            out = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
+            cum = 0
+            for i, b in enumerate(buckets):
+                cum += counts[i] if i < len(counts) else 0
+                out.append(f'{name}_bucket{{le="{b}"}} {cum}')
+            out.append(f'{name}_bucket{{le="+Inf"}} {total}')
+            out.append(f"{name}_sum {s.get('sum', 0.0)}")
+            out.append(f"{name}_count {total}")
+            parts.append("\n".join(out) + "\n")
+    return "".join(parts)
 
 
 # latency buckets tuned around the 50 ms p50 target (BASELINE.md)

@@ -1,0 +1,315 @@
+"""Black-box ring pump: native fast-path events back into observability.
+
+PR 16 made the steady state zero-Python — and invisible. A digest-hit
+Filter/Prioritize is served entirely inside ``tpushare_wire_probe`` with
+the GIL released: no trace, no explain record, no latency sample beyond
+the Python-side remainder. This module closes the gap without touching
+the fast path's cost model. The ABI v8 native ring (placement.cpp,
+``blackbox`` namespace) records one fixed-slot event per instrumented
+call — kind, outcome, monotonic completion tick, duration ticks, and the
+first 8 bytes of the wire digests — and the :class:`RingPump` drains it
+on a background thread, feeding three existing consumers:
+
+- the **phase histograms**: ring tick deltas become
+  ``tpushare_wire_native_probe_seconds`` observations, so the histogram
+  reflects actual native serve time instead of the Python-side remainder
+  (the pump flips ``nativewire.RING_LATENCY_ACTIVE`` so the serve path
+  stops double-observing);
+- the **flight recorder**: a native serve slower than the recorder's
+  ``slow_ms`` is pinned as a :class:`NativeServeTrace`, exactly like a
+  slow Python cycle;
+- the **explain store**: a served (hit) event joins the
+  :data:`DIGEST_MAP` — populated by ``wirecache._finish`` at native
+  install time, when the pod identity and verdict are in hand — and
+  lands as a truthful ``source=native`` record, so a native-heavy storm
+  leaves zero unexplained pods.
+
+Ring overflow is loud, never corrupt: the producer drops and counts, and
+the pump surfaces the cumulative drop count as
+``tpushare_blackbox_dropped_total``.
+
+Lock discipline (tests/test_lock_order_lint.py): ``DigestMap._lock`` and
+``RingPump._lock`` are LEAF locks guarding a dict and lifecycle fields
+for a few instructions. Neither is ever held across a ring drain, an
+explain/recorder call, a journal flush, or any I/O — the drain loop
+reads the ring lock-free and joins the map with short get() calls.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any
+
+from tpushare.core.native import engine
+from tpushare.metrics import Counter, LabeledCounter
+
+# kind/outcome decode for placement.cpp blackbox events
+KIND_WIRE_PROBE = 1
+KIND_CYCLE_TOPO = 2
+KIND_SOLVE_GANG = 3
+KINDS = {KIND_WIRE_PROBE: "wire_probe", KIND_CYCLE_TOPO: "cycle_topo",
+         KIND_SOLVE_GANG: "solve_gang"}
+# wire probe rc values worth labeling (incomplete/grow never reach the
+# ring — the C side suppresses retry artifacts)
+WIRE_OUTCOMES = {1: "hit", 0: "miss", -1: "error", -4: "bypass"}
+_VERB_NAMES = {0: "filter", 1: "prioritize"}
+
+BLACKBOX_EVENTS = LabeledCounter(
+    "tpushare_blackbox_events_total",
+    "Native black-box ring events drained, by instrumented call "
+    "(wire_probe / cycle_topo / solve_gang) and outcome (wire: "
+    "hit/miss/bypass/error; cycle_topo: feasible/infeasible; "
+    "solve_gang: placed/no_fit/error)",
+    ("kind", "outcome"))
+BLACKBOX_DROPPED = Counter(
+    "tpushare_blackbox_dropped_total",
+    "Native black-box ring events dropped because the ring was full "
+    "(producers never block — sustained growth means the pump is "
+    "draining too slowly for the serve rate)")
+
+
+def decode_wire_outcome(outcome: int) -> tuple[int, int]:
+    """Unpack a wire_probe event's ``rc * 256 + verb`` outcome field
+    into (rc, verb_id). verb_id 255 = bypass before the route matched."""
+    verb = outcome & 0xFF
+    return (outcome - verb) // 256, verb
+
+
+class NativeServeTrace:
+    """A flight-recorder entry for one slow native serve. Quacks enough
+    like obs.trace.Trace (trace_id / duration_ms / to_dict) for the
+    recorder ring, /debug/traces and the slowest() summary."""
+
+    __slots__ = ("trace_id", "pod_key", "duration_ms", "outcome", "verb",
+                 "time_unix")
+
+    def __init__(self, trace_id: str, pod_key: str | None,
+                 duration_ms: float, verb: str) -> None:
+        self.trace_id = trace_id
+        self.pod_key = pod_key
+        self.duration_ms = duration_ms
+        self.outcome = "native_serve"
+        self.verb = verb
+        self.time_unix = round(time.time(), 3)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "trace_id": self.trace_id,
+            "pod_key": self.pod_key,
+            "duration_ms": round(self.duration_ms, 3),
+            "outcome": self.outcome,
+            "source": "native",
+            "verb": self.verb,
+            "time_unix": self.time_unix,
+            "spans": [],
+        }
+
+
+def _prefix8(digest: bytes) -> int:
+    """Signed int64 of a digest's first 8 bytes — the SAME bit pattern
+    the C side memcpy's into an event's span8/rem8 fields."""
+    return int.from_bytes(digest[:8], "little", signed=True)
+
+
+class DigestMap:
+    """Bounded (span8, rem8, verb) -> request-context map.
+
+    The ring can't carry pod identity, but a native hit serves a
+    byte-identical request to one the Python path already answered — so
+    ``wirecache._finish`` registers the pod identity and verdict here at
+    native-table install time, and the pump joins drained hit events
+    back to them. Bounded LRU like the native table it shadows."""
+
+    MAX_ENTRIES = 512
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._map: OrderedDict[tuple[int, int, int], dict] = OrderedDict()
+
+    def register(self, span_digest: bytes, rem_digest: bytes, verb: str,
+                 info: dict[str, Any]) -> None:
+        vid = 0 if verb == "filter" else 1
+        key = (_prefix8(span_digest), _prefix8(rem_digest), vid)
+        with self._lock:
+            self._map[key] = info
+            self._map.move_to_end(key)
+            while len(self._map) > self.MAX_ENTRIES:
+                self._map.popitem(last=False)
+
+    def lookup(self, span8: int, rem8: int, verb_id: int) -> dict | None:
+        with self._lock:
+            return self._map.get((span8, rem8, verb_id))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+
+# process-global, matching the process-global C ring it annotates
+DIGEST_MAP = DigestMap()
+
+
+class RingPump:
+    """Background drain of the native event ring.
+
+    One per server process. ``start()`` enables the C ring and spawns a
+    daemon drain thread; ``stop()`` disables the ring, drains the tail
+    and joins. ``explain`` (obs.explain.ExplainStore) and ``recorder``
+    (obs.recorder.FlightRecorder) are optional — absent consumers are
+    skipped, the counters still flow."""
+
+    def __init__(self, *, explain=None, recorder=None,
+                 period_s: float | None = None,
+                 batch: int = 1024) -> None:
+        if period_s is None:
+            period_s = float(os.environ.get(
+                "TPUSHARE_BLACKBOX_PERIOD_S", "0.1"))
+        self.explain = explain
+        self.recorder = recorder
+        self.period_s = period_s
+        self.batch = batch
+        self.enabled = engine.blackbox_supported()
+        # lifecycle only; NEVER held across a drain or a consumer call
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._dropped_seen = 0
+        self._events_total = 0
+        self._serial = 0
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            engine.blackbox_enable()
+            self._set_ring_latency(True)
+            t = threading.Thread(target=self._run, daemon=True,
+                                 name="tpushare-blackbox-pump")
+            self._thread = t
+        t.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=5.0)
+        # final tail drain, then quiesce the ring
+        self.drain_once()
+        self._set_ring_latency(False)
+        engine.blackbox_disable()
+
+    @staticmethod
+    def _set_ring_latency(active: bool) -> None:
+        # flip the nativewire flag (imported lazily: nativewire must not
+        # import this module at top level, and vice versa on the hot path)
+        from tpushare.extender import nativewire
+        nativewire.RING_LATENCY_ACTIVE = active
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.drain_once()
+            except Exception:  # noqa: BLE001 — observability must not bite
+                pass
+
+    # -- the drain itself ------------------------------------------------
+
+    def drain_once(self) -> int:
+        """Drain everything currently in the ring; returns event count.
+        Public so tests and inline callers can pump synchronously."""
+        total = 0
+        while True:
+            rows = engine.blackbox_drain(self.batch)
+            if not rows:
+                break
+            total += len(rows)
+            for row in rows:
+                self._process(row)
+        self._sync_dropped()
+        if total:
+            self._events_total += total
+        return total
+
+    def _sync_dropped(self) -> None:
+        dropped = engine.blackbox_stats()["dropped_total"]
+        if dropped > self._dropped_seen:
+            BLACKBOX_DROPPED.inc(dropped - self._dropped_seen)
+            self._dropped_seen = dropped
+
+    def _process(self, row: tuple[int, ...]) -> None:
+        kind, outcome, t_ns, dur_ns, span8, rem8 = row
+        if kind == KIND_WIRE_PROBE:
+            rc, verb_id = decode_wire_outcome(outcome)
+            label = WIRE_OUTCOMES.get(rc, "other")
+            BLACKBOX_EVENTS.inc("wire_probe", label)
+            # satellite: actual native serve time into the phase
+            # histogram (the serve path's perf_counter observe is
+            # suppressed while the pump runs)
+            from tpushare.extender import nativewire
+            nativewire.WIRE_NATIVE_PROBE_SECONDS.observe(dur_ns / 1e9)
+            if rc == 1:
+                self._record_native_serve(verb_id, t_ns, dur_ns, span8,
+                                          rem8)
+        elif kind == KIND_CYCLE_TOPO:
+            BLACKBOX_EVENTS.inc(
+                "cycle_topo", "feasible" if outcome > 0 else "infeasible")
+        elif kind == KIND_SOLVE_GANG:
+            BLACKBOX_EVENTS.inc(
+                "solve_gang", {1: "placed", 0: "no_fit"}.get(
+                    outcome, "error"))
+
+    def _record_native_serve(self, verb_id: int, t_ns: int, dur_ns: int,
+                             span8: int, rem8: int) -> None:
+        info = DIGEST_MAP.lookup(span8, rem8, verb_id)
+        verb = _VERB_NAMES.get(verb_id, "?")
+        pod_key = info.get("pod_key") if info else None
+        self._serial += 1
+        trace_id = f"native-{self._serial}-{t_ns}"
+        dur_ms = dur_ns / 1e6
+        explain = self.explain
+        if explain is not None and info is not None:
+            try:
+                explain.record_native(
+                    pod_key, info.get("pod"), trace_id, verb,
+                    ok=info.get("ok"), candidates=info.get("candidates", 0),
+                    best=info.get("best"), digest=info.get("digest"),
+                    stamp=info.get("stamp"), duration_ms=dur_ms)
+            except Exception:  # noqa: BLE001
+                pass
+        recorder = self.recorder
+        if recorder is not None and dur_ms >= recorder.slow_ms:
+            # slow native serves get pinned like slow traces
+            try:
+                recorder.record(
+                    NativeServeTrace(trace_id, pod_key, dur_ms, verb))
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        ring = engine.blackbox_stats()
+        with self._lock:
+            running = self._thread is not None
+        return {
+            "supported": self.enabled,
+            "running": running,
+            "period_s": self.period_s,
+            "events_total": self._events_total,
+            "digest_map_entries": len(DIGEST_MAP),
+            "ring": ring,
+        }

@@ -102,6 +102,8 @@ class ExplainStore:
                 "nodes": nodes,
             }
         self._notify("filter_recorded", pod_key, ok, len(nodes))
+        self._notify("decision_recorded", "filter", pod_key, pod, {
+            "ok": ok, "candidates": len(nodes), "source": "computed"})
 
     def record_batch(self, pod_key: str, pod: dict[str, Any] | None,
                      trace_id: str | None, leader_trace_id: str | None,
@@ -127,6 +129,8 @@ class ExplainStore:
                                  "batch_size": size}},
             }
         self._notify("filter_recorded", pod_key, 1, 1)
+        self._notify("decision_recorded", "filter", pod_key, pod, {
+            "ok": 1, "candidates": 1, "source": "batched", "node": node})
 
     def record_gang(self, pod_key: str, pod: dict[str, Any] | None,
                     trace_id: str | None, leader_trace_id: str | None,
@@ -157,6 +161,8 @@ class ExplainStore:
                                  "gang_rank": rank}},
             }
         self._notify("filter_recorded", pod_key, 1, 1)
+        self._notify("decision_recorded", "filter", pod_key, pod, {
+            "ok": 1, "candidates": 1, "source": "gang", "node": node})
 
     def record_wire(self, pod_key: str, pod: dict[str, Any] | None,
                     trace_id: str | None, verb: str, *,
@@ -186,6 +192,53 @@ class ExplainStore:
         if verb == "filter":
             self._notify("filter_recorded", pod_key,
                          ok if ok is not None else 0, candidates)
+        self._notify("decision_recorded", verb, pod_key, pod, {
+            "ok": ok, "candidates": candidates, "best": best,
+            "source": "wirecache"})
+
+    def record_native(self, pod_key: str, pod: dict[str, Any] | None,
+                      trace_id: str | None, verb: str, *,
+                      ok: int | None = None, candidates: int = 0,
+                      best: str | None = None, digest: str | None = None,
+                      stamp: int | None = None,
+                      duration_ms: float | None = None) -> None:
+        """The verb was served entirely inside the GIL-released native
+        probe (ABI v8 black box): the pre-encoded bytes went out with no
+        Python on the path, and the ring pump joined the event back to
+        the pod via the digest map. Record the truthful aggregate with
+        ``source: native`` — digest, fragment verdict and stamp included
+        — so the audit never shows "no record" for a natively-served
+        pod, and keep the observer stream flowing like every other
+        serve."""
+        with self._lock:
+            rec = self._entry(pod_key, pod, trace_id)
+            if verb == "filter":
+                rec["filter"] = {
+                    "candidates": candidates,
+                    "ok": ok if ok is not None else 0,
+                    "nodes": {},
+                    "source": "native",
+                    "digest": digest,
+                    "stamp": stamp,
+                    "duration_ms": round(duration_ms, 3)
+                    if duration_ms is not None else None,
+                }
+            else:
+                rec["prioritize"] = {
+                    "scores": {},
+                    "best": best,
+                    "source": "native",
+                    "digest": digest,
+                    "stamp": stamp,
+                    "duration_ms": round(duration_ms, 3)
+                    if duration_ms is not None else None,
+                }
+        if verb == "filter":
+            self._notify("filter_recorded", pod_key,
+                         ok if ok is not None else 0, candidates)
+        self._notify("decision_recorded", verb, pod_key, pod, {
+            "ok": ok, "candidates": candidates, "best": best,
+            "source": "native", "stamp": stamp})
 
     def record_prioritize(self, pod_key: str, pod: dict[str, Any] | None,
                           trace_id: str | None,
@@ -194,6 +247,9 @@ class ExplainStore:
         with self._lock:
             rec = self._entry(pod_key, pod, trace_id)
             rec["prioritize"] = {"scores": scores, "best": best}
+        self._notify("decision_recorded", "prioritize", pod_key, pod, {
+            "best": best, "candidates": len(scores),
+            "source": "computed"})
 
     def record_bind(self, pod_key: str, pod_identity: dict[str, Any] | None,
                     trace_id: str | None, node: str, outcome: str,
@@ -208,6 +264,8 @@ class ExplainStore:
                 "chip_ids": chip_ids,
             }
         self._notify("bind_recorded", pod_key, outcome)
+        self._notify("decision_recorded", "bind", pod_key, pod_identity, {
+            "node": node, "outcome": outcome, "error": error or None})
 
     # -- queries --------------------------------------------------------------
 
@@ -241,3 +299,30 @@ class ExplainStore:
     def reset(self) -> None:
         with self._lock:
             self._pods.clear()
+
+
+class FanoutObserver:
+    """Fan one decision stream out to several observers (the scorecard
+    AND the incident journal share the single ``ExplainStore.observer``
+    slot). A child receives only the notifications it implements, and a
+    broken child never starves its siblings — same blast-radius contract
+    as ``_notify`` itself."""
+
+    def __init__(self, *children) -> None:
+        self.children = [c for c in children if c is not None]
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        targets = [getattr(c, method) for c in self.children
+                   if hasattr(c, method)]
+        if not targets:
+            raise AttributeError(method)
+
+        def fanout(*args, **kw):
+            for t in targets:
+                try:
+                    t(*args, **kw)
+                except Exception:  # noqa: BLE001 — observability must not bite
+                    pass
+        return fanout

@@ -1,0 +1,319 @@
+"""Incident journal: a bounded append-only record of every decision.
+
+The flight recorder answers "what was the scheduler doing around 14:32"
+for the last few hundred cycles; the journal answers it for the last few
+hundred *megabytes* — and in a form the wind tunnel can re-drive.
+Every admitted/rejected/bound pod flows through here (fed off the
+ExplainStore's decision stream, so natively-served and wirecache-served
+pods are recorded exactly like computed ones), each record carrying the
+pod's placement-relevant spec, the verdict provenance
+(computed/wirecache/native/batched/gang), the mutation stamp when one
+exists, and a CRC. ``python -m tpushare.sim --replay <journal>`` then
+rebuilds the recorded arrival window as a SimPod trace and re-drives it
+through the simulator, diffing the replayed scorecard against the
+journal's own recorded aggregate — any production incident becomes a
+deterministic wind-tunnel case.
+
+Format: JSONL, one record per line, schema ``tpushare-journal/1``:
+
+- ``{"kind": "header", "schema": ..., "t0": unix, "fleet": {...}}``
+  opens every file;
+- ``{"kind": "decision", "verb": "filter"|"prioritize"|"bind", "t": ...,
+  "pod_key": ..., "spec": {hbm_mib, chip_count, topology, qos_tier,
+  mesh_shape, priority}, ...verdict fields..., "crc": ...}``.
+
+``crc`` is zlib.crc32 over the canonical dump of the rest of the
+record; a reader skips any line that fails to parse or verify — a
+crash mid-write truncates at most the tail line and the journal stays
+readable (tests/test_journal.py proves it).
+
+Rotation: the active file rolls at half of ``TPUSHARE_JOURNAL_MAX_MB``
+(default 64) and ONE predecessor is kept, bounding disk to ~max_mb.
+
+Lock discipline (tests/test_lock_order_lint.py): ``self._io_lock``
+serializes flush/rotate file I/O and is taken FIRST; ``self._lock``
+guards the in-memory buffer and counters for a few instructions and is
+NEVER held across a flush, a ring drain, or an apiserver call — append
+is a list.append under the lock, disk happens on the flush thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Any, Iterator
+
+SCHEMA = "tpushare-journal/1"
+
+_SPEC_FIELDS = ("hbm_mib", "chip_count", "topology", "qos_tier",
+                "mesh_shape", "priority")
+
+
+def _canonical(rec: dict[str, Any]) -> bytes:
+    return json.dumps(rec, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def _stamp_crc(rec: dict[str, Any]) -> dict[str, Any]:
+    rec["crc"] = zlib.crc32(_canonical(rec))
+    return rec
+
+
+def _check_crc(rec: dict[str, Any]) -> bool:
+    crc = rec.pop("crc", None)
+    return crc is not None and zlib.crc32(_canonical(rec)) == crc
+
+
+def pod_spec_fields(pod: Any) -> dict[str, Any] | None:
+    """The placement-relevant spec of a pod dict, in SimPod vocabulary
+    (the sim trace format IS the journal's pod schema). None when the
+    pod isn't parseable as a tpushare pod."""
+    if not isinstance(pod, dict) or not pod.get("spec"):
+        return None
+    from tpushare.contract import pod as podlib
+    try:
+        topo = podlib.pod_topology_request(pod)
+        mesh = podlib.pod_mesh_shape(pod)
+        return {
+            "hbm_mib": podlib.pod_hbm_request(pod),
+            "chip_count": podlib.pod_chip_count_request(pod),
+            "topology": list(topo) if topo else None,
+            "qos_tier": _pod_tier(pod),
+            "mesh_shape": list(mesh) if mesh else None,
+            "priority": int((pod.get("spec") or {}).get("priority") or 0),
+        }
+    except Exception:  # noqa: BLE001 — an odd pod must not kill the stream
+        return None
+
+
+def _pod_tier(pod: dict[str, Any]) -> str:
+    try:
+        from tpushare.qos.tiers import pod_tier
+        return pod_tier(pod)
+    except Exception:  # noqa: BLE001
+        return "burstable"
+
+
+class DecisionJournal:
+    """One rotating decision journal per server process.
+
+    Implements the ExplainStore observer method ``decision_recorded``;
+    attach it alongside the scorecard via obs.explain.FanoutObserver."""
+
+    MAX_SPECS = 2048      # pod_key -> spec joins held for bind records
+    MAX_BUFFER = 65536    # append backpressure: drop oldest, count it
+
+    def __init__(self, directory: str, *, max_mb: float | None = None,
+                 fleet_info: dict[str, Any] | None = None,
+                 flush_period_s: float = 0.2) -> None:
+        if max_mb is None:
+            max_mb = float(os.environ.get("TPUSHARE_JOURNAL_MAX_MB", "64"))
+        self.directory = directory
+        self.max_bytes = int(max_mb * 1024 * 1024)
+        self.fleet_info = fleet_info
+        self.flush_period_s = flush_period_s
+        # buffer + counters; NEVER held across file I/O
+        self._lock = threading.Lock()
+        # flush/rotate serialization; file I/O happens under THIS one
+        self._io_lock = threading.Lock()
+        self._buffer: list[dict[str, Any]] = []
+        self._specs: dict[str, dict[str, Any]] = {}
+        self._dropped = 0
+        self._written = 0
+        self.t0 = time.time()
+        # recorded-window aggregate: the "what actually happened" side
+        # of the replay diff
+        self._agg = {"pods": 0, "admitted": 0, "rejected": 0,
+                     "binds": 0, "bind_failures": 0}
+        self._seen_pods: set[str] = set()
+        os.makedirs(directory, exist_ok=True)
+        self._path = self._next_path()
+        self._fh = open(self._path, "ab")
+        self._write_header()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- file plumbing ---------------------------------------------------
+
+    def _files(self) -> list[str]:
+        names = sorted(n for n in os.listdir(self.directory)
+                       if n.startswith("journal-")
+                       and n.endswith(".jsonl"))
+        return [os.path.join(self.directory, n) for n in names]
+
+    def _next_path(self) -> str:
+        files = self._files()
+        seq = 1
+        if files:
+            try:
+                seq = int(os.path.basename(files[-1])[8:-6]) + 1
+            except ValueError:
+                seq = len(files) + 1
+        return os.path.join(self.directory, f"journal-{seq:06d}.jsonl")
+
+    def _write_header(self) -> None:
+        rec = _stamp_crc({"kind": "header", "schema": SCHEMA,
+                          "t0": round(self.t0, 3),
+                          "fleet": self.fleet_info})
+        self._fh.write(_canonical(rec) + b"\n")
+        self._fh.flush()
+
+    # -- the observer feed -----------------------------------------------
+
+    def decision_recorded(self, verb: str, pod_key: str, pod: Any,
+                          info: dict[str, Any]) -> None:
+        """One decision off the explain stream. Called on webhook worker
+        threads and the pump thread — must stay cheap: parse, append
+        under the lock, return. Disk happens on the flush thread."""
+        now = time.time()
+        spec = pod_spec_fields(pod)
+        rec: dict[str, Any] = {"kind": "decision", "verb": verb,
+                               "t": round(now, 6), "pod_key": pod_key}
+        with self._lock:
+            if spec is not None:
+                self._specs[pod_key] = spec
+                while len(self._specs) > self.MAX_SPECS:
+                    self._specs.pop(next(iter(self._specs)))
+            else:
+                spec = self._specs.get(pod_key)
+            if spec is not None:
+                rec["spec"] = spec
+            for k in ("ok", "candidates", "best", "source", "stamp",
+                      "node", "outcome", "error"):
+                if info.get(k) is not None:
+                    rec[k] = info[k]
+            if verb == "filter":
+                if pod_key not in self._seen_pods:
+                    self._seen_pods.add(pod_key)
+                    self._agg["pods"] += 1
+                if info.get("ok"):
+                    self._agg["admitted"] += 1
+                else:
+                    self._agg["rejected"] += 1
+            elif verb == "bind":
+                if info.get("outcome") == "bound":
+                    self._agg["binds"] += 1
+                else:
+                    self._agg["bind_failures"] += 1
+            if len(self._buffer) >= self.MAX_BUFFER:
+                self._buffer.pop(0)
+                self._dropped += 1
+            self._buffer.append(rec)
+
+    # -- flushing + rotation ---------------------------------------------
+
+    def flush(self) -> int:
+        """Write every buffered record; returns lines written. Safe from
+        any thread — the io lock serializes writers, the buffer lock is
+        released before the first byte hits disk."""
+        with self._io_lock:
+            with self._lock:
+                pending, self._buffer = self._buffer, []
+            if not pending:
+                return 0
+            fh = self._fh
+            for rec in pending:
+                fh.write(_canonical(_stamp_crc(rec)) + b"\n")
+            fh.flush()
+            self._written += len(pending)
+            if fh.tell() >= self.max_bytes // 2:
+                self._rotate()
+            return len(pending)
+
+    def _rotate(self) -> None:
+        """Roll the active file (io lock held by flush). Keeps ONE
+        predecessor: disk stays bounded at ~max_bytes."""
+        self._fh.close()
+        files = self._files()
+        for stale in files[:-1]:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+        self._path = self._next_path()
+        self._fh = open(self._path, "ab")
+        self._write_header()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        t = threading.Thread(target=self._run, daemon=True,
+                             name="tpushare-journal-flush")
+        self._thread = t
+        t.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.flush_period_s):
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 — observability must not bite
+                pass
+
+    def stop(self) -> None:
+        t, self._thread = self._thread, None
+        if t is not None:
+            self._stop.set()
+            t.join(timeout=5.0)
+        try:
+            self.flush()
+        finally:
+            with self._io_lock:
+                self._fh.close()
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            agg = dict(self._agg)
+            buffered = len(self._buffer)
+            dropped = self._dropped
+            written = self._written
+        files = self._files()
+        return {
+            "directory": self.directory,
+            "path": self._path,
+            "files": [os.path.basename(f) for f in files],
+            "bytes": sum(os.path.getsize(f) for f in files
+                         if os.path.exists(f)),
+            "max_bytes": self.max_bytes,
+            "written": written,
+            "buffered": buffered,
+            "dropped": dropped,
+            "recorded": agg,
+        }
+
+    def recorded_aggregate(self) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._agg)
+
+
+# -- reading ------------------------------------------------------------------
+
+def read_journal(path: str) -> Iterator[dict[str, Any]]:
+    """Yield every valid record from a journal file or directory (files
+    in rotation order). Truncated/corrupt lines are skipped, not fatal:
+    a crash mid-write costs at most the tail record."""
+    if os.path.isdir(path):
+        files = sorted(os.path.join(path, n) for n in os.listdir(path)
+                       if n.startswith("journal-") and n.endswith(".jsonl"))
+    else:
+        files = [path]
+    for f in files:
+        with open(f, "rb") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except (ValueError, UnicodeDecodeError):
+                    continue  # truncated tail / torn write: skip
+                if not isinstance(rec, dict) or not _check_crc(rec):
+                    continue
+                yield rec

@@ -98,6 +98,17 @@ def _build_parser() -> argparse.ArgumentParser:
     sg = ap.add_argument_group(
         "sweep modes", "alternative harnesses around the replay "
                        "(mutually exclusive with each other)")
+    sg.add_argument("--replay", default=None, metavar="JOURNAL",
+                    help="incident replay: read a decision journal "
+                         "(file or TPUSHARE_JOURNAL_DIR directory, "
+                         "tpushare/obs/journal.py), rebuild the "
+                         "recorded arrival window as a SimPod trace, "
+                         "re-drive it through the simulator on the "
+                         "recorded fleet geometry, and diff the "
+                         "replayed scorecard against the journal's own "
+                         "recorded aggregate (tpushare/sim/replay.py); "
+                         "deterministic — the same journal emits "
+                         "byte-identical output")
     sg.add_argument("--autotune", action="store_true",
                     help="ranked knob sweep: replay the wind-tunnel "
                          "sweep workload under 18 knob configurations "
@@ -216,6 +227,31 @@ def _run(ap, args, emit) -> int:
     if args.pin and not (args.autotune or args.qos or args.topo):
         ap.error("--pin re-baselines a pinned gate: it requires "
                  "--autotune, --qos, or --topo")
+
+    if args.replay:
+        # incident replay owns its workload (the journal) and geometry
+        # (the journal header); trace/engine flags would silently not
+        # apply and are rejected rather than ignored
+        for flag, default in (("pods", 400), ("arrival_rate", 2.0),
+                              ("mean_duration", 40.0),
+                              ("multi_chip_fraction", 0.15),
+                              ("high_priority_fraction", 0.0),
+                              ("nodes", 8), ("chips", 4),
+                              ("hbm", 16384), ("mesh", None),
+                              ("preempt", "off"), ("engine", "python"),
+                              ("diurnal", False), ("seed", 0),
+                              ("shards", 0), ("procs", 0)):
+            if getattr(args, flag) != default:
+                ap.error(f"--{flag.replace('_', '-')} does not apply "
+                         "to --replay (workload and geometry come from "
+                         "the journal: tpushare/sim/replay.py)")
+        if args.autotune or args.qos or args.topo or args.defrag \
+                or args.gangs or args.slice:
+            ap.error("sweep modes do not apply to --replay")
+        from tpushare.sim.replay import replay_journal
+        policy = "binpack" if args.policy == "all" else args.policy
+        emit(replay_journal(args.replay, policy))
+        return 0
 
     if args.topo:
         from tpushare.sim import topo

@@ -1,0 +1,170 @@
+"""Incident replay: re-drive a recorded decision journal in the wind tunnel.
+
+The decision journal (tpushare/obs/journal.py) records every
+admitted/rejected/bound pod a live server saw, each with its
+placement-relevant spec in SimPod vocabulary — the journal's pod schema
+IS the sim trace format by construction. This module closes the loop:
+``python -m tpushare.sim --replay <journal>`` rebuilds the recorded
+arrival window as a SimPod trace, re-drives it through the simulator on
+the recorded fleet geometry, and diffs the replayed scorecard against
+the aggregate the journal itself recorded. A production incident ("why
+did admissions crater at 14:32") becomes a deterministic wind-tunnel
+case that can be re-run, bisected, and attached to a bug.
+
+Determinism contract: replaying the SAME journal emits byte-identical
+output (tests/test_journal.py proves it). Everything derives from the
+journal's own timestamps — no wall clock, no randomness; arrivals are
+offsets from the window start and every pod outlives the window, so the
+replay is a pure placement problem over the recorded arrival order.
+
+What replay can and cannot prove: the simulator re-decides placement
+with its own policy over the recorded *arrivals*; the journal records
+what the live fleet *actually decided* (including wirecache/native
+serves, preemptions, operator actions). The diff is therefore a signal,
+not an identity — a large admission-rate gap between recorded and
+replayed is exactly the anomaly worth investigating.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpushare.sim.simulator import Fleet, SimPod, run_sim
+
+# fallback geometry when the journal header carries no fleet info (an
+# old journal, or a server started without a synced cache snapshot)
+DEFAULT_FLEET = {"n_nodes": 8, "chips_per_node": 4,
+                 "hbm_per_chip_mib": 16384, "mesh": None}
+
+
+def load_window(path: str) -> dict[str, Any]:
+    """Parse a journal file/directory into the replay inputs: header
+    fleet info, the first filter decision per pod (arrival order), and
+    the recorded aggregate recomputed from the decision records
+    themselves (NOT trusted from memory — the journal is the record)."""
+    from tpushare.obs.journal import read_journal
+    fleet_info: dict[str, Any] | None = None
+    first_filter: dict[str, dict[str, Any]] = {}
+    agg = {"pods": 0, "admitted": 0, "rejected": 0,
+           "binds": 0, "bind_failures": 0}
+    records = 0
+    t_min: float | None = None
+    t_max: float | None = None
+    for rec in read_journal(path):
+        if rec.get("kind") == "header":
+            if fleet_info is None and isinstance(rec.get("fleet"), dict):
+                fleet_info = rec["fleet"]
+            continue
+        if rec.get("kind") != "decision":
+            continue
+        records += 1
+        t = rec.get("t")
+        if isinstance(t, (int, float)):
+            t_min = t if t_min is None else min(t_min, t)
+            t_max = t if t_max is None else max(t_max, t)
+        verb = rec.get("verb")
+        key = rec.get("pod_key")
+        if verb == "filter" and isinstance(key, str):
+            if key not in first_filter:
+                first_filter[key] = rec
+                agg["pods"] += 1
+            if rec.get("ok"):
+                agg["admitted"] += 1
+            else:
+                agg["rejected"] += 1
+        elif verb == "bind":
+            if rec.get("outcome") == "bound":
+                agg["binds"] += 1
+            else:
+                agg["bind_failures"] += 1
+    filters = agg["admitted"] + agg["rejected"]
+    agg["admission_rate"] = (round(agg["admitted"] / filters, 4)
+                             if filters else None)
+    return {
+        "fleet_info": fleet_info,
+        "first_filter": first_filter,
+        "recorded": agg,
+        "records": records,
+        "t_min": t_min,
+        "t_max": t_max,
+    }
+
+
+def build_trace(window: dict[str, Any]) -> list[SimPod]:
+    """One SimPod per recorded pod, in arrival (journal) order.
+
+    Arrival = offset of the pod's first filter decision from the window
+    start; duration = the whole window plus slack, so nothing departs
+    mid-replay — the replay is the recorded ARRIVAL sequence as a pure
+    placement problem, deterministic and independent of wall clock."""
+    t_min = window["t_min"] or 0.0
+    t_max = window["t_max"] or t_min
+    span = max(t_max - t_min, 1.0)
+    trace: list[SimPod] = []
+    for rec in window["first_filter"].values():
+        spec = rec.get("spec") or {}
+        t = rec.get("t")
+        arrival = (t - t_min) if isinstance(t, (int, float)) else 0.0
+        topo = spec.get("topology")
+        mesh = spec.get("mesh_shape")
+        trace.append(SimPod(
+            arrival=round(max(arrival, 0.0), 6),
+            duration=round(span * 2.0, 6),
+            hbm_mib=int(spec.get("hbm_mib") or 0),
+            chip_count=max(int(spec.get("chip_count") or 1), 1),
+            topology=tuple(topo) if topo else None,
+            priority=int(spec.get("priority") or 0),
+            qos_tier=str(spec.get("qos_tier") or "burstable"),
+            mesh_shape=tuple(mesh) if mesh else None,
+        ))
+    trace.sort(key=lambda p: p.arrival)
+    return trace
+
+
+def _fleet_from(info: dict[str, Any] | None) -> Fleet:
+    merged = dict(DEFAULT_FLEET)
+    if isinstance(info, dict):
+        for k in merged:
+            if info.get(k) is not None:
+                merged[k] = info[k]
+    mesh = merged["mesh"]
+    return Fleet.homogeneous(int(merged["n_nodes"]),
+                             int(merged["chips_per_node"]),
+                             int(merged["hbm_per_chip_mib"]),
+                             tuple(mesh) if mesh else None)
+
+
+def replay_journal(path: str, policy: str = "binpack") -> dict[str, Any]:
+    """The --replay entry: journal in, {recorded, replay, diff} out.
+
+    ``recorded`` is the aggregate recomputed from the journal's own
+    decision records; ``replay`` is the standard SimReport of re-driving
+    the rebuilt trace; ``diff`` compares the two admission views."""
+    window = load_window(path)
+    trace = build_trace(window)
+    fleet = _fleet_from(window["fleet_info"])
+    report = run_sim(fleet, trace, policy)
+    out = report.to_json()
+    recorded = window["recorded"]
+    rec_rate = recorded["admission_rate"]
+    rep_rate = (round(report.placed / report.pods, 4)
+                if report.pods else None)
+    return {
+        "mode": "replay",
+        "policy": policy,
+        "records": window["records"],
+        "window_s": (round(window["t_max"] - window["t_min"], 3)
+                     if window["t_max"] is not None else 0.0),
+        "fleet": window["fleet_info"] or dict(DEFAULT_FLEET),
+        "recorded": recorded,
+        "replay": out,
+        "diff": {
+            "recorded_admission_rate": rec_rate,
+            "replayed_admission_rate": rep_rate,
+            "admission_rate_delta": (round(rep_rate - rec_rate, 4)
+                                     if rec_rate is not None
+                                     and rep_rate is not None else None),
+            "recorded_pods": recorded["pods"],
+            "replayed_pods": report.pods,
+        },
+    }
