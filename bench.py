@@ -3850,6 +3850,67 @@ def defrag_bench() -> dict:
     }
 
 
+def migration_bench() -> dict:
+    """Live slice migration (ISSUE 20): the checkpoint-driven repack
+    proving ground, hermetic —
+
+    1. the chaos migration drill: a whole-slice checkpoint -> evict ->
+       restore move completes, and BOTH mid-move crash scenarios (serve
+       replica dying mid-checkpoint, apiserver write lost mid-placement)
+       roll the gang back byte-identically — with apiserver truth
+       sampled continuously (zero oversubscription between every two
+       moves) and zero half-moved slices;
+    2. workload-pause p50/p99 straight off the
+       ``tpushare_defrag_pause_seconds`` histogram the drill's real
+       migration sessions feed, checked under
+       ``TPUSHARE_MIGRATE_PAUSE_BUDGET_S``;
+    3. the wind-tunnel A/B (``sweep_forecast``, identical trace + move
+       budget): the forecast policy must hold average stranded chips
+       below target with STRICTLY fewer migrations than react-only
+       defrag.
+    """
+    from tpushare.chaos import (assert_migration_drill_invariants,
+                                run_migration_drill)
+    from tpushare.defrag.migration import PAUSE_SECONDS, pause_budget_s
+    from tpushare.sim.defrag import sweep_forecast
+
+    count0 = PAUSE_SECONDS.count
+    drill = run_migration_drill()
+    try:
+        assert_migration_drill_invariants(drill)
+        drill_failure = ""
+    except AssertionError as e:
+        drill_failure = str(e)
+
+    oversub = [o for s in drill.values()
+               for o in (s.get("oversubscription") or [])]
+    ab = sweep_forecast()
+    return {
+        "drill": {
+            kind: {"outcome": s.get("outcome"),
+                   "truth_samples": s.get("samples", 0),
+                   "half_moved": s.get("half_moved", []),
+                   "restores": s.get("restores", 0)}
+            for kind, s in drill.items()},
+        "drill_failure": drill_failure,
+        "oversubscription_instants": len(oversub),
+        "pause": {
+            "sessions": PAUSE_SECONDS.count - count0,
+            "p50_s": PAUSE_SECONDS.quantile(0.50),
+            "p99_s": PAUSE_SECONDS.quantile(0.99),
+            "budget_s": pause_budget_s(),
+        },
+        "forecast_ab": {
+            "verdict": ab["verdict"],
+            "react_pause_p99_s":
+                ab["react"]["migration"]["pause_p99_s"],
+            "forecast_pause_p99_s":
+                ab["forecast"]["migration"]["pause_p99_s"],
+            "stranded_target_chips": ab["stranded_target_chips"],
+        },
+    }
+
+
 def shard_scaleout_procs(n_procs: int = 4, n_pods: int = 96) -> dict:
     """Wall-clock scale-out with REAL processes (ISSUE 11).
 
@@ -4959,6 +5020,31 @@ def main() -> int:
            f"{drill['window_bound_s']:.1f}s "
            f"({drill_failure or 'all self-checks passed'})")
 
+    # live slice migration (ISSUE 20): the checkpoint-driven repack
+    # drill (completed control move + both mid-move crash rollbacks,
+    # apiserver truth sampled between every two moves), pause p50/p99
+    # under the budget, and the fewer-migrations forecast A/B
+    mig = migration_bench()
+    expect(not mig["drill_failure"]
+           and mig["oversubscription_instants"] == 0,
+           f"migration drill: slice move completed + both mid-move "
+           f"crashes rolled back, 0 oversubscription instants, 0 "
+           f"half-moved slices "
+           f"({mig['drill_failure'] or 'all self-checks passed'})")
+    mp = mig["pause"]
+    expect(mp["sessions"] > 0 and mp["p99_s"] is not None
+           and mp["p99_s"] <= mp["budget_s"],
+           f"migration pause p99 {mp['p99_s']}s under the "
+           f"{mp['budget_s']}s budget over {mp['sessions']} real "
+           f"checkpoint sessions (p50 {mp['p50_s']}s)")
+    mv = mig["forecast_ab"]["verdict"]
+    expect(mv["fewer_migrations"] and mv["stranded_held_below_target"],
+           f"forecast policy: {mv['forecast_moves']} migrations vs "
+           f"{mv['react_moves']} react-only on the identical trace, "
+           f"avg stranded {mv['forecast_avg_stranded']} chips held "
+           f"below the {mig['forecast_ab']['stranded_target_chips']}-"
+           f"chip target")
+
     # bind latency with real apiserver round-trips (stub apiserver wire)
     wire = wire_latency()
     expect(wire["p50"] < 50.0,
@@ -5251,6 +5337,10 @@ def main() -> int:
                 "drift_after_heal": len(drill["drift"]),
                 "half_bound_left": len(drill["half_bound_left"]),
             },
+            # live slice migration (ISSUE 20): drill outcomes, workload
+            # pause quantiles vs budget, and the fewer-migrations
+            # forecast-vs-react A/B verdict
+            "migration": mig,
         },
         "wire": {
             "note": "stub apiserver loopback: real HTTP wire format incl. "
@@ -5349,6 +5439,10 @@ if __name__ == "__main__":
     if "topo_placement" in sys.argv:
         print(json.dumps(topo_placement(), indent=2))
         sys.exit(0)
+    if "migration" in sys.argv:
+        result = migration_bench()
+        print(json.dumps(result, indent=2))
+        sys.exit(1 if result["drill_failure"] else 0)
     if "wire_fastpath" in sys.argv:
         procs = int(sys.argv[sys.argv.index("--procs") + 1]) \
             if "--procs" in sys.argv else 4

@@ -104,6 +104,11 @@ RANKS = {
     # planner holds nothing at all.
     ("executor.py", "self._lock"): 3,       # defrag budget governor
     ("rebalancer.py", "self._lock"): 4,     # defrag inspect state
+    # frag forecast (ISSUE 20): trend-deque bookkeeping only — NEVER
+    # held across a fleetwatch read (pressure()/fragmented_nodes() poll
+    # last_sample OUTSIDE it), a solve, or any cache call; a leaf like
+    # _pods_lock so nothing may ever be acquired inside it
+    ("forecast.py", "self._lock"): 92,
     # controller: the informer's seen-set and the workqueue condition
     # never nest with the cache chain (handlers are called lock-free)
     # or with each other today; seen-set < queue so a future requeue-
@@ -383,6 +388,64 @@ def test_pressure_lock_never_held_across_an_eviction():
                 walk(h.body, held)
 
     walk(tree.body, False)
+    assert not problems, "\n".join(problems)
+
+
+def test_no_defrag_lock_held_across_a_checkpoint_or_restore():
+    """Live migration (ISSUE 20): a checkpoint save is DURABLE-blocking
+    jax/orbax IO and a restore is worse — any defrag-layer lock held
+    across either would serialize the whole budget governor (and every
+    admission path that consults it) behind one slow checkpoint. AST
+    check over every file in tpushare/defrag/: no call whose name
+    smells like checkpoint/restore/session/eviction work appears inside
+    a ``with self._lock:`` block."""
+    banned = re.compile(
+        r"checkpoint|save|restore|\bbegin\b|commit|abort|pause|resume"
+        r"|evict|delete_pod|create_pod|allocate|solve|session"
+        r"|last_sample|sample_fleet|plan_relocation|list_pods")
+    scope = os.path.join(ROOT, "tpushare", "defrag")
+    problems: list[str] = []
+
+    def scan_calls(fname, body):
+        for n in body:
+            for sub in ast.walk(n) if not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)) else []:
+                if isinstance(sub, ast.Call):
+                    src = ast.unparse(sub.func)
+                    if banned.search(src):
+                        problems.append(
+                            f"{fname}:{sub.lineno}: '{src}(...)' called "
+                            "under self._lock — no defrag lock may be "
+                            "held across checkpoint/restore/move work")
+
+    def walk(fname, body, held):
+        for n in body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(fname, n.body, False)
+                continue
+            if isinstance(n, ast.With):
+                holds = held or any(
+                    _with_expr_key(i.context_expr) == "self._lock"
+                    for i in n.items)
+                if holds:
+                    scan_calls(fname, n.body)
+                walk(fname, n.body, holds)
+                continue
+            for cb in (getattr(n, "body", None),
+                       getattr(n, "orelse", None),
+                       getattr(n, "finalbody", None)):
+                if isinstance(cb, list):
+                    walk(fname, cb, held)
+            for h in getattr(n, "handlers", []) or []:
+                walk(fname, h.body, held)
+
+    for fn in sorted(os.listdir(scope)):
+        if not fn.endswith(".py"):
+            continue
+        path = os.path.join(scope, fn)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        walk(fn, tree.body, False)
     assert not problems, "\n".join(problems)
 
 

@@ -1,0 +1,304 @@
+"""ISSUE 20: live slice migration — checkpoint-driven repack.
+
+Four layers of the tentpole under test:
+
+- the chaos migration drill as a tier-1 gate: a whole-slice move under
+  mid-move crashes (serve replica dying mid-checkpoint, apiserver write
+  lost mid-placement) must leave ZERO oversubscription and ZERO
+  half-moved slices, always converging back to the source geometry;
+- pause-budget enforcement on a FAKE clock: a checkpoint that blows
+  ``TPUSHARE_MIGRATE_PAUSE_BUDGET_S`` aborts the move before any
+  apiserver write, with the serve loop resumed and no real sleeping;
+- the all-or-nothing property: a planned slice move demotes WHOLE under
+  randomized member-stamp churn (demote-don't-race) — no partial
+  ``TPU_PROCESS_BOUNDS`` recomposition, zero writes, zero pauses;
+- the FragForecast pressure scalar and the wind-tunnel A/B
+  (``sweep_forecast``): forecast policy holds stranded capacity below
+  target with strictly fewer migrations than react-only defrag.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from tpushare.chaos.migration_drill import (
+    _Rig,
+    _solo_pod,
+    assert_migration_drill_invariants,
+    half_moved_slices,
+    run_migration_drill,
+)
+from tpushare.contract import pod as podlib
+from tpushare.defrag.executor import DefragExecutor
+from tpushare.defrag.forecast import FragForecast, frag_weight_knob
+from tpushare.defrag.migration import (
+    PAUSE_SECONDS,
+    MigrationSession,
+    Migrator,
+    PauseBudgetExceeded,
+)
+from tpushare.metrics import Registry
+from tpushare.sim.defrag import sweep_budgets, sweep_forecast
+
+
+# -- the chaos drill, tier-1 --------------------------------------------------
+
+
+def test_migration_drill_holds_tentpole_invariants():
+    """Completed control move + both crash scenarios: zero
+    oversubscription at every sampled instant, zero half-moved slices,
+    crashes roll back byte-identically, no serve loop left paused."""
+    assert_migration_drill_invariants(run_migration_drill())
+
+
+# -- pause budget on a fake clock ---------------------------------------------
+
+
+class _Clock:
+    """Monotonic stand-in the checkpointer advances by hand."""
+
+    def __init__(self) -> None:
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+class _Frontend:
+    def __init__(self) -> None:
+        self.paused = False
+        self.pauses = 0
+
+    def pause(self, timeout: float) -> bool:
+        self.paused = True
+        self.pauses += 1
+        return True
+
+    def resume(self) -> None:
+        self.paused = False
+
+
+class _SlowCheckpointer:
+    """save() consumes fake-clock time — a checkpoint whose drain rate
+    the budget must police."""
+
+    def __init__(self, clock: _Clock, save_s: float) -> None:
+        self._clock = clock
+        self._save_s = save_s
+        self.saves = 0
+        self.restores = 0
+
+    def save(self, pod, move) -> None:
+        self._clock.now += self._save_s
+        self.saves += 1
+
+    def restore(self, pod, move) -> None:
+        self.restores += 1
+
+
+def test_session_over_budget_checkpoint_aborts_and_resumes():
+    clock = _Clock()
+    fe = _Frontend()
+    ckpt = _SlowCheckpointer(clock, save_s=7.5)
+    sess = MigrationSession({"metadata": {"name": "v"}}, move=None,
+                            checkpointer=ckpt, frontend=fe,
+                            budget_s=5.0, time_fn=clock)
+    before = PAUSE_SECONDS.count
+    with pytest.raises(PauseBudgetExceeded):
+        sess.begin()
+    # aborted strictly before restore, serve loop lifted, pause
+    # published exactly once even through idempotent abort()s
+    assert ckpt.saves == 1 and ckpt.restores == 0
+    assert fe.pauses == 1 and not fe.paused
+    assert PAUSE_SECONDS.count == before + 1
+    sess.abort()
+    sess.abort()
+    assert PAUSE_SECONDS.count == before + 1
+
+
+def test_session_under_budget_commits_and_observes_once():
+    clock = _Clock()
+    fe = _Frontend()
+    ckpt = _SlowCheckpointer(clock, save_s=2.0)
+    sess = MigrationSession({"metadata": {"name": "v"}}, move=None,
+                            checkpointer=ckpt, frontend=fe,
+                            budget_s=5.0, time_fn=clock)
+    before = PAUSE_SECONDS.count
+    sess.begin()
+    assert fe.paused  # parked across the apiserver window
+    sess.commit()
+    assert ckpt.restores == 1 and not fe.paused
+    assert PAUSE_SECONDS.count == before + 1
+    assert sess.pause_s == pytest.approx(2.0)
+
+
+def test_blown_pause_budget_rolls_slice_move_back_untouched():
+    """Executor-level: the slice move fails with the gang byte-identical
+    on its source chips, and the fake clock proves nobody slept."""
+    rig = _Rig()
+    clock = _Clock()
+    slow = _SlowCheckpointer(clock, save_s=60.0)
+    rig.migrator = Migrator(
+        checkpointer=slow,
+        frontend_for=lambda p: rig.frontends.get(podlib.pod_name(p)),
+        budget_s=1.0, time_fn=clock)
+    rig.executor = DefragExecutor(rig.cache, rig.cluster, budget=8,
+                                  migrator=rig.migrator)
+    plan = rig.planner.plan(4)
+    assert plan.slice_moves, "planner produced no slice move"
+    before = rig.snapshot()
+    t0 = time.monotonic()
+    out = rig.executor.execute_slice_move(plan.slice_moves[0])
+    assert time.monotonic() - t0 < 5.0, "budget must not be slept out"
+    assert out["outcome"] == "failed"
+    assert "budget" in out["error"]
+    assert rig.snapshot() == before
+    assert slow.restores == 0
+    assert not any(fe.paused for fe in rig.frontends.values())
+    assert half_moved_slices(rig.fc.list_pods()) == []
+
+
+# -- all-or-nothing under stamp churn -----------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_slice_move_all_or_nothing_under_stamp_churn(seed):
+    """Between plan and execute, churn ONE random member's source or
+    target node (any cache mutation bumps its generation stamp). The
+    whole slice must demote with zero writes — never a partially
+    recomposed TPU_PROCESS_BOUNDS."""
+    rng = random.Random(seed)
+    rig = _Rig()
+    plan = rig.planner.plan(4)
+    assert plan.slice_moves, "planner produced no slice move"
+    smove = plan.slice_moves[0]
+    member = rng.choice(smove.members)
+    node = member.source if rng.random() < 0.5 else member.target
+    before = rig.snapshot()
+    # the churn: one unrelated pod lands on (or leaves) the node —
+    # exactly what a concurrent bind does to a stamp
+    churn = _solo_pod(f"churn-{seed}", node, [0], 64)
+    rig.cache.add_or_update_pod(churn)
+    out = rig.executor.execute_slice_move(smove)
+    assert out["outcome"] == "demoted", \
+        f"churned {node}, expected demotion, got {out}"
+    # zero writes: no member touched, no session ever opened
+    assert rig.snapshot() == before
+    assert rig.ckpt.saved == [] and rig.ckpt.restored == []
+    assert not any(fe.pauses for fe in rig.frontends.values())
+    assert half_moved_slices(rig.fc.list_pods()) == []
+    # every member still whole on its source geometry
+    for p, m in zip(rig.member_pods(), smove.members):
+        assert podlib.pod_node_name(p) == m.source
+        assert podlib.chip_ids_from_annotations(p) == m.source_chip_ids
+
+
+# -- the forecast -------------------------------------------------------------
+
+
+def _sample(total=100_000, stranded=0, nodes=()):
+    return {"total_hbm_mib": total,
+            "tiers": {"best-effort": {"stranded_hbm_mib": stranded}},
+            "top_fragmented": [{"node": n} for n in nodes]}
+
+
+def test_forecast_pressure_zero_on_clean_fleet():
+    f = FragForecast()
+    assert f.pressure() == 0.0  # never sampled
+    f.observe(_sample(stranded=0))
+    assert f.pressure() == 0.0
+    assert f.fragmented_nodes() == frozenset()
+
+
+def test_forecast_level_and_slope():
+    f = FragForecast()
+    # 5% of fleet HBM stranded -> level 8 * 0.05 = 0.4, flat trend
+    f.observe(_sample(stranded=5_000, nodes=("n3",)))
+    assert f.pressure() == pytest.approx(0.4)
+    assert f.fragmented_nodes() == frozenset({"n3"})
+    # worsening trend adds the bounded slope boost on top of the level
+    f2 = FragForecast()
+    f2.observe(_sample(stranded=1_000))
+    f2.observe(_sample(stranded=5_000))
+    assert f2.pressure() == pytest.approx(0.4 + 8.0 * 0.04)
+    # the boost saturates at _SLOPE_BOOST, the sum at 1.0
+    f3 = FragForecast()
+    f3.observe(_sample(stranded=0))
+    f3.observe(_sample(stranded=50_000))
+    assert f3.pressure() == 1.0
+
+
+def _tier_pod(tier):
+    from tpushare import contract
+    return {"metadata": {"annotations": {contract.ANN_QOS_TIER: tier}}}
+
+
+def test_forecast_weight_tier_ordering_and_escape_hatch(monkeypatch):
+    f = FragForecast()
+    f.observe(_sample(stranded=5_000))
+    monkeypatch.setenv("TPUSHARE_FRAG_WEIGHT", "1.0")
+    assert frag_weight_knob() == 1.0
+    wg = f.weight(_tier_pod("guaranteed"))
+    wb = f.weight(_tier_pod("burstable"))
+    we = f.weight(_tier_pod("best-effort"))
+    # best-effort soaks holes hardest, guaranteed keeps its binpack
+    assert 0.0 < wg < wb < we <= 1.0
+    # the escape hatch: knob 0 zeroes the blend for every tier
+    monkeypatch.setenv("TPUSHARE_FRAG_WEIGHT", "0")
+    assert f.weight(_tier_pod("best-effort")) == 0.0
+
+
+def test_forecast_attach_registers_pressure_gauge():
+    f = FragForecast()
+    f.observe(_sample(stranded=5_000))
+    reg = Registry()
+    f.attach(reg)
+    text = reg.expose()
+    assert "tpushare_frag_pressure 0.4" in text
+
+
+# -- the wind tunnel ----------------------------------------------------------
+
+
+def test_sweep_forecast_fewer_migrations_below_target():
+    """The tentpole's A/B on the default trace: the forecast policy
+    performs STRICTLY fewer migrations than react-only defrag while
+    holding average stranded capacity below the target."""
+    r = sweep_forecast()
+    v = r["verdict"]
+    assert v["fewer_migrations"], v
+    assert v["stranded_held_below_target"], v
+    assert v["forecast_moves"] < v["react_moves"]
+    # every forecast migration still pays a modeled pause
+    fore = r["forecast"]
+    assert fore["migration"]["pauses"] == fore["moves"]
+
+
+def test_defrag_sim_frag_weight_zero_is_reference_policy():
+    """frag_weight=0 must reproduce the pre-migration budget sweep
+    exactly (the byte-identical escape hatch), with the migration
+    telemetry riding along."""
+    reports = sweep_budgets(budgets=(0, 2))
+    control, repack = reports
+    assert control["moves"] == 0 and control["frag_weight"] == 0.0
+    # the seed-7 regression pin from the pre-forecast sweep
+    assert repack["moves"] == 39
+    assert repack["recovery_pct"] == pytest.approx(18.87, abs=0.01)
+    for rep in reports:
+        mig = rep["migration"]
+        assert mig["pauses"] == rep["moves"]
+        assert mig["aborted_over_budget"] == 0
+        assert (mig["pause_p99_s"] >= mig["pause_p50_s"] >= 0.0)
+
+
+def test_defrag_sim_pause_budget_aborts_over_budget_moves():
+    """A pause budget below the modeled floor forbids every move: the
+    sim aborts them all instead of clipping the pause."""
+    r = sweep_forecast(pause_budget_s=0.01)
+    fore = r["forecast"]
+    assert fore["moves"] == 0
+    assert fore["migration"]["aborted_over_budget"] > 0
+    assert fore["migration"]["pauses"] == 0

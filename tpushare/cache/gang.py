@@ -402,6 +402,19 @@ class GangCoordinator:
                          leader_trace_id=trace_id, engine=engine)
         return None
 
+    def plan_relocation(self, gang_id: str, pod: dict[str, Any],
+                        size: int,
+                        now_ns: Callable[[], int] = time.time_ns
+                        ) -> _Plan | None:
+        """Compute-only re-solve of a LIVE gang for the defrag planner's
+        whole-slice moves: no reservations, no provisional caching, no
+        mutation of ``self._plans``. Because the gang's current chips
+        are still occupied at solve time, a returned plan necessarily
+        lands on OTHER capacity (or None: the fleet has no second home
+        for this slice right now). The per-member stamps it carries are
+        the executor's demote-don't-race pins."""
+        return self._compute_plan(gang_id, pod, size, now_ns())
+
     def filter_hosts(self, pod: dict[str, Any],
                      now_ns: Callable[[], int] = time.time_ns,
                      trace_id: str | None = None

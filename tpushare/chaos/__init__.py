@@ -1,6 +1,7 @@
 """Fault-domain chaos tooling: the conductor that replays sim fault
-schedules against a live fleet, the continuous invariant monitors, and
-the hermetic drill bench.py and tier-1 both run (ISSUE 13 tentpole b).
+schedules against a live fleet, the continuous invariant monitors, the
+hermetic drill bench.py and tier-1 both run (ISSUE 13 tentpole b), and
+the live-migration drill (mid-move crashes against whole-slice moves).
 """
 
 from tpushare.chaos.conductor import CHAOS_FAULTS, ChaosConductor
@@ -14,6 +15,11 @@ from tpushare.chaos.invariants import (
     InvariantMonitor,
     oversubscription,
 )
+from tpushare.chaos.migration_drill import (
+    assert_migration_drill_invariants,
+    half_moved_slices,
+    run_migration_drill,
+)
 
 __all__ = [
     "CHAOS_FAULTS",
@@ -22,6 +28,9 @@ __all__ = [
     "HermeticFleet",
     "InvariantMonitor",
     "assert_drill_invariants",
+    "assert_migration_drill_invariants",
+    "half_moved_slices",
     "oversubscription",
     "run_hermetic_drill",
+    "run_migration_drill",
 ]

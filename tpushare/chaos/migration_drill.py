@@ -1,0 +1,328 @@
+"""The live-migration chaos drill: slice moves under mid-move crashes.
+
+Hermetic, like :mod:`tpushare.chaos.drill` — a real SchedulerCache +
+GangCoordinator + DefragPlanner/Executor stack over one FakeCluster —
+but the storm here is surgical: a multi-host gang is fragmented into a
+planned whole-slice move and the drill kills the migration at the worst
+instants a real fleet produces:
+
+- ``crash_checkpoint`` — the victim's serve replica dies while its
+  state is being checkpointed (``checkpointer.save`` raises). This is
+  strictly before any apiserver write, so the move must abort with the
+  slice byte-identically untouched on its source chips.
+- ``crash_midplace``  — the executor's apiserver write fails after the
+  slice is evicted and PART of it is re-placed (the replacement
+  ``create_pod`` for a non-leader rank raises). The rollback must
+  reassemble the whole slice on its ORIGINAL chips.
+
+Both are run after one ``completed`` control move, with the
+:class:`~tpushare.chaos.invariants.InvariantMonitor` sampling apiserver
+truth throughout. The verdict the self-checks enforce is the tentpole's
+acceptance line: ZERO oversubscription at every sampled instant and
+ZERO half-moved slices — at no point does any gang have members
+straddling two plans, and a failed move always converges back to the
+source geometry.
+
+Used by tests/test_chaos_migration.py (tier-1) and bench.py's
+``migration`` section.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import time
+from typing import Any
+
+from tpushare import contract
+from tpushare.cache import SchedulerCache
+from tpushare.cache.gang import GangCoordinator
+from tpushare.chaos.invariants import InvariantMonitor, oversubscription
+from tpushare.contract import pod as podlib
+from tpushare.defrag.executor import DefragExecutor
+from tpushare.defrag.migration import Migrator
+from tpushare.defrag.planner import ANN_MOVABLE, DefragPlanner
+from tpushare.k8s import FakeCluster
+
+HBM_PER_CHIP = 16000
+GANG_HBM = 8000  # per chip: half HBM, so solos can share and fragment
+
+
+def _gang_pod(name: str, rank: int, gang_id: str = "g1") -> dict[str, Any]:
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {
+                         contract.ANN_GANG: gang_id,
+                         contract.ANN_GANG_SIZE: "8",
+                         contract.ANN_GANG_RANK: str(rank),
+                         contract.ANN_TOPOLOGY: "2x4",
+                         ANN_MOVABLE: "true",
+                     }},
+        "spec": {"containers": [{"name": "c", "resources": {"limits": {
+            contract.RESOURCE_COUNT: "4",
+            # per-device semantics: every gang chip must offer this much
+            contract.RESOURCE_HBM: str(GANG_HBM),
+        }}}]},
+    }
+
+
+def _solo_pod(name: str, node: str, chips: list[int],
+              hbm: int) -> dict[str, Any]:
+    ann = contract.placement_annotations(chips, hbm, HBM_PER_CHIP)
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}", "annotations": ann},
+        "spec": {"nodeName": node,
+                 "containers": [{"name": "c", "resources": {"limits": {
+                     contract.RESOURCE_HBM: str(hbm)}}}]},
+        "status": {"phase": "Running"},
+    }
+
+
+class _Frontend:
+    """A serve-loop stand-in that actually tracks the pause window."""
+
+    def __init__(self) -> None:
+        self.paused = False
+        self.pauses = 0
+
+    def pause(self, timeout: float) -> bool:
+        self.paused = True
+        self.pauses += 1
+        return True
+
+    def resume(self) -> None:
+        self.paused = False
+
+
+class _Checkpointer:
+    """Counts saves/restores; arms a one-shot crash on a chosen pod —
+    the serve replica dying mid-checkpoint."""
+
+    def __init__(self) -> None:
+        self.saved: list[str] = []
+        self.restored: list[str] = []
+        self.crash_on_save: str | None = None
+
+    def save(self, pod: dict[str, Any], move: Any) -> None:
+        name = podlib.pod_name(pod)
+        if self.crash_on_save == name:
+            self.crash_on_save = None
+            raise RuntimeError("serve replica crashed mid-checkpoint")
+        self.saved.append(name)
+
+    def restore(self, pod: dict[str, Any], move: Any) -> None:
+        self.restored.append(podlib.pod_name(pod))
+
+
+class _FlakyCluster:
+    """FakeCluster passthrough with a one-shot create_pod fault — the
+    scheduler's apiserver write dying after eviction, mid-placement.
+    One-shot on purpose: the executor's ROLLBACK writes must succeed,
+    exactly like a real apiserver that returned one 500."""
+
+    def __init__(self, fc: FakeCluster) -> None:
+        self._fc = fc
+        self.fail_create_for: str | None = None
+
+    def __getattr__(self, attr: str) -> Any:
+        return getattr(self._fc, attr)
+
+    def create_pod(self, pod: dict[str, Any]) -> dict[str, Any]:
+        name = podlib.pod_name(pod)
+        if self.fail_create_for == name:
+            self.fail_create_for = None
+            raise RuntimeError("apiserver write lost mid-placement")
+        return self._fc.create_pod(pod)
+
+
+def half_moved_slices(pods: list[dict[str, Any]]) -> list[str]:
+    """Gang ids whose live members are torn — a rank missing or
+    unbound, no stamped plan anywhere, members stamped with DIFFERENT
+    plans, or any member placed somewhere the stamped plan does not
+    say (the half-recomposed ``TPU_PROCESS_BOUNDS`` state the
+    tentpole's all-or-nothing guarantee forbids). Only the first-bound
+    member necessarily carries ``ANN_GANG_PLAN``; every member's actual
+    (host, chips) must appear in that one plan."""
+    gangs: dict[str, dict[int, dict[str, Any]]] = {}
+    for p in pods:
+        try:
+            gm = podlib.gang_membership(p)
+        except ValueError:
+            continue
+        if gm is not None:
+            gangs.setdefault(gm[0], {})[gm[2]] = p
+    torn = []
+    for gid, members in sorted(gangs.items()):
+        plans = set()
+        placements = []
+        ok = True
+        for _rank, p in sorted(members.items()):
+            node = podlib.pod_node_name(p)
+            chips = podlib.chip_ids_from_annotations(p)
+            if not node or chips is None:
+                ok = False
+                break
+            placements.append((node, tuple(sorted(chips))))
+            raw = podlib.annotations(p).get(contract.ANN_GANG_PLAN)
+            if raw:
+                plans.add(raw)
+        if ok and len(plans) == 1:
+            try:
+                rows = json.loads(next(iter(plans)))["members"]
+                want = {(r["host"], tuple(sorted(r["chips"])))
+                        for r in rows}
+            except (ValueError, KeyError, TypeError):
+                want = None
+            ok = (want is not None and len(rows) == len(members)
+                  and all(pl in want for pl in placements))
+        else:
+            ok = False
+        if not ok:
+            torn.append(gid)
+    return torn
+
+
+class _Rig:
+    """One fresh fleet: two 2-host slices, the gang bound on slc0,
+    one solo filler fragmenting the gang's leader host."""
+
+    def __init__(self) -> None:
+        fc = FakeCluster()
+        for sid, hosts in (("slc0", ("a0", "a1")),
+                           ("slc1", ("b0", "b1"))):
+            for host, origin in zip(hosts, ("0x0", "0x2")):
+                fc.add_tpu_node(host, chips=4,
+                                hbm_per_chip_mib=HBM_PER_CHIP,
+                                mesh="2x2", slice_id=sid,
+                                slice_origin=origin)
+        self.fc = fc
+        self.cluster = _FlakyCluster(fc)
+        self.cache = SchedulerCache(fc)
+        self.cache.build_cache()
+        self.gang = GangCoordinator(self.cache)
+        now_ns = time.time_ns
+        self.member_names = []
+        for rank in (0, 1):
+            pod = fc.create_pod(_gang_pod(f"g1p{rank}", rank))
+            hosts, err = self.gang.filter_hosts(pod, now_ns=now_ns)
+            assert err == "" and hosts, f"gang filter failed: {err}"
+            self.gang.bind_member(pod, hosts[0], fc, now_ns=now_ns)
+            name = podlib.pod_name(pod)
+            # what the controller's watch would do after the bind: hand
+            # the bound incarnation to the cache so pod_by_key resolves
+            self.cache.add_or_update_pod(fc.get_pod("default", name))
+            self.member_names.append(name)
+        # fragment the leader's host: one solo fills a chip, leaving
+        # the node's shareable chips non-contiguous on the 2x2 mesh
+        leader = fc.get_pod("default", self.member_names[0])
+        lhost = podlib.pod_node_name(leader)
+        solo = fc.create_pod(_solo_pod("filler", lhost, [0],
+                                       HBM_PER_CHIP - GANG_HBM))
+        self.cache.add_or_update_pod(solo)
+        self.frontends = {n: _Frontend() for n in self.member_names}
+        self.ckpt = _Checkpointer()
+        self.migrator = Migrator(
+            checkpointer=self.ckpt,
+            frontend_for=lambda p: self.frontends.get(podlib.pod_name(p)))
+        self.planner = DefragPlanner(self.cache, gang=self.gang,
+                                     cluster=fc)
+        self.executor = DefragExecutor(self.cache, self.cluster,
+                                       budget=8, migrator=self.migrator)
+
+    def member_pods(self) -> list[dict[str, Any]]:
+        return [self.fc.get_pod("default", n) for n in self.member_names]
+
+    def snapshot(self) -> list[str]:
+        """Canonical placement state of every gang member, for
+        byte-level unchanged/rolled-back assertions."""
+        out = []
+        for p in self.member_pods():
+            out.append(json.dumps({
+                "node": podlib.pod_node_name(p),
+                "annotations": podlib.annotations(p),
+            }, sort_keys=True))
+        return out
+
+
+def _run_scenario(kind: str) -> dict[str, Any]:
+    rig = _Rig()
+    monitor = InvariantMonitor(rig.fc.list_pods, HBM_PER_CHIP,
+                               interval_s=0.002).start()
+    plan = rig.planner.plan(4)
+    result: dict[str, Any] = {"kind": kind,
+                              "slice_moves_planned": len(plan.slice_moves)}
+    if not plan.slice_moves:
+        monitor.stop()
+        result["error"] = "planner produced no slice move"
+        return result
+    smove = plan.slice_moves[0]
+    before = rig.snapshot()
+    source_nodes = sorted({m.source for m in smove.members})
+    if kind == "crash_checkpoint":
+        rig.ckpt.crash_on_save = rig.member_names[1]
+    elif kind == "crash_midplace":
+        # the replacement create for the non-leader rank: by then the
+        # whole slice is evicted and the leader already re-placed
+        rig.cluster.fail_create_for = rig.member_names[1]
+    out = rig.executor.execute_slice_move(smove)
+    # let the monitor take at least one post-move sample
+    time.sleep(0.01)
+    verdict = monitor.stop()
+    pods = rig.fc.list_pods()
+    result.update({
+        "outcome": out["outcome"],
+        "error": out.get("error"),
+        "samples": verdict["samples"],
+        "oversubscription": verdict["oversubscription"],
+        "final_oversubscription": oversubscription(pods, HBM_PER_CHIP),
+        "half_moved": half_moved_slices(pods),
+        "member_nodes": sorted({podlib.pod_node_name(p)
+                                for p in rig.member_pods()}),
+        "paused_left": [n for n, fe in rig.frontends.items()
+                        if fe.paused],
+        "checkpoints": len(rig.ckpt.saved),
+        "restores": len(rig.ckpt.restored),
+    })
+    if kind == "completed":
+        result["moved_off_source"] = \
+            not (set(result["member_nodes"]) & set(source_nodes))
+    else:
+        result["rolled_back_identical"] = rig.snapshot() == before
+    return result
+
+
+def run_migration_drill() -> dict[str, Any]:
+    """All three scenarios on fresh fleets; returns the verdict dict
+    for :func:`assert_migration_drill_invariants`."""
+    return {kind: _run_scenario(kind)
+            for kind in ("completed", "crash_checkpoint",
+                         "crash_midplace")}
+
+
+def assert_migration_drill_invariants(r: dict[str, Any]) -> None:
+    """The self-checks bench.py and the tier-1 test share: the
+    acceptance line is zero oversubscription and zero half-moved
+    slices on EVERY scenario, crash or not."""
+    for kind, s in r.items():
+        assert s.get("slice_moves_planned"), \
+            f"{kind}: planner produced no slice move"
+        assert s["samples"] > 0, f"{kind}: the monitor never sampled"
+        assert not s["oversubscription"], \
+            f"{kind}: oversubscription mid-move: {s['oversubscription'][:3]}"
+        assert not s["final_oversubscription"], \
+            f"{kind}: oversubscription after: {s['final_oversubscription'][:3]}"
+        assert not s["half_moved"], \
+            f"{kind}: half-moved slices: {s['half_moved']}"
+        assert not s["paused_left"], \
+            f"{kind}: serve loops left paused: {s['paused_left']}"
+    assert r["completed"]["outcome"] == "completed"
+    assert r["completed"]["moved_off_source"], \
+        "the control move never left the source slice"
+    assert r["completed"]["restores"] == 2, \
+        "a completed slice move must restore every member"
+    for kind in ("crash_checkpoint", "crash_midplace"):
+        assert r[kind]["outcome"] == "failed", \
+            f"{kind}: expected a failed move, got {r[kind]['outcome']}"
+        assert r[kind]["rolled_back_identical"], \
+            f"{kind}: the slice did not return to its source geometry"

@@ -5,9 +5,14 @@ PR 6 made stranded contiguous capacity *visible*
 *cheap* (capacity index, native batch solves). This package *acts*:
 
 - :mod:`.planner`  — stamped repack plans from the stranded-gap picture
-  (pure core shared with :mod:`tpushare.sim.defrag`);
+  (pure core shared with :mod:`tpushare.sim.defrag`), including
+  whole-slice moves for multi-host gangs;
 - :mod:`.executor` — budget-governed, stamp-revalidated move execution
   over the restore/drain eviction paths;
+- :mod:`.migration` — checkpoint-driven bounded-pause sessions wiring
+  the serve engine + checkpointer into each restore-mode move;
+- :mod:`.forecast` — fragmentation-pressure forecast feeding the
+  Prioritize binpack-vs-scatter blend (``TPUSHARE_FRAG_WEIGHT``);
 - :mod:`.rebalancer` — the background controller the extender server
   starts/stops (``TPUSHARE_DEFRAG=0`` opts out), serving
   ``GET /inspect/defrag``.
@@ -15,13 +20,20 @@ PR 6 made stranded contiguous capacity *visible*
 
 from .executor import (DEFRAG_DEMOTIONS, DEFRAG_FREED, DEFRAG_MOVES,
                        DefragExecutor)
+from .forecast import FragForecast, frag_weight_knob
+from .migration import (MIGRATIONS, PAUSE_SECONDS, MigrationSession,
+                        Migrator, PauseBudgetExceeded, pause_budget_s)
 from .planner import (ANN_MOVABLE, DEFRAG_PLANS, DefragPlanner, Move,
-                      NodeState, RepackPlan, Victim, plan_moves)
+                      NodeState, RepackPlan, SliceMember, SliceMove,
+                      Victim, plan_moves)
 from .rebalancer import DefragController
 
 __all__ = [
     "ANN_MOVABLE",
     "DEFRAG_DEMOTIONS", "DEFRAG_FREED", "DEFRAG_MOVES", "DEFRAG_PLANS",
     "DefragController", "DefragExecutor", "DefragPlanner",
-    "Move", "NodeState", "RepackPlan", "Victim", "plan_moves",
+    "FragForecast", "MIGRATIONS", "MigrationSession", "Migrator",
+    "Move", "NodeState", "PAUSE_SECONDS", "PauseBudgetExceeded",
+    "RepackPlan", "SliceMember", "SliceMove", "Victim",
+    "frag_weight_knob", "pause_budget_s", "plan_moves",
 ]

@@ -63,7 +63,8 @@ from tpushare.contract import pod as podlib
 from tpushare.metrics import Counter, LabeledCounter
 from tpushare.obs.trace import TRACER
 
-from .planner import Move, RepackPlan
+from .migration import MIGRATIONS, PauseBudgetExceeded
+from .planner import Move, RepackPlan, SliceMove
 
 log = logging.getLogger("tpushare.defrag")
 
@@ -129,11 +130,17 @@ class DefragExecutor:
                  backoff_s: float | None = None,
                  explain=None,
                  checkpoint_hook: Callable[[dict, Move], None] | None = None,
+                 migrator=None,
                  time_fn: Callable[[], float] = time.monotonic) -> None:
         self._cache = cache
         self._cluster = cluster
         self._explain = explain
         self._checkpoint_hook = checkpoint_hook
+        # live-migration sessions (defrag/migration.py): pause the
+        # victim's serve loop, checkpoint under the pause budget, restore
+        # on the target. None = annotation-level moves only (the
+        # checkpoint_hook seam still fires for backward compatibility).
+        self._migrator = migrator
         self._time = time_fn
         self.budget = int(_env_float("TPUSHARE_DEFRAG_BUDGET", 4)) \
             if budget is None else budget
@@ -169,10 +176,12 @@ class DefragExecutor:
                 "inflight_nodes": sorted(self._inflight),
             }
 
-    def _admit(self, move: Move) -> str | None:
-        """Budget/backoff/in-flight gate; returns the skip outcome or
-        None (admitted — the window slot is consumed and both nodes are
-        marked in flight)."""
+    def _admit_nodes(self, nodes: tuple[str, ...]) -> str | None:
+        """Budget/backoff/in-flight gate over every node a move touches;
+        returns the skip outcome or None (admitted — ONE window slot is
+        consumed and all the nodes are marked in flight, so a whole-slice
+        move spends exactly one budget slot like a solo move: the budget
+        bounds disruption events, not pod count)."""
         now = self._time()
         with self._lock:
             if self._window_started is None \
@@ -181,25 +190,31 @@ class DefragExecutor:
                 self._window_used = 0
             if self._window_used >= self.budget:
                 return "skipped_budget"
-            for node in (move.source, move.target):
+            for node in nodes:
                 if self._backoff.get(node, 0.0) > now:
                     return "skipped_backoff"
-            if self._inflight & {move.source, move.target}:
+            if self._inflight & set(nodes):
                 return "skipped_inflight"
             self._window_used += 1
-            self._inflight.update((move.source, move.target))
+            self._inflight.update(nodes)
             return None
 
-    def _settle(self, move: Move, failed: bool) -> None:
+    def _admit(self, move: Move) -> str | None:
+        return self._admit_nodes((move.source, move.target))
+
+    def _settle_nodes(self, nodes: tuple[str, ...], failed: bool) -> None:
         now = self._time()
         with self._lock:
-            self._inflight.difference_update((move.source, move.target))
+            self._inflight.difference_update(nodes)
             if failed:
-                self._backoff[move.source] = now + self.backoff_s
-                self._backoff[move.target] = now + self.backoff_s
+                for node in nodes:
+                    self._backoff[node] = now + self.backoff_s
             # drop expired entries so the map cannot grow unboundedly
             self._backoff = {n: t for n, t in self._backoff.items()
                              if t > now}
+
+    def _settle(self, move: Move, failed: bool) -> None:
+        self._settle_nodes((move.source, move.target), failed)
 
     # -- stamp revalidation ---------------------------------------------------
 
@@ -268,6 +283,8 @@ class DefragExecutor:
             self._settle(move, failed=False)
             DEFRAG_DEMOTIONS.inc()
             DEFRAG_MOVES.inc("demoted")
+            if move.mode == "restore":
+                MIGRATIONS.inc("solo", "demoted")
             return {"move": move.to_dict(), "outcome": "demoted"}
         identity = {"namespace": podlib.pod_namespace(pod),
                     "name": podlib.pod_name(pod),
@@ -275,11 +292,21 @@ class DefragExecutor:
         original = copy.deepcopy(pod)
         trace = TRACER.join_or_begin(move.pod_key, pod)
         outcome = "completed"
+        session = None
+        if self._migrator is not None and move.mode == "restore":
+            session = self._migrator.session(pod, move)
         try:
             with TRACER.root_span(trace, "defrag.move",
                                   source=move.source, target=move.target,
                                   mode=move.mode,
                                   gain_chips=move.gain_chips) as sp:
+                if session is not None:
+                    # pause + durable checkpoint BEFORE any apiserver
+                    # write: a blown pause budget aborts with the victim
+                    # untouched on its source chips
+                    session.begin()
+                    sp.annotate("checkpointed",
+                                pause_s=round(session.pause_s or 0.0, 4))
                 if self._checkpoint_hook is not None \
                         and move.mode == "restore":
                     self._checkpoint_hook(pod, move)
@@ -289,6 +316,8 @@ class DefragExecutor:
                 if move.mode == "restore":
                     try:
                         self._place_replacement(pod, move)
+                        if session is not None:
+                            session.commit()  # restore-on-target + resume
                     except Exception as e:
                         self._restore_source(original)
                         sp.annotate("restored_to_source",
@@ -296,14 +325,23 @@ class DefragExecutor:
                         raise
                     sp.annotate("placed", node=move.target,
                                 chips=list(move.placement.chip_ids))
+        except PauseBudgetExceeded as e:
+            outcome = "failed"
+            error = str(e)
+            log.warning("defrag: move of %s aborted: %s",
+                        move.pod_key, e)
         except Exception as e:  # noqa: BLE001 — a move must never crash
             outcome = "failed"
             error = str(e)
             log.warning("defrag: move of %s %s -> %s failed: %s",
                         move.pod_key, move.source, move.target, e)
         finally:
+            if session is not None:
+                session.abort()  # idempotent; no-op after commit()
             self._settle(move, failed=outcome == "failed")
         DEFRAG_MOVES.inc(outcome)
+        if move.mode == "restore":
+            MIGRATIONS.inc("solo", outcome)
         if outcome == "completed":
             DEFRAG_FREED.inc(move.gain_chips)
         trace_id = trace.trace_id if trace is not None else None
@@ -315,11 +353,211 @@ class DefragExecutor:
                 chip_ids=list(move.placement.chip_ids)
                 if outcome == "completed" and move.mode == "restore"
                 else None)
+            self._record_migration(move.pod_key, identity, trace_id,
+                                   kind="solo", source=move.source,
+                                   target=move.target, outcome=outcome,
+                                   error=error)
         TRACER.finish(move.pod_key, f"defrag_{outcome}")
         return {"move": move.to_dict(), "outcome": outcome,
                 **({"error": error} if error else {})}
 
+    def _record_migration(self, pod_key, identity, trace_id, *, kind,
+                          source, target, outcome, error=None) -> None:
+        """Feed the decision journal (obs/journal.py) one migration
+        record so an incident replay reproduces the move sequence."""
+        rec = getattr(self._explain, "record_migration", None)
+        if rec is not None:
+            rec(pod_key, identity, trace_id, kind=kind, source=source,
+                target=target, outcome=outcome, error=error)
+
+    # -- whole-slice moves ----------------------------------------------------
+
+    def _revalidate_slice(self, smove: SliceMove
+                          ) -> list[dict[str, Any]] | None:
+        """EVERY member's pinned source and target stamp against live
+        node state, plus each member's identity and residency. ANY
+        mismatch returns None — the whole slice demotes with zero
+        writes (demote-don't-race): a half-revalidated slice move is
+        exactly the torn geometry this path exists to prevent."""
+        for node, stamp in {(m.source, m.source_stamp)
+                            for m in smove.members} | \
+                {(m.target, m.target_stamp) for m in smove.members
+                 if m.target_stamp is not None}:
+            info = self._cache.peek_node(node)
+            if info is None or info.version != stamp:
+                return None
+        pods: list[dict[str, Any]] = []
+        for m in smove.members:
+            pod = self._cache.pod_by_key(m.pod_key)
+            if pod is None or podlib.pod_node_name(pod) != m.source \
+                    or podlib.chip_ids_from_annotations(pod) \
+                    != m.source_chip_ids:
+                return None
+            pods.append(pod)
+        return pods
+
+    def _rollback_slice(self, evicted: list[dict[str, Any]]) -> None:
+        """Unwind a part-way slice move: tear down whatever replacement
+        incarnation each evicted member has (apiserver and cache), then
+        re-create every original with its ORIGINAL placement and plan
+        annotations — the fleet ends with the slice whole on its source
+        chips, never half-moved."""
+        for orig in evicted:
+            ns, name = (podlib.pod_namespace(orig),
+                        podlib.pod_name(orig))
+            cur = None
+            try:
+                cur = self._cluster.get_pod(ns, name)
+            except Exception:  # noqa: BLE001 — may simply not exist
+                cur = None
+            if cur is not None:
+                try:
+                    self._cluster.delete_pod(ns, name)
+                except Exception:  # noqa: BLE001
+                    pass
+                if podlib.chip_ids_from_annotations(cur) is not None:
+                    try:
+                        self._cache.remove_pod(cur)
+                    except Exception:  # noqa: BLE001
+                        pass
+            back = copy.deepcopy(orig)
+            back.get("metadata", {}).pop("resourceVersion", None)
+            self._cluster.create_pod(back)
+            self._cache.add_or_update_pod(back)
+
+    def _place_slice_member(self, pod: dict[str, Any],
+                            member, plan_annotation: str) -> None:
+        """Recreate one evicted gang member bound to its PRE-DECIDED
+        target chips. ``allocate_planned`` re-checks room under the
+        node lock and raises loudly on conflict — a slice member must
+        land exactly where the plan says or the whole move rolls back;
+        a solo-style fresh-search fallback would silently tear the
+        recomposed geometry. Every replacement carries the new
+        ``ANN_GANG_PLAN``, so the device plugin derives
+        ``TPU_PROCESS_BOUNDS`` for the new slice without any other
+        gang's plan being touched."""
+        from tpushare import contract
+        rep = _strip_placement(pod)
+        ann = rep.setdefault("metadata", {}).setdefault(
+            "annotations", {})
+        ann[contract.ANN_GANG_PLAN] = plan_annotation
+        self._cluster.create_pod(rep)
+        info = self._cache.get_node_info(member.target)
+        info.allocate_planned(
+            rep, self._cluster, member.target_chip_ids,
+            member.target_box, member.target_origin,
+            extra_annotations={
+                contract.ANN_GANG_PLAN: plan_annotation})
+        ns, name = podlib.pod_namespace(rep), podlib.pod_name(rep)
+        self._cache.add_or_update_pod(self._cluster.get_pod(ns, name))
+
+    def execute_slice_move(self, smove: SliceMove) -> dict[str, Any]:
+        """Relocate a whole multi-host gang atomically: pause +
+        checkpoint every member, evict all, re-place all on the solved
+        target geometry, restore. One budget slot for the whole slice;
+        any failure rolls EVERY member back onto its source chips."""
+        outcome = self._admit_nodes(smove.nodes)
+        if outcome is not None:
+            DEFRAG_MOVES.inc(outcome)
+            return {"move": smove.to_dict(), "outcome": outcome}
+        error: str | None = None
+        pods = self._revalidate_slice(smove)
+        if pods is None:
+            self._settle_nodes(smove.nodes, failed=False)
+            DEFRAG_DEMOTIONS.inc()
+            DEFRAG_MOVES.inc("demoted")
+            MIGRATIONS.inc("slice", "demoted")
+            self._record_migration(
+                f"gang:{smove.gang_id}", None, None, kind="slice",
+                source=smove.members[0].source,
+                target=smove.members[0].target, outcome="demoted")
+            return {"move": smove.to_dict(), "outcome": "demoted"}
+        originals = [copy.deepcopy(p) for p in pods]
+        leader_key = smove.members[0].pod_key
+        trace = TRACER.join_or_begin(leader_key, pods[0])
+        outcome = "completed"
+        sessions = []
+        evicted: list[dict[str, Any]] = []
+        try:
+            with TRACER.root_span(trace, "defrag.slice_move",
+                                  gang=smove.gang_id,
+                                  nodes=list(smove.nodes),
+                                  members=len(smove.members),
+                                  gain_chips=smove.gain_chips) as sp:
+                if self._migrator is not None:
+                    # pause + durable checkpoint for EVERY member
+                    # before any apiserver write: a blown budget aborts
+                    # with the whole slice untouched
+                    for p, m in zip(pods, smove.members):
+                        s = self._migrator.session(p, m)
+                        sessions.append(s)
+                        s.begin()
+                    sp.annotate("checkpointed", members=len(sessions))
+                if self._checkpoint_hook is not None:
+                    for p in pods:
+                        self._checkpoint_hook(p, smove)
+                try:
+                    for p in pods:
+                        self._evict(p)
+                        evicted.append(p)
+                    sp.annotate("evicted", members=len(evicted))
+                    for p, orig, m in zip(pods, originals,
+                                          smove.members):
+                        self._place_slice_member(
+                            p, m, smove.plan_annotation)
+                    for s in sessions:
+                        s.commit()
+                except Exception as e:
+                    self._rollback_slice(
+                        [o for o, _p in zip(originals, evicted)])
+                    sp.annotate("restored_to_source", error=str(e))
+                    raise
+                sp.annotate("placed",
+                            nodes=sorted({m.target
+                                          for m in smove.members}))
+        except PauseBudgetExceeded as e:
+            outcome = "failed"
+            error = str(e)
+            log.warning("defrag: slice move of gang %s aborted: %s",
+                        smove.gang_id, e)
+        except Exception as e:  # noqa: BLE001 — a move must never crash
+            outcome = "failed"
+            error = str(e)
+            log.warning("defrag: slice move of gang %s failed: %s",
+                        smove.gang_id, e)
+        finally:
+            for s in sessions:
+                s.abort()  # idempotent; no-op after commit()
+            self._settle_nodes(smove.nodes, failed=outcome == "failed")
+        DEFRAG_MOVES.inc(outcome)
+        MIGRATIONS.inc("slice", outcome)
+        if outcome == "completed":
+            DEFRAG_FREED.inc(smove.gain_chips)
+        trace_id = trace.trace_id if trace is not None else None
+        if self._explain is not None:
+            for p, m in zip(pods, smove.members):
+                identity = {"namespace": podlib.pod_namespace(p),
+                            "name": podlib.pod_name(p),
+                            "uid": podlib.pod_uid(p)}
+                self._explain.record_bind(
+                    m.pod_key, identity, trace_id,
+                    node=m.target, outcome=f"defrag_{outcome}",
+                    error=error,
+                    chip_ids=list(m.target_chip_ids)
+                    if outcome == "completed" else None)
+                self._record_migration(m.pod_key, identity, trace_id,
+                                       kind="slice", source=m.source,
+                                       target=m.target, outcome=outcome,
+                                       error=error)
+        TRACER.finish(leader_key, f"defrag_{outcome}")
+        return {"move": smove.to_dict(), "outcome": outcome,
+                **({"error": error} if error else {})}
+
     def execute(self, plan: RepackPlan) -> list[dict[str, Any]]:
         """Execute a plan's moves serially (one eviction at a time —
-        bounded disruption is the point) and return their outcomes."""
-        return [self.execute_move(m) for m in plan.moves]
+        bounded disruption is the point), whole-slice moves first (they
+        are why their nodes were excluded from solo planning), and
+        return their outcomes."""
+        out = [self.execute_slice_move(m) for m in plan.slice_moves]
+        out += [self.execute_move(m) for m in plan.moves]
+        return out

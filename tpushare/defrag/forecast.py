@@ -1,0 +1,150 @@
+"""Fragmentation-pressure forecast: close the loop UPSTREAM of defrag.
+
+The rebalancer (planner/executor) pays migrations to undo fragmentation
+after the fact; this module makes Prioritize stop *creating* it. It
+consumes fleetwatch's cached stranded-gap sample (obs/fleetwatch.py —
+the same picture the ``tpushare_fleet_stranded_hbm_mib`` gauges publish),
+keeps a short trend window, and folds level + slope into one pressure
+scalar in [0, 1]. Prioritize then blends a per-tier binpack-vs-scatter
+bias: under pressure, low-tier pods are steered toward nodes that are
+ALREADY fragmented (soak the holes) so pristine contiguous boxes stay
+whole for the gangs and guaranteed serving replicas that need them —
+every hole filled upstream is a migration defrag never has to buy.
+
+The tier factor is deliberately the mirror image of the Prioritize
+adjacency factor (handlers._TIER_TOPO_FACTOR): best-effort pods get the
+full scatter bias (they are the natural hole-fillers), guaranteed pods
+barely any (their own contiguity IS throughput).
+
+``TPUSHARE_FRAG_WEIGHT`` scales the whole effect; 0 disables the blend
+entirely and the Prioritize path is byte-identical to a build without
+this module. Pressure is 0 on an unfragmented fleet, so a healthy
+cluster also pays nothing.
+
+Lock discipline (tests/test_lock_order_lint.py): ``self._lock`` guards
+only the trend deque for a few instructions; the fleetwatch read happens
+OUTSIDE it (last_sample is itself just a lock + two reads), so this lock
+nests under nothing and holds nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any
+
+from tpushare.qos.tiers import pod_tier
+
+# scatter bias per QoS tier — the mirror image of _TIER_TOPO_FACTOR:
+# best-effort soaks fragments, guaranteed keeps its binpack+adjacency
+# ranking essentially untouched
+_TIER_FRAG_FACTOR = {"guaranteed": 0.3, "burstable": 0.6,
+                     "best-effort": 1.0}
+
+# stranded fraction at which the level term saturates: 1/8 of fleet HBM
+# stranded is a full-pressure emergency by any operational standard
+_LEVEL_GAIN = 8.0
+# how much a worsening trend can add on top of the level term
+_SLOPE_BOOST = 0.5
+
+TREND_WINDOW = 8
+
+
+def frag_weight_knob() -> float:
+    """The ``TPUSHARE_FRAG_WEIGHT`` knob (default 0.5, clamped to
+    [0, 1]). 0 disables the forecast blend entirely."""
+    try:
+        w = float(os.environ.get("TPUSHARE_FRAG_WEIGHT", "0.5"))
+    except ValueError:
+        w = 0.5
+    return min(max(w, 0.0), 1.0)
+
+
+class FragForecast:
+    """Stranded-gap trend -> placement pressure.
+
+    Feed it samples either by polling a FleetWatch (production wiring:
+    ``FragForecast(fleetwatch=...)`` — each ``pressure()`` call picks up
+    the watcher's latest cached sample) or directly via ``observe()``
+    (tests, the wind tunnel)."""
+
+    def __init__(self, fleetwatch=None, window: int = TREND_WINDOW):
+        self._fw = fleetwatch
+        # trend bookkeeping ONLY; never held across a fleetwatch call
+        self._lock = threading.Lock()
+        self._trend: deque[float] = deque(maxlen=max(window, 2))
+        self._seen_at: float | None = None
+        self._fragmented: frozenset[str] = frozenset()
+
+    # -- feeding ----------------------------------------------------------
+
+    def observe(self, sample: dict[str, Any]) -> None:
+        """Fold one fleet sample (fleetwatch.sample_fleet shape) into
+        the trend."""
+        total = sample.get("total_hbm_mib") or 0
+        worst = 0
+        for row in (sample.get("tiers") or {}).values():
+            worst = max(worst, int(row.get("stranded_hbm_mib") or 0))
+        frac = (worst / total) if total else 0.0
+        fragged = frozenset(
+            r["node"] for r in sample.get("top_fragmented") or ()
+            if r.get("node"))
+        with self._lock:
+            self._trend.append(frac)
+            self._fragmented = fragged
+
+    def _refresh(self) -> None:
+        if self._fw is None:
+            return
+        sample, at = self._fw.last_sample()
+        if sample is None or at == self._seen_at:
+            return
+        self._seen_at = at
+        self.observe(sample)
+
+    # -- the forecast -----------------------------------------------------
+
+    def pressure(self) -> float:
+        """Fragmentation pressure in [0, 1]: saturating level term plus
+        a bounded boost while the stranded trend is worsening. Exactly
+        0.0 on an unfragmented fleet."""
+        self._refresh()
+        with self._lock:
+            trend = list(self._trend)
+        if not trend or trend[-1] <= 0.0:
+            return 0.0
+        level = min(1.0, _LEVEL_GAIN * trend[-1])
+        slope = trend[-1] - trend[0]
+        boost = min(_SLOPE_BOOST, max(0.0, _LEVEL_GAIN * slope))
+        return min(1.0, level + boost)
+
+    def fragmented_nodes(self) -> frozenset[str]:
+        """Nodes with a nonzero stranded gap in the latest sample — the
+        holes a scatter-biased pod should soak."""
+        self._refresh()
+        with self._lock:
+            return self._fragmented
+
+    def weight(self, pod: dict[str, Any]) -> float:
+        """Effective scatter-blend weight for this pod: knob x pressure
+        x tier factor. 0.0 whenever the knob is 0 OR the fleet is clean,
+        so the escape hatch and the healthy path are both free."""
+        w = frag_weight_knob()
+        if w <= 0.0:
+            return 0.0
+        p = self.pressure()
+        if p <= 0.0:
+            return 0.0
+        return w * p * _TIER_FRAG_FACTOR.get(pod_tier(pod), 1.0)
+
+    # -- observability ----------------------------------------------------
+
+    def attach(self, registry) -> None:
+        registry.gauge_func(
+            "tpushare_frag_pressure",
+            "Fragmentation-pressure forecast in [0, 1] "
+            "(defrag/forecast.py): stranded-gap level + trend slope "
+            "over the fleetwatch sample window; drives the Prioritize "
+            "binpack-vs-scatter blend (TPUSHARE_FRAG_WEIGHT)",
+            lambda: [("", round(self.pressure(), 4))])

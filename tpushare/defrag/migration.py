@@ -1,0 +1,165 @@
+"""Checkpoint-driven live migration: the workload side of a repack move.
+
+The executor (defrag/executor.py) owns apiserver truth — evict, re-place,
+roll back under the stamp/backoff regime. This module owns what happens
+to the WORKLOAD across that window, as one bounded-pause session per
+move:
+
+- ``begin()``  (pre-eviction): park the victim's serve loop at a quantum
+  boundary (workloads/serve.py ``_EngineFrontend.pause``) and take the
+  durable checkpoint (workloads/checkpoint.py ``TrainCheckpointer.save``
+  semantics: blocks until durable). Runs BEFORE any apiserver write, so
+  blowing ``TPUSHARE_MIGRATE_PAUSE_BUDGET_S`` aborts with the victim
+  untouched on its source chips — the cheapest possible rollback.
+- ``commit()`` (after the replacement is placed): restore onto the
+  target and lift the pause. A restore failure raises, and the executor
+  rolls the victim back onto its source chips exactly like any other
+  failed move.
+- ``abort()``  (any failure path): lift the pause on the source.
+
+Every session publishes its wall-clock pause (begin -> commit/abort)
+into the ``tpushare_defrag_pause_seconds`` histogram, and the executor
+counts each move into ``tpushare_migrations_total{kind,outcome}``.
+
+Both collaborator seams are duck-typed so this layer stays import-clean
+of jax (the scheduler-side rule): ``checkpointer`` needs ``save(pod,
+move)`` / ``restore(pod, move)``, ``frontend_for(pod)`` returns anything
+with ``pause(timeout)->bool`` / ``resume()`` (or None for a victim with
+no serve loop). ``workloads.serve`` / ``workloads.checkpoint`` provide
+the real ones in-process; tests and bench provide fakes and clocks.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable
+
+from tpushare.metrics import Histogram, LabeledCounter
+
+# pause spans checkpoint save + evict + re-place + restore; buckets reach
+# well past any sane budget so an overrun is measured, not clipped
+PAUSE_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                 2.5, 5.0, 10.0, 30.0, 60.0)
+
+PAUSE_SECONDS = Histogram(
+    "tpushare_defrag_pause_seconds",
+    "Per-move workload pause during a live migration: serve loop parked "
+    "at a quantum boundary -> checkpoint -> evict -> restore-on-target "
+    "-> resumed (defrag/migration.py). p99 over budget = lower "
+    "TPUSHARE_DEFRAG_BUDGET or raise TPUSHARE_MIGRATE_PAUSE_BUDGET_S",
+    PAUSE_BUCKETS)
+
+MIGRATIONS = LabeledCounter(
+    "tpushare_migrations_total",
+    "Live migrations by kind (solo = one pod, slice = whole multi-host "
+    "gang moved atomically) and outcome (completed / demoted = a stamp "
+    "moved between plan and execute / failed = rolled back onto source)",
+    ("kind", "outcome"))
+
+
+def pause_budget_s() -> float:
+    """``TPUSHARE_MIGRATE_PAUSE_BUDGET_S`` (default 30 s): the longest a
+    victim's serve loop may stay parked before the move aborts."""
+    try:
+        return float(os.environ.get("TPUSHARE_MIGRATE_PAUSE_BUDGET_S",
+                                    "30.0"))
+    except ValueError:
+        return 30.0
+
+
+class PauseBudgetExceeded(RuntimeError):
+    """The checkpoint (or the quiesce before it) blew the pause budget;
+    raised from ``begin()`` strictly before any apiserver write, so the
+    abort path has nothing to roll back."""
+
+
+class MigrationSession:
+    """One move's pause->checkpoint->restore arc. Not reusable."""
+
+    def __init__(self, pod: dict[str, Any], move: Any,
+                 checkpointer=None, frontend=None,
+                 budget_s: float | None = None,
+                 time_fn: Callable[[], float] = time.monotonic) -> None:
+        self._pod = pod
+        self._move = move
+        self._ckpt = checkpointer
+        self._frontend = frontend
+        self._budget = pause_budget_s() if budget_s is None else budget_s
+        self._time = time_fn
+        self._t0: float | None = None
+        self._done = False
+
+    @property
+    def pause_s(self) -> float | None:
+        """Wall-clock pause so far (None before begin())."""
+        return None if self._t0 is None else self._time() - self._t0
+
+    def begin(self) -> None:
+        """Quiesce + durable checkpoint, budget-enforced. Raises
+        :class:`PauseBudgetExceeded` with the serve loop RESUMED and the
+        victim untouched."""
+        self._t0 = self._time()
+        fe = self._frontend
+        if fe is not None:
+            if not fe.pause(timeout=self._budget):
+                self._finish()
+                raise PauseBudgetExceeded(
+                    f"serve loop failed to quiesce within "
+                    f"{self._budget}s pause budget")
+        try:
+            if self._ckpt is not None:
+                self._ckpt.save(self._pod, self._move)
+        except Exception:
+            self._finish()
+            raise
+        elapsed = self._time() - self._t0
+        if elapsed > self._budget:
+            self._finish()
+            raise PauseBudgetExceeded(
+                f"checkpoint took {elapsed:.3f}s, over the "
+                f"{self._budget}s pause budget")
+
+    def commit(self) -> None:
+        """Restore onto the target and lift the pause. Raises on restore
+        failure (the executor then rolls back and calls abort())."""
+        if self._ckpt is not None:
+            self._ckpt.restore(self._pod, self._move)
+        self._finish()
+
+    def abort(self) -> None:
+        """Failure path: lift the pause on the source. Idempotent."""
+        self._finish()
+
+    def _finish(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        fe = self._frontend
+        if fe is not None:
+            try:
+                fe.resume()
+            except Exception:  # noqa: BLE001 — resume must not mask the
+                pass           # error that brought us here
+        if self._t0 is not None:
+            PAUSE_SECONDS.observe(self._time() - self._t0)
+
+
+class Migrator:
+    """Session factory the executor holds: resolves each victim's serve
+    frontend and checkpointer once per move."""
+
+    def __init__(self, checkpointer=None,
+                 frontend_for: Callable[[dict[str, Any]], Any] | None = None,
+                 budget_s: float | None = None,
+                 time_fn: Callable[[], float] = time.monotonic) -> None:
+        self._ckpt = checkpointer
+        self._frontend_for = frontend_for
+        self._budget = budget_s
+        self._time = time_fn
+
+    def session(self, pod: dict[str, Any], move: Any) -> MigrationSession:
+        fe = self._frontend_for(pod) if self._frontend_for else None
+        return MigrationSession(pod, move, checkpointer=self._ckpt,
+                                frontend=fe, budget_s=self._budget,
+                                time_fn=self._time)

@@ -79,6 +79,10 @@ class Victim:
     request: PlacementRequest
     mode: str = "restore"        # "restore" | "drain"
     movable: bool = True
+    # gang members never move solo: relocating one rank while its peers
+    # stay put would tear the slice geometry (TPU_PROCESS_BOUNDS spans
+    # hosts). They move only as a whole SliceMove, or not at all.
+    gang_id: str | None = None
 
 
 @dataclass
@@ -130,6 +134,79 @@ class Move:
         }
 
 
+@dataclass(frozen=True)
+class SliceMember:
+    """One gang rank inside a whole-slice move: where it sits now and
+    where the re-solved plan puts it, both stamp-pinned at plan time."""
+
+    pod_key: str
+    rank: int
+    source: str
+    source_stamp: tuple[int, int]
+    source_chip_ids: tuple[int, ...]
+    per_chip_mib: int
+    target: str
+    target_stamp: tuple[int, int] | None
+    target_chip_ids: tuple[int, ...]
+    target_box: tuple[int, ...]
+    target_origin: tuple[int, ...]
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "pod_key": self.pod_key,
+            "rank": self.rank,
+            "source": self.source,
+            "source_stamp": list(self.source_stamp),
+            "source_chip_ids": list(self.source_chip_ids),
+            "target": self.target,
+            "target_stamp": list(self.target_stamp)
+            if self.target_stamp is not None else None,
+            "target_chip_ids": list(self.target_chip_ids),
+        }
+
+
+@dataclass(frozen=True)
+class SliceMove:
+    """A multi-host gang re-solved atomically onto fresh capacity via
+    the gang coordinator's one-shot solve (``tpushare_solve_gang``,
+    ABI v5+). EVERY member's source and target stamp is pinned here at
+    plan time; the executor demotes the WHOLE slice if any one of them
+    moved before execution (demote-don't-race) — a slice is never half
+    migrated. ``plan_annotation`` is the recomposed ``ANN_GANG_PLAN``
+    JSON each replacement member carries, so the device plugin derives
+    ``TPU_PROCESS_BOUNDS`` for the new geometry without any other
+    gang's plan being touched."""
+
+    gang_id: str
+    members: tuple[SliceMember, ...]
+    plan_annotation: str
+    gain_chips: int
+    tier: int
+    mode: str = "restore"
+
+    @property
+    def nodes(self) -> tuple[str, ...]:
+        """Every node the move touches, deduplicated — the unit the
+        executor's budget governor admits (one slot per slice)."""
+        out: list[str] = []
+        for m in self.members:
+            for n in (m.source, m.target):
+                if n not in out:
+                    out.append(n)
+        return tuple(out)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "kind": "slice",
+            "gang_id": self.gang_id,
+            "members": [m.to_dict() for m in self.members],
+            "nodes": list(self.nodes),
+            "gain_chips": self.gain_chips,
+            "tier": tier_label(self.tier),
+            "mode": self.mode,
+        }
+
+
 @dataclass
 class RepackPlan:
     """A planning pass's output: ordered moves plus the fragmentation
@@ -137,12 +214,14 @@ class RepackPlan:
     recovery accounting)."""
 
     moves: list[Move] = field(default_factory=list)
+    slice_moves: list[SliceMove] = field(default_factory=list)
     fragmented_nodes: int = 0
     stranded_chips_before: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return {
             "moves": [m.to_dict() for m in self.moves],
+            "slice_moves": [m.to_dict() for m in self.slice_moves],
             "fragmented_nodes": self.fragmented_nodes,
             "stranded_chips_before": self.stranded_chips_before,
         }
@@ -254,7 +333,7 @@ def plan_moves(states: list[NodeState], solve: SolveFn,
                 break
             best: tuple[int, int, Victim] | None = None
             for v in st.victims:
-                if not v.movable or v.pod_key in moved:
+                if not v.movable or v.gang_id or v.pod_key in moved:
                     continue
                 gain = _gain(views, st.topo, v, tier, contig_cur)
                 if gain <= 0:
@@ -300,10 +379,15 @@ class DefragPlanner:
     SOLVE_RETRIES = 3  # re-solve attempts when a target overlaps a claim
 
     def __init__(self, cache,
-                 movable_fn: Callable[[dict], str | None] | None = None
-                 ) -> None:
+                 movable_fn: Callable[[dict], str | None] | None = None,
+                 gang=None, cluster=None) -> None:
         self._cache = cache
         self._movable_fn = movable_fn or self._movable_from_annotations
+        # whole-slice moves need the gang coordinator's one-shot solve
+        # (plan_relocation) and a pod lister for full-membership checks;
+        # without both, gang victims are simply never planned
+        self.gang = gang
+        self.cluster = cluster
 
     @staticmethod
     def _movable_from_annotations(pod: dict[str, Any]) -> str | None:
@@ -359,9 +443,17 @@ class DefragPlanner:
                     # guaranteed reservation — the contiguity a move
                     # would buy accrues mostly to evictable borrowers.
                     continue
+                try:
+                    gm = podlib.gang_membership(pod)
+                except ValueError:
+                    gm = None  # malformed gang labels: treat as immovable
+                    mode = None
+                if mode is None:
+                    continue
                 victims.append(Victim(
                     pod_key=key, chip_ids=tuple(sorted(ids)),
-                    per_chip_mib=per_chip[key], request=req, mode=mode))
+                    per_chip_mib=per_chip[key], request=req, mode=mode,
+                    gang_id=gm[0] if gm else None))
             states.append(NodeState(
                 name=name, stamp=vstamp, topo=info.topology,
                 hbm_per_chip=info.hbm_per_chip,
@@ -388,9 +480,177 @@ class DefragPlanner:
             return name, placement, stamp
         return None
 
+    # -- whole-slice moves ----------------------------------------------------
+
+    def _gang_members(self, gids: set[str]
+                      ) -> dict[str, dict[int, dict[str, Any]]]:
+        """Full live membership (rank -> pod) for each candidate gang,
+        from the apiserver pod list — gangs span hosts the fragmented
+        node states never see, and moving less than all of one is the
+        failure mode this subsystem exists to prevent."""
+        out: dict[str, dict[int, dict[str, Any]]] = {}
+        try:
+            pods = self.cluster.list_pods()
+        except Exception:  # noqa: BLE001 — planning must never crash
+            return out
+        for p in pods:
+            try:
+                gm = podlib.gang_membership(p)
+            except ValueError:
+                continue
+            if gm is None or gm[0] not in gids:
+                continue
+            out.setdefault(gm[0], {})[gm[2]] = p
+        return out
+
+    def _plan_slices(self, states: list[NodeState], max_moves: int
+                     ) -> tuple[list[SliceMove], dict[str, set[int]],
+                                set[str]]:
+        """Plan whole-slice relocations for gangs with a member on a
+        fragmented node. Returns (moves, claimed target chips, every
+        node a planned slice touches) so solo planning steers clear.
+
+        A gang is only planned when EVERY rank is live, bound, and
+        opted into checkpoint/restore moves, and the coordinator's
+        re-solve finds a complete new home (current occupancy makes the
+        old placement unavailable, so the solve necessarily lands on
+        other capacity). All member stamps — source and target — are
+        pinned here; the executor demotes the whole slice if any moved.
+        """
+        if self.gang is None or self.cluster is None or max_moves <= 0:
+            return [], {}, set()
+        seeds: dict[str, tuple[NodeState, int, int]] = {}
+        for st in states:
+            tier, gap, contig = worst_tier(st)
+            if gap <= 0:
+                continue
+            for v in st.victims:
+                if v.gang_id and v.movable and v.mode == "restore":
+                    seeds.setdefault(v.gang_id, (st, tier, contig))
+        if not seeds:
+            return [], {}, set()
+        membership = self._gang_members(set(seeds))
+        frag_states = {st.name: (st, tier, contig)
+                       for st, tier, contig in seeds.values()}
+        moves: list[SliceMove] = []
+        claimed: dict[str, set[int]] = {}
+        touched: set[str] = set()
+        for gid in sorted(seeds):
+            if len(moves) >= max_moves:
+                break
+            members = membership.get(gid) or {}
+            n = len(members)
+            if n < 2 or set(members) != set(range(n)):
+                continue  # not fully resident: never move half a gang
+            rows = []
+            ok = True
+            size = 0
+            for rank in range(n):
+                p = members[rank]
+                try:
+                    _gid, size, _rank = podlib.gang_membership(p)
+                except ValueError:
+                    ok = False
+                    break
+                chips = podlib.chip_ids_from_annotations(p)
+                node = podlib.pod_node_name(p)
+                if (self._movable_fn(p) != "restore" or chips is None
+                        or not node):
+                    ok = False
+                    break
+                rows.append((p, node, chips))
+            if not ok or any(node in touched or node in claimed
+                             for _p, node, _c in rows):
+                continue
+            try:
+                plan = self.gang.plan_relocation(gid, members[0], size)
+            except Exception:  # noqa: BLE001 — a failed solve skips the gang
+                plan = None
+            if plan is None or len(plan.members) != n:
+                continue  # no new home with the same host decomposition
+            tstamps = plan.stamps or [None] * n
+            smembers: list[SliceMember] = []
+            gain = 0
+            for rank, (p, node, chips) in enumerate(rows):
+                sinfo = self._cache.peek_node(node)
+                host, tchips, box, origin = plan.members[rank]
+                tinfo = self._cache.peek_node(host)
+                if sinfo is None or tinfo is None:
+                    ok = False
+                    break
+                ts = tstamps[rank] if rank < len(tstamps) else None
+                per_chip = podlib.hbm_from_annotations(p) \
+                    or sinfo.hbm_per_chip
+                if node in frag_states:
+                    st, tier, contig = frag_states[node]
+                    lift = Victim(pod_key=podlib.pod_cache_key(p),
+                                  chip_ids=tuple(chips),
+                                  per_chip_mib=per_chip,
+                                  request=PlacementRequest(
+                                      hbm_mib=per_chip,
+                                      chip_count=len(chips)))
+                    gain += max(_gain(st.views, st.topo, lift, tier,
+                                      contig), 0)
+                smembers.append(SliceMember(
+                    pod_key=podlib.pod_cache_key(p), rank=rank,
+                    source=node, source_stamp=sinfo.version,
+                    source_chip_ids=tuple(chips), per_chip_mib=per_chip,
+                    target=host,
+                    target_stamp=ts if ts is not None else tinfo.version,
+                    target_chip_ids=tuple(tchips),
+                    target_box=tuple(box), target_origin=tuple(origin)))
+            if not ok or not smembers or gain <= 0:
+                continue
+            seed_tier = seeds[gid][1]
+            move = SliceMove(gang_id=gid, members=tuple(smembers),
+                             plan_annotation=plan.to_json(),
+                             gain_chips=gain, tier=seed_tier)
+            overlap = False
+            for m in move.members:
+                if set(m.target_chip_ids) & claimed.get(m.target, set()):
+                    overlap = True  # two slices raced onto one hole
+                    break
+            if overlap:
+                continue
+            for m in move.members:
+                claimed.setdefault(m.target, set()).update(
+                    m.target_chip_ids)
+            touched.update(move.nodes)
+            moves.append(move)
+        return moves, claimed, touched
+
     def plan(self, max_moves: int) -> RepackPlan:
-        """One planning pass: collect fragmented node states, run the
-        pure core against the live what-if solver."""
-        plan = plan_moves(self.collect_states(), self._solve, max_moves)
-        DEFRAG_PLANS.inc("planned" if plan.moves else "empty")
+        """One planning pass: whole-slice moves first (they unlock the
+        biggest contiguous boxes), then the solo core over the nodes no
+        slice touches, against the live what-if solver with the slices'
+        target chips pre-claimed."""
+        states = self.collect_states()
+        slice_moves, claimed, touched = self._plan_slices(
+            states, max_moves)
+        solo_states = [st for st in states if st.name not in touched]
+
+        def solve(req: PlacementRequest, exclude: set[str],
+                  claims: Mapping[str, set[int]]
+                  ) -> tuple[str, Placement, tuple[int, int]] | None:
+            merged = {n: set(c) for n, c in claimed.items()}
+            for n, c in claims.items():
+                merged.setdefault(n, set()).update(c)
+            return self._solve(req, exclude | touched, merged)
+
+        plan = plan_moves(solo_states, solve,
+                          max(max_moves - len(slice_moves), 0))
+        plan.slice_moves = slice_moves
+        if touched:
+            # the fragmentation picture should describe the WHOLE fleet,
+            # not just the nodes left to the solo core
+            frag = strand = 0
+            for st in states:
+                _t, gap, _c = worst_tier(st)
+                if gap > 0:
+                    frag += 1
+                    strand += gap
+            plan.fragmented_nodes = frag
+            plan.stranded_chips_before = strand
+        DEFRAG_PLANS.inc(
+            "planned" if plan.moves or plan.slice_moves else "empty")
         return plan

@@ -23,6 +23,7 @@ from typing import Any, Callable
 
 from .executor import (DEFRAG_DEMOTIONS, DEFRAG_FREED, DEFRAG_MOVES,
                        DefragExecutor, _env_float)
+from .migration import MIGRATIONS, PAUSE_SECONDS
 from .planner import DEFRAG_PLANS, DefragPlanner
 
 
@@ -35,13 +36,15 @@ class DefragController:
                  period_s: float | None = None,
                  planner: DefragPlanner | None = None,
                  executor: DefragExecutor | None = None,
-                 explain=None,
+                 explain=None, gang=None, migrator=None,
                  time_fn: Callable[[], float] = time.monotonic) -> None:
         self.period_s = _env_float("TPUSHARE_DEFRAG_PERIOD_S", 30.0) \
             if period_s is None else period_s
-        self.planner = planner or DefragPlanner(cache)
+        self.planner = planner or DefragPlanner(cache, gang=gang,
+                                                cluster=cluster)
         self.executor = executor or DefragExecutor(
-            cache, cluster, explain=explain, time_fn=time_fn)
+            cache, cluster, explain=explain, migrator=migrator,
+            time_fn=time_fn)
         self._time = time_fn
         # guards only the inspect-state below; never held across a
         # planning pass or a move (lock-order: leftmost, like the
@@ -66,10 +69,19 @@ class DefragController:
         """Plan and execute one pass synchronously; returns the pass
         summary (also retained for /inspect/defrag)."""
         plan = self.planner.plan(max_moves=self.executor.budget)
-        outcomes = self.executor.execute(plan) if plan.moves else []
+        outcomes = self.executor.execute(plan) \
+            if plan.moves or plan.slice_moves else []
         summary = {"plan": plan.to_dict(),
                    "executed": len(outcomes),
                    "outcomes": [o["outcome"] for o in outcomes]}
+        # stitch each execution outcome back onto its plan entry so
+        # /inspect/defrag can tell a demoted move from a completed one
+        # (the executor runs slice moves first, in plan order)
+        planned = summary["plan"]["slice_moves"] + summary["plan"]["moves"]
+        for entry, res in zip(planned, outcomes):
+            entry["outcome"] = res["outcome"]
+            if res.get("error"):
+                entry["error"] = res["error"]
         with self._lock:
             self._passes += 1
             self._last_plan = summary["plan"]
@@ -90,6 +102,8 @@ class DefragController:
             skipped_gate = self._skipped_gate
         plans = {k[0]: v for k, v in DEFRAG_PLANS.snapshot().items()}
         move_totals = {k[0]: v for k, v in DEFRAG_MOVES.snapshot().items()}
+        migrations = {f"{k[0]}:{k[1]}": v
+                      for k, v in MIGRATIONS.snapshot().items()}
         gate = self.gate
         return {
             "running": self._thread is not None,
@@ -104,8 +118,14 @@ class DefragController:
             "counters": {
                 "plans_total": plans,
                 "moves_total": move_totals,
+                "migrations_total": migrations,
                 "demotions_total": DEFRAG_DEMOTIONS.value,
                 "freed_chips_total": DEFRAG_FREED.value,
+            },
+            "pause_s": {
+                "count": PAUSE_SECONDS.count,
+                "p50": PAUSE_SECONDS.quantile(0.5),
+                "p99": PAUSE_SECONDS.quantile(0.99),
             },
         }
 
@@ -116,6 +136,8 @@ class DefragController:
         registry.register(DEFRAG_MOVES)
         registry.register(DEFRAG_DEMOTIONS)
         registry.register(DEFRAG_FREED)
+        registry.register(MIGRATIONS)
+        registry.register(PAUSE_SECONDS)
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -429,12 +429,17 @@ class PrioritizeHandler:
 
     def __init__(self, cache: SchedulerCache, registry: Registry,
                  breaker=None, tracer=None, explain=None,
-                 wire=None) -> None:
+                 wire=None, forecast=None) -> None:
         self._cache = cache
         self._wire = wire  # wire-plane response cache, like Filter
         self._breaker = breaker  # degraded-mode accounting, like Filter
         self._tracer = tracer or TRACER  # joins the cycle Filter opened
         self._explain = explain  # ExplainStore | None
+        # fragmentation-pressure forecast (defrag/forecast.py): under
+        # stranded-gap pressure, low-tier pods are steered toward
+        # already-fragmented nodes so pristine boxes stay whole. None or
+        # TPUSHARE_FRAG_WEIGHT=0 keeps this path byte-identical.
+        self._forecast = forecast
         self._prioritize_total = registry.counter(
             "tpushare_prioritize_requests_total", "Prioritize webhook calls")
         self._prioritize_latency = registry.histogram(
@@ -474,11 +479,20 @@ class PrioritizeHandler:
                           for n in items]
         node_names = [n for n in node_names if n]
         req = request_from_pod(pod)
+        forecast = self._forecast
+        f_eff = forecast.weight(pod) \
+            if forecast is not None and req is not None else 0.0
+        frag_nodes = forecast.fragmented_nodes() if f_eff > 0.0 \
+            else frozenset()
         wire, wire_key, wire_hit = self._wire, None, None
         if wire is not None and wire_ctx is not None and req is not None:
             wire_key = req
             wire_hit = wire.lookup(wire_ctx, "prioritize", wire_key)
-            if wire_hit is not None and not wire.verify:
+            # under frag pressure the blend drifts with the fleet's
+            # stranded-gap trend, so a byte-replay of an earlier ranking
+            # would serve stale bias: compute fresh instead
+            if wire_hit is not None and not wire.verify \
+                    and f_eff <= 0.0:
                 wire.served_hit("prioritize")
                 if wire_hit.best is not None:
                     # keep Bind's seed hint warm exactly like a computed
@@ -534,12 +548,21 @@ class PrioritizeHandler:
                     # tier factor decides who wins the argument
                     p_adj = self.MAX_PRIORITY * adj / ADJ_SCALE
                     score = round((1.0 - w_eff) * score + w_eff * p_adj)
+            if s is not None and f_eff > 0.0:
+                # binpack-vs-scatter blend: under fragmentation
+                # pressure, steer this pod toward nodes that are
+                # ALREADY fragmented (soak the holes) so pristine
+                # contiguous boxes stay whole for the gangs that need
+                # them — every hole filled upstream is a migration the
+                # rebalancer never has to buy
+                p_frag = self.MAX_PRIORITY if name in frag_nodes else 0
+                score = round((1.0 - f_eff) * score + f_eff * p_frag)
             if s is not None and best_name is None:
                 best_name = name  # ties resolve to the first, like max()
             elif s is not None and s < raw[best_name]:  # type: ignore[index]
                 best_name = name
             out.append({"Host": name, "Score": score})
-        if w_eff > 0.0:
+        if w_eff > 0.0 or f_eff > 0.0:
             # Bind's seed hint must chase the node the scheduler will
             # actually pick — the blended top, not the binpack top
             ranked = [h for h in out if raw.get(h["Host"]) is not None]
@@ -561,7 +584,8 @@ class PrioritizeHandler:
             wire_ctx.pod_key, wire_ctx.pod = pod_key, pod
             return wire.finish_prioritize(wire_ctx, wire_key, out,
                                           best_name,
-                                          cacheable=not had_errors,
+                                          cacheable=not had_errors
+                                          and f_eff <= 0.0,
                                           expected=wire_hit)
         return out
 

@@ -131,15 +131,35 @@ class ExtenderServer:
         # replicas of one port share a segment
         self.federation = None
         self.fleetwatch.attach(self.registry)
+        # multi-host gang placement (docs/designs/multihost-gang.md):
+        # engages only for pods carrying the gang annotations, on nodes
+        # labeled into slices — zero cost otherwise. Constructed before
+        # the defrag controller, whose whole-slice moves re-solve LIVE
+        # gangs through the coordinator's one-shot solve.
+        from tpushare.cache.gang import GangCoordinator
+        self.gang = GangCoordinator(cache)
+        # fragmentation-pressure forecast (defrag/forecast.py): folds
+        # fleetwatch's cached stranded-gap trend into the Prioritize
+        # binpack-vs-scatter blend so admission stops CREATING the
+        # fragmentation defrag pays migrations to undo.
+        # TPUSHARE_FRAG_WEIGHT=0 disables the blend byte-identically.
+        from tpushare.defrag.forecast import FragForecast
+        self.frag_forecast = FragForecast(fleetwatch=self.fleetwatch)
+        self.frag_forecast.attach(self.registry)
         # live defragmentation (defrag/): the repack rebalancer consumes
         # the same capacity-index stranded-gap picture the fleetwatch
         # gauges publish and acts on it under a migration budget, behind
         # GET /inspect/defrag. Background thread starts with the server
         # (TPUSHARE_DEFRAG=0 opts out); decisions land in the explain
-        # audit and the cycle tracer like any scheduling verdict.
+        # audit and the cycle tracer like any scheduling verdict. Moves
+        # run as bounded-pause checkpoint sessions via the workload-side
+        # migrator seam (workloads/migrate.py).
         from tpushare.defrag import DefragController
+        from tpushare.workloads.migrate import default_migrator
         self.defrag = DefragController(cache, cluster=cluster,
-                                       explain=self.explain)
+                                       explain=self.explain,
+                                       gang=self.gang,
+                                       migrator=default_migrator())
         self.defrag.attach(self.registry)
         # QoS tiers (tpushare/qos/, ISSUE 17): the pressure monitor
         # reclaims best-effort HBM when higher-tier demand lands on an
@@ -148,11 +168,6 @@ class ExtenderServer:
         # single-class fleet pays nothing.
         from tpushare.qos.pressure import QosPressureMonitor
         self.qos_pressure = QosPressureMonitor(cache, cluster)
-        # multi-host gang placement (docs/designs/multihost-gang.md):
-        # engages only for pods carrying the gang annotations, on nodes
-        # labeled into slices — zero cost otherwise
-        from tpushare.cache.gang import GangCoordinator
-        self.gang = GangCoordinator(cache)
         # batched decision cycles (cache/batch.py): same-signature pods
         # arriving within TPUSHARE_BATCH_WINDOW_MS coalesce into one
         # multi-pod native solve. Window 0 (the default) disables the
@@ -184,11 +199,10 @@ class ExtenderServer:
                                             explain=self.explain,
                                             batcher=self.batcher,
                                             wire=self.wirecache)
-        self.prioritize_handler = PrioritizeHandler(cache, self.registry,
-                                                    breaker=breaker,
-                                                    tracer=self.tracer,
-                                                    explain=self.explain,
-                                                    wire=self.wirecache)
+        self.prioritize_handler = PrioritizeHandler(
+            cache, self.registry, breaker=breaker, tracer=self.tracer,
+            explain=self.explain, wire=self.wirecache,
+            forecast=self.frag_forecast)
         self.preempt_handler = PreemptHandler(cache, self.registry)
         # HA (an elector is wired): binds also CAS a per-node claim so two
         # replicas in a stale-leader window cannot co-place onto one chip;

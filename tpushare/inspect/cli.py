@@ -178,17 +178,37 @@ def render_defrag(snap: dict[str, Any]) -> str:
     if plan is None:
         lines.append("no plan yet")
     else:
+        n_slice = len(plan.get("slice_moves") or [])
         lines.append(
             f"last plan ({age} s ago): {plan.get('fragmented_nodes', 0)} "
             f"fragmented nodes, {plan.get('stranded_chips_before', 0)} "
-            f"stranded chips, {len(plan.get('moves') or [])} moves")
-        for m in plan.get("moves") or []:
-            lines.append(
-                f"  {m.get('pod_key')}: {m.get('source')}"
-                f"{list(m.get('victim_chip_ids') or [])} -> "
-                f"{m.get('target')}{list(m.get('target_chip_ids') or [])} "
-                f"[{m.get('mode')}, +{m.get('gain_chips')} chips at "
-                f"{m.get('tier')}]")
+            f"stranded chips, {len(plan.get('moves') or [])} moves"
+            + (f" + {n_slice} slice moves" if n_slice else ""))
+        for m in (plan.get("slice_moves") or []) \
+                + (plan.get("moves") or []):
+            if m.get("kind") == "slice":
+                head = (
+                    f"  gang {m.get('gang_id')}: "
+                    f"{len(m.get('members') or [])} members over "
+                    f"{', '.join(m.get('nodes') or [])} "
+                    f"[slice, +{m.get('gain_chips')} chips at "
+                    f"{m.get('tier')}]")
+            else:
+                head = (
+                    f"  {m.get('pod_key')}: {m.get('source')}"
+                    f"{list(m.get('victim_chip_ids') or [])} -> "
+                    f"{m.get('target')}"
+                    f"{list(m.get('target_chip_ids') or [])} "
+                    f"[{m.get('mode')}, +{m.get('gain_chips')} chips at "
+                    f"{m.get('tier')}]")
+            # the execution outcome column: a demoted move must read
+            # differently from a completed one (it moved NOTHING)
+            outcome = m.get("outcome")
+            if outcome:
+                head += f" => {outcome}"
+                if m.get("error"):
+                    head += f" ({m['error']})"
+            lines.append(head)
     moves = snap.get("recent_moves") or []
     lines.append("")
     if moves:
@@ -196,10 +216,14 @@ def render_defrag(snap: dict[str, Any]) -> str:
         for rec in moves:
             m = rec.get("move") or {}
             err = rec.get("error")
-            lines.append(
-                f"  {m.get('pod_key')} {m.get('source')} -> "
-                f"{m.get('target')}: {rec.get('outcome')}"
-                + (f" ({err})" if err else ""))
+            if m.get("kind") == "slice":
+                what = (f"gang {m.get('gang_id')} over "
+                        f"{', '.join(m.get('nodes') or [])}")
+            else:
+                what = (f"{m.get('pod_key')} {m.get('source')} -> "
+                        f"{m.get('target')}")
+            lines.append(f"  {what}: {rec.get('outcome')}"
+                         + (f" ({err})" if err else ""))
     else:
         lines.append("no moves executed yet")
     c = snap.get("counters") or {}
@@ -211,6 +235,16 @@ def render_defrag(snap: dict[str, Any]) -> str:
         f"moves [{totals or 'none'}], "
         f"demotions {int(c.get('demotions_total', 0))}, "
         f"freed chips {int(c.get('freed_chips_total', 0))}")
+    mig = ", ".join(f"{k}={int(v)}" for k, v in sorted(
+        (c.get("migrations_total") or {}).items()))
+    pause = snap.get("pause_s") or {}
+    if mig or pause.get("count"):
+        p50, p99 = pause.get("p50"), pause.get("p99")
+        lines.append(
+            f"migrations [{mig or 'none'}], pause p50 "
+            f"{round(p50, 4) if p50 is not None else '-'} s / p99 "
+            f"{round(p99, 4) if p99 is not None else '-'} s over "
+            f"{pause.get('count', 0)} sessions")
     return "\n".join(lines)
 
 

@@ -267,6 +267,30 @@ class ExplainStore:
         self._notify("decision_recorded", "bind", pod_key, pod_identity, {
             "node": node, "outcome": outcome, "error": error or None})
 
+    def record_migration(self, pod_key: str,
+                         pod_identity: dict[str, Any] | None,
+                         trace_id: str | None, *, kind: str, source: str,
+                         target: str, outcome: str,
+                         error: str | None = None) -> None:
+        """One live-migration verdict (defrag/executor.py): kept in the
+        pod's cycle record and fanned into the decision stream, so the
+        incident journal replays the move sequence like any scheduling
+        decision. ``kind`` ("solo"|"slice") folds into the journaled
+        outcome — the journal's field whitelist stays closed."""
+        with self._lock:
+            rec = self._entry(pod_key, pod_identity, trace_id)
+            rec["migration"] = {
+                "kind": kind,
+                "source": source,
+                "target": target,
+                "outcome": outcome,
+                "error": error or None,
+            }
+        self._notify("decision_recorded", "migration", pod_key,
+                     pod_identity, {"source": source, "node": target,
+                                    "outcome": f"{kind}_{outcome}",
+                                    "error": error or None})
+
     # -- queries --------------------------------------------------------------
 
     def get(self, selector: str) -> dict[str, Any] | None:

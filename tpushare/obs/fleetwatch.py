@@ -362,6 +362,13 @@ class FleetWatch:
             self._sample_at = self._time()
         return sample
 
+    def last_sample(self) -> tuple[dict[str, Any] | None, float | None]:
+        """The cached sampler pass and its timestamp, no refresh — the
+        read the frag forecast (defrag/forecast.py) polls per decision,
+        so it must stay a lock + two reads, never a fleet walk."""
+        with self._lock:
+            return self._sample, self._sample_at
+
     # -- continuous drift auditor ---------------------------------------------
 
     def _expected_chips(self, name: str, info) -> list[dict[str, int]] | None:

@@ -144,6 +144,14 @@ def _build_parser() -> argparse.ArgumentParser:
                          "budget (tpushare/sim/defrag.py)")
     sg.add_argument("--budgets", default="0,1,2,4",
                     help="--defrag: comma-separated move budgets to sweep")
+    sg.add_argument("--frag-weight", type=float, default=0.0,
+                    metavar="W",
+                    help="--defrag: > 0 switches to the migration A/B — "
+                         "the identical trace run react-only vs "
+                         "forecast-biased admission (weight W) with "
+                         "pressure-gated repack; reports both runs plus "
+                         "the fewer-migrations / stranded-held verdict "
+                         "(tpushare/sim/defrag.py sweep_forecast)")
     sg.add_argument("--shards", type=int, default=0, metavar="N",
                     help="active-active sharding mode: replay the "
                          "standard arrival trace against 1, 2 and 4 "
@@ -301,9 +309,14 @@ def _run(ap, args, emit) -> int:
         return 0
 
     if args.defrag:
-        from tpushare.sim.defrag import sweep_budgets
+        from tpushare.sim.defrag import sweep_budgets, sweep_forecast
         mesh = tuple(int(d) for d in args.mesh.split("x")) \
             if args.mesh else ((2, 2) if args.chips == 4 else None)
+        if args.frag_weight > 0.0:
+            emit(sweep_forecast(frag_weight=args.frag_weight,
+                                n_nodes=args.nodes, chips=args.chips,
+                                hbm=args.hbm, mesh=mesh))
+            return 0
         budgets = tuple(int(b) for b in args.budgets.split(","))
         for report in sweep_budgets(budgets, n_nodes=args.nodes,
                                     chips=args.chips, hbm=args.hbm,

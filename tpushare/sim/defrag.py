@@ -16,7 +16,26 @@ the pass's moves; ``recovery_pct`` is the recovered fraction summed
 over passes. Budget 0 is the control: same trace, same planner, no
 moves allowed.
 
-CLI: ``python -m tpushare.sim --defrag [--budgets 0,1,2,4]``.
+Two migration-era extensions ride on the same loop:
+
+- **Pause model** — every applied move costs a checkpoint/restore pause
+  derived from the victim's HBM footprint at a fixed drain rate
+  (``pause = floor + footprint_mib / ckpt_mib_per_s``); the report
+  carries pause p50/p99 in the same shape the live
+  ``tpushare_defrag_pause_seconds`` histogram publishes, and a
+  ``pause_budget_s`` aborts any single move whose modeled pause would
+  blow ``TPUSHARE_MIGRATE_PAUSE_BUDGET_S``.
+- **Forecast bias** — ``frag_weight`` mirrors the live Prioritize
+  blend: at each defrag cadence the sim samples which nodes carry a
+  stranded gap (the fleetwatch trend's stand-in), and placements of new
+  arrivals are steered toward those nodes so small pods soak existing
+  holes instead of opening fresh ones. Weight 0 is byte-identical to
+  the react-only policy; :func:`sweep_forecast` runs the identical
+  trace both ways and reports whether forecasting held stranded
+  capacity down with strictly fewer migrations.
+
+CLI: ``python -m tpushare.sim --defrag [--budgets 0,1,2,4]
+[--frag-weight W]``.
 """
 
 from __future__ import annotations
@@ -40,6 +59,12 @@ class _SimState:
         # vid -> (node index, chip ids, per-chip demand, SimPod)
         self.active: dict[int, tuple[int, tuple[int, ...], int, SimPod]] = {}
         self._by_name = {n.name: i for i, n in enumerate(fleet.nodes)}
+        # node indices with a stranded gap, refreshed at the defrag
+        # cadence — the sim's stand-in for the fleetwatch sample the
+        # live FragForecast polls (deliberately stale between passes,
+        # exactly like the live bias)
+        self.frag_nodes: frozenset[int] = frozenset()
+        self.frag_pressure = 0.0
 
     def place(self, vid: int, ni: int, chip_ids: tuple[int, ...],
               demand: int, pod: SimPod) -> None:
@@ -96,8 +121,11 @@ class _SimState:
         """Execute a plan's moves directly on the fleet arrays (the sim
         has no apiserver to race, so every stamped move is still valid
         by construction). Returns moves applied."""
+        return self.apply_moves(plan.moves)
+
+    def apply_moves(self, selected) -> int:
         applied = 0
-        for m in plan.moves:
+        for m in selected:
             vid = int(m.pod_key)
             entry = self.active.get(vid)
             if entry is None:
@@ -113,26 +141,125 @@ class _SimState:
         """Fleet aggregate worst-tier stranded gap, in chips."""
         return sum(worst_tier(st)[1] for st in self.states())
 
+    def refresh_forecast(self) -> None:
+        """Recompute the scatter-bias node set and the fleet pressure
+        (same shape as FragForecast: 8x the stranded HBM fraction,
+        clamped to 1) — called at the defrag cadence only, so the bias
+        between passes runs on a stale sample like the live path does.
 
-def _try_place(state: _SimState, vid: int, pod: SimPod) -> bool:
-    """tpushare's binpack policy: tightest-scoring node wins."""
+        The bias set is every node that is already BROKEN — some chip
+        carries load, so the node can no longer offer a pristine
+        whole-mesh box. Steering hole-soakers there keeps untouched
+        boxes intact for gangs, which is how admission avoids
+        manufacturing the diagonal half-empty meshes defrag would
+        otherwise have to repair."""
+        frag = set()
+        stranded_mib = 0
+        for ni, st in enumerate(self.states()):
+            gap = worst_tier(st)[1]
+            if gap > 0:
+                stranded_mib += gap * st.hbm_per_chip
+            node = self.fleet.nodes[ni]
+            if any(u > 0 for u in node.used):
+                frag.add(ni)
+        total = self.fleet.total_hbm
+        self.frag_nodes = frozenset(frag)
+        self.frag_pressure = min(1.0, 8.0 * stranded_mib / total) \
+            if total else 0.0
+
+
+def _try_place(state: _SimState, vid: int, pod: SimPod,
+               frag_weight: float = 0.0) -> bool:
+    """tpushare's binpack policy: tightest-scoring node wins. With
+    ``frag_weight`` > 0 the choice mirrors the live Prioritize frag
+    blend: binpack scores are normalized to 0..10 across candidates and
+    blended against a 10-or-0 fragmentation priority at effective
+    weight ``frag_weight * pressure``, steering pods toward nodes that
+    already carry a stranded gap. Weight 0 takes the original code
+    path verbatim.
+
+    Only single-chip pods are steered: they are the hole-soakers. A
+    multi-chip mesh dropped onto a fragmented node would eat its
+    remaining contiguous box and make the stranding WORSE — the live
+    blend reaches the same end through the tier factor (gangs run
+    guaranteed and barely biased, scatter-tolerant singles run
+    best-effort at full weight)."""
     req = pod.request
-    best = None
+    f_eff = (frag_weight * state.frag_pressure
+             if req.chip_count <= 1 else 0.0)
+    if f_eff <= 0.0:
+        best = None
+        for ni, node in enumerate(state.fleet.nodes):
+            p = select_chips_py(node.views(), node.topo, req)
+            if p is not None and (best is None or p.score < best[1].score):
+                best = (ni, p)
+        if best is None:
+            return False
+        demand = req.chip_demand_mib(state.fleet.nodes[best[0]].hbm)
+        state.place(vid, best[0], best[1].chip_ids, demand, pod)
+        return True
+    cands = []
     for ni, node in enumerate(state.fleet.nodes):
         p = select_chips_py(node.views(), node.topo, req)
-        if p is not None and (best is None or p.score < best[1].score):
-            best = (ni, p)
-    if best is None:
+        if p is not None:
+            cands.append((ni, p))
+    if not cands:
         return False
+    lo = min(p.score for _ni, p in cands)
+    hi = max(p.score for _ni, p in cands)
+    best = None
+    best_key = None
+    for ni, p in cands:
+        # lower select score = tighter fit = higher priority, same
+        # normalization direction as the live handler's binpack score
+        score10 = 10.0 if hi == lo else 10.0 * (hi - p.score) / (hi - lo)
+        p_frag = 10.0 if ni in state.frag_nodes else 0.0
+        blended = round((1.0 - f_eff) * score10 + f_eff * p_frag)
+        key = (-blended, p.score, ni)  # deterministic tie-break
+        if best_key is None or key < best_key:
+            best, best_key = (ni, p), key
     demand = req.chip_demand_mib(state.fleet.nodes[best[0]].hbm)
     state.place(vid, best[0], best[1].chip_ids, demand, pod)
     return True
 
 
+#: migration pause model defaults: a fixed floor (engine park + RPC
+#: round-trips) plus footprint drained at a checkpoint write rate
+PAUSE_FLOOR_S = 0.25
+CKPT_MIB_PER_S = 2048.0
+
+
+def _move_pause_s(m, ckpt_mib_per_s: float = CKPT_MIB_PER_S,
+                  floor_s: float = PAUSE_FLOOR_S) -> float:
+    """Deterministic modeled pause for one move: the victim's full HBM
+    footprint checkpointed then restored at ``ckpt_mib_per_s``."""
+    footprint_mib = len(m.victim_chip_ids) * m.per_chip_mib
+    return floor_s + footprint_mib / ckpt_mib_per_s
+
+
 def run_defrag_sim(fleet: Fleet, trace: list[SimPod], budget: int,
-                   defrag_period: float = 20.0) -> dict[str, Any]:
+                   defrag_period: float = 20.0,
+                   frag_weight: float = 0.0,
+                   pause_budget_s: float | None = None,
+                   ckpt_mib_per_s: float = CKPT_MIB_PER_S,
+                   stranded_target_chips: int | None = None
+                   ) -> dict[str, Any]:
     """One churn replay with a defrag pass every ``defrag_period`` time
     units, ``budget`` moves per pass (0 = control: plan but never act).
+
+    ``frag_weight`` > 0 turns on the forecast placement bias (see
+    :func:`_try_place`); ``pause_budget_s`` aborts any planned move
+    whose modeled pause exceeds the budget, mirroring the executor's
+    ``TPUSHARE_MIGRATE_PAUSE_BUDGET_S`` rollback.
+
+    ``stranded_target_chips`` switches the pass trigger from
+    react-only (repack whenever ANY chip is stranded — migrations chase
+    zero) to pressure-gated (repack only once the stranded gap exceeds
+    the target). Every migration is a paused workload, so the
+    forecast policy tolerates gaps the fleet can absorb and spends
+    pauses only when the SLO is actually threatened; the admission bias
+    is what keeps the below-target drift from compounding between
+    passes.
     """
     state = _SimState(fleet)
     events: list[tuple[float, int, str, Any]] = []
@@ -155,6 +282,9 @@ def run_defrag_sim(fleet: Fleet, trace: list[SimPod], budget: int,
     moves = passes = 0
     stranded_pre = stranded_post = 0
     placed_count = 0
+    pauses: list[float] = []
+    aborted_over_budget = 0
+    max_stranded = 0
 
     def advance(to: float) -> None:
         nonlocal now, util_integral
@@ -165,7 +295,7 @@ def run_defrag_sim(fleet: Fleet, trace: list[SimPod], budget: int,
         nonlocal placed_count
         still = []
         for vid, pod in pending:
-            if _try_place(state, vid, pod):
+            if _try_place(state, vid, pod, frag_weight):
                 placed_at[vid] = now
                 waits.append(now - pod.arrival)
                 placed_count += 1
@@ -180,7 +310,7 @@ def run_defrag_sim(fleet: Fleet, trace: list[SimPod], budget: int,
         advance(when)
         if kind == "arrive":
             vid, pod = payload
-            if _try_place(state, vid, pod):
+            if _try_place(state, vid, pod, frag_weight):
                 placed_at[vid] = now
                 waits.append(0.0)
                 placed_count += 1
@@ -195,15 +325,28 @@ def run_defrag_sim(fleet: Fleet, trace: list[SimPod], budget: int,
         elif kind == "defrag":
             passes += 1
             pre = state.stranded_chips()
-            if pre > 0:
+            max_stranded = max(max_stranded, pre)
+            act = (pre > 0 if stranded_target_chips is None
+                   else pre > stranded_target_chips)
+            if act:
                 plan = plan_moves(state.states(), state.solve, budget,
                                   per_node=budget)
                 if budget > 0 and plan.moves:
-                    moves += state.apply_plan(plan)
+                    for m in plan.moves:
+                        pause = _move_pause_s(m, ckpt_mib_per_s)
+                        if (pause_budget_s is not None
+                                and pause > pause_budget_s):
+                            aborted_over_budget += 1
+                            continue
+                        if state.apply_moves([m]):
+                            moves += 1
+                            pauses.append(pause)
                     retry_pending()
             post = state.stranded_chips()
             stranded_pre += pre
             stranded_post += post
+            if frag_weight > 0.0:
+                state.refresh_forecast()
             if events or state.active:
                 heapq.heappush(events, (now + defrag_period, seq,
                                         "defrag", None))
@@ -214,12 +357,30 @@ def run_defrag_sim(fleet: Fleet, trace: list[SimPod], budget: int,
         if waits_sorted else 0.0
     recovery = ((stranded_pre - stranded_post) / stranded_pre * 100.0
                 if stranded_pre else 0.0)
+    pauses_sorted = sorted(pauses)
+
+    def _pq(q: float) -> float:
+        if not pauses_sorted:
+            return 0.0
+        return pauses_sorted[int(q * (len(pauses_sorted) - 1))]
+
     return {
         "budget": budget,
+        "frag_weight": frag_weight,
         "defrag_passes": passes,
         "moves": moves,
+        "migration": {
+            "pauses": len(pauses),
+            "pause_p50_s": round(_pq(0.50), 4),
+            "pause_p99_s": round(_pq(0.99), 4),
+            "aborted_over_budget": aborted_over_budget,
+        },
+        "stranded_target_chips": stranded_target_chips,
         "stranded_chips_observed": stranded_pre,
         "stranded_chips_after": stranded_post,
+        "avg_stranded_chips_per_pass": round(stranded_pre / passes, 3)
+        if passes else 0.0,
+        "max_stranded_chips": max_stranded,
         "recovery_pct": round(recovery, 2),
         "pods": len(trace),
         "placed": placed_count,
@@ -255,3 +416,57 @@ def sweep_budgets(budgets=(0, 1, 2, 4), n_nodes: int = 8, chips: int = 4,
         out.append(run_defrag_sim(fleet, trace, budget,
                                   defrag_period=defrag_period))
     return out
+
+
+def sweep_forecast(frag_weight: float = 0.6, budget: int = 2,
+                   stranded_target_chips: int = 3,
+                   n_nodes: int = 8, chips: int = 4, hbm: int = 16384,
+                   mesh: tuple[int, ...] | None = (2, 2),
+                   spec: TraceSpec | None = None,
+                   defrag_period: float = 20.0,
+                   pause_budget_s: float | None = None) -> dict[str, Any]:
+    """The migration A/B the tentpole ships on: the IDENTICAL trace run
+    two ways with the same move budget.
+
+    - **react** — ``frag_weight=0``, no stranded target: defrag chases
+      every stranded chip back to zero, paying a workload pause per
+      move.
+    - **forecast** — admission steers hole-soakers under fragmentation
+      pressure and repack triggers only once the stranded gap exceeds
+      ``stranded_target_chips``.
+
+    The verdict keys say whether the forecast run held average stranded
+    capacity below the target while performing STRICTLY fewer
+    migrations — the claim the live ``TPUSHARE_FRAG_WEIGHT`` knob
+    ships on: tolerate the fragmentation the fleet can absorb, spend
+    checkpoint pauses only when the SLO is threatened."""
+    spec = spec or TraceSpec(
+        n_pods=300, arrival_rate=0.5, mean_duration=40.0,
+        sizes=(8192, 12288, 16384), multi_chip_fraction=0.3, seed=7)
+    trace = synth_trace(spec)
+    runs = {}
+    for label, w, tgt in (("react", 0.0, None),
+                          ("forecast", frag_weight, stranded_target_chips)):
+        fleet = Fleet.homogeneous(n_nodes, chips, hbm, mesh)
+        runs[label] = run_defrag_sim(
+            fleet, trace, budget, defrag_period=defrag_period,
+            frag_weight=w, pause_budget_s=pause_budget_s,
+            stranded_target_chips=tgt)
+    react, fore = runs["react"], runs["forecast"]
+    return {
+        "frag_weight": frag_weight,
+        "budget": budget,
+        "stranded_target_chips": stranded_target_chips,
+        "react": react,
+        "forecast": fore,
+        "verdict": {
+            "react_moves": react["moves"],
+            "forecast_moves": fore["moves"],
+            "fewer_migrations": fore["moves"] < react["moves"],
+            "react_avg_stranded": react["avg_stranded_chips_per_pass"],
+            "forecast_avg_stranded": fore["avg_stranded_chips_per_pass"],
+            "stranded_held_below_target": (
+                fore["avg_stranded_chips_per_pass"]
+                <= stranded_target_chips),
+        },
+    }

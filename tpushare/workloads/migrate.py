@@ -1,0 +1,138 @@
+"""Workload-side live-migration wiring (defrag/migration.py's seams).
+
+The defrag executor's :class:`~tpushare.defrag.migration.Migrator` is
+duck-typed so the scheduler layer never imports jax; this module is the
+place the REAL workloads plug in:
+
+- ``frontend_for`` comes from :mod:`tpushare.workloads.serve`'s
+  process-local registry: a serving replica registers its engine
+  frontend at startup, and the migration session parks it at a quantum
+  boundary before the checkpoint reads state.
+- ``checkpointer`` dispatches per victim through a process-local
+  handler registry. A training workload registers a
+  :class:`TrainStateHandler` (orbax-backed
+  :class:`~tpushare.workloads.checkpoint.TrainCheckpointer` underneath
+  — sharded save, cross-mesh restore); anything registered must expose
+  ``save(pod, move)`` / ``restore(pod, move)``. Victims with no handler
+  still get a durable MANIFEST (who moved where, when) under
+  ``TPUSHARE_MIGRATE_CKPT_DIR`` so an operator can audit every move
+  even for annotation-only workloads.
+
+Everything jax-flavored is imported lazily: constructing the default
+migrator in the extender process costs nothing and pulls in nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+_HANDLERS: dict[str, Any] = {}
+_HANDLERS_LOCK = threading.Lock()
+
+
+def _pod_name(pod: Any) -> str:
+    if isinstance(pod, str):
+        return pod
+    return ((pod or {}).get("metadata") or {}).get("name") or ""
+
+
+def register_checkpointer(name: str, handler: Any) -> None:
+    """Register a per-workload checkpoint handler (``save(pod, move)``/
+    ``restore(pod, move)``) under the workload's pod name."""
+    with _HANDLERS_LOCK:
+        _HANDLERS[name] = handler
+
+
+def unregister_checkpointer(name: str) -> None:
+    with _HANDLERS_LOCK:
+        _HANDLERS.pop(name, None)
+
+
+class WorkloadCheckpointer:
+    """The Migrator's ``checkpointer`` seam: dispatch to the victim's
+    registered handler, and (when a directory is configured) persist a
+    per-move manifest so the move sequence is auditable after the
+    fact. A handler failure propagates — the session aborts and the
+    executor rolls the victim back; a manifest IO failure does too,
+    because 'durable before evict' is the whole contract."""
+
+    def __init__(self, directory: str | None = None) -> None:
+        self._dir = directory
+
+    def _manifest(self, phase: str, pod: Any, move: Any) -> None:
+        if not self._dir:
+            return
+        os.makedirs(self._dir, exist_ok=True)
+        name = _pod_name(pod) or "unknown"
+        path = os.path.join(self._dir, f"{name}.migration.json")
+        record = {"phase": phase, "pod": name,
+                  "time_unix": round(time.time(), 3),
+                  "move": move.to_dict() if hasattr(move, "to_dict")
+                  else str(move)}
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(record, f, sort_keys=True)
+        os.replace(tmp, path)  # atomic: a partial write is never visible
+
+    def save(self, pod: Any, move: Any) -> None:
+        with _HANDLERS_LOCK:
+            handler = _HANDLERS.get(_pod_name(pod))
+        if handler is not None:
+            handler.save(pod, move)
+        self._manifest("checkpointed", pod, move)
+
+    def restore(self, pod: Any, move: Any) -> None:
+        with _HANDLERS_LOCK:
+            handler = _HANDLERS.get(_pod_name(pod))
+        if handler is not None:
+            handler.restore(pod, move)
+        self._manifest("restored", pod, move)
+
+
+class TrainStateHandler:
+    """Adapter from a live training loop to the migration seam: the
+    loop supplies ``state_fn() -> (step, params, opt_state, cfg)`` and
+    ``tx`` (its optax transform), and save/restore delegate to the
+    orbax-backed :class:`TrainCheckpointer` — sharded save, cross-mesh
+    restore, so a re-placed gang resumes on a DIFFERENT slice shape.
+    jax/orbax load on first construction, never at import."""
+
+    def __init__(self, directory: str, state_fn, tx, mesh=None,
+                 keep: int = 3) -> None:
+        from tpushare.workloads.checkpoint import TrainCheckpointer
+        self._ckpt = TrainCheckpointer(directory, keep=keep)
+        self._state_fn = state_fn
+        self._tx = tx
+        self._mesh = mesh
+        self._restored: Any = None
+
+    @property
+    def restored(self) -> Any:
+        """The (step, params, opt_state) the last restore produced —
+        the training loop picks it up when its pod re-enters the run."""
+        return self._restored
+
+    def save(self, pod: Any, move: Any) -> None:
+        step, params, opt_state, cfg = self._state_fn()
+        self._ckpt.save(step, params, opt_state, cfg)  # blocks: durable
+
+    def restore(self, pod: Any, move: Any) -> None:
+        _step, _params, _opt, cfg = self._state_fn()
+        self._restored = self._ckpt.restore(cfg, self._tx,
+                                            mesh=self._mesh)
+
+
+def default_migrator():
+    """The production Migrator: serve-registry frontends + the handler
+    dispatch checkpointer (manifests under ``TPUSHARE_MIGRATE_CKPT_DIR``
+    when set). Costs nothing until a move actually runs."""
+    from tpushare.defrag.migration import Migrator
+    from tpushare.workloads import serve
+    return Migrator(
+        checkpointer=WorkloadCheckpointer(
+            os.environ.get("TPUSHARE_MIGRATE_CKPT_DIR")),
+        frontend_for=serve.frontend_for)

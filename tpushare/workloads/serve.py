@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import queue
 import sys
 import threading
@@ -112,6 +113,12 @@ class _EngineFrontend:
         self._tokens = tokens_counter
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        # live-migration pause (defrag checkpoint->evict->restore): the
+        # mover parks the loop AT A QUANTUM BOUNDARY so KV state is
+        # consistent when the checkpoint reads it; requests keep queuing
+        # while paused and drain on resume
+        self._paused = threading.Event()
+        self._quiesced = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="decode-engine")
 
@@ -135,6 +142,24 @@ class _EngineFrontend:
         a stuck dispatch cannot block process exit)."""
         if self._thread.is_alive():
             self._thread.join(timeout)
+
+    def pause(self, timeout: float = 5.0) -> bool:
+        """Park the engine loop at the next quantum boundary; returns
+        once it is quiescent (no quantum in flight, KV state stable —
+        safe to checkpoint) or False on timeout. Idempotent; requests
+        submitted while paused queue up and are admitted on resume."""
+        self._paused.set()
+        if not self._thread.is_alive():
+            return True  # nothing running: trivially quiescent
+        return self._quiesced.wait(timeout)
+
+    def resume(self) -> None:
+        """Lift a pause(); the loop re-admits and advances immediately."""
+        self._paused.clear()
+
+    @property
+    def paused(self) -> bool:
+        return self._paused.is_set()
 
     def generate(self, prompt: list[int], max_new: int,
                  timeout: float = 300.0,
@@ -213,6 +238,14 @@ class _EngineFrontend:
     def _loop(self):
         inflight: dict[int, tuple] = {}  # rid -> (done, box)
         while not self._stop.is_set():
+            if self._paused.is_set():
+                # quiescent: the previous quantum fully completed, so
+                # the engine's KV/slot state is a consistent snapshot
+                # for the duration of the pause
+                self._quiesced.set()
+                self._stop.wait(0.005)
+                continue
+            self._quiesced.clear()
             # admit as many queued requests as there are free slots;
             # park until work arrives when fully idle
             while self._engine.free_slots:
@@ -287,6 +320,35 @@ class _EngineFrontend:
             if "stream" in box:
                 box["stream"].put(("error", box["error"]))
             done.set()
+
+
+# -- live-migration seam (defrag/migration.py) --------------------------------
+# Process-local registry: workload name -> serve frontend. A serving
+# replica registers its engine frontend at startup; a co-resident
+# migrator resolves its victim's loop here to park it at a quantum
+# boundary before checkpointing. Out-of-process deployments supply
+# their own frontend_for seam instead (the Migrator is duck-typed).
+_FRONTENDS: dict[str, _EngineFrontend] = {}
+_FRONTENDS_LOCK = threading.Lock()
+
+
+def register_frontend(name: str, frontend: _EngineFrontend) -> None:
+    with _FRONTENDS_LOCK:
+        _FRONTENDS[name] = frontend
+
+
+def unregister_frontend(name: str) -> None:
+    with _FRONTENDS_LOCK:
+        _FRONTENDS.pop(name, None)
+
+
+def frontend_for(pod) -> _EngineFrontend | None:
+    """The registered serve frontend for a victim pod (dict or name),
+    or None — a victim with no serve loop just checkpoints."""
+    name = pod if isinstance(pod, str) else \
+        ((pod.get("metadata") or {}).get("name") or "")
+    with _FRONTENDS_LOCK:
+        return _FRONTENDS.get(name)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -477,6 +539,10 @@ def main(argv: list[str] | None = None) -> int:
                          rolling=args.rolling_kv),
             tokens_counter=m_tokens)
         engine_front.start()
+        # visible to a co-resident live-migration session (POD_NAME is
+        # the downward-API name under Kubernetes; fall back to preset)
+        register_frontend(os.environ.get("POD_NAME") or args.preset,
+                          engine_front)
         registry.gauge_func(
             "tpushare_serve_engine_slots",
             "decode-engine slot pool occupancy",
